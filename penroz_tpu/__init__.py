@@ -1,0 +1,17 @@
+"""penroz_tpu — a TPU-native (JAX/XLA/Pallas) neural-network model service.
+
+A ground-up re-design of the capabilities of
+``derinworks/penr-oz-neural-network-v3-torch-ddp`` (see SURVEY.md) for TPU:
+
+- JSON layer/optimizer DSL compiled once into a functional module tree whose
+  parameter names mirror the reference's ``state_dict`` keys
+  (reference: mappers.py:19-99).
+- ``jax.value_and_grad`` + optax training under ``jax.jit`` with sharding over a
+  ``jax.sharding.Mesh`` instead of subprocess DDP (reference: ddp.py:38-85).
+- Preallocated functional KV cache with optional int8 TurboQuant
+  (reference: kv_cache.py) threaded through a jitted decode step.
+- An aiohttp web service exposing the same 15-route REST surface
+  (reference: main.py).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,124 @@
+"""Attention math: RoPE, causal (training/prefill) and cached (decode) paths.
+
+All functions are pure and jit-traceable.  GQA is computed by reshaping the
+query heads into ``(kv_heads, group)`` and contracting against un-expanded K/V
+— no materialized head expansion (the reference expands KV heads to full query
+head count before attending: neural_net_layers.py:76-81).
+
+On TPU the causal path dispatches to a Pallas flash-attention kernel
+(ops/pallas/flash_attention.py) when shapes allow; the jnp fallback below is
+also the correctness oracle for the kernel tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype):
+    """cos/sin tables of shape (length, head_dim) starting at ``offset``."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = offset.astype(jnp.float32) + jnp.arange(length, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(q, k, theta: float, offset):
+    """Apply rotary embeddings to (B, H, T, D) query/key tensors."""
+    head_dim = q.shape[-1]
+    cos, sin = rope_cos_sin(head_dim, theta, offset, q.shape[2], q.dtype)
+    cos, sin = cos[None, None], sin[None, None]
+    q = q * cos + _rotate_half(q) * sin
+    k = k * cos + _rotate_half(k) * sin
+    return q, k
+
+
+def _group_query_heads(q, num_kv_heads: int):
+    """(B, Hq, T, D) -> (B, Hkv, G, T, D) where G = Hq // Hkv."""
+    B, Hq, T, D = q.shape
+    group = Hq // num_kv_heads
+    return q.reshape(B, num_kv_heads, group, T, D)
+
+
+def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None):
+    """Masked softmax attention with grouped query heads.
+
+    q: (B, Hkv, G, T, D); k, v: (B, Hkv, S, D); mask: broadcastable to
+    (B, Hkv, G, T, S) with True = attend.
+    """
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    logits = jnp.einsum("bhgtd,bhsd->bhgts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhgts,bhsd->bhgtd", probs, v)
+
+
+def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None):
+    """Pure-jnp causal attention. q: (B, Hq, T, D); k, v: (B, Hkv, T, D)."""
+    B, Hq, T, D = q.shape
+    num_kv_heads = k.shape[1]
+    qg = _group_query_heads(q, num_kv_heads)
+    mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+    out = _attend(qg, k, v, mask, dropout_rate, dropout_rng)
+    return out.reshape(B, Hq, T, D)
+
+
+def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None):
+    """Causal self-attention; dispatches to the Pallas kernel on TPU."""
+    if dropout_rate == 0.0 and _use_flash(q, k):
+        from penroz_tpu.ops.pallas import flash_attention as fa
+        return fa.flash_attention(q, k, v, causal=True)
+    return causal_attention_reference(q, k, v, dropout_rate, dropout_rng)
+
+
+def cached_attention(q, k_full, v_full, offset, length,
+                     dropout_rate=0.0, dropout_rng=None):
+    """Attention over a preallocated KV cache.
+
+    q: (B, Hq, T, D) new queries at positions ``offset + [0, T)``.
+    k_full/v_full: (B, Hkv, S_max, D) cache contents after the current append.
+    ``length`` is the total valid length (offset + T).  Keys at index j are
+    attended when ``j <= offset + t`` (combined causal + validity mask).
+    """
+    B, Hq, T, D = q.shape
+    S = k_full.shape[2]
+    num_kv_heads = k_full.shape[1]
+    qg = _group_query_heads(q, num_kv_heads)
+    q_pos = offset + jnp.arange(T, dtype=jnp.int32)
+    key_idx = jnp.arange(S, dtype=jnp.int32)
+    mask = key_idx[None, :] <= q_pos[:, None]  # (T, S)
+    out = _attend(qg, k_full, v_full, mask, dropout_rate, dropout_rng)
+    return out.reshape(B, Hq, T, D)
+
+
+def _use_flash(q, k) -> bool:
+    """Whether the Pallas flash kernel applies to these shapes/platform."""
+    try:
+        platform = q.devices().pop().platform if hasattr(q, "devices") else \
+            jax.default_backend()
+    except Exception:
+        platform = jax.default_backend()
+    if platform not in ("tpu", "axon"):
+        return False
+    B, Hq, T, D = q.shape
+    Hkv = k.shape[1]
+    # MXU-friendly: head dim multiple of 128 lane requirement handled by the
+    # kernel via padding; sequence must be long enough to tile.
+    return T >= 128 and T % 128 == 0 and D in (64, 128, 256) and Hq % Hkv == 0

@@ -1,0 +1,335 @@
+"""KV cache for autoregressive decoding.
+
+Two layers of abstraction:
+
+- ``KVState`` / ``QuantKVState`` — functional, *preallocated* per-layer HBM
+  buffers threaded through the jitted decode step.  Appends are
+  ``lax.dynamic_update_slice`` writes at the current length; a single scalar
+  ``length`` is shared by all layers and advanced once per model step.  This
+  replaces the reference's grow-by-concat mutable cache (kv_cache.py:41-68)
+  with a static-shape design XLA can compile once.
+
+- ``KVCache`` / ``TurboQuantKVCache`` — small Python wrappers carrying
+  ``KVCacheMetrics`` and the reference's append/get/clear/seq_len surface
+  (kv_cache.py:25-206) for API/test parity and observability.  The int8
+  "TurboQuant" variant stores values with per-token scales and dequantizes on
+  read (kv_cache.py:101-195); the same env flag ``TURBO_QUANT_KV_CACHE=1``
+  selects it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger(__name__)
+
+TURBO_QUANT_ENV = "TURBO_QUANT_KV_CACHE"
+
+
+def turbo_quant_enabled() -> bool:
+    return os.environ.get(TURBO_QUANT_ENV, "0") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Functional state (hot path)
+# ---------------------------------------------------------------------------
+
+def _quantize_int8(t):
+    """Per-token int8 quantization: scale = amax over head dim / 127."""
+    abs_max = jnp.max(jnp.abs(t), axis=-1, keepdims=True)
+    scale = abs_max / 127.0
+    scale = jnp.where(scale == 0, jnp.ones_like(scale), scale)
+    q = jnp.clip(jnp.round(t / scale), -128, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _dequantize_int8(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+class KVState:
+    """Preallocated functional KV buffers: per-layer (B, Hkv, S_max, D)."""
+
+    quantized = False
+
+    def __init__(self, k, v, length):
+        self.k = list(k)
+        self.v = list(v)
+        self.length = length
+
+    def tree_flatten(self):
+        return (tuple(self.k), tuple(self.v), self.length), len(self.k)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k, v, length = children
+        return cls(list(k), list(v), length)
+
+    @classmethod
+    def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32):
+        """``specs``: per-attention-layer (num_kv_heads, head_dim)."""
+        k = [jnp.zeros((batch, h, max_len, d), dtype) for h, d in specs]
+        v = [jnp.zeros((batch, h, max_len, d), dtype) for h, d in specs]
+        return cls(k, v, jnp.zeros((), jnp.int32))
+
+    @property
+    def max_len(self) -> int:
+        return self.k[0].shape[2] if self.k else 0
+
+    def append(self, layer_idx: int, k_new, v_new):
+        """Write new K/V at the current length; returns full buffers.
+
+        Does NOT advance ``length`` — the model runtime advances it once per
+        step via ``advanced(T)`` after all layers have appended.
+        """
+        start = (0, 0, self.length, 0)
+        self.k[layer_idx] = jax.lax.dynamic_update_slice(
+            self.k[layer_idx], k_new.astype(self.k[layer_idx].dtype), start)
+        self.v[layer_idx] = jax.lax.dynamic_update_slice(
+            self.v[layer_idx], v_new.astype(self.v[layer_idx].dtype), start)
+        new_length = self.length + k_new.shape[2]
+        return self.k[layer_idx], self.v[layer_idx], new_length
+
+    def advanced(self, num_tokens: int):
+        """State with length advanced by ``num_tokens`` (post-step)."""
+        out = type(self)(list(self.k), list(self.v), self.length + num_tokens)
+        return self._copy_extras(out)
+
+    def reset(self):
+        out = type(self)(list(self.k), list(self.v), jnp.zeros((), jnp.int32))
+        return self._copy_extras(out)
+
+    def _copy_extras(self, out):
+        return out
+
+    # Observability: bytes resident in HBM for this cache.
+    def memory_bytes(self) -> int:
+        return sum(int(a.size) * a.dtype.itemsize for a in (*self.k, *self.v))
+
+    def logical_bytes(self) -> int:
+        """Bytes an unquantized fp cache of the same shape would occupy."""
+        return self.memory_bytes()
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantKVState(KVState):
+    """Int8 KV buffers with per-token scales (TurboQuant)."""
+
+    quantized = True
+
+    def __init__(self, k, v, length, k_scale, v_scale, out_dtype=jnp.float32):
+        super().__init__(k, v, length)
+        self.k_scale = list(k_scale)
+        self.v_scale = list(v_scale)
+        self.out_dtype = out_dtype
+
+    def tree_flatten(self):
+        children = (tuple(self.k), tuple(self.v), self.length,
+                    tuple(self.k_scale), tuple(self.v_scale))
+        return children, (len(self.k), self.out_dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k, v, length, k_scale, v_scale = children
+        return cls(list(k), list(v), length, list(k_scale), list(v_scale),
+                   out_dtype=aux[1])
+
+    @classmethod
+    def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32):
+        k = [jnp.zeros((batch, h, max_len, d), jnp.int8) for h, d in specs]
+        v = [jnp.zeros((batch, h, max_len, d), jnp.int8) for h, d in specs]
+        ks = [jnp.zeros((batch, h, max_len, 1), jnp.float32) for h, _ in specs]
+        vs = [jnp.zeros((batch, h, max_len, 1), jnp.float32) for h, _ in specs]
+        return cls(k, v, jnp.zeros((), jnp.int32), ks, vs, out_dtype=dtype)
+
+    def append(self, layer_idx: int, k_new, v_new):
+        qk, sk = _quantize_int8(k_new)
+        qv, sv = _quantize_int8(v_new)
+        start = (0, 0, self.length, 0)
+        self.k[layer_idx] = jax.lax.dynamic_update_slice(self.k[layer_idx], qk, start)
+        self.v[layer_idx] = jax.lax.dynamic_update_slice(self.v[layer_idx], qv, start)
+        self.k_scale[layer_idx] = jax.lax.dynamic_update_slice(self.k_scale[layer_idx], sk, start)
+        self.v_scale[layer_idx] = jax.lax.dynamic_update_slice(self.v_scale[layer_idx], sv, start)
+        new_length = self.length + k_new.shape[2]
+        k_full = _dequantize_int8(self.k[layer_idx], self.k_scale[layer_idx], self.out_dtype)
+        v_full = _dequantize_int8(self.v[layer_idx], self.v_scale[layer_idx], self.out_dtype)
+        return k_full, v_full, new_length
+
+    def _copy_extras(self, out):
+        out.k_scale = list(self.k_scale)
+        out.v_scale = list(self.v_scale)
+        out.out_dtype = self.out_dtype
+        return out
+
+    def logical_bytes(self) -> int:
+        itemsize = jnp.dtype(self.out_dtype).itemsize
+        return sum(int(a.size) * itemsize for a in (*self.k, *self.v))
+
+
+def create_kv_state(specs, batch: int, max_len: int, dtype=jnp.float32,
+                    quantized: bool | None = None) -> KVState:
+    """Factory honoring the ``TURBO_QUANT_KV_CACHE=1`` env flag."""
+    if quantized is None:
+        quantized = turbo_quant_enabled()
+    if quantized:
+        log.info("TurboQuant KV cache enabled (%s=1)", TURBO_QUANT_ENV)
+        return QuantKVState.create(specs, batch, max_len, dtype)
+    return KVState.create(specs, batch, max_len, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Metrics + API-parity wrappers
+# ---------------------------------------------------------------------------
+
+@dataclass
+class KVCacheMetrics:
+    """Lightweight metrics for KV cache usage (parity: kv_cache.py:14-22)."""
+    num_appends: int = 0
+    total_entries: int = 0
+    memory_bytes: int = 0
+    compressed_memory_bytes: int = 0
+    compression_ratio: float = 1.0
+    last_append_latency_ms: float = 0.0
+
+
+class KVCache:
+    """Dynamically growing per-layer KV store with metrics.
+
+    Used for observability and standalone (non-jit) decode; the jitted decode
+    path uses ``KVState``.  Float inputs are stored as-is.
+    """
+
+    def __init__(self, num_layers: int = 0):
+        self._num_layers = num_layers
+        self._keys = [None] * num_layers
+        self._values = [None] * num_layers
+        self._metrics = KVCacheMetrics()
+
+    @property
+    def metrics(self) -> KVCacheMetrics:
+        return self._metrics
+
+    def _store(self, layer_idx, key, value):
+        if self._keys[layer_idx] is not None:
+            key = jnp.concatenate([self._keys[layer_idx], key], axis=2)
+            value = jnp.concatenate([self._values[layer_idx], value], axis=2)
+        self._keys[layer_idx] = key
+        self._values[layer_idx] = value
+        return key, value
+
+    def append(self, layer_idx: int, key, value):
+        """Append (B, H, S_new, D) K/V; returns accumulated full tensors."""
+        t0 = time.monotonic()
+        key, value = jnp.asarray(key), jnp.asarray(value)
+        new_bytes = key.size * key.dtype.itemsize + value.size * value.dtype.itemsize
+        full_key, full_value = self._store(layer_idx, key, value)
+        m = self._metrics
+        m.num_appends += 1
+        m.total_entries += key.shape[2]
+        m.memory_bytes += int(new_bytes)
+        m.compressed_memory_bytes = m.memory_bytes
+        m.compression_ratio = 1.0
+        m.last_append_latency_ms = (time.monotonic() - t0) * 1000
+        return full_key, full_value
+
+    def get(self, layer_idx: int):
+        return self._keys[layer_idx], self._values[layer_idx]
+
+    def clear(self):
+        self._keys = [None] * self._num_layers
+        self._values = [None] * self._num_layers
+        self._metrics = KVCacheMetrics()
+
+    def seq_len(self, layer_idx: int = 0) -> int:
+        k = self._keys[layer_idx]
+        return int(k.shape[2]) if k is not None else 0
+
+    def record_step(self, num_tokens: int, logical_bytes: int,
+                    stored_bytes: int, latency_ms: float = 0.0):
+        """Metrics update from the jitted decode path (one call per step)."""
+        m = self._metrics
+        m.num_appends += 1
+        m.total_entries += num_tokens
+        m.memory_bytes = int(logical_bytes)
+        m.compressed_memory_bytes = int(stored_bytes)
+        m.compression_ratio = (m.memory_bytes / m.compressed_memory_bytes
+                               if m.compressed_memory_bytes else 1.0)
+        m.last_append_latency_ms = latency_ms
+
+    def log_metrics(self):
+        m = self._metrics
+        log.info(
+            "KVCache metrics: entries=%d, memory=%.1fKB, "
+            "compression_ratio=%.2f, last_append=%.3fms",
+            m.total_entries, m.memory_bytes / 1024, m.compression_ratio,
+            m.last_append_latency_ms)
+
+
+class TurboQuantKVCache(KVCache):
+    """Int8 + per-token-scale variant of :class:`KVCache`."""
+
+    def __init__(self, num_layers: int = 0):
+        super().__init__(num_layers)
+        self._scales_k = [None] * num_layers
+        self._scales_v = [None] * num_layers
+
+    @staticmethod
+    def _quantize(tensor):
+        return _quantize_int8(jnp.asarray(tensor))
+
+    @staticmethod
+    def _dequantize(quantized, scale):
+        return quantized.astype(jnp.float32) * scale
+
+    def append(self, layer_idx: int, key, value):
+        t0 = time.monotonic()
+        key, value = jnp.asarray(key), jnp.asarray(value)
+        q_key, s_key = self._quantize(key)
+        q_value, s_value = self._quantize(value)
+        compressed_new = sum(int(t.size) * t.dtype.itemsize
+                             for t in (q_key, q_value, s_key, s_value))
+
+        if self._keys[layer_idx] is not None:
+            q_key = jnp.concatenate([self._keys[layer_idx], q_key], axis=2)
+            q_value = jnp.concatenate([self._values[layer_idx], q_value], axis=2)
+            s_key = jnp.concatenate([self._scales_k[layer_idx], s_key], axis=2)
+            s_value = jnp.concatenate([self._scales_v[layer_idx], s_value], axis=2)
+        self._keys[layer_idx] = q_key
+        self._values[layer_idx] = q_value
+        self._scales_k[layer_idx] = s_key
+        self._scales_v[layer_idx] = s_value
+
+        full_key = self._dequantize(q_key, s_key)
+        full_value = self._dequantize(q_value, s_value)
+
+        m = self._metrics
+        m.num_appends += 1
+        m.total_entries += key.shape[2]
+        uncompressed_new = (key.size * key.dtype.itemsize
+                            + value.size * value.dtype.itemsize)
+        m.compressed_memory_bytes += int(compressed_new)
+        m.memory_bytes += int(uncompressed_new)
+        m.compression_ratio = (m.memory_bytes / m.compressed_memory_bytes
+                               if m.compressed_memory_bytes > 0 else 1.0)
+        m.last_append_latency_ms = (time.monotonic() - t0) * 1000
+        return full_key, full_value
+
+    def clear(self):
+        super().clear()
+        self._scales_k = [None] * self._num_layers
+        self._scales_v = [None] * self._num_layers
+
+
+def create_kv_cache(num_layers: int) -> KVCache:
+    """Factory: TurboQuant or plain cache based on the env flag."""
+    if turbo_quant_enabled():
+        log.info("TurboQuant KV cache enabled (%s=1)", TURBO_QUANT_ENV)
+        return TurboQuantKVCache(num_layers)
+    return KVCache(num_layers)

@@ -1,0 +1,517 @@
+"""Functional layer modules for the JSON layer DSL.
+
+Every module is a lightweight Python object that knows how to
+
+- ``init(rng)``  -> flat dict of parameter arrays, and
+- ``apply(x, ctx)`` -> output array,
+
+where parameters live in a single flat ``{"layers.0.0.weight": Array}`` dict
+whose key names mirror the reference implementation's torch ``state_dict``
+naming (reference: neural_net_model.py:58, mappers.py:318-448).  Keeping the
+flat naming makes checkpoint round-trips and HuggingFace weight mapping pure
+table lookups, while the apply path stays a pure function that ``jax.jit`` can
+trace once per shape.
+
+Design notes (TPU-first):
+- No module mutates state.  Batch-norm running statistics are "buffers" kept in
+  a separate flat dict; updated values are written into ``ctx.buffer_updates``
+  during trace and returned from the jitted caller.
+- The KV cache is a pytree threaded through ``ctx.kv`` (see ops/kv_cache.py);
+  attention layers never hold references to it (reference mutates modules:
+  neural_net_layers.py:24-31).
+- Position offsets are dynamic scalar arrays (``ctx.pos_offset``) so a single
+  compiled decode step serves every generation position (reference mutates
+  ``PositionEmbedding.position_offset``: neural_net_layers.py:98-118).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from penroz_tpu.ops import attention as attn_ops
+
+
+class Ctx:
+    """Per-call context threaded through module application.
+
+    Holds the parameter/buffer dicts plus dynamic state (PRNG key, KV cache,
+    position offset).  Constructed fresh inside each jitted function, so its
+    attributes may freely hold traced arrays.
+    """
+
+    def __init__(self, params, buffers=None, *, training=False, rng=None,
+                 kv=None, pos_offset=None, compute_dtype=None):
+        self.params = params
+        self.buffers = buffers or {}
+        self.training = training
+        self.rng = rng
+        self.kv = kv  # ops.kv_cache.KVState or None
+        self.pos_offset = pos_offset  # scalar int32 array or None
+        self.compute_dtype = compute_dtype
+        self.buffer_updates = {}
+        self._rng_counter = 0
+
+    def next_rng(self):
+        if self.rng is None:
+            raise ValueError("PRNG key required (dropout in training mode)")
+        self._rng_counter += 1
+        return jax.random.fold_in(self.rng, self._rng_counter)
+
+    def offset(self):
+        """Current sequence position offset (0 when no cache attached)."""
+        if self.pos_offset is not None:
+            return self.pos_offset
+        if self.kv is not None:
+            return self.kv.length
+        return jnp.zeros((), jnp.int32)
+
+
+class Module:
+    """Base class for DSL layer modules."""
+
+    prefix: str = ""
+
+    def bind(self, prefix: str):
+        """Assign the flat-dict key prefix for this module's parameters."""
+        self.prefix = prefix
+        for name, child in self.children():
+            child.bind(f"{prefix}.{name}" if prefix else name)
+        return self
+
+    def children(self) -> Sequence[tuple[str, "Module"]]:
+        return ()
+
+    def walk(self):
+        """Yield self and all descendant modules depth-first."""
+        yield self
+        for _, child in self.children():
+            yield from child.walk()
+
+    def key(self, name: str) -> str:
+        return f"{self.prefix}.{name}" if self.prefix else name
+
+    # -- parameters ---------------------------------------------------------
+    def init(self, rng) -> dict[str, jax.Array]:
+        """Default torch-equivalent initialization of own (non-child) params."""
+        return {}
+
+    def init_buffers(self) -> dict[str, jax.Array]:
+        return {}
+
+    def param_shapes(self) -> dict[str, tuple]:
+        """Shapes of own (non-child) trainable parameters."""
+        return {}
+
+    # -- application --------------------------------------------------------
+    def apply(self, x, ctx: Ctx):
+        raise NotImplementedError
+
+    def _p(self, ctx: Ctx, name: str):
+        p = ctx.params[self.key(name)]
+        if ctx.compute_dtype is not None and jnp.issubdtype(p.dtype, jnp.floating):
+            p = p.astype(ctx.compute_dtype)
+        return p
+
+
+def _uniform(rng, shape, bound, dtype=jnp.float32):
+    return jax.random.uniform(rng, shape, dtype, minval=-bound, maxval=bound)
+
+
+# ---------------------------------------------------------------------------
+# Leaf layers
+# ---------------------------------------------------------------------------
+
+class Embedding(Module):
+    def __init__(self, num_embeddings: int, embedding_dim: int):
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+
+    def param_shapes(self):
+        return {"weight": (self.num_embeddings, self.embedding_dim)}
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.num_embeddings, self.embedding_dim), jnp.float32)
+        return {self.key("weight"): w}
+
+    def apply(self, x, ctx):
+        return jnp.take(self._p(ctx, "weight"), x, axis=0)
+
+
+class ScaledEmbedding(Embedding):
+    """Embedding whose output is scaled by a constant (Gemma sqrt(d) scale)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, scale: float = 1.0):
+        super().__init__(num_embeddings, embedding_dim)
+        self.scale = float(scale)
+
+    def apply(self, x, ctx):
+        out = super().apply(x, ctx)
+        return out * jnp.asarray(self.scale, out.dtype)
+
+
+class PositionEmbedding(Embedding):
+    """Learned position embedding indexed from the dynamic context offset.
+
+    The reference mutates a ``position_offset`` attribute during cached decode
+    (neural_net_layers.py:98-118); here the offset is a traced scalar from the
+    Ctx so one compiled program covers all positions.
+    """
+
+    def apply(self, x, ctx):
+        num_positions = x.shape[-1]
+        positions = ctx.offset() + jnp.arange(num_positions, dtype=jnp.int32)
+        return jnp.take(self._p(ctx, "weight"), positions, axis=0)
+
+
+class Linear(Module):
+    """Dense layer storing weight as (out, in) for state-dict parity."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True):
+        self.in_features = int(in_features)
+        self.out_features = int(out_features)
+        self.use_bias = bool(bias)
+
+    def param_shapes(self):
+        shapes = {"weight": (self.out_features, self.in_features)}
+        if self.use_bias:
+            shapes["bias"] = (self.out_features,)
+        return shapes
+
+    def init(self, rng):
+        kw, kb = jax.random.split(rng)
+        bound = 1.0 / math.sqrt(self.in_features)
+        params = {self.key("weight"): _uniform(kw, (self.out_features, self.in_features), bound)}
+        if self.use_bias:
+            params[self.key("bias")] = _uniform(kb, (self.out_features,), bound)
+        return params
+
+    def apply(self, x, ctx):
+        w = self._p(ctx, "weight")
+        out = jnp.matmul(x, w.T)
+        if self.use_bias:
+            out = out + self._p(ctx, "bias")
+        return out
+
+
+class Flatten(Module):
+    def __init__(self, start_dim: int = 1, end_dim: int = -1):
+        self.start_dim = start_dim
+        self.end_dim = end_dim
+
+    def apply(self, x, ctx):
+        start = self.start_dim if self.start_dim >= 0 else x.ndim + self.start_dim
+        end = self.end_dim if self.end_dim >= 0 else x.ndim + self.end_dim
+        shape = x.shape[:start] + (-1,) + x.shape[end + 1:]
+        return jnp.reshape(x, shape)
+
+
+class BatchNorm1d(Module):
+    def __init__(self, num_features: int, eps: float = 1e-5, momentum: float = 0.1):
+        self.num_features = int(num_features)
+        self.eps = float(eps)
+        self.momentum = float(momentum)
+
+    def param_shapes(self):
+        return {"weight": (self.num_features,), "bias": (self.num_features,)}
+
+    def init(self, rng):
+        return {self.key("weight"): jnp.ones((self.num_features,), jnp.float32),
+                self.key("bias"): jnp.zeros((self.num_features,), jnp.float32)}
+
+    def init_buffers(self):
+        return {self.key("running_mean"): jnp.zeros((self.num_features,), jnp.float32),
+                self.key("running_var"): jnp.ones((self.num_features,), jnp.float32),
+                self.key("num_batches_tracked"): jnp.zeros((), jnp.int64
+                                                           if jax.config.jax_enable_x64 else jnp.int32)}
+
+    def apply(self, x, ctx):
+        w, b = self._p(ctx, "weight"), self._p(ctx, "bias")
+        reduce_axes = tuple(i for i in range(x.ndim) if i != 1) if x.ndim > 2 else (0,)
+        if ctx.training:
+            mean = jnp.mean(x, axis=reduce_axes)
+            var = jnp.var(x, axis=reduce_axes)
+            n = x.size // x.shape[1]
+            unbiased = var * (n / max(n - 1, 1))
+            rm = ctx.buffers[self.key("running_mean")]
+            rv = ctx.buffers[self.key("running_var")]
+            nb = ctx.buffers[self.key("num_batches_tracked")]
+            m = self.momentum
+            ctx.buffer_updates[self.key("running_mean")] = (1 - m) * rm + m * mean
+            ctx.buffer_updates[self.key("running_var")] = (1 - m) * rv + m * unbiased
+            ctx.buffer_updates[self.key("num_batches_tracked")] = nb + 1
+        else:
+            mean = ctx.buffers[self.key("running_mean")]
+            var = ctx.buffers[self.key("running_var")]
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        mean, var = mean.reshape(shape), var.reshape(shape)
+        inv = jax.lax.rsqrt(var + self.eps)
+        return (x - mean) * inv * w.reshape(shape) + b.reshape(shape)
+
+
+class LayerNorm(Module):
+    def __init__(self, normalized_shape, eps: float = 1e-5, bias: bool = True):
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(int(d) for d in normalized_shape)
+        self.eps = float(eps)
+        self.use_bias = bool(bias)
+
+    def param_shapes(self):
+        shapes = {"weight": self.normalized_shape}
+        if self.use_bias:
+            shapes["bias"] = self.normalized_shape
+        return shapes
+
+    def init(self, rng):
+        params = {self.key("weight"): jnp.ones(self.normalized_shape, jnp.float32)}
+        if self.use_bias:
+            params[self.key("bias")] = jnp.zeros(self.normalized_shape, jnp.float32)
+        return params
+
+    def apply(self, x, ctx):
+        axes = tuple(range(x.ndim - len(self.normalized_shape), x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - mean) * jax.lax.rsqrt(var + self.eps) * self._p(ctx, "weight")
+        if self.use_bias:
+            out = out + self._p(ctx, "bias")
+        return out
+
+
+class RMSNorm(Module):
+    """RMS normalization computed internally in float32 (reference:
+    neural_net_layers.py:144-155)."""
+
+    def __init__(self, normalized_shape: int, eps: float = 1e-6):
+        self.normalized_shape = int(normalized_shape)
+        self.eps = float(eps)
+
+    def param_shapes(self):
+        return {"weight": (self.normalized_shape,)}
+
+    def init(self, rng):
+        return {self.key("weight"): jnp.ones((self.normalized_shape,), jnp.float32)}
+
+    def apply(self, x, ctx):
+        dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        norm = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (xf * norm).astype(dtype) * self._p(ctx, "weight")
+
+
+class ReLU(Module):
+    def apply(self, x, ctx):
+        return jax.nn.relu(x)
+
+
+class GELU(Module):
+    def __init__(self, approximate: str = "none"):
+        self.approximate = approximate
+
+    def apply(self, x, ctx):
+        return jax.nn.gelu(x, approximate=(self.approximate == "tanh"))
+
+
+class SiLU(Module):
+    def apply(self, x, ctx):
+        return jax.nn.silu(x)
+
+
+class Sigmoid(Module):
+    def apply(self, x, ctx):
+        return jax.nn.sigmoid(x)
+
+
+class Tanh(Module):
+    def apply(self, x, ctx):
+        return jnp.tanh(x)
+
+
+class Softmax(Module):
+    def __init__(self, dim: Optional[int] = None):
+        self.dim = dim
+
+    def apply(self, x, ctx):
+        return jax.nn.softmax(x, axis=self.dim if self.dim is not None else -1)
+
+
+class SoftmaxOnLast(Softmax):
+    """Softmax over the vocabulary of only the final sequence position."""
+
+    def apply(self, x, ctx):
+        return jax.nn.softmax(x[:, -1, :], axis=self.dim if self.dim is not None else -1)
+
+
+class Dropout(Module):
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def apply(self, x, ctx):
+        if not ctx.training or self.p == 0.0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(ctx.next_rng(), keep, x.shape)
+        return jnp.where(mask, x / keep, jnp.zeros((), x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+class Sequential(Module):
+    def __init__(self, *layers: Module):
+        self.layers = list(layers)
+
+    def children(self):
+        return [(str(i), l) for i, l in enumerate(self.layers)]
+
+    def apply(self, x, ctx):
+        for layer in self.layers:
+            x = layer.apply(x, ctx)
+        return x
+
+
+class Summation(Sequential):
+    """Sum of each child applied to the same input (token+position embed)."""
+
+    def apply(self, x, ctx):
+        out = self.layers[0].apply(x, ctx)
+        for layer in self.layers[1:]:
+            out = out + layer.apply(x, ctx)
+        return out
+
+
+class ResidualConnection(Sequential):
+    """x = x + child(x), applied for each child in order."""
+
+    def apply(self, x, ctx):
+        for layer in self.layers:
+            x = x + layer.apply(x, ctx)
+        return x
+
+
+class TransformerBlock(Module):
+    """Pre-norm decoder block with optional Gemma-style post-norms.
+
+    ``post_norm_on_residual=True`` (Gemma 3+): ``h = post_norm(x + branch(x))``;
+    ``False`` (Gemma 2): ``h = x + post_norm(branch(x))``.
+    (reference: neural_net_layers.py:188-225)
+    """
+
+    def __init__(self, attn_block: Module, mlp_block: Module,
+                 post_attn_norm: Module = None, post_mlp_norm: Module = None,
+                 post_norm_on_residual: bool = True):
+        self.attn_block = attn_block
+        self.mlp_block = mlp_block
+        self.post_attn_norm = post_attn_norm
+        self.post_mlp_norm = post_mlp_norm
+        self.post_norm_on_residual = bool(post_norm_on_residual)
+
+    def children(self):
+        out = [("attn_block", self.attn_block), ("mlp_block", self.mlp_block)]
+        if self.post_attn_norm is not None:
+            out.append(("post_attn_norm", self.post_attn_norm))
+        if self.post_mlp_norm is not None:
+            out.append(("post_mlp_norm", self.post_mlp_norm))
+        return out
+
+    def apply(self, x, ctx):
+        attn_out = self.attn_block.apply(x, ctx)
+        if self.post_attn_norm is not None and not self.post_norm_on_residual:
+            attn_out = self.post_attn_norm.apply(attn_out, ctx)
+        h = x + attn_out
+        if self.post_attn_norm is not None and self.post_norm_on_residual:
+            h = self.post_attn_norm.apply(h, ctx)
+
+        mlp_out = self.mlp_block.apply(h, ctx)
+        if self.post_mlp_norm is not None and not self.post_norm_on_residual:
+            mlp_out = self.post_mlp_norm.apply(mlp_out, ctx)
+        out = h + mlp_out
+        if self.post_mlp_norm is not None and self.post_norm_on_residual:
+            out = self.post_mlp_norm.apply(out, ctx)
+        return out
+
+
+class GatedMLP(Module):
+    """SwiGLU/GeGLU gated MLP (Gemma/LLaMA style)."""
+
+    def __init__(self, in_features: int, intermediate_size: int,
+                 bias: bool = False, activation: str = "gelu_pytorch_tanh"):
+        self.gate_proj = Linear(in_features, intermediate_size, bias=bias)
+        self.up_proj = Linear(in_features, intermediate_size, bias=bias)
+        self.down_proj = Linear(intermediate_size, in_features, bias=bias)
+        self.activation = activation
+
+    def children(self):
+        return [("gate_proj", self.gate_proj), ("up_proj", self.up_proj),
+                ("down_proj", self.down_proj)]
+
+    def _act(self, x):
+        if self.activation in ("silu", "swish"):
+            return jax.nn.silu(x)
+        return jax.nn.gelu(x, approximate=(self.activation == "gelu_pytorch_tanh"))
+
+    def apply(self, x, ctx):
+        gated = self._act(self.gate_proj.apply(x, ctx)) * self.up_proj.apply(x, ctx)
+        return self.down_proj.apply(gated, ctx)
+
+
+# ---------------------------------------------------------------------------
+# Attention
+# ---------------------------------------------------------------------------
+
+class CausalSelfAttention(Module):
+    """Causal self-attention over a fused QKV input with GQA + optional RoPE.
+
+    Consumes a ``(B, T, q_dim + 2*kv_dim)`` projection (reference:
+    neural_net_layers.py:59-95).  Head dim is derived from the input width.
+    When a KV cache is present in the Ctx, new K/V are written at the current
+    cache length (pre-GQA-expansion — unlike the reference, which expands KV
+    heads before caching, we store only ``num_kv_heads`` heads in HBM).
+    """
+
+    def __init__(self, num_heads: int, dropout: float = 0.0,
+                 num_kv_heads: Optional[int] = None,
+                 rope_theta: Optional[float] = None,
+                 head_dim: Optional[int] = None):
+        self.num_heads = int(num_heads)
+        self.num_kv_heads = int(num_kv_heads) if num_kv_heads is not None else int(num_heads)
+        self.dropout = float(dropout)
+        self.rope_theta = float(rope_theta) if rope_theta is not None else None
+        self.head_dim = int(head_dim) if head_dim is not None else None
+        self.layer_idx = 0  # assigned by the model builder
+
+    def apply(self, qkv, ctx):
+        B, T, total_dim = qkv.shape
+        head_dim = total_dim // (self.num_heads + 2 * self.num_kv_heads)
+        q_dim = self.num_heads * head_dim
+        kv_dim = self.num_kv_heads * head_dim
+
+        q = qkv[..., :q_dim].reshape(B, T, self.num_heads, head_dim)
+        k = qkv[..., q_dim:q_dim + kv_dim].reshape(B, T, self.num_kv_heads, head_dim)
+        v = qkv[..., q_dim + kv_dim:].reshape(B, T, self.num_kv_heads, head_dim)
+        # to (B, H, T, D)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+
+        offset = ctx.offset()
+        if self.rope_theta is not None:
+            q, k = attn_ops.apply_rope(q, k, self.rope_theta, offset)
+
+        dropout_rate = self.dropout if ctx.training else 0.0
+        dropout_rng = ctx.next_rng() if (dropout_rate > 0.0 and ctx.training) else None
+
+        if ctx.kv is not None:
+            k_full, v_full, length = ctx.kv.append(self.layer_idx, k, v)
+            out = attn_ops.cached_attention(q, k_full, v_full, offset, length,
+                                            dropout_rate=dropout_rate,
+                                            dropout_rng=dropout_rng)
+        else:
+            out = attn_ops.causal_attention(q, k, v, dropout_rate=dropout_rate,
+                                            dropout_rng=dropout_rng)
+
+        return out.transpose(0, 2, 1, 3).reshape(B, T, q_dim)

@@ -1,0 +1,149 @@
+"""Pallas TPU flash-attention (causal, GQA) — forward kernel.
+
+Blockwise online-softmax attention: the query block stays resident in VMEM
+while K/V blocks stream through, carrying running (max, sum, accumulator)
+statistics.  This keeps the (T, S) score matrix out of HBM entirely — the
+fusion the reference gets from ``F.scaled_dot_product_attention``'s cuDNN
+flash kernels (reference: neural_net_layers.py:92), built here directly on
+the MXU.
+
+The backward pass recomputes attention via the jnp reference implementation
+(flash keeps only O(T·D) residuals); a dedicated backward kernel is a later
+optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                sm_scale: float):
+    block_q = q_ref.shape[2]
+    head_dim = q_ref.shape[3]
+    seq_k = k_ref.shape[2]
+    qi = pl.program_id(2)
+
+    q = q_ref[0, 0]
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+
+    if causal:
+        # Only K blocks at or below this query block's diagonal contribute.
+        hi = jax.lax.div(qi * block_q + block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, seq_k // block_k)
+    else:
+        hi = seq_k // block_k
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _largest_dividing_block(n: int, preferred: int) -> int:
+    """Largest power-of-two block ≤ preferred that divides n (min 128)."""
+    block = min(preferred, n)
+    while block > 128 and n % block != 0:
+        block //= 2
+    return block
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool = False):
+    B, Hq, T, D = q.shape
+    Hkv, S = k.shape[1], k.shape[2]
+    group = Hq // Hkv
+    # Blocks must tile the sequence exactly — otherwise tail queries would
+    # never be written and tail keys never attended.
+    block_q = _largest_dividing_block(T, block_q)
+    block_k = _largest_dividing_block(S, block_k)
+    if T % block_q != 0 or S % block_k != 0:
+        raise ValueError(f"flash_attention requires T%{block_q}==0 and "
+                         f"S%{block_k}==0; got T={T}, S={S}")
+    sm_scale = 1.0 / (D ** 0.5)
+
+    grid = (B, Hq, T // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                               sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D),
+                         lambda b, h, i: (b, h // group, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D),
+                         lambda b, h, i: (b, h // group, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * Hq * T * S * D * (0.5 if causal else 1.0),
+            bytes_accessed=(q.size + k.size + v.size + q.size) * q.dtype.itemsize,
+            transcendentals=B * Hq * T * S),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """Flash attention. q: (B, Hq, T, D); k, v: (B, Hkv, S, D) with Hq % Hkv == 0."""
+    return _flash_forward(q, k, v, causal, block_q, block_k)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    return flash_attention(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, residuals, g):
+    from penroz_tpu.ops.attention import causal_attention_reference
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: causal_attention_reference(q_, k_, v_),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -1,0 +1,74 @@
+"""Multi-host process topology.
+
+The reference derives rank/world from torchrun env vars and wraps models in
+DistributedDataParallel (ddp.py:13-17, neural_net_model.py:609).  On TPU there
+is one process per host and per-chip parallelism lives inside the compiled
+program, so the only process-level concepts we need are:
+
+- ``initialize()`` — call ``jax.distributed.initialize`` once per process when
+  a multi-host environment is detected (or explicitly requested);
+- ``process_index`` / ``process_count`` — which replace RANK / WORLD_SIZE in
+  the rank-strided data-loader arithmetic (reference: neural_net_model.py:581-584);
+- ``master_proc`` — gates checkpoint writes and progress recording.
+
+Device-level world size (how many chips participate in an allreduce) is the
+mesh size, not the process count — see parallel/mesh.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Initialize the multi-host JAX runtime (idempotent).
+
+    Auto-detects standard cluster envs (TPU pod metadata, or explicit
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).  Safe to
+    call on a single host — it becomes a no-op.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes or _env_int("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
+    if coordinator_address is None and num_processes is None:
+        return False  # single-host; nothing to do
+    log.info("Initializing jax.distributed: coordinator=%s procs=%s id=%s",
+             coordinator_address, num_processes, process_id)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def _env_int(name: str):
+    value = os.environ.get(name)
+    return int(value) if value is not None else None
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def master_proc() -> bool:
+    return process_index() == 0
+
+
+def is_distributed() -> bool:
+    return process_count() > 1

@@ -1,0 +1,68 @@
+"""Device meshes and sharding rules.
+
+All parallelism is expressed as shardings over a ``jax.sharding.Mesh`` and
+compiled by XLA into ICI/DCN collectives — there is no wrapper object doing
+gradient allreduce (the reference's DistributedDataParallel + NCCL buckets,
+neural_net_model.py:609, ddp.py:80-85).  Axes:
+
+- ``data``      — batch sharding (DP); gradients are averaged by XLA because
+                  replicated params + sharded batch force a psum.
+- ``model``     — tensor parallelism for weight matrices (TP).
+- ``sequence``  — context/sequence parallelism for long sequences (SP).
+
+Single-device training uses a trivial 1-device mesh so the code path is
+identical everywhere.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "sequence"
+
+
+def make_mesh(devices=None, *, data: Optional[int] = None, model: int = 1,
+              sequence: int = 1) -> Mesh:
+    """Build a (data, model, sequence) mesh over the given (default: all)
+    devices.  ``data`` defaults to whatever is left after model × sequence."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        if n % (model * sequence) != 0:
+            raise ValueError(f"{n} devices not divisible by model={model} × "
+                             f"sequence={sequence}")
+        data = n // (model * sequence)
+    if data * model * sequence != n:
+        raise ValueError(f"mesh {data}×{model}×{sequence} != {n} devices")
+    arr = np.array(devices).reshape(data, model, sequence)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+def batch_sharding(mesh: Mesh, batch_ndim: int = 2) -> NamedSharding:
+    """Shard the leading batch dim over ``data`` (and optionally the sequence
+    dim over ``sequence``)."""
+    spec = [DATA_AXIS] + [None] * (batch_ndim - 1)
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, array):
+    """Place a host batch onto the mesh, sharded along ``data``."""
+    return jax.device_put(array, batch_sharding(mesh, np.ndim(array)))
+
+
+def local_data_size(mesh: Mesh) -> int:
+    """Number of devices along the data axis."""
+    return mesh.shape[DATA_AXIS]

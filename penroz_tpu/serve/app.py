@@ -1,0 +1,355 @@
+"""REST API service (aiohttp) — same 15-route surface as the reference
+FastAPI app (main.py:310-496), same semantics:
+
+- per-id asyncio locks with 409 on conflict for /import/, /dataset/ download
+  and /train/;
+- 202 + background task for /dataset/ download and /train/;
+- gzip request-body decompression middleware;
+- KeyError→404, ValueError→400, validation→422, anything else→500;
+- /generate/ streaming one token per line.
+
+TPU-specific design: /train/ runs in a worker thread of this process rather
+than forking a DDP process tree (main.py:461-464) — a single process owns the
+TPU runtime and per-chip parallelism lives inside the compiled program.
+Training still checkpoints through /dev/shm, so /progress/ polls observe it
+exactly as they do in the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import json
+import logging
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict
+
+import pydantic
+from aiohttp import web
+
+from penroz_tpu.data.loaders import Downloader, Loader
+from penroz_tpu.data.tokenizers import Tokenizer
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+from penroz_tpu.serve import schemas
+
+log = logging.getLogger(__name__)
+
+STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+TEMPLATES_DIR = os.path.join(os.path.dirname(__file__), "templates")
+
+# Heavy work (training, HF import, dataset download) runs here; one at a time
+# per resource via the locks below, globally bounded by the pool.
+_EXECUTOR = ThreadPoolExecutor(max_workers=4, thread_name_prefix="penroz-work")
+
+dataset_locks: Dict[str, asyncio.Lock] = {}
+model_locks: Dict[str, asyncio.Lock] = {}
+
+
+def _json(content, status: int = 200) -> web.Response:
+    return web.json_response(content, status=status)
+
+
+@web.middleware
+async def gzip_middleware(request: web.Request, handler):
+    # aiohttp inflates gzip request bodies itself; only decompress when the
+    # payload still carries the gzip magic (e.g. proxies that skip inflation).
+    if request.headers.get("Content-Encoding", "").lower() == "gzip":
+        body = await request.read()
+        log.info("Retrieved gzip encoded request body")
+        if body[:2] == b"\x1f\x8b":
+            request._read_bytes = gzip.decompress(body)
+            log.info("Decompressed gzip encoded body")
+    return await handler(request)
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except pydantic.ValidationError as e:
+        return _json({"detail": json.loads(e.json())}, status=422)
+    except KeyError as e:
+        return _json({"detail": f"Not found error occurred: {e}"}, status=404)
+    except ValueError as e:
+        return _json({"detail": f"Value error occurred: {e}"}, status=400)
+    except Exception as e:  # noqa: BLE001
+        log.error("An error occurred: %s", e)
+        return _json({"detail": "Please refer to server logs"}, status=500)
+
+
+async def _parse(request: web.Request, model_cls):
+    try:
+        payload = await request.json()
+    except json.JSONDecodeError:
+        raise web.HTTPUnprocessableEntity(
+            text=json.dumps({"detail": "Invalid JSON body"}),
+            content_type="application/json")
+    return model_cls.model_validate(payload)
+
+
+def _query_param(request: web.Request, name: str) -> str:
+    value = request.query.get(name)
+    if value is None:
+        raise web.HTTPUnprocessableEntity(
+            text=json.dumps({"detail": f"Missing query parameter {name}"}),
+            content_type="application/json")
+    return value
+
+
+async def _run_blocking(fn, *args):
+    return await asyncio.get_running_loop().run_in_executor(_EXECUTOR, fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# Handlers
+# ---------------------------------------------------------------------------
+
+async def redirect_to_dashboard(request: web.Request):
+    raise web.HTTPFound("/dashboard")
+
+
+async def dashboard(request: web.Request):
+    with open(os.path.join(TEMPLATES_DIR, "dashboard.html")) as f:
+        return web.Response(text=f.read(), content_type="text/html")
+
+
+async def create_model(request: web.Request):
+    body = await _parse(request, schemas.CreateModelRequest)
+    log.info("Requesting creation of model %s", body.model_id)
+    model = NeuralNetworkModel(body.model_id, Mapper(body.layers, body.optimizer))
+    model.serialize()
+    return _json({"message": f"Model {body.model_id} created and saved successfully"})
+
+
+async def import_from_huggingface(request: web.Request):
+    body = await _parse(request, schemas.ImportModelRequest)
+    model_id = body.model_id
+    log.info("Requesting import of HuggingFace model %s as %s",
+             body.hf_repo_id, model_id)
+    lock = model_locks.setdefault(model_id, asyncio.Lock())
+    if lock.locked():
+        return _json({"detail": f"Operation already in progress for model {model_id}."},
+                     status=409)
+    async with lock:
+        await _run_blocking(NeuralNetworkModel.from_huggingface, model_id,
+                            body.hf_repo_id, body.revision, body.device)
+    return _json({
+        "model_id": model_id,
+        "status": "imported",
+        "message": f"Model imported from HuggingFace ({body.hf_repo_id}) "
+                   f"and ready for use",
+    })
+
+
+async def list_dataset(request: web.Request):
+    dataset_id = _query_param(request, "dataset_id")
+    log.info("Requesting list of files for dataset %s", dataset_id)
+    return _json({"files": Loader(dataset_id).list()})
+
+
+async def download_dataset(request: web.Request):
+    body = await _parse(request, schemas.DownloadDatasetRequest)
+    dataset_id = body.dataset_id
+    log.info("Requesting download of dataset %s", dataset_id)
+    lock = dataset_locks.setdefault(dataset_id, asyncio.Lock())
+    if lock.locked():
+        return _json({"detail": f"Downloading dataset {dataset_id} already in progress."},
+                     status=409)
+    downloader = Downloader(dataset_id, body.shard_size, body.encoding)
+
+    async def download():
+        async with lock:
+            try:
+                await _run_blocking(downloader.download, body.path, body.name,
+                                    body.split)
+            except Exception:  # noqa: BLE001
+                log.exception("Dataset %s download failed", dataset_id)
+
+    asyncio.get_running_loop().create_task(download())
+    return _json({"message": f"Downloading Dataset {dataset_id} asynchronously."},
+                 status=202)
+
+
+async def delete_dataset(request: web.Request):
+    dataset_id = _query_param(request, "dataset_id")
+    log.info("Requesting deletion of dataset %s", dataset_id)
+    Loader(dataset_id).delete()
+    return web.Response(status=204)
+
+
+async def tokenize_text(request: web.Request):
+    body = await _parse(request, schemas.TokenizeTextRequest)
+    log.info("Requesting tokenization of text %s", body.text)
+    tokens = Tokenizer(body.encoding).tokenize(body.text)
+    return _json({"encoding": body.encoding, "tokens": tokens})
+
+
+async def compute_model_output(request: web.Request):
+    body = await _parse(request, schemas.OutputRequest)
+    log.info("Requesting output for model %s", body.model_id)
+    model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
+    output, cost = await _run_blocking(model.compute_output, body.input,
+                                       body.target)
+    return _json({"output": output, "cost": cost})
+
+
+async def evaluate_model(request: web.Request):
+    body = await _parse(request, schemas.EvaluateRequest)
+    log.info("Requesting evaluation of model %s", body.model_id)
+    model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
+    cost = await _run_blocking(
+        lambda: model.evaluate_model(body.dataset_id, body.target_dataset_id,
+                                     body.shard, body.epochs, body.batch_size,
+                                     body.block_size, body.step_size))
+    return _json({"cost": cost})
+
+
+async def model_generate(request: web.Request):
+    body = await _parse(request, schemas.GenerateRequest)
+    log.info("Generating tokens using model %s", body.model_id)
+    model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
+    if body.stream:
+        log.info("Streaming token generation for model %s", body.model_id)
+        response = web.StreamResponse(
+            headers={"Content-Type": "text/plain; charset=utf-8"})
+        await response.prepare(request)
+        queue: asyncio.Queue = asyncio.Queue()
+        loop = asyncio.get_running_loop()
+        _DONE = object()
+
+        def produce():
+            try:
+                for token in model.generate_tokens_stream(
+                        body.input, body.block_size, body.max_new_tokens,
+                        body.temperature, body.top_k, body.stop_token):
+                    loop.call_soon_threadsafe(queue.put_nowait, token)
+            finally:
+                loop.call_soon_threadsafe(queue.put_nowait, _DONE)
+
+        producer = loop.run_in_executor(_EXECUTOR, produce)
+        while True:
+            token = await queue.get()
+            if token is _DONE:
+                break
+            await response.write(f"{token}\n".encode())
+        try:
+            await producer
+        except Exception:  # noqa: BLE001
+            # Headers already went out — we can only end the stream and log.
+            log.exception("Streaming generation failed for model %s",
+                          body.model_id)
+        await response.write_eof()
+        return response
+
+    tokens = await _run_blocking(
+        lambda: model.generate_tokens(body.input, body.block_size,
+                                      body.max_new_tokens, body.temperature,
+                                      body.top_k, body.stop_token))
+    return _json({"tokens": tokens})
+
+
+async def decode_tokens(request: web.Request):
+    body = await _parse(request, schemas.DecodeTokensRequest)
+    log.info("Requesting decoding of %d token(s)", len(body.tokens))
+    text = Tokenizer(body.encoding).decode(body.tokens)
+    return _json({"encoding": body.encoding, "text": text})
+
+
+async def train_model(request: web.Request):
+    body = await _parse(request, schemas.TrainingRequest)
+    model_id = body.model_id
+    log.info("Requesting training for model %s on device %s",
+             model_id, body.device)
+    # Validate early so a bad model id 404s instead of silently failing in
+    # the background (the checkpoint read is cheap via shm).
+    await _run_blocking(NeuralNetworkModel.deserialize, model_id)
+
+    lock = model_locks.setdefault(model_id, asyncio.Lock())
+    if lock.locked():
+        return _json({"detail": f"Training already in progress for model {model_id}."},
+                     status=409)
+
+    async def _launch():
+        async with lock:
+            log.info("Waiting for training of model %s to complete...", model_id)
+            try:
+                await _run_blocking(
+                    NeuralNetworkModel.train_model_on_device, model_id,
+                    body.device, body.dataset_id, body.shard, body.epochs,
+                    body.batch_size, body.block_size, body.step_size)
+            except Exception:  # noqa: BLE001
+                log.exception("Training failed for model %s", model_id)
+            else:
+                log.info("Training completed for model %s", model_id)
+
+    asyncio.get_running_loop().create_task(_launch())
+    return _json({"message": f"Training for model {model_id} started asynchronously."},
+                 status=202)
+
+
+async def model_progress(request: web.Request):
+    model_id = _query_param(request, "model_id")
+    log.info("Requesting progress for model %s", model_id)
+    model = await _run_blocking(NeuralNetworkModel.deserialize, model_id)
+    return _json({
+        "progress": model.progress,
+        "average_cost": model.avg_cost,
+        "average_cost_history": model.avg_cost_history,
+        "status": model.status,
+    })
+
+
+async def model_stats(request: web.Request):
+    model_id = _query_param(request, "model_id")
+    log.info("Requesting stats for model %s", model_id)
+    model = await _run_blocking(NeuralNetworkModel.deserialize, model_id)
+    return _json(model.stats)
+
+
+async def delete_model(request: web.Request):
+    model_id = _query_param(request, "model_id")
+    log.info("Requesting deletion of model %s", model_id)
+    NeuralNetworkModel.delete(model_id)
+    return web.Response(status=204)
+
+
+def create_app() -> web.Application:
+    app = web.Application(middlewares=[error_middleware, gzip_middleware],
+                          client_max_size=1024 ** 3)
+    app.router.add_get("/", redirect_to_dashboard)
+    app.router.add_get("/dashboard", dashboard)
+    app.router.add_post("/model/", create_model)
+    app.router.add_post("/import/", import_from_huggingface)
+    app.router.add_get("/dataset/", list_dataset)
+    app.router.add_post("/dataset/", download_dataset)
+    app.router.add_delete("/dataset/", delete_dataset)
+    app.router.add_post("/tokenize/", tokenize_text)
+    app.router.add_post("/output/", compute_model_output)
+    app.router.add_post("/evaluate/", evaluate_model)
+    app.router.add_post("/generate/", model_generate)
+    app.router.add_post("/decode/", decode_tokens)
+    app.router.add_put("/train/", train_model)
+    app.router.add_get("/progress/", model_progress)
+    app.router.add_get("/stats/", model_stats)
+    app.router.add_delete("/model/", delete_model)
+    if os.path.isdir(STATIC_DIR):
+        app.router.add_static("/static/", STATIC_DIR)
+    return app
+
+
+def main(host: str = "127.0.0.1", port: int = 8000):  # pragma: no cover
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [%(processName)s] %(name)s: %(message)s")
+    from penroz_tpu.parallel import dist
+    dist.initialize()
+    web.run_app(create_app(), host=host, port=port)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main(host=os.environ.get("HOST", "127.0.0.1"),
+         port=int(os.environ.get("PORT", "8000")))

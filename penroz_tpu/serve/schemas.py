@@ -1,0 +1,82 @@
+"""Pydantic request models for the REST API (reference: main.py:38-282)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pydantic import BaseModel, Field
+
+
+class ModelRequest(BaseModel):
+    model_id: str = Field(..., description="The unique identifier for the model.")
+
+
+class ModelOnDeviceRequest(ModelRequest):
+    device: str = Field("cpu", description="Device to place the model on "
+                        "('cpu' or 'tpu'; 'cuda'/'gpu' map to the accelerator).")
+
+
+class CreateModelRequest(ModelRequest):
+    layers: list[dict] = Field(..., description="Layer DSL: list of "
+                               "{algo: args} dicts, with optional init entries.")
+    optimizer: dict = Field(..., description="{optimizer_name: args} dict.")
+
+
+class DatasetRequest(BaseModel):
+    dataset_id: str = Field(..., description="The unique identifier for the dataset")
+
+
+class TokenizerRequest(BaseModel):
+    encoding: str = Field(..., description="Tiktoken encoding (prefix "
+                          "'tiktoken/') or HuggingFace tokenizer name")
+
+
+class DownloadDatasetRequest(DatasetRequest, TokenizerRequest):
+    path: str = Field(..., description="HuggingFace dataset path")
+    name: Optional[str] = Field(None, description="HuggingFace dataset config name")
+    split: str = Field(..., description="Dataset split to download")
+    shard_size: int = Field(..., description="Number of tokens per shard")
+
+
+class TrainingRequest(ModelOnDeviceRequest, DatasetRequest):
+    shard: int = Field(..., description="Dataset shard to begin training from")
+    epochs: int = Field(..., description="Number of training epochs")
+    batch_size: int = Field(..., description="Batch size sampled each epoch")
+    block_size: int = Field(..., description="Sequence length per sample")
+    step_size: int = Field(..., description="Blocks per accumulation step")
+
+
+class EvaluateRequest(TrainingRequest):
+    target_dataset_id: Optional[str] = Field(None, description="Separate "
+                                             "target dataset (optional)")
+
+
+class TokenizeTextRequest(TokenizerRequest):
+    text: str = Field(..., description="Text to tokenize")
+
+
+class OutputRequest(ModelRequest):
+    input: list = Field(..., description="The input context")
+    target: Optional[list | int] = Field(None, description="Expected target")
+
+
+class GenerateRequest(ModelRequest):
+    input: list = Field(..., description="The initial token context")
+    block_size: int = Field(..., description="Max context length")
+    max_new_tokens: int = Field(..., description="Max tokens to generate")
+    temperature: float = Field(1.0, description="Logits temperature")
+    top_k: Optional[int] = Field(None, description="Top-K sampling")
+    stop_token: Optional[int] = Field(None, description="Early-stop token id")
+    stream: bool = Field(False, description="Stream tokens as produced")
+
+
+class DecodeTokensRequest(TokenizerRequest):
+    tokens: list[int] = Field(..., description="Token ids to decode")
+
+
+class ImportModelRequest(BaseModel):
+    hf_repo_id: str = Field(..., description="HuggingFace repo id (GPT-2 or "
+                            "Gemma families)")
+    model_id: str = Field(..., description="Internal model id to save under")
+    revision: Optional[str] = Field(None, description="HF revision/branch/tag")
+    device: str = Field("cpu", description="Device to load the model on")

@@ -1,0 +1,225 @@
+/* penroz-tpu dashboard: polls /progress/ and /stats/ and renders training
+ * curves + histograms on plain <canvas> (no chart library). */
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+function getQueryState() {
+  const p = new URLSearchParams(location.search);
+  return { modelId: p.get("model_id") || "", filter: p.get("filter") || "" };
+}
+
+function setQueryState(modelId, filter) {
+  const p = new URLSearchParams();
+  if (modelId) p.set("model_id", modelId);
+  if (filter) p.set("filter", filter);
+  history.replaceState(null, "", `${location.pathname}?${p}`);
+}
+
+/* ---- tiny canvas plotting helpers ------------------------------------- */
+
+const COLORS = ["#7fd1b9", "#e0b35c", "#7aa2f7", "#e06c75", "#b58cd9",
+                "#56b6c2", "#98c379", "#d19a66"];
+
+function prepCanvas(canvas) {
+  const ctx = canvas.getContext("2d");
+  ctx.clearRect(0, 0, canvas.width, canvas.height);
+  return ctx;
+}
+
+function drawAxes(ctx, w, h, pad) {
+  ctx.strokeStyle = "#2a3642";
+  ctx.beginPath();
+  ctx.moveTo(pad, 8); ctx.lineTo(pad, h - pad); ctx.lineTo(w - 8, h - pad);
+  ctx.stroke();
+}
+
+function drawLabel(ctx, text, x, y, color = "#5d7285") {
+  ctx.fillStyle = color;
+  ctx.font = "11px sans-serif";
+  ctx.fillText(text, x, y);
+}
+
+/* Draw one or more series as lines. series: [{name, xs, ys}] */
+function lineChart(canvas, series, opts = {}) {
+  const ctx = prepCanvas(canvas);
+  const w = canvas.width, h = canvas.height, pad = 46;
+  drawAxes(ctx, w, h, pad);
+  const pts = series.flatMap(s => s.ys.filter(Number.isFinite));
+  if (!pts.length) { drawLabel(ctx, "no data", w / 2 - 20, h / 2); return; }
+  let lo = Math.min(...pts), hi = Math.max(...pts);
+  if (lo === hi) { lo -= 1; hi += 1; }
+  const xMax = Math.max(...series.map(s => s.xs.length ? Math.max(...s.xs) : 1));
+  const xMin = Math.min(...series.map(s => s.xs.length ? Math.min(...s.xs) : 0));
+  const sx = (x) => pad + (x - xMin) / Math.max(1e-9, xMax - xMin) * (w - pad - 16);
+  const sy = (y) => (h - pad) - (y - lo) / (hi - lo) * (h - pad - 16);
+
+  series.forEach((s, i) => {
+    ctx.strokeStyle = COLORS[i % COLORS.length];
+    ctx.lineWidth = 1.5;
+    ctx.beginPath();
+    let started = false;
+    s.xs.forEach((x, j) => {
+      const y = s.ys[j];
+      if (!Number.isFinite(y)) return;
+      if (!started) { ctx.moveTo(sx(x), sy(y)); started = true; }
+      else ctx.lineTo(sx(x), sy(y));
+    });
+    ctx.stroke();
+  });
+  drawLabel(ctx, hi.toPrecision(4), 4, 16);
+  drawLabel(ctx, lo.toPrecision(4), 4, h - pad);
+  if (opts.legend) {
+    series.forEach((s, i) => {
+      drawLabel(ctx, s.name, pad + 8 + i * 130, 16, COLORS[i % COLORS.length]);
+    });
+  }
+}
+
+/* Histogram as filled bars. data: {x: edges, y: densities} */
+function histChart(canvas, data) {
+  const ctx = prepCanvas(canvas);
+  const w = canvas.width, h = canvas.height, pad = 8;
+  if (!data || !data.x || !data.x.length) {
+    drawLabel(ctx, "no data", w / 2 - 20, h / 2); return;
+  }
+  const hi = Math.max(...data.y, 1e-12);
+  const n = data.y.length;
+  const bw = (w - 2 * pad) / n;
+  ctx.fillStyle = "#3f7f6b";
+  data.y.forEach((v, i) => {
+    const bh = v / hi * (h - 2 * pad);
+    ctx.fillRect(pad + i * bw, h - pad - bh, Math.max(1, bw - 1), bh);
+  });
+  drawLabel(ctx, Number(data.x[0]).toPrecision(3), pad, h - 1);
+  drawLabel(ctx, Number(data.x[n - 1]).toPrecision(3), w - 50, h - 1);
+}
+
+/* ---- data fetch + render ---------------------------------------------- */
+
+async function fetchJson(url) {
+  const res = await fetch(url);
+  if (!res.ok) throw new Error(`${url}: HTTP ${res.status}`);
+  return res.json();
+}
+
+function renderProgress(data) {
+  const progress = data.progress || [];
+  const epochs = progress.map(p => p.epoch);
+  const badge = $("status-badge");
+  const code = data.status && data.status.code || "—";
+  badge.textContent = code;
+  badge.className = "badge " + (code === "Error" ? "err" :
+    code === "Training" ? "busy" : "ok");
+
+  lineChart($("cost-chart"), [{
+    name: "log10(cost)", xs: epochs,
+    ys: progress.map(p => Math.log10(Math.max(p.cost, 1e-12))),
+  }], { legend: true });
+
+  lineChart($("avg-cost-chart"), [{
+    name: "avg cost",
+    xs: (data.average_cost_history || []).map((_, i) => i),
+    ys: data.average_cost_history || [],
+  }]);
+
+  lineChart($("speed-chart"), [{
+    name: "tokens/sec", xs: epochs,
+    ys: progress.map(p => p.speedPerSec),
+  }]);
+
+  // weight update ratios: one series per weight index (log10)
+  const nWeights = progress.length ?
+    (progress[progress.length - 1].weight_upd_ratio || []).length : 0;
+  const series = [];
+  for (let wi = 0; wi < nWeights; wi++) {
+    const ys = progress.map(p => {
+      const r = (p.weight_upd_ratio || [])[wi];
+      return r == null ? NaN : Math.log10(Math.max(r, 1e-12));
+    });
+    if (ys.some(Number.isFinite)) series.push({ name: `w${wi}`, xs: epochs, ys });
+  }
+  lineChart($("ratio-chart"), series.slice(0, COLORS.length), { legend: false });
+}
+
+function matchesFilter(name, idx, filter) {
+  if (!filter) return true;
+  const f = filter.toLowerCase();
+  return name.toLowerCase().includes(f) || String(idx) === f;
+}
+
+function renderStats(stats, filter) {
+  const grid = $("hist-grid");
+  grid.innerHTML = "";
+  if (!stats) {
+    grid.innerHTML = "<div class='cell'><div class='title'>no stats yet</div></div>";
+    return;
+  }
+  const addCell = (title, meta, histData) => {
+    const cell = document.createElement("div");
+    cell.className = "cell";
+    const canvas = document.createElement("canvas");
+    canvas.width = 300; canvas.height = 120;
+    cell.innerHTML = `<div class="title">${title}</div><div class="meta">${meta}</div>`;
+    cell.appendChild(canvas);
+    grid.appendChild(cell);
+    histChart(canvas, histData);
+  };
+
+  (stats.layers || []).forEach((layer, i) => {
+    if (!layer || !matchesFilter(layer.algo, i, filter)) return;
+    const act = layer.activation;
+    addCell(`L${i} ${layer.algo} activations`,
+      `μ=${act.mean.toPrecision(3)} σ=${act.std.toPrecision(3)} ` +
+      `sat=${(act.saturated * 100).toFixed(1)}%`, act.histogram);
+    if (layer.gradient) {
+      addCell(`L${i} ${layer.algo} ∂cost/∂act`,
+        `μ=${layer.gradient.mean.toPrecision(3)} σ=${layer.gradient.std.toPrecision(3)}`,
+        layer.gradient.histogram);
+    }
+  });
+  (stats.weights || []).forEach((wstat, i) => {
+    if (!wstat || !matchesFilter("weight " + wstat.shape, i, filter)) return;
+    addCell(`W${i} ${wstat.shape} ∂cost/∂w`,
+      `w: μ=${wstat.data.mean.toPrecision(3)} σ=${wstat.data.std.toPrecision(3)}`,
+      wstat.gradient.histogram);
+  });
+}
+
+async function refresh() {
+  const modelId = $("model-id").value.trim();
+  const filter = $("layer-filter").value.trim();
+  setQueryState(modelId, filter);
+  if (!modelId) return;
+  try {
+    const progress = await fetchJson(`/progress/?model_id=${encodeURIComponent(modelId)}`);
+    renderProgress(progress);
+  } catch (e) {
+    $("status-badge").textContent = "not found";
+    $("status-badge").className = "badge err";
+    return;
+  }
+  try {
+    const stats = await fetchJson(`/stats/?model_id=${encodeURIComponent(modelId)}`);
+    renderStats(stats, filter);
+  } catch (e) {
+    renderStats(null, filter);
+  }
+}
+
+let autoTimer = null;
+function setupAuto() {
+  if (autoTimer) { clearInterval(autoTimer); autoTimer = null; }
+  if ($("auto-refresh").checked) autoTimer = setInterval(refresh, 5000);
+}
+
+window.addEventListener("DOMContentLoaded", () => {
+  const state = getQueryState();
+  $("model-id").value = state.modelId;
+  $("layer-filter").value = state.filter;
+  $("refresh-btn").addEventListener("click", refresh);
+  $("auto-refresh").addEventListener("change", setupAuto);
+  [$("model-id"), $("layer-filter")].forEach(el =>
+    el.addEventListener("keydown", (e) => { if (e.key === "Enter") refresh(); }));
+  if (state.modelId) refresh();
+});

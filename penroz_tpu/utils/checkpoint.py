@@ -1,0 +1,101 @@
+"""Model checkpoint I/O with a /dev/shm write-through cache.
+
+Checkpoint = one pickle-protocol-5 blob holding the layer DSL, the flat param/
+buffer dicts (numpy arrays; bf16 via ml_dtypes), the optax optimizer config +
+state, and the progress/stats/status JSON — the same logical contents as the
+reference's ``torch.save`` blob (neural_net_model.py:98-174).
+
+Write path: serialize into the shared-memory dir (fast, observable by every
+process on the host) and flush to the durable ``models/`` dir in a detached
+background process — both behaviors are API-visible (the reference's /dev/shm
+cache + async ``shutil.copyfile`` flush, neural_net_model.py:113-122).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import platform
+import shutil
+import tempfile
+import threading
+
+log = logging.getLogger(__name__)
+
+MODELS_FOLDER = "models"
+
+
+def detect_shm_path() -> str:
+    """Best shared-memory directory for this OS (fallback: tempdir)."""
+    system = platform.system()
+    if system == "Linux" and os.path.isdir("/dev/shm") and os.access("/dev/shm", os.W_OK):
+        return "/dev/shm"
+    if system == "Darwin" and os.path.isdir("/Volumes/RAMDisk") and os.access("/Volumes/RAMDisk", os.W_OK):
+        return "/Volumes/RAMDisk"
+    return tempfile.gettempdir()
+
+
+SHM_PATH = detect_shm_path()
+
+
+def model_path(model_id: str) -> str:
+    return os.path.join(MODELS_FOLDER, f"model_{model_id}.ckpt")
+
+
+def shm_model_path(model_id: str) -> str:
+    return os.path.join(SHM_PATH, model_path(model_id))
+
+
+def save(model_id: str, data: dict, sync_flush: bool = False):
+    """Write checkpoint to shm and flush to disk in the background."""
+    os.makedirs(MODELS_FOLDER, exist_ok=True)
+    os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
+    shm_path = shm_model_path(model_id)
+    durable_path = model_path(model_id)
+    log.info("Caching model to %s...", shm_path)
+    with open(shm_path, "wb") as f:
+        pickle.dump(data, f, protocol=5)
+    log.info("Model cached successfully: %s", shm_path)
+    if sync_flush:
+        shutil.copyfile(shm_path, durable_path)
+    else:
+        # Background flush: a thread, not a fork — os.fork() deadlocks under
+        # JAX's thread pool, and the copy is pure file I/O anyway.
+        log.info("Offload flushing model cache %s to %s...", shm_path, durable_path)
+        threading.Thread(target=shutil.copyfile,
+                         args=(shm_path, durable_path), daemon=True).start()
+
+
+def load(model_id: str) -> dict:
+    """Read checkpoint, repopulating the shm cache on a miss.
+
+    :raises KeyError: if the model was never created (API maps this to 404).
+    """
+    shm_path = shm_model_path(model_id)
+    durable_path = model_path(model_id)
+    try:
+        if not os.path.exists(shm_path):
+            log.info("Cache miss: copying from %s", durable_path)
+            os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
+            shutil.copyfile(durable_path, shm_path)
+        with open(shm_path, "rb") as f:
+            return pickle.load(f)
+    except FileNotFoundError as e:
+        log.error("File not found error occurred: %s", e)
+        raise KeyError(f"Model {model_id} not created yet.")
+
+
+def delete(model_id: str):
+    """Remove the shm cache copy and the durable checkpoint.
+
+    Mirrors the reference's semantics (neural_net_model.py:239-248): a missing
+    shm copy short-circuits with a warning.
+    """
+    try:
+        os.remove(shm_model_path(model_id))
+        durable_path = model_path(model_id)
+        if os.path.exists(durable_path):
+            os.remove(durable_path)
+    except FileNotFoundError as e:
+        log.warning("Failed to delete: %s", e)

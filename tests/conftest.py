@@ -1,0 +1,82 @@
+"""Test harness: CPU JAX with 8 virtual devices, isolated model/data dirs.
+
+The reference tests fake multi-process DDP by mocking the launcher
+(test_ddp.py); we go one better — a virtual 8-device CPU mesh exercises real
+sharded compilation and collectives in-process (SURVEY.md §4 implication).
+"""
+
+import os
+
+# Must be set before jax initializes.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# Persistent compilation cache: repeat test runs skip XLA recompiles.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    """Run the test in a temp cwd with an isolated shm dir so model/data
+    folders never leak between tests."""
+    from penroz_tpu.utils import checkpoint
+    monkeypatch.chdir(tmp_path)
+    shm = tmp_path / "shm"
+    shm.mkdir()
+    monkeypatch.setattr(checkpoint, "SHM_PATH", str(shm))
+    return tmp_path
+
+
+@pytest.fixture
+def toy_gpt_layers():
+    """Small GPT-style DSL used across tests."""
+    d, heads, vocab, block = 32, 4, 64, 16
+    return ([{"summation": [
+                {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+                 "normal": {"mean": 0.0, "std": 0.02}},
+                {"position": {"num_embeddings": block, "embedding_dim": d},
+                 "normal": {"mean": 0.0, "std": 0.02}}]},
+             {"dropout": {"p": 0.0}}]
+            + [{"residual": [
+                {"sequential": [
+                    {"layernorm": {"normalized_shape": d}},
+                    {"linear": {"in_features": d, "out_features": 3 * d},
+                     "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                    {"attention": {"num_heads": heads, "dropout": 0.0}},
+                    {"linear": {"in_features": d, "out_features": d}},
+                    {"dropout": {"p": 0.0}}]},
+                {"sequential": [
+                    {"layernorm": {"normalized_shape": d}},
+                    {"linear": {"in_features": d, "out_features": 4 * d}},
+                    {"gelu": {}},
+                    {"linear": {"in_features": 4 * d, "out_features": d}},
+                    {"dropout": {"p": 0.0}}]}]} for _ in range(2)]
+            + [{"layernorm": {"normalized_shape": d}},
+               {"linear": {"in_features": d, "out_features": vocab,
+                           "bias": False}},
+               {"softmaxlast": {"dim": -1}}])
+
+
+@pytest.fixture
+def toy_optimizer():
+    return {"adamw": {"lr": 1e-3, "betas": [0.9, 0.95], "eps": 1e-8}}
+
+
+@pytest.fixture
+def toy_shards(workdir):
+    """Two small uint16 token shards for dataset 'toy'."""
+    import numpy as np
+    data_dir = workdir / "data"
+    data_dir.mkdir(exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        np.save(data_dir / f"toy_{i:06d}",
+                rng.integers(0, 64, 5000).astype(np.uint16))
+    return "toy"

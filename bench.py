@@ -1,0 +1,145 @@
+"""Benchmark: GPT-2 124M training throughput (tokens/sec/chip + MFU) and
+single-prompt decode TTFT on the default accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+reported against the driver's north-star target of 35% MFU on the /train/
+path: vs_baseline = measured_MFU / 0.35.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flops_per_token(n_matmul_params: int, depth: int, d_model: int,
+                     seq: int) -> float:
+    """Forward+backward FLOPs per trained token (nanoGPT/PaLM accounting).
+
+    ``n_matmul_params`` excludes embedding-table lookups (wte/wpe) — only
+    params that participate in matmuls count toward 6N."""
+    return 6.0 * n_matmul_params + 12.0 * depth * d_model * seq
+
+
+def peak_flops(device) -> float:
+    """bf16 peak FLOPs/s for the benchmark chip."""
+    kind = getattr(device, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind:
+        return 918e12
+    return 197e12  # conservative default
+
+
+def bench_train(arch, mapper, params, batch=8, block=1024, steps_per_call=4,
+                warmup=2, timed=6):
+    import optax
+    optimizer = mapper.to_optimizer()
+    opt_state = optimizer.init(params)
+    epoch_fn = arch.train_epoch_fn(mapper.optimizer, steps_per_call, False,
+                                   jnp.bfloat16)
+    rng = jax.random.key(0)
+    data_rng = np.random.default_rng(0)
+    x = jnp.asarray(data_rng.integers(0, 50304, (steps_per_call, batch, block),
+                                      dtype=np.int32))
+    y = jnp.asarray(data_rng.integers(0, 50304, (steps_per_call, batch, block),
+                                      dtype=np.int32))
+    buffers = {}
+
+    for _ in range(warmup):
+        params, opt_state, buffers, cost, _ = epoch_fn(params, opt_state,
+                                                       buffers, x, y, rng)
+    float(cost)  # host transfer: block_until_ready is unreliable over relay
+
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        params, opt_state, buffers, cost, _ = epoch_fn(params, opt_state,
+                                                       buffers, x, y, rng)
+    last_cost = float(cost)
+    elapsed = time.perf_counter() - t0
+    tokens = timed * steps_per_call * batch * block
+    return tokens / elapsed, last_cost
+
+
+def bench_ttft(arch, params, block=1024, prompt_len=128, trials=10):
+    """p50 time-to-first-token: prefill(prompt) + sample, steady state."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    from penroz_tpu.ops import kv_cache as KV
+
+    model = NeuralNetworkModel.__new__(NeuralNetworkModel)
+    model.params = params
+    model.buffers = {}
+    model.arch = arch
+    model.device = None
+    model._sample_rng = jax.random.key(0)
+
+    specs = model._kv_specs(1, prompt_len)
+    decode = arch.decode_fn()
+    compute_dtype = jnp.bfloat16
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, 50304, (1, prompt_len), dtype=np.int32))
+
+    times = []
+    for _ in range(trials + 2):
+        kv = KV.create_kv_state(specs, 1, block, model.dtype)
+        t0 = time.perf_counter()
+        logits, kv = decode(model.params, model.buffers, kv, prompt,
+                            compute_dtype=compute_dtype)
+        tok = model._sample(logits, 1.0, None)
+        int(np.asarray(tok)[0, 0])  # host transfer forces execution
+        times.append((time.perf_counter() - t0) * 1000)
+    return statistics.median(times[2:])  # drop compile/warmup trials
+
+
+def main():
+    from __graft_entry__ import OPTIMIZER, _gpt2_dsl
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import CompiledArch
+
+    device = jax.devices()[0]
+    depth, d_model, block = 12, 768, 1024
+    mapper = Mapper(_gpt2_dsl(depth=depth, d=d_model, block=block), OPTIMIZER)
+    arch = CompiledArch.get(mapper.layers)
+    params, _ = mapper.init_params(arch.mods, seed=0)
+    params = jax.device_put(params, device)
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+    # Embedding tables (layer 0 summation: wte + wpe) are lookups, not matmuls.
+    n_matmul_params = n_params - sum(
+        int(np.prod(p.shape)) for k, p in params.items()
+        if k.startswith("layers.0."))
+
+    # TTFT first — the training benchmark donates (and thus consumes) params.
+    ttft_ms = bench_ttft(arch, params, block=block)
+    tokens_per_sec, cost = bench_train(arch, mapper, params)
+    mfu = (tokens_per_sec
+           * _flops_per_token(n_matmul_params, depth, d_model, block)
+           / peak_flops(device))
+
+    print(json.dumps({
+        "metric": "gpt2-124M train tokens/sec/chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.35, 3),
+        "mfu": round(mfu, 4),
+        "ttft_ms_p50": round(ttft_ms, 2),
+        "train_cost_sample": round(cost, 3),
+        "device": str(device.device_kind),
+        "n_params": n_params,
+    }))
+
+
+if __name__ == "__main__":
+    main()

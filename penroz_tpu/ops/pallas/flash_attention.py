@@ -119,9 +119,10 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
-            flops=4 * B * Hq * T * S * D * (0.5 if causal else 1.0),
-            bytes_accessed=(q.size + k.size + v.size + q.size) * q.dtype.itemsize,
-            transcendentals=B * Hq * T * S),
+            flops=int(4 * B * Hq * T * S * D * (0.5 if causal else 1.0)),
+            bytes_accessed=int((q.size + k.size + v.size + q.size)
+                               * q.dtype.itemsize),
+            transcendentals=int(B * Hq * T * S)),
         interpret=interpret,
     )(q, k, v)
 

@@ -1,0 +1,71 @@
+"""Parameter/batch sharding rules for tensor + sequence parallelism.
+
+Tensor parallelism is expressed purely as ``NamedSharding`` placement of the
+flat param dict over the mesh's ``model`` axis; XLA's SPMD partitioner then
+inserts the all-gathers/reduce-scatters on ICI.  The layout heuristic follows
+the Megatron column→row pairing using weight geometry:
+
+- expanding Linear weights (out > in: QKV, MLP up/gate, LM head) are
+  column-parallel — shard the out dim;
+- contracting Linear weights (out < in: attention proj, MLP down) are
+  row-parallel — shard the in dim;
+- square weights and vectors are replicated;
+- embedding tables shard the vocab dim.
+
+Sequence parallelism: the batch's time dimension is sharded over the
+``sequence`` axis; XLA gathers K/V for full attention (ring attention as a
+Pallas kernel is the planned upgrade path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from penroz_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def _divides(dim: int, mesh: Mesh, axis: str) -> bool:
+    return mesh.shape[axis] > 0 and dim % mesh.shape[axis] == 0
+
+
+def param_spec(key: str, shape: tuple, mesh: Mesh) -> P:
+    """PartitionSpec for one flat-dict parameter."""
+    if len(shape) != 2:
+        return P()
+    out_dim, in_dim = shape
+    is_embedding = key.endswith(".weight") and out_dim > 8 * in_dim
+    if is_embedding and _divides(out_dim, mesh, MODEL_AXIS):
+        return P(MODEL_AXIS, None)  # vocab-sharded table / lm head
+    if out_dim > in_dim and _divides(out_dim, mesh, MODEL_AXIS):
+        return P(MODEL_AXIS, None)  # column parallel
+    if in_dim > out_dim and _divides(in_dim, mesh, MODEL_AXIS):
+        return P(None, MODEL_AXIS)  # row parallel
+    return P()
+
+
+def param_shardings(params: dict, mesh: Mesh) -> dict:
+    return {k: NamedSharding(mesh, param_spec(k, tuple(v.shape), mesh))
+            for k, v in params.items()}
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    """Place a flat param dict onto the mesh under the TP layout."""
+    import jax
+    shardings = param_shardings(params, mesh)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+def batch_spec(mesh: Mesh, *, leading_steps: bool = False,
+               shard_sequence: bool = False) -> P:
+    """Spec for (B, T) or (num_steps, B, T) token batches."""
+    seq = SEQ_AXIS if (shard_sequence and mesh.shape[SEQ_AXIS] > 1) else None
+    spec = (DATA_AXIS, seq)
+    if leading_steps:
+        spec = (None,) + spec
+    return P(*spec)
+
+
+def shard_batch(batch, mesh: Mesh, **kw):
+    import jax
+    return jax.device_put(batch, NamedSharding(mesh, batch_spec(mesh, **kw)))

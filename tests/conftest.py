@@ -21,6 +21,15 @@ import jax  # noqa: E402
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
+# Pin computation to the (virtual 8-device) CPU backend even when an
+# accelerator plugin is present and default: tests must behave like CI.
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+
+@pytest.fixture
+def cpu_devices():
+    return jax.devices("cpu")
+
 
 @pytest.fixture
 def workdir(tmp_path, monkeypatch):

@@ -99,15 +99,13 @@ class KVState:
 
     def advanced(self, num_tokens: int):
         """State with length advanced by ``num_tokens`` (post-step)."""
-        out = type(self)(list(self.k), list(self.v), self.length + num_tokens)
-        return self._copy_extras(out)
+        return self._with_length(self.length + num_tokens)
 
     def reset(self):
-        out = type(self)(list(self.k), list(self.v), jnp.zeros((), jnp.int32))
-        return self._copy_extras(out)
+        return self._with_length(jnp.zeros((), jnp.int32))
 
-    def _copy_extras(self, out):
-        return out
+    def _with_length(self, length):
+        return KVState(list(self.k), list(self.v), length)
 
     # Observability: bytes resident in HBM for this cache.
     def memory_bytes(self) -> int:
@@ -162,11 +160,10 @@ class QuantKVState(KVState):
         v_full = _dequantize_int8(self.v[layer_idx], self.v_scale[layer_idx], self.out_dtype)
         return k_full, v_full, new_length
 
-    def _copy_extras(self, out):
-        out.k_scale = list(self.k_scale)
-        out.v_scale = list(self.v_scale)
-        out.out_dtype = self.out_dtype
-        return out
+    def _with_length(self, length):
+        return QuantKVState(list(self.k), list(self.v), length,
+                            list(self.k_scale), list(self.v_scale),
+                            out_dtype=self.out_dtype)
 
     def logical_bytes(self) -> int:
         itemsize = jnp.dtype(self.out_dtype).itemsize

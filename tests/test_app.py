@@ -1,0 +1,244 @@
+"""REST API tests (aiohttp TestClient — mirrors the reference's FastAPI
+TestClient coverage in test_main.py: route behavior, lock 409s, gzip,
+streaming, error mapping)."""
+
+import asyncio
+import gzip
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from penroz_tpu.serve import app as app_mod
+
+TOY_LAYERS = [
+    {"embedding": {"num_embeddings": 32, "embedding_dim": 8}},
+    {"linear": {"in_features": 8, "out_features": 32}},
+    {"softmaxlast": {"dim": -1}},
+]
+SGD = {"sgd": {"lr": 0.1}}
+
+
+@pytest.fixture
+def client(workdir, event_loop=None):
+    app_mod.model_locks.clear()
+    app_mod.dataset_locks.clear()
+    loop = asyncio.new_event_loop()
+    client = TestClient(TestServer(app_mod.create_app()), loop=loop)
+    loop.run_until_complete(client.start_server())
+    yield _SyncClient(client, loop)
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+class _SyncClient:
+    """Synchronous facade over the async TestClient."""
+
+    def __init__(self, client, loop):
+        self._client = client
+        self._loop = loop
+
+    def request(self, method, path, **kw):
+        async def go():
+            resp = await self._client.request(method, path, **kw)
+            body = await resp.read()
+            return resp, body
+        return self._loop.run_until_complete(go())
+
+    def json(self, method, path, **kw):
+        resp, body = self.request(method, path, **kw)
+        return resp.status, (json.loads(body) if body else None)
+
+
+def _create_model(client, model_id="m1", layers=None, optimizer=None):
+    status, body = client.json("POST", "/model/", json={
+        "model_id": model_id,
+        "layers": layers or TOY_LAYERS,
+        "optimizer": optimizer or SGD,
+    })
+    assert status == 200, body
+    return body
+
+
+def _make_shards(workdir, dataset_id="ds", vocab=32):
+    (workdir / "data").mkdir(exist_ok=True)
+    rng = np.random.default_rng(0)
+    np.save(workdir / "data" / f"{dataset_id}_000000",
+            rng.integers(0, vocab, 4000).astype(np.uint16))
+
+
+def test_create_model(client):
+    body = _create_model(client)
+    assert "created and saved successfully" in body["message"]
+
+
+def test_root_redirects_to_dashboard(client):
+    resp, body = client.request("GET", "/")
+    assert resp.status == 200
+    assert b"dashboard" in body
+
+
+def test_output_route(client):
+    _create_model(client)
+    status, body = client.json("POST", "/output/", json={
+        "model_id": "m1", "input": [[1, 2]], "target": [[2, 3]]})
+    assert status == 200
+    assert len(body["output"][0]) == 32
+    assert body["cost"] > 0
+
+
+def test_generate_route(client):
+    _create_model(client)
+    status, body = client.json("POST", "/generate/", json={
+        "model_id": "m1", "input": [[1, 2]], "block_size": 8,
+        "max_new_tokens": 3, "temperature": 0.0})
+    assert status == 200
+    assert len(body["tokens"]) == 5
+
+
+def test_generate_streaming(client):
+    _create_model(client)
+    resp, body = client.request("POST", "/generate/", json={
+        "model_id": "m1", "input": [[1]], "block_size": 8,
+        "max_new_tokens": 4, "stream": True})
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    lines = body.decode().strip().split("\n")
+    assert len(lines) == 4
+    assert all(line.isdigit() for line in lines)
+
+
+def test_train_route_202_and_progress(client, workdir):
+    _create_model(client)
+    _make_shards(workdir)
+    status, body = client.json("PUT", "/train/", json={
+        "model_id": "m1", "device": "cpu", "dataset_id": "ds", "shard": 0,
+        "epochs": 2, "batch_size": 2, "block_size": 8, "step_size": 1})
+    assert status == 202
+    assert "asynchronously" in body["message"]
+    import time
+    for _ in range(300):
+        status, body = client.json("GET", "/progress/?model_id=m1")
+        if body["status"]["code"] in ("Trained", "Error"):
+            break
+        time.sleep(0.2)
+    assert body["status"]["code"] == "Trained", body["status"]
+    assert len(body["progress"]) == 2
+    assert body["average_cost"] is not None
+    status, stats = client.json("GET", "/stats/?model_id=m1")
+    assert status == 200
+    assert len(stats["layers"]) >= 2
+
+
+def test_train_unknown_model_404(client):
+    status, body = client.json("PUT", "/train/", json={
+        "model_id": "nope", "device": "cpu", "dataset_id": "ds", "shard": 0,
+        "epochs": 1, "batch_size": 2, "block_size": 8, "step_size": 1})
+    assert status == 404
+
+
+def test_train_conflict_409(client, workdir):
+    _create_model(client)
+    lock = app_mod.model_locks.setdefault("m1", asyncio.Lock())
+    client._loop.run_until_complete(lock.acquire())
+    try:
+        status, body = client.json("PUT", "/train/", json={
+            "model_id": "m1", "device": "cpu", "dataset_id": "ds", "shard": 0,
+            "epochs": 1, "batch_size": 2, "block_size": 8, "step_size": 1})
+        assert status == 409
+        assert "already in progress" in body["detail"]
+    finally:
+        lock.release()
+
+
+def test_dataset_download_409_and_list(client, workdir):
+    lock = app_mod.dataset_locks.setdefault("dl", asyncio.Lock())
+    client._loop.run_until_complete(lock.acquire())
+    try:
+        status, body = client.json("POST", "/dataset/", json={
+            "dataset_id": "dl", "encoding": "byte", "path": "p",
+            "name": "n", "split": "train", "shard_size": 100})
+        assert status == 409
+    finally:
+        lock.release()
+    _make_shards(workdir, "listme")
+    status, body = client.json("GET", "/dataset/?dataset_id=listme")
+    assert body["files"] == ["listme_000000.npy"]
+
+
+def test_dataset_delete_204(client, workdir):
+    _make_shards(workdir, "deadds")
+    resp, _ = client.request("DELETE", "/dataset/?dataset_id=deadds")
+    assert resp.status == 204
+    status, body = client.json("GET", "/dataset/?dataset_id=deadds")
+    assert body["files"] == []
+
+
+def test_tokenize_and_decode(client):
+    status, body = client.json("POST", "/tokenize/", json={
+        "encoding": "byte", "text": "ab"})
+    assert status == 200
+    assert body["tokens"] == [97, 98, 256]
+    status, body = client.json("POST", "/decode/", json={
+        "encoding": "byte", "tokens": [97, 98]})
+    assert body["text"] == "ab"
+
+
+def test_evaluate_route(client, workdir):
+    _create_model(client)
+    _make_shards(workdir)
+    status, body = client.json("POST", "/evaluate/", json={
+        "model_id": "m1", "device": "cpu", "dataset_id": "ds", "shard": 0,
+        "epochs": 1, "batch_size": 2, "block_size": 8, "step_size": 1})
+    assert status == 200
+    assert body["cost"] > 0
+
+
+def test_gzip_request_body(client):
+    payload = gzip.compress(json.dumps(
+        {"encoding": "byte", "text": "zip"}).encode())
+    resp, body = client.request(
+        "POST", "/tokenize/", data=payload,
+        headers={"Content-Type": "application/json",
+                 "Content-Encoding": "gzip"})
+    assert resp.status == 200
+    assert json.loads(body)["tokens"] == [122, 105, 112, 256]
+
+
+def test_error_mapping(client):
+    # 404: unknown model
+    status, body = client.json("GET", "/progress/?model_id=ghost")
+    assert status == 404
+    assert "Not found" in body["detail"]
+    # 422: validation error
+    status, body = client.json("POST", "/generate/", json={"model_id": "x"})
+    assert status == 422
+    # 422: missing query param
+    status, body = client.json("GET", "/progress/")
+    assert status == 422
+    # 400: bad layer DSL (ValueError)
+    status, body = client.json("POST", "/model/", json={
+        "model_id": "bad", "layers": [{"nonsense": {}}], "optimizer": SGD})
+    assert status == 400
+    assert "Value error" in body["detail"]
+
+
+def test_delete_model_204_then_404(client):
+    _create_model(client, "gone")
+    resp, _ = client.request("DELETE", "/model/?model_id=gone")
+    assert resp.status == 204
+    status, _ = client.json("GET", "/progress/?model_id=gone")
+    assert status == 404
+
+
+def test_model_locks_shared_between_train_and_import(client):
+    """/import/ and /train/ share the per-model lock namespace."""
+    lock = app_mod.model_locks.setdefault("shared", asyncio.Lock())
+    client._loop.run_until_complete(lock.acquire())
+    try:
+        status, _ = client.json("POST", "/import/", json={
+            "hf_repo_id": "openai-community/gpt2", "model_id": "shared"})
+        assert status == 409
+    finally:
+        lock.release()

@@ -1,0 +1,330 @@
+"""Mapper/DSL tests: registries, init overrides, optimizer coercion, HF
+config → DSL builders and HF state-dict mapping (mirrors test_mappers.py
+coverage areas of the reference)."""
+
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from penroz_tpu.models.dsl import Mapper, build_optimizer
+from penroz_tpu.ops import modules as M
+
+
+# -- layer building ---------------------------------------------------------
+
+def test_unsupported_layer_raises():
+    with pytest.raises(ValueError, match="Unsupported layer"):
+        Mapper([{"frobnicator": {}}], {"sgd": {"lr": 0.1}}).to_modules()
+
+
+def test_unsupported_optimizer_raises():
+    with pytest.raises(ValueError, match="Unsupported optimizer"):
+        Mapper([], {"rmsprop": {}}).to_optimizer()
+
+
+def test_nested_container_build():
+    layers = [{"sequential": [{"linear": {"in_features": 4, "out_features": 8}},
+                              {"relu": {}},
+                              {"sequential": [{"linear": {"in_features": 8,
+                                                          "out_features": 2}}]}]}]
+    mods = Mapper(layers, {"sgd": {"lr": 0.1}}).to_modules()
+    assert isinstance(mods[0], M.Sequential)
+    assert isinstance(mods[0].layers[2], M.Sequential)
+    # prefixes follow torch ModuleList naming
+    assert mods[0].layers[2].layers[0].key("weight") == "layers.0.2.0.weight"
+
+
+def test_init_overrides_applied():
+    layers = [{"linear": {"in_features": 100, "out_features": 50},
+               "normal": {"mean": 5.0, "std": 0.01}, "zeros": {}}]
+    mapper = Mapper(layers, {"sgd": {"lr": 0.1}})
+    mods = mapper.to_modules()
+    params, _ = mapper.init_params(mods)
+    w = np.asarray(params["layers.0.weight"])
+    assert abs(w.mean() - 5.0) < 0.01
+    np.testing.assert_array_equal(np.asarray(params["layers.0.bias"]), 0)
+
+
+def test_confidence_scales_weight():
+    layers = [{"linear": {"in_features": 10, "out_features": 10},
+               "normal": {"mean": 1.0, "std": 0.001}, "confidence": 0.5}]
+    mapper = Mapper(layers, {"sgd": {"lr": 0.1}})
+    params, _ = mapper.init_params(mapper.to_modules())
+    assert abs(np.asarray(params["layers.0.weight"]).mean() - 0.5) < 0.01
+
+
+def test_xavier_kaiming_bounds():
+    layers = [{"linear": {"in_features": 64, "out_features": 64},
+               "xavier_uniform": {}},
+              {"linear": {"in_features": 64, "out_features": 64},
+               "kaiming_uniform": {"a": 0.0, "nonlinearity": "relu"}}]
+    mapper = Mapper(layers, {"sgd": {"lr": 0.1}})
+    params, _ = mapper.init_params(mapper.to_modules())
+    xav = np.asarray(params["layers.0.weight"])
+    assert np.abs(xav).max() <= np.sqrt(6.0 / 128) + 1e-6
+    kai = np.asarray(params["layers.1.weight"])
+    assert np.abs(kai).max() <= np.sqrt(2.0) * np.sqrt(3.0 / 64) + 1e-6
+
+
+def test_optimizer_betas_list_coerced():
+    opt = build_optimizer({"adamw": {"lr": 1e-3, "betas": [0.5, 0.7]}})
+    assert isinstance(opt, optax.GradientTransformation)
+    state = opt.init({"w": np.zeros((2, 2), np.float32)})
+    assert state is not None
+
+
+@pytest.mark.parametrize("config", [
+    {"adam": {"lr": 1e-3, "weight_decay": 0.1}},
+    {"sgd": {"lr": 0.1, "momentum": 0.9, "nesterov": True}},
+    {"sgd": {"lr": 0.1, "weight_decay": 0.01}},
+])
+def test_optimizer_variants_step(config):
+    opt = build_optimizer(config)
+    params = {"w": np.ones((2, 2), np.float32)}
+    state = opt.init(params)
+    grads = {"w": np.full((2, 2), 0.5, np.float32)}
+    updates, _ = opt.update(grads, state, params)
+    assert np.all(np.isfinite(np.asarray(updates["w"])))
+
+
+# -- HF config → DSL --------------------------------------------------------
+
+def _gpt2_config():
+    return SimpleNamespace(model_type="gpt2", vocab_size=50257, n_embd=16,
+                           n_head=2, n_layer=2, n_positions=32,
+                           activation_function="gelu_new", resid_pdrop=0.1,
+                           embd_pdrop=0.2, attn_pdrop=0.3)
+
+
+def test_gpt2_dsl_structure():
+    layers = Mapper.from_hf_config(_gpt2_config())
+    assert len(layers) == 2 + 2 + 3
+    assert "summation" in layers[0]
+    emb, pos = layers[0]["summation"]
+    assert emb["embedding"]["num_embeddings"] == 50257
+    assert pos["position"]["num_embeddings"] == 32
+    assert layers[1] == {"dropout": {"p": 0.2}}
+    block = layers[2]["residual"]
+    attn_seq = block[0]["sequential"]
+    assert attn_seq[1]["linear"]["out_features"] == 48
+    assert attn_seq[2]["attention"] == {"num_heads": 2, "dropout": 0.3}
+    assert attn_seq[4] == {"dropout": {"p": 0.1}}
+    mlp_seq = block[1]["sequential"]
+    assert mlp_seq[2] == {"gelu": {"approximate": "tanh"}}
+    assert layers[-2]["linear"]["bias"] is False
+    assert layers[-1] == {"softmaxlast": {"dim": -1}}
+
+
+def test_gpt2_dsl_layer_override():
+    layers = Mapper.from_hf_config(_gpt2_config(), n_layer_override=5)
+    assert len(layers) == 2 + 5 + 3
+
+
+def _gemma2_config():
+    return SimpleNamespace(
+        model_type="gemma2", vocab_size=1000, hidden_size=32,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        num_hidden_layers=2, intermediate_size=64, rms_norm_eps=1e-5,
+        rope_theta=10000.0, attention_dropout=0.0,
+        hidden_activation="gelu_pytorch_tanh")
+
+
+def test_gemma_dsl_structure():
+    layers = Mapper.from_hf_config(_gemma2_config())
+    assert len(layers) == 1 + 2 + 3
+    assert layers[0]["scaledembedding"]["scale"] == pytest.approx(32 ** 0.5)
+    block = layers[1]["transformerblock"]
+    attn_seq = block["attn_block"]["sequential"]
+    # qkv: 4*8 + 2*2*8 = 64
+    assert attn_seq[1]["linear"]["out_features"] == 64
+    assert attn_seq[2]["attention"]["num_kv_heads"] == 2
+    assert attn_seq[2]["attention"]["rope_theta"] == 10000.0
+    assert block["post_norm_on_residual"] is False  # gemma2 pattern
+    assert "post_attn_norm" in block
+    assert block["mlp_block"]["sequential"][1]["gatedmlp"]["intermediate_size"] == 64
+
+
+def test_gemma1_no_post_norms():
+    config = _gemma2_config()
+    config.model_type = "gemma"
+    block = Mapper.from_hf_config(config)[1]["transformerblock"]
+    assert "post_attn_norm" not in block
+
+
+def test_gemma4_heterogeneous_layers():
+    config = SimpleNamespace(
+        model_type="gemma4",
+        text_config=SimpleNamespace(
+            vocab_size=1000, hidden_size=32, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=8, num_hidden_layers=4,
+            intermediate_size=64, rms_norm_eps=1e-5, rope_theta=None,
+            rope_scaling={"sliding_attention": {"rope_theta": 77.0}},
+            attention_dropout=0.0, hidden_activation="silu",
+            layer_types=["sliding_attention", "full_attention",
+                         "sliding_attention", "full_attention"],
+            global_head_dim=16, num_global_key_value_heads=1,
+            use_double_wide_mlp=True, num_kv_shared_layers=2))
+    layers = Mapper.from_hf_config(config)
+    blocks = [l["transformerblock"] for l in layers[1:5]]
+    # sliding layer: head_dim 8, kv 2 → qkv = 4*8 + 2*2*8 = 64
+    assert blocks[0]["attn_block"]["sequential"][1]["linear"]["out_features"] == 64
+    # full layer: head_dim 16, kv 1 → qkv = 4*16 + 2*1*16 = 96
+    assert blocks[1]["attn_block"]["sequential"][1]["linear"]["out_features"] == 96
+    assert blocks[1]["attn_block"]["sequential"][2]["attention"]["rope_theta"] == 77.0
+    # kv-shared layers (last 2) get double-wide MLP
+    widths = [b["mlp_block"]["sequential"][1]["gatedmlp"]["intermediate_size"]
+              for b in blocks]
+    assert widths == [64, 64, 128, 128]
+
+
+# -- HF state dict mapping --------------------------------------------------
+
+def _fake_gpt2_sd(n_layer=2, d=4, vocab=10, block=8):
+    rng = np.random.default_rng(0)
+    sd = {"transformer.wte.weight": rng.normal(size=(vocab, d)).astype(np.float32),
+          "transformer.wpe.weight": rng.normal(size=(block, d)).astype(np.float32),
+          "transformer.ln_f.weight": np.ones(d, np.float32),
+          "transformer.ln_f.bias": np.zeros(d, np.float32)}
+    for i in range(n_layer):
+        p = f"transformer.h.{i}"
+        sd[f"{p}.ln_1.weight"] = np.ones(d, np.float32)
+        sd[f"{p}.ln_1.bias"] = np.zeros(d, np.float32)
+        sd[f"{p}.attn.c_attn.weight"] = rng.normal(size=(d, 3 * d)).astype(np.float32)
+        sd[f"{p}.attn.c_attn.bias"] = np.zeros(3 * d, np.float32)
+        sd[f"{p}.attn.c_proj.weight"] = rng.normal(size=(d, d)).astype(np.float32)
+        sd[f"{p}.attn.c_proj.bias"] = np.zeros(d, np.float32)
+        sd[f"{p}.ln_2.weight"] = np.ones(d, np.float32)
+        sd[f"{p}.ln_2.bias"] = np.zeros(d, np.float32)
+        sd[f"{p}.mlp.c_fc.weight"] = rng.normal(size=(d, 4 * d)).astype(np.float32)
+        sd[f"{p}.mlp.c_fc.bias"] = np.zeros(4 * d, np.float32)
+        sd[f"{p}.mlp.c_proj.weight"] = rng.normal(size=(4 * d, d)).astype(np.float32)
+        sd[f"{p}.mlp.c_proj.bias"] = np.zeros(d, np.float32)
+    return sd
+
+
+def test_detect_n_layer_gpt2():
+    assert Mapper.detect_hf_n_layer(_fake_gpt2_sd(n_layer=3)) == 3
+
+
+def test_detect_n_layer_unknown():
+    assert Mapper.detect_hf_n_layer({"foo.bar": 1}) == 0
+
+
+def test_gpt2_mapping_transposes_conv1d():
+    sd = _fake_gpt2_sd()
+    mapped = Mapper.map_hf_state_dict_to_custom(sd, 2)
+    np.testing.assert_array_equal(
+        mapped["layers.2.0.1.weight"],
+        sd["transformer.h.0.attn.c_attn.weight"].T)
+    np.testing.assert_array_equal(
+        mapped["layers.2.1.3.weight"],
+        sd["transformer.h.0.mlp.c_proj.weight"].T)
+    # LayerNorm not transposed
+    np.testing.assert_array_equal(mapped["layers.2.0.0.weight"],
+                                  sd["transformer.h.0.ln_1.weight"])
+
+
+def test_gpt2_mapping_tied_lm_head():
+    sd = _fake_gpt2_sd()
+    mapped = Mapper.map_hf_state_dict_to_custom(sd, 2)
+    np.testing.assert_array_equal(mapped["layers.5.weight"],
+                                  sd["transformer.wte.weight"])
+    sd["lm_head.weight"] = np.full_like(sd["transformer.wte.weight"], 7.0)
+    mapped = Mapper.map_hf_state_dict_to_custom(sd, 2)
+    np.testing.assert_array_equal(mapped["layers.5.weight"], sd["lm_head.weight"])
+
+
+def test_gpt2_mapping_key_set_matches_fresh_model():
+    """Mapped keys == a freshly built model's param keys (the reference's
+    strongest mapping assertion: test_mappers key-set equality)."""
+    config = _gpt2_config()
+    sd = _fake_gpt2_sd(n_layer=2, d=16, vocab=50257, block=32)
+    # regenerate fake sd at config dims
+    mapped = Mapper.map_hf_state_dict_to_custom(sd, 2)
+    layers = Mapper.from_hf_config(_gpt2_config())
+    mapper = Mapper(layers, {"sgd": {"lr": 0.1}})
+    mods = mapper.to_modules()
+    param_keys = set()
+    for mod in mods:
+        for sub in mod.walk():
+            param_keys.update(sub.key(n) for n in sub.param_shapes())
+    assert set(mapped) == param_keys
+
+
+def _fake_gemma_sd(n_layer=2, d=8, vocab=20, kv_heads=1, heads=2, head_dim=4,
+                   inter=16, prefix="model", post_norms=True):
+    rng = np.random.default_rng(0)
+    sd = {f"{prefix}.embed_tokens.weight": rng.normal(size=(vocab, d)).astype(np.float32),
+          f"{prefix}.norm.weight": np.zeros(d, np.float32)}
+    for i in range(n_layer):
+        p = f"{prefix}.layers.{i}"
+        sd[f"{p}.input_layernorm.weight"] = np.zeros(d, np.float32)
+        sd[f"{p}.self_attn.q_proj.weight"] = rng.normal(size=(heads * head_dim, d)).astype(np.float32)
+        sd[f"{p}.self_attn.k_proj.weight"] = rng.normal(size=(kv_heads * head_dim, d)).astype(np.float32)
+        sd[f"{p}.self_attn.v_proj.weight"] = rng.normal(size=(kv_heads * head_dim, d)).astype(np.float32)
+        sd[f"{p}.self_attn.o_proj.weight"] = rng.normal(size=(d, heads * head_dim)).astype(np.float32)
+        if post_norms:
+            sd[f"{p}.post_attention_layernorm.weight"] = np.zeros(d, np.float32)
+            sd[f"{p}.pre_feedforward_layernorm.weight"] = np.zeros(d, np.float32)
+            sd[f"{p}.post_feedforward_layernorm.weight"] = np.zeros(d, np.float32)
+        else:
+            sd[f"{p}.post_attention_layernorm.weight"] = np.zeros(d, np.float32)
+        sd[f"{p}.mlp.gate_proj.weight"] = rng.normal(size=(inter, d)).astype(np.float32)
+        sd[f"{p}.mlp.up_proj.weight"] = rng.normal(size=(inter, d)).astype(np.float32)
+        sd[f"{p}.mlp.down_proj.weight"] = rng.normal(size=(d, inter)).astype(np.float32)
+    return sd
+
+
+def test_gemma_mapping_qkv_concat_and_norm_offset():
+    config = SimpleNamespace(model_type="gemma2", num_hidden_layers=2)
+    sd = _fake_gemma_sd()
+    mapped = Mapper.map_hf_state_dict_to_custom(sd, 2, config)
+    qkv = mapped["layers.1.attn_block.1.weight"]
+    np.testing.assert_array_equal(
+        qkv, np.concatenate([sd["model.layers.0.self_attn.q_proj.weight"],
+                             sd["model.layers.0.self_attn.k_proj.weight"],
+                             sd["model.layers.0.self_attn.v_proj.weight"]], axis=0))
+    # RMSNorm weights get the +1 offset
+    np.testing.assert_array_equal(mapped["layers.1.attn_block.0.weight"],
+                                  np.ones(8, np.float32))
+    np.testing.assert_array_equal(mapped["layers.3.weight"],
+                                  np.ones(8, np.float32))
+
+
+def test_gemma_multimodal_prefix():
+    config = SimpleNamespace(model_type="gemma3", num_hidden_layers=2)
+    sd = _fake_gemma_sd(prefix="model.language_model")
+    mapped = Mapper.map_hf_state_dict_to_custom(sd, 2, config)
+    assert "layers.0.weight" in mapped
+    assert Mapper.detect_hf_n_layer(sd) == 2
+
+
+def test_gemma_kv_shared_layer_copies_reference_weights():
+    text = SimpleNamespace(
+        num_kv_shared_layers=1,
+        layer_types=["sliding_attention", "full_attention", "sliding_attention"])
+    config = SimpleNamespace(model_type="gemma4", text_config=text)
+    sd = _fake_gemma_sd(n_layer=3)
+    # poison the shared layer's own k/v: mapping must use layer 0's instead
+    sd["model.layers.2.self_attn.k_proj.weight"] = np.full((4, 8), 99.0, np.float32)
+    sd["model.layers.2.self_attn.v_proj.weight"] = np.full((4, 8), 99.0, np.float32)
+    mapped = Mapper.map_hf_state_dict_to_custom(sd, 3, config)
+    qkv = mapped["layers.3.attn_block.1.weight"]
+    np.testing.assert_array_equal(
+        qkv[2 * 4:3 * 4], sd["model.layers.0.self_attn.k_proj.weight"])
+    np.testing.assert_array_equal(
+        qkv[2 * 4:], np.concatenate([
+            sd["model.layers.0.self_attn.k_proj.weight"],
+            sd["model.layers.0.self_attn.v_proj.weight"]], axis=0))
+
+
+def test_gemma1_post_attention_norm_is_pre_mlp():
+    config = SimpleNamespace(model_type="gemma", num_hidden_layers=2)
+    sd = _fake_gemma_sd(post_norms=False)
+    mapped = Mapper.map_hf_state_dict_to_custom(sd, 2, config)
+    assert "layers.1.post_attn_norm.weight" not in mapped
+    np.testing.assert_array_equal(mapped["layers.1.mlp_block.0.weight"],
+                                  np.ones(8, np.float32))

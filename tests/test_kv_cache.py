@@ -1,0 +1,142 @@
+"""KV cache tests: metrics wrapper parity (reference: test_kv_cache.py) plus
+the functional preallocated KVState/QuantKVState used by the jitted decode."""
+
+import numpy as np
+import pytest
+
+from penroz_tpu.ops import kv_cache as KV
+
+
+def _kv(shape=(1, 2, 3, 4), seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=shape).astype(np.float32) * scale,
+            rng.normal(size=shape).astype(np.float32) * scale)
+
+
+# -- wrapper (metrics/API parity) ------------------------------------------
+
+def test_append_and_get():
+    cache = KV.KVCache(num_layers=2)
+    k, v = _kv()
+    fk, fv = cache.append(0, k, v)
+    np.testing.assert_array_equal(np.asarray(fk), k)
+    assert cache.seq_len(0) == 3
+    assert cache.seq_len(1) == 0
+    k2, v2 = _kv(seed=1)
+    fk, fv = cache.append(0, k2, v2)
+    assert fk.shape == (1, 2, 6, 4)
+    np.testing.assert_array_equal(np.asarray(fk)[:, :, 3:], k2)
+    gk, gv = cache.get(0)
+    assert gk.shape == (1, 2, 6, 4)
+    assert cache.get(1) == (None, None)
+
+
+def test_clear_resets_state_and_metrics():
+    cache = KV.KVCache(num_layers=1)
+    cache.append(0, *_kv())
+    cache.clear()
+    assert cache.seq_len(0) == 0
+    assert cache.metrics.num_appends == 0
+    assert cache.metrics.memory_bytes == 0
+
+
+def test_metrics_accumulate():
+    cache = KV.KVCache(num_layers=1)
+    k, v = _kv()
+    cache.append(0, k, v)
+    cache.append(0, k, v)
+    m = cache.metrics
+    assert m.num_appends == 2
+    assert m.total_entries == 6
+    assert m.memory_bytes == 2 * (k.nbytes + v.nbytes)
+    assert m.compression_ratio == 1.0
+    assert m.last_append_latency_ms >= 0.0
+    cache.log_metrics()  # must not raise
+
+
+def test_turbo_quant_int8_storage_and_tolerance():
+    cache = KV.TurboQuantKVCache(num_layers=1)
+    k, v = _kv(scale=3.0)
+    fk, fv = cache.append(0, k, v)
+    qk, _ = cache.get(0)
+    assert np.asarray(qk).dtype == np.int8
+    np.testing.assert_allclose(np.asarray(fk), k, atol=0.05)
+    np.testing.assert_allclose(np.asarray(fv), v, atol=0.05)
+
+
+def test_turbo_quant_compression_ratio():
+    cache = KV.TurboQuantKVCache(num_layers=1)
+    k, v = _kv(shape=(1, 2, 8, 64))
+    cache.append(0, k, v)
+    assert cache.metrics.compression_ratio > 1.0
+    assert cache.metrics.compressed_memory_bytes < cache.metrics.memory_bytes
+
+
+def test_turbo_quant_per_token_scales():
+    """Rows of very different magnitude are each reconstructed accurately."""
+    cache = KV.TurboQuantKVCache(num_layers=1)
+    k = np.ones((1, 1, 2, 4), np.float32)
+    k[0, 0, 0] *= 1000.0
+    k[0, 0, 1] *= 0.001
+    fk, _ = cache.append(0, k, k.copy())
+    np.testing.assert_allclose(np.asarray(fk), k, rtol=0.02)
+
+
+def test_turbo_quant_zero_rows_survive():
+    cache = KV.TurboQuantKVCache(num_layers=1)
+    k = np.zeros((1, 1, 2, 4), np.float32)
+    fk, _ = cache.append(0, k, k.copy())
+    np.testing.assert_array_equal(np.asarray(fk), k)
+
+
+def test_factory_env_flag(monkeypatch):
+    monkeypatch.delenv(KV.TURBO_QUANT_ENV, raising=False)
+    assert type(KV.create_kv_cache(1)) is KV.KVCache
+    monkeypatch.setenv(KV.TURBO_QUANT_ENV, "1")
+    assert type(KV.create_kv_cache(1)) is KV.TurboQuantKVCache
+    assert type(KV.create_kv_state([(1, 4)], 1, 8)) is KV.QuantKVState
+
+
+# -- functional preallocated state -----------------------------------------
+
+def test_kv_state_append_and_advance():
+    state = KV.KVState.create([(2, 4), (2, 4)], batch=1, max_len=8)
+    k, v = _kv(shape=(1, 2, 3, 4))
+    fk, fv, new_len = state.append(0, k, v)
+    assert fk.shape == (1, 2, 8, 4)
+    np.testing.assert_allclose(np.asarray(fk)[:, :, :3], k, rtol=1e-6)
+    assert int(new_len) == 3
+    assert int(state.length) == 0  # length advances once per model step
+    state = state.advanced(3)
+    assert int(state.length) == 3
+    k2, v2 = _kv(shape=(1, 2, 1, 4), seed=1)
+    fk, _, new_len = state.append(0, k2, v2)
+    np.testing.assert_allclose(np.asarray(fk)[:, :, 3:4], k2, rtol=1e-6)
+    assert int(new_len) == 4
+    state = state.reset()
+    assert int(state.length) == 0
+
+
+def test_kv_state_is_pytree():
+    import jax
+    state = KV.KVState.create([(1, 4)], batch=1, max_len=4)
+    leaves = jax.tree.leaves(state)
+    assert len(leaves) == 3  # k, v, length
+    rebuilt = jax.tree.unflatten(jax.tree.structure(state), leaves)
+    assert isinstance(rebuilt, KV.KVState)
+
+
+def test_quant_kv_state_roundtrip():
+    state = KV.QuantKVState.create([(2, 4)], batch=1, max_len=8)
+    k, v = _kv(shape=(1, 2, 3, 4), scale=2.0)
+    fk, fv, _ = state.append(0, k, v)
+    assert state.k[0].dtype == np.int8
+    np.testing.assert_allclose(np.asarray(fk)[:, :, :3], k, atol=0.05)
+    assert state.memory_bytes() < state.logical_bytes()
+
+
+def test_record_step_metrics():
+    cache = KV.KVCache(num_layers=1)
+    cache.record_step(num_tokens=4, logical_bytes=1000, stored_bytes=250)
+    assert cache.metrics.compression_ratio == 4.0
+    assert cache.metrics.total_entries == 4

@@ -1,0 +1,257 @@
+"""Model runtime tests (mirrors the reference's test_neural_net_model.py
+strategy): DSL init tables, forward/output/eval/generate behavior, a real
+training integration with serialize/deserialize round-trip, error statuses,
+and bf16 dtype restoration."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+
+SGD = {"sgd": {"lr": 0.1}}
+ADAMW = {"adamw": {"lr": 1e-3, "betas": [0.9, 0.95], "eps": 1e-8}}
+
+MLP_LAYERS = [
+    {"flatten": {}},
+    {"linear": {"in_features": 8, "out_features": 16},
+     "xavier_uniform": {}, "zeros": {}},
+    {"batchnorm1d": {"num_features": 16}},
+    {"tanh": {}},
+    {"linear": {"in_features": 16, "out_features": 4}},
+    {"softmax": {"dim": -1}},
+]
+
+
+@pytest.mark.parametrize("layers,expected_params", [
+    ([{"linear": {"in_features": 3, "out_features": 2}}], 8),
+    ([{"embedding": {"num_embeddings": 10, "embedding_dim": 4}}], 40),
+    (MLP_LAYERS, 8 * 16 + 16 + 2 * 16 + 16 * 4 + 4),
+])
+def test_param_counts(workdir, layers, expected_params):
+    model = NeuralNetworkModel("m", Mapper(layers, SGD))
+    assert model.num_params == expected_params
+
+
+def test_state_dict_keys_include_buffers(workdir):
+    model = NeuralNetworkModel("m", Mapper(MLP_LAYERS, SGD))
+    sd = model.state_dict()
+    assert "layers.2.running_mean" in sd
+    assert "layers.2.num_batches_tracked" in sd
+    assert "layers.1.weight" in sd
+
+
+def test_compute_output_softmax_and_cost(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("m", Mapper(toy_gpt_layers, SGD))
+    out, cost = model.compute_output([[1, 2, 3]], [[2, 3, 4]])
+    out = np.asarray(out)
+    assert out.shape == (1, 64)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+    assert cost is not None and cost > 0
+
+
+def test_compute_output_no_target(workdir):
+    model = NeuralNetworkModel("m", Mapper(
+        [{"linear": {"in_features": 2, "out_features": 2}}], SGD))
+    out, cost = model.compute_output([[1.0, 2.0]])
+    assert cost is None
+    assert len(out[0]) == 2
+
+
+def test_compute_output_mse(workdir):
+    model = NeuralNetworkModel("m", Mapper(
+        [{"linear": {"in_features": 2, "out_features": 2}}], SGD))
+    _, cost = model.compute_output([[1.0, 2.0]], [[0.0, 0.0]])
+    assert cost > 0
+
+
+def test_serialize_roundtrip_params_and_optimizer(workdir, toy_gpt_layers,
+                                                 toy_shards):
+    model = NeuralNetworkModel("rt", Mapper(toy_gpt_layers, ADAMW))
+    model.train_model("toy", shard=0, epochs=2, batch_size=2, block_size=16,
+                      step_size=1)
+    model.serialize(sync_flush=True)
+    loaded = NeuralNetworkModel.deserialize("rt")
+    assert loaded.status["code"] == "Trained"
+    for key, val in model.params.items():
+        np.testing.assert_array_equal(np.asarray(val),
+                                      np.asarray(loaded.params[key]))
+    # optimizer moments survive the round trip
+    import jax
+    orig = jax.tree.leaves(model.opt_state)
+    back = jax.tree.leaves(loaded.opt_state)
+    assert len(orig) == len(back)
+    for a, b in zip(orig, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_changes_params_and_records_progress(workdir, toy_gpt_layers,
+                                                   toy_shards):
+    model = NeuralNetworkModel("tr", Mapper(toy_gpt_layers, ADAMW))
+    before = {k: np.asarray(v).copy() for k, v in model.params.items()}
+    model.train_model("toy", shard=0, epochs=3, batch_size=4, block_size=16,
+                      step_size=2)
+    changed = any(not np.array_equal(before[k], np.asarray(v))
+                  for k, v in model.params.items())
+    assert changed
+    assert len(model.progress) == 3
+    entry = model.progress[-1]
+    assert set(entry) >= {"epoch", "cost", "durationInSecs", "speedPerSec",
+                          "weight_upd_ratio"}
+    assert entry["epoch"] == 3
+    assert len(entry["weight_upd_ratio"]) == len(model.arch.param_order)
+    assert model.avg_cost is not None
+    assert len(model.avg_cost_history) == 1
+    assert model.status["code"] == "Trained"
+    # stats recorded on the final epoch
+    assert model.stats is not None
+    assert len(model.stats["weights"]) == len(model.arch.param_order)
+    sat = model.stats["layers"][0]["activation"]["saturated"]
+    assert 0.0 <= sat <= 1.0
+
+
+def test_train_missing_dataset_sets_error_status(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("err", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    with pytest.raises(Exception):
+        NeuralNetworkModel.train_model_on_device(
+            "err", "cpu", "nonexistent-ds", 0, 1, 2, 16, 1)
+    loaded = NeuralNetworkModel.deserialize("err")
+    assert loaded.status["code"] == "Error"
+
+
+def test_evaluate_model(workdir, toy_gpt_layers, toy_shards):
+    model = NeuralNetworkModel("ev", Mapper(toy_gpt_layers, SGD))
+    cost = model.evaluate_model("toy", None, 0, 2, 2, 16, 1)
+    assert np.isfinite(cost) and cost > 0
+
+
+def test_evaluate_with_target_dataset(workdir, toy_gpt_layers, toy_shards):
+    model = NeuralNetworkModel("ev2", Mapper(toy_gpt_layers, SGD))
+    cost = model.evaluate_model("toy", "toy", 0, 1, 2, 16, 1)
+    assert np.isfinite(cost)
+
+
+def test_generate_greedy_deterministic(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("g", Mapper(toy_gpt_layers, SGD))
+    a = model.generate_tokens([[1, 2]], block_size=16, max_new_tokens=4,
+                              temperature=0.0)
+    b = model.generate_tokens([[1, 2]], block_size=16, max_new_tokens=4,
+                              temperature=0.0)
+    assert a == b
+    assert len(a) == 6
+    assert a[:2] == [1, 2]
+
+
+def test_generate_top_k_and_ranges(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("g2", Mapper(toy_gpt_layers, SGD))
+    tokens = model.generate_tokens([[1]], block_size=16, max_new_tokens=5,
+                                   temperature=0.8, top_k=5)
+    assert len(tokens) == 6
+    assert all(0 <= t < 64 for t in tokens)
+
+
+def test_generate_stop_token(workdir):
+    # constant-logits model: bias forces token 3 to always win at temp 0
+    layers = [{"embedding": {"num_embeddings": 8, "embedding_dim": 4},
+               "normal": {"mean": 0.0, "std": 0.001}},
+              {"linear": {"in_features": 4, "out_features": 8}},
+              {"softmaxlast": {"dim": -1}}]
+    model = NeuralNetworkModel("g3", Mapper(layers, SGD))
+    bias = np.zeros(8, np.float32)
+    bias[3] = 100.0
+    model.params["layers.1.bias"] = jnp.asarray(bias)
+    tokens = model.generate_tokens([[0]], block_size=8, max_new_tokens=10,
+                                   temperature=0.0, stop_token=3)
+    assert tokens == [0, 3]
+
+
+def test_generate_stream_matches_count(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("g4", Mapper(toy_gpt_layers, SGD))
+    tokens = list(model.generate_tokens_stream([[1, 2]], block_size=16,
+                                               max_new_tokens=3))
+    assert len(tokens) == 3
+
+
+def test_generate_context_overflow_reprefills(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("g5", Mapper(toy_gpt_layers, SGD))
+    # block_size 4 < prompt+generated: exercises crop-and-reprefill
+    tokens = model.generate_tokens([[1, 2, 3]], block_size=4,
+                                   max_new_tokens=6, temperature=0.0)
+    assert len(tokens) == 9
+
+
+def test_generate_with_turbo_quant(workdir, toy_gpt_layers, monkeypatch):
+    monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    model = NeuralNetworkModel("g6", Mapper(toy_gpt_layers, SGD))
+    tokens = model.generate_tokens([[1, 2]], block_size=16, max_new_tokens=3,
+                                   temperature=0.0)
+    assert len(tokens) == 5
+
+
+def test_kv_cache_consistency_greedy(workdir, toy_gpt_layers):
+    """Greedy decode with KV cache == greedy decode recomputing full context."""
+    model = NeuralNetworkModel("g7", Mapper(toy_gpt_layers, SGD))
+    cached = model.generate_tokens([[5, 6, 7]], block_size=16,
+                                   max_new_tokens=5, temperature=0.0)
+    # recompute without cache by feeding the full context each step
+    context = [5, 6, 7]
+    for _ in range(5):
+        out, _ = model.compute_output([context[-16:]])
+        context.append(int(np.argmax(out[0])))
+    assert cached == context
+
+
+def test_bf16_roundtrip(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("bf", Mapper(toy_gpt_layers, SGD))
+    model.to(dtype=jnp.bfloat16)
+    assert model.dtype == jnp.bfloat16
+    model.serialize(sync_flush=True)
+    loaded = NeuralNetworkModel.deserialize("bf")
+    assert loaded.dtype == jnp.bfloat16
+    out, cost = loaded.compute_output([[1, 2]], [[2, 3]])
+    assert np.isfinite(cost)
+    tokens = loaded.generate_tokens([[1]], block_size=16, max_new_tokens=2)
+    assert len(tokens) == 3
+
+
+def test_deserialize_missing_raises_keyerror(workdir):
+    with pytest.raises(KeyError):
+        NeuralNetworkModel.deserialize("missing-model")
+
+
+def test_delete_removes_checkpoint(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("del", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    NeuralNetworkModel.deserialize("del")
+    NeuralNetworkModel.delete("del")
+    with pytest.raises(KeyError):
+        NeuralNetworkModel.deserialize("del")
+
+
+def test_shm_cache_miss_repopulates(workdir, toy_gpt_layers):
+    import os
+    from penroz_tpu.utils import checkpoint as ckpt
+    model = NeuralNetworkModel("cm", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    os.remove(ckpt.shm_model_path("cm"))
+    loaded = NeuralNetworkModel.deserialize("cm")  # repopulates from durable
+    assert loaded.num_params == model.num_params
+    assert os.path.exists(ckpt.shm_model_path("cm"))
+
+
+def test_mlp_training_per_position(workdir, toy_shards):
+    """Makemore-style MLP path: per-position embedding/tanh stack + CE."""
+    layers = [
+        {"embedding": {"num_embeddings": 64, "embedding_dim": 8}},
+        {"linear": {"in_features": 8, "out_features": 32}},
+        {"tanh": {}},
+        {"linear": {"in_features": 32, "out_features": 64}},
+        {"softmax": {"dim": -1}},
+    ]
+    model = NeuralNetworkModel("mlp", Mapper(layers, SGD))
+    model.train_model("toy", shard=0, epochs=2, batch_size=4, block_size=16,
+                      step_size=4)
+    assert model.status["code"] == "Trained"
+    assert np.isfinite(model.progress[-1]["cost"])

@@ -1,0 +1,97 @@
+"""Parallelism tests on the virtual 8-device CPU mesh: real sharded
+compilation + execution (the reference only mocks its launcher —
+SURVEY.md §4 calls out this upgrade)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from penroz_tpu.parallel import dist, mesh as mesh_lib, sharding
+
+
+def test_virtual_device_count(cpu_devices):
+    assert len(cpu_devices) == 8
+
+
+def test_make_mesh_shapes(cpu_devices):
+    mesh = mesh_lib.make_mesh(cpu_devices)
+    assert mesh.shape == {"data": 8, "model": 1, "sequence": 1}
+    mesh = mesh_lib.make_mesh(cpu_devices, model=2, sequence=2)
+    assert mesh.shape == {"data": 2, "model": 2, "sequence": 2}
+    with pytest.raises(ValueError):
+        mesh_lib.make_mesh(cpu_devices, model=3)
+
+
+def test_param_spec_rules(cpu_devices):
+    mesh = mesh_lib.make_mesh(cpu_devices, model=2)
+    # column-parallel: expanding projection
+    assert sharding.param_spec("w.qkv", (96, 32), mesh) == P("model", None)
+    # row-parallel: contracting projection
+    assert sharding.param_spec("w.out", (32, 96), mesh) == P(None, "model")
+    # square → replicated
+    assert sharding.param_spec("w.sq", (32, 32), mesh) == P()
+    # vector → replicated
+    assert sharding.param_spec("w.b", (32,), mesh) == P()
+    # embedding-like table shards the vocab dim
+    assert sharding.param_spec("layers.0.weight", (50304, 64), mesh) == \
+        P("model", None)
+    # indivisible dims → replicated
+    assert sharding.param_spec("w.odd", (33, 7), mesh) == P()
+
+
+def test_data_parallel_grad_equivalence(cpu_devices):
+    """Grads from a data-sharded step == single-device grads."""
+    mesh = mesh_lib.make_mesh(cpu_devices[:4])
+
+    def loss(w, x):
+        return jnp.mean((x @ w) ** 2)
+
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(8, 4)),
+                    jnp.float32)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(16, 8)),
+                    jnp.float32)
+    g_single = jax.grad(loss)(w, x)
+
+    w_repl = jax.device_put(w, mesh_lib.replicated(mesh))
+    x_shard = jax.device_put(x, mesh_lib.batch_sharding(mesh))
+    g_sharded = jax.jit(jax.grad(loss))(w_repl, x_shard)
+    np.testing.assert_allclose(np.asarray(g_single), np.asarray(g_sharded),
+                               rtol=1e-5)
+
+
+def test_tensor_parallel_forward_equivalence(cpu_devices):
+    """Column-sharded matmul output == replicated matmul output."""
+    mesh = mesh_lib.make_mesh(cpu_devices, model=2)
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 16)),
+                    jnp.float32)  # column-parallel (out, in)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16)),
+                    jnp.float32)
+    expected = x @ w.T
+    w_tp = jax.device_put(w, sharding.param_shardings({"w.big": w}, mesh)["w.big"])
+    out = jax.jit(lambda w, x: x @ w.T)(w_tp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-4)
+
+
+def test_dryrun_multichip_entrypoint():
+    import __graft_entry__ as entrypoints
+    entrypoints.dryrun_multichip(8)
+
+
+def test_graft_entry_compiles():
+    import __graft_entry__ as entrypoints
+    fn, args = entrypoints.entry()
+    # single forward on tiny slice would be heavy (124M params on CPU);
+    # compile-check via eval_shape only, as the driver does single-chip.
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == ()
+
+
+def test_process_topology_single_host():
+    assert dist.process_count() == 1
+    assert dist.process_index() == 0
+    assert dist.master_proc()
+    assert not dist.is_distributed()
+    assert dist.initialize() is False  # no cluster env → no-op

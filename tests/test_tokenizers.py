@@ -1,0 +1,59 @@
+"""Tokenizer facade tests (backends mocked, as in the reference's
+test_gpt_tokenizers.py; the byte backend runs for real — it's offline)."""
+
+import sys
+from unittest.mock import MagicMock, patch
+
+import pytest
+
+from penroz_tpu.data.tokenizers import Tokenizer, BYTE_EOT
+
+
+def test_byte_roundtrip():
+    tok = Tokenizer("byte")
+    tokens = tok.tokenize("Hello ✓")
+    assert tokens[-1] == BYTE_EOT
+    assert tok.decode(tokens) == "Hello ✓"
+
+
+def test_byte_empty_string_gets_eot():
+    assert Tokenizer("byte").tokenize("") == [BYTE_EOT]
+
+
+def test_tiktoken_backend():
+    enc = MagicMock()
+    enc.encode_ordinary.return_value = [1, 2]
+    enc.eot_token = 99
+    enc.decode.return_value = "hi"
+    fake_mod = MagicMock()
+    fake_mod.get_encoding.return_value = enc
+    with patch.dict(sys.modules, {"tiktoken": fake_mod}):
+        tok = Tokenizer("tiktoken/gpt2")
+        assert tok.tokenize("hi") == [1, 2, 99]
+        assert tok.decode([1, 2]) == "hi"
+    fake_mod.get_encoding.assert_called_once_with("gpt2")
+
+
+def test_huggingface_backend():
+    enc = MagicMock()
+    enc.encode.return_value = [5, 6]
+    enc.eos_token_id = 7
+    enc.decode.return_value = "text"
+    fake_auto = MagicMock()
+    fake_auto.from_pretrained.return_value = enc
+    fake_transformers = MagicMock(AutoTokenizer=fake_auto)
+    with patch.dict(sys.modules, {"transformers": fake_transformers}):
+        tok = Tokenizer("google/gemma-2b")
+        assert tok.tokenize("x") == [5, 6, 7]
+        enc.encode.assert_called_with("x", add_special_tokens=False)
+        assert tok.decode([5]) == "text"
+
+
+def test_huggingface_no_eos():
+    enc = MagicMock()
+    enc.encode.return_value = [5]
+    enc.eos_token_id = None
+    fake_transformers = MagicMock()
+    fake_transformers.AutoTokenizer.from_pretrained.return_value = enc
+    with patch.dict(sys.modules, {"transformers": fake_transformers}):
+        assert Tokenizer("some/model").tokenize("x") == [5]

@@ -110,6 +110,9 @@ def cached_attention(q, k_full, v_full, offset, length,
 
 def _use_flash(q, k) -> bool:
     """Whether the Pallas flash kernel applies to these shapes/platform."""
+    import os
+    if os.environ.get("PENROZ_DISABLE_FLASH", "0") == "1":
+        return False
     try:
         platform = q.devices().pop().platform if hasattr(q, "devices") else \
             jax.default_backend()

@@ -1,0 +1,99 @@
+"""Ring attention: causal self-attention with the sequence dimension sharded
+over the mesh's ``sequence`` axis.
+
+Each device keeps its local query block resident and processes K/V blocks as
+they rotate around the ring via ``lax.ppermute`` (XLA lowers this onto ICI
+neighbor links), carrying online-softmax statistics — the distributed
+analogue of the flash-attention inner loop.  Peak memory per device is
+O(T/n · T/n) for scores and O(T/n · D) for accumulators, enabling context
+lengths that cannot fit on one chip.
+
+The reference has no long-context support at all (SURVEY.md §5: sequence
+length bounded by block_size, full causal attention only), so this module is
+an extension point, not a parity item.
+
+Causal scheduling note: block j of K/V only contributes to query block i when
+j <= i, so later ring steps are fully masked for low-index devices.  We still
+rotate all n steps (uniform SPMD program) but skip the masked compute via
+``lax.cond``-free arithmetic — the masked contribution is zeros and XLA's
+predication keeps it cheap relative to the collective itself.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from penroz_tpu.parallel.mesh import SEQ_AXIS
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard body. q/k/v: (B, H, T_local, D) — the local blocks."""
+    B, Hq, Tl, D = q.shape
+    Hkv = k.shape[1]
+    group = Hq // Hkv
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / (D ** 0.5)
+
+    qg = q.reshape(B, Hkv, group, Tl, D)
+    q_pos = my_idx * Tl + jnp.arange(Tl, dtype=jnp.int32)
+
+    def step(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        # k_cur originated on device (my_idx - i) mod n after i rotations.
+        src = (my_idx - i) % n
+        k_pos = src * Tl + jnp.arange(Tl, dtype=jnp.int32)
+        s = jnp.einsum("bhgtd,bhsd->bhgts", qg, k_cur,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+            s = jnp.where(mask[None, None, None], s, _NEG_INF)
+        s_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, s_max)
+        # Guard fully-masked rows: keep them at -inf without producing NaNs.
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(m_new)[..., None], p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhgts,bhsd->bhgtd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
+        # Rotate K/V one hop around the ring: device d sends to d+1.
+        perm = [(d, (d + 1) % n) for d in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, acc_new, k_next, v_next
+
+    m0 = jnp.full((B, Hkv, group, Tl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, group, Tl), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, group, Tl, D), jnp.float32)
+    # Mark the replicated-initialized carries as device-varying so the loop
+    # carry type matches what the ring rotation produces.
+    m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), (axis_name,))
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l[..., None]).astype(q.dtype)
+    return out.reshape(B, Hq, Tl, D)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                   axis_name: str = SEQ_AXIS):
+    """Sequence-parallel attention over ``mesh``'s sequence axis.
+
+    q: (B, Hq, T, D); k/v: (B, Hkv, T, D), all sharded (or shardable) on the
+    T dimension.  Returns attention output with the same sharding.
+    """
+    spec = P(None, None, axis_name, None)
+    body = functools.partial(_ring_attention_local, axis_name=axis_name,
+                             causal=causal)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,349 @@
+// penroz_bpe — native byte-level BPE tokenizer core (trainer + encoder).
+//
+// The reference consumes BPE through tiktoken's Rust extension
+// (gpt_tokenizers.py:10); this is the framework's own native equivalent so
+// tokenization works offline and shard building is not bottlenecked on
+// Python. Exposed as a plain CPython extension (no pybind11 dependency).
+//
+// Scheme ("penroz-bpe"): byte-level symbols (0..255), greedy lowest-rank
+// merges; words are pre-split as {optional leading space}{letters} | digits |
+// other-run, so encodings are stable across documents. Trained models are
+// just the merge list in order.
+//
+// API:
+//   train(corpus: bytes, num_merges: int) -> list[(int, int)]
+//   Encoder(merges: list[(int, int)])
+//     .encode(text: bytes) -> list[int]      # token ids
+//     .decode(ids: list[int]) -> bytes
+//     .vocab_size -> int
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+using Pair = std::pair<int, int>;
+
+struct PairHash {
+  size_t operator()(const Pair& p) const {
+    return (static_cast<size_t>(p.first) << 32) ^
+           static_cast<size_t>(static_cast<uint32_t>(p.second));
+  }
+};
+
+// -------- word pre-splitting ------------------------------------------------
+
+inline bool is_letter(uint8_t c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || c >= 0x80;
+}
+inline bool is_digit(uint8_t c) { return c >= '0' && c <= '9'; }
+
+// Split raw bytes into words: [space]letters+ | digits+ | single other.
+std::vector<std::pair<size_t, size_t>> split_words(const uint8_t* data,
+                                                   size_t len) {
+  std::vector<std::pair<size_t, size_t>> words;
+  size_t i = 0;
+  while (i < len) {
+    size_t start = i;
+    size_t j = i;
+    if (data[j] == ' ' && j + 1 < len && is_letter(data[j + 1])) j++;
+    if (is_letter(data[j])) {
+      while (j < len && is_letter(data[j])) j++;
+      words.emplace_back(start, j - start);
+      i = j;
+    } else if (is_digit(data[j])) {
+      while (j < len && is_digit(data[j])) j++;
+      words.emplace_back(start, j - start);
+      i = j;
+    } else {
+      words.emplace_back(start, 1);
+      i = start + 1;
+    }
+  }
+  return words;
+}
+
+// -------- training ----------------------------------------------------------
+
+struct TrainWord {
+  std::vector<int> syms;
+  int64_t count = 0;
+};
+
+PyObject* bpe_train(PyObject*, PyObject* args) {
+  Py_buffer corpus;
+  long num_merges;
+  if (!PyArg_ParseTuple(args, "y*l", &corpus, &num_merges)) return nullptr;
+  const uint8_t* data = static_cast<const uint8_t*>(corpus.buf);
+  size_t len = corpus.len;
+
+  // Deduplicate words with counts.
+  std::unordered_map<std::string, int64_t> word_counts;
+  for (auto [off, wlen] : split_words(data, len)) {
+    word_counts[std::string(reinterpret_cast<const char*>(data + off), wlen)]
+        += 1;
+  }
+  PyBuffer_Release(&corpus);
+
+  std::vector<TrainWord> words;
+  words.reserve(word_counts.size());
+  for (auto& [w, c] : word_counts) {
+    TrainWord tw;
+    tw.count = c;
+    tw.syms.reserve(w.size());
+    for (uint8_t b : w) tw.syms.push_back(b);
+    words.push_back(std::move(tw));
+  }
+
+  // Pair counts + index of words containing each pair.
+  std::unordered_map<Pair, int64_t, PairHash> pair_counts;
+  std::unordered_map<Pair, std::unordered_set<size_t>, PairHash> pair_words;
+  for (size_t wi = 0; wi < words.size(); wi++) {
+    auto& syms = words[wi].syms;
+    for (size_t k = 0; k + 1 < syms.size(); k++) {
+      Pair p{syms[k], syms[k + 1]};
+      pair_counts[p] += words[wi].count;
+      pair_words[p].insert(wi);
+    }
+  }
+
+  std::vector<Pair> merges;
+  merges.reserve(num_merges);
+  int next_id = 256;
+
+  for (long m = 0; m < num_merges; m++) {
+    // Highest-count pair (ties broken deterministically by pair value).
+    Pair best{-1, -1};
+    int64_t best_count = 0;
+    for (auto& [p, c] : pair_counts) {
+      if (c > best_count ||
+          (c == best_count && best.first >= 0 && p < best)) {
+        best = p;
+        best_count = c;
+      }
+    }
+    if (best_count < 2) break;  // nothing left worth merging
+
+    int new_id = next_id++;
+    merges.push_back(best);
+
+    // Rewrite only the words that contain the merged pair.
+    auto affected_it = pair_words.find(best);
+    std::vector<size_t> affected(affected_it->second.begin(),
+                                 affected_it->second.end());
+    for (size_t wi : affected) {
+      auto& syms = words[wi].syms;
+      int64_t wc = words[wi].count;
+      // remove old pair contributions of this word
+      for (size_t k = 0; k + 1 < syms.size(); k++) {
+        Pair p{syms[k], syms[k + 1]};
+        auto it = pair_counts.find(p);
+        if (it != pair_counts.end()) {
+          it->second -= wc;
+          if (it->second <= 0) pair_counts.erase(it);
+        }
+        auto pw = pair_words.find(p);
+        if (pw != pair_words.end()) pw->second.erase(wi);
+      }
+      // apply the merge
+      std::vector<int> out;
+      out.reserve(syms.size());
+      for (size_t k = 0; k < syms.size();) {
+        if (k + 1 < syms.size() && syms[k] == best.first &&
+            syms[k + 1] == best.second) {
+          out.push_back(new_id);
+          k += 2;
+        } else {
+          out.push_back(syms[k]);
+          k += 1;
+        }
+      }
+      syms = std::move(out);
+      // add new pair contributions
+      for (size_t k = 0; k + 1 < syms.size(); k++) {
+        Pair p{syms[k], syms[k + 1]};
+        pair_counts[p] += wc;
+        pair_words[p].insert(wi);
+      }
+    }
+  }
+
+  PyObject* result = PyList_New(merges.size());
+  for (size_t i = 0; i < merges.size(); i++) {
+    PyList_SET_ITEM(result, i,
+                    Py_BuildValue("(ii)", merges[i].first, merges[i].second));
+  }
+  return result;
+}
+
+// -------- encoder -----------------------------------------------------------
+
+struct EncoderObject {
+  PyObject_HEAD
+  std::unordered_map<Pair, int, PairHash>* ranks;     // pair -> rank
+  std::unordered_map<Pair, int, PairHash>* pair_ids;  // pair -> merged id
+  std::vector<std::string>* vocab;                    // id -> bytes
+};
+
+void encoder_dealloc(PyObject* self) {
+  auto* enc = reinterpret_cast<EncoderObject*>(self);
+  delete enc->ranks;
+  delete enc->pair_ids;
+  delete enc->vocab;
+  Py_TYPE(self)->tp_free(self);
+}
+
+int encoder_init(PyObject* self, PyObject* args, PyObject*) {
+  PyObject* merges;
+  if (!PyArg_ParseTuple(args, "O", &merges)) return -1;
+  auto* enc = reinterpret_cast<EncoderObject*>(self);
+  enc->ranks = new std::unordered_map<Pair, int, PairHash>();
+  enc->pair_ids = new std::unordered_map<Pair, int, PairHash>();
+  enc->vocab = new std::vector<std::string>();
+  enc->vocab->reserve(256 + PySequence_Size(merges));
+  for (int b = 0; b < 256; b++)
+    enc->vocab->push_back(std::string(1, static_cast<char>(b)));
+
+  PyObject* seq = PySequence_Fast(merges, "merges must be a sequence");
+  if (!seq) return -1;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+    int a, b;
+    if (!PyArg_ParseTuple(item, "ii", &a, &b)) {
+      Py_DECREF(seq);
+      return -1;
+    }
+    Pair p{a, b};
+    int id = 256 + static_cast<int>(i);
+    (*enc->ranks)[p] = static_cast<int>(i);
+    (*enc->pair_ids)[p] = id;
+    enc->vocab->push_back((*enc->vocab)[a] + (*enc->vocab)[b]);
+  }
+  Py_DECREF(seq);
+  return 0;
+}
+
+void encode_word(const EncoderObject* enc, const uint8_t* data, size_t len,
+                 std::vector<int>& out) {
+  std::vector<int> syms;
+  syms.reserve(len);
+  for (size_t i = 0; i < len; i++) syms.push_back(data[i]);
+  // Greedy lowest-rank merging.
+  while (syms.size() >= 2) {
+    int best_rank = INT32_MAX;
+    size_t best_pos = 0;
+    for (size_t k = 0; k + 1 < syms.size(); k++) {
+      auto it = enc->ranks->find({syms[k], syms[k + 1]});
+      if (it != enc->ranks->end() && it->second < best_rank) {
+        best_rank = it->second;
+        best_pos = k;
+      }
+    }
+    if (best_rank == INT32_MAX) break;
+    Pair p{syms[best_pos], syms[best_pos + 1]};
+    syms[best_pos] = enc->pair_ids->at(p);
+    syms.erase(syms.begin() + best_pos + 1);
+  }
+  out.insert(out.end(), syms.begin(), syms.end());
+}
+
+PyObject* encoder_encode(PyObject* self, PyObject* args) {
+  Py_buffer text;
+  if (!PyArg_ParseTuple(args, "y*", &text)) return nullptr;
+  auto* enc = reinterpret_cast<EncoderObject*>(self);
+  const uint8_t* data = static_cast<const uint8_t*>(text.buf);
+  std::vector<int> ids;
+  ids.reserve(text.len / 3 + 4);
+  for (auto [off, wlen] : split_words(data, text.len)) {
+    encode_word(enc, data + off, wlen, ids);
+  }
+  PyBuffer_Release(&text);
+  PyObject* result = PyList_New(ids.size());
+  for (size_t i = 0; i < ids.size(); i++) {
+    PyList_SET_ITEM(result, i, PyLong_FromLong(ids[i]));
+  }
+  return result;
+}
+
+PyObject* encoder_decode(PyObject* self, PyObject* args) {
+  PyObject* ids;
+  if (!PyArg_ParseTuple(args, "O", &ids)) return nullptr;
+  auto* enc = reinterpret_cast<EncoderObject*>(self);
+  PyObject* seq = PySequence_Fast(ids, "ids must be a sequence");
+  if (!seq) return nullptr;
+  std::string out;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    long id = PyLong_AsLong(PySequence_Fast_GET_ITEM(seq, i));
+    if (id >= 0 && static_cast<size_t>(id) < enc->vocab->size()) {
+      out += (*enc->vocab)[id];
+    }
+  }
+  Py_DECREF(seq);
+  return PyBytes_FromStringAndSize(out.data(), out.size());
+}
+
+PyObject* encoder_vocab_size(PyObject* self, void*) {
+  auto* enc = reinterpret_cast<EncoderObject*>(self);
+  return PyLong_FromSize_t(enc->vocab->size());
+}
+
+PyMethodDef encoder_methods[] = {
+    {"encode", encoder_encode, METH_VARARGS, "encode(bytes) -> list[int]"},
+    {"decode", encoder_decode, METH_VARARGS, "decode(list[int]) -> bytes"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyGetSetDef encoder_getset[] = {
+    {"vocab_size", encoder_vocab_size, nullptr, "total vocabulary size",
+     nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+PyType_Slot encoder_slots[] = {
+    {Py_tp_init, reinterpret_cast<void*>(encoder_init)},
+    {Py_tp_dealloc, reinterpret_cast<void*>(encoder_dealloc)},
+    {Py_tp_methods, encoder_methods},
+    {Py_tp_getset, encoder_getset},
+    {Py_tp_new, reinterpret_cast<void*>(PyType_GenericNew)},
+    {0, nullptr},
+};
+
+PyType_Spec encoder_spec = {
+    "penroz_bpe.Encoder", sizeof(EncoderObject), 0,
+    Py_TPFLAGS_DEFAULT, encoder_slots,
+};
+
+PyMethodDef module_methods[] = {
+    {"train", bpe_train, METH_VARARGS,
+     "train(corpus: bytes, num_merges: int) -> list[(int, int)]"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module_def = {
+    PyModuleDef_HEAD_INIT, "penroz_bpe",
+    "Native byte-level BPE tokenizer core", -1, module_methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_penroz_bpe() {
+  PyObject* mod = PyModule_Create(&module_def);
+  if (!mod) return nullptr;
+  PyObject* encoder_type = PyType_FromSpec(&encoder_spec);
+  if (!encoder_type || PyModule_AddObject(mod, "Encoder", encoder_type) < 0) {
+    Py_XDECREF(encoder_type);
+    Py_DECREF(mod);
+    return nullptr;
+  }
+  return mod;
+}

@@ -1,0 +1,83 @@
+"""Native C++ BPE core tests: build, train/encode oracle equivalence,
+round-trips, model files, facade integration."""
+
+import pytest
+
+from penroz_tpu.data import bpe as bpe_mod
+from penroz_tpu.data.bpe import ByteBPE, _PyEncoder, _py_train, split_words
+
+CORPUS = ("the quick brown fox jumps over the lazy dog. " * 50 +
+          "she sells sea shells by the sea shore 987 " * 30)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return ByteBPE.train_from_text(CORPUS, vocab_size=320)
+
+
+def test_split_words_scheme():
+    assert split_words(b"hi there") == [b"hi", b" there"]
+    assert split_words(b"a1b") == [b"a", b"1", b"b"]
+    assert split_words(b"x  y") == [b"x", b" ", b" y"]
+    assert split_words(b"12 34") == [b"12", b" ", b"34"]
+    assert split_words(b"") == []
+
+
+def test_train_produces_merges(trained):
+    assert trained.vocab_size > 256
+    assert all(isinstance(m, tuple) and len(m) == 2 for m in trained.merges)
+
+
+def test_roundtrip(trained):
+    for text in ["the quick fox", "shells 987", "unseen wörds ok",
+                 "punct!? (mix) 42"]:
+        assert trained.decode(trained.encode(text)) == text
+
+
+def test_compression(trained):
+    text = "the quick brown fox jumps over the lazy dog"
+    assert len(trained.encode(text)) < len(text.encode())
+
+
+def test_native_matches_python_oracle(trained):
+    if not trained.native:
+        pytest.skip("native core unavailable")
+    merges_py = _py_train(CORPUS.encode(), len(trained.merges))
+    assert merges_py == trained.merges
+    oracle = _PyEncoder(trained.merges)
+    for text in [CORPUS[:200], "brand new input 123", "dog dog dog"]:
+        assert oracle.encode(text.encode()) == trained.encode(text)
+
+
+def test_save_load_roundtrip(trained, tmp_path):
+    path = tmp_path / "model.json"
+    trained.save(str(path))
+    loaded = ByteBPE.load(str(path))
+    assert loaded.merges == trained.merges
+    text = "the lazy shore"
+    assert loaded.encode(text) == trained.encode(text)
+
+
+def test_load_rejects_bad_format(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text('{"format": "other"}')
+    with pytest.raises(ValueError):
+        ByteBPE.load(str(path))
+
+
+def test_tokenizer_facade(trained, tmp_path):
+    from penroz_tpu.data.tokenizers import Tokenizer
+    path = tmp_path / "model.json"
+    trained.save(str(path))
+    tok = Tokenizer(f"bpe:{path}")
+    tokens = tok.tokenize("sea shells")
+    assert tokens[-1] == trained.eot_token
+    assert tok.decode(tokens) == "sea shells"
+
+
+def test_python_fallback_when_native_missing(monkeypatch):
+    monkeypatch.setattr(bpe_mod, "_native_module", None)
+    monkeypatch.setattr(bpe_mod, "_native_failed", True)
+    bpe = ByteBPE.train_from_text("aaa bbb aaa bbb aaa", vocab_size=260)
+    assert not bpe.native
+    assert bpe.decode(bpe.encode("aaa bbb")) == "aaa bbb"

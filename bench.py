@@ -91,17 +91,50 @@ def bench_ttft(arch, params, block=1024, prompt_len=128, trials=10):
     compute_dtype = jnp.bfloat16
     prompt = jnp.asarray(np.random.default_rng(0).integers(
         0, 50304, (1, prompt_len), dtype=np.int32))
+    temp = jnp.asarray(1.0, jnp.float32)
 
     times = []
-    for _ in range(trials + 2):
+    for i in range(trials + 2):
         kv = KV.create_kv_state(specs, 1, block, model.dtype)
+        rng = jax.random.key(i)
         t0 = time.perf_counter()
-        logits, kv = decode(model.params, model.buffers, kv, prompt,
-                            compute_dtype=compute_dtype)
-        tok = model._sample(logits, 1.0, None)
+        tok, kv = decode(model.params, model.buffers, kv, prompt, rng, temp,
+                         compute_dtype=compute_dtype, greedy=False,
+                         top_k=None)
         int(np.asarray(tok)[0, 0])  # host transfer forces execution
         times.append((time.perf_counter() - t0) * 1000)
     return statistics.median(times[2:])  # drop compile/warmup trials
+
+
+def bench_decode_throughput(arch, params, mapper, block=1024, tokens=96):
+    """Steady-state single-stream decode tokens/sec via the chunked path."""
+    from penroz_tpu.models.model import NeuralNetworkModel
+    model = NeuralNetworkModel.__new__(NeuralNetworkModel)
+    model.params = params
+    model.buffers = {}
+    model.arch = arch
+    model.device = None
+    model._sample_rng = jax.random.key(0)
+    prompt = [list(np.random.default_rng(0).integers(0, 50304, 128))]
+    # warm every power-of-two chunk program (1+16+8+4+2+1 = 32)
+    list(model.generate_tokens_stream(prompt, block, 32, temperature=1.0))
+    t0 = time.perf_counter()
+    model.generate_tokens(prompt, block, tokens, temperature=1.0)
+    return tokens / (time.perf_counter() - t0)
+
+
+def bench_dispatch_floor():
+    """p50 latency of a trivial jitted call — the harness/relay floor that
+    bounds TTFT and per-dispatch decode on remotely attached TPUs."""
+    trivial = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((4,))
+    np.asarray(trivial(x))
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(trivial(x))
+        times.append((time.perf_counter() - t0) * 1000)
+    return statistics.median(times)
 
 
 def main():
@@ -121,8 +154,10 @@ def main():
         int(np.prod(p.shape)) for k, p in params.items()
         if k.startswith("layers.0."))
 
-    # TTFT first — the training benchmark donates (and thus consumes) params.
+    # TTFT/decode first — the training benchmark donates (consumes) params.
+    dispatch_floor = bench_dispatch_floor()
     ttft_ms = bench_ttft(arch, params, block=block)
+    decode_tps = bench_decode_throughput(arch, params, mapper, block=block)
     tokens_per_sec, cost = bench_train(arch, mapper, params)
     mfu = (tokens_per_sec
            * _flops_per_token(n_matmul_params, depth, d_model, block)
@@ -135,6 +170,8 @@ def main():
         "vs_baseline": round(mfu / 0.35, 3),
         "mfu": round(mfu, 4),
         "ttft_ms_p50": round(ttft_ms, 2),
+        "decode_tokens_per_sec": round(decode_tps, 1),
+        "dispatch_floor_ms": round(dispatch_floor, 2),
         "train_cost_sample": round(cost, 3),
         "device": str(device.device_kind),
         "n_params": n_params,

@@ -1,0 +1,3 @@
+"""Data layer: tokenizers (incl. the native BPE core), dataset download/
+sharding, and rank-strided shard loading — the TPU-native equivalents of the
+reference's gpt_tokenizers.py and loaders.py."""

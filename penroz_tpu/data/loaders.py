@@ -1,0 +1,131 @@
+"""Dataset download/sharding and rank-strided shard loading.
+
+The TPU-native equivalent of the reference's ``loaders.py``:
+
+- ``Downloader`` — HF ``datasets`` → tokenize → fixed-size uint16 ``.npy``
+  shards named ``{dataset_id}_{idx:06d}`` (reference: loaders.py:16-41).
+  Tokenization fans out over a thread pool (tiktoken/HF tokenizers release
+  the GIL in native code; the reference forks a process pool instead,
+  loaders.py:29-32, which would fight the JAX runtime here).
+- ``Loader`` — stateful ``next_batch`` over the sorted shard sequence with
+  shard wraparound/concatenation and rank-strided indexing via
+  ``begin_idx``/``idx_offset`` (reference: loaders.py:45-87); targets are the
+  input shifted by ``target_offset`` (0 → no targets, for separate target
+  datasets in /evaluate/).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from penroz_tpu.data.tokenizers import Tokenizer
+
+DATA_FOLDER = "data"
+
+
+class Loader:
+    def __init__(self, dataset_id: str, begin_shard: int = 0,
+                 begin_idx: int = 0, buffer_size: int = 1024,
+                 idx_offset: int | None = None):
+        self.dataset_id = dataset_id
+        self.shard = begin_shard
+        self.idx = begin_idx
+        self.buffer_size = int(buffer_size)
+        self.idx_offset = int(idx_offset if idx_offset is not None
+                              else buffer_size)
+        self._cache: dict[int, np.ndarray] = {}
+
+    def _files(self) -> list[str]:
+        pattern = os.path.join(DATA_FOLDER, f"{self.dataset_id}_*.npy")
+        return sorted(os.path.basename(p) for p in glob.glob(pattern))
+
+    def list(self) -> list[str]:
+        return self._files()
+
+    def delete(self):
+        for name in self._files():
+            os.remove(os.path.join(DATA_FOLDER, name))
+        self._cache.clear()
+
+    def _shard_data(self, files: list[str], shard_idx: int) -> np.ndarray:
+        shard_idx %= len(files)
+        data = self._cache.get(shard_idx)
+        if data is None:
+            # keep at most two shards resident (current + wraparound peek)
+            if len(self._cache) > 1:
+                self._cache.clear()
+            data = np.load(os.path.join(DATA_FOLDER, files[shard_idx]))
+            self._cache[shard_idx] = data
+        return data
+
+    def next_batch(self, target_offset: int = 1):
+        """(input, target) flat int32 arrays of ``buffer_size`` tokens;
+        target is input shifted by ``target_offset`` (None when 0)."""
+        files = self._files()
+        if not files:
+            raise ValueError(f"Dataset {self.dataset_id} has no shards")
+        need = self.buffer_size + target_offset
+        self.shard %= len(files)
+        data = self._shard_data(files, self.shard)
+        while self.idx >= len(data):
+            self.idx -= len(data)
+            self.shard = (self.shard + 1) % len(files)
+            data = self._shard_data(files, self.shard)
+        buf = data[self.idx:self.idx + need]
+        peek = self.shard
+        while len(buf) < need:
+            peek = (peek + 1) % len(files)
+            extra = self._shard_data(files, peek)
+            buf = np.concatenate([buf, extra[:need - len(buf)]])
+        x = buf[:self.buffer_size].astype(np.int32)
+        y = (buf[target_offset:target_offset + self.buffer_size]
+             .astype(np.int32) if target_offset else None)
+        self.idx += self.idx_offset
+        return x, y
+
+
+class Downloader:
+    def __init__(self, dataset_id: str, shard_size: int = 2 ** 24,
+                 encoding: str = "tiktoken/gpt2"):
+        self.dataset_id = dataset_id
+        self.shard_size = int(shard_size)
+        self.tokenizer = Tokenizer(encoding)
+
+    def download(self, path: str, name: str | None = None,
+                 split: str = "train"):
+        """Download + tokenize + write fixed-size uint16 shards (the final
+        partial shard is also flushed)."""
+        import datasets
+        ds = datasets.load_dataset(path, name, split=split)
+        os.makedirs(DATA_FOLDER, exist_ok=True)
+        buffer = np.empty(self.shard_size, np.uint16)
+        fill = 0
+        shard_idx = 0
+
+        def flush(upto: int):
+            nonlocal shard_idx
+            np.save(os.path.join(
+                DATA_FOLDER, f"{self.dataset_id}_{shard_idx:06d}"),
+                buffer[:upto])
+            shard_idx += 1
+
+        workers = max(1, (os.cpu_count() or 2) // 2)
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            for tokens in pool.map(self.tokenizer.tokenize, ds["text"],
+                                   chunksize=16):
+                arr = np.asarray(tokens, np.uint16)
+                pos = 0
+                while pos < len(arr):
+                    take = min(len(arr) - pos, self.shard_size - fill)
+                    buffer[fill:fill + take] = arr[pos:pos + take]
+                    fill += take
+                    pos += take
+                    if fill == self.shard_size:
+                        flush(fill)
+                        fill = 0
+        if fill:
+            flush(fill)

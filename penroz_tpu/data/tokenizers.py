@@ -1,0 +1,51 @@
+"""Tokenizer facade over four backends (reference: gpt_tokenizers.py:8-22):
+
+- ``byte`` — raw UTF-8 bytes + an EOT id (offline, dependency-free);
+- ``tiktoken/<name>`` — tiktoken encodings (``encode_ordinary`` + eot);
+- ``bpe:<path>`` — our native byte-BPE model files (data/bpe.py);
+- anything else — a HuggingFace ``AutoTokenizer`` name
+  (``add_special_tokens=False`` + eos).
+
+All backends are imported lazily so offline paths never touch hub code.
+"""
+
+from __future__ import annotations
+
+BYTE_EOT = 256
+
+
+class Tokenizer:
+    def __init__(self, encoding: str):
+        self.encoding = encoding
+        if encoding == "byte":
+            self._kind = "byte"
+        elif encoding.startswith("tiktoken/"):
+            import tiktoken
+            self._enc = tiktoken.get_encoding(encoding.split("/", 1)[1])
+            self._kind = "tiktoken"
+        elif encoding.startswith("bpe:"):
+            from penroz_tpu.data.bpe import ByteBPE
+            self._enc = ByteBPE.load(encoding.split(":", 1)[1])
+            self._kind = "bpe"
+        else:
+            from transformers import AutoTokenizer
+            self._enc = AutoTokenizer.from_pretrained(encoding)
+            self._kind = "hf"
+
+    def tokenize(self, text: str) -> list[int]:
+        if self._kind == "byte":
+            return list(text.encode()) + [BYTE_EOT]
+        if self._kind == "tiktoken":
+            return list(self._enc.encode_ordinary(text)) + [self._enc.eot_token]
+        if self._kind == "bpe":
+            return self._enc.encode(text) + [self._enc.eot_token]
+        tokens = list(self._enc.encode(text, add_special_tokens=False))
+        if self._enc.eos_token_id is not None:
+            tokens.append(self._enc.eos_token_id)
+        return tokens
+
+    def decode(self, tokens) -> str:
+        if self._kind == "byte":
+            return bytes(t for t in tokens if 0 <= t < 256).decode(
+                "utf-8", errors="replace")
+        return self._enc.decode(tokens)

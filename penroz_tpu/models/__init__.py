@@ -1,0 +1,3 @@
+"""Model layer: JSON layer/optimizer DSL (dsl.py) and the model runtime
+(model.py) — the TPU-native equivalents of the reference's mappers.py and
+neural_net_model.py."""

@@ -1,0 +1,512 @@
+"""JSON layer/optimizer DSL → functional module trees and optax optimizers.
+
+The TPU-native equivalent of the reference's ``mappers.py``:
+
+- a registry of layer algos (reference: mappers.py:19-41) building the
+  functional modules in ``penroz_tpu.ops.modules``;
+- weight-init overrides (``normal``/``xavier_uniform``/``kaiming_uniform``/
+  ``zeros``) plus ``confidence`` weight scaling (reference: mappers.py:43-51,
+  63-99);
+- an optimizer registry over optax (reference: mappers.py:53-57, 264-274);
+- HuggingFace config → DSL builders for GPT-2 and the Gemma family
+  (reference: mappers.py:121-262) and HF state-dict → flat-param-dict key
+  remapping (reference: mappers.py:304-448).
+
+Parameter key names mirror the reference's torch ``state_dict`` naming
+(``layers.{i}...``) so checkpoints and HF imports stay pure table lookups.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from penroz_tpu.ops import modules as M
+
+# Init-override keys that may sit alongside the layer algo in a DSL entry
+# (reference: mappers.py:43-51; ``confidence`` scaling: mappers.py:88-93).
+INIT_KEYS = ("normal", "xavier_uniform", "kaiming_uniform", "zeros")
+
+_CONTAINER_ALGOS = {
+    "sequential": M.Sequential,
+    "summation": M.Summation,
+    "residual": M.ResidualConnection,
+}
+
+_LEAF_ALGOS = {
+    "linear": M.Linear,
+    "embedding": M.Embedding,
+    "position": M.PositionEmbedding,
+    "scaledembedding": M.ScaledEmbedding,
+    "flatten": M.Flatten,
+    "batchnorm1d": M.BatchNorm1d,
+    "layernorm": M.LayerNorm,
+    "rmsnorm": M.RMSNorm,
+    "relu": M.ReLU,
+    "gelu": M.GELU,
+    "silu": M.SiLU,
+    "sigmoid": M.Sigmoid,
+    "tanh": M.Tanh,
+    "softmax": M.Softmax,
+    "softmaxlast": M.SoftmaxOnLast,
+    "dropout": M.Dropout,
+    "attention": M.CausalSelfAttention,
+    "gatedmlp": M.GatedMLP,
+}
+
+_OPTIMIZERS = ("adamw", "adam", "sgd")
+
+
+def layer_algo(entry: dict) -> str:
+    """The single layer-algo key of a DSL entry (init keys are siblings)."""
+    algos = [k for k in entry if k not in INIT_KEYS and k != "confidence"]
+    if len(algos) != 1:
+        raise ValueError(f"Layer entry must have exactly one algo key, got "
+                         f"{sorted(entry)}")
+    return algos[0]
+
+
+def to_layer(entry: dict) -> M.Module:
+    """Recursively build one module from a DSL entry (reference:
+    mappers.py:63-99)."""
+    algo = layer_algo(entry)
+    args = entry[algo]
+    if algo in _CONTAINER_ALGOS:
+        mod = _CONTAINER_ALGOS[algo](*[to_layer(e) for e in args])
+    elif algo == "transformerblock":
+        kwargs: dict[str, Any] = {
+            "attn_block": to_layer(args["attn_block"]),
+            "mlp_block": to_layer(args["mlp_block"]),
+            "post_norm_on_residual": bool(args.get("post_norm_on_residual",
+                                                   True)),
+        }
+        for name in ("post_attn_norm", "post_mlp_norm"):
+            if name in args:
+                kwargs[name] = to_layer(args[name])
+        mod = M.TransformerBlock(**kwargs)
+    elif algo in _LEAF_ALGOS:
+        mod = _LEAF_ALGOS[algo](**args)
+    else:
+        raise ValueError(f"Unsupported layer: {algo}")
+    mod._algo = algo
+    mod._init_spec = {k: entry[k] for k in entry
+                      if k in INIT_KEYS or k == "confidence"}
+    return mod
+
+
+def build_modules(layers: list[dict]) -> list[M.Module]:
+    """Build + bind the top-level module list (param prefix ``layers.{i}``)."""
+    mods = [to_layer(entry) for entry in layers]
+    for i, mod in enumerate(mods):
+        mod.bind(f"layers.{i}")
+    return mods
+
+
+def _fans(shape: tuple) -> tuple[int, int]:
+    """(fan_in, fan_out) for a weight stored as (out, in) — torch layout."""
+    if len(shape) >= 2:
+        return int(shape[-1]), int(shape[0])
+    return int(shape[0]), int(shape[0])
+
+
+def _override_init(mod: M.Module, params: dict, spec: dict, rng) -> dict:
+    """Apply an init-override spec to a module's own params (reference:
+    mappers.py:63-99: per-layer init + confidence weight scaling)."""
+    shapes = mod.param_shapes()
+    wkey = mod.key("weight")
+    if "weight" in shapes and wkey in params:
+        shape = shapes["weight"]
+        fan_in, fan_out = _fans(shape)
+        w = params[wkey]
+        if "normal" in spec:
+            mean = float(spec["normal"].get("mean", 0.0))
+            std = float(spec["normal"].get("std", 1.0))
+            w = jax.random.normal(jax.random.fold_in(rng, 101), shape,
+                                  jnp.float32) * std + mean
+        elif "xavier_uniform" in spec:
+            bound = math.sqrt(6.0 / (fan_in + fan_out))
+            w = jax.random.uniform(jax.random.fold_in(rng, 102), shape,
+                                   jnp.float32, -bound, bound)
+        elif "kaiming_uniform" in spec:
+            a = float(spec["kaiming_uniform"].get("a", math.sqrt(5.0)))
+            nonlinearity = spec["kaiming_uniform"].get("nonlinearity",
+                                                       "leaky_relu")
+            if nonlinearity == "relu":
+                gain = math.sqrt(2.0)
+            elif nonlinearity == "leaky_relu":
+                gain = math.sqrt(2.0 / (1.0 + a * a))
+            else:
+                gain = 1.0
+            bound = gain * math.sqrt(3.0 / fan_in)
+            w = jax.random.uniform(jax.random.fold_in(rng, 103), shape,
+                                   jnp.float32, -bound, bound)
+        if "confidence" in spec:
+            w = w * float(spec["confidence"])
+        params[wkey] = w
+    bkey = mod.key("bias")
+    if "zeros" in spec and bkey in params:
+        params[bkey] = jnp.zeros(shapes["bias"], jnp.float32)
+    return params
+
+
+def init_module_params(mods: list[M.Module], seed: int = 0):
+    """Deterministically initialize the flat param/buffer dicts for a bound
+    module list, honoring per-layer init-override specs."""
+    base = jax.random.key(seed)
+    params: dict[str, jax.Array] = {}
+    buffers: dict[str, jax.Array] = {}
+    idx = 0
+    for top in mods:
+        for sub in top.walk():
+            idx += 1
+            rng = jax.random.fold_in(base, idx)
+            own = sub.init(rng)
+            spec = getattr(sub, "_init_spec", None)
+            if spec:
+                own = _override_init(sub, own, spec, rng)
+            params.update(own)
+            buffers.update(sub.init_buffers())
+    return params, buffers
+
+
+def build_optimizer(config: dict) -> optax.GradientTransformation:
+    """Optimizer DSL → optax transform (reference: mappers.py:53-57,264-274).
+
+    ``betas`` lists are coerced to the (b1, b2) pair; ``weight_decay`` follows
+    torch semantics (decoupled for adamw, L2-into-grad for adam/sgd).
+    """
+    if len(config) != 1:
+        raise ValueError(f"Optimizer config must have exactly one key, got "
+                         f"{sorted(config)}")
+    (name, args), = config.items()
+    if name not in _OPTIMIZERS:
+        raise ValueError(f"Unsupported optimizer: {name}")
+    args = dict(args)
+    lr = float(args.pop("lr", 1e-3))
+    if name in ("adamw", "adam"):
+        betas = args.pop("betas", (0.9, 0.999))
+        b1, b2 = float(betas[0]), float(betas[1])
+        eps = float(args.pop("eps", 1e-8))
+        if name == "adamw":
+            weight_decay = float(args.pop("weight_decay", 0.01))
+            return optax.adamw(lr, b1=b1, b2=b2, eps=eps,
+                               weight_decay=weight_decay)
+        weight_decay = float(args.pop("weight_decay", 0.0))
+        opt = optax.adam(lr, b1=b1, b2=b2, eps=eps)
+        if weight_decay:
+            return optax.chain(optax.add_decayed_weights(weight_decay), opt)
+        return opt
+    momentum = float(args.pop("momentum", 0.0)) or None
+    nesterov = bool(args.pop("nesterov", False))
+    weight_decay = float(args.pop("weight_decay", 0.0))
+    opt = optax.sgd(lr, momentum=momentum, nesterov=nesterov)
+    if weight_decay:
+        return optax.chain(optax.add_decayed_weights(weight_decay), opt)
+    return opt
+
+
+class Mapper:
+    """Layer + optimizer DSL front-end (reference: mappers.py `Mapper`)."""
+
+    def __init__(self, layers: list[dict], optimizer: dict):
+        self.layers = layers
+        self.optimizer = optimizer
+
+    def to_modules(self) -> list[M.Module]:
+        return build_modules(self.layers)
+
+    def init_params(self, mods: list[M.Module], seed: int = 0):
+        return init_module_params(mods, seed=seed)
+
+    def to_optimizer(self) -> optax.GradientTransformation:
+        return build_optimizer(self.optimizer)
+
+    # -- HuggingFace config → DSL ------------------------------------------
+
+    @staticmethod
+    def from_hf_config(config, n_layer_override: Optional[int] = None
+                       ) -> list[dict]:
+        """Build the layer DSL for a HuggingFace model config (reference:
+        mappers.py:121-262 for GPT-2 and Gemma 1/2/3/4)."""
+        model_type = getattr(config, "model_type", "") or ""
+        if model_type == "gpt2":
+            return _gpt2_dsl_from_config(config, n_layer_override)
+        if model_type.startswith("gemma"):
+            return _gemma_dsl_from_config(config, n_layer_override)
+        raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
+
+    # -- HF state-dict detection + remapping --------------------------------
+
+    @staticmethod
+    def detect_hf_n_layer(state_dict: dict) -> int:
+        """Sniff the transformer layer count from state-dict key names
+        (reference: mappers.py:276-302)."""
+        import re
+        pattern = re.compile(
+            r"(?:transformer\.h|model\.(?:language_model\.)?layers)\.(\d+)\.")
+        n = 0
+        for key in state_dict:
+            m = pattern.match(key)
+            if m:
+                n = max(n, int(m.group(1)) + 1)
+        return n
+
+    @staticmethod
+    def map_hf_state_dict_to_custom(state_dict: dict, n_layer: int,
+                                    config=None) -> dict:
+        """Remap an HF state dict (numpy arrays) onto our flat param keys
+        (reference: mappers.py:304-448)."""
+        if "transformer.wte.weight" in state_dict:
+            return _map_gpt2_state_dict(state_dict, n_layer)
+        return _map_gemma_state_dict(state_dict, n_layer, config)
+
+
+# ---------------------------------------------------------------------------
+# GPT-2
+# ---------------------------------------------------------------------------
+
+def _gpt2_gelu_entry(activation: str) -> dict:
+    if activation in ("gelu_new", "gelu_pytorch_tanh"):
+        return {"gelu": {"approximate": "tanh"}}
+    return {"gelu": {}}
+
+
+def _gpt2_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """GPT-2 HF config → layer DSL (reference: mappers.py:121-176)."""
+    d = int(config.n_embd)
+    n = int(n_layer_override if n_layer_override else config.n_layer)
+    heads = int(config.n_head)
+    vocab = int(config.vocab_size)
+    block = int(config.n_positions)
+    attn_drop = float(getattr(config, "attn_pdrop", 0.0) or 0.0)
+    resid_drop = float(getattr(config, "resid_pdrop", 0.0) or 0.0)
+    embd_drop = float(getattr(config, "embd_pdrop", 0.0) or 0.0)
+    gelu = _gpt2_gelu_entry(getattr(config, "activation_function", "gelu_new"))
+    proj_std = 0.02 / math.sqrt(2 * n)
+
+    layers: list[dict] = [
+        {"summation": [
+            {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+             "normal": {"mean": 0.0, "std": 0.02}},
+            {"position": {"num_embeddings": block, "embedding_dim": d},
+             "normal": {"mean": 0.0, "std": 0.02}}]},
+        {"dropout": {"p": embd_drop}},
+    ]
+    for _ in range(n):
+        layers.append({"residual": [
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d, "out_features": 3 * d},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"attention": {"num_heads": heads, "dropout": attn_drop}},
+                {"linear": {"in_features": d, "out_features": d},
+                 "normal": {"mean": 0.0, "std": proj_std}, "zeros": {}},
+                {"dropout": {"p": resid_drop}}]},
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d, "out_features": 4 * d},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                gelu,
+                {"linear": {"in_features": 4 * d, "out_features": d},
+                 "normal": {"mean": 0.0, "std": proj_std}, "zeros": {}},
+                {"dropout": {"p": resid_drop}}]}]})
+    layers += [
+        {"layernorm": {"normalized_shape": d}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False},
+         "normal": {"mean": 0.0, "std": 0.02}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_gpt2_state_dict(sd: dict, n_layer: int) -> dict:
+    """GPT-2 HF keys → ours; Conv1D weights transposed, lm_head tied to wte
+    (reference: mappers.py:333-352)."""
+    out = {
+        "layers.0.0.weight": sd["transformer.wte.weight"],
+        "layers.0.1.weight": sd["transformer.wpe.weight"],
+    }
+    ln_map = {"ln_1": "0.0", "ln_2": "1.0"}
+    conv1d_map = {"attn.c_attn": "0.1", "attn.c_proj": "0.3",
+                  "mlp.c_fc": "1.1", "mlp.c_proj": "1.3"}
+    for i in range(n_layer):
+        src = f"transformer.h.{i}"
+        dst = f"layers.{2 + i}"
+        for hf_name, ours in ln_map.items():
+            out[f"{dst}.{ours}.weight"] = sd[f"{src}.{hf_name}.weight"]
+            out[f"{dst}.{ours}.bias"] = sd[f"{src}.{hf_name}.bias"]
+        for hf_name, ours in conv1d_map.items():
+            # HF Conv1D stores (in, out); our Linear stores (out, in).
+            out[f"{dst}.{ours}.weight"] = \
+                np.ascontiguousarray(sd[f"{src}.{hf_name}.weight"].T)
+            out[f"{dst}.{ours}.bias"] = sd[f"{src}.{hf_name}.bias"]
+    out[f"layers.{2 + n_layer}.weight"] = sd["transformer.ln_f.weight"]
+    out[f"layers.{2 + n_layer}.bias"] = sd["transformer.ln_f.bias"]
+    out[f"layers.{3 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd["transformer.wte.weight"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Gemma family
+# ---------------------------------------------------------------------------
+
+def _gemma_text_config(config):
+    return getattr(config, "text_config", None) or config
+
+
+def _gemma_rope_theta(cfg, layer_type: str) -> float:
+    """Per-layer RoPE theta: prefer a matching ``rope_scaling`` entry, fall
+    back to any entry, then to ``rope_theta`` (reference: mappers.py:198-222)."""
+    scaling = getattr(cfg, "rope_scaling", None)
+    if isinstance(scaling, dict) and scaling:
+        entry = scaling.get(layer_type)
+        if not isinstance(entry, dict):
+            entry = next(iter(scaling.values()))
+        if isinstance(entry, dict) and "rope_theta" in entry:
+            return float(entry["rope_theta"])
+    theta = getattr(cfg, "rope_theta", None)
+    return float(theta) if theta else 10000.0
+
+
+def _gemma_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """Gemma 1/2/3/4 HF config → layer DSL, incl. GQA dims, per-layer
+    heterogeneous ``layer_types`` and double-wide MLPs on KV-shared layers
+    (reference: mappers.py:178-262)."""
+    model_type = getattr(config, "model_type", "gemma")
+    cfg = _gemma_text_config(config)
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "rms_norm_eps", 1e-6))
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    activation = getattr(cfg, "hidden_activation", "gelu_pytorch_tanh")
+    layer_types = list(getattr(cfg, "layer_types", None)
+                       or ["full_attention"] * n)
+    num_kv_shared = int(getattr(cfg, "num_kv_shared_layers", 0) or 0)
+    double_wide = bool(getattr(cfg, "use_double_wide_mlp", False))
+    # gemma (v1): no post-attn/post-mlp norms; gemma2: norms applied to the
+    # branch output; gemma3+: norms applied to the residual sum
+    # (reference: neural_net_layers.py:188-225 block variants).
+    has_post_norms = model_type != "gemma"
+    post_norm_on_residual = model_type not in ("gemma", "gemma2")
+
+    def head_dim_for(layer_type: str) -> int:
+        if layer_type == "full_attention" and \
+                getattr(cfg, "global_head_dim", None):
+            return int(cfg.global_head_dim)
+        return int(cfg.head_dim)
+
+    def kv_heads_for(layer_type: str) -> int:
+        if layer_type == "full_attention" and \
+                getattr(cfg, "num_global_key_value_heads", None):
+            return int(cfg.num_global_key_value_heads)
+        return int(cfg.num_key_value_heads)
+
+    layers: list[dict] = [
+        {"scaledembedding": {"num_embeddings": vocab, "embedding_dim": d,
+                             "scale": d ** 0.5},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for i in range(n):
+        layer_type = layer_types[i] if i < len(layer_types) else "full_attention"
+        hd = head_dim_for(layer_type)
+        kv = kv_heads_for(layer_type)
+        inter = int(cfg.intermediate_size)
+        if double_wide and num_kv_shared and i >= n - num_kv_shared:
+            inter *= 2
+        block: dict[str, Any] = {
+            "attn_block": {"sequential": [
+                {"rmsnorm": {"normalized_shape": d, "eps": eps}},
+                {"linear": {"in_features": d,
+                            "out_features": (heads + 2 * kv) * hd,
+                            "bias": False}},
+                {"attention": {"num_heads": heads, "num_kv_heads": kv,
+                               "rope_theta": _gemma_rope_theta(cfg, layer_type),
+                               "head_dim": hd, "dropout": attn_drop}},
+                {"linear": {"in_features": heads * hd, "out_features": d,
+                            "bias": False}}]},
+            "mlp_block": {"sequential": [
+                {"rmsnorm": {"normalized_shape": d, "eps": eps}},
+                {"gatedmlp": {"in_features": d, "intermediate_size": inter,
+                              "activation": activation}}]},
+            "post_norm_on_residual": post_norm_on_residual,
+        }
+        if has_post_norms:
+            block["post_attn_norm"] = {"rmsnorm": {"normalized_shape": d,
+                                                   "eps": eps}}
+            block["post_mlp_norm"] = {"rmsnorm": {"normalized_shape": d,
+                                                  "eps": eps}}
+        layers.append({"transformerblock": block})
+    layers += [
+        {"rmsnorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _plus_one(arr):
+    """RMSNorm weight offset: HF Gemma stores ``w`` and applies ``x*(1+w)``;
+    our RMSNorm multiplies directly (reference: mappers.py:401,424-442)."""
+    a = np.asarray(arr)
+    return (a.astype(np.float32) + 1.0).astype(a.dtype)
+
+
+def _map_gemma_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """Gemma HF keys → ours: QKV concat, +1 RMSNorm offset, KV-shared-layer
+    copy from the reference layer, multimodal prefix (reference:
+    mappers.py:356-448)."""
+    prefix = "model"
+    if any(k.startswith("model.language_model.") for k in sd):
+        prefix = "model.language_model"
+    model_type = getattr(config, "model_type", "gemma2") if config else "gemma2"
+    cfg = _gemma_text_config(config) if config is not None else None
+    has_post_norms = model_type != "gemma"
+    num_kv_shared = int(getattr(cfg, "num_kv_shared_layers", 0) or 0) if cfg else 0
+    layer_types = list(getattr(cfg, "layer_types", None) or []) if cfg else []
+
+    out = {"layers.0.weight": sd[f"{prefix}.embed_tokens.weight"]}
+    for i in range(n_layer):
+        src = f"{prefix}.layers.{i}"
+        dst = f"layers.{1 + i}"
+        # KV-shared layers read K/V from the last same-type non-shared layer.
+        kv_src_idx = i
+        if num_kv_shared and i >= n_layer - num_kv_shared and layer_types:
+            own_type = layer_types[i] if i < len(layer_types) else None
+            for j in range(n_layer - num_kv_shared - 1, -1, -1):
+                if j < len(layer_types) and layer_types[j] == own_type:
+                    kv_src_idx = j
+                    break
+        kv_src = f"{prefix}.layers.{kv_src_idx}"
+        out[f"{dst}.attn_block.1.weight"] = np.concatenate(
+            [np.asarray(sd[f"{src}.self_attn.q_proj.weight"]),
+             np.asarray(sd[f"{kv_src}.self_attn.k_proj.weight"]),
+             np.asarray(sd[f"{kv_src}.self_attn.v_proj.weight"])], axis=0)
+        out[f"{dst}.attn_block.0.weight"] = \
+            _plus_one(sd[f"{src}.input_layernorm.weight"])
+        out[f"{dst}.attn_block.3.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
+        if has_post_norms:
+            out[f"{dst}.post_attn_norm.weight"] = \
+                _plus_one(sd[f"{src}.post_attention_layernorm.weight"])
+            out[f"{dst}.mlp_block.0.weight"] = \
+                _plus_one(sd[f"{src}.pre_feedforward_layernorm.weight"])
+            out[f"{dst}.post_mlp_norm.weight"] = \
+                _plus_one(sd[f"{src}.post_feedforward_layernorm.weight"])
+        else:
+            # gemma1: the post-attention norm IS the pre-MLP norm.
+            out[f"{dst}.mlp_block.0.weight"] = \
+                _plus_one(sd[f"{src}.post_attention_layernorm.weight"])
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            out[f"{dst}.mlp_block.1.{proj}.weight"] = \
+                sd[f"{src}.mlp.{proj}.weight"]
+    out[f"layers.{1 + n_layer}.weight"] = _plus_one(sd[f"{prefix}.norm.weight"])
+    out[f"layers.{2 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd[f"{prefix}.embed_tokens.weight"])
+    return out

@@ -63,8 +63,22 @@ def save(model_id: str, data: dict, sync_flush: bool = False):
         # Background flush: a thread, not a fork — os.fork() deadlocks under
         # JAX's thread pool, and the copy is pure file I/O anyway.
         log.info("Offload flushing model cache %s to %s...", shm_path, durable_path)
-        threading.Thread(target=shutil.copyfile,
-                         args=(shm_path, durable_path), daemon=True).start()
+        threading.Thread(target=_flush, args=(shm_path, durable_path),
+                         daemon=True).start()
+
+
+def _flush(shm_path: str, durable_path: str):
+    try:
+        tmp_path = durable_path + ".tmp"
+        shutil.copyfile(shm_path, tmp_path)
+        os.replace(tmp_path, durable_path)
+        if not os.path.exists(shm_path):
+            # delete() ran mid-flush: don't resurrect the durable copy
+            os.remove(durable_path)
+            log.warning("Flush rolled back, model deleted: %s", durable_path)
+    except FileNotFoundError:
+        # The model was deleted between the save and the flush; nothing to do.
+        log.warning("Flush skipped, source vanished: %s", shm_path)
 
 
 def load(model_id: str) -> dict:

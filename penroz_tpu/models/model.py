@@ -37,6 +37,8 @@ from penroz_tpu.models.dsl import Mapper
 from penroz_tpu.ops import kv_cache as KV
 from penroz_tpu.ops import modules as M
 from penroz_tpu.parallel import dist
+from penroz_tpu.parallel import mesh as mesh_lib
+from penroz_tpu.parallel import sharding as sharding_lib
 from penroz_tpu.utils import checkpoint, stats as stats_lib
 
 log = logging.getLogger(__name__)
@@ -506,8 +508,19 @@ class NeuralNetworkModel:
                            "message": f"Training on {dataset_id}"}
             if master:
                 self.serialize()
+            mesh = self._training_mesh(step_size, block_size)
+            sp_mesh = None
+            if mesh is not None:
+                log.info("Training over device mesh %s", dict(mesh.shape))
+                self.params = sharding_lib.shard_params(self.params, mesh)
+                self.opt_state = jax.device_put(self.opt_state,
+                                                mesh_lib.replicated(mesh))
+                self.buffers = jax.device_put(self.buffers,
+                                              mesh_lib.replicated(mesh))
+                if mesh.shape[mesh_lib.SEQ_AXIS] > 1:
+                    sp_mesh = mesh
             epoch_fn = self.arch.train_epoch_fn(self.optimizer_config,
-                                                num_steps)
+                                                num_steps, sp_mesh=sp_mesh)
             rng = jax.random.key(0)
             base_epoch = self.progress[-1]["epoch"] if self.progress else 0
             last_save = time.monotonic()
@@ -522,6 +535,13 @@ class NeuralNetworkModel:
                     ys.append(y.reshape(step_size, block_size))
                 xs = jnp.asarray(np.stack(xs))
                 ys = jnp.asarray(np.stack(ys))
+                if mesh is not None:
+                    xs = sharding_lib.shard_batch(
+                        xs, mesh, leading_steps=True,
+                        shard_sequence=sp_mesh is not None)
+                    ys = sharding_lib.shard_batch(
+                        ys, mesh, leading_steps=True,
+                        shard_sequence=sp_mesh is not None)
                 last_batch = (xs[0], ys[0])
                 self.params, self.opt_state, self.buffers, cost, ratios = \
                     epoch_fn(self.params, self.opt_state, self.buffers, xs, ys,
@@ -568,6 +588,50 @@ class NeuralNetworkModel:
                 except Exception:  # noqa: BLE001
                     log.exception("Failed to persist error status")
             raise
+
+    def _training_mesh(self, step_size: int, block_size: int):
+        """Device mesh for the training run (None = single device).
+
+        Data-parallelism over every local device is automatic when the
+        micro-batch divides the data axis; ``PENROZ_MESH_MODEL`` /
+        ``PENROZ_MESH_SEQUENCE`` carve tensor/sequence-parallel axes out of
+        the same device set, and ``PENROZ_TRAIN_MESH=0`` disables meshing.
+        This replaces the reference's per-request DDP process tree
+        (ddp.py:38-73) — the mesh lives inside one compiled program.
+        """
+        if os.environ.get("PENROZ_TRAIN_MESH", "1") == "0":
+            return None
+        if dist.process_count() > 1:
+            # Multi-host training shards a *global* batch over a global mesh
+            # (make_array_from_process_local_data) — not wired up yet; a
+            # process-local mesh here would skip cross-host gradient sync.
+            log.warning("Mesh training disabled under multi-process runtime")
+            return None
+        try:
+            platform = self.device.platform if self.device is not None else None
+            devices = (jax.local_devices(backend=platform) if platform
+                       else jax.local_devices())
+        except RuntimeError:
+            return None
+        try:
+            model = int(os.environ.get("PENROZ_MESH_MODEL", "1"))
+            seq = int(os.environ.get("PENROZ_MESH_SEQUENCE", "1"))
+        except ValueError:
+            log.warning("Invalid PENROZ_MESH_MODEL/PENROZ_MESH_SEQUENCE; "
+                        "falling back to single device")
+            return None
+        if model < 1 or seq < 1:
+            return None
+        n = len(devices)
+        if n <= 1 or n % (model * seq):
+            return None
+        data = n // (model * seq)
+        if step_size % data or (seq > 1 and block_size % seq):
+            log.info("Mesh fallback to single device: micro-batch %d / "
+                     "sequence %d not divisible by data=%d / sequence=%d",
+                     step_size, block_size, data, seq)
+            return None
+        return mesh_lib.make_mesh(devices, model=model, sequence=seq)
 
     @classmethod
     def train_model_on_device(cls, model_id, device, dataset_id, shard,

@@ -48,19 +48,14 @@ def make_mesh(devices=None, *, data: Optional[int] = None, model: int = 1,
 
 
 def batch_sharding(mesh: Mesh, batch_ndim: int = 2) -> NamedSharding:
-    """Shard the leading batch dim over ``data`` (and optionally the sequence
-    dim over ``sequence``)."""
+    """Shard the leading batch dim over ``data``.  For sequence sharding use
+    ``parallel.sharding.shard_batch`` (spec-based, handles both axes)."""
     spec = [DATA_AXIS] + [None] * (batch_ndim - 1)
     return NamedSharding(mesh, P(*spec))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
-
-
-def shard_batch(mesh: Mesh, array):
-    """Place a host batch onto the mesh, sharded along ``data``."""
-    return jax.device_put(array, batch_sharding(mesh, np.ndim(array)))
 
 
 def local_data_size(mesh: Mesh) -> int:

@@ -48,14 +48,18 @@ def shm_model_path(model_id: str) -> str:
 
 
 def save(model_id: str, data: dict, sync_flush: bool = False):
-    """Write checkpoint to shm and flush to disk in the background."""
+    """Write checkpoint to shm and flush to disk in the background.
+
+    Both writes are atomic (temp file + rename) so concurrent readers —
+    cross-process ``load()`` on shm, the background flush on durable — never
+    observe a half-written pickle.
+    """
     os.makedirs(MODELS_FOLDER, exist_ok=True)
     os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
     shm_path = shm_model_path(model_id)
     durable_path = model_path(model_id)
     log.info("Caching model to %s...", shm_path)
-    with open(shm_path, "wb") as f:
-        pickle.dump(data, f, protocol=5)
+    _atomic_pickle(shm_path, data)
     log.info("Model cached successfully: %s", shm_path)
     if sync_flush:
         shutil.copyfile(shm_path, durable_path)
@@ -67,9 +71,27 @@ def save(model_id: str, data: dict, sync_flush: bool = False):
                          daemon=True).start()
 
 
+def _atomic_pickle(path: str, data: dict):
+    fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(path),
+                                    prefix=os.path.basename(path) + ".")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(data, f, protocol=5)
+        os.replace(tmp_path, path)
+    except BaseException:
+        if os.path.exists(tmp_path):
+            os.remove(tmp_path)
+        raise
+
+
 def _flush(shm_path: str, durable_path: str):
     try:
-        tmp_path = durable_path + ".tmp"
+        # Unique temp name: overlapping flushes of the same model must not
+        # interleave writes into one file.
+        fd, tmp_path = tempfile.mkstemp(
+            dir=os.path.dirname(durable_path) or ".",
+            prefix=os.path.basename(durable_path) + ".")
+        os.close(fd)
         shutil.copyfile(shm_path, tmp_path)
         os.replace(tmp_path, durable_path)
         if not os.path.exists(shm_path):

@@ -144,6 +144,39 @@ def test_train_epoch_with_ring_attention(cpu_devices, toy_gpt_layers):
     np.testing.assert_allclose(float(cost_sp), float(cost_plain), rtol=1e-5)
 
 
+def test_train_model_uses_data_parallel_mesh(workdir, toy_gpt_layers,
+                                             toy_shards, monkeypatch):
+    """train_model shards the micro-batch over all 8 virtual devices and
+    matches the single-device run numerically (same data, same init)."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"sgd": {"lr": 0.1}}
+    dp = NeuralNetworkModel("dp8", Mapper(toy_gpt_layers, optim)).to_device("cpu")
+    single = NeuralNetworkModel("dp1", Mapper(toy_gpt_layers, optim)).to_device("cpu")
+    mesh = dp._training_mesh(step_size=8, block_size=16)
+    assert mesh is not None and mesh.shape["data"] == 8
+    dp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                   step_size=8)
+    monkeypatch.setenv("PENROZ_TRAIN_MESH", "0")
+    single.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                       step_size=8)
+    assert dp.status["code"] == "Trained"
+    np.testing.assert_allclose(dp.progress[-1]["cost"],
+                               single.progress[-1]["cost"], rtol=1e-4)
+    for k in dp.params:
+        np.testing.assert_allclose(np.asarray(dp.params[k], np.float32),
+                                   np.asarray(single.params[k], np.float32),
+                                   atol=1e-5)
+
+
+def test_training_mesh_fallback_on_indivisible_batch(workdir, toy_gpt_layers):
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    model = NeuralNetworkModel(
+        "fb", Mapper(toy_gpt_layers, {"sgd": {"lr": 0.1}})).to_device("cpu")
+    assert model._training_mesh(step_size=3, block_size=16) is None
+
+
 def test_process_topology_single_host():
     assert dist.process_count() == 1
     assert dist.process_index() == 0

@@ -30,10 +30,28 @@ import jax.numpy as jnp
 log = logging.getLogger(__name__)
 
 TURBO_QUANT_ENV = "TURBO_QUANT_KV_CACHE"
+PAGED_ENV = "PAGED_KV_CACHE"
+PAGE_SIZE_ENV = "PENROZ_KV_PAGE_SIZE"
 
 
 def turbo_quant_enabled() -> bool:
     return os.environ.get(TURBO_QUANT_ENV, "0") == "1"
+
+
+def paged_enabled() -> bool:
+    return os.environ.get(PAGED_ENV, "0") == "1"
+
+
+def default_page_size() -> int:
+    raw = os.environ.get(PAGE_SIZE_ENV, "128")
+    try:
+        size = int(raw)
+        if size <= 0:
+            raise ValueError
+    except ValueError:
+        log.warning("Ignoring invalid %s=%r; using 128", PAGE_SIZE_ENV, raw)
+        return 128
+    return size
 
 
 # ---------------------------------------------------------------------------
@@ -170,14 +188,202 @@ class QuantKVState(KVState):
         return sum(int(a.size) * itemsize for a in (*self.k, *self.v))
 
 
+@jax.tree_util.register_pytree_node_class
+class PagedKVState(KVState):
+    """Paged KV cache: fixed-size pages in a shared HBM pool + block table.
+
+    The contiguous per-sequence buffers of :class:`KVState` become per-layer
+    *page pools* — flat ``(num_pages * page_size, Hkv, D)`` arrays whose rows
+    are grouped into pages of ``page_size`` tokens — plus one block table
+    ``(B, pages_per_seq)`` mapping each sequence's logical page to a physical
+    page.  Pages are assigned on demand by an in-jit bump allocator
+    (vLLM-style paged attention; BASELINE.json config "gpt2-medium /generate/
+    with paged KV on TPU HBM").
+
+    The pool itself is preallocated (XLA needs static shapes), so single-
+    sequence decode holds the same HBM as the contiguous cache; the paged
+    layout is the substrate for pool sharing across sequences, which needs a
+    freeing allocator (the current bump allocator only frees on ``reset``, so
+    ``create`` rejects undersized pools rather than aliasing live pages).
+    ``assigned_bytes()`` tracks actual per-sequence growth.
+    The attention-facing ``append`` currently materializes dense gathered
+    views (a paged Pallas decode kernel that walks the block table directly
+    is the planned replacement for that copy).
+
+    The surface is identical to :class:`KVState` (``append`` returns gathered
+    full ``(B, Hkv, S_max, D)`` views; ``advanced``/``reset`` thread state), so
+    it is a drop-in for the jitted decode path.  ``-1`` block-table entries
+    mark unassigned pages; their gathered rows are garbage but always sit at
+    positions ≥ the valid length, which the attention mask ignores
+    (ops/attention.py:91-108).
+    """
+
+    quantized = False
+
+    # ``counters`` packs (length, next_free, assigned_pages) into one int32
+    # array: a single buffer cannot alias itself when the state is donated.
+
+    def __init__(self, k, v, counters, block_table,
+                 page_size: int, pages_per_seq: int):
+        self.k = list(k)
+        self.v = list(v)
+        self.counters = counters
+        self.block_table = block_table
+        self.page_size = int(page_size)
+        self.pages_per_seq = int(pages_per_seq)
+
+    @property
+    def length(self):
+        return self.counters[0]
+
+    @property
+    def next_free(self):
+        return self.counters[1]
+
+    @property
+    def assigned_pages(self):
+        """Per-sequence logical pages handed out so far this step."""
+        return self.counters[2]
+
+    def tree_flatten(self):
+        children = (tuple(self.k), tuple(self.v), self.counters,
+                    self.block_table)
+        return children, (self.page_size, self.pages_per_seq)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k, v, counters, block_table = children
+        return cls(list(k), list(v), counters, block_table,
+                   page_size=aux[0], pages_per_seq=aux[1])
+
+    @classmethod
+    def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32,
+               page_size: int | None = None, pool_pages: int | None = None):
+        page = page_size or default_page_size()
+        pages_per_seq = -(-max_len // page)
+        num_pages = pool_pages or batch * pages_per_seq
+        if num_pages < batch * pages_per_seq:
+            raise ValueError(
+                f"pool_pages={num_pages} cannot back {batch} sequence(s) of "
+                f"{pages_per_seq} pages: the bump allocator frees only on "
+                "reset, so an undersized pool would alias live pages")
+        k = [jnp.zeros((num_pages * page, h, d), dtype) for h, d in specs]
+        v = [jnp.zeros((num_pages * page, h, d), dtype) for h, d in specs]
+        table = jnp.full((batch, pages_per_seq), -1, jnp.int32)
+        return cls(k, v, jnp.zeros((3,), jnp.int32), table,
+                   page, pages_per_seq)
+
+    @property
+    def max_len(self) -> int:
+        return self.pages_per_seq * self.page_size
+
+    @property
+    def num_pool_pages(self) -> int:
+        return self.k[0].shape[0] // self.page_size if self.k else 0
+
+    def _allocate(self, new_length):
+        """Bump-allocate physical pages covering ``[0, new_length)``.
+
+        Idempotent within a step: every layer's ``append`` calls this with
+        the same ``new_length``; ``assigned_pages`` (not ``length``, which
+        only advances post-step) tracks what the first call handed out, so
+        subsequent calls see ``delta == 0``.
+        """
+        P, S = self.page_size, self.pages_per_seq
+        B = self.block_table.shape[0]
+        assigned = self.assigned_pages
+        needed = jnp.minimum((new_length + P - 1) // P, S)
+        delta = needed - assigned
+        slots = jnp.arange(S, dtype=jnp.int32)
+        fresh = (slots >= assigned) & (slots < needed)
+        b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+        entries = self.next_free + b_idx * delta + (slots[None, :] - assigned)
+        self.block_table = jnp.where(fresh[None, :], entries.astype(jnp.int32),
+                                     self.block_table)
+        self.counters = jnp.stack([self.counters[0],
+                                   self.next_free + B * delta, needed])
+
+    def _rows(self, pos):
+        """Physical row indices for logical positions ``pos`` (n,) → (B, n)."""
+        P = self.page_size
+        phys_page = self.block_table[:, pos // P]  # (B, n)
+        return phys_page * P + pos % P
+
+    def append(self, layer_idx: int, k_new, v_new):
+        B, H, T, D = k_new.shape
+        new_length = self.length + T
+        self._allocate(new_length)
+        pos = self.length + jnp.arange(T, dtype=jnp.int32)
+        rows = self._rows(pos).reshape(-1)  # (B*T,)
+        kv_rows = lambda t: t.transpose(0, 2, 1, 3).reshape(B * T, H, D)
+        self.k[layer_idx] = self.k[layer_idx].at[rows].set(
+            kv_rows(k_new).astype(self.k[layer_idx].dtype))
+        self.v[layer_idx] = self.v[layer_idx].at[rows].set(
+            kv_rows(v_new).astype(self.v[layer_idx].dtype))
+        return (self._gather(self.k[layer_idx]),
+                self._gather(self.v[layer_idx]), new_length)
+
+    def _gather(self, flat):
+        """Assemble the (B, Hkv, S_max, D) view the attention mask expects."""
+        all_pos = jnp.arange(self.max_len, dtype=jnp.int32)
+        rows = jnp.clip(self._rows(all_pos), 0)  # unassigned → row 0 (masked)
+        return jnp.take(flat, rows, axis=0, mode="clip").transpose(0, 2, 1, 3)
+
+    def _with_length(self, length):
+        counters = self.counters.at[0].set(length)
+        return PagedKVState(list(self.k), list(self.v), counters,
+                            self.block_table,
+                            self.page_size, self.pages_per_seq)
+
+    def reset(self):
+        table = jnp.full_like(self.block_table, -1)
+        return PagedKVState(list(self.k), list(self.v),
+                            jnp.zeros((3,), jnp.int32), table,
+                            self.page_size, self.pages_per_seq)
+
+    def _row_bytes(self) -> int:
+        """Bytes per token row summed over every layer's K and V pool."""
+        return sum(a.shape[1] * a.shape[2] * a.dtype.itemsize
+                   for a in (*self.k, *self.v))
+
+    # ``memory_bytes`` is inherited: the preallocated pool is what actually
+    # sits in HBM, so the reported compression ratio is an honest 1.0.
+
+    def assigned_bytes(self) -> int:
+        """Bytes of *assigned* pages (what live sequences actually hold).
+
+        ``next_free`` counts pages per pool; every layer's pool assigns the
+        same pages, so live bytes = pages × page_size × summed row bytes."""
+        import numpy as np
+        live_pages = min(int(np.asarray(self.next_free)), self.num_pool_pages)
+        return live_pages * self.page_size * self._row_bytes()
+
+    def logical_bytes(self) -> int:
+        """Bytes a contiguous per-sequence cache of max_len would occupy."""
+        B = self.block_table.shape[0]
+        return B * self.max_len * self._row_bytes()
+
+
 def create_kv_state(specs, batch: int, max_len: int, dtype=jnp.float32,
-                    quantized: bool | None = None) -> KVState:
-    """Factory honoring the ``TURBO_QUANT_KV_CACHE=1`` env flag."""
+                    quantized: bool | None = None,
+                    paged: bool | None = None) -> KVState:
+    """Factory honoring ``TURBO_QUANT_KV_CACHE=1`` and ``PAGED_KV_CACHE=1``.
+
+    Quantized takes precedence when both are requested (an int8 paged pool is
+    not implemented yet)."""
     if quantized is None:
         quantized = turbo_quant_enabled()
+    if paged is None:
+        paged = paged_enabled()
     if quantized:
         log.info("TurboQuant KV cache enabled (%s=1)", TURBO_QUANT_ENV)
+        if paged:
+            log.warning("PAGED_KV_CACHE ignored: TurboQuant takes precedence")
         return QuantKVState.create(specs, batch, max_len, dtype)
+    if paged:
+        log.info("Paged KV cache enabled (%s=1, page_size=%d)", PAGED_ENV,
+                 default_page_size())
+        return PagedKVState.create(specs, batch, max_len, dtype)
     return KVState.create(specs, batch, max_len, dtype)
 
 

@@ -62,7 +62,7 @@ def save(model_id: str, data: dict, sync_flush: bool = False):
     _atomic_pickle(shm_path, data)
     log.info("Model cached successfully: %s", shm_path)
     if sync_flush:
-        shutil.copyfile(shm_path, durable_path)
+        _flush(shm_path, durable_path)
     else:
         # Background flush: a thread, not a fork — os.fork() deadlocks under
         # JAX's thread pool, and the copy is pure file I/O anyway.
@@ -71,9 +71,23 @@ def save(model_id: str, data: dict, sync_flush: bool = False):
                          daemon=True).start()
 
 
-def _atomic_pickle(path: str, data: dict):
-    fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(path),
+# Probed once at import, before any flush thread exists: os.umask is
+# process-global, so probing it per-call would race those threads.
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+def _mkstemp_for(path: str):
+    """Unique temp sibling of ``path`` with umask-default permissions
+    (mkstemp's 0600 would make shm checkpoints unreadable cross-user)."""
+    fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                     prefix=os.path.basename(path) + ".")
+    os.fchmod(fd, 0o666 & ~_UMASK)
+    return fd, tmp_path
+
+
+def _atomic_pickle(path: str, data: dict):
+    fd, tmp_path = _mkstemp_for(path)
     try:
         with os.fdopen(fd, "wb") as f:
             pickle.dump(data, f, protocol=5)
@@ -85,13 +99,11 @@ def _atomic_pickle(path: str, data: dict):
 
 
 def _flush(shm_path: str, durable_path: str):
+    # Unique temp name: overlapping flushes of the same model must not
+    # interleave writes into one file.
+    fd, tmp_path = _mkstemp_for(durable_path)
+    os.close(fd)
     try:
-        # Unique temp name: overlapping flushes of the same model must not
-        # interleave writes into one file.
-        fd, tmp_path = tempfile.mkstemp(
-            dir=os.path.dirname(durable_path) or ".",
-            prefix=os.path.basename(durable_path) + ".")
-        os.close(fd)
         shutil.copyfile(shm_path, tmp_path)
         os.replace(tmp_path, durable_path)
         if not os.path.exists(shm_path):
@@ -101,6 +113,9 @@ def _flush(shm_path: str, durable_path: str):
     except FileNotFoundError:
         # The model was deleted between the save and the flush; nothing to do.
         log.warning("Flush skipped, source vanished: %s", shm_path)
+    finally:
+        if os.path.exists(tmp_path):
+            os.remove(tmp_path)
 
 
 def load(model_id: str) -> dict:

@@ -140,3 +140,116 @@ def test_record_step_metrics():
     cache.record_step(num_tokens=4, logical_bytes=1000, stored_bytes=250)
     assert cache.metrics.compression_ratio == 4.0
     assert cache.metrics.total_entries == 4
+
+
+# -- paged state ------------------------------------------------------------
+
+def test_paged_state_append_matches_contiguous():
+    specs = [(2, 4), (2, 4)]
+    plain = KV.KVState.create(specs, batch=2, max_len=8)
+    paged = KV.PagedKVState.create(specs, batch=2, max_len=8, page_size=4)
+    k, v = _kv(shape=(2, 2, 3, 4))
+    fk_p, fv_p, len_p = plain.append(0, k, v)
+    fk_g, fv_g, len_g = paged.append(0, k, v)
+    assert int(len_p) == int(len_g) == 3
+    np.testing.assert_allclose(np.asarray(fk_g)[:, :, :3],
+                               np.asarray(fk_p)[:, :, :3], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(fv_g)[:, :, :3],
+                               np.asarray(fv_p)[:, :, :3], rtol=1e-6)
+    plain, paged = plain.advanced(3), paged.advanced(3)
+    k2, v2 = _kv(shape=(2, 2, 2, 4), seed=1)
+    fk_p, _, _ = plain.append(1, k2, v2)
+    fk_g, _, _ = paged.append(1, k2, v2)
+    np.testing.assert_allclose(np.asarray(fk_g)[:, :, 3:5],
+                               np.asarray(fk_p)[:, :, 3:5], rtol=1e-6)
+
+
+def test_paged_bump_allocator_assigns_pages_on_demand():
+    paged = KV.PagedKVState.create([(1, 4)], batch=2, max_len=16, page_size=4)
+    assert int(paged.next_free) == 0
+    assert np.all(np.asarray(paged.block_table) == -1)
+    k, v = _kv(shape=(2, 1, 3, 4))
+    paged.append(0, k, v)  # 3 tokens → 1 page per sequence
+    assert int(paged.next_free) == 2
+    table = np.asarray(paged.block_table)
+    assert (table[:, 0] >= 0).all() and (table[:, 1:] == -1).all()
+    assert table[0, 0] != table[1, 0]  # distinct physical pages
+    paged = paged.advanced(3)
+    k2, v2 = _kv(shape=(2, 1, 2, 4), seed=1)
+    paged.append(0, k2, v2)  # crosses into page 1
+    assert int(paged.next_free) == 4
+    assert (np.asarray(paged.block_table)[:, 1] >= 0).all()
+
+
+def test_paged_allocation_idempotent_across_layers():
+    paged = KV.PagedKVState.create([(1, 4), (1, 4)], batch=1, max_len=8,
+                                   page_size=4)
+    k, v = _kv(shape=(1, 1, 3, 4))
+    paged.append(0, k, v)
+    nf = int(paged.next_free)
+    paged.append(1, k, v)  # same step, second layer: no new pages
+    assert int(paged.next_free) == nf
+
+
+def test_paged_assigned_bytes_grow_with_usage():
+    paged = KV.PagedKVState.create([(2, 4)], batch=1, max_len=64, page_size=8)
+    assert paged.assigned_bytes() == 0
+    k, v = _kv(shape=(1, 2, 8, 4))
+    paged.append(0, k, v)
+    used = paged.assigned_bytes()
+    assert 0 < used < paged.logical_bytes()
+    paged = paged.advanced(8)
+    paged.append(0, k, v)
+    assert paged.assigned_bytes() == 2 * used
+    # memory_bytes reports the real preallocated pool (honest ratio 1.0)
+    assert paged.memory_bytes() == paged.logical_bytes()
+
+
+def test_paged_rejects_undersized_pool():
+    """No freeing allocator yet: an undersized pool would alias live pages
+    across sequences, so create() refuses it outright."""
+    with pytest.raises(ValueError, match="alias live pages"):
+        KV.PagedKVState.create([(1, 2)], batch=2, max_len=4, page_size=4,
+                               pool_pages=1)
+
+
+def test_paged_reset_frees_pages():
+    paged = KV.PagedKVState.create([(1, 4)], batch=1, max_len=8, page_size=4)
+    k, v = _kv(shape=(1, 1, 3, 4))
+    paged.append(0, k, v)
+    paged = paged.reset()
+    assert int(paged.next_free) == 0
+    assert int(paged.length) == 0
+    assert np.all(np.asarray(paged.block_table) == -1)
+
+
+def test_paged_is_pytree_and_jit_compatible():
+    import jax
+    import jax.numpy as jnp
+
+    paged = KV.PagedKVState.create([(1, 4)], batch=1, max_len=8, page_size=4)
+    rebuilt = jax.tree.unflatten(jax.tree.structure(paged),
+                                 jax.tree.leaves(paged))
+    assert isinstance(rebuilt, KV.PagedKVState)
+    assert rebuilt.page_size == 4
+
+    @jax.jit
+    def step(state, k, v):
+        fk, fv, new_len = state.append(0, k, v)
+        return fk, state.advanced(k.shape[2])
+
+    k, v = _kv(shape=(1, 1, 3, 4))
+    fk, new_state = step(paged, jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(fk)[:, :, :3], k, rtol=1e-6)
+    assert int(new_state.length) == 3
+    assert int(new_state.next_free) == 1
+
+
+def test_factory_paged_env_flag(monkeypatch):
+    monkeypatch.setenv(KV.PAGED_ENV, "1")
+    state = KV.create_kv_state([(1, 4)], batch=1, max_len=8)
+    assert isinstance(state, KV.PagedKVState)
+    # TurboQuant wins when both flags are set.
+    monkeypatch.setenv(KV.TURBO_QUANT_ENV, "1")
+    state = KV.create_kv_state([(1, 4)], batch=1, max_len=8)
+    assert isinstance(state, KV.QuantKVState)

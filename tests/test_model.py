@@ -255,3 +255,29 @@ def test_mlp_training_per_position(workdir, toy_shards):
                       step_size=4)
     assert model.status["code"] == "Trained"
     assert np.isfinite(model.progress[-1]["cost"])
+
+
+def test_generate_paged_matches_contiguous(workdir, toy_gpt_layers,
+                                           monkeypatch):
+    """Greedy decode with PAGED_KV_CACHE=1 must match the contiguous cache
+    token-for-token (BASELINE config: paged-KV /generate/)."""
+    model = NeuralNetworkModel("gp", Mapper(toy_gpt_layers, SGD))
+    plain = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                  max_new_tokens=6, temperature=0.0)
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    model2 = NeuralNetworkModel("gp2", Mapper(toy_gpt_layers, SGD))
+    model2.params = model.params
+    paged = model2.generate_tokens([[1, 2, 3]], block_size=16,
+                                   max_new_tokens=6, temperature=0.0)
+    assert paged == plain
+
+
+def test_generate_paged_overflow_reprefills(workdir, toy_gpt_layers,
+                                            monkeypatch):
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    model = NeuralNetworkModel("gp3", Mapper(toy_gpt_layers, SGD))
+    tokens = model.generate_tokens([[1, 2, 3]], block_size=8,
+                                   max_new_tokens=10, temperature=0.0)
+    assert len(tokens) == 13

@@ -138,10 +138,10 @@ class CompiledArch:
 
     def _apply(self, params, buffers, x, *, training=False, rng=None, kv=None,
                pos_offset=None, skip_softmax=False, compute_dtype=None,
-               sp_mesh=None):
+               sp_mesh=None, platform=None):
         ctx = M.Ctx(params, buffers, training=training, rng=rng, kv=kv,
                     pos_offset=pos_offset, compute_dtype=compute_dtype,
-                    sp_mesh=sp_mesh)
+                    sp_mesh=sp_mesh, platform=platform)
         acts = []
         h = x
         logits = None
@@ -169,7 +169,8 @@ class CompiledArch:
 
     def forward(self, params, buffers, tokens, targets=None, *,
                 training=False, rng=None, kv=None, pos_offset=None,
-                skip_softmax=False, compute_dtype=None, sp_mesh=None):
+                skip_softmax=False, compute_dtype=None, sp_mesh=None,
+                platform=None):
         """Full forward collecting every top-level activation.
 
         Returns ``(activations, cost, buffer_updates, new_kv)``; ``cost`` is
@@ -178,28 +179,31 @@ class CompiledArch:
         acts, logits, ctx = self._apply(
             params, buffers, tokens, training=training, rng=rng, kv=kv,
             pos_offset=pos_offset, skip_softmax=skip_softmax,
-            compute_dtype=compute_dtype, sp_mesh=sp_mesh)
+            compute_dtype=compute_dtype, sp_mesh=sp_mesh, platform=platform)
         cost = (self._cost_from_logits(logits, targets)
                 if targets is not None else None)
         new_kv = ctx.kv.advanced(tokens.shape[-1]) if ctx.kv is not None else None
         return acts, cost, ctx.buffer_updates, new_kv
 
     def jit_forward(self, params, buffers, tokens, targets=None, *,
-                    skip_softmax=False, compute_dtype=None):
+                    skip_softmax=False, compute_dtype=None, platform=None):
         """Jitted inference forward (cached per static configuration)."""
-        key = ("fwd", targets is not None, skip_softmax, str(compute_dtype))
+        key = ("fwd", targets is not None, skip_softmax, str(compute_dtype),
+               platform)
         fn = self._jit_cache.get(key)
         if fn is None:
             if targets is None:
                 def fwd(p, b, t):
                     return self.forward(p, b, t, None,
                                         skip_softmax=skip_softmax,
-                                        compute_dtype=compute_dtype)
+                                        compute_dtype=compute_dtype,
+                                        platform=platform)
             else:
                 def fwd(p, b, t, y):
                     return self.forward(p, b, t, y,
                                         skip_softmax=skip_softmax,
-                                        compute_dtype=compute_dtype)
+                                        compute_dtype=compute_dtype,
+                                        platform=platform)
             fn = self._jit_cache[key] = jax.jit(fwd)
         if targets is None:
             return fn(params, buffers, tokens)
@@ -208,7 +212,8 @@ class CompiledArch:
     # -- training -----------------------------------------------------------
 
     def train_epoch_fn(self, optimizer_config: dict, num_steps: int,
-                       remat: bool = False, compute_dtype=None, sp_mesh=None):
+                       remat: bool = False, compute_dtype=None, sp_mesh=None,
+                       platform=None):
         """One jitted epoch: ``num_steps`` grad-accumulation micro-steps via
         ``lax.scan`` then a single optax update (reference hot loop:
         neural_net_model.py:614-677; sync deferred to the final micro-step is
@@ -219,7 +224,8 @@ class CompiledArch:
         ``xs``/``ys`` are ``(num_steps, B, T)`` token batches.
         """
         key = ("epoch", json.dumps(optimizer_config, sort_keys=True),
-               int(num_steps), bool(remat), str(compute_dtype), sp_mesh)
+               int(num_steps), bool(remat), str(compute_dtype), sp_mesh,
+               platform)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -230,7 +236,7 @@ class CompiledArch:
             _, cost, buf_upd, _ = self.forward(
                 params, buffers, x, y, training=True, rng=rng,
                 skip_softmax=True, compute_dtype=compute_dtype,
-                sp_mesh=sp_mesh)
+                sp_mesh=sp_mesh, platform=platform)
             return cost, buf_upd
 
         if remat:
@@ -274,12 +280,13 @@ class CompiledArch:
     # -- decode -------------------------------------------------------------
 
     def _decode_step(self, params, buffers, kv, tokens, rng, temp, *,
-                     greedy, top_k, compute_dtype):
+                     greedy, top_k, compute_dtype, platform=None):
         """Feed tokens through the stack with the KV cache, sample the next
         token on-device (reference samples on host: :393-405)."""
         acts, _, _, new_kv = self.forward(
             params, buffers, tokens, None, kv=kv, pos_offset=kv.length,
-            skip_softmax=True, compute_dtype=compute_dtype)
+            skip_softmax=True, compute_dtype=compute_dtype,
+            platform=platform)
         logits = acts[-1]
         if logits.ndim == 3:
             logits = logits[:, -1, :]
@@ -301,23 +308,28 @@ class CompiledArch:
         (greedy, top_k, dtype); shapes retrace automatically)."""
 
         def decode(params, buffers, kv, tokens, rng, temp, *,
-                   compute_dtype=None, greedy=False, top_k=None):
-            key = ("decode", bool(greedy), top_k, str(compute_dtype))
+                   compute_dtype=None, greedy=False, top_k=None,
+                   platform=None):
+            key = ("decode", bool(greedy), top_k, str(compute_dtype),
+                   platform)
             fn = self._jit_cache.get(key)
             if fn is None:
                 def step(p, b, k, t, r, tmp):
                     return self._decode_step(p, b, k, t, r, tmp,
                                              greedy=greedy, top_k=top_k,
-                                             compute_dtype=compute_dtype)
+                                             compute_dtype=compute_dtype,
+                                             platform=platform)
                 fn = self._jit_cache[key] = jax.jit(step, donate_argnums=(2,))
             return fn(params, buffers, kv, tokens, rng, temp)
 
         return decode
 
     def decode_chunk(self, params, buffers, kv, last_tok, rng, temp, *,
-                     chunk: int, greedy=False, top_k=None, compute_dtype=None):
+                     chunk: int, greedy=False, top_k=None, compute_dtype=None,
+                     platform=None):
         """Run ``chunk`` fused decode+sample steps in one dispatch."""
-        key = ("chunk", int(chunk), bool(greedy), top_k, str(compute_dtype))
+        key = ("chunk", int(chunk), bool(greedy), top_k, str(compute_dtype),
+               platform)
         fn = self._jit_cache.get(key)
         if fn is None:
             def run(p, b, kv0, tok0, r, tmp):
@@ -326,7 +338,7 @@ class CompiledArch:
                     new_tok, kv_c = self._decode_step(
                         p, b, kv_c, tok, jax.random.fold_in(r, i), tmp,
                         greedy=greedy, top_k=top_k,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype, platform=platform)
                     return (kv_c, new_tok), new_tok[:, 0]
 
                 (kv_c, _), toks = jax.lax.scan(step, (kv0, tok0),
@@ -338,21 +350,23 @@ class CompiledArch:
 
     # -- diagnostics --------------------------------------------------------
 
-    def stats_grads(self, params, buffers, x, y, compute_dtype=None):
+    def stats_grads(self, params, buffers, x, y, compute_dtype=None,
+                    platform=None):
         """Activations, activation-gradients and weight-gradients for one
         batch — the /stats/ inputs.  Activation grads come from an explicit
         zero-delta VJP (JAX has no ``retain_grad``; reference :643-646)."""
         acts, _, _, _ = self.jit_forward(params, buffers, x, y,
                                          skip_softmax=True,
-                                         compute_dtype=compute_dtype)
+                                         compute_dtype=compute_dtype,
+                                         platform=platform)
         deltas = [jnp.zeros(a.shape, a.dtype) for a in acts]
 
-        key = ("statsgrad", str(compute_dtype))
+        key = ("statsgrad", str(compute_dtype), platform)
         fn = self._jit_cache.get(key)
         if fn is None:
             def f(p, d, xb, yb, bufs):
                 ctx = M.Ctx(p, bufs, training=False,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype, platform=platform)
                 h = xb
                 i = 0
                 for mod in self.mods:
@@ -428,6 +442,22 @@ class NeuralNetworkModel:
             self.device = dev
         return self
 
+    @property
+    def _platform(self) -> Optional[str]:
+        """Execution-platform hint for the Pallas kernel gates.  A model
+        explicitly placed (device='cpu' on a TPU-attached host) must not
+        trace TPU kernels that cannot lower for its backend; params placement
+        is what actually decides where jit runs."""
+        if self.device is not None:
+            return self.device.platform
+        try:
+            p = next(iter(self.params.values()))
+            if isinstance(p, jax.Array) and not isinstance(p, jax.core.Tracer):
+                return next(iter(p.devices())).platform
+        except StopIteration:
+            pass
+        return None
+
     # -- inference ----------------------------------------------------------
 
     def _as_input(self, data):
@@ -442,7 +472,8 @@ class NeuralNetworkModel:
         x = self._as_input(input)
         if target is None:
             acts, cost, _, _ = self.arch.jit_forward(self.params, self.buffers,
-                                                     x)
+                                                     x,
+                                                     platform=self._platform)
         else:
             t = np.asarray(target)
             if self.arch.classification:
@@ -450,7 +481,8 @@ class NeuralNetworkModel:
             else:
                 t = jnp.asarray(t, jnp.float32)
             acts, cost, _, _ = self.arch.jit_forward(self.params, self.buffers,
-                                                     x, t)
+                                                     x, t,
+                                                     platform=self._platform)
         output = np.asarray(acts[-1], np.float32).tolist()
         return output, (float(cost) if cost is not None else None)
 
@@ -483,7 +515,8 @@ class NeuralNetworkModel:
                 x = jnp.asarray(x.reshape(step_size, block_size))
                 y = jnp.asarray(y.reshape(step_size, block_size))
                 _, cost, _, _ = self.arch.jit_forward(
-                    self.params, self.buffers, x, y, skip_softmax=True)
+                    self.params, self.buffers, x, y, skip_softmax=True,
+                    platform=self._platform)
                 costs.append(float(cost))
         return float(np.mean(costs))
 
@@ -520,7 +553,8 @@ class NeuralNetworkModel:
                 if mesh.shape[mesh_lib.SEQ_AXIS] > 1:
                     sp_mesh = mesh
             epoch_fn = self.arch.train_epoch_fn(self.optimizer_config,
-                                                num_steps, sp_mesh=sp_mesh)
+                                                num_steps, sp_mesh=sp_mesh,
+                                                platform=self._platform)
             rng = jax.random.key(0)
             base_epoch = self.progress[-1]["epoch"] if self.progress else 0
             last_save = time.monotonic()
@@ -648,7 +682,7 @@ class NeuralNetworkModel:
 
     def _compute_stats(self, x, y) -> dict:
         acts, act_grads, weight_grads = self.arch.stats_grads(
-            self.params, self.buffers, x, y)
+            self.params, self.buffers, x, y, platform=self._platform)
         acts_np = [np.asarray(a, np.float32) for a in acts]
         grads_np = [np.asarray(g, np.float32) for g in act_grads]
         weights = [np.asarray(self.params[k], np.float32)
@@ -698,7 +732,8 @@ class NeuralNetworkModel:
                 x = jnp.asarray(np.asarray(feed, np.int64)[None, :],
                                 jnp.int32)
                 tok_arr, kv = decode(self.params, self.buffers, kv, x, rng,
-                                     temp, greedy=greedy, top_k=top_k)
+                                     temp, greedy=greedy, top_k=top_k,
+                                     platform=self._platform)
                 cache_len = len(feed)
                 new_tokens = [int(np.asarray(tok_arr)[0, 0])]
             else:
@@ -708,7 +743,7 @@ class NeuralNetworkModel:
                 x = jnp.asarray([[last_tok]], jnp.int32)
                 toks_arr, kv = self.arch.decode_chunk(
                     self.params, self.buffers, kv, x, rng, temp, chunk=chunk,
-                    greedy=greedy, top_k=top_k)
+                    greedy=greedy, top_k=top_k, platform=self._platform)
                 cache_len += chunk
                 new_tokens = [int(t) for t in np.asarray(toks_arr)[0]]
             dispatch += 1

@@ -80,23 +80,36 @@ def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None):
     return out.reshape(B, Hq, T, D)
 
 
-def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None):
-    """Causal self-attention; dispatches to the Pallas kernel on TPU."""
-    if dropout_rate == 0.0 and _use_flash(q, k):
+def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                     platform=None):
+    """Causal self-attention; dispatches to the Pallas kernel on TPU.
+
+    ``platform`` is the caller's execution-placement hint ('tpu'/'cpu'/...).
+    Inside jit the arrays are tracers, so without the hint the gate can only
+    guess from global config — and a model explicitly placed on CPU on a
+    TPU-attached host would dispatch kernels that cannot lower for CPU.
+    """
+    if dropout_rate == 0.0 and _use_flash(q, k, platform):
         from penroz_tpu.ops.pallas import flash_attention as fa
         return fa.flash_attention(q, k, v, causal=True)
     return causal_attention_reference(q, k, v, dropout_rate, dropout_rng)
 
 
 def cached_attention(q, k_full, v_full, offset, length,
-                     dropout_rate=0.0, dropout_rng=None):
+                     dropout_rate=0.0, dropout_rng=None, platform=None):
     """Attention over a preallocated KV cache.
 
     q: (B, Hq, T, D) new queries at positions ``offset + [0, T)``.
     k_full/v_full: (B, Hkv, S_max, D) cache contents after the current append.
     ``length`` is the total valid length (offset + T).  Keys at index j are
     attended when ``j <= offset + t`` (combined causal + validity mask).
+
+    Dispatches to the Pallas decode kernel on TPU (compute bounded by the
+    valid length, not S_max); this jnp path is its correctness oracle.
     """
+    if dropout_rate == 0.0 and _use_flash_decode(q, k_full, platform):
+        from penroz_tpu.ops.pallas import decode_attention as da
+        return da.decode_attention(q, k_full, v_full, offset, length)
     B, Hq, T, D = q.shape
     S = k_full.shape[2]
     num_kv_heads = k_full.shape[1]
@@ -108,20 +121,54 @@ def cached_attention(q, k_full, v_full, offset, length,
     return out.reshape(B, Hq, T, D)
 
 
-def _use_flash(q, k) -> bool:
-    """Whether the Pallas flash kernel applies to these shapes/platform."""
+def _tpu_platform(x, platform=None) -> bool:
+    """Whether attention on ``x`` will run on TPU.
+
+    ``platform`` — the caller's placement hint — wins when given.  Otherwise:
+    a concrete array knows its device; a tracer doesn't, and
+    ``jax.default_backend()`` reports the highest-priority backend even when
+    ``jax_default_device`` pins computation elsewhere (e.g. CPU tests on a
+    TPU-attached host), so the config is consulted before the backend.
+    """
     import os
     if os.environ.get("PENROZ_DISABLE_FLASH", "0") == "1":
         return False
+    if platform is not None:
+        return platform in ("tpu", "axon")
     try:
-        platform = q.devices().pop().platform if hasattr(q, "devices") else \
-            jax.default_backend()
+        if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer):
+            platform = next(iter(x.devices())).platform
+        elif jax.config.jax_default_device is not None:
+            platform = jax.config.jax_default_device.platform
+        else:
+            platform = jax.default_backend()
     except Exception:
-        platform = jax.default_backend()
-    if platform not in ("tpu", "axon"):
+        return False
+    return platform in ("tpu", "axon")
+
+
+def _use_flash(q, k, platform=None) -> bool:
+    """Whether the Pallas flash kernel applies to these shapes/platform."""
+    if not _tpu_platform(q, platform):
         return False
     B, Hq, T, D = q.shape
     Hkv = k.shape[1]
     # MXU-friendly: head dim multiple of 128 lane requirement handled by the
     # kernel via padding; sequence must be long enough to tile.
     return T >= 128 and T % 128 == 0 and D in (64, 128, 256) and Hq % Hkv == 0
+
+
+def _use_flash_decode(q, k_full, platform=None) -> bool:
+    """Whether the Pallas decode kernel applies (static shape checks only —
+    offset/length are traced)."""
+    if not _tpu_platform(q, platform):
+        return False
+    B, Hq, T, D = q.shape
+    Hkv, S = k_full.shape[1], k_full.shape[2]
+    # The kernel stages full (S, D) K and V per (batch, kv-head) instance in
+    # VMEM (~16 MB/core); leave headroom for q/out/accumulators.  Longer
+    # caches fall back to the jnp path until the kernel tiles K via the grid.
+    kv_vmem_bytes = 2 * S * D * jnp.dtype(k_full.dtype).itemsize
+    return (S >= 128 and S % 128 == 0 and D in (64, 128, 256)
+            and Hq % Hkv == 0 and (Hq // Hkv) * T <= 512
+            and kv_vmem_bytes <= 6 * 1024 * 1024)

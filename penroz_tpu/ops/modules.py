@@ -45,7 +45,8 @@ class Ctx:
     """
 
     def __init__(self, params, buffers=None, *, training=False, rng=None,
-                 kv=None, pos_offset=None, compute_dtype=None, sp_mesh=None):
+                 kv=None, pos_offset=None, compute_dtype=None, sp_mesh=None,
+                 platform=None):
         self.params = params
         self.buffers = buffers or {}
         self.training = training
@@ -54,6 +55,7 @@ class Ctx:
         self.pos_offset = pos_offset  # scalar int32 array or None
         self.compute_dtype = compute_dtype
         self.sp_mesh = sp_mesh  # Mesh with a >1 'sequence' axis → ring attn
+        self.platform = platform  # execution platform hint for kernel gates
         self.buffer_updates = {}
         self._rng_counter = 0
 
@@ -510,13 +512,15 @@ class CausalSelfAttention(Module):
             k_full, v_full, length = ctx.kv.append(self.layer_idx, k, v)
             out = attn_ops.cached_attention(q, k_full, v_full, offset, length,
                                             dropout_rate=dropout_rate,
-                                            dropout_rng=dropout_rng)
+                                            dropout_rng=dropout_rng,
+                                            platform=ctx.platform)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
             # Sequence-parallel training: ring attention over ICI.
             from penroz_tpu.parallel.ring_attention import ring_attention
             out = ring_attention(q, k, v, ctx.sp_mesh, causal=True)
         else:
             out = attn_ops.causal_attention(q, k, v, dropout_rate=dropout_rate,
-                                            dropout_rng=dropout_rng)
+                                            dropout_rng=dropout_rng,
+                                            platform=ctx.platform)
 
         return out.transpose(0, 2, 1, 3).reshape(B, T, q_dim)

@@ -1,0 +1,130 @@
+"""Pallas TPU decode-attention kernel: cached single/few-token queries.
+
+The decode hot loop attends a handful of new query tokens (T = 1 chunked up
+to ~16) against a preallocated KV cache of capacity ``S_max`` holding
+``offset + T`` valid entries.  The jnp fallback (ops/attention.py:91-108)
+pays compute and bandwidth proportional to ``S_max``; this kernel prefetches
+the valid length as a scalar and bounds its K/V loop by it, so per-token cost
+tracks the *actual* cache occupancy.  GQA is handled by folding the query
+group into the row dimension — one kernel instance per (batch, kv-head)
+computes all grouped query heads on the MXU at once.
+
+Replaces the decode half of the reference's
+``F.scaled_dot_product_attention`` (neural_net_layers.py:92) the way the
+training kernel (pallas/flash_attention.py) replaces the causal half.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from penroz_tpu.ops.pallas.flash_attention import _largest_dividing_block
+
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                   num_queries: int, sm_scale: float):
+    """One (batch, kv-head) instance: GT grouped query rows vs valid cache.
+
+    q_ref: (1, 1, GT, D) where GT = group * T, row r ↦ (g = r // T, t = r % T).
+    k_ref/v_ref: (1, 1, S_max, D).  len_ref[0] = offset + T (valid entries).
+    """
+    gt = q_ref.shape[2]
+    head_dim = q_ref.shape[3]
+    total = len_ref[0]
+    offset = total - num_queries
+
+    q = q_ref[0, 0]
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        # Row r is query token t = r % T at absolute position offset + t; it
+        # may attend keys at positions ≤ offset + t (combined causal +
+        # validity mask of the jnp oracle).
+        t = jax.lax.broadcasted_iota(jnp.int32, (gt, block_k), 0) % num_queries
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (gt, block_k), 1)
+        s = jnp.where(k_pos <= offset + t, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((gt, head_dim), jnp.float32)
+    m0 = jnp.full((gt,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((gt,), jnp.float32)
+
+    # Only K blocks overlapping [0, total) contribute — the dynamic bound is
+    # the whole point of prefetching the length.
+    hi = jax.lax.div(total + block_k - 1, block_k)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_full, v_full, offset, length,
+                     block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):
+    """Fused cached attention.  Same contract as the jnp oracle
+    ``cached_attention``: q (B, Hq, T, D); k_full/v_full (B, Hkv, S_max, D);
+    ``length`` = offset + T valid entries (post-append)."""
+    B, Hq, T, D = q.shape
+    Hkv, S = k_full.shape[1], k_full.shape[2]
+    group = Hq // Hkv
+    block_k = _largest_dividing_block(S, block_k)
+    if S % block_k != 0:
+        raise ValueError(f"decode_attention requires S%{block_k}==0, got {S}")
+    sm_scale = 1.0 / (D ** 0.5)
+
+    # Fold the GQA group into the query-row dimension: head order is kv-major
+    # (matches _group_query_heads), so this is a pure reshape.
+    q_rows = q.reshape(B, Hkv, group * T, D)
+    total = jnp.asarray(length, jnp.int32).reshape(1)
+
+    kernel = functools.partial(_decode_kernel, block_k=block_k,
+                               num_queries=T, sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, group * T, D), lambda b, h, len_ref: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, len_ref: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, len_ref: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group * T, D),
+                               lambda b, h, len_ref: (b, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q_rows.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * B * Hq * T * S * D),
+            bytes_accessed=int((q.size + k_full.size + v_full.size + q.size)
+                               * q.dtype.itemsize),
+            transcendentals=int(B * Hq * T * S)),
+        interpret=interpret,
+    )(total, q_rows, k_full, v_full)
+    return out.reshape(B, Hq, T, D)

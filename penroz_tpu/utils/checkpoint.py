@@ -71,18 +71,14 @@ def save(model_id: str, data: dict, sync_flush: bool = False):
                          daemon=True).start()
 
 
-# Probed once at import, before any flush thread exists: os.umask is
-# process-global, so probing it per-call would race those threads.
-_UMASK = os.umask(0)
-os.umask(_UMASK)
-
-
 def _mkstemp_for(path: str):
-    """Unique temp sibling of ``path`` with umask-default permissions
-    (mkstemp's 0600 would make shm checkpoints unreadable cross-user)."""
+    """Unique temp sibling of ``path``, world-readable like a plain open()
+    write (mkstemp's 0600 would make shm checkpoints unreadable cross-user;
+    a fixed mode avoids probing the process-global umask, which would race
+    other threads)."""
     fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                     prefix=os.path.basename(path) + ".")
-    os.fchmod(fd, 0o666 & ~_UMASK)
+    os.fchmod(fd, 0o644)
     return fd, tmp_path
 
 
@@ -99,11 +95,12 @@ def _atomic_pickle(path: str, data: dict):
 
 
 def _flush(shm_path: str, durable_path: str):
-    # Unique temp name: overlapping flushes of the same model must not
-    # interleave writes into one file.
-    fd, tmp_path = _mkstemp_for(durable_path)
-    os.close(fd)
+    tmp_path = None
     try:
+        # Unique temp name: overlapping flushes of the same model must not
+        # interleave writes into one file.
+        fd, tmp_path = _mkstemp_for(durable_path)
+        os.close(fd)
         shutil.copyfile(shm_path, tmp_path)
         os.replace(tmp_path, durable_path)
         if not os.path.exists(shm_path):
@@ -111,10 +108,10 @@ def _flush(shm_path: str, durable_path: str):
             os.remove(durable_path)
             log.warning("Flush rolled back, model deleted: %s", durable_path)
     except FileNotFoundError:
-        # The model was deleted between the save and the flush; nothing to do.
+        # Model deleted (or workdir cleaned) between save and flush.
         log.warning("Flush skipped, source vanished: %s", shm_path)
     finally:
-        if os.path.exists(tmp_path):
+        if tmp_path is not None and os.path.exists(tmp_path):
             os.remove(tmp_path)
 
 

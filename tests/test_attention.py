@@ -126,3 +126,74 @@ def test_flash_gradient_path():
     g_vjp = vjp(jnp.ones((B, H, T, D)))
     for a, b in zip(g_ref, g_vjp):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_decode_kernel_matches_oracle_interpret():
+    """Pallas decode kernel (interpret) vs jnp cached_attention oracle at
+    several cache occupancies, incl. GQA and chunked (T>1) decode."""
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    rng = np.random.default_rng(2)
+    B, Hq, Hkv, D, S = 2, 4, 2, 64, 256
+    k_full = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    v_full = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    for offset, T in [(0, 8), (5, 1), (100, 4), (255, 1), (0, 1)]:
+        q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+        off = jnp.asarray(offset, jnp.int32)
+        length = jnp.asarray(offset + T, jnp.int32)
+        ref = A.cached_attention(q, k_full, v_full, off, length)
+        out = DA.decode_attention(q, k_full, v_full, off, length,
+                                  block_k=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5,
+                                   err_msg=f"offset={offset}, T={T}")
+
+
+def test_decode_kernel_single_kv_head_interpret():
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    rng = np.random.default_rng(3)
+    B, Hq, Hkv, D, S = 1, 1, 1, 128, 128
+    k_full = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    v_full = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(B, Hq, 1, D)).astype(np.float32))
+    ref = A.cached_attention(q, k_full, v_full, jnp.asarray(17),
+                             jnp.asarray(18))
+    out = DA.decode_attention(q, k_full, v_full, jnp.asarray(17),
+                              jnp.asarray(18), interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_decode_kernel_under_jit_interpret():
+    """The decode kernel must trace under jit with a traced offset (the
+    dispatch condition is static on shapes only)."""
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    rng = np.random.default_rng(4)
+    B, Hq, Hkv, D, S = 1, 2, 1, 64, 128
+    k_full = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    v_full = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(B, Hq, 1, D)).astype(np.float32))
+
+    @jax.jit
+    def f(q, k, v, off):
+        return DA.decode_attention(q, k, v, off, off + 1, interpret=True)
+
+    for off in (0, 63, 127):
+        ref = A.cached_attention(q, k_full, v_full, jnp.asarray(off),
+                                 jnp.asarray(off + 1))
+        np.testing.assert_allclose(np.asarray(f(q, k_full, v_full,
+                                                jnp.asarray(off, jnp.int32))),
+                                   np.asarray(ref), atol=2e-5)
+
+
+def test_kernel_gates_respect_platform_hint():
+    """A model placed on CPU must never dispatch TPU kernels, regardless of
+    the process default backend (regression: device='cpu' /train/ on a
+    TPU-attached host crashed with 'Only interpret mode is supported')."""
+    q = jnp.zeros((1, 2, 128, 64))
+    k = jnp.zeros((1, 2, 128, 64))
+    assert not A._use_flash(q, k, platform="cpu")
+    assert not A._use_flash_decode(q, k, platform="cpu")
+    assert A._use_flash(q, k, platform="tpu")
+    assert A._use_flash_decode(q, k, platform="tpu")
+    # oversized cache falls back even on TPU (VMEM bound)
+    k_big = jnp.zeros((1, 2, 32768, 64))
+    assert not A._use_flash_decode(q, k_big, platform="tpu")

@@ -57,6 +57,7 @@ _LEAF_ALGOS = {
     "dropout": M.Dropout,
     "attention": M.CausalSelfAttention,
     "gatedmlp": M.GatedMLP,
+    "moe": M.MixtureOfExperts,
 }
 
 _OPTIMIZERS = ("adamw", "adam", "sgd")

@@ -650,22 +650,24 @@ class NeuralNetworkModel:
         try:
             model = int(os.environ.get("PENROZ_MESH_MODEL", "1"))
             seq = int(os.environ.get("PENROZ_MESH_SEQUENCE", "1"))
+            expert = int(os.environ.get("PENROZ_MESH_EXPERT", "1"))
         except ValueError:
-            log.warning("Invalid PENROZ_MESH_MODEL/PENROZ_MESH_SEQUENCE; "
-                        "falling back to single device")
+            log.warning("Invalid PENROZ_MESH_MODEL/PENROZ_MESH_SEQUENCE/"
+                        "PENROZ_MESH_EXPERT; falling back to single device")
             return None
-        if model < 1 or seq < 1:
+        if model < 1 or seq < 1 or expert < 1:
             return None
         n = len(devices)
-        if n <= 1 or n % (model * seq):
+        if n <= 1 or n % (model * seq * expert):
             return None
-        data = n // (model * seq)
+        data = n // (model * seq * expert)
         if step_size % data or (seq > 1 and block_size % seq):
             log.info("Mesh fallback to single device: micro-batch %d / "
                      "sequence %d not divisible by data=%d / sequence=%d",
                      step_size, block_size, data, seq)
             return None
-        return mesh_lib.make_mesh(devices, model=model, sequence=seq)
+        return mesh_lib.make_mesh(devices, model=model, sequence=seq,
+                                  expert=expert)
 
     @classmethod
     def train_model_on_device(cls, model_id, device, dataset_id, shard,

@@ -138,12 +138,19 @@ def _tpu_platform(x, platform=None) -> bool:
     try:
         if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer):
             platform = next(iter(x.devices())).platform
-        elif jax.config.jax_default_device is not None:
-            platform = jax.config.jax_default_device.platform
         else:
-            platform = jax.default_backend()
+            dev = jax.config.jax_default_device
+            if dev is None:
+                platform = jax.default_backend()
+            elif isinstance(dev, str):  # modern JAX accepts platform strings
+                platform = dev
+            else:
+                platform = dev.platform
     except Exception:
-        return False
+        try:
+            platform = jax.default_backend()
+        except Exception:
+            return False
     return platform in ("tpu", "axon")
 
 

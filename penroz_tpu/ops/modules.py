@@ -455,13 +455,109 @@ class GatedMLP(Module):
                 ("down_proj", self.down_proj)]
 
     def _act(self, x):
-        if self.activation in ("silu", "swish"):
-            return jax.nn.silu(x)
-        return jax.nn.gelu(x, approximate=(self.activation == "gelu_pytorch_tanh"))
+        return _gated_activation(self.activation, x)
 
     def apply(self, x, ctx):
         gated = self._act(self.gate_proj.apply(x, ctx)) * self.up_proj.apply(x, ctx)
         return self.down_proj.apply(gated, ctx)
+
+
+def _gated_activation(name: str, x):
+    """silu / gelu / gelu_pytorch_tanh dispatch shared by the gated MLPs."""
+    if name in ("silu", "swish"):
+        return jax.nn.silu(x)
+    return jax.nn.gelu(x, approximate=(name == "gelu_pytorch_tanh"))
+
+
+class MixtureOfExperts(Module):
+    """Top-k routed mixture of gated-MLP experts (Mixtral/Switch style).
+
+    TPU-first layout: expert weights are *stacked* on a leading expert
+    dimension — ``experts.gate_proj.weight`` (E, H, D) etc. — so a single
+    einsum drives the MXU for every expert at once, and the expert dimension
+    shards over the mesh ``expert`` axis (expert parallelism: each device
+    computes its expert shard for all tokens; the top-k-weighted combine is
+    a contraction over E, which XLA turns into a psum over the axis).
+
+    Dispatch is dense: every expert processes every token and non-selected
+    contributions are zeroed by the router weights.  That keeps every shape
+    static for XLA (no token dropping, no capacity factor) at the cost of
+    E/top_k× extra MLP FLOPs — the right trade below ~16 experts; an
+    all_to_all token-dispatch path is the upgrade for larger E.
+
+    No reference equivalent (the reference has no MoE; nearest is GatedMLP,
+    neural_net_layers.py:158-174) — this is a capability extension wired
+    into the same DSL registry.
+    """
+
+    def __init__(self, in_features: int, intermediate_size: int,
+                 num_experts: int, top_k: int = 2, bias: bool = False,
+                 activation: str = "silu"):
+        if top_k < 1 or top_k > num_experts:
+            raise ValueError(f"top_k={top_k} outside [1, {num_experts}]")
+        if bias:
+            raise ValueError("MixtureOfExperts does not support bias yet")
+        self.in_features = int(in_features)
+        self.intermediate_size = int(intermediate_size)
+        self.num_experts = int(num_experts)
+        self.top_k = int(top_k)
+        self.activation = activation
+
+    def param_shapes(self):
+        d, h, e = self.in_features, self.intermediate_size, self.num_experts
+        return {
+            "router.weight": (e, d),
+            "experts.gate_proj.weight": (e, h, d),
+            "experts.up_proj.weight": (e, h, d),
+            "experts.down_proj.weight": (e, d, h),
+        }
+
+    def init(self, rng):
+        d = self.in_features
+        keys = jax.random.split(rng, 4)
+        bound = 1.0 / math.sqrt(d)
+        bound_h = 1.0 / math.sqrt(self.intermediate_size)
+        shapes = self.param_shapes()
+        return {
+            self.key("router.weight"):
+                _uniform(keys[0], shapes["router.weight"], bound),
+            self.key("experts.gate_proj.weight"):
+                _uniform(keys[1], shapes["experts.gate_proj.weight"], bound),
+            self.key("experts.up_proj.weight"):
+                _uniform(keys[2], shapes["experts.up_proj.weight"], bound),
+            self.key("experts.down_proj.weight"):
+                _uniform(keys[3], shapes["experts.down_proj.weight"], bound_h),
+        }
+
+    def _act(self, x):
+        return _gated_activation(self.activation, x)
+
+    def router_weights(self, x, ctx):
+        """(B, T, E) combine weights: softmax → top-k → renormalize.
+
+        Routing runs entirely in fp32 — logits einsum included: bf16
+        rounding before the (monotonic) softmax still flips expert choices
+        on near-tie tokens."""
+        router = ctx.params[self.key("router.weight")]
+        logits = jnp.einsum("btd,ed->bte", x.astype(jnp.float32),
+                            router.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_vals, top_idx = jax.lax.top_k(probs, self.top_k)
+        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+        one_hot = jax.nn.one_hot(top_idx, self.num_experts,
+                                 dtype=jnp.float32)  # (B, T, k, E)
+        return jnp.einsum("btk,btke->bte", top_vals, one_hot)
+
+    def apply(self, x, ctx):
+        w_gate = self._p(ctx, "experts.gate_proj.weight")
+        w_up = self._p(ctx, "experts.up_proj.weight")
+        w_down = self._p(ctx, "experts.down_proj.weight")
+        weights = self.router_weights(x, ctx).astype(x.dtype)
+        g = jnp.einsum("btd,ehd->bteh", x, w_gate)
+        u = jnp.einsum("btd,ehd->bteh", x, w_up)
+        hidden = self._act(g) * u
+        y = jnp.einsum("bteh,edh->bted", hidden, w_down)
+        return jnp.einsum("bted,bte->btd", y, weights)
 
 
 # ---------------------------------------------------------------------------

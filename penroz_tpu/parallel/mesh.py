@@ -9,6 +9,9 @@ neural_net_model.py:609, ddp.py:80-85).  Axes:
                   replicated params + sharded batch force a psum.
 - ``model``     — tensor parallelism for weight matrices (TP).
 - ``sequence``  — context/sequence parallelism for long sequences (SP).
+- ``expert``    — expert parallelism for MoE layers (EP): stacked expert
+                  weights shard their leading E dim; the top-k combine is a
+                  contraction over E that XLA lowers to a psum on the axis.
 
 Single-device training uses a trivial 1-device mesh so the code path is
 identical everywhere.
@@ -28,23 +31,26 @@ log = logging.getLogger(__name__)
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "sequence"
+EXPERT_AXIS = "expert"
 
 
 def make_mesh(devices=None, *, data: Optional[int] = None, model: int = 1,
-              sequence: int = 1) -> Mesh:
-    """Build a (data, model, sequence) mesh over the given (default: all)
-    devices.  ``data`` defaults to whatever is left after model × sequence."""
+              sequence: int = 1, expert: int = 1) -> Mesh:
+    """Build a (data, model, sequence, expert) mesh over the given (default:
+    all) devices.  ``data`` defaults to whatever is left after the others."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    denom = model * sequence * expert
     if data is None:
-        if n % (model * sequence) != 0:
+        if n % denom != 0:
             raise ValueError(f"{n} devices not divisible by model={model} × "
-                             f"sequence={sequence}")
-        data = n // (model * sequence)
-    if data * model * sequence != n:
-        raise ValueError(f"mesh {data}×{model}×{sequence} != {n} devices")
-    arr = np.array(devices).reshape(data, model, sequence)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+                             f"sequence={sequence} × expert={expert}")
+        data = n // denom
+    if data * denom != n:
+        raise ValueError(f"mesh {data}×{model}×{sequence}×{expert} != {n} "
+                         "devices")
+    arr = np.array(devices).reshape(data, model, sequence, expert)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS))
 
 
 def batch_sharding(mesh: Mesh, batch_ndim: int = 2) -> NamedSharding:

@@ -10,7 +10,8 @@ the Megatron column→row pairing using weight geometry:
 - contracting Linear weights (out < in: attention proj, MLP down) are
   row-parallel — shard the in dim;
 - square weights and vectors are replicated;
-- embedding tables shard the vocab dim.
+- embedding tables shard the vocab dim;
+- stacked MoE expert weights (E, ., .) shard E over the ``expert`` axis.
 
 Sequence parallelism: the batch's time dimension is sharded over the
 ``sequence`` axis; XLA gathers K/V for full attention (ring attention as a
@@ -22,7 +23,8 @@ from __future__ import annotations
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from penroz_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from penroz_tpu.parallel.mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS,
+                                      SEQ_AXIS)
 
 
 def _divides(dim: int, mesh: Mesh, axis: str) -> bool:
@@ -31,6 +33,11 @@ def _divides(dim: int, mesh: Mesh, axis: str) -> bool:
 
 def param_spec(key: str, shape: tuple, mesh: Mesh) -> P:
     """PartitionSpec for one flat-dict parameter."""
+    if len(shape) == 3 and ".experts." in key:
+        # Stacked MoE expert weights (E, ., .): expert-parallel on dim 0.
+        if _divides(shape[0], mesh, EXPERT_AXIS):
+            return P(EXPERT_AXIS, None, None)
+        return P()
     if len(shape) != 2:
         return P()
     out_dim, in_dim = shape

@@ -20,6 +20,7 @@ import platform
 import shutil
 import tempfile
 import threading
+import uuid
 
 log = logging.getLogger(__name__)
 
@@ -72,14 +73,22 @@ def save(model_id: str, data: dict, sync_flush: bool = False):
 
 
 def _mkstemp_for(path: str):
-    """Unique temp sibling of ``path``, world-readable like a plain open()
-    write (mkstemp's 0600 would make shm checkpoints unreadable cross-user;
-    a fixed mode avoids probing the process-global umask, which would race
-    other threads)."""
-    fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
-                                    prefix=os.path.basename(path) + ".")
-    os.fchmod(fd, 0o644)
-    return fd, tmp_path
+    """Unique temp sibling of ``path`` with plain-open() permissions.
+
+    ``os.open(..., 0o666)`` lets the kernel apply the process umask at
+    creation — same result as ``open(path, "wb")`` (which the write path
+    used before temp files), without mkstemp's 0600 (unreadable cross-user)
+    and without probing the process-global umask (racy under threads)."""
+    directory = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    while True:
+        tmp_path = os.path.join(directory, f"{base}.{uuid.uuid4().hex[:12]}")
+        try:
+            fd = os.open(tmp_path,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
+            return fd, tmp_path
+        except FileExistsError:
+            continue
 
 
 def _atomic_pickle(path: str, data: dict):

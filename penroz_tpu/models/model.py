@@ -628,8 +628,9 @@ class NeuralNetworkModel:
 
         Data-parallelism over every local device is automatic when the
         micro-batch divides the data axis; ``PENROZ_MESH_MODEL`` /
-        ``PENROZ_MESH_SEQUENCE`` carve tensor/sequence-parallel axes out of
-        the same device set, and ``PENROZ_TRAIN_MESH=0`` disables meshing.
+        ``PENROZ_MESH_SEQUENCE`` / ``PENROZ_MESH_EXPERT`` carve tensor/
+        sequence/expert-parallel axes out of the same device set, and
+        ``PENROZ_TRAIN_MESH=0`` disables meshing.
         This replaces the reference's per-request DDP process tree
         (ddp.py:38-73) — the mesh lives inside one compiled program.
         """

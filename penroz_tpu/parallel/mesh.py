@@ -12,6 +12,9 @@ neural_net_model.py:609, ddp.py:80-85).  Axes:
 - ``expert``    — expert parallelism for MoE layers (EP): stacked expert
                   weights shard their leading E dim; the top-k combine is a
                   contraction over E that XLA lowers to a psum on the axis.
+- ``pipe``      — pipeline parallelism (PP): stacked transformer-block
+                  params shard their leading layer dim; microbatches stream
+                  between stages via ppermute (parallel/pipeline.py).
 
 Single-device training uses a trivial 1-device mesh so the code path is
 identical everywhere.
@@ -32,25 +35,28 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "sequence"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 
 
 def make_mesh(devices=None, *, data: Optional[int] = None, model: int = 1,
-              sequence: int = 1, expert: int = 1) -> Mesh:
-    """Build a (data, model, sequence, expert) mesh over the given (default:
-    all) devices.  ``data`` defaults to whatever is left after the others."""
+              sequence: int = 1, expert: int = 1, pipe: int = 1) -> Mesh:
+    """Build a (data, model, sequence, expert, pipe) mesh over the given
+    (default: all) devices.  ``data`` defaults to whatever is left over."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    denom = model * sequence * expert
+    denom = model * sequence * expert * pipe
     if data is None:
         if n % denom != 0:
             raise ValueError(f"{n} devices not divisible by model={model} × "
-                             f"sequence={sequence} × expert={expert}")
+                             f"sequence={sequence} × expert={expert} × "
+                             f"pipe={pipe}")
         data = n // denom
     if data * denom != n:
-        raise ValueError(f"mesh {data}×{model}×{sequence}×{expert} != {n} "
-                         "devices")
-    arr = np.array(devices).reshape(data, model, sequence, expert)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS))
+        raise ValueError(f"mesh {data}×{model}×{sequence}×{expert}×{pipe} "
+                         f"!= {n} devices")
+    arr = np.array(devices).reshape(data, model, sequence, expert, pipe)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS,
+                      PIPE_AXIS))
 
 
 def batch_sharding(mesh: Mesh, batch_ndim: int = 2) -> NamedSharding:

@@ -1,0 +1,143 @@
+"""Pipeline parallelism: GPipe schedule over a ``pipe`` mesh axis.
+
+The repeated transformer blocks of a DSL stack are *stacked* on a leading
+layer dimension and sharded over the ``pipe`` axis — each stage (device
+group) holds ``L / P`` consecutive blocks.  Microbatches stream through the
+stages inside one ``shard_map``-compiled program: every schedule tick, each
+stage applies its blocks (a ``lax.scan`` over its stacked shard) and hands
+its activation to the next stage with ``lax.ppermute`` over ICI.  The
+pipeline bubble is the standard GPipe ``(P-1)/(M+P-1)`` and invalid
+in-flight activations are masked at the output buffer, never observed.
+
+The whole schedule is differentiable (``ppermute`` has a transpose), so the
+same function sits under ``jax.grad`` for pipeline-parallel training.
+
+No reference equivalent (the reference's only strategy is single-node DDP,
+SURVEY.md §2.4) — this is capability extension shaped by the mesh design:
+PP is a sharding of the *depth* dimension the way TP shards width.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from penroz_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+
+
+def stack_block_params(params: dict, block_indices, prefix="layers") -> dict:
+    """Stack per-block params ``layers.{i}.<suffix>`` into ``(L, ...)`` leaves.
+
+    ``block_indices`` must name structurally identical DSL entries (same
+    suffix set and shapes) — the usual repeated transformer blocks.
+    Returns ``{suffix: stacked}``.
+    """
+    first = f"{prefix}.{block_indices[0]}."
+    suffixes = [k[len(first):] for k in params if k.startswith(first)]
+    if not suffixes:
+        raise ValueError(f"no params under {first}")
+    stacked = {}
+    for suffix in suffixes:
+        leaves = [params[f"{prefix}.{i}.{suffix}"] for i in block_indices]
+        stacked[suffix] = jnp.stack(leaves)
+    return stacked
+
+
+def unstack_block_params(stacked: dict, block_indices, prefix="layers") -> dict:
+    """Inverse of :func:`stack_block_params`."""
+    out = {}
+    for suffix, leaf in stacked.items():
+        for j, i in enumerate(block_indices):
+            out[f"{prefix}.{i}.{suffix}"] = leaf[j]
+    return out
+
+
+def gpipe_spec(mesh):
+    """(stacked-params spec, microbatch spec, output spec) for gpipe_apply."""
+    param_spec = P(PIPE_AXIS)
+    mb_spec = P(None, DATA_AXIS)     # (M, B_mb, T, D): batch over data
+    return param_spec, mb_spec, mb_spec
+
+
+def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
+                num_microbatches: int):
+    """Apply ``L`` stacked blocks to ``x`` with a ``P``-stage GPipe schedule.
+
+    ``block_fn(block_params: dict, h) -> h`` applies ONE block given its
+    un-stacked param dict.  ``stacked_params`` leaves carry a leading ``L``
+    dim with ``L % P == 0``; ``x`` is ``(B, T, D)`` with
+    ``B % num_microbatches == 0``.  Output equals applying the ``L`` blocks
+    sequentially (same math, pipelined schedule).
+    """
+    pipe = mesh.shape[PIPE_AXIS]
+    num_layers = next(iter(stacked_params.values())).shape[0]
+    if num_layers % pipe:
+        raise ValueError(f"{num_layers} blocks not divisible by pipe={pipe}")
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"microbatches={num_microbatches}")
+    mbs = x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:])
+    m = num_microbatches
+
+    param_spec, mb_spec, out_spec = gpipe_spec(mesh)
+    in_specs = (jax.tree.map(lambda _: param_spec, stacked_params), mb_spec)
+
+    def stage_fn(params_stage, mbs_local):
+        stage = jax.lax.axis_index(PIPE_AXIS)
+
+        def apply_blocks(h):
+            h, _ = jax.lax.scan(
+                lambda hh, pl: (block_fn(pl, hh), None), h, params_stage)
+            return h
+
+        def tick(carry, t):
+            state, buf = carry
+            # Stage 0 ingests a fresh microbatch; others consume the
+            # activation handed over by the previous stage last tick.
+            feed = mbs_local[jnp.clip(t, 0, m - 1)]
+            h = apply_blocks(jnp.where(stage == 0, feed, state))
+            # Stage s works on microbatch t - s; the last stage commits it.
+            out_mb = t - stage
+            valid = (out_mb >= 0) & (out_mb < m) & (stage == pipe - 1)
+            committed = buf.at[jnp.clip(out_mb, 0, m - 1)].set(h)
+            buf = jnp.where(valid, committed, buf)
+            state = jax.lax.ppermute(
+                h, PIPE_AXIS, [(i, (i + 1) % pipe) for i in range(pipe)])
+            return (state, buf), None
+
+        # The carry is device-varying over both `data` (inherited from the
+        # sharded microbatches via zeros_like) and `pipe` (each stage's state
+        # diverges after the first ppermute); the zero init must match.
+        zero_buf = jax.lax.pvary(jnp.zeros_like(mbs_local), (PIPE_AXIS,))
+        zero_state = zero_buf[0]
+        (_, buf), _ = jax.lax.scan(tick, (zero_state, zero_buf),
+                                   jnp.arange(m + pipe - 1))
+        # Only the last stage holds real outputs; broadcast them to all.
+        mine = jnp.where(stage == pipe - 1, buf, jnp.zeros_like(buf))
+        return jax.lax.psum(mine, PIPE_AXIS)
+
+    out = shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_spec)(stacked_params, mbs)
+    return out.reshape(batch, *x.shape[1:])
+
+
+def block_fn_from_arch(arch, block_index: int):
+    """``block_fn`` for :func:`gpipe_apply` from one bound DSL block module.
+
+    Uses the module tree of block ``block_index`` with params rebound from
+    the un-stacked leaf dict (all stacked blocks are structurally identical,
+    so one module tree serves every layer).
+    """
+    from penroz_tpu.ops import modules as M
+    mod = arch.mods[block_index]
+    prefix = f"layers.{block_index}."
+
+    def block_fn(block_params: dict, h):
+        ctx = M.Ctx({prefix + suffix: leaf
+                     for suffix, leaf in block_params.items()})
+        return mod.apply(h, ctx)
+
+    return block_fn

@@ -76,16 +76,20 @@ def _mkstemp_for(path: str):
     """Unique temp sibling of ``path`` with plain-open() permissions.
 
     ``os.open(..., 0o666)`` lets the kernel apply the process umask at
-    creation — same result as ``open(path, "wb")`` (which the write path
-    used before temp files), without mkstemp's 0600 (unreadable cross-user)
-    and without probing the process-global umask (racy under threads)."""
+    creation — the same semantics as the reference's plain ``open(path,
+    "wb")`` writes (neural_net_model.py:116): a permissive umask yields
+    cross-user-readable shm checkpoints, a hardened one keeps them private.
+    Avoids both mkstemp's unconditional 0600 and probing the process-global
+    umask (racy under threads).  O_CLOEXEC keeps the fd out of spawned
+    subprocesses."""
     directory = os.path.dirname(path) or "."
     base = os.path.basename(path)
     while True:
         tmp_path = os.path.join(directory, f"{base}.{uuid.uuid4().hex[:12]}")
         try:
             fd = os.open(tmp_path,
-                         os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY | os.O_CLOEXEC,
+                         0o666)
             return fd, tmp_path
         except FileExistsError:
             continue

@@ -17,11 +17,14 @@ def test_virtual_device_count(cpu_devices):
 
 def test_make_mesh_shapes(cpu_devices):
     mesh = mesh_lib.make_mesh(cpu_devices)
-    assert mesh.shape == {"data": 8, "model": 1, "sequence": 1, "expert": 1}
+    assert mesh.shape == {"data": 8, "model": 1, "sequence": 1, "expert": 1,
+                          "pipe": 1}
     mesh = mesh_lib.make_mesh(cpu_devices, model=2, sequence=2)
-    assert mesh.shape == {"data": 2, "model": 2, "sequence": 2, "expert": 1}
-    mesh = mesh_lib.make_mesh(cpu_devices, model=2, expert=2)
-    assert mesh.shape == {"data": 2, "model": 2, "sequence": 1, "expert": 2}
+    assert mesh.shape == {"data": 2, "model": 2, "sequence": 2, "expert": 1,
+                          "pipe": 1}
+    mesh = mesh_lib.make_mesh(cpu_devices, model=2, expert=2, pipe=2)
+    assert mesh.shape == {"data": 1, "model": 2, "sequence": 1, "expert": 2,
+                          "pipe": 2}
     with pytest.raises(ValueError):
         mesh_lib.make_mesh(cpu_devices, model=3)
 

@@ -18,58 +18,17 @@ from __future__ import annotations
 import json
 import logging
 import os
-import subprocess
-import sysconfig
 from collections import Counter, defaultdict
 
 log = logging.getLogger(__name__)
 
 FORMAT = "penroz-bpe"
 
-_native_module = None
-_native_failed = False
-
-
-def _source_path() -> str:
-    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    return os.path.join(repo_root, "native", "penroz_bpe.cpp")
-
-
-def _build_native() -> str:
-    """Compile the extension next to this module (cached by mtime)."""
-    src = _source_path()
+def _load_native():
+    from penroz_tpu.utils import native_build
     out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "_native")
-    os.makedirs(out_dir, exist_ok=True)
-    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    so_path = os.path.join(out_dir, f"penroz_bpe{suffix}")
-    if (os.path.exists(so_path)
-            and os.path.getmtime(so_path) >= os.path.getmtime(src)):
-        return so_path
-    include = sysconfig.get_paths()["include"]
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", f"-I{include}",
-           src, "-o", so_path]
-    subprocess.run(cmd, check=True, capture_output=True)
-    return so_path
-
-
-def _load_native():
-    global _native_module, _native_failed
-    if _native_module is not None or _native_failed:
-        return _native_module
-    try:
-        import importlib.util
-        so_path = _build_native()
-        spec = importlib.util.spec_from_file_location("penroz_bpe", so_path)
-        module = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(module)
-        _native_module = module
-    except Exception as e:  # noqa: BLE001
-        log.warning("Native BPE core unavailable (%s); using Python fallback",
-                    e)
-        _native_failed = True
-    return _native_module
+    return native_build.load_extension("penroz_bpe", out_dir)
 
 
 # ---------------------------------------------------------------------------

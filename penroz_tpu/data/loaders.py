@@ -17,6 +17,7 @@ The TPU-native equivalent of the reference's ``loaders.py``:
 from __future__ import annotations
 
 import glob
+import logging
 import os
 from concurrent.futures import ThreadPoolExecutor
 
@@ -24,7 +25,27 @@ import numpy as np
 
 from penroz_tpu.data.tokenizers import Tokenizer
 
+log = logging.getLogger(__name__)
+
 DATA_FOLDER = "data"
+NATIVE_LOADER_ENV = "PENROZ_NATIVE_LOADER"
+
+
+def _native_loader_module():
+    if os.environ.get(NATIVE_LOADER_ENV, "1") == "0":
+        return None
+    from penroz_tpu.utils import native_build
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "_native")
+    return native_build.load_extension("penroz_loader", out_dir)
+
+
+def _npy_payload(path: str):
+    """(byte offset, token count) of a uint16 1-D .npy payload, or None."""
+    m = np.load(path, mmap_mode="r")
+    if m.dtype != np.uint16 or m.ndim != 1:
+        return None
+    return int(m.offset), int(m.shape[0])
 
 
 class Loader:
@@ -38,6 +59,9 @@ class Loader:
         self.idx_offset = int(idx_offset if idx_offset is not None
                               else buffer_size)
         self._cache: dict[int, np.ndarray] = {}
+        self._stream = None          # native mmap stream (penroz_loader)
+        self._stream_sig: list[tuple] = []   # (name, size, mtime_ns) per shard
+        self._prefix: list[int] = []
 
     def _files(self) -> list[str]:
         pattern = os.path.join(DATA_FOLDER, f"{self.dataset_id}_*.npy")
@@ -50,6 +74,9 @@ class Loader:
         for name in self._files():
             os.remove(os.path.join(DATA_FOLDER, name))
         self._cache.clear()
+        # Drop the mmap stream too: a re-download reusing the same shard
+        # filenames must not serve the deleted files' pages.
+        self._stream, self._stream_sig, self._prefix = None, [], []
 
     def _shard_data(self, files: list[str], shard_idx: int) -> np.ndarray:
         shard_idx %= len(files)
@@ -62,6 +89,41 @@ class Loader:
             self._cache[shard_idx] = data
         return data
 
+    def _native_stream(self, files: list[str]):
+        """mmap-backed token stream over ``files``; None → numpy fallback.
+
+        Rebuilt whenever any shard's (name, size, mtime) changes — new
+        shards from a concurrent Downloader, or same-name rewrites after a
+        delete + re-download."""
+        try:
+            sig = [(name, st.st_size, st.st_mtime_ns) for name, st in
+                   ((n, os.stat(os.path.join(DATA_FOLDER, n)))
+                    for n in files)]
+        except OSError:
+            return None
+        if sig == self._stream_sig:
+            return self._stream
+        self._stream, self._stream_sig = None, sig
+        module = _native_loader_module()
+        if module is None:
+            return None
+        shards, prefix, total = [], [], 0
+        try:
+            for name in files:
+                path = os.path.join(DATA_FOLDER, name)
+                payload = _npy_payload(path)
+                if payload is None:
+                    return None  # non-uint16 shard: numpy path handles it
+                prefix.append(total)
+                total += payload[1]
+                shards.append((path, payload[0], payload[1]))
+            self._stream = module.Stream(shards)
+            self._prefix = prefix
+        except Exception as e:  # noqa: BLE001
+            log.warning("Native loader failed (%s); using numpy path", e)
+            self._stream = None
+        return self._stream
+
     def next_batch(self, target_offset: int = 1):
         """(input, target) flat int32 arrays of ``buffer_size`` tokens;
         target is input shifted by ``target_offset`` (None when 0)."""
@@ -69,6 +131,28 @@ class Loader:
         if not files:
             raise ValueError(f"Dataset {self.dataset_id} has no shards")
         need = self.buffer_size + target_offset
+        stream = self._native_stream(files)
+        if stream is not None:
+            # (shard, idx) → linear stream position, then fold the state
+            # back to normalized (shard, idx) exactly as the fallback's
+            # shard-walk would — both paths must hold identical state so a
+            # mid-run path switch or shard-list change never shifts the
+            # window (ranks on different toolchains read the same data).
+            pos = (self._prefix[self.shard % len(files)]
+                   + self.idx) % stream.total_tokens
+            self.shard = max(i for i, p in enumerate(self._prefix)
+                             if p <= pos)
+            self.idx = pos - self._prefix[self.shard]
+            buf = np.empty(need, np.int32)
+            stream.gather_into(buf, pos, need)
+            x = buf[:self.buffer_size]
+            # y copies: x and y must not alias one buffer (the fallback
+            # returns independent arrays; mutation semantics must match).
+            y = (buf[target_offset:target_offset + self.buffer_size].copy()
+                 if target_offset else None)
+            self.idx += self.idx_offset
+            stream.prefetch(pos + self.idx_offset, need)
+            return x, y
         self.shard %= len(files)
         data = self._shard_data(files, self.shard)
         while self.idx >= len(data):

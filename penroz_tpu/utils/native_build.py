@@ -1,0 +1,62 @@
+"""On-demand g++ builds of the framework's CPython extension cores.
+
+Each native component (``native/*.cpp``) is compiled once into
+``penroz_tpu/<pkg>/_native/`` and cached by source mtime — no setuptools
+invocation, no pybind11; plain CPython API extensions.  Callers treat a
+build/import failure as "native unavailable" and fall back to their Python
+implementation, so a missing toolchain degrades performance, not features.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+
+log = logging.getLogger(__name__)
+
+_modules: dict[str, object] = {}
+_failed: set[str] = set()
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_extension(name: str, out_dir: str) -> str:
+    """Compile ``native/{name}.cpp`` → ``{out_dir}/{name}{EXT_SUFFIX}``."""
+    src = os.path.join(_repo_root(), "native", f"{name}.cpp")
+    os.makedirs(out_dir, exist_ok=True)
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so_path = os.path.join(out_dir, f"{name}{suffix}")
+    if (os.path.exists(so_path)
+            and os.path.getmtime(so_path) >= os.path.getmtime(src)):
+        return so_path
+    include = sysconfig.get_paths()["include"]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", f"-I{include}",
+           src, "-o", so_path]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return so_path
+
+
+def load_extension(name: str, out_dir: str):
+    """Build + import a native core; None when the toolchain is missing."""
+    if name in _modules:
+        return _modules[name]
+    if name in _failed:
+        return None
+    try:
+        so_path = build_extension(name, out_dir)
+        spec = importlib.util.spec_from_file_location(name, so_path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        _modules[name] = module
+        return module
+    except Exception as e:  # noqa: BLE001
+        log.warning("Native core %s unavailable (%s); using Python fallback",
+                    name, e)
+        _failed.add(name)
+        return None

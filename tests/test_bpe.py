@@ -76,8 +76,7 @@ def test_tokenizer_facade(trained, tmp_path):
 
 
 def test_python_fallback_when_native_missing(monkeypatch):
-    monkeypatch.setattr(bpe_mod, "_native_module", None)
-    monkeypatch.setattr(bpe_mod, "_native_failed", True)
+    monkeypatch.setattr(bpe_mod, "_load_native", lambda: None)
     bpe = ByteBPE.train_from_text("aaa bbb aaa bbb aaa", vocab_size=260)
     assert not bpe.native
     assert bpe.decode(bpe.encode("aaa bbb")) == "aaa bbb"

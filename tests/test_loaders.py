@@ -89,3 +89,121 @@ def test_loader_ignores_other_datasets(shard_dir):
     _write_shards(shard_dir, "aaa", [10])
     _write_shards(shard_dir, "bbb", [10])
     assert loaders.Loader("aaa").list() == ["aaa_000000.npy"]
+
+
+# -- native mmap stream -----------------------------------------------------
+
+def _make_shards(tmp_path, sizes, dataset="nat"):
+    import numpy as np, os
+    data_dir = tmp_path / "data"
+    data_dir.mkdir(exist_ok=True)
+    start = 0
+    for i, size in enumerate(sizes):
+        arr = (np.arange(start, start + size) % 65536).astype(np.uint16)
+        np.save(data_dir / f"{dataset}_{i:06d}", arr)
+        start += size
+    return dataset
+
+
+def test_native_stream_matches_numpy_fallback(workdir, monkeypatch):
+    """Every batch from the native mmap stream == the numpy shard-walk,
+    across shard boundaries and end-of-stream wraparound."""
+    from penroz_tpu.data.loaders import Loader, _native_loader_module
+    if _native_loader_module() is None:
+        import pytest
+        pytest.skip("native loader unavailable")
+    dataset = _make_shards(workdir, [100, 70, 30])
+    monkeypatch.setenv("PENROZ_NATIVE_LOADER", "0")
+    fallback = Loader(dataset, buffer_size=64)
+    expected = [fallback.next_batch() for _ in range(8)]
+    monkeypatch.delenv("PENROZ_NATIVE_LOADER")
+    native = Loader(dataset, buffer_size=64)
+    for xf, yf in expected:  # 8 × 64 > 200 tokens → wraps the stream
+        xn, yn = native.next_batch()
+        np.testing.assert_array_equal(xn, xf)
+        np.testing.assert_array_equal(yn, yf)
+    assert native._stream is not None  # really took the native path
+    assert fallback._stream is None
+
+
+def test_native_stream_rank_strided(workdir, monkeypatch):
+    from penroz_tpu.data.loaders import Loader, _native_loader_module
+    if _native_loader_module() is None:
+        import pytest
+        pytest.skip("native loader unavailable")
+    dataset = _make_shards(workdir, [128, 128])
+    # two "ranks" with disjoint strided windows
+    for rank in range(2):
+        monkeypatch.setenv("PENROZ_NATIVE_LOADER", "0")
+        fallback = Loader(dataset, begin_idx=32 * rank, buffer_size=32,
+                          idx_offset=64)
+        expected = [fallback.next_batch()[0] for _ in range(6)]
+        monkeypatch.delenv("PENROZ_NATIVE_LOADER")
+        native = Loader(dataset, begin_idx=32 * rank, buffer_size=32,
+                        idx_offset=64)
+        for xf in expected:
+            xn, _ = native.next_batch()
+            np.testing.assert_array_equal(xn, xf)
+
+
+def test_native_stream_picks_up_new_shards(workdir):
+    """A shard appended mid-stream (concurrent Downloader) is seen on the
+    next batch — the stream rebuilds when the file list changes."""
+    from penroz_tpu.data.loaders import Loader, _native_loader_module
+    if _native_loader_module() is None:
+        import pytest
+        pytest.skip("native loader unavailable")
+    dataset = _make_shards(workdir, [64])
+    loader = Loader(dataset, buffer_size=32)
+    loader.next_batch()
+    total_before = loader._stream.total_tokens if loader._stream else 0
+    _make_shards(workdir, [64, 64], dataset=dataset)  # rewrites 0, adds 1
+    loader.next_batch()
+    assert loader._stream.total_tokens == 128
+    assert total_before == 64
+
+
+def test_native_state_survives_shard_append_after_wrap(workdir, monkeypatch):
+    """Regression: after the stream wraps, appending a shard must yield the
+    same next batch on native and fallback paths (normalized state)."""
+    from penroz_tpu.data.loaders import Loader, _native_loader_module
+    if _native_loader_module() is None:
+        import pytest
+        pytest.skip("native loader unavailable")
+
+    def run(native: bool):
+        if native:
+            monkeypatch.delenv("PENROZ_NATIVE_LOADER", raising=False)
+        else:
+            monkeypatch.setenv("PENROZ_NATIVE_LOADER", "0")
+        for f in (workdir / "data").glob("wrp_*.npy"):
+            f.unlink()
+        _make_shards(workdir, [100], dataset="wrp")
+        loader = Loader("wrp", buffer_size=64)
+        for _ in range(5):  # wraps several times
+            loader.next_batch()
+        _make_shards(workdir, [100, 50], dataset="wrp")  # append a shard
+        return loader.next_batch()[0]
+
+    np.testing.assert_array_equal(run(native=True), run(native=False))
+
+
+def test_native_stream_not_stale_after_delete(workdir):
+    """Regression: delete + re-download with identical filenames must not
+    serve the deleted files' mmapped pages."""
+    from penroz_tpu.data.loaders import Loader, _native_loader_module
+    if _native_loader_module() is None:
+        import pytest
+        pytest.skip("native loader unavailable")
+    _make_shards(workdir, [64], dataset="del")
+    loader = Loader("del", buffer_size=32)
+    first, _ = loader.next_batch()
+    loader.delete()
+    import numpy as _np
+    data_dir = workdir / "data"
+    _np.save(data_dir / "del_000000",
+             _np.full(64, 7, _np.uint16))  # same name, new content
+    loader.shard = loader.idx = 0
+    fresh, _ = loader.next_batch()
+    assert (np.asarray(fresh) == 7).all()
+    assert not np.array_equal(first, fresh)

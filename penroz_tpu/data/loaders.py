@@ -192,9 +192,16 @@ class Downloader:
 
         def flush(upto: int):
             nonlocal shard_idx
-            np.save(os.path.join(
-                DATA_FOLDER, f"{self.dataset_id}_{shard_idx:06d}"),
-                buffer[:upto])
+            # Atomic publish: write to a temp name and os.replace.  A
+            # re-download must never truncate a shard inode that a live
+            # Loader has mmapped (penroz_loader) — replace swaps the
+            # directory entry and the old inode stays valid until unmapped.
+            final = os.path.join(DATA_FOLDER,
+                                 f"{self.dataset_id}_{shard_idx:06d}.npy")
+            tmp = final + ".tmp"
+            with open(tmp, "wb") as f:  # np.save on a file object: no
+                np.save(f, buffer[:upto])  # surprise .npy suffix appended
+            os.replace(tmp, final)
             shard_idx += 1
 
         workers = max(1, (os.cpu_count() or 2) // 2)

@@ -341,10 +341,28 @@ def create_app() -> web.Application:
     return app
 
 
-def main(host: str = "127.0.0.1", port: int = 8000):  # pragma: no cover
+def _configure_logging():  # pragma: no cover
+    """dictConfig from PENROZ_LOG_CONFIG (reference: main.py:503-506 loads
+    log_config.json into uvicorn); fallback: basicConfig with the same
+    processName-bearing format for DDP-style visibility."""
+    config_path = os.environ.get("PENROZ_LOG_CONFIG")
+    if config_path and os.path.exists(config_path):
+        import json as _json
+        import logging.config
+        with open(config_path) as f:
+            logging.config.dictConfig(_json.load(f))
+        return
+    if config_path:
+        import sys
+        print(f"WARNING: PENROZ_LOG_CONFIG={config_path!r} does not exist; "
+              "falling back to basicConfig", file=sys.stderr)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s [%(processName)s] %(name)s: %(message)s")
+
+
+def main(host: str = "127.0.0.1", port: int = 8000):  # pragma: no cover
+    _configure_logging()
     from penroz_tpu.parallel import dist
     dist.initialize()
     web.run_app(create_app(), host=host, port=port)

@@ -14,6 +14,7 @@ import logging
 import os
 import subprocess
 import sysconfig
+import threading
 
 log = logging.getLogger(__name__)
 
@@ -36,9 +37,17 @@ def build_extension(name: str, out_dir: str) -> str:
             and os.path.getmtime(so_path) >= os.path.getmtime(src)):
         return so_path
     include = sysconfig.get_paths()["include"]
+    # Unique temp output + atomic rename: concurrent first-touch builders
+    # (two training threads) must never dlopen a half-written .so.
+    tmp_path = f"{so_path}.{os.getpid()}.{threading.get_ident()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", f"-I{include}",
-           src, "-o", so_path]
-    subprocess.run(cmd, check=True, capture_output=True)
+           src, "-o", tmp_path]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp_path, so_path)
+    finally:
+        if os.path.exists(tmp_path):
+            os.remove(tmp_path)
     return so_path
 
 

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Bootstrap a venv and serve on all interfaces (TPU VM deployment).
+set -e
+if [ ! -d ".venv" ]; then
+    python3 -m venv .venv
+fi
+source .venv/bin/activate
+pip install -e .
+HOST=0.0.0.0 PENROZ_LOG_CONFIG=log_config.json python -m penroz_tpu.serve.app

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Bootstrap a venv and run the model service on localhost.
+set -e
+if [ ! -d ".venv" ]; then
+    python3 -m venv .venv
+fi
+source .venv/bin/activate
+pip install -e .
+python -m penroz_tpu.serve.app

@@ -242,3 +242,25 @@ def test_model_locks_shared_between_train_and_import(client):
         assert status == 409
     finally:
         lock.release()
+
+
+def test_ops_files_present_and_valid():
+    """run scripts, log config, CI workflow (parity: reference test_run_sh)."""
+    import json, os, stat
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for script in ("run.sh", "run-in-vm.sh"):
+        path = os.path.join(root, script)
+        assert os.path.exists(path)
+        assert os.stat(path).st_mode & stat.S_IXUSR
+        with open(path) as f:
+            content = f.read()
+        assert content.startswith("#!/bin/bash")
+        assert "penroz_tpu.serve.app" in content
+    with open(os.path.join(root, "log_config.json")) as f:
+        cfg = json.load(f)
+    assert cfg["version"] == 1
+    assert "aiohttp.access" in cfg["loggers"]
+    import logging.config
+    logging.config.dictConfig(cfg)  # must be a valid dictConfig
+    assert os.path.exists(os.path.join(root, ".github", "workflows",
+                                       "ci.yml"))

@@ -39,7 +39,7 @@ from penroz_tpu.ops import modules as M
 from penroz_tpu.parallel import dist
 from penroz_tpu.parallel import mesh as mesh_lib
 from penroz_tpu.parallel import sharding as sharding_lib
-from penroz_tpu.utils import checkpoint, stats as stats_lib
+from penroz_tpu.utils import checkpoint, profiling, stats as stats_lib
 
 log = logging.getLogger(__name__)
 
@@ -562,13 +562,14 @@ class NeuralNetworkModel:
             last_batch = None
             for epoch in range(epochs):
                 t0 = time.monotonic()
-                xs, ys = [], []
-                for _ in range(num_steps):
-                    x, y = loader.next_batch()
-                    xs.append(x.reshape(step_size, block_size))
-                    ys.append(y.reshape(step_size, block_size))
-                xs = jnp.asarray(np.stack(xs))
-                ys = jnp.asarray(np.stack(ys))
+                with profiling.span("penroz/load_batch"):
+                    xs, ys = [], []
+                    for _ in range(num_steps):
+                        x, y = loader.next_batch()
+                        xs.append(x.reshape(step_size, block_size))
+                        ys.append(y.reshape(step_size, block_size))
+                    xs = jnp.asarray(np.stack(xs))
+                    ys = jnp.asarray(np.stack(ys))
                 if mesh is not None:
                     xs = sharding_lib.shard_batch(
                         xs, mesh, leading_steps=True,
@@ -577,9 +578,10 @@ class NeuralNetworkModel:
                         ys, mesh, leading_steps=True,
                         shard_sequence=sp_mesh is not None)
                 last_batch = (xs[0], ys[0])
-                self.params, self.opt_state, self.buffers, cost, ratios = \
-                    epoch_fn(self.params, self.opt_state, self.buffers, xs, ys,
-                             jax.random.fold_in(rng, epoch))
+                with profiling.span("penroz/train_epoch"):
+                    self.params, self.opt_state, self.buffers, cost, ratios = \
+                        epoch_fn(self.params, self.opt_state, self.buffers,
+                                 xs, ys, jax.random.fold_in(rng, epoch))
                 cost = float(cost)
                 epoch_costs.append(cost)
                 duration = time.monotonic() - t0
@@ -730,25 +732,29 @@ class NeuralNetworkModel:
             t0 = time.monotonic()
             rng = jax.random.fold_in(call_rng, dispatch)
             if cache_len == 0 or cache_len >= block_size:
-                kv = kv.reset()
-                feed = context[-block_size:]
-                x = jnp.asarray(np.asarray(feed, np.int64)[None, :],
-                                jnp.int32)
-                tok_arr, kv = decode(self.params, self.buffers, kv, x, rng,
-                                     temp, greedy=greedy, top_k=top_k,
-                                     platform=self._platform)
-                cache_len = len(feed)
-                new_tokens = [int(np.asarray(tok_arr)[0, 0])]
+                with profiling.span("penroz/prefill"):
+                    kv = kv.reset()
+                    feed = context[-block_size:]
+                    x = jnp.asarray(np.asarray(feed, np.int64)[None, :],
+                                    jnp.int32)
+                    tok_arr, kv = decode(self.params, self.buffers, kv, x,
+                                         rng, temp, greedy=greedy,
+                                         top_k=top_k,
+                                         platform=self._platform)
+                    cache_len = len(feed)
+                    new_tokens = [int(np.asarray(tok_arr)[0, 0])]
             else:
-                room = block_size - cache_len
-                chunk = min(chunk_budget, max_new_tokens - produced, room)
-                chunk = 1 << (chunk.bit_length() - 1)  # pow-2 compile variants
-                x = jnp.asarray([[last_tok]], jnp.int32)
-                toks_arr, kv = self.arch.decode_chunk(
-                    self.params, self.buffers, kv, x, rng, temp, chunk=chunk,
-                    greedy=greedy, top_k=top_k, platform=self._platform)
-                cache_len += chunk
-                new_tokens = [int(t) for t in np.asarray(toks_arr)[0]]
+                with profiling.span("penroz/decode_chunk"):
+                    room = block_size - cache_len
+                    chunk = min(chunk_budget, max_new_tokens - produced, room)
+                    chunk = 1 << (chunk.bit_length() - 1)  # pow-2 variants
+                    x = jnp.asarray([[last_tok]], jnp.int32)
+                    toks_arr, kv = self.arch.decode_chunk(
+                        self.params, self.buffers, kv, x, rng, temp,
+                        chunk=chunk, greedy=greedy, top_k=top_k,
+                        platform=self._platform)
+                    cache_len += chunk
+                    new_tokens = [int(t) for t in np.asarray(toks_arr)[0]]
             dispatch += 1
             if metrics is not None:
                 metrics.record_step(len(new_tokens), kv.logical_bytes(),

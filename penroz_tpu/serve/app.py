@@ -291,6 +291,27 @@ async def train_model(request: web.Request):
                  status=202)
 
 
+async def profile(request: web.Request):
+    """Start/stop a jax.profiler trace capture (no reference equivalent —
+    SURVEY.md §5 profiling upgrade)."""
+    from penroz_tpu.utils import profiling
+    body = await _parse(request, schemas.ProfileRequest)
+    # start/stop serialize trace state (stop writes the whole capture to
+    # disk) — keep them off the event loop like every other blocking op.
+    if body.action == "start":
+        if not await _run_blocking(profiling.start, body.log_dir):
+            return _json({"detail": "A profile capture is already running."},
+                         status=409)
+        return _json({"message": f"Profiling started into {body.log_dir}"})
+    if body.action == "stop":
+        log_dir = await _run_blocking(profiling.stop)
+        if log_dir is None:
+            return _json({"detail": "No profile capture is running."},
+                         status=409)
+        return _json({"message": f"Profiling stopped; trace in {log_dir}"})
+    raise ValueError(f"Unknown profile action {body.action!r}")
+
+
 async def model_progress(request: web.Request):
     model_id = _query_param(request, "model_id")
     log.info("Requesting progress for model %s", model_id)
@@ -333,6 +354,7 @@ def create_app() -> web.Application:
     app.router.add_post("/generate/", model_generate)
     app.router.add_post("/decode/", decode_tokens)
     app.router.add_put("/train/", train_model)
+    app.router.add_post("/profile/", profile)
     app.router.add_get("/progress/", model_progress)
     app.router.add_get("/stats/", model_stats)
     app.router.add_delete("/model/", delete_model)
@@ -345,12 +367,11 @@ def _configure_logging():  # pragma: no cover
     """dictConfig from PENROZ_LOG_CONFIG (reference: main.py:503-506 loads
     log_config.json into uvicorn); fallback: basicConfig with the same
     processName-bearing format for DDP-style visibility."""
+    import logging.config  # binds the submodule; `logging` itself is global
     config_path = os.environ.get("PENROZ_LOG_CONFIG")
     if config_path and os.path.exists(config_path):
-        import json as _json
-        import logging.config
         with open(config_path) as f:
-            logging.config.dictConfig(_json.load(f))
+            logging.config.dictConfig(json.load(f))
         return
     if config_path:
         import sys
@@ -364,7 +385,9 @@ def _configure_logging():  # pragma: no cover
 def main(host: str = "127.0.0.1", port: int = 8000):  # pragma: no cover
     _configure_logging()
     from penroz_tpu.parallel import dist
+    from penroz_tpu.utils import profiling
     dist.initialize()
+    profiling.maybe_start_server()
     web.run_app(create_app(), host=host, port=port)
 
 

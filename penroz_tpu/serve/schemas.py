@@ -80,3 +80,10 @@ class ImportModelRequest(BaseModel):
     model_id: str = Field(..., description="Internal model id to save under")
     revision: Optional[str] = Field(None, description="HF revision/branch/tag")
     device: str = Field("cpu", description="Device to load the model on")
+
+
+class ProfileRequest(BaseModel):
+    action: str = Field(..., description="'start' or 'stop' a jax.profiler "
+                        "trace capture.")
+    log_dir: str = Field("profiles", description="Directory for the captured "
+                         "trace (start only); view with TensorBoard/Perfetto.")

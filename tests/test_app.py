@@ -264,3 +264,48 @@ def test_ops_files_present_and_valid():
     logging.config.dictConfig(cfg)  # must be a valid dictConfig
     assert os.path.exists(os.path.join(root, ".github", "workflows",
                                        "ci.yml"))
+
+
+def test_profile_start_stop_roundtrip(client, tmp_path):
+    """POST /profile/ start → trace capture → stop writes trace files."""
+    log_dir = str(tmp_path / "prof")
+    status, _ = client.json("POST", "/profile/",
+                            json={"action": "start", "log_dir": log_dir})
+    assert status == 200
+    # a second start while capturing → 409
+    status, _ = client.json("POST", "/profile/",
+                            json={"action": "start", "log_dir": log_dir})
+    assert status == 409
+    import jax.numpy as jnp
+    (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    status, _ = client.json("POST", "/profile/", json={"action": "stop"})
+    assert status == 200
+    import os
+    found = [f for _, _, fs in os.walk(log_dir) for f in fs]
+    assert found, "trace capture produced no files"
+    # stop when idle → 409
+    status, _ = client.json("POST", "/profile/", json={"action": "stop"})
+    assert status == 409
+
+
+def test_profile_unknown_action(client):
+    status, _ = client.json("POST", "/profile/", json={"action": "bogus"})
+    assert status == 400
+
+
+def test_configure_logging_all_paths(monkeypatch, tmp_path, capsys):
+    """Regression: the basicConfig fallback crashed with UnboundLocalError
+    when PENROZ_LOG_CONFIG was unset (branch-local `import logging.config`
+    shadowed the module-level `logging` name)."""
+    monkeypatch.delenv("PENROZ_LOG_CONFIG", raising=False)
+    app_mod._configure_logging()  # must not raise
+    monkeypatch.setenv("PENROZ_LOG_CONFIG", str(tmp_path / "missing.json"))
+    app_mod._configure_logging()
+    assert "does not exist" in capsys.readouterr().err
+    config = tmp_path / "log.json"
+    config.write_text(json.dumps({
+        "version": 1, "disable_existing_loggers": False,
+        "handlers": {"default": {"class": "logging.StreamHandler"}},
+        "root": {"handlers": ["default"]}}))
+    monkeypatch.setenv("PENROZ_LOG_CONFIG", str(config))
+    app_mod._configure_logging()

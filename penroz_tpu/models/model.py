@@ -568,13 +568,14 @@ class NeuralNetworkModel:
                         x, y = loader.next_batch()
                         xs.append(x.reshape(step_size, block_size))
                         ys.append(y.reshape(step_size, block_size))
-                    xs = jnp.asarray(np.stack(xs))
-                    ys = jnp.asarray(np.stack(ys))
+                    # stay on host: global_batch/jit place them exactly once
+                    xs = np.stack(xs)
+                    ys = np.stack(ys)
                 if mesh is not None:
-                    xs = sharding_lib.shard_batch(
+                    xs = sharding_lib.global_batch(
                         xs, mesh, leading_steps=True,
                         shard_sequence=sp_mesh is not None)
-                    ys = sharding_lib.shard_batch(
+                    ys = sharding_lib.global_batch(
                         ys, mesh, leading_steps=True,
                         shard_sequence=sp_mesh is not None)
                 last_batch = (xs[0], ys[0])
@@ -611,7 +612,12 @@ class NeuralNetworkModel:
                 if len(self.avg_cost_history) > 100:
                     self.avg_cost_history.pop(len(self.avg_cost_history) // 2)
             if master and last_batch is not None:
-                self.stats = self._compute_stats(*last_batch)
+                if not getattr(last_batch[0], "is_fully_addressable", True):
+                    # Stats need host-materialized activations; a multi-host
+                    # global batch is not fully addressable from one process.
+                    log.info("Skipping stats capture: batch spans hosts")
+                else:
+                    self.stats = self._compute_stats(*last_batch)
             self.status = {"code": "Trained",
                            "message": f"Trained {epochs} epoch(s)"}
             if master:
@@ -639,11 +645,7 @@ class NeuralNetworkModel:
         if os.environ.get("PENROZ_TRAIN_MESH", "1") == "0":
             return None
         if dist.process_count() > 1:
-            # Multi-host training shards a *global* batch over a global mesh
-            # (make_array_from_process_local_data) — not wired up yet; a
-            # process-local mesh here would skip cross-host gradient sync.
-            log.warning("Mesh training disabled under multi-process runtime")
-            return None
+            return self._multihost_mesh(step_size)
         try:
             platform = self.device.platform if self.device is not None else None
             devices = (jax.local_devices(backend=platform) if platform
@@ -671,6 +673,38 @@ class NeuralNetworkModel:
             return None
         return mesh_lib.make_mesh(devices, model=model, sequence=seq,
                                   expert=expert)
+
+    def _multihost_mesh(self, step_size: int):
+        """Global data-parallel mesh spanning every host's devices.
+
+        Pure DP for now: params/optimizer stay replicated, so each process
+        can materialize them for checkpointing; the data axis is ordered by
+        process (jax.devices() groups by process_index), so each host's
+        rank-strided loader rows land on its own chips.  TP/SP/EP across
+        hosts needs sharded checkpointing first.
+        """
+        world = dist.process_count()
+        # Every failure here RAISES: falling back to mesh=None under
+        # multi-process would train divergent per-host replicas with no
+        # gradient sync while the loader still stripes the data — silent
+        # corruption, not degradation.
+        platform = self.device.platform if self.device is not None else None
+        devices = jax.devices(platform) if platform else jax.devices()
+        n = len(devices)
+        if n % world:
+            raise RuntimeError(f"multi-host training: {n} global devices "
+                               f"not divisible by {world} processes")
+        for knob in ("PENROZ_MESH_MODEL", "PENROZ_MESH_SEQUENCE",
+                     "PENROZ_MESH_EXPERT"):
+            if os.environ.get(knob, "1") != "1":
+                log.warning("%s ignored under multi-host: pure data "
+                            "parallelism only", knob)
+        if (step_size * world) % n:
+            raise ValueError(
+                f"multi-host training: global micro-batch "
+                f"{step_size * world} (step_size × processes) must be "
+                f"divisible by {n} devices")
+        return mesh_lib.make_mesh(devices)
 
     @classmethod
     def train_model_on_device(cls, model_id, device, dataset_id, shard,

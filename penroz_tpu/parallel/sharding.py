@@ -76,3 +76,30 @@ def batch_spec(mesh: Mesh, *, leading_steps: bool = False,
 def shard_batch(batch, mesh: Mesh, **kw):
     import jax
     return jax.device_put(batch, NamedSharding(mesh, batch_spec(mesh, **kw)))
+
+
+def global_batch(batch, mesh: Mesh, *, leading_steps: bool = False,
+                 shard_sequence: bool = False):
+    """Place a batch on the mesh, lifting process-local rows to a global
+    array under multi-host (SURVEY.md §7.1: the rank-strided Loader feeds
+    each host its slice; ``jax.make_array_from_process_local_data`` stitches
+    the slices into one global batch whose data-axis sharding makes XLA
+    insert the cross-host gradient psum).
+
+    Single-process this is exactly :func:`shard_batch`.  The batch dim is
+    axis 1 with ``leading_steps`` (num_steps, B, T), else axis 0.
+    """
+    import jax
+    from penroz_tpu.parallel import dist
+    world = dist.process_count()
+    if world <= 1:
+        return shard_batch(batch, mesh, leading_steps=leading_steps,
+                           shard_sequence=shard_sequence)
+    spec = batch_spec(mesh, leading_steps=leading_steps,
+                      shard_sequence=shard_sequence)
+    sharding = NamedSharding(mesh, spec)
+    batch_axis = 1 if leading_steps else 0
+    global_shape = list(np.shape(batch))
+    global_shape[batch_axis] *= world
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(batch), tuple(global_shape))

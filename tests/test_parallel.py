@@ -188,3 +188,58 @@ def test_process_topology_single_host():
     assert dist.master_proc()
     assert not dist.is_distributed()
     assert dist.initialize() is False  # no cluster env → no-op
+
+
+def test_global_batch_single_process_equals_shard_batch(cpu_devices):
+    mesh = mesh_lib.make_mesh(cpu_devices[:4])
+    x = jnp.asarray(np.arange(2 * 8 * 4).reshape(2, 8, 4))
+    a = sharding.shard_batch(x, mesh, leading_steps=True)
+    b = sharding.global_batch(x, mesh, leading_steps=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.sharding == b.sharding
+
+
+def test_global_batch_multihost_lifts_local_rows(cpu_devices, monkeypatch):
+    """Under world=2 the local (steps, B, T) rows become a global array of
+    (steps, 2B, T) via make_array_from_process_local_data."""
+    import jax
+    mesh = mesh_lib.make_mesh(cpu_devices)
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    captured = {}
+
+    def fake_make_array(sharding_, local, global_shape):
+        captured["sharding"] = sharding_
+        captured["local_shape"] = local.shape
+        captured["global_shape"] = global_shape
+        return "global-array"
+
+    monkeypatch.setattr(jax, "make_array_from_process_local_data",
+                        fake_make_array)
+    x = np.zeros((2, 4, 8), np.int32)
+    out = sharding.global_batch(x, mesh, leading_steps=True)
+    assert out == "global-array"
+    assert captured["local_shape"] == (2, 4, 8)
+    assert captured["global_shape"] == (2, 8, 8)
+    from jax.sharding import PartitionSpec as P
+    assert captured["sharding"].spec == P(None, "data", None)
+
+
+def test_multihost_training_mesh_pure_dp(workdir, toy_gpt_layers,
+                                         monkeypatch):
+    """process_count>1 yields a global pure-DP mesh (all devices on the
+    data axis) and ignores the TP/SP/EP env knobs with a warning."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    model = NeuralNetworkModel("mh", Mapper(toy_gpt_layers,
+                                            {"sgd": {"lr": 0.1}}))
+    model.to_device("cpu")  # pin to the virtual 8-device CPU backend
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setenv("PENROZ_MESH_MODEL", "2")
+    mesh = model._training_mesh(step_size=4, block_size=16)
+    assert mesh is not None
+    assert mesh.shape["data"] == 8
+    assert mesh.shape["model"] == 1
+    # indivisible global micro-batch must raise, not silently train
+    # divergent unsynced replicas
+    with pytest.raises(ValueError, match="divisible"):
+        model._training_mesh(step_size=3, block_size=16)

@@ -121,6 +121,47 @@ def cached_attention(q, k_full, v_full, offset, length,
     return out.reshape(B, Hq, T, D)
 
 
+def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
+                           offset, length, dropout_rate=0.0,
+                           dropout_rng=None, platform=None):
+    """Cached attention over a paged KV pool (block table indirection).
+
+    On TPU dispatches to the paged Pallas kernel — one physical page of K/V
+    resident in VMEM at a time, so context length is HBM-bounded.  The
+    fallback (also the correctness oracle) gathers the dense view and
+    reuses :func:`cached_attention`'s jnp path.
+    """
+    if dropout_rate == 0.0 and _use_paged_kernel(q, flat_k, block_table,
+                                                 page_size, platform):
+        from penroz_tpu.ops.pallas import paged_attention as pa
+        return pa.paged_decode_attention(q, flat_k, flat_v, block_table,
+                                         page_size, offset, length)
+    B = q.shape[0]
+    pages_per_seq = block_table.shape[1]
+    max_len = pages_per_seq * page_size
+    all_pos = jnp.arange(max_len, dtype=jnp.int32)
+    phys = jnp.maximum(block_table[:, all_pos // page_size], 0)
+    rows = phys * page_size + all_pos % page_size  # (B, max_len)
+    # flat pools are head-major (Hkv, pool_rows, D)
+    gather = lambda flat: jnp.take(flat, rows, axis=1,
+                                   mode="clip").transpose(1, 0, 2, 3)
+    # Dense-gather fallback; cached_attention may still use the contiguous
+    # decode kernel on the gathered views when shapes allow.
+    return cached_attention(q, gather(flat_k), gather(flat_v), offset,
+                            length, dropout_rate, dropout_rng,
+                            platform=platform)
+
+
+def _use_paged_kernel(q, flat_k, block_table, page_size: int,
+                      platform=None) -> bool:
+    if not _tpu_platform(q, platform):
+        return False
+    B, Hq, T, D = q.shape
+    Hkv = flat_k.shape[0]
+    return (D in (64, 128, 256) and page_size % 8 == 0 and page_size >= 8
+            and Hq % Hkv == 0 and (Hq // Hkv) * T <= 512)
+
+
 def _tpu_platform(x, platform=None) -> bool:
     """Whether attention on ``x`` will run on TPU.
 

@@ -193,8 +193,10 @@ class PagedKVState(KVState):
     """Paged KV cache: fixed-size pages in a shared HBM pool + block table.
 
     The contiguous per-sequence buffers of :class:`KVState` become per-layer
-    *page pools* — flat ``(num_pages * page_size, Hkv, D)`` arrays whose rows
-    are grouped into pages of ``page_size`` tokens — plus one block table
+    *page pools* — flat ``(Hkv, num_pages * page_size, D)`` arrays whose row
+    axis is grouped into pages of ``page_size`` tokens (head-major so one
+    page of one head is a well-tiled ``(page_size, D)`` VMEM block for the
+    paged Pallas kernel) — plus one block table
     ``(B, pages_per_seq)`` mapping each sequence's logical page to a physical
     page.  Pages are assigned on demand by an in-jit bump allocator
     (vLLM-style paged attention; BASELINE.json config "gpt2-medium /generate/
@@ -267,8 +269,8 @@ class PagedKVState(KVState):
                 f"pool_pages={num_pages} cannot back {batch} sequence(s) of "
                 f"{pages_per_seq} pages: the bump allocator frees only on "
                 "reset, so an undersized pool would alias live pages")
-        k = [jnp.zeros((num_pages * page, h, d), dtype) for h, d in specs]
-        v = [jnp.zeros((num_pages * page, h, d), dtype) for h, d in specs]
+        k = [jnp.zeros((h, num_pages * page, d), dtype) for h, d in specs]
+        v = [jnp.zeros((h, num_pages * page, d), dtype) for h, d in specs]
         table = jnp.full((batch, pages_per_seq), -1, jnp.int32)
         return cls(k, v, jnp.zeros((3,), jnp.int32), table,
                    page, pages_per_seq)
@@ -279,7 +281,7 @@ class PagedKVState(KVState):
 
     @property
     def num_pool_pages(self) -> int:
-        return self.k[0].shape[0] // self.page_size if self.k else 0
+        return self.k[0].shape[1] // self.page_size if self.k else 0
 
     def _allocate(self, new_length):
         """Bump-allocate physical pages covering ``[0, new_length)``.
@@ -309,17 +311,25 @@ class PagedKVState(KVState):
         phys_page = self.block_table[:, pos // P]  # (B, n)
         return phys_page * P + pos % P
 
-    def append(self, layer_idx: int, k_new, v_new):
+    def append_rows(self, layer_idx: int, k_new, v_new):
+        """Scatter new K/V into the page pools; returns the *flat* pools
+        (no dense gather — the paged Pallas kernel walks the block table
+        directly, ops/pallas/paged_attention.py)."""
         B, H, T, D = k_new.shape
         new_length = self.length + T
         self._allocate(new_length)
         pos = self.length + jnp.arange(T, dtype=jnp.int32)
         rows = self._rows(pos).reshape(-1)  # (B*T,)
-        kv_rows = lambda t: t.transpose(0, 2, 1, 3).reshape(B * T, H, D)
-        self.k[layer_idx] = self.k[layer_idx].at[rows].set(
+        kv_rows = lambda t: t.transpose(1, 0, 2, 3).reshape(H, B * T, D)
+        self.k[layer_idx] = self.k[layer_idx].at[:, rows].set(
             kv_rows(k_new).astype(self.k[layer_idx].dtype))
-        self.v[layer_idx] = self.v[layer_idx].at[rows].set(
+        self.v[layer_idx] = self.v[layer_idx].at[:, rows].set(
             kv_rows(v_new).astype(self.v[layer_idx].dtype))
+        return self.k[layer_idx], self.v[layer_idx], new_length
+
+    def append(self, layer_idx: int, k_new, v_new):
+        """Scatter + dense gathered views (the jnp fallback/oracle path)."""
+        _, _, new_length = self.append_rows(layer_idx, k_new, v_new)
         return (self._gather(self.k[layer_idx]),
                 self._gather(self.v[layer_idx]), new_length)
 
@@ -327,7 +337,9 @@ class PagedKVState(KVState):
         """Assemble the (B, Hkv, S_max, D) view the attention mask expects."""
         all_pos = jnp.arange(self.max_len, dtype=jnp.int32)
         rows = jnp.clip(self._rows(all_pos), 0)  # unassigned → row 0 (masked)
-        return jnp.take(flat, rows, axis=0, mode="clip").transpose(0, 2, 1, 3)
+        # flat: (Hkv, pool_rows, D); rows: (B, S_max)
+        return jnp.take(flat, rows, axis=1,
+                        mode="clip").transpose(1, 0, 2, 3)
 
     def _with_length(self, length):
         counters = self.counters.at[0].set(length)
@@ -343,7 +355,7 @@ class PagedKVState(KVState):
 
     def _row_bytes(self) -> int:
         """Bytes per token row summed over every layer's K and V pool."""
-        return sum(a.shape[1] * a.shape[2] * a.dtype.itemsize
+        return sum(a.shape[0] * a.shape[2] * a.dtype.itemsize
                    for a in (*self.k, *self.v))
 
     # ``memory_bytes`` is inherited: the preallocated pool is what actually

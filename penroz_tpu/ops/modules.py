@@ -605,11 +605,21 @@ class CausalSelfAttention(Module):
         dropout_rng = ctx.next_rng() if (dropout_rate > 0.0 and ctx.training) else None
 
         if ctx.kv is not None:
-            k_full, v_full, length = ctx.kv.append(self.layer_idx, k, v)
-            out = attn_ops.cached_attention(q, k_full, v_full, offset, length,
-                                            dropout_rate=dropout_rate,
-                                            dropout_rng=dropout_rng,
-                                            platform=ctx.platform)
+            from penroz_tpu.ops import kv_cache as KV
+            if isinstance(ctx.kv, KV.PagedKVState):
+                flat_k, flat_v, length = ctx.kv.append_rows(self.layer_idx,
+                                                            k, v)
+                out = attn_ops.paged_cached_attention(
+                    q, flat_k, flat_v, ctx.kv.block_table, ctx.kv.page_size,
+                    offset, length, dropout_rate=dropout_rate,
+                    dropout_rng=dropout_rng, platform=ctx.platform)
+            else:
+                k_full, v_full, length = ctx.kv.append(self.layer_idx, k, v)
+                out = attn_ops.cached_attention(q, k_full, v_full, offset,
+                                                length,
+                                                dropout_rate=dropout_rate,
+                                                dropout_rng=dropout_rng,
+                                                platform=ctx.platform)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
             # Sequence-parallel training: ring attention over ICI.
             from penroz_tpu.parallel.ring_attention import ring_attention

@@ -1,0 +1,143 @@
+"""Pallas TPU paged decode-attention: walks the block table directly.
+
+The jnp paged path (`ops/kv_cache.py::PagedKVState.append`) materializes a
+dense (B, Hkv, S_max, D) gather of the page pool before attending — correct,
+but it pays a full-cache copy per layer per step and bounds S_max by VMEM.
+This kernel instead streams one *physical page* at a time: the block table
+is scalar-prefetched, each grid step's BlockSpec index_map looks up the
+page's physical row block in the flat pool, and online-softmax statistics
+carry across pages in VMEM scratch.  Only page_size × D of K/V is resident
+per step, so max context is bounded by HBM, not VMEM — the vLLM-style
+paged-attention dataflow built on the MXU.
+
+Grid: (batch, kv_head, logical_page); the page dimension is sequential
+("arbitrary") so scratch accumulators persist across it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *,
+                  page_size: int, num_queries: int, pages_per_seq: int,
+                  sm_scale: float):
+    j = pl.program_id(2)
+    total = len_ref[0]
+    offset = total - num_queries
+    gt = q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * page_size < total)
+    def _attend_page():
+        q = q_ref[0, 0]          # (GT, D)
+        k = k_ref[0]             # (page_size, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (GT, P)
+        t = jax.lax.broadcasted_iota(jnp.int32, (gt, page_size), 0) \
+            % num_queries
+        k_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (gt, page_size), 1)
+        s = jnp.where(k_pos <= offset + t, s, _NEG_INF)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+        l_ref[:, 0] = l_new
+
+    @pl.when(j == pages_per_seq - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
+                           offset, length, interpret: bool = False):
+    """Cached attention over a paged pool.
+
+    q: (B, Hq, T, D) new queries; flat_k/flat_v: (Hkv, num_pages *
+    page_size, D) shared head-major pools; block_table: (B, pages_per_seq)
+    physical page per
+    logical page (-1 = unassigned); ``length`` = offset + T valid tokens.
+    Matches the jnp oracle (gather + ``cached_attention``) exactly.
+    """
+    B, Hq, T, D = q.shape
+    Hkv = flat_k.shape[0]
+    group = Hq // Hkv
+    pages_per_seq = block_table.shape[1]
+    sm_scale = 1.0 / (D ** 0.5)
+
+    q_rows = q.reshape(B, Hkv, group * T, D)
+    total = jnp.asarray(length, jnp.int32).reshape(1)
+    # Unassigned pages (-1) sit past the valid length; clamp them onto page
+    # 0 so the DMA index is in-pool — their keys are masked by k_pos>total.
+    table = jnp.maximum(block_table, 0).astype(jnp.int32).reshape(-1)
+
+    kernel = functools.partial(_paged_kernel, page_size=page_size,
+                               num_queries=T, pages_per_seq=pages_per_seq,
+                               sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, group * T, D),
+                         lambda b, h, j, len_ref, table_ref: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, page_size, D),
+                lambda b, h, j, len_ref, table_ref:
+                    (h, table_ref[b * pages_per_seq + j], 0),
+                memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, page_size, D),
+                lambda b, h, j, len_ref, table_ref:
+                    (h, table_ref[b * pages_per_seq + j], 0),
+                memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group * T, D),
+                               lambda b, h, j, len_ref, table_ref:
+                                   (b, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((group * T, D), jnp.float32),
+            pltpu.VMEM((group * T, 1), jnp.float32),
+            pltpu.VMEM((group * T, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q_rows.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * B * Hq * T * pages_per_seq * page_size * D),
+            bytes_accessed=int((q.size + 2 * B * pages_per_seq * page_size
+                                * Hkv * D) * q.dtype.itemsize),
+            transcendentals=int(B * Hq * T * pages_per_seq * page_size)),
+        interpret=interpret,
+    )(total, table, q_rows, flat_k, flat_v)
+    return out.reshape(B, Hq, T, D)

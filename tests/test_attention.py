@@ -197,3 +197,47 @@ def test_kernel_gates_respect_platform_hint():
     # oversized cache falls back even on TPU (VMEM bound)
     k_big = jnp.zeros((1, 2, 32768, 64))
     assert not A._use_flash_decode(q, k_big, platform="tpu")
+
+
+def test_paged_kernel_matches_oracle_interpret():
+    """Paged Pallas kernel (interpret) vs the dense-gather jnp oracle across
+    occupancies, incl. partially filled pages and GQA."""
+    from penroz_tpu.ops.pallas import paged_attention as PA
+    from penroz_tpu.ops import kv_cache as KV
+    rng = np.random.default_rng(5)
+    B, Hq, Hkv, D, P, pages = 2, 4, 2, 64, 16, 8
+    S_max = P * pages
+    state = KV.PagedKVState.create([(Hkv, D)], batch=B, max_len=S_max,
+                                   page_size=P)
+    # fill 3 pages + 5 tokens
+    fill = 3 * P + 5
+    k_fill = jnp.asarray(rng.normal(size=(B, Hkv, fill, D)).astype(np.float32))
+    v_fill = jnp.asarray(rng.normal(size=(B, Hkv, fill, D)).astype(np.float32))
+    state.append_rows(0, k_fill, v_fill)
+    state = state.advanced(fill)
+    for T in (1, 4):
+        q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+        k_new = jnp.asarray(rng.normal(size=(B, Hkv, T, D)).astype(np.float32))
+        v_new = jnp.asarray(rng.normal(size=(B, Hkv, T, D)).astype(np.float32))
+        trial = KV.PagedKVState(list(state.k), list(state.v), state.counters,
+                                state.block_table, state.page_size,
+                                state.pages_per_seq)
+        flat_k, flat_v, length = trial.append_rows(0, k_new, v_new)
+        ref = A.paged_cached_attention(q, flat_k, flat_v, trial.block_table,
+                                       P, trial.length, length,
+                                       platform="cpu")
+        out = PA.paged_decode_attention(q, flat_k, flat_v, trial.block_table,
+                                        P, trial.length, length,
+                                        interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, err_msg=f"T={T}")
+
+
+def test_paged_kernel_gate():
+    from penroz_tpu.ops import kv_cache as KV
+    q = jnp.zeros((1, 2, 1, 64))
+    flat = jnp.zeros((2, 256, 64))  # head-major pool (Hkv, rows, D)
+    table = jnp.zeros((1, 4), jnp.int32)
+    assert A._use_paged_kernel(q, flat, table, 64, platform="tpu")
+    assert not A._use_paged_kernel(q, flat, table, 64, platform="cpu")
+    assert not A._use_paged_kernel(q, flat, table, 7, platform="tpu")

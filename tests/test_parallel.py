@@ -85,6 +85,42 @@ def test_dryrun_multichip_entrypoint():
     entrypoints.dryrun_multichip(8)
 
 
+def test_dryrun_multichip_hermetic_env(monkeypatch):
+    """The public dryrun must never touch the parent's jax backend: it
+    re-execs in a child with JAX_PLATFORMS=cpu, the forced device count,
+    and TPU plugin registration disabled (round-1 contract failure)."""
+    import __graft_entry__ as entrypoints
+    captured = {}
+
+    def fake_run(cmd, env=None, **kwargs):
+        captured["cmd"] = cmd
+        captured["env"] = env
+
+        class Result:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+        return Result()
+
+    # Poison the parent env the way the driver's TPU process would.
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=2 --foo=bar")
+    monkeypatch.delenv("_PENROZ_DRYRUN_CHILD", raising=False)
+    monkeypatch.setattr("subprocess.run", fake_run)
+    entrypoints.dryrun_multichip(4)
+
+    env = captured["env"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["_PENROZ_DRYRUN_CHILD"] == "1"
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    assert "--xla_force_host_platform_device_count=2" not in env["XLA_FLAGS"]
+    assert "--foo=bar" in env["XLA_FLAGS"]
+    assert "dryrun_multichip(4)" in captured["cmd"][-1]
+
+
 def test_graft_entry_compiles():
     import __graft_entry__ as entrypoints
     fn, args = entrypoints.entry()

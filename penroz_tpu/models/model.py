@@ -489,12 +489,23 @@ class NeuralNetworkModel:
     def evaluate_model(self, dataset_id, target_dataset_id, shard, epochs,
                        batch_size, block_size, step_size) -> float:
         """Forward-only evaluation with the training loader math
-        (reference: neural_net_model.py:300-358)."""
+        (reference: neural_net_model.py:300-358).
+
+        Reference parity: one ``(batch_size, block_size)`` buffer is loaded
+        per epoch and forwarded ``num_steps`` times under no-grad
+        (:337-351) — identical data each step, so we forward once and weight
+        by ``1/epochs`` (numerically equal, ``num_steps``× fewer FLOPs).
+        The result is averaged across processes like the reference's
+        ``ddp_all_reduce`` (:352-354).  Multi-host contract: as with
+        ``/train/`` over a global mesh, every host's server must receive
+        the same request — the final reduction is a collective and a
+        single-host request would block until the distributed runtime
+        times out.
+        """
         from penroz_tpu.data.loaders import Loader
         world = dist.process_count()
         rank = dist.process_index()
-        buffer_size = step_size * block_size
-        num_steps = max(1, batch_size // (step_size * world))
+        buffer_size = batch_size * block_size
         loader = Loader(dataset_id, begin_shard=shard,
                         begin_idx=buffer_size * rank, buffer_size=buffer_size,
                         idx_offset=buffer_size * world)
@@ -504,44 +515,60 @@ class NeuralNetworkModel:
                                    begin_idx=buffer_size * rank,
                                    buffer_size=buffer_size,
                                    idx_offset=buffer_size * world)
-        costs = []
+        avg_cost = 0.0
         for _ in range(epochs):
-            for _ in range(num_steps):
-                if target_loader is not None:
-                    x, _ = loader.next_batch(target_offset=0)
-                    y, _ = target_loader.next_batch(target_offset=0)
-                else:
-                    x, y = loader.next_batch()
-                x = jnp.asarray(x.reshape(step_size, block_size))
-                y = jnp.asarray(y.reshape(step_size, block_size))
-                _, cost, _, _ = self.arch.jit_forward(
-                    self.params, self.buffers, x, y, skip_softmax=True,
-                    platform=self._platform)
-                costs.append(float(cost))
-        return float(np.mean(costs))
+            if target_loader is not None:
+                x, _ = loader.next_batch(target_offset=0)
+                y, _ = target_loader.next_batch(target_offset=0)
+            else:
+                x, y = loader.next_batch()
+            x = jnp.asarray(x.reshape(batch_size, block_size))
+            y = jnp.asarray(y.reshape(batch_size, block_size))
+            _, cost, _, _ = self.arch.jit_forward(
+                self.params, self.buffers, x, y, skip_softmax=True,
+                platform=self._platform)
+            avg_cost += float(cost) / epochs
+        return dist.all_reduce_mean(avg_cost)
 
     # -- training -----------------------------------------------------------
 
     def train_model(self, dataset_id, shard=0, epochs=1, batch_size=1,
                     block_size=1024, step_size=1):
         """Grad-accumulated training with progress/stats bookkeeping and
-        periodic checkpoints (reference: neural_net_model.py:552-722)."""
+        periodic checkpoints (reference: neural_net_model.py:552-722).
+
+        Reference micro-batch semantics (:581-586, :629-631): every
+        micro-step consumes a full ``(batch_size, block_size)`` buffer from
+        the loader; ``step_size`` only sets how many such micro-steps
+        accumulate into one optimizer step
+        (``num_steps = buffer_size // (step_size * block_size * world)``).
+        Progress/stats reset at train start (:597-601); ``speedPerSec``
+        counts ``buffer_size`` tokens per epoch exactly as the reference
+        does (:684-703), although an epoch consumes ``num_steps`` buffers.
+
+        Per-epoch cost under a multi-host mesh is computed over the global
+        batch inside the compiled program, which subsumes the reference's
+        per-epoch ``ddp_all_reduce(cost)`` (:664-665).
+        """
         from penroz_tpu.data.loaders import Loader
         master = dist.master_proc()
         try:
             world = dist.process_count()
             rank = dist.process_index()
-            buffer_size = step_size * block_size
-            num_steps = max(1, batch_size // (step_size * world))
+            buffer_size = batch_size * block_size
+            num_steps = max(1, buffer_size
+                            // (step_size * block_size * world))
             loader = Loader(dataset_id, begin_shard=shard,
                             begin_idx=buffer_size * rank,
                             buffer_size=buffer_size,
                             idx_offset=buffer_size * world)
+            self.progress = []
+            self.stats = None
             self.status = {"code": "Training",
                            "message": f"Training on {dataset_id}"}
             if master:
                 self.serialize()
-            mesh = self._training_mesh(step_size, block_size)
+            mesh = self._training_mesh(batch_size, block_size)
             sp_mesh = None
             if mesh is not None:
                 log.info("Training over device mesh %s", dict(mesh.shape))
@@ -556,21 +583,29 @@ class NeuralNetworkModel:
                                                 num_steps, sp_mesh=sp_mesh,
                                                 platform=self._platform)
             rng = jax.random.key(0)
-            base_epoch = self.progress[-1]["epoch"] if self.progress else 0
             last_save = time.monotonic()
-            epoch_costs = []
-            last_batch = None
+            last_stats = time.monotonic()
+            # Stats refresh runs a full instrumented pass (the reference
+            # histograms grads already retained by its backward,
+            # :643-646, which is nearly free; ours re-derives them), so
+            # it gets its own, longer cadence than the 10s checkpoint.
+            stats_interval = float(
+                os.environ.get("PENROZ_STATS_INTERVAL", "60"))
+            sample_every = max(1, epochs // 100)
+            last_batch = None  # host-local numpy micro-batch for /stats/
             for epoch in range(epochs):
                 t0 = time.monotonic()
+                long_training = t0 - last_save >= 10
                 with profiling.span("penroz/load_batch"):
                     xs, ys = [], []
                     for _ in range(num_steps):
                         x, y = loader.next_batch()
-                        xs.append(x.reshape(step_size, block_size))
-                        ys.append(y.reshape(step_size, block_size))
+                        xs.append(x.reshape(batch_size, block_size))
+                        ys.append(y.reshape(batch_size, block_size))
                     # stay on host: global_batch/jit place them exactly once
                     xs = np.stack(xs)
                     ys = np.stack(ys)
+                last_batch = (xs[-1], ys[-1])
                 if mesh is not None:
                     xs = sharding_lib.global_batch(
                         xs, mesh, leading_steps=True,
@@ -578,49 +613,38 @@ class NeuralNetworkModel:
                     ys = sharding_lib.global_batch(
                         ys, mesh, leading_steps=True,
                         shard_sequence=sp_mesh is not None)
-                last_batch = (xs[0], ys[0])
                 with profiling.span("penroz/train_epoch"):
                     self.params, self.opt_state, self.buffers, cost, ratios = \
                         epoch_fn(self.params, self.opt_state, self.buffers,
                                  xs, ys, jax.random.fold_in(rng, epoch))
                 cost = float(cost)
-                epoch_costs.append(cost)
                 duration = time.monotonic() - t0
-                tokens = num_steps * step_size * block_size * world
                 if master:
-                    entry = {
-                        "epoch": base_epoch + epoch + 1,
-                        "cost": cost,
-                        "durationInSecs": duration,
-                        "speedPerSec": tokens / max(duration, 1e-9),
-                        "weight_upd_ratio":
-                            np.asarray(ratios, np.float64).tolist(),
-                    }
-                    self.progress.append(entry)
-                    if len(self.progress) > 100:
-                        self.progress.pop(len(self.progress) // 2)
+                    if epoch % sample_every == 0:
+                        self.progress.append({
+                            "epoch": epoch + 1,
+                            "cost": cost,
+                            "durationInSecs": duration,
+                            "speedPerSec": buffer_size / max(duration, 1e-9),
+                            "weight_upd_ratio":
+                                np.asarray(ratios, np.float64).tolist(),
+                        })
                     log.info("Epoch %d: cost=%.4f %.0f tokens/sec",
-                             entry["epoch"], cost, entry["speedPerSec"])
-                    if time.monotonic() - last_save >= 10:
+                             epoch + 1, cost,
+                             buffer_size / max(duration, 1e-9))
+                    if long_training:
+                        refresh = (time.monotonic() - last_stats
+                                   >= stats_interval)
+                        self._record_overall_progress(
+                            last_batch if refresh else None)
+                        if refresh:
+                            last_stats = time.monotonic()
                         self.serialize()
                         last_save = time.monotonic()
-            run_avg = float(np.mean(epoch_costs)) if epoch_costs else None
-            if run_avg is not None:
-                self.avg_cost = (run_avg if self.avg_cost is None
-                                 else (self.avg_cost + run_avg) / 2)
-                self.avg_cost_history.append(self.avg_cost)
-                if len(self.avg_cost_history) > 100:
-                    self.avg_cost_history.pop(len(self.avg_cost_history) // 2)
-            if master and last_batch is not None:
-                if not getattr(last_batch[0], "is_fully_addressable", True):
-                    # Stats need host-materialized activations; a multi-host
-                    # global batch is not fully addressable from one process.
-                    log.info("Skipping stats capture: batch spans hosts")
-                else:
-                    self.stats = self._compute_stats(*last_batch)
             self.status = {"code": "Trained",
                            "message": f"Trained {epochs} epoch(s)"}
             if master:
+                self._record_overall_progress(last_batch)
                 self.serialize()
         except Exception as e:  # noqa: BLE001
             self.status = {"code": "Error", "message": str(e)}
@@ -631,21 +655,48 @@ class NeuralNetworkModel:
                     log.exception("Failed to persist error status")
             raise
 
-    def _training_mesh(self, step_size: int, block_size: int):
+    def _record_overall_progress(self, last_batch):
+        """Fold the run's progress into the overall average-cost history and
+        refresh /stats/ (reference ``_record_training_overall_progress``,
+        neural_net_model.py:724-733)."""
+        import random
+        if self.progress:
+            avg_progress_cost = (sum(p["cost"] for p in self.progress)
+                                 / len(self.progress))
+            self.avg_cost = ((self.avg_cost or avg_progress_cost)
+                             + avg_progress_cost) / 2.0
+            self.avg_cost_history.append(self.avg_cost)
+            if len(self.avg_cost_history) > 100:
+                self.avg_cost_history.pop(random.randint(1, 98))
+        if last_batch is not None:
+            self.stats = self._compute_stats(*last_batch)
+
+    def _training_mesh(self, micro_batch: int, block_size: int):
         """Device mesh for the training run (None = single device).
 
+        ``micro_batch`` is the per-process rows of one micro-step —
+        ``batch_size`` under the reference's buffer semantics.
         Data-parallelism over every local device is automatic when the
         micro-batch divides the data axis; ``PENROZ_MESH_MODEL`` /
         ``PENROZ_MESH_SEQUENCE`` / ``PENROZ_MESH_EXPERT`` carve tensor/
         sequence/expert-parallel axes out of the same device set, and
-        ``PENROZ_TRAIN_MESH=0`` disables meshing.
+        ``PENROZ_TRAIN_MESH=0`` disables meshing (single-process only).
         This replaces the reference's per-request DDP process tree
         (ddp.py:38-73) — the mesh lives inside one compiled program.
         """
         if os.environ.get("PENROZ_TRAIN_MESH", "1") == "0":
+            if dist.process_count() > 1:
+                # Opting out of the mesh under multi-host would train
+                # divergent per-host replicas with no gradient sync while
+                # the loader still rank-strides the data — silent
+                # corruption, so refuse loudly.
+                raise RuntimeError(
+                    "PENROZ_TRAIN_MESH=0 is invalid when "
+                    f"process_count={dist.process_count()} > 1: multi-host "
+                    "training requires the global mesh for gradient sync")
             return None
         if dist.process_count() > 1:
-            return self._multihost_mesh(step_size)
+            return self._multihost_mesh(micro_batch)
         try:
             platform = self.device.platform if self.device is not None else None
             devices = (jax.local_devices(backend=platform) if platform
@@ -666,15 +717,15 @@ class NeuralNetworkModel:
         if n <= 1 or n % (model * seq * expert):
             return None
         data = n // (model * seq * expert)
-        if step_size % data or (seq > 1 and block_size % seq):
+        if micro_batch % data or (seq > 1 and block_size % seq):
             log.info("Mesh fallback to single device: micro-batch %d / "
                      "sequence %d not divisible by data=%d / sequence=%d",
-                     step_size, block_size, data, seq)
+                     micro_batch, block_size, data, seq)
             return None
         return mesh_lib.make_mesh(devices, model=model, sequence=seq,
                                   expert=expert)
 
-    def _multihost_mesh(self, step_size: int):
+    def _multihost_mesh(self, micro_batch: int):
         """Global data-parallel mesh spanning every host's devices.
 
         Pure DP for now: params/optimizer stay replicated, so each process
@@ -699,10 +750,10 @@ class NeuralNetworkModel:
             if os.environ.get(knob, "1") != "1":
                 log.warning("%s ignored under multi-host: pure data "
                             "parallelism only", knob)
-        if (step_size * world) % n:
+        if (micro_batch * world) % n:
             raise ValueError(
                 f"multi-host training: global micro-batch "
-                f"{step_size * world} (step_size × processes) must be "
+                f"{micro_batch * world} (batch_size × processes) must be "
                 f"divisible by {n} devices")
         return mesh_lib.make_mesh(devices)
 
@@ -720,11 +771,32 @@ class NeuralNetworkModel:
         return model
 
     def _compute_stats(self, x, y) -> dict:
+        """/stats/ histograms from one host-local micro-batch.
+
+        Under multi-host the params are global arrays spanning hosts; the
+        instrumented pass runs process-locally on this host's copy of the
+        (replicated) params with its local sub-batch — the reference always
+        produces stats on master (neural_net_model.py:705-709), so a
+        master-local sample preserves the feature instead of skipping it.
+        """
+        params, buffers = self.params, self.buffers
+        if any(not getattr(v, "is_fully_addressable", True)
+               for v in params.values()):
+            if not all(getattr(v, "is_fully_replicated", True)
+                       for v in params.values()):
+                log.info("Skipping stats capture: params sharded across "
+                         "hosts")
+                return self.stats
+            dev = jax.local_devices()[0]
+            params = {k: jax.device_put(np.asarray(v), dev)
+                      for k, v in params.items()}
+            buffers = {k: jax.device_put(np.asarray(v), dev)
+                       for k, v in buffers.items()}
         acts, act_grads, weight_grads = self.arch.stats_grads(
-            self.params, self.buffers, x, y, platform=self._platform)
+            params, buffers, x, y, platform=self._platform)
         acts_np = [np.asarray(a, np.float32) for a in acts]
         grads_np = [np.asarray(g, np.float32) for g in act_grads]
-        weights = [np.asarray(self.params[k], np.float32)
+        weights = [np.asarray(params[k], np.float32)
                    for k in self.arch.param_order]
         wgrads = [np.asarray(weight_grads[k], np.float32)
                   for k in self.arch.param_order]

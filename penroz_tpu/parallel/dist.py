@@ -72,3 +72,19 @@ def master_proc() -> bool:
 
 def is_distributed() -> bool:
     return process_count() > 1
+
+
+def all_reduce_mean(value: float) -> float:
+    """Average a host-local scalar across processes.
+
+    Replaces the reference's ``ddp_all_reduce`` with NCCL ``ReduceOp.AVG``
+    (ddp.py:80-85, used for the eval cost at neural_net_model.py:352-354).
+    Single-process: identity.
+    """
+    if process_count() == 1:
+        return float(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(
+        np.asarray(value, np.float32))
+    return float(np.mean(gathered))

@@ -194,7 +194,7 @@ def test_train_model_uses_data_parallel_mesh(workdir, toy_gpt_layers,
     optim = {"sgd": {"lr": 0.1}}
     dp = NeuralNetworkModel("dp8", Mapper(toy_gpt_layers, optim)).to_device("cpu")
     single = NeuralNetworkModel("dp1", Mapper(toy_gpt_layers, optim)).to_device("cpu")
-    mesh = dp._training_mesh(step_size=8, block_size=16)
+    mesh = dp._training_mesh(micro_batch=8, block_size=16)
     assert mesh is not None and mesh.shape["data"] == 8
     dp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
                    step_size=8)
@@ -215,7 +215,19 @@ def test_training_mesh_fallback_on_indivisible_batch(workdir, toy_gpt_layers):
     from penroz_tpu.models.model import NeuralNetworkModel
     model = NeuralNetworkModel(
         "fb", Mapper(toy_gpt_layers, {"sgd": {"lr": 0.1}})).to_device("cpu")
-    assert model._training_mesh(step_size=3, block_size=16) is None
+    assert model._training_mesh(micro_batch=3, block_size=16) is None
+
+
+def test_all_reduce_mean_single_process_identity():
+    assert dist.all_reduce_mean(3.5) == 3.5
+
+
+def test_all_reduce_mean_gathers_across_processes(monkeypatch):
+    from jax.experimental import multihost_utils
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda x: np.asarray([2.0, 4.0], np.float32))
+    assert dist.all_reduce_mean(2.0) == 3.0
 
 
 def test_process_topology_single_host():
@@ -271,11 +283,11 @@ def test_multihost_training_mesh_pure_dp(workdir, toy_gpt_layers,
     model.to_device("cpu")  # pin to the virtual 8-device CPU backend
     monkeypatch.setattr(dist, "process_count", lambda: 2)
     monkeypatch.setenv("PENROZ_MESH_MODEL", "2")
-    mesh = model._training_mesh(step_size=4, block_size=16)
+    mesh = model._training_mesh(micro_batch=4, block_size=16)
     assert mesh is not None
     assert mesh.shape["data"] == 8
     assert mesh.shape["model"] == 1
     # indivisible global micro-batch must raise, not silently train
     # divergent unsynced replicas
     with pytest.raises(ValueError, match="divisible"):
-        model._training_mesh(step_size=3, block_size=16)
+        model._training_mesh(micro_batch=3, block_size=16)

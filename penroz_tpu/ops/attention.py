@@ -59,15 +59,21 @@ def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None):
     (B, Hkv, G, T, S) with True = attend.
     """
     scale = 1.0 / (q.shape[-1] ** 0.5)
+    # HIGHEST pins true-f32 dot precision for f32 inputs: attention softmax
+    # is precision-sensitive and some backends default f32 dots to bf16-
+    # class multiplies.  bf16 inputs keep the MXU-native default.
+    precision = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
     logits = jnp.einsum("bhgtd,bhsd->bhgts", q, k,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=jnp.float32,
+                        precision=precision) * scale
     logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
     probs = probs.astype(v.dtype)
-    return jnp.einsum("bhgts,bhsd->bhgtd", probs, v)
+    return jnp.einsum("bhgts,bhsd->bhgtd", probs, v, precision=precision)
 
 
 def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None):
@@ -89,8 +95,19 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
     guess from global config — and a model explicitly placed on CPU on a
     TPU-attached host would dispatch kernels that cannot lower for CPU.
     """
-    if dropout_rate == 0.0 and _use_flash(q, k, platform):
+    if _use_flash(q, k, platform):
         from penroz_tpu.ops.pallas import flash_attention as fa
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            # Stay fused under dropout (the reference keeps fused SDPA with
+            # dropout): the kernel derives its keep-mask from an int32 seed
+            # via an in-kernel position hash — distributional parity with
+            # the bernoulli fallback, zero HBM mask traffic.
+            seed = jax.random.randint(dropout_rng, (), 0,
+                                      jnp.iinfo(jnp.int32).max,
+                                      dtype=jnp.int32)
+            return fa.flash_attention(q, k, v, causal=True,
+                                      dropout_rate=float(dropout_rate),
+                                      seed=seed)
         return fa.flash_attention(q, k, v, causal=True)
     return causal_attention_reference(q, k, v, dropout_rate, dropout_rng)
 

@@ -1,15 +1,25 @@
-"""Pallas TPU flash-attention (causal, GQA) — forward kernel.
+"""Pallas TPU flash-attention (causal, GQA, dropout) — forward + backward.
 
-Blockwise online-softmax attention: the query block stays resident in VMEM
-while K/V blocks stream through, carrying running (max, sum, accumulator)
-statistics.  This keeps the (T, S) score matrix out of HBM entirely — the
-fusion the reference gets from ``F.scaled_dot_product_attention``'s cuDNN
-flash kernels (reference: neural_net_layers.py:92), built here directly on
-the MXU.
+Blockwise online-softmax attention.  The query block stays resident in VMEM
+while K/V blocks stream through the innermost grid dimension, carrying
+running (max, sum, accumulator) statistics in VMEM scratch — so neither the
+(T, S) score matrix nor the full (S, D) K/V ever sit in VMEM at once, and
+context length is bounded by HBM only.  This is the fusion the reference
+gets from ``F.scaled_dot_product_attention``'s cuDNN flash kernels
+(reference: neural_net_layers.py:92), built directly on the MXU.
 
-The backward pass recomputes attention via the jnp reference implementation
-(flash keeps only O(T·D) residuals); a dedicated backward kernel is a later
-optimization.
+The backward is the standard flash-attention two-kernel split with in-kernel
+recompute from the forward's saved logsumexp:
+
+- ``_dq_kernel``    — query blocks resident, K/V streaming; produces dQ.
+- ``_dkv_kernel``   — key/value blocks resident, Q/dO streaming; produces
+  per-query-head dK/dV (summed over GQA groups outside).
+
+Dropout runs *inside* the kernels via a counter-based position hash
+(lowbias32-style mixer over (q_pos, k_pos, seed)), so the keep-mask needs no
+HBM storage, is identical across the forward and both backward kernels by
+construction, and — unlike the hardware PRNG — can be reproduced exactly by
+the jnp oracle (:func:`dropout_keep_mask_reference`) for equivalence tests.
 """
 
 from __future__ import annotations
@@ -18,59 +28,132 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
+_LANES = 128  # f32 scratch lane width for the (m, l) carries
+_HEAD_SEED_PRIME = np.int32(0x632BE5A7)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                sm_scale: float):
-    block_q = q_ref.shape[2]
-    head_dim = q_ref.shape[3]
-    seq_k = k_ref.shape[2]
-    qi = pl.program_id(2)
+def _dot_precision(dtype):
+    """HIGHEST for f32 operands (some backends default f32 dots to bf16-
+    class multiplies); default for bf16 — Mosaic rejects fp32 contract
+    precision on bf16 operands, and the MXU is bf16-native anyway."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
 
-    q = q_ref[0, 0]
 
-    def body(j, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+def _keep_mask(q_pos, k_pos, seed, rate: float):
+    """Boolean keep-mask from a position hash (True = keep).
+
+    ``q_pos``/``k_pos``: int32 arrays broadcastable against each other
+    (absolute sequence positions); ``seed``: int32 scalar already mixed
+    with the (batch, head) index.  Pure jnp — traced identically inside
+    the Pallas kernels and in the test oracle, so the mask is exactly
+    reproducible.
+    """
+    x = (q_pos.astype(jnp.uint32) * np.uint32(0x9E3779B1)
+         ^ k_pos.astype(jnp.uint32) * np.uint32(0x85EBCA77)
+         ^ seed.astype(jnp.uint32) * np.uint32(0xC2B2AE3D))
+    x = x ^ (x >> 16)
+    x = x * np.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * np.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    threshold = np.uint32(min(int((1.0 - rate) * 2.0 ** 32), 2 ** 32 - 1))
+    return x < threshold
+
+
+def dropout_keep_mask_reference(seed, b, h, num_heads: int, T: int, S: int,
+                                rate: float):
+    """(T, S) keep-mask the kernels generate for batch ``b``, head ``h``."""
+    q_pos = jnp.arange(T, dtype=jnp.int32)[:, None]
+    k_pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+    seed_bh = (jnp.asarray(seed, jnp.int32)
+               + jnp.asarray(b * num_heads + h, jnp.int32)
+               * _HEAD_SEED_PRIME)
+    return _keep_mask(q_pos, k_pos, seed_bh, rate)
+
+
+def _block_positions(qi, kj, block_q: int, block_k: int):
+    """Absolute (q_pos, k_pos) int32 grids of shape (block_q, block_k)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_pos, k_pos
+
+
+def _head_seed(seed_ref, b, h, num_heads: int):
+    return seed_ref[0] + (b * num_heads + h) * _HEAD_SEED_PRIME
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, causal: bool, sm_scale: float,
+                block_q: int, block_k: int, num_k: int, num_heads: int,
+                dropout_rate: float):
+    b, h, qi, kj = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
+                    pl.program_id(3))
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Blocks strictly above the diagonal contribute nothing under causal.
+    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(q.dtype)) * sm_scale
+        q_pos, k_pos = _block_positions(qi, kj, block_q, block_k)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        if dropout_rate > 0.0:
+            # l accumulates the *undropped* probabilities (dropout applies
+            # after softmax normalization); only the V-contraction drops.
+            keep = _keep_mask(q_pos, k_pos,
+                              _head_seed(seed_ref, b, h, num_heads),
+                              dropout_rate)
+            p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+        else:
+            p_acc = p
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(v.dtype))
+        m_scr[...] = jax.lax.broadcast_in_dim(m_new, m_scr.shape, (0,))
+        l_scr[...] = jax.lax.broadcast_in_dim(l_new, l_scr.shape, (0,))
 
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-
-    if causal:
-        # Only K blocks at or below this query block's diagonal contribute.
-        hi = jax.lax.div(qi * block_q + block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, seq_k // block_k)
-    else:
-        hi = seq_k // block_k
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(kj == num_k - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(l_safe))[:, None]
 
 
 def _largest_dividing_block(n: int, preferred: int) -> int:
@@ -81,8 +164,11 @@ def _largest_dividing_block(n: int, preferred: int) -> int:
     return block
 
 
-def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool = False):
+def _flash_forward(q, k, v, causal: bool = True,
+                   block_q: int = DEFAULT_BLOCK_Q,
+                   block_k: int = DEFAULT_BLOCK_K,
+                   dropout_rate: float = 0.0, seed=None,
+                   interpret: bool = False, return_lse: bool = False):
     B, Hq, T, D = q.shape
     Hkv, S = k.shape[1], k.shape[2]
     group = Hq // Hkv
@@ -94,57 +180,325 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         raise ValueError(f"flash_attention requires T%{block_q}==0 and "
                          f"S%{block_k}==0; got T={T}, S={S}")
     sm_scale = 1.0 / (D ** 0.5)
+    num_k = S // block_k
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
 
-    grid = (B, Hq, T // block_q)
-    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                               sm_scale=sm_scale)
-    return pl.pallas_call(
+    grid = (B, Hq, T // block_q, num_k)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, num_k=num_k, num_heads=Hq,
+        dropout_rate=dropout_rate)
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, h, i: (b, h, i, 0),
+                         lambda b, h, i, j: (b, h, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, S, D),
-                         lambda b, h, i: (b, h // group, 0, 0),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // group, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, S, D),
-                         lambda b, h, i: (b, h // group, 0, 0),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // group, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i: (b, h, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            # (…, 1) trailing lane: Mosaic requires the last two block dims
+            # be (8, 128)-divisible or equal to the array dims.
+            jax.ShapeDtypeStruct((B, Hq, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=int(4 * B * Hq * T * S * D * (0.5 if causal else 1.0)),
             bytes_accessed=int((q.size + k.size + v.size + q.size)
                                * q.dtype.itemsize),
             transcendentals=int(B * Hq * T * S)),
         interpret=interpret,
-    )(q, k, v)
+    )(seed, q, k, v)
+    return (out, lse) if return_lse else out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _recompute_probs(q, k, lse, qi, kj, seed_ref, b, h, *, causal: bool,
+                     sm_scale: float, block_q: int, block_k: int,
+                     num_heads: int, dropout_rate: float):
+    """Normalized probabilities p (and the dropout keep-scale) for one
+    (query-block, key-block) tile, identical to the forward's math."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=_dot_precision(q.dtype)) * sm_scale
+    q_pos, k_pos = _block_positions(qi, kj, block_q, block_k)
+    if causal:
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    if dropout_rate > 0.0:
+        keep = _keep_mask(q_pos, k_pos,
+                          _head_seed(seed_ref, b, h, num_heads),
+                          dropout_rate)
+        drop_scale = jnp.where(keep, 1.0 / (1.0 - dropout_rate), 0.0)
+    else:
+        drop_scale = None
+    return p, drop_scale
+
+
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
+               dq_ref, dq_scr, *, causal: bool, sm_scale: float,
+               block_q: int, block_k: int, num_k: int, num_heads: int,
+               dropout_rate: float):
+    b, h, qi, kj = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
+                    pl.program_id(3))
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        p, drop_scale = _recompute_probs(
+            q, k, lse_ref[0, 0][:, 0], qi, kj, seed_ref, b, h, causal=causal,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            num_heads=num_heads, dropout_rate=dropout_rate)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(v.dtype))
+        if drop_scale is not None:
+            dp = dp * drop_scale
+        ds = p * (dp - delta_ref[0, 0]) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(k.dtype))
+
+    @pl.when(kj == num_k - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                sm_scale: float, block_q: int, block_k: int, num_q: int,
+                num_heads: int, dropout_rate: float):
+    b, h, kj, qi = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
+                    pl.program_id(3))
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (qi * block_q + block_q - 1 >= kj * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        p, drop_scale = _recompute_probs(
+            q, k, lse_ref[0, 0][:, 0], qi, kj, seed_ref, b, h, causal=causal,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            num_heads=num_heads, dropout_rate=dropout_rate)
+        p_drop = p if drop_scale is None else p * drop_scale
+        # dV += p̃ᵀ · dO
+        dv_scr[...] += jax.lax.dot_general(
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(do.dtype))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(v.dtype))
+        if drop_scale is not None:
+            dp = dp * drop_scale
+        ds = p * (dp - delta_ref[0, 0]) * sm_scale
+        # dK += dSᵀ · Q
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(q.dtype))
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
+                    block_k: int, dropout_rate: float, seed,
+                    interpret: bool = False):
+    B, Hq, T, D = q.shape
+    Hkv, S = k.shape[1], k.shape[2]
+    group = Hq // Hkv
+    block_q = _largest_dividing_block(T, block_q)
+    block_k = _largest_dividing_block(S, block_k)
+    sm_scale = 1.0 / (D ** 0.5)
+    num_q = T // block_q
+    num_k = S // block_k
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+
+    # δ_i = Σ_d dO_id · O_id — the softmax-backward row term; O(B·H·T·D),
+    # cheap enough to fuse outside the kernels.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, 1, block_k, D),
+                           lambda b, h, i, j: (b, h // group, j, 0),
+                           memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda b, h, i, j: (b, h, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k, num_k=num_k,
+                          num_heads=Hq, dropout_rate=dropout_rate),
+        grid=(B, Hq, num_q, num_k),
+        in_specs=[seed_spec, q_spec, kv_spec, kv_spec, row_spec, row_spec,
+                  q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=int(5 * B * Hq * T * S * D * (0.5 if causal else 1.0)),
+            bytes_accessed=int((3 * q.size + 2 * k.size)
+                               * q.dtype.itemsize),
+            transcendentals=int(B * Hq * T * S)),
+        interpret=interpret,
+    )(seed, q, k, v, lse, delta, g)
+
+    # K/V-resident kernel: Q, dO, lse, δ stream through the inner grid.
+    # index maps take (b, h, kj, qi) — note q-row specs select on qi (dim 3).
+    q_stream = pl.BlockSpec((1, 1, block_q, D),
+                            lambda b, h, j, i: (b, h, i, 0),
+                            memory_space=pltpu.VMEM)
+    kv_res = pl.BlockSpec((1, 1, block_k, D),
+                          lambda b, h, j, i: (b, h // group, j, 0),
+                          memory_space=pltpu.VMEM)
+    row_stream = pl.BlockSpec((1, 1, block_q, 1),
+                              lambda b, h, j, i: (b, h, i, 0),
+                              memory_space=pltpu.VMEM)
+    dkv_out = pl.BlockSpec((1, 1, block_k, D),
+                           lambda b, h, j, i: (b, h, j, 0),
+                           memory_space=pltpu.VMEM)
+    dk_ph, dv_ph = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k, num_q=num_q,
+                          num_heads=Hq, dropout_rate=dropout_rate),
+        grid=(B, Hq, num_k, num_q),
+        in_specs=[seed_spec, q_stream, kv_res, kv_res, row_stream,
+                  row_stream, q_stream],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[jax.ShapeDtypeStruct((B, Hq, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, Hq, S, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=int(5 * B * Hq * T * S * D * (0.5 if causal else 1.0)),
+            bytes_accessed=int((3 * q.size + 4 * B * Hq * S * D)
+                               * q.dtype.itemsize),
+            transcendentals=int(B * Hq * T * S)),
+        interpret=interpret,
+    )(seed, q, k, v, lse, delta, g)
+
+    if group > 1:
+        dk = dk_ph.reshape(B, Hkv, group, S, D).sum(axis=2).astype(k.dtype)
+        dv = dv_ph.reshape(B, Hkv, group, S, D).sum(axis=2).astype(v.dtype)
+    else:
+        dk = dk_ph.astype(k.dtype)
+        dv = dv_ph.astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seed, causal, block_q, block_k, dropout_rate, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_k,
+                         dropout_rate=dropout_rate, seed=seed,
+                         interpret=interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, seed, causal, block_q, block_k, dropout_rate,
+                    interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
+                              dropout_rate=dropout_rate, seed=seed,
+                              interpret=interpret, return_lse=True)
+    return out, (q, k, v, seed, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, dropout_rate, interpret,
+                    residuals, g):
+    q, k, v, seed, out, lse = residuals
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, block_q,
+                                 block_k, dropout_rate, seed,
+                                 interpret=interpret)
+    return dq, dk, dv, np.zeros((), dtype=jax.dtypes.float0)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
-    """Flash attention. q: (B, Hq, T, D); k, v: (B, Hkv, S, D) with Hq % Hkv == 0."""
-    return _flash_forward(q, k, v, causal, block_q, block_k)
+                    block_k: int = DEFAULT_BLOCK_K,
+                    dropout_rate: float = 0.0, seed=None,
+                    interpret: bool = False):
+    """Flash attention with a fused flash backward.
 
-
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
-    return flash_attention(q, k, v, causal, block_q, block_k), (q, k, v)
-
-
-def _flash_bwd_rule(causal, block_q, block_k, residuals, g):
-    from penroz_tpu.ops.attention import causal_attention_reference
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: causal_attention_reference(q_, k_, v_),
-                     q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+    q: (B, Hq, T, D); k, v: (B, Hkv, S, D) with Hq % Hkv == 0.
+    ``dropout_rate`` > 0 applies post-softmax dropout inside the kernels
+    (mask derived from ``seed`` — pass a fresh int32 scalar per step).
+    """
+    if seed is None:
+        seed = jnp.zeros((), jnp.int32)
+    return _flash(q, k, v, jnp.asarray(seed, jnp.int32), causal,
+                  int(block_q), int(block_k), float(dropout_rate),
+                  bool(interpret))

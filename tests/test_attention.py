@@ -103,29 +103,124 @@ def test_flash_kernel_odd_tail_blocks():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_flash_gradient_path():
-    """custom_vjp backward (recompute) produces finite grads matching oracle."""
+def _grad_close(got, want, rel=2e-4):
+    for name, a, b in zip("qkv", got, want):
+        err = float(jnp.abs(a - b).max())
+        scale = max(float(jnp.abs(b).max()), 1.0)
+        assert err <= rel * scale, f"d{name}: {err} > {rel} * {scale}"
+
+
+def test_flash_backward_kernels_match_oracle():
+    """The Pallas dq/dkv kernels (interpret) match the jnp oracle's grads."""
     from penroz_tpu.ops.pallas import flash_attention as FA
-    B, H, T, D = 1, 1, 128, 64
+    B, H, T, D = 1, 2, 256, 64
     rng = np.random.default_rng(3)
     q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
     k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
     v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    gf = jax.grad(lambda q, k, v: FA.flash_attention(
+        q, k, v, True, 128, 128, interpret=True).sum(), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: A.causal_attention_reference(
+        q, k, v).sum(), (0, 1, 2))(q, k, v)
+    _grad_close(gf, gr)
 
-    def loss_flash(q, k, v):
-        return FA.flash_attention(q, k, v, True, 128, 128).sum()
 
-    def loss_ref(q, k, v):
-        return A.causal_attention_reference(q, k, v).sum()
+def test_flash_backward_gqa_group_sum():
+    """GQA backward: per-query-head dK/dV fold correctly over the group."""
+    from penroz_tpu.ops.pallas import flash_attention as FA
+    B, Hq, Hkv, T, D = 2, 4, 2, 256, 64
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, Hkv, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, Hkv, T, D)).astype(np.float32))
+    gf = jax.grad(lambda q, k, v: FA.flash_attention(
+        q, k, v, True, 128, 128, interpret=True).sum(), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: A.causal_attention_reference(
+        q, k, v).sum(), (0, 1, 2))(q, k, v)
+    _grad_close(gf, gr)
 
-    # flash fwd runs the kernel; on CPU tests we use the interpret path via
-    # the reference oracle for fwd equivalence, so compare grads directly.
-    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    _, vjp = jax.vjp(lambda a, b, c: A.causal_attention_reference(a, b, c),
-                     q, k, v)
-    g_vjp = vjp(jnp.ones((B, H, T, D)))
-    for a, b in zip(g_ref, g_vjp):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+def test_flash_backward_long_context_t4096():
+    """VERDICT done-criterion: grad parity vs the oracle at T≥4096 — the
+    K-grid-tiled kernels never hold (T, S) scores or full (S, D) K/V in
+    VMEM, so long context lowers and matches."""
+    from penroz_tpu.ops.pallas import flash_attention as FA
+    B, H, T, D = 1, 1, 4096, 64
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    gf = jax.grad(lambda q, k, v: FA.flash_attention(
+        q, k, v, True, 512, 512, interpret=True).sum(), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: A.causal_attention_reference(
+        q, k, v).sum(), (0, 1, 2))(q, k, v)
+    _grad_close(gf, gr)
+
+
+def _masked_dropout_oracle(q, k, v, rate, seed):
+    """Causal attention applying the kernels' exact hash-derived keep-mask
+    (flash_attention.dropout_keep_mask_reference) — the fixed-mask oracle."""
+    from penroz_tpu.ops.pallas import flash_attention as FA
+    HI = jax.lax.Precision.HIGHEST
+    B, Hq, T, D = q.shape
+    group = Hq // k.shape[1]
+    outs = []
+    for b in range(B):
+        heads = []
+        for h in range(Hq):
+            s = jnp.matmul(q[b, h], k[b, h // group].T,
+                           precision=HI) / (D ** 0.5)
+            s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+            p = jax.nn.softmax(s, -1)
+            keep = FA.dropout_keep_mask_reference(seed, b, h, Hq, T, T, rate)
+            p = jnp.where(keep, p / (1 - rate), 0.0)
+            heads.append(jnp.matmul(p, v[b, h // group], precision=HI))
+        outs.append(jnp.stack(heads))
+    return jnp.stack(outs)
+
+
+def test_flash_dropout_matches_fixed_mask_oracle():
+    """Kernel dropout == oracle applying the identical hash mask: forward
+    exactly, gradients through both backward kernels."""
+    from penroz_tpu.ops.pallas import flash_attention as FA
+    B, Hq, Hkv, T, D = 2, 4, 2, 256, 64
+    rate, seed = 0.3, 1234
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, Hkv, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, Hkv, T, D)).astype(np.float32))
+    out = FA._flash_forward(q, k, v, causal=True, block_q=128, block_k=128,
+                            dropout_rate=rate, seed=seed, interpret=True)
+    ref = _masked_dropout_oracle(q, k, v, rate, seed)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # dropout actually drops (outputs differ from the no-dropout kernel)
+    base = FA._flash_forward(q, k, v, causal=True, block_q=128, block_k=128,
+                             interpret=True)
+    assert float(jnp.abs(out - base).max()) > 0.01
+    gk = jax.grad(lambda q, k, v: FA.flash_attention(
+        q, k, v, True, 128, 128, dropout_rate=rate, seed=seed,
+        interpret=True).sum(), (0, 1, 2))(q, k, v)
+    go = jax.grad(lambda q, k, v: _masked_dropout_oracle(
+        q, k, v, rate, seed).sum(), (0, 1, 2))(q, k, v)
+    _grad_close(gk, go)
+
+
+def test_dropout_keeps_kernel_dispatch(monkeypatch):
+    """dropout>0 on TPU still dispatches the flash kernel (the reference
+    keeps fused SDPA under dropout; round-1 fell back to the jnp path)."""
+    from penroz_tpu.ops.pallas import flash_attention as FA
+    calls = {}
+
+    def fake_flash(q, k, v, **kwargs):
+        calls.update(kwargs)
+        return jnp.zeros_like(q)
+
+    monkeypatch.setattr(FA, "flash_attention", fake_flash)
+    q, k, v = _qkv(B=1, Hq=2, Hkv=2, T=128, D=64)
+    A.causal_attention(q, k, v, dropout_rate=0.1,
+                       dropout_rng=jax.random.key(0), platform="tpu")
+    assert calls.get("dropout_rate") == 0.1
+    assert "seed" in calls
 
 
 def test_decode_kernel_matches_oracle_interpret():

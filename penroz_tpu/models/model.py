@@ -35,6 +35,7 @@ import optax
 from penroz_tpu.models import dsl
 from penroz_tpu.models.dsl import Mapper
 from penroz_tpu.ops import kv_cache as KV
+from penroz_tpu.ops import losses
 from penroz_tpu.ops import modules as M
 from penroz_tpu.parallel import dist
 from penroz_tpu.parallel import mesh as mesh_lib
@@ -157,13 +158,16 @@ class CompiledArch:
             logits = h
         return acts, logits, ctx
 
-    def _cost_from_logits(self, logits, targets):
+    def _cost_from_logits(self, logits, targets, platform=None):
         """CE for classification stacks, MSE otherwise (reference forward
-        cost semantics: neural_net_model.py:250-271)."""
+        cost semantics: neural_net_model.py:250-271).
+
+        CE streams chunks through a fused custom-VJP loss (Pallas kernels on
+        TPU) instead of upcasting the full (B, T, V) logits to fp32
+        (ops/losses.py)."""
         if self.classification:
-            lg = logits.astype(jnp.float32)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                lg, targets).mean()
+            return losses.fused_cross_entropy_mean(logits, targets,
+                                                   platform=platform)
         return jnp.mean((logits.astype(jnp.float32)
                          - targets.astype(jnp.float32)) ** 2)
 
@@ -180,7 +184,7 @@ class CompiledArch:
             params, buffers, tokens, training=training, rng=rng, kv=kv,
             pos_offset=pos_offset, skip_softmax=skip_softmax,
             compute_dtype=compute_dtype, sp_mesh=sp_mesh, platform=platform)
-        cost = (self._cost_from_logits(logits, targets)
+        cost = (self._cost_from_logits(logits, targets, platform=platform)
                 if targets is not None else None)
         new_kv = ctx.kv.advanced(tokens.shape[-1]) if ctx.kv is not None else None
         return acts, cost, ctx.buffer_updates, new_kv
@@ -244,13 +248,27 @@ class CompiledArch:
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
         def epoch(params, opt_state, buffers, xs, ys, rng):
+            # Cast params to the compute dtype ONCE per epoch, outside the
+            # micro-step scan — the cast's VJP is an upcast of the incoming
+            # (bf16) gradients, so accumulating them in fp32 below yields
+            # bit-identical grads to casting inside every micro-step while
+            # saving num_steps-1 full passes over the parameters.
+            if compute_dtype is not None:
+                params_c = {
+                    k: v.astype(compute_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v
+                    for k, v in params.items()}
+            else:
+                params_c = params
+
             def micro(carry, batch):
                 grads_acc, bufs, cost_acc, i = carry
                 x, y = batch
-                (cost, upd), grads = grad_fn(params, bufs, x, y,
+                (cost, upd), grads = grad_fn(params_c, bufs, x, y,
                                              jax.random.fold_in(rng, i))
                 bufs = {**bufs, **upd}
-                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                grads_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
                 return (grads_acc, bufs, cost_acc + cost, i + 1), None
 
             zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
@@ -374,7 +392,7 @@ class CompiledArch:
                         continue
                     h = mod.apply(h, ctx) + d[i]
                     i += 1
-                return self._cost_from_logits(h, yb)
+                return self._cost_from_logits(h, yb, platform=platform)
 
             fn = self._jit_cache[key] = jax.jit(
                 lambda p, d, xb, yb, bufs:
@@ -579,8 +597,29 @@ class NeuralNetworkModel:
                                               mesh_lib.replicated(mesh))
                 if mesh.shape[mesh_lib.SEQ_AXIS] > 1:
                     sp_mesh = mesh
+            # PENROZ_REMAT=1 rematerializes the forward inside the backward
+            # (jax.checkpoint) — trades ~1/3 more FLOPs for activation memory,
+            # the lever for configs that would otherwise exceed HBM.
+            remat = os.environ.get("PENROZ_REMAT", "0") == "1"
+            # Reference parity: training autocasts to bf16 on CUDA
+            # (neural_net_model.py:567-578) and stays full-precision on CPU.
+            # The TPU-native equivalent is bf16 compute on TPU — params and
+            # optimizer state remain fp32; no GradScaler is needed on TPU.
+            # PENROZ_TRAIN_DTYPE=float32|bfloat16 overrides.
+            dtype_env = os.environ.get("PENROZ_TRAIN_DTYPE", "")
+            if dtype_env:
+                compute_dtype = (None if dtype_env == "float32"
+                                 else jnp.dtype(dtype_env))
+            elif self._platform in ("tpu", "axon") or (
+                    self._platform is None
+                    and jax.default_backend() in ("tpu", "axon")):
+                compute_dtype = jnp.bfloat16
+            else:
+                compute_dtype = None
             epoch_fn = self.arch.train_epoch_fn(self.optimizer_config,
-                                                num_steps, sp_mesh=sp_mesh,
+                                                num_steps, remat=remat,
+                                                compute_dtype=compute_dtype,
+                                                sp_mesh=sp_mesh,
                                                 platform=self._platform)
             rng = jax.random.key(0)
             last_save = time.monotonic()

@@ -26,6 +26,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any, Optional, Sequence
 
@@ -129,6 +130,52 @@ def _uniform(rng, shape, bound, dtype=jnp.float32):
 # Leaf layers
 # ---------------------------------------------------------------------------
 
+_GATHER_BWD_CHUNK = 1024
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _gather_rows(table, ids, num_rows: int, dtype_name: str):
+    return jnp.take(table, ids, axis=0)
+
+
+def _gather_rows_fwd(table, ids, num_rows: int, dtype_name: str):
+    return jnp.take(table, ids, axis=0), ids
+
+
+def _gather_rows_bwd(num_rows: int, dtype_name: str, ids, g):
+    """one-hotᵀ @ g instead of scatter-add: XLA TPU lowers row-scatter with
+    thousands of update rows to a serialized loop, while the matmul rides
+    the MXU (the dense AdamW update over the full table dominates the
+    optimizer step anyway, so a dense gradient costs nothing extra there).
+    The contraction streams id-chunks through a scan so the transient
+    one-hot operand stays at (num_rows, chunk) — ~100 MB for a GPT-2 vocab —
+    instead of a full (num_rows, B·T) buffer in HBM."""
+    flat_ids = ids.reshape(-1)
+    d = g.shape[-1]
+    gf = g.reshape(-1, d)
+    chunk = min(_GATHER_BWD_CHUNK, flat_ids.shape[0])
+    pad = -flat_ids.shape[0] % chunk
+    if pad:
+        # -1 ids produce an all-zero one-hot column → no grad contribution.
+        flat_ids = jnp.pad(flat_ids, (0, pad), constant_values=-1)
+        gf = jnp.pad(gf, ((0, pad), (0, 0)))
+    idc = flat_ids.reshape(-1, chunk)
+    gc = gf.reshape(-1, chunk, d)
+
+    def step(acc, ch):
+        cid, cg = ch
+        onehot = jax.nn.one_hot(cid, num_rows, dtype=cg.dtype, axis=0)
+        return acc + jnp.matmul(onehot, cg).astype(jnp.float32), None
+
+    acc0 = jnp.zeros((num_rows, d), jnp.float32)
+    dw, _ = jax.lax.scan(step, acc0, (idc, gc))
+    return (dw.astype(jnp.dtype(dtype_name)),
+            np.zeros(ids.shape, dtype=jax.dtypes.float0))
+
+
+_gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
+
+
 class Embedding(Module):
     def __init__(self, num_embeddings: int, embedding_dim: int):
         self.num_embeddings = int(num_embeddings)
@@ -142,7 +189,11 @@ class Embedding(Module):
         return {self.key("weight"): w}
 
     def apply(self, x, ctx):
-        return jnp.take(self._p(ctx, "weight"), x, axis=0)
+        w = self._p(ctx, "weight")
+        if attn_ops._tpu_platform(w, ctx.platform):
+            # TPU: matmul-based backward (see _gather_rows_bwd).
+            return _gather_rows(w, x, self.num_embeddings, w.dtype.name)
+        return jnp.take(w, x, axis=0)  # CPU scatter-add VJP is fine
 
 
 class ScaledEmbedding(Embedding):
@@ -167,6 +218,10 @@ class PositionEmbedding(Embedding):
 
     def apply(self, x, ctx):
         num_positions = x.shape[-1]
+        # Per-index clamping (jnp.take) — a dynamic slice would shift the
+        # whole window on overflow, corrupting still-valid positions.  The
+        # scatter in this VJP touches at most num_positions contiguous rows,
+        # which XLA handles fine.
         positions = ctx.offset() + jnp.arange(num_positions, dtype=jnp.int32)
         return jnp.take(self._p(ctx, "weight"), positions, axis=0)
 

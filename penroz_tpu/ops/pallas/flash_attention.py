@@ -32,8 +32,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 _LANES = 128  # f32 scratch lane width for the (m, l) carries
 _HEAD_SEED_PRIME = np.int32(0x632BE5A7)

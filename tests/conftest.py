@@ -7,8 +7,12 @@ sharded compilation and collectives in-process (SURVEY.md §4 implication).
 
 import os
 
-# Must be set before jax initializes.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes.  Forced (not setdefault): some sandboxes
+# export JAX_PLATFORMS=<accelerator> globally and the suite is CPU-hermetic.
+# Note this cannot undo a sitecustomize-registered PJRT plugin that dials a
+# remote accelerator at backend init — for full hermeticity also launch
+# pytest with a scrubbed PYTHONPATH (no plugin site dir).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +

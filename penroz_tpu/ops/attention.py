@@ -171,7 +171,7 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
 
 def _use_paged_kernel(q, flat_k, block_table, page_size: int,
                       platform=None) -> bool:
-    if not _tpu_platform(q, platform):
+    if _flash_disabled() or not _tpu_platform(q, platform):
         return False
     B, Hq, T, D = q.shape
     Hkv = flat_k.shape[0]
@@ -179,8 +179,16 @@ def _use_paged_kernel(q, flat_k, block_table, page_size: int,
             and Hq % Hkv == 0 and (Hq // Hkv) * T <= 512)
 
 
+def _flash_disabled() -> bool:
+    """PENROZ_DISABLE_FLASH=1 disables the Pallas *attention* kernels only —
+    other Pallas consumers (fused CE, embedding backward) gate on
+    :func:`_tpu_platform` directly so an attention A/B stays isolated."""
+    import os
+    return os.environ.get("PENROZ_DISABLE_FLASH", "0") == "1"
+
+
 def _tpu_platform(x, platform=None) -> bool:
-    """Whether attention on ``x`` will run on TPU.
+    """Whether computation on ``x`` will run on TPU (pure platform check).
 
     ``platform`` — the caller's placement hint — wins when given.  Otherwise:
     a concrete array knows its device; a tracer doesn't, and
@@ -188,9 +196,6 @@ def _tpu_platform(x, platform=None) -> bool:
     ``jax_default_device`` pins computation elsewhere (e.g. CPU tests on a
     TPU-attached host), so the config is consulted before the backend.
     """
-    import os
-    if os.environ.get("PENROZ_DISABLE_FLASH", "0") == "1":
-        return False
     if platform is not None:
         return platform in ("tpu", "axon")
     try:
@@ -214,7 +219,7 @@ def _tpu_platform(x, platform=None) -> bool:
 
 def _use_flash(q, k, platform=None) -> bool:
     """Whether the Pallas flash kernel applies to these shapes/platform."""
-    if not _tpu_platform(q, platform):
+    if _flash_disabled() or not _tpu_platform(q, platform):
         return False
     B, Hq, T, D = q.shape
     Hkv = k.shape[1]
@@ -226,7 +231,7 @@ def _use_flash(q, k, platform=None) -> bool:
 def _use_flash_decode(q, k_full, platform=None) -> bool:
     """Whether the Pallas decode kernel applies (static shape checks only —
     offset/length are traced)."""
-    if not _tpu_platform(q, platform):
+    if _flash_disabled() or not _tpu_platform(q, platform):
         return False
     B, Hq, T, D = q.shape
     Hkv, S = k_full.shape[1], k_full.shape[2]

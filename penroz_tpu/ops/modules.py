@@ -165,7 +165,10 @@ def _gather_rows_bwd(num_rows: int, dtype_name: str, ids, g):
     def step(acc, ch):
         cid, cg = ch
         onehot = jax.nn.one_hot(cid, num_rows, dtype=cg.dtype, axis=0)
-        return acc + jnp.matmul(onehot, cg).astype(jnp.float32), None
+        # fp32 MXU accumulation — a bf16 product would round each chunk's
+        # per-id gradient sum to 8 mantissa bits before the fp32 carry add.
+        return acc + jnp.matmul(onehot, cg,
+                                preferred_element_type=jnp.float32), None
 
     acc0 = jnp.zeros((num_rows, d), jnp.float32)
     dw, _ = jax.lax.scan(step, acc0, (idc, gc))

@@ -338,11 +338,31 @@ async def delete_model(request: web.Request):
     return web.Response(status=204)
 
 
+async def openapi_json(request: web.Request):
+    """OpenAPI 3.1 spec (FastAPI gives the reference this for free;
+    serve/openapi.py generates ours from the same pydantic schemas)."""
+    from penroz_tpu.serve import openapi
+    global _OPENAPI_CACHE
+    if _OPENAPI_CACHE is None:
+        _OPENAPI_CACHE = openapi.spec_json()
+    return web.Response(text=_OPENAPI_CACHE, content_type="application/json")
+
+
+async def docs(request: web.Request):
+    from penroz_tpu.serve import openapi
+    return web.Response(text=openapi.docs_html(), content_type="text/html")
+
+
+_OPENAPI_CACHE = None
+
+
 def create_app() -> web.Application:
     app = web.Application(middlewares=[error_middleware, gzip_middleware],
                           client_max_size=1024 ** 3)
     app.router.add_get("/", redirect_to_dashboard)
     app.router.add_get("/dashboard", dashboard)
+    app.router.add_get("/openapi.json", openapi_json)
+    app.router.add_get("/docs", docs)
     app.router.add_post("/model/", create_model)
     app.router.add_post("/import/", import_from_huggingface)
     app.router.add_get("/dataset/", list_dataset)

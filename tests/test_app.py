@@ -309,3 +309,34 @@ def test_configure_logging_all_paths(monkeypatch, tmp_path, capsys):
         "root": {"handlers": ["default"]}}))
     monkeypatch.setenv("PENROZ_LOG_CONFIG", str(config))
     app_mod._configure_logging()
+
+
+def test_openapi_spec(client):
+    """OpenAPI parity with the reference's FastAPI docs surface: the spec
+    covers every route and /model/ carries the GPT-2-124M example
+    (reference: main.py:53-93)."""
+    status, spec = client.json("GET", "/openapi.json")
+    assert status == 200
+    assert spec["openapi"].startswith("3.")
+    for path in ["/model/", "/import/", "/dataset/", "/tokenize/",
+                 "/output/", "/evaluate/", "/generate/", "/decode/",
+                 "/train/", "/progress/", "/stats/", "/profile/",
+                 "/dashboard"]:
+        assert path in spec["paths"], path
+    assert set(spec["paths"]["/dataset/"]) == {"get", "post", "delete"}
+    assert "CreateModelRequest" in spec["components"]["schemas"]
+    example = (spec["paths"]["/model/"]["post"]["requestBody"]["content"]
+               ["application/json"]["example"])
+    assert example["model_id"] == "gpt2-124M"
+    embed = example["layers"][0]["summation"][0]["embedding"]
+    assert embed == {"num_embeddings": 50257, "embedding_dim": 768}
+    blocks = [l for l in example["layers"] if "residual" in l]
+    assert len(blocks) == 12
+    assert "adamw" in example["optimizer"]
+
+
+def test_docs_page(client):
+    resp, body = client.request("GET", "/docs")
+    assert resp.status == 200
+    assert "text/html" in resp.headers["Content-Type"]
+    assert b"openapi.json" in body

@@ -570,6 +570,8 @@ class NeuralNetworkModel:
         """
         from penroz_tpu.data.loaders import Loader
         master = dist.master_proc()
+        saves_shards = False
+        epoch = 0
         try:
             world = dist.process_count()
             rank = dist.process_index()
@@ -591,12 +593,22 @@ class NeuralNetworkModel:
             if mesh is not None:
                 log.info("Training over device mesh %s", dict(mesh.shape))
                 self.params = sharding_lib.shard_params(self.params, mesh)
-                self.opt_state = jax.device_put(self.opt_state,
-                                                mesh_lib.replicated(mesh))
+                # Optimizer moments follow the parameter TP layout so no
+                # host ever holds the full state (sharded checkpointing).
+                self.opt_state = jax.device_put(
+                    self.opt_state,
+                    sharding_lib.opt_state_sharding_tree(self.opt_state,
+                                                         self.params, mesh))
                 self.buffers = jax.device_put(self.buffers,
                                               mesh_lib.replicated(mesh))
                 if mesh.shape[mesh_lib.SEQ_AXIS] > 1:
                     sp_mesh = mesh
+            # With cross-host-sharded params every process must persist its
+            # own shard file at each checkpoint; the master also writes the
+            # metadata blob (serialize() handles the split internally).
+            saves_shards = (mesh is not None and world > 1
+                            and not all(self._is_host_readable(v)
+                                        for v in self.params.values()))
             # PENROZ_REMAT=1 rematerializes the forward inside the backward
             # (jax.checkpoint) — trades ~1/3 more FLOPs for activation memory,
             # the lever for configs that would otherwise exceed HBM.
@@ -635,6 +647,13 @@ class NeuralNetworkModel:
             for epoch in range(epochs):
                 t0 = time.monotonic()
                 long_training = t0 - last_save >= 10
+                if saves_shards:
+                    # All hosts must agree on checkpoint epochs or the blob
+                    # and the per-host shard files would mix training steps;
+                    # a tiny scalar reduction makes the clock-based decision
+                    # deterministic across the fleet.
+                    long_training = dist.all_reduce_mean(
+                        1.0 if long_training else 0.0) >= 0.5
                 with profiling.span("penroz/load_batch"):
                     xs, ys = [], []
                     for _ in range(num_steps):
@@ -671,25 +690,32 @@ class NeuralNetworkModel:
                     log.info("Epoch %d: cost=%.4f %.0f tokens/sec",
                              epoch + 1, cost,
                              buffer_size / max(duration, 1e-9))
-                    if long_training:
+                if long_training:
+                    if master:
                         refresh = (time.monotonic() - last_stats
                                    >= stats_interval)
                         self._record_overall_progress(
                             last_batch if refresh else None)
                         if refresh:
                             last_stats = time.monotonic()
-                        self.serialize()
-                        last_save = time.monotonic()
+                    if master or saves_shards:
+                        self.serialize(tag=epoch)
+                    last_save = time.monotonic()
             self.status = {"code": "Trained",
                            "message": f"Trained {epochs} epoch(s)"}
             if master:
                 self._record_overall_progress(last_batch)
-                self.serialize()
+            if master or saves_shards:
+                self.serialize(tag=epochs)
         except Exception as e:  # noqa: BLE001
             self.status = {"code": "Error", "message": str(e)}
-            if master:
+            # With sharded params EVERY host must write its crash-time
+            # shard — a master-only blob would mix steps with the other
+            # hosts' older shard files (the load-time tag check would then
+            # reject the checkpoint outright).
+            if master or saves_shards:
                 try:
-                    self.serialize(sync_flush=True)
+                    self.serialize(sync_flush=True, tag=("error", epoch))
                 except Exception:  # noqa: BLE001
                     log.exception("Failed to persist error status")
             raise
@@ -765,13 +791,14 @@ class NeuralNetworkModel:
                                   expert=expert)
 
     def _multihost_mesh(self, micro_batch: int):
-        """Global data-parallel mesh spanning every host's devices.
+        """Global mesh spanning every host's devices.
 
-        Pure DP for now: params/optimizer stay replicated, so each process
-        can materialize them for checkpointing; the data axis is ordered by
-        process (jax.devices() groups by process_index), so each host's
-        rank-strided loader rows land on its own chips.  TP/SP/EP across
-        hosts needs sharded checkpointing first.
+        The data axis is ordered by process (jax.devices() groups by
+        process_index), so each host's rank-strided loader rows land on its
+        own chips.  PENROZ_MESH_MODEL / PENROZ_MESH_SEQUENCE /
+        PENROZ_MESH_EXPERT carve TP/SP/EP axes out of the global device set;
+        the resulting cross-host-sharded params/optimizer are persisted via
+        per-host shard files (see :meth:`serialize`).
         """
         world = dist.process_count()
         # Every failure here RAISES: falling back to mesh=None under
@@ -784,17 +811,25 @@ class NeuralNetworkModel:
         if n % world:
             raise RuntimeError(f"multi-host training: {n} global devices "
                                f"not divisible by {world} processes")
-        for knob in ("PENROZ_MESH_MODEL", "PENROZ_MESH_SEQUENCE",
-                     "PENROZ_MESH_EXPERT"):
-            if os.environ.get(knob, "1") != "1":
-                log.warning("%s ignored under multi-host: pure data "
-                            "parallelism only", knob)
-        if (micro_batch * world) % n:
+        try:
+            model = int(os.environ.get("PENROZ_MESH_MODEL", "1"))
+            seq = int(os.environ.get("PENROZ_MESH_SEQUENCE", "1"))
+            expert = int(os.environ.get("PENROZ_MESH_EXPERT", "1"))
+        except ValueError as e:
+            raise ValueError(f"Invalid mesh-axis env knob: {e}")
+        denom = model * seq * expert
+        if model < 1 or seq < 1 or expert < 1 or n % denom:
+            raise ValueError(
+                f"multi-host training: {n} global devices not divisible by "
+                f"model={model} × sequence={seq} × expert={expert}")
+        data = n // denom
+        if (micro_batch * world) % data:
             raise ValueError(
                 f"multi-host training: global micro-batch "
                 f"{micro_batch * world} (batch_size × processes) must be "
-                f"divisible by {n} devices")
-        return mesh_lib.make_mesh(devices)
+                f"divisible by the data axis ({data})")
+        return mesh_lib.make_mesh(devices, model=model, sequence=seq,
+                                  expert=expert)
 
     @classmethod
     def train_model_on_device(cls, model_id, device, dataset_id, shard,
@@ -953,16 +988,71 @@ class NeuralNetworkModel:
 
     # -- persistence --------------------------------------------------------
 
-    def serialize(self, sync_flush: bool = False):
+    @staticmethod
+    def _is_host_readable(v) -> bool:
+        """Whether ``np.asarray(v)`` works on this host (plain / addressable
+        / fully-replicated arrays — everything except cross-host shards)."""
+        return (getattr(v, "is_fully_addressable", True)
+                or getattr(v, "is_fully_replicated", False))
+
+    def _checkpoint_items(self):
+        """Flat name → array view of everything persisted (params, buffers,
+        optimizer leaves) so sharding-aware save/load handles them
+        uniformly.  Optimizer leaves get synthetic ``__opt__{i}`` names."""
+        items = dict(self.params)
+        items.update({f"__buf__{k}": v for k, v in self.buffers.items()})
+        items.update({f"__opt__{i}": leaf for i, leaf
+                      in enumerate(jax.tree.leaves(self.opt_state))})
+        return items
+
+    def serialize(self, sync_flush: bool = False, tag=None):
         """Checkpoint to shm + durable dir (reference:
-        neural_net_model.py:98-122)."""
+        neural_net_model.py:98-122).
+
+        Cross-host-sharded arrays (TP/SP/EP over a multi-host mesh) cannot be
+        materialized on one host; each process persists the shard pieces it
+        owns (``replica_id == 0`` only, so the union covers each index range
+        exactly once) into ``model_{id}.shard{rank}.ckpt``, and the master
+        blob records their global shape/dtype for reassembly on load.
+        ``tag`` (the epoch number during training — identical on every host)
+        is stamped into the blob and every shard file so a load can reject a
+        checkpoint whose pieces come from different training steps."""
+        sharded_meta: dict = {}
+        shard_pieces: dict = {}
+        host_arrays: dict = {}
+        for name, v in self._checkpoint_items().items():
+            if self._is_host_readable(v):
+                host_arrays[name] = np.asarray(v)
+            else:
+                sharded_meta[name] = {"shape": tuple(v.shape),
+                                      "dtype": str(v.dtype)}
+                shard_pieces[name] = [
+                    (tuple((sl.start, sl.stop) for sl in shard.index),
+                     np.asarray(shard.data))
+                    for shard in v.addressable_shards
+                    if shard.replica_id == 0]
+        if shard_pieces:
+            checkpoint.save_shard(
+                self.model_id, dist.process_index(),
+                {"tag": tag, "pieces": shard_pieces},
+                sync_flush=sync_flush, world=dist.process_count())
+        if not dist.master_proc():
+            return
+        params = {k: host_arrays[k] for k in self.params
+                  if k in host_arrays}
+        buffers = {k: host_arrays[f"__buf__{k}"] for k in self.buffers
+                   if f"__buf__{k}" in host_arrays}
+        opt_leaves = {i: host_arrays[f"__opt__{i}"]
+                      for i in range(len(jax.tree.leaves(self.opt_state)))
+                      if f"__opt__{i}" in host_arrays}
         data = {
             "layers": self.layers_dsl,
             "optimizer": self.optimizer_config,
-            "params": {k: np.asarray(v) for k, v in self.params.items()},
-            "buffers": {k: np.asarray(v) for k, v in self.buffers.items()},
-            "opt_state_leaves": [np.asarray(l)
-                                 for l in jax.tree.leaves(self.opt_state)],
+            "params": params,
+            "buffers": buffers,
+            "opt_state_leaves": opt_leaves,
+            "sharded": sharded_meta,
+            "shard_tag": tag,
             "progress": self.progress,
             "avg_cost": self.avg_cost,
             "avg_cost_history": self.avg_cost_history,
@@ -970,6 +1060,42 @@ class NeuralNetworkModel:
             "status": self.status,
         }
         checkpoint.save(self.model_id, data, sync_flush=sync_flush)
+
+    @staticmethod
+    def _reassemble_sharded(model_id: str, sharded_meta: dict,
+                            expected_tag=None) -> dict:
+        """Rebuild full arrays from the per-host shard files (TP/SP/EP
+        checkpoints).  Requires every host's shard file to be readable —
+        true on shared filesystems and in tests; raises otherwise.  Shard
+        files stamped with a different step tag than the blob are rejected
+        (a crash between hosts' checkpoints would otherwise stitch weight
+        pieces from different training steps)."""
+        shards = []
+        for i, payload in enumerate(checkpoint.load_shards(model_id)):
+            if payload.get("tag") != expected_tag:
+                raise RuntimeError(
+                    f"Sharded checkpoint for {model_id} is torn: shard file "
+                    f"#{i} is from step {payload.get('tag')!r} but the "
+                    f"metadata blob is from step {expected_tag!r}")
+            shards.append(payload["pieces"])
+        out = {}
+        for name, meta in sharded_meta.items():
+            shape = tuple(meta["shape"])
+            arr = np.zeros(shape, dtype=np.dtype(meta["dtype"]))
+            covered = 0
+            for shard_data in shards:
+                for ranges, piece in shard_data.get(name, []):
+                    idx = tuple(slice(a, b) for a, b in ranges)
+                    arr[idx] = piece
+                    covered += int(np.prod(piece.shape))
+            if covered < int(np.prod(shape)):
+                raise RuntimeError(
+                    f"Sharded checkpoint for {model_id} is incomplete: "
+                    f"{name} has {covered}/{int(np.prod(shape))} elements "
+                    f"across {len(shards)} shard file(s) — all hosts' shard "
+                    f"files must be visible to reassemble")
+            out[name] = arr
+        return out
 
     @classmethod
     def deserialize(cls, model_id: str) -> "NeuralNetworkModel":
@@ -981,13 +1107,30 @@ class NeuralNetworkModel:
         model.layers_dsl = data["layers"]
         model.optimizer_config = data["optimizer"]
         model.arch = CompiledArch.get(model.layers_dsl)
-        model.params = {k: jnp.asarray(v) for k, v in data["params"].items()}
-        model.buffers = {k: jnp.asarray(v) for k, v in data["buffers"].items()}
+        assembled = (cls._reassemble_sharded(model_id, data["sharded"],
+                                             data.get("shard_tag"))
+                     if data.get("sharded") else {})
+        params = dict(data["params"])
+        buffers = dict(data["buffers"])
+        opt_leaves_in = data["opt_state_leaves"]
+        if isinstance(opt_leaves_in, dict):
+            opt_leaves = dict(opt_leaves_in)
+        else:  # pre-sharding checkpoint format: plain list
+            opt_leaves = dict(enumerate(opt_leaves_in))
+        for name, arr in assembled.items():
+            if name.startswith("__buf__"):
+                buffers[name[len("__buf__"):]] = arr
+            elif name.startswith("__opt__"):
+                opt_leaves[int(name[len("__opt__"):])] = arr
+            else:
+                params[name] = arr
+        model.params = {k: jnp.asarray(v) for k, v in params.items()}
+        model.buffers = {k: jnp.asarray(v) for k, v in buffers.items()}
         optimizer = dsl.build_optimizer(model.optimizer_config)
         template = jax.eval_shape(optimizer.init, model.params)
         model.opt_state = jax.tree.unflatten(
             jax.tree.structure(template),
-            [jnp.asarray(l) for l in data["opt_state_leaves"]])
+            [jnp.asarray(opt_leaves[i]) for i in range(len(opt_leaves))])
         model.progress = data.get("progress", [])
         model.avg_cost = data.get("avg_cost")
         model.avg_cost_history = data.get("avg_cost_history", [])

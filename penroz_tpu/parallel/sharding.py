@@ -103,3 +103,31 @@ def global_batch(batch, mesh: Mesh, *, leading_steps: bool = False,
     global_shape[batch_axis] *= world
     return jax.make_array_from_process_local_data(
         sharding, np.asarray(batch), tuple(global_shape))
+
+
+def opt_state_sharding_tree(opt_state, params: dict, mesh: Mesh):
+    """Sharding pytree for an optax state matching the param layout.
+
+    optax moment trees (e.g. AdamW's ``mu``/``nu``) mirror the flat param
+    dict, so any leaf reached through a dict key that names a parameter (and
+    whose shape matches it) inherits that parameter's TP sharding; scalars
+    (step counts) and anything unrecognized stay replicated.  Keeping the
+    moments sharded like the weights is what makes TP across hosts
+    checkpointable — no host ever needs the full optimizer state.
+    """
+    import jax
+    from jax.tree_util import DictKey
+
+    pspecs = {k: param_spec(k, tuple(v.shape), mesh)
+              for k, v in params.items()}
+    repl = NamedSharding(mesh, P())
+
+    def leaf_sharding(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        for entry in reversed(path):
+            if (isinstance(entry, DictKey) and entry.key in pspecs
+                    and shape == tuple(params[entry.key].shape)):
+                return NamedSharding(mesh, pspecs[entry.key])
+        return repl
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, opt_state)

@@ -48,6 +48,73 @@ def shm_model_path(model_id: str) -> str:
     return os.path.join(SHM_PATH, model_path(model_id))
 
 
+def shard_file_path(model_id: str, process_index: int) -> str:
+    """Per-host shard file for cross-host-sharded arrays (TP/SP/EP over a
+    multi-host mesh).  The main blob keeps metadata + addressable arrays;
+    host ``k`` persists the array pieces only it holds."""
+    return os.path.join(MODELS_FOLDER,
+                        f"model_{model_id}.shard{process_index}.ckpt")
+
+
+def _shard_indices(model_id: str) -> list[int]:
+    """Process indices with an existing shard file (shm or durable),
+    discovered by glob so stale non-contiguous leftovers are found too."""
+    import glob
+    import re
+    pattern = f"model_{re.escape(model_id)}.shard*.ckpt"
+    indices = set()
+    for base in (os.path.join(SHM_PATH, MODELS_FOLDER), MODELS_FOLDER):
+        for path in glob.glob(os.path.join(base, pattern)):
+            m = re.search(r"\.shard(\d+)\.ckpt$", path)
+            if m:
+                indices.add(int(m.group(1)))
+    return sorted(indices)
+
+
+def save_shard(model_id: str, process_index: int, data: dict,
+               sync_flush: bool = False, world: int | None = None):
+    """Persist one host's array shards with the same shm write-through +
+    background flush behavior as the main blob.
+
+    The master (index 0) also prunes shard files at indices >= ``world`` —
+    leftovers from an earlier run with more processes would otherwise be
+    reassembled on load, overwriting fresh weights with stale pieces."""
+    os.makedirs(MODELS_FOLDER, exist_ok=True)
+    os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
+    rel = shard_file_path(model_id, process_index)
+    shm_path = os.path.join(SHM_PATH, rel)
+    _atomic_pickle(shm_path, data)
+    if sync_flush:
+        _flush(shm_path, rel)
+    else:
+        threading.Thread(target=_flush, args=(shm_path, rel),
+                         daemon=True).start()
+    if process_index == 0 and world is not None:
+        for idx in _shard_indices(model_id):
+            if idx >= world:
+                _remove_shard_files(model_id, idx)
+
+
+def _remove_shard_files(model_id: str, idx: int):
+    rel = shard_file_path(model_id, idx)
+    for path in (os.path.join(SHM_PATH, rel), rel):
+        if os.path.exists(path):
+            os.remove(path)
+
+
+def load_shards(model_id: str) -> list[dict]:
+    """Every readable shard file for ``model_id`` (shm first, durable
+    fallback), in process-index order.  Returns [] when none exist."""
+    shards = []
+    for idx in _shard_indices(model_id):
+        rel = shard_file_path(model_id, idx)
+        shm_path = os.path.join(SHM_PATH, rel)
+        path = shm_path if os.path.exists(shm_path) else rel
+        with open(path, "rb") as f:
+            shards.append(pickle.load(f))
+    return shards
+
+
 def save(model_id: str, data: dict, sync_flush: bool = False):
     """Write checkpoint to shm and flush to disk in the background.
 
@@ -148,15 +215,22 @@ def load(model_id: str) -> dict:
 
 
 def delete(model_id: str):
-    """Remove the shm cache copy and the durable checkpoint.
+    """Remove the shm cache copy, the durable checkpoint, and shard files.
 
-    Mirrors the reference's semantics (neural_net_model.py:239-248): a missing
-    shm copy short-circuits with a warning.
+    The reference removes both copies (neural_net_model.py:239-248) but its
+    missing-shm short-circuit would leave the durable file behind after e.g.
+    a reboot cleared /dev/shm; here each copy is removed independently so a
+    deleted model can never be resurrected by a cache-miss reload.
     """
-    try:
-        os.remove(shm_model_path(model_id))
-        durable_path = model_path(model_id)
-        if os.path.exists(durable_path):
-            os.remove(durable_path)
-    except FileNotFoundError as e:
-        log.warning("Failed to delete: %s", e)
+    shm_path = shm_model_path(model_id)
+    if os.path.exists(shm_path):
+        os.remove(shm_path)
+    else:
+        log.warning("Failed to delete (no shm copy): %s", shm_path)
+    # Durable copy removed independently — a cleared /dev/shm (e.g. reboot)
+    # must not leave a resurrectable durable checkpoint behind.
+    durable_path = model_path(model_id)
+    if os.path.exists(durable_path):
+        os.remove(durable_path)
+    for idx in _shard_indices(model_id):
+        _remove_shard_files(model_id, idx)

@@ -272,21 +272,24 @@ def test_global_batch_multihost_lifts_local_rows(cpu_devices, monkeypatch):
     assert captured["sharding"].spec == P(None, "data", None)
 
 
-def test_multihost_training_mesh_pure_dp(workdir, toy_gpt_layers,
-                                         monkeypatch):
-    """process_count>1 yields a global pure-DP mesh (all devices on the
-    data axis) and ignores the TP/SP/EP env knobs with a warning."""
+def test_multihost_training_mesh(workdir, toy_gpt_layers, monkeypatch):
+    """process_count>1 yields a global mesh; the TP/SP/EP env knobs carve
+    axes out of the global device set (sharded checkpointing lifted the
+    round-1 pure-DP restriction)."""
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
     model = NeuralNetworkModel("mh", Mapper(toy_gpt_layers,
                                             {"sgd": {"lr": 0.1}}))
     model.to_device("cpu")  # pin to the virtual 8-device CPU backend
     monkeypatch.setattr(dist, "process_count", lambda: 2)
-    monkeypatch.setenv("PENROZ_MESH_MODEL", "2")
     mesh = model._training_mesh(micro_batch=4, block_size=16)
     assert mesh is not None
     assert mesh.shape["data"] == 8
     assert mesh.shape["model"] == 1
+    monkeypatch.setenv("PENROZ_MESH_MODEL", "2")
+    mesh = model._training_mesh(micro_batch=4, block_size=16)
+    assert mesh.shape["model"] == 2
+    assert mesh.shape["data"] == 4
     # indivisible global micro-batch must raise, not silently train
     # divergent unsynced replicas
     with pytest.raises(ValueError, match="divisible"):

@@ -709,13 +709,15 @@ class NeuralNetworkModel:
                 self.serialize(tag=epochs)
         except Exception as e:  # noqa: BLE001
             self.status = {"code": "Error", "message": str(e)}
-            # With sharded params EVERY host must write its crash-time
-            # shard — a master-only blob would mix steps with the other
-            # hosts' older shard files (the load-time tag check would then
-            # reject the checkpoint outright).
+            # Untagged on purpose: hosts reach this handler independently
+            # (possibly at different epochs, possibly only one of them), so
+            # a shard-file rewrite here could tear the last consistent
+            # checkpoint.  serialize() degrades an untagged sharded save to
+            # a master-only metadata update — Error status is recorded,
+            # weights stay at the last coordinated checkpoint.
             if master or saves_shards:
                 try:
-                    self.serialize(sync_flush=True, tag=("error", epoch))
+                    self.serialize(sync_flush=True)
                 except Exception:  # noqa: BLE001
                     log.exception("Failed to persist error status")
             raise
@@ -761,7 +763,7 @@ class NeuralNetworkModel:
                     "training requires the global mesh for gradient sync")
             return None
         if dist.process_count() > 1:
-            return self._multihost_mesh(micro_batch)
+            return self._multihost_mesh(micro_batch, block_size)
         try:
             platform = self.device.platform if self.device is not None else None
             devices = (jax.local_devices(backend=platform) if platform
@@ -790,7 +792,7 @@ class NeuralNetworkModel:
         return mesh_lib.make_mesh(devices, model=model, sequence=seq,
                                   expert=expert)
 
-    def _multihost_mesh(self, micro_batch: int):
+    def _multihost_mesh(self, micro_batch: int, block_size: int = 0):
         """Global mesh spanning every host's devices.
 
         The data axis is ordered by process (jax.devices() groups by
@@ -828,6 +830,10 @@ class NeuralNetworkModel:
                 f"multi-host training: global micro-batch "
                 f"{micro_batch * world} (batch_size × processes) must be "
                 f"divisible by the data axis ({data})")
+        if seq > 1 and block_size and block_size % seq:
+            raise ValueError(
+                f"multi-host training: block_size {block_size} must be "
+                f"divisible by the sequence axis ({seq})")
         return mesh_lib.make_mesh(devices, model=model, sequence=seq,
                                   expert=expert)
 
@@ -1016,14 +1022,24 @@ class NeuralNetworkModel:
         blob records their global shape/dtype for reassembly on load.
         ``tag`` (the epoch number during training — identical on every host)
         is stamped into the blob and every shard file so a load can reject a
-        checkpoint whose pieces come from different training steps."""
+        checkpoint whose pieces come from different training steps.
+
+        An UNTAGGED call on a model with sharded params (a status update at
+        train start, the error path, a serve-side save) is not coordinated
+        across hosts, so it must not rewrite shard files — one host's write
+        would permanently tear the last consistent checkpoint.  Such calls
+        degrade to a master-only metadata update of the existing blob."""
+        items = self._checkpoint_items()
+        any_sharded = not all(self._is_host_readable(v)
+                              for v in items.values())
+        if any_sharded and tag is None:
+            if dist.master_proc():
+                self._serialize_meta_only(sync_flush)
+            return
         sharded_meta: dict = {}
         shard_pieces: dict = {}
-        host_arrays: dict = {}
-        for name, v in self._checkpoint_items().items():
-            if self._is_host_readable(v):
-                host_arrays[name] = np.asarray(v)
-            else:
+        for name, v in items.items():
+            if not self._is_host_readable(v):
                 sharded_meta[name] = {"shape": tuple(v.shape),
                                       "dtype": str(v.dtype)}
                 shard_pieces[name] = [
@@ -1038,6 +1054,11 @@ class NeuralNetworkModel:
                 sync_flush=sync_flush, world=dist.process_count())
         if not dist.master_proc():
             return
+        # Host-readable materialization only after the master check — every
+        # non-master host doing full D2H copies of replicated state just to
+        # discard them would waste seconds per checkpoint at scale.
+        host_arrays = {name: np.asarray(v) for name, v in items.items()
+                       if self._is_host_readable(v)}
         params = {k: host_arrays[k] for k in self.params
                   if k in host_arrays}
         buffers = {k: host_arrays[f"__buf__{k}"] for k in self.buffers
@@ -1059,6 +1080,23 @@ class NeuralNetworkModel:
             "stats": self.stats,
             "status": self.status,
         }
+        checkpoint.save(self.model_id, data, sync_flush=sync_flush)
+
+    def _serialize_meta_only(self, sync_flush: bool = False):
+        """Update progress/status in the existing blob without touching the
+        weights or shard files — the safe write for uncoordinated saves on a
+        sharded model (preserves the last consistent checkpoint)."""
+        try:
+            data = checkpoint.load(self.model_id)
+        except KeyError:
+            log.warning("Meta-only checkpoint skipped: no existing blob "
+                        "for %s", self.model_id)
+            return
+        data["progress"] = self.progress
+        data["avg_cost"] = self.avg_cost
+        data["avg_cost_history"] = self.avg_cost_history
+        data["stats"] = self.stats
+        data["status"] = self.status
         checkpoint.save(self.model_id, data, sync_flush=sync_flush)
 
     @staticmethod

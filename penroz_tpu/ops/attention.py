@@ -140,19 +140,23 @@ def cached_attention(q, k_full, v_full, offset, length,
 
 def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
                            offset, length, dropout_rate=0.0,
-                           dropout_rng=None, platform=None):
+                           dropout_rng=None, platform=None,
+                           k_scale=None, v_scale=None):
     """Cached attention over a paged KV pool (block table indirection).
 
     On TPU dispatches to the paged Pallas kernel — one physical page of K/V
-    resident in VMEM at a time, so context length is HBM-bounded.  The
-    fallback (also the correctness oracle) gathers the dense view and
-    reuses :func:`cached_attention`'s jnp path.
+    resident in VMEM at a time, so context length is HBM-bounded.  With
+    ``k_scale``/``v_scale`` the pools are int8 (TurboQuant + paged) and the
+    kernel dequantizes per page in VMEM.  The fallback (also the correctness
+    oracle) gathers the dense (dequantized) view and reuses
+    :func:`cached_attention`'s jnp path.
     """
     if dropout_rate == 0.0 and _use_paged_kernel(q, flat_k, block_table,
                                                  page_size, platform):
         from penroz_tpu.ops.pallas import paged_attention as pa
         return pa.paged_decode_attention(q, flat_k, flat_v, block_table,
-                                         page_size, offset, length)
+                                         page_size, offset, length,
+                                         k_scale=k_scale, v_scale=v_scale)
     B = q.shape[0]
     pages_per_seq = block_table.shape[1]
     max_len = pages_per_seq * page_size
@@ -162,9 +166,16 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
     # flat pools are head-major (Hkv, pool_rows, D)
     gather = lambda flat: jnp.take(flat, rows, axis=1,
                                    mode="clip").transpose(1, 0, 2, 3)
+    if k_scale is not None:
+        k_full = (gather(flat_k).astype(jnp.float32)
+                  * gather(k_scale)).astype(q.dtype)
+        v_full = (gather(flat_v).astype(jnp.float32)
+                  * gather(v_scale)).astype(q.dtype)
+    else:
+        k_full, v_full = gather(flat_k), gather(flat_v)
     # Dense-gather fallback; cached_attention may still use the contiguous
     # decode kernel on the gathered views when shapes allow.
-    return cached_attention(q, gather(flat_k), gather(flat_v), offset,
+    return cached_attention(q, k_full, v_full, offset,
                             length, dropout_rate, dropout_rng,
                             platform=platform)
 

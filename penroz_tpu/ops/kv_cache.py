@@ -311,20 +311,36 @@ class PagedKVState(KVState):
         phys_page = self.block_table[:, pos // P]  # (B, n)
         return phys_page * P + pos % P
 
-    def append_rows(self, layer_idx: int, k_new, v_new):
-        """Scatter new K/V into the page pools; returns the *flat* pools
-        (no dense gather — the paged Pallas kernel walks the block table
-        directly, ops/pallas/paged_attention.py)."""
-        B, H, T, D = k_new.shape
+    def _allocate_rows(self, T: int):
+        """Bump-allocate pages for ``T`` new tokens; returns the flat pool
+        row index per (batch, token) plus the new valid length."""
         new_length = self.length + T
         self._allocate(new_length)
         pos = self.length + jnp.arange(T, dtype=jnp.int32)
-        rows = self._rows(pos).reshape(-1)  # (B*T,)
-        kv_rows = lambda t: t.transpose(1, 0, 2, 3).reshape(H, B * T, D)
+        return self._rows(pos).reshape(-1), new_length  # rows: (B*T,)
+
+    @staticmethod
+    def _to_rows(t):
+        """(B, H, T, d) → head-major flat rows (H, B*T, d)."""
+        B, H, T, d = t.shape
+        return t.transpose(1, 0, 2, 3).reshape(H, B * T, d)
+
+    def append_rows(self, layer_idx: int, k_new, v_new):
+        """Scatter new K/V into the page pools; returns the *flat* pools
+        (no dense gather — the paged Pallas kernel walks the block table
+        directly, ops/pallas/paged_attention.py).
+
+        Precondition: ``length + T <= max_len``.  ``_allocate`` clamps the
+        page count and ``_rows`` clamps the logical-page lookup, so an
+        overflowing append silently overwrites the final page's rows
+        instead of raising — callers must reset/re-prefill at capacity the
+        way the generate loop does (models/model.py overflow path).
+        """
+        rows, new_length = self._allocate_rows(k_new.shape[2])
         self.k[layer_idx] = self.k[layer_idx].at[:, rows].set(
-            kv_rows(k_new).astype(self.k[layer_idx].dtype))
+            self._to_rows(k_new).astype(self.k[layer_idx].dtype))
         self.v[layer_idx] = self.v[layer_idx].at[:, rows].set(
-            kv_rows(v_new).astype(self.v[layer_idx].dtype))
+            self._to_rows(v_new).astype(self.v[layer_idx].dtype))
         return self.k[layer_idx], self.v[layer_idx], new_length
 
     def append(self, layer_idx: int, k_new, v_new):
@@ -376,21 +392,133 @@ class PagedKVState(KVState):
         return B * self.max_len * self._row_bytes()
 
 
+@jax.tree_util.register_pytree_node_class
+class QuantPagedKVState(PagedKVState):
+    """Int8 paged pool: TurboQuant storage + paged layout combined.
+
+    The page pools hold int8 values; parallel ``(Hkv, rows, 1)`` fp32 pools
+    hold the per-token scales (TurboQuant layout, kv_cache.py:101-195 in the
+    reference).  The paged Pallas kernel dequantizes one page at a time in
+    VMEM (ops/pallas/paged_attention.py), so HBM holds ~¼ the bytes of the
+    fp32 paged pool while context stays HBM-bounded.
+    """
+
+    quantized = True
+
+    def __init__(self, k, v, counters, block_table, page_size: int,
+                 pages_per_seq: int, k_scale, v_scale,
+                 out_dtype=jnp.float32):
+        super().__init__(k, v, counters, block_table, page_size,
+                         pages_per_seq)
+        self.k_scale = list(k_scale)
+        self.v_scale = list(v_scale)
+        self.out_dtype = out_dtype
+
+    def tree_flatten(self):
+        children = (tuple(self.k), tuple(self.v), self.counters,
+                    self.block_table, tuple(self.k_scale),
+                    tuple(self.v_scale))
+        return children, (self.page_size, self.pages_per_seq, self.out_dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k, v, counters, block_table, k_scale, v_scale = children
+        return cls(list(k), list(v), counters, block_table,
+                   page_size=aux[0], pages_per_seq=aux[1],
+                   k_scale=list(k_scale), v_scale=list(v_scale),
+                   out_dtype=aux[2])
+
+    @classmethod
+    def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32,
+               page_size: int | None = None, pool_pages: int | None = None):
+        base = PagedKVState.create(specs, batch, max_len, jnp.int8,
+                                   page_size=page_size,
+                                   pool_pages=pool_pages)
+        rows = base.k[0].shape[1] if base.k else 0
+        ks = [jnp.zeros((h, rows, 1), jnp.float32) for h, _ in specs]
+        vs = [jnp.zeros((h, rows, 1), jnp.float32) for h, _ in specs]
+        return cls(base.k, base.v, base.counters, base.block_table,
+                   base.page_size, base.pages_per_seq, ks, vs,
+                   out_dtype=dtype)
+
+    def append_rows(self, layer_idx: int, k_new, v_new):
+        """Quantize then scatter values *and* scales into the pools (same
+        allocator/scatter path and overflow precondition as the parent)."""
+        qk, sk = _quantize_int8(k_new)
+        qv, sv = _quantize_int8(v_new)
+        rows, new_length = self._allocate_rows(k_new.shape[2])
+        self.k[layer_idx] = self.k[layer_idx].at[:, rows].set(
+            self._to_rows(qk))
+        self.v[layer_idx] = self.v[layer_idx].at[:, rows].set(
+            self._to_rows(qv))
+        self.k_scale[layer_idx] = self.k_scale[layer_idx].at[:, rows].set(
+            self._to_rows(sk))
+        self.v_scale[layer_idx] = self.v_scale[layer_idx].at[:, rows].set(
+            self._to_rows(sv))
+        return self.k[layer_idx], self.v[layer_idx], new_length
+
+    def append(self, layer_idx: int, k_new, v_new):
+        """Scatter + dense dequantized views (jnp fallback/oracle path)."""
+        _, _, new_length = self.append_rows(layer_idx, k_new, v_new)
+        k_full = _dequantize_int8(self._gather(self.k[layer_idx]),
+                                  self._gather(self.k_scale[layer_idx]),
+                                  self.out_dtype)
+        v_full = _dequantize_int8(self._gather(self.v[layer_idx]),
+                                  self._gather(self.v_scale[layer_idx]),
+                                  self.out_dtype)
+        return k_full, v_full, new_length
+
+    def _with_length(self, length):
+        counters = self.counters.at[0].set(length)
+        return QuantPagedKVState(list(self.k), list(self.v), counters,
+                                 self.block_table, self.page_size,
+                                 self.pages_per_seq, list(self.k_scale),
+                                 list(self.v_scale),
+                                 out_dtype=self.out_dtype)
+
+    def reset(self):
+        table = jnp.full_like(self.block_table, -1)
+        return QuantPagedKVState(list(self.k), list(self.v),
+                                 jnp.zeros((3,), jnp.int32), table,
+                                 self.page_size, self.pages_per_seq,
+                                 list(self.k_scale), list(self.v_scale),
+                                 out_dtype=self.out_dtype)
+
+    def _row_bytes(self) -> int:
+        """int8 value rows + fp32 scale rows per token, over every layer."""
+        values = super()._row_bytes()
+        scales = sum(a.shape[0] * a.shape[2] * a.dtype.itemsize
+                     for a in (*self.k_scale, *self.v_scale))
+        return values + scales
+
+    def memory_bytes(self) -> int:
+        return sum(int(a.size) * a.dtype.itemsize
+                   for a in (*self.k, *self.v, *self.k_scale, *self.v_scale))
+
+    def logical_bytes(self) -> int:
+        """Bytes a contiguous out_dtype cache of max_len would occupy."""
+        B = self.block_table.shape[0]
+        itemsize = jnp.dtype(self.out_dtype).itemsize
+        per_row = sum(a.shape[0] * a.shape[2] * itemsize
+                      for a in (*self.k, *self.v))
+        return B * self.max_len * per_row
+
+
 def create_kv_state(specs, batch: int, max_len: int, dtype=jnp.float32,
                     quantized: bool | None = None,
                     paged: bool | None = None) -> KVState:
-    """Factory honoring ``TURBO_QUANT_KV_CACHE=1`` and ``PAGED_KV_CACHE=1``.
-
-    Quantized takes precedence when both are requested (an int8 paged pool is
-    not implemented yet)."""
+    """Factory honoring ``TURBO_QUANT_KV_CACHE=1`` and ``PAGED_KV_CACHE=1``
+    (both together → the int8 paged pool)."""
     if quantized is None:
         quantized = turbo_quant_enabled()
     if paged is None:
         paged = paged_enabled()
+    if quantized and paged:
+        log.info("Int8 paged KV cache enabled (%s=1 + %s=1, page_size=%d)",
+                 TURBO_QUANT_ENV, PAGED_ENV, default_page_size())
+        return QuantPagedKVState.create(specs, batch, max_len, dtype)
     if quantized:
         log.info("TurboQuant KV cache enabled (%s=1)", TURBO_QUANT_ENV)
-        if paged:
-            log.warning("PAGED_KV_CACHE ignored: TurboQuant takes precedence")
         return QuantKVState.create(specs, batch, max_len, dtype)
     if paged:
         log.info("Paged KV cache enabled (%s=1, page_size=%d)", PAGED_ENV,

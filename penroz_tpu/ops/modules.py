@@ -667,10 +667,14 @@ class CausalSelfAttention(Module):
             if isinstance(ctx.kv, KV.PagedKVState):
                 flat_k, flat_v, length = ctx.kv.append_rows(self.layer_idx,
                                                             k, v)
+                scales = {}
+                if ctx.kv.quantized:  # int8 pools carry per-token scales
+                    scales = {"k_scale": ctx.kv.k_scale[self.layer_idx],
+                              "v_scale": ctx.kv.v_scale[self.layer_idx]}
                 out = attn_ops.paged_cached_attention(
                     q, flat_k, flat_v, ctx.kv.block_table, ctx.kv.page_size,
                     offset, length, dropout_rate=dropout_rate,
-                    dropout_rng=dropout_rng, platform=ctx.platform)
+                    dropout_rng=dropout_rng, platform=ctx.platform, **scales)
             else:
                 k_full, v_full, length = ctx.kv.append(self.layer_idx, k, v)
                 out = attn_ops.cached_attention(q, k_full, v_full, offset,

@@ -26,10 +26,13 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *,
+def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
                   page_size: int, num_queries: int, pages_per_seq: int,
-                  sm_scale: float):
+                  sm_scale: float, quantized: bool = False):
+    if quantized:  # int8 pools carry per-token scale pages
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     j = pl.program_id(2)
     total = len_ref[0]
     offset = total - num_queries
@@ -46,6 +49,11 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0]          # (GT, D)
         k = k_ref[0]             # (page_size, D)
         v = v_ref[0]
+        if quantized:
+            # Dequantize the page in VMEM: int8 values × per-token scales
+            # (TurboQuant layout, ops/kv_cache.py:_quantize_int8).
+            k = (k.astype(jnp.float32) * ks_ref[0]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs_ref[0]).astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (GT, P)
@@ -75,20 +83,24 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
-                           offset, length, interpret: bool = False):
+                           offset, length, k_scale=None, v_scale=None,
+                           interpret: bool = False):
     """Cached attention over a paged pool.
 
     q: (B, Hq, T, D) new queries; flat_k/flat_v: (Hkv, num_pages *
     page_size, D) shared head-major pools; block_table: (B, pages_per_seq)
     physical page per
     logical page (-1 = unassigned); ``length`` = offset + T valid tokens.
-    Matches the jnp oracle (gather + ``cached_attention``) exactly.
+    With ``k_scale``/``v_scale`` (``(Hkv, rows, 1)`` fp32 per-token scales)
+    the pools are int8 and each page is dequantized in VMEM (TurboQuant +
+    paged).  Matches the jnp oracle (gather + ``cached_attention``) exactly.
     """
     B, Hq, T, D = q.shape
     Hkv = flat_k.shape[0]
     group = Hq // Hkv
     pages_per_seq = block_table.shape[1]
     sm_scale = 1.0 / (D ** 0.5)
+    quantized = k_scale is not None
 
     q_rows = q.reshape(B, Hkv, group * T, D)
     total = jnp.asarray(length, jnp.int32).reshape(1)
@@ -98,25 +110,32 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
 
     kernel = functools.partial(_paged_kernel, page_size=page_size,
                                num_queries=T, pages_per_seq=pages_per_seq,
-                               sm_scale=sm_scale)
+                               sm_scale=sm_scale, quantized=quantized)
+    page_spec = pl.BlockSpec(
+        (1, page_size, D),
+        lambda b, h, j, len_ref, table_ref:
+            (h, table_ref[b * pages_per_seq + j], 0),
+        memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((1, 1, group * T, D),
+                     lambda b, h, j, len_ref, table_ref: (b, h, 0, 0),
+                     memory_space=pltpu.VMEM),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q_rows, flat_k, flat_v]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, page_size, 1),
+            lambda b, h, j, len_ref, table_ref:
+                (h, table_ref[b * pages_per_seq + j], 0),
+            memory_space=pltpu.VMEM)
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, Hkv, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, 1, group * T, D),
-                         lambda b, h, j, len_ref, table_ref: (b, h, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (1, page_size, D),
-                lambda b, h, j, len_ref, table_ref:
-                    (h, table_ref[b * pages_per_seq + j], 0),
-                memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (1, page_size, D),
-                lambda b, h, j, len_ref, table_ref:
-                    (h, table_ref[b * pages_per_seq + j], 0),
-                memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group * T, D),
                                lambda b, h, j, len_ref, table_ref:
                                    (b, h, 0, 0),
@@ -139,5 +158,5 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
                                 * Hkv * D) * q.dtype.itemsize),
             transcendentals=int(B * Hq * T * pages_per_seq * page_size)),
         interpret=interpret,
-    )(total, table, q_rows, flat_k, flat_v)
+    )(total, table, *operands)
     return out.reshape(B, Hq, T, D)

@@ -95,11 +95,20 @@ def save_shard(model_id: str, process_index: int, data: dict,
                 _remove_shard_files(model_id, idx)
 
 
+def _remove_quietly(path: str) -> bool:
+    """Remove if present; racing removers (concurrent DELETEs, the flush
+    thread) must not turn an already-gone file into an exception."""
+    try:
+        os.remove(path)
+        return True
+    except FileNotFoundError:
+        return False
+
+
 def _remove_shard_files(model_id: str, idx: int):
     rel = shard_file_path(model_id, idx)
     for path in (os.path.join(SHM_PATH, rel), rel):
-        if os.path.exists(path):
-            os.remove(path)
+        _remove_quietly(path)
 
 
 def load_shards(model_id: str) -> list[dict]:
@@ -222,15 +231,12 @@ def delete(model_id: str):
     a reboot cleared /dev/shm; here each copy is removed independently so a
     deleted model can never be resurrected by a cache-miss reload.
     """
-    shm_path = shm_model_path(model_id)
-    if os.path.exists(shm_path):
-        os.remove(shm_path)
-    else:
-        log.warning("Failed to delete (no shm copy): %s", shm_path)
+    removed = _remove_quietly(shm_model_path(model_id))
+    if not removed:
+        log.warning("Failed to delete (no shm copy): %s",
+                    shm_model_path(model_id))
     # Durable copy removed independently — a cleared /dev/shm (e.g. reboot)
     # must not leave a resurrectable durable checkpoint behind.
-    durable_path = model_path(model_id)
-    if os.path.exists(durable_path):
-        os.remove(durable_path)
+    _remove_quietly(model_path(model_id))
     for idx in _shard_indices(model_id):
         _remove_shard_files(model_id, idx)

@@ -336,3 +336,33 @@ def test_paged_kernel_gate():
     assert A._use_paged_kernel(q, flat, table, 64, platform="tpu")
     assert not A._use_paged_kernel(q, flat, table, 64, platform="cpu")
     assert not A._use_paged_kernel(q, flat, table, 7, platform="tpu")
+
+
+def test_paged_kernel_quantized_matches_oracle_interpret():
+    """Int8 paged kernel (in-VMEM dequant, interpret mode) vs the jnp
+    dequantizing gather oracle."""
+    from penroz_tpu.ops.pallas import paged_attention as PA
+    from penroz_tpu.ops import kv_cache as KV
+    rng = np.random.default_rng(9)
+    B, Hq, Hkv, D, P = 2, 4, 2, 64, 16
+    state = KV.QuantPagedKVState.create([(Hkv, D)], batch=B, max_len=P * 4,
+                                        page_size=P)
+    fill = P + 3
+    k_fill = jnp.asarray(rng.normal(size=(B, Hkv, fill, D)), jnp.float32)
+    v_fill = jnp.asarray(rng.normal(size=(B, Hkv, fill, D)), jnp.float32)
+    state.append_rows(0, k_fill, v_fill)
+    state = state.advanced(fill)
+
+    q = jnp.asarray(rng.normal(size=(B, Hq, 1, D)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(B, Hkv, 1, D)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, Hkv, 1, D)), jnp.float32)
+    flat_k, flat_v, length = state.append_rows(0, k_new, v_new)
+    ks, vs = state.k_scale[0], state.v_scale[0]
+
+    ref = A.paged_cached_attention(q, flat_k, flat_v, state.block_table, P,
+                                   state.length, length, platform="cpu",
+                                   k_scale=ks, v_scale=vs)
+    out = PA.paged_decode_attention(q, flat_k, flat_v, state.block_table, P,
+                                    state.length, length, k_scale=ks,
+                                    v_scale=vs, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

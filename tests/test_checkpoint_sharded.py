@@ -66,7 +66,7 @@ def test_sharded_round_trip(workdir, monkeypatch):
         monkeypatch.setattr(dist, "process_index", lambda r=rank: r)
         model.params = dict(model.params)
         model.params[key] = _FakeShardedArray(full, rows)
-        model.serialize(sync_flush=True)
+        model.serialize(sync_flush=True, tag=0)
 
     blob = checkpoint.load("shardy")
     assert key not in blob["params"]
@@ -98,7 +98,7 @@ def test_sharded_opt_state_round_trip(workdir, monkeypatch):
         new_leaves[mu_idx] = _FakeShardedArray(full, rows)
         model.opt_state = jax.tree.unflatten(
             jax.tree.structure(model.opt_state), new_leaves)
-        model.serialize(sync_flush=True)
+        model.serialize(sync_flush=True, tag=0)
 
     restored = NeuralNetworkModel.deserialize("shardopt")
     got = jax.tree.leaves(restored.opt_state)[mu_idx]
@@ -112,7 +112,7 @@ def test_incomplete_shards_raise(workdir, monkeypatch):
     monkeypatch.setattr(dist, "process_index", lambda: 0)
     model.params = dict(model.params)
     model.params["layers.0.weight"] = _FakeShardedArray(full, (0, 2))
-    model.serialize(sync_flush=True)  # rank 1's file never written
+    model.serialize(sync_flush=True, tag=0)  # rank 1's file never written
     with pytest.raises(RuntimeError, match="incomplete"):
         NeuralNetworkModel.deserialize("partial")
 
@@ -123,7 +123,7 @@ def test_delete_removes_shard_files(workdir, monkeypatch):
     model.params = dict(model.params)
     model.params["layers.0.weight"] = _FakeShardedArray(
         np.ones((4, 8), np.float32), (0, 4))
-    model.serialize(sync_flush=True)
+    model.serialize(sync_flush=True, tag=0)
     assert len(checkpoint.load_shards("deleteme")) == 1
     NeuralNetworkModel.delete("deleteme")
     assert checkpoint.load_shards("deleteme") == []
@@ -207,3 +207,39 @@ def test_torn_checkpoint_tag_mismatch_raises(workdir, monkeypatch):
     model.serialize(sync_flush=True, tag=4)
     with pytest.raises(RuntimeError, match="torn"):
         NeuralNetworkModel.deserialize("torn")
+
+
+def test_untagged_serialize_on_sharded_params_is_meta_only(workdir,
+                                                           monkeypatch):
+    """An uncoordinated (untagged) serialize on a sharded model — error
+    path, train-start status write, serve-side save — must not rewrite
+    shard files or the blob's weight sections; it only updates metadata.
+    One host rewriting its shard alone would permanently tear the last
+    consistent checkpoint."""
+    full = np.arange(32, dtype=np.float32).reshape(4, 8)
+    key = "layers.0.weight"
+    model = _make_model("metaonly")
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    for rank, rows in ((1, (2, 4)), (0, (0, 2))):
+        monkeypatch.setattr(dist, "process_index", lambda r=rank: r)
+        model.params = dict(model.params)
+        model.params[key] = _FakeShardedArray(full, rows)
+        model.serialize(sync_flush=True, tag=7)
+
+    # Uncoordinated follow-up on the master (e.g. the error path).
+    model.status = {"code": "Error", "message": "boom"}
+    model.serialize(sync_flush=True)  # untagged
+
+    blob = checkpoint.load("metaonly")
+    assert blob["status"]["code"] == "Error"       # metadata updated
+    assert blob["shard_tag"] == 7                  # weights untouched
+    shards = checkpoint.load_shards("metaonly")
+    assert [s["tag"] for s in shards] == [7, 7]
+    restored = NeuralNetworkModel.deserialize("metaonly")
+    np.testing.assert_array_equal(np.asarray(restored.params[key]), full)
+
+    # Non-master untagged serialize is a complete no-op.
+    monkeypatch.setattr(dist, "process_index", lambda: 1)
+    model.status = {"code": "Training", "message": "x"}
+    model.serialize(sync_flush=True)
+    assert checkpoint.load("metaonly")["status"]["code"] == "Error"

@@ -1,6 +1,7 @@
 """KV cache tests: metrics wrapper parity (reference: test_kv_cache.py) plus
 the functional preallocated KVState/QuantKVState used by the jitted decode."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -249,7 +250,67 @@ def test_factory_paged_env_flag(monkeypatch):
     monkeypatch.setenv(KV.PAGED_ENV, "1")
     state = KV.create_kv_state([(1, 4)], batch=1, max_len=8)
     assert isinstance(state, KV.PagedKVState)
-    # TurboQuant wins when both flags are set.
+    # Both flags together select the int8 paged pool.
     monkeypatch.setenv(KV.TURBO_QUANT_ENV, "1")
     state = KV.create_kv_state([(1, 4)], batch=1, max_len=8)
-    assert isinstance(state, KV.QuantKVState)
+    assert isinstance(state, KV.QuantPagedKVState)
+
+
+# -- int8 paged state --------------------------------------------------------
+
+def test_factory_turbo_plus_paged_yields_quant_paged(monkeypatch):
+    monkeypatch.setenv(KV.TURBO_QUANT_ENV, "1")
+    monkeypatch.setenv(KV.PAGED_ENV, "1")
+    state = KV.create_kv_state([(2, 4)], batch=1, max_len=8)
+    assert isinstance(state, KV.QuantPagedKVState)
+    assert state.quantized
+    assert state.k[0].dtype == jnp.int8
+
+
+def test_quant_paged_append_matches_quant_contiguous():
+    """Int8 paged gather/dequant view equals the contiguous TurboQuant view
+    (same quantization, different storage layout)."""
+    rng = np.random.default_rng(3)
+    specs = [(2, 4), (2, 4)]
+    plain = KV.QuantKVState.create(specs, batch=2, max_len=8)
+    paged = KV.QuantPagedKVState.create(specs, batch=2, max_len=8,
+                                        page_size=4)
+    k = jnp.asarray(rng.normal(size=(2, 2, 3, 4)) * 5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 2, 3, 4)) * 0.1, jnp.float32)
+    pk, pv, plen = plain.append(0, k, v)
+    gk, gv, glen = paged.append(0, k, v)
+    assert int(plen) == int(glen) == 3
+    np.testing.assert_allclose(np.asarray(gk)[:, :, :3],
+                               np.asarray(pk)[:, :, :3], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gv)[:, :, :3],
+                               np.asarray(pv)[:, :, :3], atol=1e-6)
+    # dequantized values approximate the originals (reference tolerance
+    # 0.05, test_kv_cache.py:184-199)
+    np.testing.assert_allclose(np.asarray(gk)[:, :, :3], np.asarray(k),
+                               atol=0.05 * 5)
+    np.testing.assert_allclose(np.asarray(gv)[:, :, :3], np.asarray(v),
+                               atol=0.05 * 0.1 + 1e-3)
+
+
+def test_quant_paged_memory_accounting():
+    state = KV.QuantPagedKVState.create([(2, 64)], batch=1, max_len=128,
+                                        page_size=64)
+    # int8 values + fp32 per-token scales must undercut the fp32 logical
+    # cache the compression ratio is measured against
+    assert state.memory_bytes() < state.logical_bytes()
+    ratio = state.logical_bytes() / state.memory_bytes()
+    assert ratio > 2.0
+
+
+def test_quant_paged_reset_and_advance_preserve_type():
+    state = KV.QuantPagedKVState.create([(1, 4)], batch=1, max_len=8,
+                                        page_size=4)
+    k = jnp.ones((1, 1, 2, 4), jnp.float32)
+    state.append_rows(0, k, k)
+    state = state.advanced(2)
+    assert isinstance(state, KV.QuantPagedKVState)
+    assert int(state.length) == 2
+    state = state.reset()
+    assert isinstance(state, KV.QuantPagedKVState)
+    assert int(state.length) == 0
+    assert np.all(np.asarray(state.block_table) == -1)

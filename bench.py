@@ -123,6 +123,41 @@ def bench_decode_throughput(arch, params, mapper, block=1024, tokens=96):
     return tokens / (time.perf_counter() - t0)
 
 
+def bench_paged_generate(arch, params, block=1024, tokens=64):
+    """Paged-KV single-stream decode (BASELINE config "gpt2-medium
+    /generate/ with paged KV"): tokens/sec through the paged pool +
+    assigned page bytes at the end of the run."""
+    import os
+
+    from penroz_tpu.models.model import NeuralNetworkModel
+    from penroz_tpu.ops import kv_cache as KV
+
+    model = NeuralNetworkModel.__new__(NeuralNetworkModel)
+    model.params = params
+    model.buffers = {}
+    model.arch = arch
+    model.device = None
+    model._sample_rng = jax.random.key(0)
+    prompt = [list(np.random.default_rng(0).integers(0, 50304, 128))]
+
+    os.environ[KV.PAGED_ENV] = "1"
+    try:
+        list(model.generate_tokens_stream(prompt, block, 16,
+                                          temperature=1.0))  # warm
+        metrics = KV.KVCache(len(arch.attn_layers))
+        ctx = list(prompt[0])
+        t0 = time.perf_counter()
+        for _ in model._generate_iter(ctx, block, tokens, 1.0, None,
+                                      metrics):
+            pass
+        tps = tokens / (time.perf_counter() - t0)
+        st = getattr(metrics, "final_state", None)
+        assigned = st.assigned_bytes() if hasattr(st, "assigned_bytes") else 0
+        return tps, assigned
+    finally:
+        os.environ.pop(KV.PAGED_ENV, None)
+
+
 def bench_dispatch_floor():
     """p50 latency of a trivial jitted call — the harness/relay floor that
     bounds TTFT and per-dispatch decode on remotely attached TPUs."""
@@ -158,6 +193,8 @@ def main():
     dispatch_floor = bench_dispatch_floor()
     ttft_ms = bench_ttft(arch, params, block=block)
     decode_tps = bench_decode_throughput(arch, params, mapper, block=block)
+    paged_tps, paged_assigned = bench_paged_generate(arch, params,
+                                                     block=block)
     tokens_per_sec, cost = bench_train(arch, mapper, params)
     mfu = (tokens_per_sec
            * _flops_per_token(n_matmul_params, depth, d_model, block)
@@ -171,6 +208,8 @@ def main():
         "mfu": round(mfu, 4),
         "ttft_ms_p50": round(ttft_ms, 2),
         "decode_tokens_per_sec": round(decode_tps, 1),
+        "paged_decode_tokens_per_sec": round(paged_tps, 1),
+        "paged_assigned_mb": round(paged_assigned / 2 ** 20, 2),
         "dispatch_floor_ms": round(dispatch_floor, 2),
         "train_cost_sample": round(cost, 3),
         "device": str(device.device_kind),

@@ -1,0 +1,135 @@
+"""Scaling-efficiency harness: train tokens/sec/device at 1/2/4/8-device
+mesh sizes (BASELINE.md target "Scaling efficiency — measure 1→64 chips").
+
+On a pod this runs against real chips; on a development host it re-execs
+itself per mesh size under ``XLA_FLAGS=--xla_force_host_platform_device_
+count=N JAX_PLATFORMS=cpu`` so the same data-parallel program (global batch
+sharded over the mesh's data axis, gradient psum inserted by XLA) is
+exercised end-to-end on a virtual mesh.  Weak scaling: per-device batch is
+fixed, so ideal scaling keeps tokens/sec/device flat and efficiency(N) =
+tps(N) / (N · tps(1)).
+
+Prints ONE JSON line:
+  {"metric": "scaling efficiency", "value": eff@max, "unit": ...,
+   "points": [{"devices": N, "tokens_per_sec": ..., "per_device": ...}]}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+MESH_SIZES = (1, 2, 4, 8)
+PER_DEVICE_BATCH = 4
+BLOCK = 256
+DEPTH = 4
+D_MODEL = 256
+STEPS = 3
+TIMED = 4
+
+
+def _child(n_devices: int) -> None:
+    """Measure tokens/sec for one mesh size; prints a JSON line."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import CompiledArch
+    from penroz_tpu.parallel import mesh as mesh_lib
+    from penroz_tpu.parallel import sharding as sharding_lib
+    from __graft_entry__ import OPTIMIZER, _gpt2_dsl
+
+    devices = jax.devices()[:n_devices]
+    mapper = Mapper(_gpt2_dsl(vocab=2048, d=D_MODEL, heads=4, depth=DEPTH,
+                              block=BLOCK), OPTIMIZER)
+    arch = CompiledArch.get(mapper.layers)
+    params, _ = mapper.init_params(arch.mods, seed=0)
+    opt_state = mapper.to_optimizer().init(params)
+
+    mesh = mesh_lib.make_mesh(devices)
+    params = sharding_lib.shard_params(params, mesh)
+    opt_state = jax.device_put(opt_state, mesh_lib.replicated(mesh))
+
+    batch = PER_DEVICE_BATCH * n_devices
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 2048, (STEPS, batch, BLOCK), dtype=np.int32)
+    y = rng.integers(0, 2048, (STEPS, batch, BLOCK), dtype=np.int32)
+    xs = sharding_lib.shard_batch(x, mesh, leading_steps=True)
+    ys = sharding_lib.shard_batch(y, mesh, leading_steps=True)
+
+    epoch_fn = arch.train_epoch_fn(mapper.optimizer, STEPS)
+    key = jax.random.key(0)
+    buffers = {}
+    for _ in range(2):  # compile + warm
+        params, opt_state, buffers, cost, _ = epoch_fn(params, opt_state,
+                                                       buffers, xs, ys, key)
+    float(cost)
+    t0 = time.perf_counter()
+    for _ in range(TIMED):
+        params, opt_state, buffers, cost, _ = epoch_fn(params, opt_state,
+                                                       buffers, xs, ys, key)
+    float(cost)
+    elapsed = time.perf_counter() - t0
+    tokens = TIMED * STEPS * batch * BLOCK
+    print(json.dumps({"devices": n_devices,
+                      "tokens_per_sec": tokens / elapsed}))
+
+
+def main() -> None:
+    points = []
+    for n in MESH_SIZES:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = env.get("BENCH_SCALING_PLATFORM", "cpu")
+        if env["JAX_PLATFORMS"] == "cpu":
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count={n}"
+                                ).strip()
+            # A remote-accelerator plugin on PYTHONPATH would still dial its
+            # backend under JAX_PLATFORMS=cpu; scrub to repo-only.
+            env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", str(n)],
+            env=env, capture_output=True, text=True, timeout=1200)
+        if out.returncode != 0:
+            print(out.stderr[-2000:], file=sys.stderr)
+            raise SystemExit(f"child failed for {n} devices")
+        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+        rec = json.loads(line)
+        rec["per_device"] = rec["tokens_per_sec"] / rec["devices"]
+        points.append(rec)
+
+    base = points[0]["tokens_per_sec"]
+    top = points[-1]
+    virtual = os.environ.get("BENCH_SCALING_PLATFORM", "cpu") == "cpu"
+    if virtual:
+        # All "devices" share one host CPU, so per-device weak scaling is
+        # physically impossible — the meaningful number is how much total
+        # throughput the sharded program retains versus single-device
+        # (collective/partitioning overhead).  Real chips report true
+        # per-device efficiency below.
+        metric = (f"virtual-mesh total-throughput retention "
+                  f"@{top['devices']} devices")
+        value = top["tokens_per_sec"] / base
+    else:
+        metric = f"train scaling efficiency @{top['devices']} devices"
+        value = top["tokens_per_sec"] / (top["devices"] * base)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 4),
+        "unit": "fraction of linear",
+        "vs_baseline": round(value, 4),  # linear scaling = 1.0
+        "virtual_mesh": virtual,
+        "points": [{k: (round(v, 1) if isinstance(v, float) else v)
+                    for k, v in p.items()} for p in points],
+    }))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        _child(int(sys.argv[2]))
+    else:
+        main()

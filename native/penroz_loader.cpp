@@ -129,6 +129,17 @@ PyObject* stream_gather_into(StreamObject* self, PyObject* args) {
   Py_buffer dest;
   unsigned long long start, count;
   if (!PyArg_ParseTuple(args, "w*KK", &dest, &start, &count)) return nullptr;
+  // Reject counts whose byte size would overflow before the dest.len
+  // comparison ("K" also silently wraps negative Python ints into huge
+  // values) — an overflowed product would pass the check and the copy
+  // loop would write far past the buffer.
+  if (count > SIZE_MAX / sizeof(int32_t) ||
+      count > static_cast<unsigned long long>(PY_SSIZE_T_MAX) /
+                  sizeof(int32_t)) {
+    PyBuffer_Release(&dest);
+    PyErr_SetString(PyExc_ValueError, "count out of range");
+    return nullptr;
+  }
   if (dest.len < static_cast<Py_ssize_t>(count * sizeof(int32_t))) {
     PyBuffer_Release(&dest);
     PyErr_SetString(PyExc_ValueError, "destination buffer too small");

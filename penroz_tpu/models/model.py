@@ -48,7 +48,11 @@ DECODE_CHUNK_ENV = "PENROZ_DECODE_CHUNK"
 
 
 def _resolve_device(device: Optional[str]):
-    """Map an API device string to a jax.Device (None = leave placement)."""
+    """Map an API device string to a jax.Device (None = leave placement).
+
+    Unknown strings raise ValueError (→ HTTP 400) — silently falling back
+    to default placement would train on the wrong device for a typo like
+    ``"tpuu"``."""
     if device is None:
         return None
     device = device.lower()
@@ -61,7 +65,8 @@ def _resolve_device(device: Optional[str]):
             except RuntimeError:
                 continue
         return jax.devices()[0]
-    return None
+    raise ValueError(f"Unknown device {device!r}; expected 'cpu', 'tpu', "
+                     f"'gpu', 'cuda', 'axon' or 'accelerator'")
 
 
 class CompiledArch:
@@ -186,6 +191,10 @@ class CompiledArch:
             compute_dtype=compute_dtype, sp_mesh=sp_mesh, platform=platform)
         cost = (self._cost_from_logits(logits, targets, platform=platform)
                 if targets is not None else None)
+        if cost is not None and ctx.aux_losses:
+            # Auxiliary training losses (MoE load balancing) ride the same
+            # scalar so value_and_grad backpropagates them with the task loss.
+            cost = cost + sum(ctx.aux_losses)
         new_kv = ctx.kv.advanced(tokens.shape[-1]) if ctx.kv is not None else None
         return acts, cost, ctx.buffer_updates, new_kv
 
@@ -889,6 +898,24 @@ class NeuralNetworkModel:
         dt = self.dtype
         return dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
 
+    def _auto_paged(self, block_size: int) -> Optional[bool]:
+        """Default to the paged cache on TPU when the contiguous decode
+        kernel's VMEM gate would trip (ops/attention.py:_use_flash_decode
+        stages full (S, D) K/V; beyond ~6 MB it falls back to a jnp path
+        paying S_max compute every step).  None = let the env flags decide.
+        """
+        from penroz_tpu.ops.attention import (DECODE_KV_VMEM_BUDGET,
+                                              _tpu_platform)
+        if os.environ.get(KV.PAGED_ENV) is not None or KV.turbo_quant_enabled():
+            return None  # explicit configuration wins
+        if not _tpu_platform(next(iter(self.params.values()), None),
+                             self._platform):
+            return None
+        itemsize = jnp.dtype(self._kv_dtype()).itemsize
+        too_big = any(2 * block_size * d * itemsize > DECODE_KV_VMEM_BUDGET
+                      for _, d in self.arch.kv_specs)
+        return True if too_big else None
+
     def _kv_specs(self, batch: int = 1, max_len: int = 0):
         return self.arch.kv_specs
 
@@ -909,7 +936,8 @@ class NeuralNetworkModel:
         chunk_budget = max(1, int(os.environ.get(DECODE_CHUNK_ENV, "16")))
         decode = self.arch.decode_fn()
         kv = KV.create_kv_state(self.arch.kv_specs, 1, block_size,
-                                self._kv_dtype())
+                                self._kv_dtype(),
+                                paged=self._auto_paged(block_size))
         cache_len = 0
         produced = 0
         dispatch = 0
@@ -946,6 +974,9 @@ class NeuralNetworkModel:
                 metrics.record_step(len(new_tokens), kv.logical_bytes(),
                                     kv.memory_bytes(),
                                     (time.monotonic() - t0) * 1000)
+                # Final functional state, observable after exhaustion (the
+                # paged bench reads assigned_bytes() from it).
+                metrics.final_state = kv
             for tok in new_tokens:
                 context.append(tok)
                 last_tok = tok
@@ -1164,6 +1195,14 @@ class NeuralNetworkModel:
                 params[name] = arr
         model.params = {k: jnp.asarray(v) for k, v in params.items()}
         model.buffers = {k: jnp.asarray(v) for k, v in buffers.items()}
+        # Buffer-schema migration: checkpoints written before a module
+        # gained a buffer (e.g. MoE router_fraction) lack its key; training
+        # would then grow the lax.scan carry mid-step and fail at trace
+        # time.  Fill absent buffers with their module defaults.
+        for mod in model.arch.mods:
+            for sub in mod.walk():
+                for key, value in sub.init_buffers().items():
+                    model.buffers.setdefault(key, jnp.asarray(value))
         optimizer = dsl.build_optimizer(model.optimizer_config)
         template = jax.eval_shape(optimizer.init, model.params)
         model.opt_state = jax.tree.unflatten(

@@ -20,6 +20,11 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
+# VMEM the contiguous decode kernel may spend staging full (S, D) K+V per
+# (batch, kv-head) instance; beyond this it falls back to the jnp path and
+# the model runtime auto-pages instead (models/model.py:_auto_paged).
+DECODE_KV_VMEM_BUDGET = 6 * 1024 * 1024
+
 
 def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype):
     """cos/sin tables of shape (length, head_dim) starting at ``offset``."""
@@ -252,4 +257,4 @@ def _use_flash_decode(q, k_full, platform=None) -> bool:
     kv_vmem_bytes = 2 * S * D * jnp.dtype(k_full.dtype).itemsize
     return (S >= 128 and S % 128 == 0 and D in (64, 128, 256)
             and Hq % Hkv == 0 and (Hq // Hkv) * T <= 512
-            and kv_vmem_bytes <= 6 * 1024 * 1024)
+            and kv_vmem_bytes <= DECODE_KV_VMEM_BUDGET)

@@ -58,6 +58,7 @@ class Ctx:
         self.sp_mesh = sp_mesh  # Mesh with a >1 'sequence' axis → ring attn
         self.platform = platform  # execution platform hint for kernel gates
         self.buffer_updates = {}
+        self.aux_losses = []  # auxiliary training losses (e.g. MoE balance)
         self._rng_counter = 0
 
     def next_rng(self):
@@ -550,7 +551,7 @@ class MixtureOfExperts(Module):
 
     def __init__(self, in_features: int, intermediate_size: int,
                  num_experts: int, top_k: int = 2, bias: bool = False,
-                 activation: str = "silu"):
+                 activation: str = "silu", aux_loss_coef: float = 0.0):
         if top_k < 1 or top_k > num_experts:
             raise ValueError(f"top_k={top_k} outside [1, {num_experts}]")
         if bias:
@@ -560,6 +561,12 @@ class MixtureOfExperts(Module):
         self.num_experts = int(num_experts)
         self.top_k = int(top_k)
         self.activation = activation
+        # Switch/Mixtral-style load-balance loss weight; 0 disables.  A
+        # top-k router trained purely on task loss commonly collapses onto
+        # few experts, and dense dispatch makes the collapse invisible (no
+        # capacity-overflow signal) — the aux term and the router_fraction
+        # buffer below are the countermeasure + the observability.
+        self.aux_loss_coef = float(aux_loss_coef)
 
     def param_shapes(self):
         d, h, e = self.in_features, self.intermediate_size, self.num_experts
@@ -590,6 +597,12 @@ class MixtureOfExperts(Module):
     def _act(self, x):
         return _gated_activation(self.activation, x)
 
+    def init_buffers(self):
+        # Latest per-expert routing fraction (observability; updated each
+        # training step like BatchNorm running stats).
+        return {self.key("router_fraction"):
+                jnp.zeros((self.num_experts,), jnp.float32)}
+
     def router_weights(self, x, ctx):
         """(B, T, E) combine weights: softmax → top-k → renormalize.
 
@@ -604,6 +617,18 @@ class MixtureOfExperts(Module):
         top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
         one_hot = jax.nn.one_hot(top_idx, self.num_experts,
                                  dtype=jnp.float32)  # (B, T, k, E)
+        if ctx.training:
+            # f_e: fraction of routing slots assigned to expert e;
+            # P_e: mean router probability.  Switch aux = E · Σ f_e P_e is
+            # minimized (=1) by uniform routing.
+            fractions = jnp.mean(jnp.sum(one_hot, axis=2), axis=(0, 1))
+            mean_probs = jnp.mean(probs, axis=(0, 1))
+            ctx.buffer_updates[self.key("router_fraction")] = \
+                fractions / self.top_k
+            if self.aux_loss_coef > 0.0:
+                aux = self.num_experts * jnp.sum(
+                    (fractions / self.top_k) * mean_probs)
+                ctx.aux_losses.append(self.aux_loss_coef * aux)
         return jnp.einsum("btk,btke->bte", top_vals, one_hot)
 
     def apply(self, x, ctx):

@@ -134,3 +134,102 @@ def test_moe_dsl_train_and_generate(workdir, toy_shards):
     tokens = model.generate_tokens([[1, 2]], block_size=16, max_new_tokens=4,
                                    temperature=0.0)
     assert len(tokens) == 6
+
+
+def test_moe_aux_loss_and_router_stats():
+    """Load-balance aux loss accumulates into ctx during training and the
+    per-expert routing fractions land in buffer_updates (observable expert
+    collapse — the dense dispatch otherwise hides it)."""
+    mod = M.MixtureOfExperts(8, 16, num_experts=4, top_k=2,
+                             aux_loss_coef=0.01)
+    mod.bind("moe")
+    params = mod.init(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 8)),
+                    jnp.float32)
+
+    ctx = M.Ctx(params, mod.init_buffers(), training=True,
+                rng=jax.random.key(1))
+    mod.apply(x, ctx)
+    assert len(ctx.aux_losses) == 1
+    aux = float(ctx.aux_losses[0])
+    # Switch aux = coef · E · Σ f·P ≥ coef for any routing; ≈ coef at uniform
+    assert aux >= 0.01 - 1e-6
+    frac = np.asarray(ctx.buffer_updates[mod.key("router_fraction")])
+    assert frac.shape == (4,)
+    np.testing.assert_allclose(frac.sum(), 1.0, atol=1e-5)
+
+    # Inference and coef=0 add no aux loss.
+    ctx_eval = M.Ctx(params, mod.init_buffers(), training=False)
+    mod.apply(x, ctx_eval)
+    assert ctx_eval.aux_losses == []
+    mod0 = M.MixtureOfExperts(8, 16, num_experts=4, top_k=2)
+    mod0.bind("moe")
+    ctx0 = M.Ctx(mod0.init(jax.random.key(0)), mod0.init_buffers(),
+                 training=True, rng=jax.random.key(1))
+    mod0.apply(x, ctx0)
+    assert ctx0.aux_losses == []
+
+
+def test_moe_aux_loss_reaches_training_cost():
+    """The aux term backpropagates: router grads are nonzero even when the
+    task loss is flat in the router (symmetric experts)."""
+    layers = [{"linear": {"in_features": 4, "out_features": 8}},
+              {"moe": {"in_features": 8, "intermediate_size": 8,
+                       "num_experts": 2, "top_k": 1,
+                       "aux_loss_coef": 0.1}},
+              {"linear": {"in_features": 8, "out_features": 4}}]
+    mapper = Mapper(layers, {"sgd": {"lr": 0.1}})
+    arch = CompiledArch.get(mapper.layers)
+    params, buffers = mapper.init_params(arch.mods, seed=0)
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 5, 4)),
+                    jnp.float32)
+    y = jnp.asarray(np.random.default_rng(3).normal(size=(2, 5, 4)),
+                    jnp.float32)
+
+    def loss(p):
+        _, cost, _, _ = arch.forward(p, buffers, x, y, training=True,
+                                     rng=jax.random.key(0))
+        return cost
+
+    def loss_no_aux(p):
+        _, cost, _, _ = arch.forward(p, buffers, x, y, training=False)
+        return cost
+
+    with_aux = float(loss(params))
+    without = float(loss_no_aux(params))
+    assert with_aux > without  # aux term present in the training cost
+
+
+def test_moe_train_epoch_and_checkpoint_migration(workdir):
+    """MoE trains through train_epoch_fn (buffer updates must not change
+    the lax.scan carry structure), and checkpoints saved before the
+    router_fraction buffer existed still train after deserialize."""
+    from penroz_tpu.utils import checkpoint
+    layers = [{"linear": {"in_features": 4, "out_features": 8}},
+              {"moe": {"in_features": 8, "intermediate_size": 8,
+                       "num_experts": 2, "top_k": 1}},
+              {"linear": {"in_features": 8, "out_features": 4}}]
+    model = NeuralNetworkModel("moemig", Mapper(layers, {"sgd": {"lr": 0.1}}))
+    model.serialize(sync_flush=True)
+
+    # Simulate a pre-router_fraction checkpoint: strip the buffer key.
+    blob = checkpoint.load("moemig")
+    blob["buffers"] = {k: v for k, v in blob["buffers"].items()
+                       if "router_fraction" not in k}
+    checkpoint.save("moemig", blob, sync_flush=True)
+
+    restored = NeuralNetworkModel.deserialize("moemig")
+    assert any("router_fraction" in k for k in restored.buffers)  # migrated
+
+    epoch_fn = restored.arch.train_epoch_fn(restored.optimizer_config,
+                                            num_steps=2)
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.normal(size=(2, 2, 5, 4)), jnp.float32)
+    ys = jnp.asarray(rng.normal(size=(2, 2, 5, 4)), jnp.float32)
+    params, opt_state, buffers, cost, _ = epoch_fn(
+        restored.params, restored.opt_state, restored.buffers, xs, ys,
+        jax.random.key(0))
+    assert np.isfinite(float(cost))
+    frac = np.asarray(
+        next(v for k, v in buffers.items() if "router_fraction" in k))
+    np.testing.assert_allclose(frac.sum(), 1.0, atol=1e-5)

@@ -44,6 +44,10 @@ def _child(n_devices: int) -> None:
     from __graft_entry__ import OPTIMIZER, _gpt2_dsl
 
     devices = jax.devices()[:n_devices]
+    if len(devices) != n_devices:
+        raise SystemExit(f"requested {n_devices} devices but only "
+                         f"{len(devices)} available — refusing to report "
+                         f"a mislabeled scaling point")
     mapper = Mapper(_gpt2_dsl(vocab=2048, d=D_MODEL, heads=4, depth=DEPTH,
                               block=BLOCK), OPTIMIZER)
     arch = CompiledArch.get(mapper.layers)

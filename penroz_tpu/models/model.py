@@ -14,9 +14,11 @@ TPU-native re-design of the reference's ``neural_net_model.py``:
   avg-cost/stats/status bookkeeping and /dev/shm write-through checkpoints
   (reference :98-174, 516-722).
 
-Decode is chunked: up to ``PENROZ_DECODE_CHUNK`` (default 16) fused
-decode+sample steps run per dispatch via ``lax.scan`` with power-of-two chunk
-descent, bounding both per-token dispatch overhead and compile variants.
+Decode is chunked and pipelined: up to ``PENROZ_DECODE_CHUNK`` (default 64)
+fused decode+sample steps run per dispatch via ``lax.scan`` with power-of-two
+chunk descent, and the next chunk is dispatched before the previous chunk's
+tokens are transferred to the host (the last sampled token stays on-device),
+bounding per-token dispatch overhead, compile variants, and host round-trips.
 """
 
 from __future__ import annotations
@@ -924,66 +926,109 @@ class NeuralNetworkModel:
                        top_k: Optional[int], metrics: Optional[KV.KVCache]):
         """Yield new tokens one at a time, appending each to ``context``.
 
-        Chunked decode: one (re)prefill dispatch, then up to
-        ``PENROZ_DECODE_CHUNK`` fused decode+sample steps per dispatch.  When
-        the cache fills, the context is cropped and re-prefilled (reference
-        overflow path: neural_net_model.py:375-389).
+        Chunked, pipelined decode: one (re)prefill dispatch, then up to
+        ``PENROZ_DECODE_CHUNK`` fused decode+sample steps per dispatch.  The
+        next chunk is dispatched *before* the previous chunk's tokens are
+        transferred to the host — the last sampled token stays on-device as
+        the next chunk's input, so host-side conversion/yielding overlaps
+        the device compute (a chunk dispatched past a ``stop_token`` is
+        simply abandoned).  When the cache fills, the context is cropped
+        and re-prefilled (reference overflow path:
+        neural_net_model.py:375-389); the re-prefill needs the full host
+        context, so the pipeline drains at that boundary.
         """
         greedy = temperature is None or float(temperature) == 0.0
         temp = jnp.asarray(float(temperature) if temperature else 1.0,
                            jnp.float32)
         self._sample_rng, call_rng = jax.random.split(self._sample_rng)
-        chunk_budget = max(1, int(os.environ.get(DECODE_CHUNK_ENV, "16")))
+        chunk_budget = max(1, int(os.environ.get(DECODE_CHUNK_ENV, "64")))
         decode = self.arch.decode_fn()
         kv = KV.create_kv_state(self.arch.kv_specs, 1, block_size,
                                 self._kv_dtype(),
                                 paged=self._auto_paged(block_size))
         cache_len = 0
-        produced = 0
+        produced = 0    # tokens yielded to the caller
+        dispatched = 0  # tokens sampled on-device (may run one chunk ahead)
         dispatch = 0
-        last_tok: Optional[int] = None
-        while produced < max_new_tokens:
-            t0 = time.monotonic()
-            rng = jax.random.fold_in(call_rng, dispatch)
-            if cache_len == 0 or cache_len >= block_size:
-                with profiling.span("penroz/prefill"):
-                    kv = kv.reset()
-                    feed = context[-block_size:]
-                    x = jnp.asarray(np.asarray(feed, np.int64)[None, :],
-                                    jnp.int32)
-                    tok_arr, kv = decode(self.params, self.buffers, kv, x,
-                                         rng, temp, greedy=greedy,
-                                         top_k=top_k,
-                                         platform=self._platform)
-                    cache_len = len(feed)
-                    new_tokens = [int(np.asarray(tok_arr)[0, 0])]
-            else:
-                with profiling.span("penroz/decode_chunk"):
-                    room = block_size - cache_len
-                    chunk = min(chunk_budget, max_new_tokens - produced, room)
-                    chunk = 1 << (chunk.bit_length() - 1)  # pow-2 variants
-                    x = jnp.asarray([[last_tok]], jnp.int32)
-                    toks_arr, kv = self.arch.decode_chunk(
-                        self.params, self.buffers, kv, x, rng, temp,
-                        chunk=chunk, greedy=greedy, top_k=top_k,
-                        platform=self._platform)
-                    cache_len += chunk
-                    new_tokens = [int(t) for t in np.asarray(toks_arr)[0]]
-            dispatch += 1
+        last_dev = None  # (B, n) device tokens of the newest chunk
+        pending = None   # (device tokens, count, dispatch time) to flush
+
+        def flush(entry):
+            nonlocal produced
+            arr, count, dispatch_ms, logical, stored, state = entry
+            t_wait = time.monotonic()
+            toks = [int(t) for t in np.asarray(arr)[0][:count]]
             if metrics is not None:
-                metrics.record_step(len(new_tokens), kv.logical_bytes(),
-                                    kv.memory_bytes(),
-                                    (time.monotonic() - t0) * 1000)
-                # Final functional state, observable after exhaustion (the
-                # paged bench reads assigned_bytes() from it).
-                metrics.final_state = kv
-            for tok in new_tokens:
+                # dispatch (trace/enqueue) time of THIS chunk + the blocking
+                # wait for its results; bytes captured at enqueue so a
+                # pipelined successor's growth isn't charged to this chunk.
+                wait_ms = (time.monotonic() - t_wait) * 1000
+                metrics.record_step(count, logical, stored,
+                                    dispatch_ms + wait_ms)
+                metrics.final_state = state
+            for tok in toks:
                 context.append(tok)
-                last_tok = tok
                 produced += 1
                 yield tok
                 if produced >= max_new_tokens:
-                    break
+                    return
+
+        while produced < max_new_tokens:
+            new_pending = None
+            if dispatched < max_new_tokens:
+                at_boundary = cache_len == 0 or cache_len >= block_size
+                if at_boundary and pending is not None:
+                    # Re-prefill reads context from the host: drain first.
+                    yield from flush(pending)
+                    pending = None
+                    if produced >= max_new_tokens:
+                        break
+                    at_boundary = cache_len == 0 or cache_len >= block_size
+                t0 = time.monotonic()
+                rng = jax.random.fold_in(call_rng, dispatch)
+                if at_boundary:
+                    with profiling.span("penroz/prefill"):
+                        kv = kv.reset()
+                        feed = context[-block_size:]
+                        x = jnp.asarray(np.asarray(feed, np.int64)[None, :],
+                                        jnp.int32)
+                        tok_arr, kv = decode(self.params, self.buffers, kv,
+                                             x, rng, temp, greedy=greedy,
+                                             top_k=top_k,
+                                             platform=self._platform)
+                        cache_len = len(feed)
+                        new_pending = (tok_arr, 1,
+                                       (time.monotonic() - t0) * 1000,
+                                       kv.logical_bytes(), kv.memory_bytes(),
+                                       kv)
+                        last_dev = tok_arr
+                        dispatched += 1
+                else:
+                    with profiling.span("penroz/decode_chunk"):
+                        room = block_size - cache_len
+                        chunk = min(chunk_budget,
+                                    max_new_tokens - dispatched, room)
+                        chunk = 1 << (chunk.bit_length() - 1)  # pow-2
+                        toks_arr, kv = self.arch.decode_chunk(
+                            self.params, self.buffers, kv,
+                            last_dev[:, -1:], rng, temp, chunk=chunk,
+                            greedy=greedy, top_k=top_k,
+                            platform=self._platform)
+                        cache_len += chunk
+                        new_pending = (toks_arr, chunk,
+                                       (time.monotonic() - t0) * 1000,
+                                       kv.logical_bytes(), kv.memory_bytes(),
+                                       kv)
+                        last_dev = toks_arr
+                        dispatched += chunk
+                dispatch += 1
+            # Host conversion of the previous chunk overlaps the dispatch
+            # above, which is still executing on-device.
+            if pending is not None:
+                yield from flush(pending)
+            pending = new_pending
+        if pending is not None and produced < max_new_tokens:
+            yield from flush(pending)
 
     @staticmethod
     def _prompt_tokens(input) -> list[int]:

@@ -264,8 +264,11 @@ async def train_model(request: web.Request):
     model_id = body.model_id
     log.info("Requesting training for model %s on device %s",
              model_id, body.device)
-    # Validate early so a bad model id 404s instead of silently failing in
-    # the background (the checkpoint read is cheap via shm).
+    # Validate early so a bad model id 404s and a bad device string 400s
+    # instead of silently failing in the fire-and-forget background task
+    # (the checkpoint read is cheap via shm).
+    from penroz_tpu.models.model import _resolve_device
+    _resolve_device(body.device)
     await _run_blocking(NeuralNetworkModel.deserialize, model_id)
 
     lock = model_locks.setdefault(model_id, asyncio.Lock())

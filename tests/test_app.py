@@ -340,3 +340,14 @@ def test_docs_page(client):
     assert resp.status == 200
     assert "text/html" in resp.headers["Content-Type"]
     assert b"openapi.json" in body
+
+
+def test_train_bad_device_400s_before_202(client, toy_shards_appdir=None):
+    """A device typo must 400 synchronously, not 202 then silently no-op in
+    the background task."""
+    _create_model(client, "devcheck")
+    status, body = client.json("PUT", "/train/", json={
+        "model_id": "devcheck", "dataset_id": "nope", "shard": 0,
+        "epochs": 1, "batch_size": 1, "block_size": 4, "step_size": 1,
+        "device": "tpuu"})
+    assert status == 400

@@ -228,7 +228,7 @@ class CompiledArch:
 
     def train_epoch_fn(self, optimizer_config: dict, num_steps: int,
                        remat: bool = False, compute_dtype=None, sp_mesh=None,
-                       platform=None):
+                       platform=None, with_ratios: bool = True):
         """One jitted epoch: ``num_steps`` grad-accumulation micro-steps via
         ``lax.scan`` then a single optax update (reference hot loop:
         neural_net_model.py:614-677; sync deferred to the final micro-step is
@@ -237,10 +237,16 @@ class CompiledArch:
         Returns ``fn(params, opt_state, buffers, xs, ys, rng) ->
         (params, opt_state, buffers, cost, weight_update_ratios)`` where
         ``xs``/``ys`` are ``(num_steps, B, T)`` token batches.
+
+        ``with_ratios=False`` compiles a variant that skips the per-weight
+        update-ratio stds (two full passes over the parameters) — the
+        reference only needs them on progress-sampled epochs
+        (neural_net_model.py:686-700), so the hot loop shouldn't pay them
+        every step; the skipping variant returns ``ratios=None``.
         """
         key = ("epoch", json.dumps(optimizer_config, sort_keys=True),
                int(num_steps), bool(remat), str(compute_dtype), sp_mesh,
-               platform)
+               platform, bool(with_ratios))
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -293,6 +299,8 @@ class CompiledArch:
                 lambda g, p: (g * inv).astype(p.dtype), grads, params)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if not with_ratios:
+                return new_params, new_opt_state, new_buffers, cost, None
             # per-weight update ratio std(Δw)/std(w) (reference :686-700)
             ratios = []
             for k in self.param_order:
@@ -644,6 +652,19 @@ class NeuralNetworkModel:
                                                 compute_dtype=compute_dtype,
                                                 sp_mesh=sp_mesh,
                                                 platform=self._platform)
+            # Non-sampled epochs skip the two full parameter passes the
+            # update-ratio stds cost.  The choice is a pure function of the
+            # epoch index so every host runs the same compiled program
+            # (collective schedules must match under a multi-host mesh).
+            sample_every = max(1, epochs // 100)
+            epoch_fn_fast = (
+                self.arch.train_epoch_fn(self.optimizer_config, num_steps,
+                                         remat=remat,
+                                         compute_dtype=compute_dtype,
+                                         sp_mesh=sp_mesh,
+                                         platform=self._platform,
+                                         with_ratios=False)
+                if sample_every > 1 else epoch_fn)
             rng = jax.random.key(0)
             last_save = time.monotonic()
             last_stats = time.monotonic()
@@ -653,7 +674,6 @@ class NeuralNetworkModel:
             # it gets its own, longer cadence than the 10s checkpoint.
             stats_interval = float(
                 os.environ.get("PENROZ_STATS_INTERVAL", "60"))
-            sample_every = max(1, epochs // 100)
             last_batch = None  # host-local numpy micro-batch for /stats/
             for epoch in range(epochs):
                 t0 = time.monotonic()
@@ -682,10 +702,12 @@ class NeuralNetworkModel:
                     ys = sharding_lib.global_batch(
                         ys, mesh, leading_steps=True,
                         shard_sequence=sp_mesh is not None)
+                sampled = epoch % sample_every == 0
+                fn = epoch_fn if sampled else epoch_fn_fast
                 with profiling.span("penroz/train_epoch"):
                     self.params, self.opt_state, self.buffers, cost, ratios = \
-                        epoch_fn(self.params, self.opt_state, self.buffers,
-                                 xs, ys, jax.random.fold_in(rng, epoch))
+                        fn(self.params, self.opt_state, self.buffers,
+                           xs, ys, jax.random.fold_in(rng, epoch))
                 cost = float(cost)
                 duration = time.monotonic() - t0
                 if master:

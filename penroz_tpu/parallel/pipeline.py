@@ -22,7 +22,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from penroz_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
 
@@ -111,7 +110,8 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
         # The carry is device-varying over both `data` (inherited from the
         # sharded microbatches via zeros_like) and `pipe` (each stage's state
         # diverges after the first ppermute); the zero init must match.
-        zero_buf = jax.lax.pvary(jnp.zeros_like(mbs_local), (PIPE_AXIS,))
+        zero_buf = jax.lax.pcast(jnp.zeros_like(mbs_local), (PIPE_AXIS,),
+                                 to="varying")
         zero_state = zero_buf[0]
         (_, buf), _ = jax.lax.scan(tick, (zero_state, zero_buf),
                                    jnp.arange(m + pipe - 1))
@@ -119,7 +119,7 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
         mine = jnp.where(stage == pipe - 1, buf, jnp.zeros_like(buf))
         return jax.lax.psum(mine, PIPE_AXIS)
 
-    out = shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
+    out = jax.shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
                     out_specs=out_spec)(stacked_params, mbs)
     return out.reshape(batch, *x.shape[1:])
 

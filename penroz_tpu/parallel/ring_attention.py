@@ -76,7 +76,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     acc0 = jnp.zeros((B, Hkv, group, Tl, D), jnp.float32)
     # Mark the replicated-initialized carries as device-varying so the loop
     # carry type matches what the ring rotation produces.
-    m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), (axis_name,))
+    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,),
+                                 to="varying")
     m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
 
     l = jnp.where(l == 0.0, 1.0, l)

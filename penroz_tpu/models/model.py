@@ -922,24 +922,6 @@ class NeuralNetworkModel:
         dt = self.dtype
         return dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
 
-    def _auto_paged(self, block_size: int) -> Optional[bool]:
-        """Default to the paged cache on TPU when the contiguous decode
-        kernel's VMEM gate would trip (ops/attention.py:_use_flash_decode
-        stages full (S, D) K/V; beyond ~6 MB it falls back to a jnp path
-        paying S_max compute every step).  None = let the env flags decide.
-        """
-        from penroz_tpu.ops.attention import (DECODE_KV_VMEM_BUDGET,
-                                              _tpu_platform)
-        if os.environ.get(KV.PAGED_ENV) is not None or KV.turbo_quant_enabled():
-            return None  # explicit configuration wins
-        if not _tpu_platform(next(iter(self.params.values()), None),
-                             self._platform):
-            return None
-        itemsize = jnp.dtype(self._kv_dtype()).itemsize
-        too_big = any(2 * block_size * d * itemsize > DECODE_KV_VMEM_BUDGET
-                      for _, d in self.arch.kv_specs)
-        return True if too_big else None
-
     def _kv_specs(self, batch: int = 1, max_len: int = 0):
         return self.arch.kv_specs
 
@@ -965,9 +947,11 @@ class NeuralNetworkModel:
         self._sample_rng, call_rng = jax.random.split(self._sample_rng)
         chunk_budget = max(1, int(os.environ.get(DECODE_CHUNK_ENV, "64")))
         decode = self.arch.decode_fn()
+        # Cache layout (contiguous / paged / int8) is env-configured; the
+        # contiguous decode kernel streams K/V tiles through its grid, so
+        # long contexts need no auto-paging heuristic.
         kv = KV.create_kv_state(self.arch.kv_specs, 1, block_size,
-                                self._kv_dtype(),
-                                paged=self._auto_paged(block_size))
+                                self._kv_dtype())
         cache_len = 0
         produced = 0    # tokens yielded to the caller
         dispatched = 0  # tokens sampled on-device (may run one chunk ahead)

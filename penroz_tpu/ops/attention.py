@@ -20,11 +20,6 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
-# VMEM the contiguous decode kernel may spend staging full (S, D) K+V per
-# (batch, kv-head) instance; beyond this it falls back to the jnp path and
-# the model runtime auto-pages instead (models/model.py:_auto_paged).
-DECODE_KV_VMEM_BUDGET = 6 * 1024 * 1024
-
 
 def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype):
     """cos/sin tables of shape (length, head_dim) starting at ``offset``."""
@@ -251,10 +246,8 @@ def _use_flash_decode(q, k_full, platform=None) -> bool:
         return False
     B, Hq, T, D = q.shape
     Hkv, S = k_full.shape[1], k_full.shape[2]
-    # The kernel stages full (S, D) K and V per (batch, kv-head) instance in
-    # VMEM (~16 MB/core); leave headroom for q/out/accumulators.  Longer
-    # caches fall back to the jnp path until the kernel tiles K via the grid.
-    kv_vmem_bytes = 2 * S * D * jnp.dtype(k_full.dtype).itemsize
+    # K/V stream through the kernel grid one tile at a time, so S is
+    # HBM-bounded (no VMEM gate) — bandwidth tracks the valid length via
+    # the clamped index map, not S_max.
     return (S >= 128 and S % 128 == 0 and D in (64, 128, 256)
-            and Hq % Hkv == 0 and (Hq // Hkv) * T <= 512
-            and kv_vmem_bytes <= DECODE_KV_VMEM_BUDGET)
+            and Hq % Hkv == 0 and (Hq // Hkv) * T <= 512)

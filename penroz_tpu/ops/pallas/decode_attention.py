@@ -4,10 +4,22 @@ The decode hot loop attends a handful of new query tokens (T = 1 chunked up
 to ~16) against a preallocated KV cache of capacity ``S_max`` holding
 ``offset + T`` valid entries.  The jnp fallback (ops/attention.py:91-108)
 pays compute and bandwidth proportional to ``S_max``; this kernel prefetches
-the valid length as a scalar and bounds its K/V loop by it, so per-token cost
+the valid length as a scalar and bounds its work by it, so per-token cost
 tracks the *actual* cache occupancy.  GQA is handled by folding the query
 group into the row dimension — one kernel instance per (batch, kv-head)
 computes all grouped query heads on the MXU at once.
+
+K/V stream through the innermost grid dimension one ``block_k`` tile at a
+time (carrying running max/sum/accumulator in VMEM scratch), so VMEM holds
+a single tile regardless of ``S_max`` — context length is HBM-bounded, not
+VMEM-bounded.  Grid steps past the valid length clamp their block index to
+the last valid tile: Pallas elides the HBM→VMEM copy when the index is
+unchanged and ``pl.when`` skips the compute, so overrun steps pay no HBM
+bandwidth and no FLOPs — only per-grid-step scalar-core bookkeeping, which
+grows with ``S_max / block_k``.  At realistic decode capacities (≤ 32k
+tokens → ≤ 128 steps) that overhead is noise; for caches orders of
+magnitude larger than their occupancy, prefer the paged cache
+(``PAGED_KV_CACHE=1``), whose pool is sized by allocation, not capacity.
 
 Replaces the decode half of the reference's
 ``F.scaled_dot_product_attention`` (neural_net_layers.py:92) the way the
@@ -23,30 +35,40 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from penroz_tpu.ops.pallas.flash_attention import _largest_dividing_block
+from penroz_tpu.ops.pallas.flash_attention import (_LANES,
+                                                   _largest_dividing_block)
 
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                   num_queries: int, sm_scale: float):
-    """One (batch, kv-head) instance: GT grouped query rows vs valid cache.
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, block_k: int, num_k: int, num_queries: int,
+                   sm_scale: float):
+    """One (batch, kv-head, k-block) step: GT grouped query rows vs one tile.
 
     q_ref: (1, 1, GT, D) where GT = group * T, row r ↦ (g = r // T, t = r % T).
-    k_ref/v_ref: (1, 1, S_max, D).  len_ref[0] = offset + T (valid entries).
+    k_ref/v_ref: (1, 1, block_k, D) — the j-th valid tile (clamped index map).
+    len_ref[0] = offset + T (valid entries).  Scratch carries the online-
+    softmax state across the sequential j dimension.
     """
+    j = pl.program_id(2)
     gt = q_ref.shape[2]
-    head_dim = q_ref.shape[3]
     total = len_ref[0]
     offset = total - num_queries
+    hi = jax.lax.div(total + block_k - 1, block_k)
 
-    q = q_ref[0, 0]
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def body(j, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+    @pl.when(j < hi)
+    def _block():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -57,26 +79,24 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gt, block_k), 1)
         s = jnp.where(k_pos <= offset + t, s, _NEG_INF)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
-    acc0 = jnp.zeros((gt, head_dim), jnp.float32)
-    m0 = jnp.full((gt,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((gt,), jnp.float32)
-
-    # Only K blocks overlapping [0, total) contribute — the dynamic bound is
-    # the whole point of prefetching the length.
-    hi = jax.lax.div(total + block_k - 1, block_k)
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(j == num_k - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
 
 
 def decode_attention(q, k_full, v_full, offset, length,
@@ -91,35 +111,48 @@ def decode_attention(q, k_full, v_full, offset, length,
     if S % block_k != 0:
         raise ValueError(f"decode_attention requires S%{block_k}==0, got {S}")
     sm_scale = 1.0 / (D ** 0.5)
+    num_k = S // block_k
 
     # Fold the GQA group into the query-row dimension: head order is kv-major
     # (matches _group_query_heads), so this is a pure reshape.
     q_rows = q.reshape(B, Hkv, group * T, D)
     total = jnp.asarray(length, jnp.int32).reshape(1)
 
-    kernel = functools.partial(_decode_kernel, block_k=block_k,
+    def kv_index(b, h, j, len_ref):
+        # Clamp past-the-end steps to the last valid tile: same index ⇒
+        # Pallas elides the copy, so invalid tail tiles are never fetched.
+        hi = jax.lax.div(len_ref[0] + block_k - 1, block_k)
+        return (b, h, jnp.minimum(j, hi - 1), 0)
+
+    kernel = functools.partial(_decode_kernel, block_k=block_k, num_k=num_k,
                                num_queries=T, sm_scale=sm_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(B, Hkv),
+        grid=(B, Hkv, num_k),
         in_specs=[
-            pl.BlockSpec((1, 1, group * T, D), lambda b, h, len_ref: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, group * T, D),
+                         lambda b, h, j, len_ref: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, len_ref: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, block_k, D), kv_index,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, len_ref: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, block_k, D), kv_index,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, group * T, D),
-                               lambda b, h, len_ref: (b, h, 0, 0),
+                               lambda b, h, j, len_ref: (b, h, 0, 0),
                                memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((group * T, _LANES), jnp.float32),
+            pltpu.VMEM((group * T, _LANES), jnp.float32),
+            pltpu.VMEM((group * T, D), jnp.float32),
+        ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q_rows.shape, q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=int(4 * B * Hq * T * S * D),
             bytes_accessed=int((q.size + k_full.size + v_full.size + q.size)

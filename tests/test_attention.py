@@ -289,9 +289,34 @@ def test_kernel_gates_respect_platform_hint():
     assert not A._use_flash_decode(q, k, platform="cpu")
     assert A._use_flash(q, k, platform="tpu")
     assert A._use_flash_decode(q, k, platform="tpu")
-    # oversized cache falls back even on TPU (VMEM bound)
-    k_big = jnp.zeros((1, 2, 32768, 64))
-    assert not A._use_flash_decode(q, k_big, platform="tpu")
+    # long caches stay fused: K/V stream through the kernel grid, so there
+    # is no VMEM bound on cache capacity (round-1 gate removed) — even a
+    # 2M-token cache dispatches the kernel
+    k_big = jax.ShapeDtypeStruct((1, 2, 2_097_152, 64), jnp.float32)
+    assert A._use_flash_decode(q, k_big, platform="tpu")
+    assert not A._use_flash_decode(q, k_big, platform="cpu")
+
+
+def test_decode_kernel_long_cache_interpret():
+    """K-tiled decode kernel vs oracle on a cache much longer than one tile,
+    at occupancies that end mid-tile, at tile boundaries, and nearly empty
+    (the clamped index map must never fetch past the last valid tile)."""
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    rng = np.random.default_rng(7)
+    B, Hq, Hkv, D, S = 1, 4, 2, 64, 2048
+    k_full = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    v_full = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    for offset, T in [(0, 1), (100, 4), (511, 1), (512, 1), (1000, 8),
+                      (2040, 8), (2047, 1)]:
+        q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+        off = jnp.asarray(offset, jnp.int32)
+        length = jnp.asarray(offset + T, jnp.int32)
+        ref = A.cached_attention(q, k_full, v_full, off, length)
+        out = DA.decode_attention(q, k_full, v_full, off, length,
+                                  block_k=256, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5,
+                                   err_msg=f"offset={offset}, T={T}")
 
 
 def test_paged_kernel_matches_oracle_interpret():

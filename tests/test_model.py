@@ -427,20 +427,3 @@ def test_generate_paged_overflow_reprefills(workdir, toy_gpt_layers,
     assert len(tokens) == 13
 
 
-def test_auto_paged_gate(toy_gpt_layers, toy_optimizer, monkeypatch):
-    """Long-context decode on TPU defaults to the paged cache once the
-    contiguous decode kernel's VMEM bound would trip; explicit env flags
-    and non-TPU platforms are untouched."""
-    from penroz_tpu.models.dsl import Mapper
-    from penroz_tpu.models.model import NeuralNetworkModel
-    from penroz_tpu.ops import kv_cache as KV
-    model = NeuralNetworkModel("apg", Mapper(toy_gpt_layers, toy_optimizer))
-    # CPU platform (conftest forces cpu backend): never auto-pages.
-    assert model._auto_paged(200_000) is None
-    # Pretend TPU placement: small context stays contiguous, huge pages.
-    monkeypatch.setattr(type(model), "_platform", property(lambda s: "tpu"))
-    assert model._auto_paged(1024) is None
-    assert model._auto_paged(2_000_000) is True
-    # Explicit flags win either way.
-    monkeypatch.setenv(KV.PAGED_ENV, "0")
-    assert model._auto_paged(2_000_000) is None

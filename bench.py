@@ -118,9 +118,9 @@ def bench_decode_throughput(arch, params, mapper, block=1024, tokens=96):
     model.device = None
     model._sample_rng = jax.random.key(0)
     prompt = [list(np.random.default_rng(0).integers(0, 50304, 128))]
-    # warm with the same token count so every pow-2 chunk program the timed
-    # run will use (64, 32, 16, ...) is already compiled
-    list(model.generate_tokens_stream(prompt, block, tokens, temperature=1.0))
+    # warm with the same call so the exact chunk programs the timed run
+    # dispatches (pow-2 ceiling of the tail) are already compiled
+    model.generate_tokens(prompt, block, tokens, temperature=1.0)
     t0 = time.perf_counter()
     model.generate_tokens(prompt, block, tokens, temperature=1.0)
     return tokens / (time.perf_counter() - t0)
@@ -145,10 +145,11 @@ def bench_paged_generate(arch, params, block=1024, tokens=64):
 
     os.environ[KV.PAGED_ENV] = "1"
     try:
-        # warm with the same token count so every pow-2 chunk program the
-        # timed run will use is already compiled
-        list(model.generate_tokens_stream(prompt, block, tokens,
-                                          temperature=1.0))
+        # warm with the same call shape (non-ramped) so the exact chunk
+        # programs the timed run dispatches are already compiled
+        for _ in model._generate_iter(list(prompt[0]), block, tokens, 1.0,
+                                      None, None):
+            pass
         metrics = KV.KVCache(len(arch.attn_layers))
         ctx = list(prompt[0])
         t0 = time.perf_counter()

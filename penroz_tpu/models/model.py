@@ -14,9 +14,10 @@ TPU-native re-design of the reference's ``neural_net_model.py``:
   avg-cost/stats/status bookkeeping and /dev/shm write-through checkpoints
   (reference :98-174, 516-722).
 
-Decode is chunked and pipelined: up to ``PENROZ_DECODE_CHUNK`` (default 64)
+Decode is chunked and pipelined: up to ``PENROZ_DECODE_CHUNK`` (default 128)
 fused decode+sample steps run per dispatch via ``lax.scan`` with power-of-two
-chunk descent, and the next chunk is dispatched before the previous chunk's
+chunk sizes (tails round up to the compiled ceiling and discard the
+overshoot), and the next chunk is dispatched before the previous chunk's
 tokens are transferred to the host (the last sampled token stays on-device),
 bounding per-token dispatch overhead, compile variants, and host round-trips.
 """
@@ -927,7 +928,8 @@ class NeuralNetworkModel:
 
     def _generate_iter(self, context: list[int], block_size: int,
                        max_new_tokens: int, temperature: float,
-                       top_k: Optional[int], metrics: Optional[KV.KVCache]):
+                       top_k: Optional[int], metrics: Optional[KV.KVCache],
+                       ramp: bool = False):
         """Yield new tokens one at a time, appending each to ``context``.
 
         Chunked, pipelined decode: one (re)prefill dispatch, then up to
@@ -940,12 +942,21 @@ class NeuralNetworkModel:
         and re-prefilled (reference overflow path:
         neural_net_model.py:375-389); the re-prefill needs the full host
         context, so the pipeline drains at that boundary.
+
+        Chunk sizes are powers of two (bounded set of compiled programs).
+        A tail shorter than its pow-2 ceiling dispatches the *ceiling* and
+        discards the overshoot — a few wasted decode steps are far cheaper
+        than the extra dispatch round-trips the descending pow-2
+        decomposition would pay (e.g. 95 tail tokens = one 128-chunk, not
+        64+16+8+4+2+1).  ``ramp=True`` (streaming) starts at 8 and doubles
+        per dispatch so early tokens flow without waiting on a full chunk.
         """
         greedy = temperature is None or float(temperature) == 0.0
         temp = jnp.asarray(float(temperature) if temperature else 1.0,
                            jnp.float32)
         self._sample_rng, call_rng = jax.random.split(self._sample_rng)
-        chunk_budget = max(1, int(os.environ.get(DECODE_CHUNK_ENV, "64")))
+        chunk_budget = max(1, int(os.environ.get(DECODE_CHUNK_ENV, "128")))
+        ramp_budget = 8 if ramp else chunk_budget
         decode = self.arch.decode_fn()
         # Cache layout (contiguous / paged / int8) is env-configured; the
         # contiguous decode kernel streams K/V tiles through its grid, so
@@ -1012,21 +1023,27 @@ class NeuralNetworkModel:
                 else:
                     with profiling.span("penroz/decode_chunk"):
                         room = block_size - cache_len
-                        chunk = min(chunk_budget,
-                                    max_new_tokens - dispatched, room)
-                        chunk = 1 << (chunk.bit_length() - 1)  # pow-2
+                        remaining = max_new_tokens - dispatched
+                        cap = min(chunk_budget, ramp_budget, room)
+                        # pow-2 ceiling of the tail, clipped by the cap;
+                        # a non-pow-2 cap floors back down.
+                        chunk = min(1 << (remaining - 1).bit_length(), cap)
+                        if chunk & (chunk - 1):
+                            chunk = 1 << (chunk.bit_length() - 1)
+                        count = min(chunk, remaining)
                         toks_arr, kv = self.arch.decode_chunk(
                             self.params, self.buffers, kv,
                             last_dev[:, -1:], rng, temp, chunk=chunk,
                             greedy=greedy, top_k=top_k,
                             platform=self._platform)
                         cache_len += chunk
-                        new_pending = (toks_arr, chunk,
+                        new_pending = (toks_arr, count,
                                        (time.monotonic() - t0) * 1000,
                                        kv.logical_bytes(), kv.memory_bytes(),
                                        kv)
                         last_dev = toks_arr
-                        dispatched += chunk
+                        dispatched += count
+                        ramp_budget = min(ramp_budget * 2, chunk_budget)
                 dispatch += 1
             # Host conversion of the previous chunk overlaps the dispatch
             # above, which is still executing on-device.
@@ -1067,7 +1084,7 @@ class NeuralNetworkModel:
         try:
             for tok in self._generate_iter(context, block_size,
                                            max_new_tokens, temperature, top_k,
-                                           metrics):
+                                           metrics, ramp=True):
                 yield tok
                 if stop_token is not None and tok == stop_token:
                     return

@@ -318,6 +318,24 @@ def test_generate_stream_matches_count(workdir, toy_gpt_layers):
     assert len(tokens) == 3
 
 
+def test_generate_tail_overshoot_chunking(workdir, toy_gpt_layers,
+                                          monkeypatch):
+    """A tail shorter than its pow-2 ceiling dispatches the ceiling chunk
+    and discards the overshoot — token count and greedy results must be
+    exact, and stream (ramped chunks) must equal batch under T=0."""
+    monkeypatch.setenv("PENROZ_DECODE_CHUNK", "16")
+    model = NeuralNetworkModel("g4o", Mapper(toy_gpt_layers, SGD))
+    # 11 new tokens = prefill(1) + chunks 8+2 under the old descending
+    # decomposition; now prefill(1) + one 16-chunk with 6 discarded.
+    batch = model.generate_tokens([[1, 2]], block_size=64,
+                                  max_new_tokens=11, temperature=0.0)
+    assert len(batch) == 13
+    stream = list(model.generate_tokens_stream([[1, 2]], block_size=64,
+                                               max_new_tokens=11,
+                                               temperature=0.0))
+    assert stream == batch[2:]
+
+
 def test_generate_context_overflow_reprefills(workdir, toy_gpt_layers):
     model = NeuralNetworkModel("g5", Mapper(toy_gpt_layers, SGD))
     # block_size 4 < prompt+generated: exercises crop-and-reprefill

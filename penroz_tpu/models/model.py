@@ -1218,7 +1218,8 @@ class NeuralNetworkModel:
         out = {}
         for name, meta in sharded_meta.items():
             shape = tuple(meta["shape"])
-            arr = np.zeros(shape, dtype=np.dtype(meta["dtype"]))
+            # checkpoint.np_dtype: plain np.dtype cannot parse "bfloat16"
+            arr = np.zeros(shape, dtype=checkpoint.np_dtype(meta["dtype"]))
             covered = 0
             for shard_data in shards:
                 for ranges, piece in shard_data.get(name, []):

@@ -1,9 +1,22 @@
 """Model checkpoint I/O with a /dev/shm write-through cache.
 
-Checkpoint = one pickle-protocol-5 blob holding the layer DSL, the flat param/
-buffer dicts (numpy arrays; bf16 via ml_dtypes), the optax optimizer config +
-state, and the progress/stats/status JSON — the same logical contents as the
-reference's ``torch.save`` blob (neural_net_model.py:98-174).
+Checkpoint = one file holding the layer DSL, the flat param/buffer dicts
+(numpy arrays; bf16 via ml_dtypes), the optax optimizer config + state, and
+the progress/stats/status JSON — the same logical contents as the
+reference's ``torch.save`` blob (neural_net_model.py:98-174), but in a
+**non-executable container** (safetensors-style: JSON header + raw array
+bytes, below) instead of a pickle: loading a checkpoint can never run code,
+unlike ``torch.load``'s pickle VM (SURVEY §7.1's planned upgrade).
+
+Container layout (``MAGIC`` = ``b"PENROZC1"``)::
+
+    MAGIC | uint64-LE header_len | header JSON (utf-8) | array payload
+
+The header's ``tree`` is the checkpoint's JSON structure with every numpy
+leaf replaced by ``{"__array__": i}`` and every dict encoded as
+``{"__dict__": [[key, value], ...]}`` (preserving int keys, which JSON
+objects cannot); ``arrays[i]`` records dtype/shape/offset/nbytes into the
+64-byte-aligned payload.  Decoding is pure JSON + ``np.frombuffer``.
 
 Write path: serialize into the shared-memory dir (fast, observable by every
 process on the host) and flush to the durable ``models/`` dir in a detached
@@ -13,18 +26,138 @@ cache + async ``shutil.copyfile`` flush, neural_net_model.py:113-122).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
-import pickle
 import platform
 import shutil
+import struct
 import tempfile
 import threading
 import uuid
 
+import numpy as np
+
 log = logging.getLogger(__name__)
 
 MODELS_FOLDER = "models"
+MAGIC = b"PENROZC1"
+_ALIGN = 64
+
+
+def np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes families (``bfloat16``,
+    ``float8_*``) whose names plain ``np.dtype`` cannot parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise TypeError(f"unknown checkpoint dtype {name!r}")
+
+
+def _encode_parts(data):
+    """Split a JSON-able tree with numpy leaves into (header bytes, arrays,
+    meta) — the writer streams arrays to the file so multi-GB checkpoints
+    never exist as one in-memory blob."""
+    arrays: list[np.ndarray] = []
+
+    def enc(x):
+        if isinstance(x, np.ndarray):
+            arrays.append(np.ascontiguousarray(x))
+            return {"__array__": len(arrays) - 1}
+        if isinstance(x, np.generic):  # numpy scalar → python scalar
+            return x.item()
+        if isinstance(x, dict):
+            return {"__dict__": [[k, enc(v)] for k, v in x.items()]}
+        if isinstance(x, (list, tuple)):
+            return [enc(v) for v in x]
+        return x  # str/int/float/bool/None — json handles or raises
+
+    tree = enc(data)
+    meta = []
+    offset = 0
+    for a in arrays:
+        offset = -(-offset // _ALIGN) * _ALIGN
+        meta.append({"dtype": str(a.dtype), "shape": list(a.shape),
+                     "offset": offset, "nbytes": a.nbytes})
+        offset += a.nbytes
+    header = json.dumps({"tree": tree, "arrays": meta},
+                        separators=(",", ":")).encode("utf-8")
+    return header, arrays, meta
+
+
+def _write_stream(f, data):
+    """Write the container to a binary file object."""
+    header, arrays, meta = _encode_parts(data)
+    f.write(MAGIC)
+    f.write(struct.pack("<Q", len(header)))
+    f.write(header)
+    written = 0
+    for a, m in zip(arrays, meta):
+        f.write(b"\0" * (m["offset"] - written))
+        # tobytes(): ml_dtypes (bf16) and 0-d/empty arrays don't all
+        # support zero-copy buffer export; one-array copies keep peak
+        # memory at max(array) instead of sum(arrays).
+        f.write(a.tobytes())
+        written = m["offset"] + m["nbytes"]
+
+
+def _encode(data) -> bytes:
+    """Container bytes in memory (tests / small blobs)."""
+    import io
+    buf = io.BytesIO()
+    _write_stream(buf, data)
+    return buf.getvalue()
+
+
+def _read(path: str):
+    """Decode a container file via mmap: raw bytes are paged by the kernel
+    while each array is copied out, so peak memory is ~sum(arrays), not
+    file-size + sum(arrays) (the writer streams for the same reason)."""
+    import mmap
+    with open(path, "rb") as f:
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:  # zero-length file → same error as bad magic
+            raise ValueError(
+                "not a penroz checkpoint (bad magic); legacy pickle "
+                "checkpoints are not loaded — re-create or re-import the "
+                "model")
+        try:
+            return _decode(mm)
+        finally:
+            mm.close()
+
+
+def _decode(buf: bytes):
+    """Decode container bytes back into the tree (inverse of ``_encode``)."""
+    if buf[:8] != MAGIC:
+        raise ValueError(
+            "not a penroz checkpoint (bad magic); legacy pickle checkpoints "
+            "are not loaded — re-create or re-import the model")
+    (header_len,) = struct.unpack("<Q", buf[8:16])
+    header = json.loads(buf[16:16 + header_len].decode("utf-8"))
+    payload = memoryview(buf)[16 + header_len:]
+    arrays = []
+    for m in header["arrays"]:
+        raw = payload[m["offset"]:m["offset"] + m["nbytes"]]
+        arrays.append(np.frombuffer(raw, dtype=np_dtype(m["dtype"]))
+                      .reshape(m["shape"]).copy())
+
+    def dec(x):
+        if isinstance(x, dict):
+            if "__array__" in x and len(x) == 1:
+                return arrays[x["__array__"]]
+            pairs = x["__dict__"]
+            return {k: dec(v) for k, v in pairs}
+        if isinstance(x, list):
+            return [dec(v) for v in x]
+        return x
+
+    return dec(header["tree"])
 
 
 def detect_shm_path() -> str:
@@ -83,7 +216,7 @@ def save_shard(model_id: str, process_index: int, data: dict,
     os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
     rel = shard_file_path(model_id, process_index)
     shm_path = os.path.join(SHM_PATH, rel)
-    _atomic_pickle(shm_path, data)
+    _atomic_write(shm_path, data)
     if sync_flush:
         _flush(shm_path, rel)
     else:
@@ -119,8 +252,7 @@ def load_shards(model_id: str) -> list[dict]:
         rel = shard_file_path(model_id, idx)
         shm_path = os.path.join(SHM_PATH, rel)
         path = shm_path if os.path.exists(shm_path) else rel
-        with open(path, "rb") as f:
-            shards.append(pickle.load(f))
+        shards.append(_read(path))
     return shards
 
 
@@ -129,14 +261,14 @@ def save(model_id: str, data: dict, sync_flush: bool = False):
 
     Both writes are atomic (temp file + rename) so concurrent readers —
     cross-process ``load()`` on shm, the background flush on durable — never
-    observe a half-written pickle.
+    observe a half-written checkpoint.
     """
     os.makedirs(MODELS_FOLDER, exist_ok=True)
     os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
     shm_path = shm_model_path(model_id)
     durable_path = model_path(model_id)
     log.info("Caching model to %s...", shm_path)
-    _atomic_pickle(shm_path, data)
+    _atomic_write(shm_path, data)
     log.info("Model cached successfully: %s", shm_path)
     if sync_flush:
         _flush(shm_path, durable_path)
@@ -171,11 +303,11 @@ def _mkstemp_for(path: str):
             continue
 
 
-def _atomic_pickle(path: str, data: dict):
+def _atomic_write(path: str, data: dict):
     fd, tmp_path = _mkstemp_for(path)
     try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(data, f, protocol=5)
+            _write_stream(f, data)
         os.replace(tmp_path, path)
     except BaseException:
         if os.path.exists(tmp_path):
@@ -216,8 +348,7 @@ def load(model_id: str) -> dict:
             log.info("Cache miss: copying from %s", durable_path)
             os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
             shutil.copyfile(durable_path, shm_path)
-        with open(shm_path, "rb") as f:
-            return pickle.load(f)
+        return _read(shm_path)
     except FileNotFoundError as e:
         log.error("File not found error occurred: %s", e)
         raise KeyError(f"Model {model_id} not created yet.")

@@ -1,0 +1,93 @@
+"""Checkpoint container codec: non-executable load (no pickle).
+
+The reference persists models as ``torch.save`` pickles
+(neural_net_model.py:116) whose load can execute arbitrary code; the
+penroz container is JSON header + raw array bytes (checkpoint.py module
+docstring), so these tests pin round-trip fidelity — including the bits
+pickle got for free: int dict keys, bf16 dtypes, nested structure — and
+that pickle bytes are rejected outright.
+"""
+
+import pickle
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from penroz_tpu.utils import checkpoint
+
+
+def _roundtrip(data):
+    return checkpoint._decode(checkpoint._encode(data))
+
+
+def test_roundtrip_nested_tree_with_arrays():
+    data = {
+        "layers": [{"linear": {"in_features": 4, "out_features": 2}}],
+        "params": {
+            "layers.0.weight": np.arange(8, dtype=np.float32).reshape(4, 2),
+            "layers.0.bias": np.zeros(2, dtype=ml_dtypes.bfloat16),
+        },
+        "opt_state_leaves": {0: np.int32(3), 1: np.ones(2, np.float64)},
+        "status": {"code": "Trained", "message": None},
+        "avg_cost": 1.5,
+        "progress": [{"epoch": 0, "cost": 2.0, "ok": True}],
+        "unicode": "penröz ✓",
+    }
+    out = _roundtrip(data)
+    assert out["layers"] == data["layers"]
+    np.testing.assert_array_equal(out["params"]["layers.0.weight"],
+                                  data["params"]["layers.0.weight"])
+    assert out["params"]["layers.0.bias"].dtype == ml_dtypes.bfloat16
+    # int dict keys survive (JSON objects alone cannot express them)
+    assert set(out["opt_state_leaves"]) == {0, 1}
+    # numpy scalars come back as python scalars
+    assert out["opt_state_leaves"][0] == 3
+    assert out["status"] == data["status"]
+    assert out["progress"] == data["progress"]
+    assert out["unicode"] == data["unicode"]
+
+
+def test_roundtrip_noncontiguous_and_empty_arrays():
+    base = np.arange(24, dtype=np.float32).reshape(4, 6)
+    data = {"t": base[:, ::2], "empty": np.zeros((0, 3), np.int8)}
+    out = _roundtrip(data)
+    np.testing.assert_array_equal(out["t"], base[:, ::2])
+    assert out["empty"].shape == (0, 3)
+    assert out["empty"].dtype == np.int8
+
+
+def test_shard_pieces_shape_survives():
+    """The shard-file payload shape: pieces are (ranges, array) pairs whose
+    tuples become lists — reassembly unpacks them positionally."""
+    data = {"tag": 7, "pieces": {"w": [(((0, 2), (0, 4)),
+                                        np.ones((2, 4), np.float32))]}}
+    out = _roundtrip(data)
+    (ranges, arr), = out["pieces"]["w"]
+    assert [tuple(r) for r in ranges] == [(0, 2), (0, 4)]
+    np.testing.assert_array_equal(arr, np.ones((2, 4), np.float32))
+
+
+def test_pickle_bytes_rejected():
+    blob = pickle.dumps({"params": {}}, protocol=5)
+    with pytest.raises(ValueError, match="bad magic"):
+        checkpoint._decode(blob)
+
+
+def test_payload_alignment():
+    buf = checkpoint._encode({"a": np.ones(3, np.float32),
+                              "b": np.ones(5, np.int8),
+                              "c": np.ones(2, np.float32)})
+    import json as _json
+    import struct as _struct
+    (hlen,) = _struct.unpack("<Q", buf[8:16])
+    header = _json.loads(buf[16:16 + hlen])
+    for m in header["arrays"]:
+        assert m["offset"] % 64 == 0
+
+
+def test_np_dtype_resolves_ml_dtypes_and_rejects_unknown():
+    assert checkpoint.np_dtype("bfloat16") == np.dtype(ml_dtypes.bfloat16)
+    assert checkpoint.np_dtype("float32") == np.dtype(np.float32)
+    with pytest.raises(TypeError, match="unknown checkpoint dtype"):
+        checkpoint.np_dtype("not_a_dtype")

@@ -41,15 +41,24 @@ def _child(n_devices: int) -> None:
     from penroz_tpu.models.model import CompiledArch
     from penroz_tpu.parallel import mesh as mesh_lib
     from penroz_tpu.parallel import sharding as sharding_lib
-    from __graft_entry__ import OPTIMIZER, _gpt2_dsl
+    from __graft_entry__ import OPTIMIZER
 
     devices = jax.devices()[:n_devices]
     if len(devices) != n_devices:
         raise SystemExit(f"requested {n_devices} devices but only "
                          f"{len(devices)} available — refusing to report "
                          f"a mislabeled scaling point")
-    mapper = Mapper(_gpt2_dsl(vocab=2048, d=D_MODEL, heads=4, depth=DEPTH,
-                              block=BLOCK), OPTIMIZER)
+    # BENCH_SCALING_MODEL=gpt2-xl runs a real ladder size (BASELINE.md's
+    # "gpt2-xl multi-host /train/" scaling config — for pods; the default
+    # shrunken stack keeps the virtual CPU mesh tractable).
+    preset = os.environ.get("BENCH_SCALING_MODEL")
+    from penroz_tpu.models import presets
+    if preset:
+        layers = presets.gpt2(preset, block=BLOCK)
+    else:
+        layers = presets.gpt2_custom(d=D_MODEL, heads=4, depth=DEPTH,
+                                     vocab=2048, block=BLOCK)
+    mapper = Mapper(layers, OPTIMIZER)
     arch = CompiledArch.get(mapper.layers)
     params, _ = mapper.init_params(arch.mods, seed=0)
     opt_state = mapper.to_optimizer().init(params)

@@ -1,0 +1,105 @@
+"""Ready-made layer-DSL configs for the benchmark/baseline model families.
+
+The reference embeds exactly one config — the GPT-2-124M `/model/` OpenAPI
+example (reference main.py:53-93); these builders generate that same DSL
+shape for the whole GPT-2 size ladder (BASELINE.md's gpt2-124M/xl train
+configs) plus the makemore-style char-level MLP (BASELINE.md's CPU-parity
+config).  All return plain JSON-able DSL lists accepted by ``POST /model/``
+and :class:`penroz_tpu.models.dsl.Mapper`.
+"""
+
+from __future__ import annotations
+
+GPT2_SIZES = {
+    # name: (d_model, heads, depth)
+    "gpt2": (768, 12, 12),          # 124M
+    "gpt2-medium": (1024, 16, 24),  # 350M
+    "gpt2-large": (1280, 20, 36),   # 774M
+    "gpt2-xl": (1600, 25, 48),      # 1.5B
+}
+
+ADAMW = {"adamw": {"lr": 6e-4, "betas": [0.9, 0.95], "eps": 1e-8}}
+
+
+def gpt2(size: str = "gpt2", vocab: int = 50304, block: int = 1024,
+         dropout: float = 0.0) -> list:
+    """GPT-2 style DSL (the reference's /model/ example, main.py:53-84) at
+    any ladder size.  ``vocab`` defaults to the 64-padded 50304 the nanoGPT
+    lineage uses for MXU-friendly lm-head matmuls."""
+    if size not in GPT2_SIZES:
+        raise ValueError(f"unknown gpt2 size {size!r}; "
+                         f"one of {sorted(GPT2_SIZES)}")
+    d, heads, depth = GPT2_SIZES[size]
+    return gpt2_custom(d=d, heads=heads, depth=depth, vocab=vocab,
+                       block=block, dropout=dropout)
+
+
+def gpt2_custom(d: int, heads: int, depth: int, vocab: int = 50304,
+                block: int = 1024, dropout: float = 0.0) -> list:
+    """GPT-2-shaped DSL at arbitrary dimensions — the single source for the
+    ladder sizes above, the driver contract's flagship config
+    (``__graft_entry__._gpt2_dsl``), and the scaling bench's shrunken stack.
+    (The HF-config→DSL builder in models/dsl.py stays separate: it is
+    table-driven against the reference's ``mappers.py:121-176`` field
+    mapping, which is its own parity contract.)"""
+    std = 0.02
+    proj_std = std / (2 * depth) ** 0.5
+    return ([{"summation": [
+                {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+                 "normal": {"mean": 0.0, "std": std}},
+                {"position": {"num_embeddings": block, "embedding_dim": d},
+                 "normal": {"mean": 0.0, "std": std}}]},
+             {"dropout": {"p": dropout}}]
+            + [{"residual": [
+                {"sequential": [
+                    {"layernorm": {"normalized_shape": d}},
+                    {"linear": {"in_features": d, "out_features": 3 * d},
+                     "normal": {"mean": 0.0, "std": std}, "zeros": {}},
+                    {"attention": {"num_heads": heads, "dropout": dropout}},
+                    {"linear": {"in_features": d, "out_features": d},
+                     "normal": {"mean": 0.0, "std": proj_std}, "zeros": {}},
+                    {"dropout": {"p": dropout}}]},
+                {"sequential": [
+                    {"layernorm": {"normalized_shape": d}},
+                    {"linear": {"in_features": d, "out_features": 4 * d},
+                     "normal": {"mean": 0.0, "std": std}, "zeros": {}},
+                    {"gelu": {"approximate": "tanh"}},
+                    {"linear": {"in_features": 4 * d, "out_features": d},
+                     "normal": {"mean": 0.0, "std": proj_std}, "zeros": {}},
+                    {"dropout": {"p": dropout}}]}]} for _ in range(depth)]
+            + [{"layernorm": {"normalized_shape": d}},
+               {"linear": {"in_features": d, "out_features": vocab,
+                           "bias": False}},
+               {"softmaxlast": {"dim": -1}}])
+
+
+def makemore_mlp(vocab: int = 27, d_embed: int = 10,
+                 d_hidden: int = 200) -> list:
+    """Char-level MLP in the makemore style (BASELINE.md CPU-parity config):
+    per-position embedding → tanh MLP → softmax CE.  Runs the single-process
+    CPU path end-to-end (tests/test_model.py::test_mlp_training_per_position
+    is the executable spec)."""
+    return [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d_embed},
+         "normal": {"mean": 0.0, "std": 0.02}},
+        {"linear": {"in_features": d_embed, "out_features": d_hidden},
+         "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+        {"tanh": {}},
+        {"linear": {"in_features": d_hidden, "out_features": vocab},
+         "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+
+
+def param_count(layers: list, optimizer: dict = ADAMW) -> int:
+    """Total parameter count of a DSL config without allocating it:
+    ``jax.eval_shape`` traces the initializer to ShapeDtypeStructs, so even
+    gpt2-xl counts in milliseconds."""
+    import jax
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import CompiledArch
+    mapper = Mapper(layers, optimizer)
+    arch = CompiledArch.get(mapper.layers)
+    import math
+    params, _ = jax.eval_shape(lambda: mapper.init_params(arch.mods, seed=0))
+    return sum(math.prod(v.shape) for v in params.values())

@@ -1,0 +1,77 @@
+"""Model presets: DSL validity + parameter counts of the GPT-2 ladder.
+
+Counts follow the GPT-2 architecture formula (per block:
+12*d^2 + 13*d; embeddings vocab*d + block*d; final ln 2d) — the same
+arithmetic the reference's shape/param-count test tables pin for its DSL
+(test_neural_net_model.py:19-104)."""
+
+import pytest
+
+from penroz_tpu.models import presets
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import CompiledArch
+
+
+def _expected(d, depth, vocab=50304, block=1024):
+    per_block = 12 * d * d + 13 * d
+    # + vocab*d twice: wte AND the untied lm_head linear — the DSL
+    # instantiates a separate output projection exactly like the
+    # reference's /model/ example (main.py:53-84); HF import overwrites it
+    # with the tied weight (mappers.py:352)
+    return 2 * vocab * d + block * d + depth * per_block + 2 * d
+
+
+@pytest.mark.parametrize("size,d,depth", [
+    ("gpt2", 768, 12),
+    ("gpt2-medium", 1024, 24),
+    ("gpt2-large", 1280, 36),
+    ("gpt2-xl", 1600, 48),
+])
+def test_gpt2_param_counts(size, d, depth):
+    layers = presets.gpt2(size)
+    assert presets.param_count(layers) == _expected(d, depth)
+
+
+def test_gpt2_124m_matches_reference_example_structure():
+    """Same layer sequence as the reference's /model/ OpenAPI example
+    (main.py:53-84): summation(embed+pos), dropout, 12 residual blocks,
+    ln, lm_head, softmax."""
+    layers = presets.gpt2("gpt2")
+    assert "summation" in layers[0]
+    assert "dropout" in layers[1]
+    assert sum("residual" in l for l in layers) == 12
+    assert "softmaxlast" in layers[-1]
+    assert layers[-2]["linear"]["bias"] is False
+
+
+def test_gpt2_xl_module_tree():
+    """The 1.5B DSL compiles to a module tree (param_count above is
+    allocation-free via eval_shape, so even xl count-checks cheaply)."""
+    layers = presets.gpt2("gpt2-xl")
+    arch = CompiledArch.get(Mapper(layers, presets.ADAMW).layers)
+    assert sum("residual" in l for l in layers) == 48
+    assert len(arch.attn_layers) == 48
+
+
+def test_graft_entry_delegates_to_presets():
+    """The driver contract's flagship DSL is the canonical builder's output
+    — the two can never drift."""
+    import __graft_entry__ as g
+    assert g._gpt2_dsl() == presets.gpt2("gpt2")
+
+
+def test_unknown_size_rejected():
+    with pytest.raises(ValueError, match="unknown gpt2 size"):
+        presets.gpt2("gpt5")
+
+
+def test_makemore_mlp_trains(workdir, toy_shards):
+    """BASELINE CPU-parity config: the char-MLP preset trains end-to-end
+    single-process."""
+    from penroz_tpu.models.model import NeuralNetworkModel
+    model = NeuralNetworkModel(
+        "mmlp", Mapper(presets.makemore_mlp(vocab=64),
+                       {"sgd": {"lr": 0.1}}))
+    model.train_model("toy", shard=0, epochs=2, batch_size=4,
+                      block_size=8, step_size=2)
+    assert model.status["code"] == "Trained"

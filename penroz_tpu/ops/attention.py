@@ -113,20 +113,28 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
 
 
 def cached_attention(q, k_full, v_full, offset, length,
-                     dropout_rate=0.0, dropout_rng=None, platform=None):
+                     dropout_rate=0.0, dropout_rng=None, platform=None,
+                     k_scale=None, v_scale=None):
     """Attention over a preallocated KV cache.
 
     q: (B, Hq, T, D) new queries at positions ``offset + [0, T)``.
     k_full/v_full: (B, Hkv, S_max, D) cache contents after the current append.
     ``length`` is the total valid length (offset + T).  Keys at index j are
     attended when ``j <= offset + t`` (combined causal + validity mask).
+    With ``k_scale``/``v_scale`` (B, Hkv, S_max, 1) the cache is int8
+    (TurboQuant): the kernel dequantizes per VMEM tile; this jnp fallback
+    dequantizes the dense view (also the numerical oracle).
 
     Dispatches to the Pallas decode kernel on TPU (compute bounded by the
     valid length, not S_max); this jnp path is its correctness oracle.
     """
     if dropout_rate == 0.0 and _use_flash_decode(q, k_full, platform):
         from penroz_tpu.ops.pallas import decode_attention as da
-        return da.decode_attention(q, k_full, v_full, offset, length)
+        return da.decode_attention(q, k_full, v_full, offset, length,
+                                   k_scale=k_scale, v_scale=v_scale)
+    if k_scale is not None:
+        k_full = (k_full.astype(jnp.float32) * k_scale).astype(q.dtype)
+        v_full = (v_full.astype(jnp.float32) * v_scale).astype(q.dtype)
     B, Hq, T, D = q.shape
     S = k_full.shape[2]
     num_kv_heads = k_full.shape[1]

@@ -165,7 +165,15 @@ class QuantKVState(KVState):
         vs = [jnp.zeros((batch, h, max_len, 1), jnp.float32) for h, _ in specs]
         return cls(k, v, jnp.zeros((), jnp.int32), ks, vs, out_dtype=dtype)
 
-    def append(self, layer_idx: int, k_new, v_new):
+    def append_raw(self, layer_idx: int, k_new, v_new):
+        """Quantize + store; return the RAW int8 buffers and new length.
+
+        The attention consumer passes the per-token scales alongside
+        (``cached_attention(k_scale=..., v_scale=...)``) so dequantization
+        happens per VMEM tile inside the decode kernel — materializing a
+        full-precision copy of the whole cache every decode step (what
+        :meth:`append` does) costs 3× the HBM traffic int8 storage saves.
+        """
         qk, sk = _quantize_int8(k_new)
         qv, sv = _quantize_int8(v_new)
         start = (0, 0, self.length, 0)
@@ -173,9 +181,16 @@ class QuantKVState(KVState):
         self.v[layer_idx] = jax.lax.dynamic_update_slice(self.v[layer_idx], qv, start)
         self.k_scale[layer_idx] = jax.lax.dynamic_update_slice(self.k_scale[layer_idx], sk, start)
         self.v_scale[layer_idx] = jax.lax.dynamic_update_slice(self.v_scale[layer_idx], sv, start)
-        new_length = self.length + k_new.shape[2]
-        k_full = _dequantize_int8(self.k[layer_idx], self.k_scale[layer_idx], self.out_dtype)
-        v_full = _dequantize_int8(self.v[layer_idx], self.v_scale[layer_idx], self.out_dtype)
+        return (self.k[layer_idx], self.v[layer_idx],
+                self.length + k_new.shape[2])
+
+    def append(self, layer_idx: int, k_new, v_new):
+        """Store + return the dequantized full cache (correctness oracle
+        for :meth:`append_raw`; the hot decode path uses the raw variant)."""
+        qk_full, qv_full, new_length = self.append_raw(layer_idx, k_new,
+                                                       v_new)
+        k_full = _dequantize_int8(qk_full, self.k_scale[layer_idx], self.out_dtype)
+        v_full = _dequantize_int8(qv_full, self.v_scale[layer_idx], self.out_dtype)
         return k_full, v_full, new_length
 
     def _with_length(self, length):

@@ -689,24 +689,36 @@ class CausalSelfAttention(Module):
 
         if ctx.kv is not None:
             from penroz_tpu.ops import kv_cache as KV
-            if isinstance(ctx.kv, KV.PagedKVState):
-                flat_k, flat_v, length = ctx.kv.append_rows(self.layer_idx,
-                                                            k, v)
-                scales = {}
-                if ctx.kv.quantized:  # int8 pools carry per-token scales
-                    scales = {"k_scale": ctx.kv.k_scale[self.layer_idx],
-                              "v_scale": ctx.kv.v_scale[self.layer_idx]}
+            paged = isinstance(ctx.kv, KV.PagedKVState)
+            if paged:
+                store_k, store_v, length = ctx.kv.append_rows(self.layer_idx,
+                                                              k, v)
+            elif ctx.kv.quantized:
+                # int8 cache: store + attend on the raw buffers — the
+                # kernel dequantizes per VMEM tile, never materializing a
+                # full-precision cache.
+                store_k, store_v, length = ctx.kv.append_raw(self.layer_idx,
+                                                             k, v)
+            else:
+                store_k, store_v, length = ctx.kv.append(self.layer_idx,
+                                                         k, v)
+            # int8 caches (paged pools and contiguous) carry per-token
+            # scales; read AFTER the append so the new tokens' scales are in.
+            scales = ({"k_scale": ctx.kv.k_scale[self.layer_idx],
+                       "v_scale": ctx.kv.v_scale[self.layer_idx]}
+                      if ctx.kv.quantized else {})
+            if paged:
                 out = attn_ops.paged_cached_attention(
-                    q, flat_k, flat_v, ctx.kv.block_table, ctx.kv.page_size,
+                    q, store_k, store_v, ctx.kv.block_table, ctx.kv.page_size,
                     offset, length, dropout_rate=dropout_rate,
                     dropout_rng=dropout_rng, platform=ctx.platform, **scales)
             else:
-                k_full, v_full, length = ctx.kv.append(self.layer_idx, k, v)
-                out = attn_ops.cached_attention(q, k_full, v_full, offset,
+                out = attn_ops.cached_attention(q, store_k, store_v, offset,
                                                 length,
                                                 dropout_rate=dropout_rate,
                                                 dropout_rng=dropout_rng,
-                                                platform=ctx.platform)
+                                                platform=ctx.platform,
+                                                **scales)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
             # Sequence-parallel training: ring attention over ICI.
             from penroz_tpu.parallel.ring_attention import ring_attention

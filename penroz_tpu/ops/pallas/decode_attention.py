@@ -42,16 +42,24 @@ DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, block_k: int, num_k: int, num_queries: int,
-                   sm_scale: float):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
+                   num_k: int, num_queries: int, sm_scale: float,
+                   quantized: bool):
     """One (batch, kv-head, k-block) step: GT grouped query rows vs one tile.
 
     q_ref: (1, 1, GT, D) where GT = group * T, row r ↦ (g = r // T, t = r % T).
     k_ref/v_ref: (1, 1, block_k, D) — the j-th valid tile (clamped index map).
+    With ``quantized`` two extra (1, 1, block_k, 1) refs carry the int8
+    tiles' per-token scales and dequantization happens here in VMEM — the
+    full-precision cache never exists in HBM.
     len_ref[0] = offset + T (valid entries).  Scratch carries the online-
     softmax state across the sequential j dimension.
     """
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = refs
     j = pl.program_id(2)
     gt = q_ref.shape[2]
     total = len_ref[0]
@@ -69,6 +77,9 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
+        if quantized:
+            k = (k.astype(jnp.float32) * ks_ref[0, 0]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs_ref[0, 0]).astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -100,10 +111,13 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
 
 
 def decode_attention(q, k_full, v_full, offset, length,
-                     block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):
+                     block_k: int = DEFAULT_BLOCK_K, interpret: bool = False,
+                     k_scale=None, v_scale=None):
     """Fused cached attention.  Same contract as the jnp oracle
     ``cached_attention``: q (B, Hq, T, D); k_full/v_full (B, Hkv, S_max, D);
-    ``length`` = offset + T valid entries (post-append)."""
+    ``length`` = offset + T valid entries (post-append).  With
+    ``k_scale``/``v_scale`` (B, Hkv, S_max, 1) the cache is int8 (TurboQuant)
+    and tiles dequantize in VMEM."""
     B, Hq, T, D = q.shape
     Hkv, S = k_full.shape[1], k_full.shape[2]
     group = Hq // Hkv
@@ -112,6 +126,7 @@ def decode_attention(q, k_full, v_full, offset, length,
         raise ValueError(f"decode_attention requires S%{block_k}==0, got {S}")
     sm_scale = 1.0 / (D ** 0.5)
     num_k = S // block_k
+    quantized = k_scale is not None
 
     # Fold the GQA group into the query-row dimension: head order is kv-major
     # (matches _group_query_heads), so this is a pure reshape.
@@ -125,19 +140,28 @@ def decode_attention(q, k_full, v_full, offset, length,
         return (b, h, jnp.minimum(j, hi - 1), 0)
 
     kernel = functools.partial(_decode_kernel, block_k=block_k, num_k=num_k,
-                               num_queries=T, sm_scale=sm_scale)
+                               num_queries=T, sm_scale=sm_scale,
+                               quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, group * T, D),
+                     lambda b, h, j, len_ref: (b, h, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_k, D), kv_index,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_k, D), kv_index,
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [total, q_rows, k_full, v_full]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, 1, block_k, 1), kv_index,
+                                  memory_space=pltpu.VMEM)
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, Hkv, num_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, group * T, D),
-                         lambda b, h, j, len_ref: (b, h, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, D), kv_index,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, D), kv_index,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group * T, D),
                                lambda b, h, j, len_ref: (b, h, 0, 0),
                                memory_space=pltpu.VMEM),
@@ -155,9 +179,15 @@ def decode_attention(q, k_full, v_full, offset, length,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=int(4 * B * Hq * T * S * D),
-            bytes_accessed=int((q.size + k_full.size + v_full.size + q.size)
-                               * q.dtype.itemsize),
+            # per-operand itemsize: the int8 path reads 1-byte K/V tiles
+            # plus two f32 scale streams — q-dtype accounting would
+            # overstate its HBM traffic ~4x
+            bytes_accessed=int(
+                2 * q.size * q.dtype.itemsize
+                + k_full.size * k_full.dtype.itemsize
+                + v_full.size * v_full.dtype.itemsize
+                + (2 * B * Hkv * S * 4 if quantized else 0)),
             transcendentals=int(B * Hq * T * S)),
         interpret=interpret,
-    )(total, q_rows, k_full, v_full)
+    )(*operands)
     return out.reshape(B, Hq, T, D)

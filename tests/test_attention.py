@@ -297,6 +297,49 @@ def test_kernel_gates_respect_platform_hint():
     assert not A._use_flash_decode(q, k_big, platform="cpu")
 
 
+def test_decode_kernel_int8_scales_interpret():
+    """Quantized decode path: the kernel's per-tile dequant must match the
+    jnp oracle's dense dequantized attention (TurboQuant cache contents)."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    rng = np.random.default_rng(11)
+    B, Hq, Hkv, D, S = 1, 4, 2, 64, 512
+    state = KV.QuantKVState.create([(Hkv, D)], B, S, jnp.float32)
+    seeded = jnp.asarray(rng.normal(size=(B, Hkv, 300, D)).astype(np.float32))
+    qk, qv, _ = state.append_raw(0, seeded, seeded * 0.5 + 1.0)
+    ks, vs = state.k_scale[0], state.v_scale[0]
+    for offset, T in [(300 - 1, 1), (100, 4), (0, 8)]:
+        q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+        off = jnp.asarray(offset, jnp.int32)
+        length = jnp.asarray(offset + T, jnp.int32)
+        ref = A.cached_attention(q, qk, qv, off, length, platform="cpu",
+                                 k_scale=ks, v_scale=vs)
+        out = DA.decode_attention(q, qk, qv, off, length, block_k=128,
+                                  interpret=True, k_scale=ks, v_scale=vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5,
+                                   err_msg=f"offset={offset}, T={T}")
+
+
+def test_quant_append_raw_matches_append_oracle():
+    """append_raw + explicit dequant == append's dequantized output."""
+    from penroz_tpu.ops import kv_cache as KV
+    rng = np.random.default_rng(12)
+    k = jnp.asarray(rng.normal(size=(1, 2, 4, 8)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, 2, 4, 8)).astype(np.float32))
+    a = KV.QuantKVState.create([(2, 8)], 1, 16, jnp.float32)
+    b = KV.QuantKVState.create([(2, 8)], 1, 16, jnp.float32)
+    fk, fv, n1 = a.append(0, k, v)
+    qk, qv, n2 = b.append_raw(0, k, v)
+    assert int(n1) == int(n2)
+    np.testing.assert_array_equal(
+        np.asarray(fk),
+        np.asarray(qk.astype(jnp.float32) * b.k_scale[0]))
+    np.testing.assert_array_equal(
+        np.asarray(fv),
+        np.asarray(qv.astype(jnp.float32) * b.v_scale[0]))
+
+
 def test_decode_kernel_long_cache_interpret():
     """K-tiled decode kernel vs oracle on a cache much longer than one tile,
     at occupancies that end mid-tile, at tile boundaries, and nearly empty

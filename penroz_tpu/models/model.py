@@ -499,8 +499,20 @@ class NeuralNetworkModel:
     # -- inference ----------------------------------------------------------
 
     def _as_input(self, data):
-        arr = np.asarray(data)
+        try:
+            arr = np.asarray(data)
+        except ValueError:
+            raise ValueError(
+                "input rows have inconsistent lengths; expected a "
+                "rectangular batch like [[1, 2, 3], [4, 5, 6]]")
         if arr.dtype.kind in "iu":
+            if self.arch.attn_layers and arr.ndim != 2:
+                # A flat token list on a sequence model dies deep in the
+                # stack with an opaque unpack error; say what's wrong at
+                # the API boundary instead (→ HTTP 400).
+                raise ValueError(
+                    f"token input must be 2-D (batch, length) for this "
+                    f"model, e.g. [[1, 2, 3]]; got {arr.ndim}-D")
             return jnp.asarray(arr.astype(np.int64), jnp.int32)
         return jnp.asarray(arr).astype(self.dtype)
 

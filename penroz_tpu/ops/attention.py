@@ -128,6 +128,9 @@ def cached_attention(q, k_full, v_full, offset, length,
     Dispatches to the Pallas decode kernel on TPU (compute bounded by the
     valid length, not S_max); this jnp path is its correctness oracle.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together "
+                         "(int8 caches carry scales for both streams)")
     if dropout_rate == 0.0 and _use_flash_decode(q, k_full, platform):
         from penroz_tpu.ops.pallas import decode_attention as da
         return da.decode_attention(q, k_full, v_full, offset, length,

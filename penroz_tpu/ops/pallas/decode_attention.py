@@ -126,6 +126,9 @@ def decode_attention(q, k_full, v_full, offset, length,
         raise ValueError(f"decode_attention requires S%{block_k}==0, got {S}")
     sm_scale = 1.0 / (D ** 0.5)
     num_k = S // block_k
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together "
+                         "(int8 caches carry scales for both streams)")
     quantized = k_scale is not None
 
     # Fold the GQA group into the query-row dimension: head order is kv-major

@@ -318,6 +318,18 @@ def test_generate_stream_matches_count(workdir, toy_gpt_layers):
     assert len(tokens) == 3
 
 
+def test_compute_output_flat_tokens_clear_error(workdir, toy_gpt_layers):
+    """A flat token list on a sequence model must 400 with a message naming
+    the expected shape, not an opaque unpack error from inside the stack."""
+    model = NeuralNetworkModel("shp", Mapper(toy_gpt_layers, SGD))
+    with pytest.raises(ValueError, match=r"2-D \(batch, length\)"):
+        model.compute_output([1, 2, 3])
+    with pytest.raises(ValueError, match="inconsistent lengths"):
+        model.compute_output([[1, 2, 3], [4, 5]])
+    out, cost = model.compute_output([[1, 2, 3]])
+    assert cost is None and len(out) == 1
+
+
 def test_generate_tail_overshoot_chunking(workdir, toy_gpt_layers,
                                           monkeypatch):
     """A tail shorter than its pow-2 ceiling dispatches the ceiling chunk

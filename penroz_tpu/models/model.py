@@ -1196,19 +1196,24 @@ class NeuralNetworkModel:
     def _serialize_meta_only(self, sync_flush: bool = False):
         """Update progress/status in the existing blob without touching the
         weights or shard files — the safe write for uncoordinated saves on a
-        sharded model (preserves the last consistent checkpoint)."""
+        sharded model (preserves the last consistent checkpoint).
+
+        ``checkpoint.patch_meta`` rewrites only the header and streams the
+        array payload through verbatim — no decode, no re-encode, no RAM
+        spike on multi-GB checkpoints.  (``sync_flush`` is moot:
+        patch_meta always writes both copies synchronously.)"""
+        del sync_flush
         try:
-            data = checkpoint.load(self.model_id)
+            checkpoint.patch_meta(self.model_id, {
+                "progress": self.progress,
+                "avg_cost": self.avg_cost,
+                "avg_cost_history": self.avg_cost_history,
+                "stats": self.stats,
+                "status": self.status,
+            })
         except KeyError:
             log.warning("Meta-only checkpoint skipped: no existing blob "
                         "for %s", self.model_id)
-            return
-        data["progress"] = self.progress
-        data["avg_cost"] = self.avg_cost
-        data["avg_cost_history"] = self.avg_cost_history
-        data["stats"] = self.stats
-        data["status"] = self.status
-        checkpoint.save(self.model_id, data, sync_flush=sync_flush)
 
     @staticmethod
     def _reassemble_sharded(model_id: str, sharded_meta: dict,

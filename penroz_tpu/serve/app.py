@@ -359,7 +359,41 @@ async def docs(request: web.Request):
 _OPENAPI_CACHE = None
 
 
+def _sweep_orphaned_training():
+    """Mark stale 'Training' statuses as Error at server start.
+
+    Training runs inside the server process (the TPU runtime is
+    single-tenant per process), so at startup no training can possibly be
+    running — a checkpoint still saying 'Training' was orphaned by a
+    restart/crash mid-run.  The reference cannot make this inference (its
+    training is a separate DDP process that may outlive the API,
+    main.py:461-464) and leaves the status stuck forever; here the failure
+    is detectable, so report it.  Header-only peeks keep the sweep cheap.
+    """
+    from penroz_tpu.utils import checkpoint
+    for model_id in checkpoint.list_model_ids():
+        try:
+            if checkpoint.peek_tree(model_id).get(
+                    "status", {}).get("code") != "Training":
+                continue
+            # header-only rewrite: the array payload streams through
+            # untouched, so even multi-GB checkpoints patch in O(file copy)
+            # with no decode and no RAM spike
+            checkpoint.patch_meta(model_id, {"status": {
+                "code": "Error",
+                "message": "Training interrupted by server restart"}})
+            log.warning("Marked orphaned training as Error: %s", model_id)
+        except Exception:  # noqa: BLE001 — sweep must never block startup
+            log.exception("Orphan sweep failed for model %s", model_id)
+
+
 def create_app() -> web.Application:
+    # Synchronous, BEFORE the socket binds: a client retrying /train/ right
+    # after a restart must not race the sweep (a background sweep could mark
+    # the new live run as Error and clobber its first checkpoint with the
+    # stale pre-restart payload).  patch_meta keeps this cheap — O(file
+    # copy) per orphan, no array decode.
+    _sweep_orphaned_training()
     app = web.Application(middlewares=[error_middleware, gzip_middleware],
                           client_max_size=1024 ** 3)
     app.router.add_get("/", redirect_to_dashboard)

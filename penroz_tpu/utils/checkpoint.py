@@ -113,6 +113,115 @@ def _encode(data) -> bytes:
     return buf.getvalue()
 
 
+def list_model_ids() -> list[str]:
+    """Model ids with a main checkpoint blob (durable or shm copy)."""
+    import glob
+    import re
+    ids = set()
+    for base in (MODELS_FOLDER, os.path.join(SHM_PATH, MODELS_FOLDER)):
+        for path in glob.glob(os.path.join(base, "model_*.ckpt")):
+            m = re.match(r"model_(.+?)\.ckpt$", os.path.basename(path))
+            # exclude exactly the shard-file suffix (".shard<idx>"), not
+            # any id merely containing ".shard"
+            if m and not re.search(r"\.shard\d+$", m.group(1)):
+                ids.add(m.group(1))
+    return sorted(ids)
+
+
+def _decode_tree(tree, array_leaf):
+    """Shared walker for the container's tree encoding; ``array_leaf(i)``
+    resolves ``{"__array__": i}`` nodes (payload arrays for full loads,
+    ``None`` for header-only peeks)."""
+    def dec(x):
+        if isinstance(x, dict):
+            if "__array__" in x and len(x) == 1:
+                return array_leaf(x["__array__"])
+            return {k: dec(v) for k, v in x["__dict__"]}
+        if isinstance(x, list):
+            return [dec(v) for v in x]
+        return x
+    return dec(tree)
+
+
+def _source_path(model_id: str) -> str:
+    shm_path = shm_model_path(model_id)
+    return shm_path if os.path.exists(shm_path) else model_path(model_id)
+
+
+def _read_header(f):
+    """Parse the container header; returns (header dict, payload offset)."""
+    prefix = f.read(16)
+    if prefix[:8] != MAGIC:
+        raise ValueError(
+            "not a penroz checkpoint (bad magic); legacy pickle "
+            "checkpoints are not loaded — re-create or re-import the model")
+    (header_len,) = struct.unpack("<Q", prefix[8:16])
+    return json.loads(f.read(header_len).decode("utf-8")), 16 + header_len
+
+
+def peek_tree(model_id: str) -> dict:
+    """Decode a checkpoint's metadata tree WITHOUT reading array payloads —
+    array leaves come back as ``None``.  Reads only the JSON header, so
+    status/progress checks across many large models stay cheap.
+
+    :raises KeyError: if the model was never created.
+    """
+    try:
+        with open(_source_path(model_id), "rb") as f:
+            header, _ = _read_header(f)
+    except FileNotFoundError:
+        raise KeyError(f"Model {model_id} not created yet.")
+    return _decode_tree(header["tree"], lambda i: None)
+
+
+def patch_meta(model_id: str, updates: dict):
+    """Rewrite top-level metadata fields (status, progress, ...) without
+    decoding or re-encoding the array payload: a new header is written and
+    the payload bytes are streamed through verbatim (array offsets are
+    payload-relative, so a changed header length does not disturb them).
+    ``updates`` values must be array-free (JSON-able + numpy scalars).
+
+    Both copies (shm + durable) are written synchronously — callers patch
+    metadata to record a fact (e.g. an orphaned-training Error) and a
+    deferred flush could lose it.
+
+    :raises KeyError: if the model was never created.
+    """
+    # Narrow scope: only a missing SOURCE means "model not created" — a
+    # FileNotFoundError from the write loop below (e.g. concurrent delete
+    # of models/) must surface as the write failure it is.
+    try:
+        f = open(_source_path(model_id), "rb")
+    except FileNotFoundError:
+        raise KeyError(f"Model {model_id} not created yet.")
+    with f:
+        header, payload_off = _read_header(f)
+        pairs = dict(header["tree"]["__dict__"])
+        for key, value in updates.items():
+            enc_header, arrays, _ = _encode_parts(value)
+            if arrays:
+                raise ValueError("patch_meta values must be array-free")
+            pairs[key] = json.loads(enc_header)["tree"]
+        header["tree"]["__dict__"] = [[k, v] for k, v in pairs.items()]
+        new_header = json.dumps(header, separators=(",", ":")
+                                ).encode("utf-8")
+        for dest in (shm_model_path(model_id), model_path(model_id)):
+            os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+            fd, tmp_path = _mkstemp_for(dest)
+            try:
+                with os.fdopen(fd, "wb") as out:
+                    out.write(MAGIC)
+                    out.write(struct.pack("<Q", len(new_header)))
+                    out.write(new_header)
+                    f.seek(payload_off)
+                    shutil.copyfileobj(f, out)
+                os.replace(tmp_path, dest)
+            except BaseException:
+                if os.path.exists(tmp_path):
+                    os.remove(tmp_path)
+                raise
+
+
 def _read(path: str):
     """Decode a container file via mmap: raw bytes are paged by the kernel
     while each array is copied out, so peak memory is ~sum(arrays), not
@@ -146,18 +255,7 @@ def _decode(buf: bytes):
         raw = payload[m["offset"]:m["offset"] + m["nbytes"]]
         arrays.append(np.frombuffer(raw, dtype=np_dtype(m["dtype"]))
                       .reshape(m["shape"]).copy())
-
-    def dec(x):
-        if isinstance(x, dict):
-            if "__array__" in x and len(x) == 1:
-                return arrays[x["__array__"]]
-            pairs = x["__dict__"]
-            return {k: dec(v) for k, v in pairs}
-        if isinstance(x, list):
-            return [dec(v) for v in x]
-        return x
-
-    return dec(header["tree"])
+    return _decode_tree(header["tree"], arrays.__getitem__)
 
 
 def detect_shm_path() -> str:

@@ -351,3 +351,27 @@ def test_train_bad_device_400s_before_202(client, toy_shards_appdir=None):
         "epochs": 1, "batch_size": 1, "block_size": 4, "step_size": 1,
         "device": "tpuu"})
     assert status == 400
+
+
+def test_orphaned_training_swept_at_startup(workdir):
+    """A checkpoint stuck in 'Training' (server killed mid-run) must read
+    Error after a restart — training runs in the server process, so no run
+    can survive one.  Other statuses pass through untouched."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    from penroz_tpu.utils import checkpoint
+
+    for mid, code in (("orph", "Training"), ("done", "Trained")):
+        m = NeuralNetworkModel(mid, Mapper(TOY_LAYERS, SGD))
+        m.status = {"code": code, "message": None}
+        m.serialize(sync_flush=True)
+
+    app_mod._sweep_orphaned_training()
+
+    swept = checkpoint.peek_tree("orph")["status"]
+    assert swept["code"] == "Error"
+    assert "restart" in swept["message"]
+    assert checkpoint.peek_tree("done")["status"]["code"] == "Trained"
+    # weights survive the metadata rewrite
+    restored = NeuralNetworkModel.deserialize("orph")
+    assert restored.params

@@ -91,3 +91,42 @@ def test_np_dtype_resolves_ml_dtypes_and_rejects_unknown():
     assert checkpoint.np_dtype("float32") == np.dtype(np.float32)
     with pytest.raises(TypeError, match="unknown checkpoint dtype"):
         checkpoint.np_dtype("not_a_dtype")
+
+
+def test_patch_meta_header_only_rewrite(tmp_path, monkeypatch):
+    """patch_meta must update metadata fields and stream the array payload
+    through byte-identically, without ever decoding it."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(checkpoint, "SHM_PATH", str(tmp_path / "shm"))
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    checkpoint.save("pm", {"status": {"code": "Training", "message": None},
+                           "params": {"w": arr}, "progress": [1, 2]},
+                    sync_flush=True)
+    checkpoint.patch_meta("pm", {"status": {"code": "Error",
+                                            "message": "interrupted"}})
+    out = checkpoint.load("pm")
+    assert out["status"] == {"code": "Error", "message": "interrupted"}
+    assert out["progress"] == [1, 2]
+    np.testing.assert_array_equal(out["params"]["w"], arr)
+    # peek agrees and never touches arrays
+    peek = checkpoint.peek_tree("pm")
+    assert peek["status"]["code"] == "Error"
+    assert peek["params"]["w"] is None
+    # array-carrying updates are rejected
+    with pytest.raises(ValueError, match="array-free"):
+        checkpoint.patch_meta("pm", {"params": {"w": arr}})
+    with pytest.raises(KeyError):
+        checkpoint.patch_meta("nope", {"status": {}})
+
+
+def test_list_model_ids_shard_suffix_only(tmp_path, monkeypatch):
+    """Only the exact '.shard<idx>' suffix marks a shard file; a model id
+    that merely contains '.shard' must stay visible."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(checkpoint, "SHM_PATH", str(tmp_path / "shm"))
+    for mid in ("plain", "v1.sharded", "odd.shard"):
+        checkpoint.save(mid, {"status": {"code": "Created"}},
+                        sync_flush=True)
+    checkpoint.save_shard("plain", 1, {"tag": 0, "pieces": {}},
+                          sync_flush=True)
+    assert checkpoint.list_model_ids() == ["odd.shard", "plain", "v1.sharded"]

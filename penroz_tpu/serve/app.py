@@ -25,6 +25,7 @@ import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict
 
+import numpy as np
 import pydantic
 from aiohttp import web
 
@@ -331,7 +332,21 @@ async def model_stats(request: web.Request):
     model_id = _query_param(request, "model_id")
     log.info("Requesting stats for model %s", model_id)
     model = await _run_blocking(NeuralNetworkModel.deserialize, model_id)
-    return _json(model.stats)
+    stats = model.stats
+    # MoE observability (additive key — dashboard ignores unknowns): the
+    # per-expert routing fractions updated each training step, so expert
+    # collapse is visible without digging into checkpoints.  Only once
+    # stats exist: an untrained model must keep returning null (dashboard
+    # 'no stats yet' state), and its all-zero init fractions would
+    # masquerade as observed routing.
+    if stats is not None:
+        routing = {name: [float(x) for x in np.asarray(buf)]
+                   for name, buf in model.buffers.items()
+                   if name.endswith("router_fraction")}
+        if routing:
+            stats = dict(stats)
+            stats["moe_router_fractions"] = routing
+    return _json(stats)
 
 
 async def delete_model(request: web.Request):

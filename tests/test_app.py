@@ -375,3 +375,32 @@ def test_orphaned_training_swept_at_startup(workdir):
     # weights survive the metadata rewrite
     restored = NeuralNetworkModel.deserialize("orph")
     assert restored.params
+
+
+def test_stats_exposes_moe_router_fractions(client, workdir):
+    """A trained MoE model's /stats/ carries per-expert routing fractions
+    (additive key; expert collapse must be observable from the API)."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+
+    d, vocab = 8, 32
+    layers = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d}},
+        {"moe": {"in_features": d, "intermediate_size": 2 * d,
+                 "num_experts": 4, "top_k": 2}},
+        {"linear": {"in_features": d, "out_features": vocab}},
+        {"softmaxlast": {"dim": -1}}]
+    import os as _os
+    _os.makedirs("data", exist_ok=True)
+    np.save("data/moestats_000000",
+            np.random.randint(0, vocab, 4096).astype(np.uint16))
+    model = NeuralNetworkModel("moest", Mapper(layers, SGD))
+    model.train_model("moestats", shard=0, epochs=1, batch_size=2,
+                      block_size=8, step_size=1)
+
+    status, body = client.json("GET", "/stats/?model_id=moest")
+    assert status == 200
+    routing = body["moe_router_fractions"]
+    (fractions,) = routing.values()
+    assert len(fractions) == 4
+    assert abs(sum(fractions) - 1.0) < 1e-5

@@ -318,6 +318,39 @@ def test_generate_stream_matches_count(workdir, toy_gpt_layers):
     assert len(tokens) == 3
 
 
+def test_generate_gqa_rope_cached_decode(workdir, monkeypatch):
+    """Gemma-style attention (GQA num_kv_heads < heads, RoPE positions)
+    through the functional KV cache: batch == stream at T=0, overflow
+    re-prefill works, and the int8 cache path agrees within quant
+    tolerance of nothing-exploding (finite, right count)."""
+    d, heads, kv = 16, 4, 2
+    layers = [
+        {"embedding": {"num_embeddings": 32, "embedding_dim": d}},
+        {"residual": [
+            {"sequential": [
+                {"rmsnorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d,
+                            "out_features": d + 2 * (d // heads) * kv},
+                 "normal": {"mean": 0.0, "std": 0.05}},
+                {"attention": {"num_heads": heads, "num_kv_heads": kv,
+                               "rope_theta": 10000.0, "dropout": 0.0}},
+                {"linear": {"in_features": d, "out_features": d}}]}]},
+        {"linear": {"in_features": d, "out_features": 32, "bias": False}},
+        {"softmaxlast": {"dim": -1}}]
+    model = NeuralNetworkModel("gqa", Mapper(layers, SGD))
+    batch = model.generate_tokens([[1, 2, 3]], block_size=8,
+                                  max_new_tokens=9, temperature=0.0)
+    assert len(batch) == 12  # overflow at block_size=8 re-prefilled
+    stream = list(model.generate_tokens_stream([[1, 2, 3]], block_size=8,
+                                               max_new_tokens=9,
+                                               temperature=0.0))
+    assert stream == batch[3:]
+    monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    quant = model.generate_tokens([[1, 2, 3]], block_size=8,
+                                  max_new_tokens=9, temperature=0.0)
+    assert len(quant) == 12 and all(0 <= t < 32 for t in quant)
+
+
 def test_compute_output_flat_tokens_clear_error(workdir, toy_gpt_layers):
     """A flat token list on a sequence model must 400 with a message naming
     the expected shape, not an opaque unpack error from inside the stack."""

@@ -155,7 +155,7 @@ function renderStats(stats, filter) {
     grid.innerHTML = "<div class='cell'><div class='title'>no stats yet</div></div>";
     return;
   }
-  const addCell = (title, meta, histData) => {
+  const addCell = (title, meta, draw) => {
     const cell = document.createElement("div");
     cell.className = "cell";
     const canvas = document.createElement("canvas");
@@ -163,26 +163,45 @@ function renderStats(stats, filter) {
     cell.innerHTML = `<div class="title">${title}</div><div class="meta">${meta}</div>`;
     cell.appendChild(canvas);
     grid.appendChild(cell);
-    histChart(canvas, histData);
+    draw(canvas);
   };
+  const addHistCell = (title, meta, histData) =>
+    addCell(title, meta, (canvas) => histChart(canvas, histData));
 
   (stats.layers || []).forEach((layer, i) => {
     if (!layer || !matchesFilter(layer.algo, i, filter)) return;
     const act = layer.activation;
-    addCell(`L${i} ${layer.algo} activations`,
+    addHistCell(`L${i} ${layer.algo} activations`,
       `μ=${act.mean.toPrecision(3)} σ=${act.std.toPrecision(3)} ` +
       `sat=${(act.saturated * 100).toFixed(1)}%`, act.histogram);
     if (layer.gradient) {
-      addCell(`L${i} ${layer.algo} ∂cost/∂act`,
+      addHistCell(`L${i} ${layer.algo} ∂cost/∂act`,
         `μ=${layer.gradient.mean.toPrecision(3)} σ=${layer.gradient.std.toPrecision(3)}`,
         layer.gradient.histogram);
     }
   });
   (stats.weights || []).forEach((wstat, i) => {
     if (!wstat || !matchesFilter("weight " + wstat.shape, i, filter)) return;
-    addCell(`W${i} ${wstat.shape} ∂cost/∂w`,
+    addHistCell(`W${i} ${wstat.shape} ∂cost/∂w`,
       `w: μ=${wstat.data.mean.toPrecision(3)} σ=${wstat.data.std.toPrecision(3)}`,
       wstat.gradient.histogram);
+  });
+  // MoE routing: per-expert fraction bars (uniform = balanced; a single
+  // tall bar = expert collapse).
+  Object.entries(stats.moe_router_fractions || {}).forEach(([name, fr]) => {
+    if (!matchesFilter(name, -1, filter)) return;
+    const max = Math.max(...fr, 1e-9);
+    addCell(name, `${fr.length} experts, max=${(max * 100).toFixed(1)}%`,
+      (canvas) => {
+        const ctx = prepCanvas(canvas);
+        const w = canvas.width, h = canvas.height, pad = 8;
+        const bw = (w - 2 * pad) / fr.length;
+        ctx.fillStyle = "#4c8dd6";
+        fr.forEach((v, i) => {
+          const bh = (h - 2 * pad) * (v / max);
+          ctx.fillRect(pad + i * bw, h - pad - bh, Math.max(1, bw - 1), bh);
+        });
+      });
   });
 }
 

@@ -239,6 +239,8 @@ class Mapper:
             return _gpt2_dsl_from_config(config, n_layer_override)
         if model_type.startswith("gemma"):
             return _gemma_dsl_from_config(config, n_layer_override)
+        if model_type in _LLAMA_FAMILY:
+            return _llama_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -264,6 +266,8 @@ class Mapper:
         (reference: mappers.py:304-448)."""
         if "transformer.wte.weight" in state_dict:
             return _map_gpt2_state_dict(state_dict, n_layer)
+        if getattr(config, "model_type", "") in _LLAMA_FAMILY:
+            return _map_llama_state_dict(state_dict, n_layer, config)
         return _map_gemma_state_dict(state_dict, n_layer, config)
 
 
@@ -508,6 +512,135 @@ def _map_gemma_state_dict(sd: dict, n_layer: int, config=None) -> dict:
             out[f"{dst}.mlp_block.1.{proj}.weight"] = \
                 sd[f"{src}.mlp.{proj}.weight"]
     out[f"layers.{1 + n_layer}.weight"] = _plus_one(sd[f"{prefix}.norm.weight"])
+    out[f"layers.{2 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd[f"{prefix}.embed_tokens.weight"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Llama family (beyond reference parity: mappers.py covers GPT-2 + Gemma
+# only; Llama/Mistral/Qwen2 reuse the same GQA+RoPE+RMSNorm+GatedMLP
+# modules with pre-norm blocks, no +1 norm offset and no embedding scale)
+# ---------------------------------------------------------------------------
+
+_LLAMA_FAMILY = ("llama", "mistral", "qwen2")
+
+
+def _llama_text_config(config):
+    get = getattr(config, "get_text_config", None)
+    return get() if callable(get) else config
+
+
+def _llama_biases(model_type: str, cfg) -> tuple[bool, bool]:
+    """(qkv_bias, o_bias).  Qwen2 hardcodes qkv bias on / o bias off in its
+    attention module; Llama/Mistral follow ``attention_bias`` (default
+    False) for all four projections."""
+    if model_type == "qwen2":
+        return True, False
+    bias = bool(getattr(cfg, "attention_bias", False) or False)
+    return bias, bias
+
+
+def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """Llama/Mistral/Qwen2 HF config → layer DSL.
+
+    Loud about what is NOT supported: an active ``rope_scaling`` (Llama
+    3.1+ 'llama3'/yarn types rewrite inv_freq) would import "successfully"
+    but produce silently wrong logits, so it raises.  A sliding window
+    (Mistral) only diverges from HF for contexts longer than the window —
+    attention here is always full causal, the same treatment the reference
+    gives Gemma's sliding layers (mappers.py:224-228) — so it warns and
+    proceeds.
+    """
+    model_type = getattr(config, "model_type", "llama")
+    cfg = _llama_text_config(config)
+    scaling = getattr(cfg, "rope_scaling", None)
+    if scaling and (scaling.get("rope_type") or
+                    scaling.get("type") or "default") != "default":
+        raise ValueError(
+            f"rope_scaling {scaling.get('rope_type') or scaling.get('type')!r}"
+            " is not supported; importing would produce wrong logits")
+    window = getattr(cfg, "sliding_window", None)
+    if window:
+        import logging
+        logging.getLogger(__name__).warning(
+            "%s sliding_window=%s imported as full causal attention; "
+            "outputs diverge from HF only for contexts longer than the "
+            "window", model_type, window)
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    kv = int(getattr(cfg, "num_key_value_heads", None) or heads)
+    hd = int(getattr(cfg, "head_dim", None) or d // heads)
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "rms_norm_eps", 1e-6))
+    rope = float(getattr(cfg, "rope_theta", 10000.0) or 10000.0)
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    activation = getattr(cfg, "hidden_act", "silu")
+    qkv_bias, o_bias = _llama_biases(model_type, cfg)
+    if getattr(cfg, "mlp_bias", False):
+        raise ValueError("mlp_bias=True Llama checkpoints are not supported")
+
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for _ in range(n):
+        layers.append({"transformerblock": {
+            "attn_block": {"sequential": [
+                {"rmsnorm": {"normalized_shape": d, "eps": eps}},
+                {"linear": {"in_features": d,
+                            "out_features": (heads + 2 * kv) * hd,
+                            "bias": qkv_bias}},
+                {"attention": {"num_heads": heads, "num_kv_heads": kv,
+                               "rope_theta": rope, "head_dim": hd,
+                               "dropout": attn_drop}},
+                {"linear": {"in_features": heads * hd, "out_features": d,
+                            "bias": o_bias}}]},
+            "mlp_block": {"sequential": [
+                {"rmsnorm": {"normalized_shape": d, "eps": eps}},
+                {"gatedmlp": {"in_features": d,
+                              "intermediate_size": int(cfg.intermediate_size),
+                              "activation": activation}}]},
+            "post_norm_on_residual": False,
+        }})
+    layers += [
+        {"rmsnorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """Llama/Mistral/Qwen2 HF keys → ours: QKV (+bias) concat, straight
+    RMSNorm copy (no Gemma +1 offset), tied-or-untied lm_head."""
+    prefix = "model"
+    if any(k.startswith("model.language_model.") for k in sd):
+        prefix = "model.language_model"
+    out = {"layers.0.weight": sd[f"{prefix}.embed_tokens.weight"]}
+    for i in range(n_layer):
+        src = f"{prefix}.layers.{i}"
+        dst = f"layers.{1 + i}"
+        out[f"{dst}.attn_block.0.weight"] = sd[f"{src}.input_layernorm.weight"]
+        out[f"{dst}.attn_block.1.weight"] = np.concatenate(
+            [np.asarray(sd[f"{src}.self_attn.q_proj.weight"]),
+             np.asarray(sd[f"{src}.self_attn.k_proj.weight"]),
+             np.asarray(sd[f"{src}.self_attn.v_proj.weight"])], axis=0)
+        if f"{src}.self_attn.q_proj.bias" in sd:
+            out[f"{dst}.attn_block.1.bias"] = np.concatenate(
+                [np.asarray(sd[f"{src}.self_attn.q_proj.bias"]),
+                 np.asarray(sd[f"{src}.self_attn.k_proj.bias"]),
+                 np.asarray(sd[f"{src}.self_attn.v_proj.bias"])], axis=0)
+        out[f"{dst}.attn_block.3.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
+        if f"{src}.self_attn.o_proj.bias" in sd:
+            out[f"{dst}.attn_block.3.bias"] = sd[f"{src}.self_attn.o_proj.bias"]
+        out[f"{dst}.mlp_block.0.weight"] = \
+            sd[f"{src}.post_attention_layernorm.weight"]
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            out[f"{dst}.mlp_block.1.{proj}.weight"] = \
+                sd[f"{src}.mlp.{proj}.weight"]
+    out[f"layers.{1 + n_layer}.weight"] = sd[f"{prefix}.norm.weight"]
     out[f"layers.{2 + n_layer}.weight"] = sd.get(
         "lm_head.weight", sd[f"{prefix}.embed_tokens.weight"])
     return out

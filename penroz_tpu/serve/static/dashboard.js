@@ -76,21 +76,28 @@ function lineChart(canvas, series, opts = {}) {
   }
 }
 
-/* Histogram as filled bars. data: {x: edges, y: densities} */
-function histChart(canvas, data) {
+/* Max-normalized bar series on a canvas. */
+function drawBars(canvas, ys, color) {
   const ctx = prepCanvas(canvas);
   const w = canvas.width, h = canvas.height, pad = 8;
-  if (!data || !data.x || !data.x.length) {
-    drawLabel(ctx, "no data", w / 2 - 20, h / 2); return;
-  }
-  const hi = Math.max(...data.y, 1e-12);
-  const n = data.y.length;
-  const bw = (w - 2 * pad) / n;
-  ctx.fillStyle = "#3f7f6b";
-  data.y.forEach((v, i) => {
+  const hi = Math.max(...ys, 1e-12);
+  const bw = (w - 2 * pad) / ys.length;
+  ctx.fillStyle = color;
+  ys.forEach((v, i) => {
     const bh = v / hi * (h - 2 * pad);
     ctx.fillRect(pad + i * bw, h - pad - bh, Math.max(1, bw - 1), bh);
   });
+  return ctx;
+}
+
+/* Histogram as filled bars. data: {x: edges, y: densities} */
+function histChart(canvas, data) {
+  const w = canvas.width, h = canvas.height, pad = 8;
+  if (!data || !data.x || !data.x.length) {
+    drawLabel(prepCanvas(canvas), "no data", w / 2 - 20, h / 2); return;
+  }
+  const n = data.y.length;
+  const ctx = drawBars(canvas, data.y, "#3f7f6b");
   drawLabel(ctx, Number(data.x[0]).toPrecision(3), pad, h - 1);
   drawLabel(ctx, Number(data.x[n - 1]).toPrecision(3), w - 50, h - 1);
 }
@@ -192,16 +199,7 @@ function renderStats(stats, filter) {
     if (!matchesFilter(name, -1, filter)) return;
     const max = Math.max(...fr, 1e-9);
     addCell(name, `${fr.length} experts, max=${(max * 100).toFixed(1)}%`,
-      (canvas) => {
-        const ctx = prepCanvas(canvas);
-        const w = canvas.width, h = canvas.height, pad = 8;
-        const bw = (w - 2 * pad) / fr.length;
-        ctx.fillStyle = "#4c8dd6";
-        fr.forEach((v, i) => {
-          const bh = (h - 2 * pad) * (v / max);
-          ctx.fillRect(pad + i * bw, h - pad - bh, Math.max(1, bw - 1), bh);
-        });
-      });
+      (canvas) => drawBars(canvas, fr, "#4c8dd6"));
   });
 }
 

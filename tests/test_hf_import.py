@@ -113,3 +113,87 @@ def test_import_rejects_mismatched_state_dict(workdir):
                return_value=Broken()):
         with pytest.raises(KeyError):
             NeuralNetworkModel.from_huggingface("broken", "fake/repo")
+
+
+def _tiny_llama():
+    from transformers import LlamaConfig, LlamaForCausalLM
+    config = LlamaConfig(vocab_size=96, hidden_size=16, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         head_dim=4, intermediate_size=32,
+                         max_position_embeddings=64, rope_theta=10000.0,
+                         attention_dropout=0.0, hidden_act="silu",
+                         attention_bias=False, mlp_bias=False,
+                         tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, LlamaForCausalLM(config).eval()
+
+
+def _tiny_qwen2():
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+    config = Qwen2Config(vocab_size=96, hidden_size=16, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         intermediate_size=32, max_position_embeddings=64,
+                         rope_theta=10000.0, attention_dropout=0.0,
+                         hidden_act="silu", tie_word_embeddings=True)
+    torch.manual_seed(0)
+    return config, Qwen2ForCausalLM(config).eval()
+
+
+def test_llama_import_logit_parity(workdir):
+    """Llama family (beyond reference parity): straight RMSNorm copy, no
+    embedding scale, untied lm_head, GQA + RoPE."""
+    config, torch_model = _tiny_llama()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "llama-tiny")
+    assert model.status["code"] == "Imported"
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+
+def test_qwen2_import_logit_parity_and_generate(workdir):
+    """Qwen2: hardcoded QKV bias (concat-mapped), no o bias, tied lm_head."""
+    config, torch_model = _tiny_qwen2()
+    tokens = np.array([[5, 9, 63, 2]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "qwen-tiny")
+    import jax.numpy as jnp
+    assert "layers.1.attn_block.1.bias" in model.params  # qkv bias mapped
+    assert "layers.1.attn_block.3.bias" not in model.params  # o has none
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    gen = NeuralNetworkModel.deserialize("qwen-tiny").generate_tokens(
+        [[1, 2, 3]], block_size=16, max_new_tokens=4, temperature=0.0)
+    assert len(gen) == 7 and all(0 <= t < 96 for t in gen)
+
+
+def test_llama_rope_scaling_rejected():
+    """An active rope_scaling (Llama 3.1+ rewrites inv_freq) must fail the
+    import loudly — a 'successful' import with wrong RoPE frequencies would
+    silently produce wrong logits."""
+    from transformers import LlamaConfig
+    config = LlamaConfig(vocab_size=96, hidden_size=16, num_hidden_layers=1,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         head_dim=4, intermediate_size=32,
+                         rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                                       "low_freq_factor": 1.0,
+                                       "high_freq_factor": 4.0,
+                                       "original_max_position_embeddings": 8192})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        Mapper.from_hf_config(config)

@@ -544,22 +544,31 @@ def _llama_biases(model_type: str, cfg) -> tuple[bool, bool]:
 def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     """Llama/Mistral/Qwen2 HF config → layer DSL.
 
-    Loud about what is NOT supported: an active ``rope_scaling`` (Llama
-    3.1+ 'llama3'/yarn types rewrite inv_freq) would import "successfully"
-    but produce silently wrong logits, so it raises.  A sliding window
-    (Mistral) only diverges from HF for contexts longer than the window —
-    attention here is always full causal, the same treatment the reference
-    gives Gemma's sliding layers (mappers.py:224-228) — so it warns and
-    proceeds.
+    ``rope_scaling`` with ``rope_type='llama3'`` (Llama 3.1+) is applied as
+    an inverse-frequency rescale (ops/attention.rope_cos_sin); other active
+    types (yarn, dynamic, ...) raise — importing with them ignored would
+    produce silently wrong logits.  A sliding window (Mistral) only
+    diverges from HF for contexts longer than the window — attention here
+    is always full causal, the same treatment the reference gives Gemma's
+    sliding layers (mappers.py:224-228) — so it warns and proceeds.
     """
     model_type = getattr(config, "model_type", "llama")
     cfg = _llama_text_config(config)
-    scaling = getattr(cfg, "rope_scaling", None)
-    if scaling and (scaling.get("rope_type") or
-                    scaling.get("type") or "default") != "default":
-        raise ValueError(
-            f"rope_scaling {scaling.get('rope_type') or scaling.get('type')!r}"
-            " is not supported; importing would produce wrong logits")
+    scaling = getattr(cfg, "rope_scaling", None) or None
+    if scaling:
+        rope_type = (scaling.get("rope_type") or scaling.get("type")
+                     or "default")
+        if rope_type == "default":
+            scaling = None
+        elif rope_type != "llama3":
+            raise ValueError(
+                f"rope_scaling {rope_type!r} is not supported; importing "
+                "would produce wrong logits")
+        else:
+            scaling = {"rope_type": "llama3", **{
+                k: float(scaling[k]) for k in
+                ("factor", "low_freq_factor", "high_freq_factor",
+                 "original_max_position_embeddings") if k in scaling}}
     window = getattr(cfg, "sliding_window", None)
     if window:
         import logging
@@ -581,6 +590,10 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     if getattr(cfg, "mlp_bias", False):
         raise ValueError("mlp_bias=True Llama checkpoints are not supported")
 
+    attn_args = {"num_heads": heads, "num_kv_heads": kv, "rope_theta": rope,
+                 "head_dim": hd, "dropout": attn_drop}
+    if scaling:
+        attn_args["rope_scaling"] = scaling
     layers: list[dict] = [
         {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
          "normal": {"mean": 0.0, "std": 0.02}},
@@ -592,9 +605,7 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                 {"linear": {"in_features": d,
                             "out_features": (heads + 2 * kv) * hd,
                             "bias": qkv_bias}},
-                {"attention": {"num_heads": heads, "num_kv_heads": kv,
-                               "rope_theta": rope, "head_dim": hd,
-                               "dropout": attn_drop}},
+                {"attention": dict(attn_args)},
                 {"linear": {"in_features": heads * hd, "out_features": d,
                             "bias": o_bias}}]},
             "mlp_block": {"sequential": [

@@ -17,13 +17,36 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _NEG_INF = -1e30
 
 
-def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype):
-    """cos/sin tables of shape (length, head_dim) starting at ``offset``."""
+def _llama3_scale_inv_freq(inv_freq, scaling: dict):
+    """Llama-3.1 frequency rescaling (HF ``_compute_llama3_parameters``):
+    long-wavelength components divide by ``factor``, short ones pass
+    through, and a smooth ramp interpolates between the two bands."""
+    factor = float(scaling["factor"])
+    low = float(scaling.get("low_freq_factor", 1.0))
+    high = float(scaling.get("high_freq_factor", 4.0))
+    orig = float(scaling["original_max_position_embeddings"])
+    wavelen = 2.0 * np.pi / inv_freq
+    smooth = (orig / wavelen - low) / (high - low)
+    smoothed = (1.0 - smooth) / factor * inv_freq + smooth * inv_freq
+    scaled = jnp.where(wavelen > orig / low, inv_freq / factor, inv_freq)
+    is_medium = (wavelen <= orig / low) & (wavelen >= orig / high)
+    return jnp.where(is_medium, smoothed, scaled)
+
+
+def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype,
+                 scaling: Optional[dict] = None):
+    """cos/sin tables of shape (length, head_dim) starting at ``offset``.
+
+    ``scaling``: an HF ``rope_scaling`` dict with ``rope_type='llama3'``
+    rescales the inverse frequencies (Llama 3.1+ long-context models)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling:
+        inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
     t = offset.astype(jnp.float32) + jnp.arange(length, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)
     emb = jnp.concatenate([freqs, freqs], axis=-1)
@@ -35,10 +58,11 @@ def _rotate_half(x):
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
-def apply_rope(q, k, theta: float, offset):
+def apply_rope(q, k, theta: float, offset, scaling: Optional[dict] = None):
     """Apply rotary embeddings to (B, H, T, D) query/key tensors."""
     head_dim = q.shape[-1]
-    cos, sin = rope_cos_sin(head_dim, theta, offset, q.shape[2], q.dtype)
+    cos, sin = rope_cos_sin(head_dim, theta, offset, q.shape[2], q.dtype,
+                            scaling=scaling)
     cos, sin = cos[None, None], sin[None, None]
     q = q * cos + _rotate_half(q) * sin
     k = k * cos + _rotate_half(k) * sin

@@ -660,12 +660,41 @@ class CausalSelfAttention(Module):
     def __init__(self, num_heads: int, dropout: float = 0.0,
                  num_kv_heads: Optional[int] = None,
                  rope_theta: Optional[float] = None,
-                 head_dim: Optional[int] = None):
+                 head_dim: Optional[int] = None,
+                 rope_scaling: Optional[dict] = None):
         self.num_heads = int(num_heads)
         self.num_kv_heads = int(num_kv_heads) if num_kv_heads is not None else int(num_heads)
         self.dropout = float(dropout)
         self.rope_theta = float(rope_theta) if rope_theta is not None else None
         self.head_dim = int(head_dim) if head_dim is not None else None
+        # llama3-type inverse-frequency rescaling (ops/attention.rope_cos_sin).
+        # Validated HERE, at model build time (→ HTTP 400 on POST /model/):
+        # the DSL reaches this module directly, so the HF importer's guard
+        # alone would let a yarn dict silently run the llama3 formula or a
+        # missing key crash opaquely at first jit trace.
+        if rope_scaling:
+            rope_type = (rope_scaling.get("rope_type")
+                         or rope_scaling.get("type") or "default")
+            if rope_type != "llama3":
+                raise ValueError(f"rope_scaling type {rope_type!r} is not "
+                                 "supported (only 'llama3')")
+            missing = [k for k in ("factor",
+                                   "original_max_position_embeddings")
+                       if k not in rope_scaling]
+            if missing:
+                raise ValueError(f"rope_scaling missing keys: {missing}")
+            self.rope_scaling = {
+                "rope_type": "llama3",
+                "factor": float(rope_scaling["factor"]),
+                "low_freq_factor":
+                    float(rope_scaling.get("low_freq_factor", 1.0)),
+                "high_freq_factor":
+                    float(rope_scaling.get("high_freq_factor", 4.0)),
+                "original_max_position_embeddings":
+                    float(rope_scaling["original_max_position_embeddings"]),
+            }
+        else:
+            self.rope_scaling = None
         self.layer_idx = 0  # assigned by the model builder
 
     def apply(self, qkv, ctx):
@@ -682,7 +711,8 @@ class CausalSelfAttention(Module):
 
         offset = ctx.offset()
         if self.rope_theta is not None:
-            q, k = attn_ops.apply_rope(q, k, self.rope_theta, offset)
+            q, k = attn_ops.apply_rope(q, k, self.rope_theta, offset,
+                                       scaling=self.rope_scaling)
 
         dropout_rate = self.dropout if ctx.training else 0.0
         dropout_rng = ctx.next_rng() if (dropout_rate > 0.0 and ctx.training) else None

@@ -183,17 +183,58 @@ def test_qwen2_import_logit_parity_and_generate(workdir):
     assert len(gen) == 7 and all(0 <= t < 96 for t in gen)
 
 
-def test_llama_rope_scaling_rejected():
-    """An active rope_scaling (Llama 3.1+ rewrites inv_freq) must fail the
-    import loudly — a 'successful' import with wrong RoPE frequencies would
-    silently produce wrong logits."""
+def test_llama3_rope_scaling_logit_parity(workdir):
+    """Llama 3.1-style rope_scaling (llama3 inverse-frequency rescale) must
+    match the torch implementation's logits, not just import."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+    config = LlamaConfig(vocab_size=96, hidden_size=16, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         head_dim=4, intermediate_size=32,
+                         max_position_embeddings=128, rope_theta=10000.0,
+                         attention_dropout=0.0, tie_word_embeddings=False,
+                         rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                                       "low_freq_factor": 1.0,
+                                       "high_freq_factor": 4.0,
+                                       "original_max_position_embeddings": 16})
+    torch.manual_seed(0)
+    torch_model = LlamaForCausalLM(config).eval()
+    # positions past original_max_position_embeddings exercise the rescale
+    tokens = np.arange(24, dtype=np.int64)[None, :] % 96
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "llama31-tiny")
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+
+
+def test_llama_unsupported_rope_scaling_rejected():
+    """Non-llama3 active scaling types (yarn, dynamic) must fail the import
+    loudly — importing with them ignored would silently produce wrong
+    logits."""
     from transformers import LlamaConfig
     config = LlamaConfig(vocab_size=96, hidden_size=16, num_hidden_layers=1,
                          num_attention_heads=4, num_key_value_heads=2,
                          head_dim=4, intermediate_size=32,
-                         rope_scaling={"rope_type": "llama3", "factor": 8.0,
-                                       "low_freq_factor": 1.0,
-                                       "high_freq_factor": 4.0,
-                                       "original_max_position_embeddings": 8192})
+                         rope_scaling={"rope_type": "yarn", "factor": 4.0})
     with pytest.raises(ValueError, match="rope_scaling"):
         Mapper.from_hf_config(config)
+
+
+def test_dsl_rope_scaling_validated_at_build():
+    """rope_scaling is validated where the DSL reaches the module (POST
+    /model/ → 400), not only in the HF importer — a yarn dict must not
+    silently run the llama3 formula."""
+    from penroz_tpu.ops.modules import CausalSelfAttention
+    with pytest.raises(ValueError, match="not supported"):
+        CausalSelfAttention(num_heads=2, rope_theta=1e4,
+                            rope_scaling={"rope_type": "yarn", "factor": 4.0})
+    with pytest.raises(ValueError, match="missing keys"):
+        CausalSelfAttention(num_heads=2, rope_theta=1e4,
+                            rope_scaling={"rope_type": "llama3"})

@@ -432,9 +432,16 @@ def _gemma_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                 {"linear": {"in_features": d,
                             "out_features": (heads + 2 * kv) * hd,
                             "bias": False}},
-                {"attention": {"num_heads": heads, "num_kv_heads": kv,
-                               "rope_theta": _gemma_rope_theta(cfg, layer_type),
-                               "head_dim": hd, "dropout": attn_drop}},
+                {"attention": dict(
+                    {"num_heads": heads, "num_kv_heads": kv,
+                     "rope_theta": _gemma_rope_theta(cfg, layer_type),
+                     "head_dim": hd, "dropout": attn_drop},
+                    # sliding layers get REAL windowed attention (the
+                    # reference keeps all attention full causal and maps
+                    # layer_types to dims only, mappers.py:224-228)
+                    **({"sliding_window": int(cfg.sliding_window)}
+                       if layer_type == "sliding_attention"
+                       and getattr(cfg, "sliding_window", None) else {}))},
                 {"linear": {"in_features": heads * hd, "out_features": d,
                             "bias": False}}]},
             "mlp_block": {"sequential": [
@@ -547,10 +554,9 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     ``rope_scaling`` with ``rope_type='llama3'`` (Llama 3.1+) is applied as
     an inverse-frequency rescale (ops/attention.rope_cos_sin); other active
     types (yarn, dynamic, ...) raise — importing with them ignored would
-    produce silently wrong logits.  A sliding window (Mistral) only
-    diverges from HF for contexts longer than the window — attention here
-    is always full causal, the same treatment the reference gives Gemma's
-    sliding layers (mappers.py:224-228) — so it warns and proceeds.
+    produce silently wrong logits.  A sliding window (Mistral) becomes real
+    windowed attention (ops/attention window masks) — beyond the reference,
+    which keeps all attention full causal (mappers.py:224-228).
     """
     model_type = getattr(config, "model_type", "llama")
     cfg = _llama_text_config(config)
@@ -570,12 +576,19 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                 ("factor", "low_freq_factor", "high_freq_factor",
                  "original_max_position_embeddings") if k in scaling}}
     window = getattr(cfg, "sliding_window", None)
-    if window:
-        import logging
-        logging.getLogger(__name__).warning(
-            "%s sliding_window=%s imported as full causal attention; "
-            "outputs diverge from HF only for contexts longer than the "
-            "window", model_type, window)
+    window = int(window) if window else None
+    # Per-layer gating: Qwen2's use_sliding_window/max_window_layers (and
+    # any llama-family config with layer_types) window only the layers HF
+    # marks 'sliding_attention'; Mistral windows every layer.
+    layer_types = list(getattr(cfg, "layer_types", None) or [])
+
+    def window_for(i: int):
+        if window is None:
+            return None
+        if layer_types:
+            lt = layer_types[i] if i < len(layer_types) else "full_attention"
+            return window if lt == "sliding_attention" else None
+        return window
     d = int(cfg.hidden_size)
     n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
     heads = int(cfg.num_attention_heads)
@@ -598,14 +611,17 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
         {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
          "normal": {"mean": 0.0, "std": 0.02}},
     ]
-    for _ in range(n):
+    for i in range(n):
+        layer_attn = dict(attn_args)
+        if window_for(i) is not None:
+            layer_attn["sliding_window"] = window_for(i)
         layers.append({"transformerblock": {
             "attn_block": {"sequential": [
                 {"rmsnorm": {"normalized_shape": d, "eps": eps}},
                 {"linear": {"in_features": d,
                             "out_features": (heads + 2 * kv) * hd,
                             "bias": qkv_bias}},
-                {"attention": dict(attn_args)},
+                {"attention": layer_attn},
                 {"linear": {"in_features": heads * hd, "out_features": d,
                             "bias": o_bias}}]},
             "mlp_block": {"sequential": [

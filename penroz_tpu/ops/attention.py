@@ -100,18 +100,27 @@ def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None):
     return jnp.einsum("bhgts,bhsd->bhgtd", probs, v, precision=precision)
 
 
-def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None):
-    """Pure-jnp causal attention. q: (B, Hq, T, D); k, v: (B, Hkv, T, D)."""
+def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                               window: Optional[int] = None):
+    """Pure-jnp causal attention. q: (B, Hq, T, D); k, v: (B, Hkv, T, D).
+
+    ``window``: sliding-window width — query t attends keys in
+    ``(t - window, t]`` (HF Mistral/Gemma-2 semantics: the window *includes*
+    the query position and the ``window - 1`` keys before it)."""
     B, Hq, T, D = q.shape
     num_kv_heads = k.shape[1]
     qg = _group_query_heads(q, num_kv_heads)
-    mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+    q_pos = jnp.arange(T)[:, None]
+    k_pos = jnp.arange(T)[None, :]
+    mask = k_pos <= q_pos
+    if window is not None:
+        mask &= k_pos > q_pos - int(window)
     out = _attend(qg, k, v, mask, dropout_rate, dropout_rng)
     return out.reshape(B, Hq, T, D)
 
 
 def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
-                     platform=None):
+                     platform=None, window: Optional[int] = None):
     """Causal self-attention; dispatches to the Pallas kernel on TPU.
 
     ``platform`` is the caller's execution-placement hint ('tpu'/'cpu'/...).
@@ -131,14 +140,16 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
                                       dtype=jnp.int32)
             return fa.flash_attention(q, k, v, causal=True,
                                       dropout_rate=float(dropout_rate),
-                                      seed=seed)
-        return fa.flash_attention(q, k, v, causal=True)
-    return causal_attention_reference(q, k, v, dropout_rate, dropout_rng)
+                                      seed=seed, window=window)
+        return fa.flash_attention(q, k, v, causal=True, window=window)
+    return causal_attention_reference(q, k, v, dropout_rate, dropout_rng,
+                                      window=window)
 
 
 def cached_attention(q, k_full, v_full, offset, length,
                      dropout_rate=0.0, dropout_rng=None, platform=None,
-                     k_scale=None, v_scale=None):
+                     k_scale=None, v_scale=None,
+                     window: Optional[int] = None):
     """Attention over a preallocated KV cache.
 
     q: (B, Hq, T, D) new queries at positions ``offset + [0, T)``.
@@ -158,7 +169,8 @@ def cached_attention(q, k_full, v_full, offset, length,
     if dropout_rate == 0.0 and _use_flash_decode(q, k_full, platform):
         from penroz_tpu.ops.pallas import decode_attention as da
         return da.decode_attention(q, k_full, v_full, offset, length,
-                                   k_scale=k_scale, v_scale=v_scale)
+                                   k_scale=k_scale, v_scale=v_scale,
+                                   window=window)
     if k_scale is not None:
         k_full = (k_full.astype(jnp.float32) * k_scale).astype(q.dtype)
         v_full = (v_full.astype(jnp.float32) * v_scale).astype(q.dtype)
@@ -169,6 +181,8 @@ def cached_attention(q, k_full, v_full, offset, length,
     q_pos = offset + jnp.arange(T, dtype=jnp.int32)
     key_idx = jnp.arange(S, dtype=jnp.int32)
     mask = key_idx[None, :] <= q_pos[:, None]  # (T, S)
+    if window is not None:
+        mask &= key_idx[None, :] > q_pos[:, None] - int(window)
     out = _attend(qg, k_full, v_full, mask, dropout_rate, dropout_rng)
     return out.reshape(B, Hq, T, D)
 

@@ -661,7 +661,13 @@ class CausalSelfAttention(Module):
                  num_kv_heads: Optional[int] = None,
                  rope_theta: Optional[float] = None,
                  head_dim: Optional[int] = None,
-                 rope_scaling: Optional[dict] = None):
+                 rope_scaling: Optional[dict] = None,
+                 sliding_window: Optional[int] = None):
+        if sliding_window is not None and int(sliding_window) < 1:
+            raise ValueError(f"sliding_window must be >= 1, "
+                             f"got {sliding_window}")
+        self.sliding_window = (int(sliding_window)
+                               if sliding_window is not None else None)
         self.num_heads = int(num_heads)
         self.num_kv_heads = int(num_kv_heads) if num_kv_heads is not None else int(num_heads)
         self.dropout = float(dropout)
@@ -683,6 +689,16 @@ class CausalSelfAttention(Module):
                        if k not in rope_scaling]
             if missing:
                 raise ValueError(f"rope_scaling missing keys: {missing}")
+            low = float(rope_scaling.get("low_freq_factor", 1.0))
+            high = float(rope_scaling.get("high_freq_factor", 4.0))
+            if high <= low:
+                # the band-smoothing divides by (high - low): equal factors
+                # would NaN every logit at first forward (HF's
+                # rope_config_validation rejects this too)
+                raise ValueError(f"rope_scaling needs high_freq_factor > "
+                                 f"low_freq_factor, got {low} >= {high}")
+            if float(rope_scaling["factor"]) < 1.0:
+                raise ValueError("rope_scaling factor must be >= 1")
             self.rope_scaling = {
                 "rope_type": "llama3",
                 "factor": float(rope_scaling["factor"]),
@@ -738,6 +754,10 @@ class CausalSelfAttention(Module):
                        "v_scale": ctx.kv.v_scale[self.layer_idx]}
                       if ctx.kv.quantized else {})
             if paged:
+                if self.sliding_window is not None:
+                    raise ValueError(
+                        "sliding_window attention is not supported with the "
+                        "paged KV cache; unset PAGED_KV_CACHE for this model")
                 out = attn_ops.paged_cached_attention(
                     q, store_k, store_v, ctx.kv.block_table, ctx.kv.page_size,
                     offset, length, dropout_rate=dropout_rate,
@@ -748,14 +768,19 @@ class CausalSelfAttention(Module):
                                                 dropout_rate=dropout_rate,
                                                 dropout_rng=dropout_rng,
                                                 platform=ctx.platform,
+                                                window=self.sliding_window,
                                                 **scales)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
+            if self.sliding_window is not None:
+                raise ValueError("sliding_window attention is not supported "
+                                 "with ring (sequence-parallel) attention")
             # Sequence-parallel training: ring attention over ICI.
             from penroz_tpu.parallel.ring_attention import ring_attention
             out = ring_attention(q, k, v, ctx.sp_mesh, causal=True)
         else:
             out = attn_ops.causal_attention(q, k, v, dropout_rate=dropout_rate,
                                             dropout_rng=dropout_rng,
-                                            platform=ctx.platform)
+                                            platform=ctx.platform,
+                                            window=self.sliding_window)
 
         return out.transpose(0, 2, 1, 3).reshape(B, T, q_dim)

@@ -44,7 +44,7 @@ _NEG_INF = -1e30
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
                    num_k: int, num_queries: int, sm_scale: float,
-                   quantized: bool):
+                   quantized: bool, window=None):
     """One (batch, kv-head, k-block) step: GT grouped query rows vs one tile.
 
     q_ref: (1, 1, GT, D) where GT = group * T, row r ↦ (g = r // T, t = r % T).
@@ -65,6 +65,11 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
     total = len_ref[0]
     offset = total - num_queries
     hi = jax.lax.div(total + block_k - 1, block_k)
+    live = j < hi
+    if window is not None:
+        # tiles entirely below every query's window contribute nothing —
+        # skipping them keeps decode compute O(window), not O(cache)
+        live &= (j + 1) * block_k - 1 > offset - window
 
     @pl.when(j == 0)
     def _init():
@@ -72,7 +77,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(j < hi)
+    @pl.when(live)
     def _block():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -89,13 +94,20 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
         t = jax.lax.broadcasted_iota(jnp.int32, (gt, block_k), 0) % num_queries
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gt, block_k), 1)
-        s = jnp.where(k_pos <= offset + t, s, _NEG_INF)
+        mask = k_pos <= offset + t
+        if window is not None:
+            mask &= k_pos > offset + t - window
+        s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
+        if window is not None:
+            # _NEG_INF is finite: fully-masked rows in early tiles would
+            # otherwise get p = exp(-1e30 - -1e30) = 1
+            p = jnp.where(mask, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -112,7 +124,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
 
 def decode_attention(q, k_full, v_full, offset, length,
                      block_k: int = DEFAULT_BLOCK_K, interpret: bool = False,
-                     k_scale=None, v_scale=None):
+                     k_scale=None, v_scale=None, window=None):
     """Fused cached attention.  Same contract as the jnp oracle
     ``cached_attention``: q (B, Hq, T, D); k_full/v_full (B, Hkv, S_max, D);
     ``length`` = offset + T valid entries (post-append).  With
@@ -137,14 +149,21 @@ def decode_attention(q, k_full, v_full, offset, length,
     total = jnp.asarray(length, jnp.int32).reshape(1)
 
     def kv_index(b, h, j, len_ref):
-        # Clamp past-the-end steps to the last valid tile: same index ⇒
-        # Pallas elides the copy, so invalid tail tiles are never fetched.
+        # Clamp out-of-band steps to the nearest band tile: same index ⇒
+        # Pallas elides the copy, so tiles past the occupancy (and, with a
+        # window, tiles below the band) are never fetched from HBM.
         hi = jax.lax.div(len_ref[0] + block_k - 1, block_k)
-        return (b, h, jnp.minimum(j, hi - 1), 0)
+        j_eff = jnp.minimum(j, hi - 1)
+        if window is not None:
+            lo_pos = jnp.maximum(len_ref[0] - T - window + 1, 0)
+            j_eff = jnp.maximum(j_eff, jax.lax.div(lo_pos, block_k))
+        return (b, h, j_eff, 0)
 
     kernel = functools.partial(_decode_kernel, block_k=block_k, num_k=num_k,
                                num_queries=T, sm_scale=sm_scale,
-                               quantized=quantized)
+                               quantized=quantized,
+                               window=int(window) if window is not None
+                               else None)
     in_specs = [
         pl.BlockSpec((1, 1, group * T, D),
                      lambda b, h, j, len_ref: (b, h, 0, 0),

@@ -97,10 +97,33 @@ def _head_seed(seed_ref, b, h, num_heads: int):
 # ---------------------------------------------------------------------------
 
 
+def _band_mask(q_pos, k_pos, causal: bool, window):
+    """Causal (+ optional sliding-window lower bound) mask, or None."""
+    if not causal:
+        return None
+    mask = k_pos <= q_pos
+    if window is not None:
+        mask &= k_pos > q_pos - window
+    return mask
+
+
+def _live_block(qi, kj, block_q: int, block_k: int, causal: bool, window):
+    """Whether block (qi, kj) intersects the attention band.  Under causal,
+    blocks strictly above the diagonal contribute nothing; with a sliding
+    window, blocks entirely left of the band do not either — this skip is
+    where the window's compute savings come from."""
+    if not causal:
+        return True
+    live = kj * block_k <= qi * block_q + block_q - 1
+    if window is not None:
+        live &= (kj + 1) * block_k - 1 > qi * block_q - window
+    return live
+
+
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, causal: bool, sm_scale: float,
                 block_q: int, block_k: int, num_k: int, num_heads: int,
-                dropout_rate: float):
+                dropout_rate: float, window=None):
     b, h, qi, kj = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
                     pl.program_id(3))
 
@@ -110,8 +133,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Blocks strictly above the diagonal contribute nothing under causal.
-    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+    live = _live_block(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -123,14 +145,20 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32,
             precision=_dot_precision(q.dtype)) * sm_scale
         q_pos, k_pos = _block_positions(qi, kj, block_q, block_k)
-        if causal:
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        mask = _band_mask(q_pos, k_pos, causal, window)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
+        if window is not None:
+            # _NEG_INF is finite (-1e30): a row whose window lies entirely
+            # outside this tile has s == m_new == -1e30 and exp(s - m_new)
+            # would be 1, not 0 — zero masked entries explicitly.
+            p = jnp.where(mask, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         if dropout_rate > 0.0:
             # l accumulates the *undropped* probabilities (dropout applies
@@ -168,7 +196,8 @@ def _flash_forward(q, k, v, causal: bool = True,
                    block_q: int = DEFAULT_BLOCK_Q,
                    block_k: int = DEFAULT_BLOCK_K,
                    dropout_rate: float = 0.0, seed=None,
-                   interpret: bool = False, return_lse: bool = False):
+                   interpret: bool = False, return_lse: bool = False,
+                   window=None):
     B, Hq, T, D = q.shape
     Hkv, S = k.shape[1], k.shape[2]
     group = Hq // Hkv
@@ -190,7 +219,7 @@ def _flash_forward(q, k, v, causal: bool = True,
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
         block_k=block_k, num_k=num_k, num_heads=Hq,
-        dropout_rate=dropout_rate)
+        dropout_rate=dropout_rate, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -245,7 +274,7 @@ def _flash_forward(q, k, v, causal: bool = True,
 
 def _recompute_probs(q, k, lse, qi, kj, seed_ref, b, h, *, causal: bool,
                      sm_scale: float, block_q: int, block_k: int,
-                     num_heads: int, dropout_rate: float):
+                     num_heads: int, dropout_rate: float, window=None):
     """Normalized probabilities p (and the dropout keep-scale) for one
     (query-block, key-block) tile, identical to the forward's math."""
     s = jax.lax.dot_general(
@@ -253,9 +282,14 @@ def _recompute_probs(q, k, lse, qi, kj, seed_ref, b, h, *, causal: bool,
         preferred_element_type=jnp.float32,
         precision=_dot_precision(q.dtype)) * sm_scale
     q_pos, k_pos = _block_positions(qi, kj, block_q, block_k)
-    if causal:
-        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    mask = _band_mask(q_pos, k_pos, causal, window)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
     p = jnp.exp(s - lse[:, None])
+    if window is not None:
+        # rows fully outside the window in this tile have lse == -1e30 too;
+        # exp(s - lse) would be 1 — zero masked entries explicitly
+        p = jnp.where(mask, p, 0.0)
     if dropout_rate > 0.0:
         keep = _keep_mask(q_pos, k_pos,
                           _head_seed(seed_ref, b, h, num_heads),
@@ -269,7 +303,7 @@ def _recompute_probs(q, k, lse, qi, kj, seed_ref, b, h, *, causal: bool,
 def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
                dq_ref, dq_scr, *, causal: bool, sm_scale: float,
                block_q: int, block_k: int, num_k: int, num_heads: int,
-               dropout_rate: float):
+               dropout_rate: float, window=None):
     b, h, qi, kj = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
                     pl.program_id(3))
 
@@ -277,7 +311,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+    live = _live_block(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -288,7 +322,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
         p, drop_scale = _recompute_probs(
             q, k, lse_ref[0, 0][:, 0], qi, kj, seed_ref, b, h, causal=causal,
             sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            num_heads=num_heads, dropout_rate=dropout_rate)
+            num_heads=num_heads, dropout_rate=dropout_rate, window=window)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -309,7 +343,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
 def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
                 sm_scale: float, block_q: int, block_k: int, num_q: int,
-                num_heads: int, dropout_rate: float):
+                num_heads: int, dropout_rate: float, window=None):
     b, h, kj, qi = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
                     pl.program_id(3))
 
@@ -318,7 +352,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    live = (qi * block_q + block_q - 1 >= kj * block_k) if causal else True
+    live = _live_block(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -329,7 +363,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
         p, drop_scale = _recompute_probs(
             q, k, lse_ref[0, 0][:, 0], qi, kj, seed_ref, b, h, causal=causal,
             sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            num_heads=num_heads, dropout_rate=dropout_rate)
+            num_heads=num_heads, dropout_rate=dropout_rate, window=window)
         p_drop = p if drop_scale is None else p * drop_scale
         # dV += p̃ᵀ · dO
         dv_scr[...] += jax.lax.dot_general(
@@ -357,7 +391,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                     block_k: int, dropout_rate: float, seed,
-                    interpret: bool = False):
+                    interpret: bool = False, window=None):
     B, Hq, T, D = q.shape
     Hkv, S = k.shape[1], k.shape[2]
     group = Hq // Hkv
@@ -389,7 +423,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, num_k=num_k,
-                          num_heads=Hq, dropout_rate=dropout_rate),
+                          num_heads=Hq, dropout_rate=dropout_rate,
+                          window=window),
         grid=(B, Hq, num_q, num_k),
         in_specs=[seed_spec, q_spec, kv_spec, kv_spec, row_spec, row_spec,
                   q_spec],
@@ -424,7 +459,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     dk_ph, dv_ph = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, num_q=num_q,
-                          num_heads=Hq, dropout_rate=dropout_rate),
+                          num_heads=Hq, dropout_rate=dropout_rate,
+                          window=window),
         grid=(B, Hq, num_k, num_q),
         in_specs=[seed_spec, q_stream, kv_res, kv_res, row_stream,
                   row_stream, q_stream],
@@ -458,28 +494,30 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, seed, causal, block_q, block_k, dropout_rate, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, seed, causal, block_q, block_k, dropout_rate, interpret,
+           window):
     out = _flash_forward(q, k, v, causal, block_q, block_k,
                          dropout_rate=dropout_rate, seed=seed,
-                         interpret=interpret)
+                         interpret=interpret, window=window)
     return out
 
 
 def _flash_fwd_rule(q, k, v, seed, causal, block_q, block_k, dropout_rate,
-                    interpret):
+                    interpret, window):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
                               dropout_rate=dropout_rate, seed=seed,
-                              interpret=interpret, return_lse=True)
+                              interpret=interpret, return_lse=True,
+                              window=window)
     return out, (q, k, v, seed, out, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, dropout_rate, interpret,
-                    residuals, g):
+                    window, residuals, g):
     q, k, v, seed, out, lse = residuals
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, block_q,
                                  block_k, dropout_rate, seed,
-                                 interpret=interpret)
+                                 interpret=interpret, window=window)
     return dq, dk, dv, np.zeros((), dtype=jax.dtypes.float0)
 
 
@@ -490,15 +528,18 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     dropout_rate: float = 0.0, seed=None,
-                    interpret: bool = False):
+                    interpret: bool = False, window=None):
     """Flash attention with a fused flash backward.
 
     q: (B, Hq, T, D); k, v: (B, Hkv, S, D) with Hq % Hkv == 0.
     ``dropout_rate`` > 0 applies post-softmax dropout inside the kernels
     (mask derived from ``seed`` — pass a fresh int32 scalar per step).
+    ``window``: sliding-window width (causal only) — query t attends keys
+    in ``(t - window, t]``; off-band blocks are skipped in the grid.
     """
     if seed is None:
         seed = jnp.zeros((), jnp.int32)
     return _flash(q, k, v, jnp.asarray(seed, jnp.int32), causal,
                   int(block_q), int(block_k), float(dropout_rate),
-                  bool(interpret))
+                  bool(interpret),
+                  int(window) if window is not None else None)

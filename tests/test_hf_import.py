@@ -238,3 +238,100 @@ def test_dsl_rope_scaling_validated_at_build():
     with pytest.raises(ValueError, match="missing keys"):
         CausalSelfAttention(num_heads=2, rope_theta=1e4,
                             rope_scaling={"rope_type": "llama3"})
+
+
+def test_mistral_sliding_window_logit_parity(workdir):
+    """Mistral imports with REAL windowed attention: logits must match
+    torch at sequence lengths beyond the sliding window (the reference
+    keeps all attention full causal and would diverge here)."""
+    from transformers import MistralConfig, MistralForCausalLM
+    config = MistralConfig(vocab_size=96, hidden_size=16, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           head_dim=4, intermediate_size=32,
+                           max_position_embeddings=128, rope_theta=10000.0,
+                           attention_dropout=0.0, sliding_window=8,
+                           tie_word_embeddings=False)
+    torch.manual_seed(0)
+    torch_model = MistralForCausalLM(config).eval()
+    tokens = (np.arange(24, dtype=np.int64)[None, :] * 7) % 96  # 24 > 8
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "mistral-tiny")
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    # windowed decode path works too
+    gen = NeuralNetworkModel.deserialize("mistral-tiny").generate_tokens(
+        [[1, 2, 3]], block_size=32, max_new_tokens=12, temperature=0.0)
+    assert len(gen) == 15
+
+
+def test_gemma2_sliding_layers_logit_parity(workdir):
+    """Gemma-2 layer_types: sliding layers get windowed attention, full
+    layers stay full — parity vs torch past the window."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+    config = Gemma2Config(vocab_size=96, hidden_size=16, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          head_dim=8, intermediate_size=32,
+                          max_position_embeddings=64, rope_theta=10000.0,
+                          attn_logit_softcapping=None,
+                          final_logit_softcapping=None,
+                          query_pre_attn_scalar=8, sliding_window=8,
+                          layer_types=["sliding_attention", "full_attention"],
+                          attention_dropout=0.0,
+                          hidden_activation="gelu_pytorch_tanh")
+    torch.manual_seed(0)
+    torch_model = Gemma2ForCausalLM(config).eval()
+    tokens = (np.arange(20, dtype=np.int64)[None, :] * 5) % 96  # 20 > 8
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "gemma2-sw")
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.2)
+
+
+def test_qwen2_max_window_layers_gating():
+    """Qwen2 use_sliding_window windows only the layers HF marks
+    'sliding_attention' (max_window_layers full layers first), not all."""
+    from transformers import Qwen2Config
+    config = Qwen2Config(vocab_size=96, hidden_size=16, num_hidden_layers=4,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         intermediate_size=32, use_sliding_window=True,
+                         sliding_window=8, max_window_layers=2)
+    layers = Mapper.from_hf_config(config)
+    blocks = [l["transformerblock"] for l in layers if "transformerblock" in l]
+    windows = [b["attn_block"]["sequential"][2]["attention"]
+               .get("sliding_window") for b in blocks]
+    expected = [8 if lt == "sliding_attention" else None
+                for lt in config.layer_types]
+    assert windows == expected
+    assert None in windows  # some layers stay full...
+    assert 8 in windows     # ...and some are windowed
+
+
+def test_rope_scaling_numeric_validation():
+    """Degenerate llama3 scaling numbers NaN every logit via the band
+    smoothing's (high - low) division — reject at build time."""
+    from penroz_tpu.ops.modules import CausalSelfAttention
+    base = {"rope_type": "llama3", "factor": 8.0,
+            "original_max_position_embeddings": 8192}
+    with pytest.raises(ValueError, match="high_freq_factor"):
+        CausalSelfAttention(num_heads=2, rope_theta=1e4,
+                            rope_scaling={**base, "low_freq_factor": 2.0,
+                                          "high_freq_factor": 2.0})
+    with pytest.raises(ValueError, match="factor must be"):
+        CausalSelfAttention(num_heads=2, rope_theta=1e4,
+                            rope_scaling={**base, "factor": 0.5})

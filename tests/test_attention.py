@@ -493,3 +493,60 @@ def test_decode_kernel_window_matches_oracle_interpret():
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5,
                                    err_msg=f"window={window}, off={offset}")
+
+
+def test_decode_kernel_window_with_int8_scales_interpret():
+    """Sliding window + TurboQuant together: per-tile dequant under the
+    band mask matches the dense dequantized windowed oracle."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    rng = np.random.default_rng(31)
+    B, Hq, Hkv, D, S = 1, 4, 2, 64, 512
+    state = KV.QuantKVState.create([(Hkv, D)], B, S, jnp.float32)
+    seeded = jnp.asarray(rng.normal(size=(B, Hkv, 400, D)).astype(np.float32))
+    qk, qv, _ = state.append_raw(0, seeded, seeded * 0.3 - 0.5)
+    ks, vs = state.k_scale[0], state.v_scale[0]
+    window = 64
+    for offset, T in [(399, 1), (200, 4)]:
+        q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+        off = jnp.asarray(offset, jnp.int32)
+        length = jnp.asarray(offset + T, jnp.int32)
+        ref = A.cached_attention(q, qk, qv, off, length, platform="cpu",
+                                 k_scale=ks, v_scale=vs, window=window)
+        out = DA.decode_attention(q, qk, qv, off, length, block_k=128,
+                                  interpret=True, k_scale=ks, v_scale=vs,
+                                  window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, err_msg=f"offset={offset}")
+
+
+def test_window_rejects_unsupported_combos(monkeypatch):
+    """Paged cache and ring attention with a window must raise loudly, not
+    silently attend full causal."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    from penroz_tpu.ops import modules as M
+    layers = [
+        {"embedding": {"num_embeddings": 32, "embedding_dim": 16}},
+        {"residual": [
+            {"sequential": [
+                {"rmsnorm": {"normalized_shape": 16}},
+                {"linear": {"in_features": 16, "out_features": 48}},
+                {"attention": {"num_heads": 2, "sliding_window": 4,
+                               "dropout": 0.0}},
+                {"linear": {"in_features": 16, "out_features": 16}}]}]},
+        {"linear": {"in_features": 16, "out_features": 32}},
+        {"softmaxlast": {"dim": -1}}]
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    model = NeuralNetworkModel("wcombo", Mapper(layers, {"sgd": {"lr": 0.1}}))
+    with pytest.raises(Exception, match="sliding_window"):
+        model.generate_tokens([[1, 2]], block_size=16, max_new_tokens=2,
+                              temperature=0.0)
+
+    # ring attention (sequence-parallel) + window: the guard fires before
+    # any mesh machinery, so a truthy sp_mesh sentinel suffices
+    attn = M.CausalSelfAttention(num_heads=2, sliding_window=4, dropout=0.0)
+    ctx = M.Ctx({}, sp_mesh=object())
+    qkv = jnp.zeros((1, 8, 48), jnp.float32)
+    with pytest.raises(ValueError, match="sliding_window"):
+        attn.apply(qkv, ctx)

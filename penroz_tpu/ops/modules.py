@@ -754,14 +754,11 @@ class CausalSelfAttention(Module):
                        "v_scale": ctx.kv.v_scale[self.layer_idx]}
                       if ctx.kv.quantized else {})
             if paged:
-                if self.sliding_window is not None:
-                    raise ValueError(
-                        "sliding_window attention is not supported with the "
-                        "paged KV cache; unset PAGED_KV_CACHE for this model")
                 out = attn_ops.paged_cached_attention(
                     q, store_k, store_v, ctx.kv.block_table, ctx.kv.page_size,
                     offset, length, dropout_rate=dropout_rate,
-                    dropout_rng=dropout_rng, platform=ctx.platform, **scales)
+                    dropout_rng=dropout_rng, platform=ctx.platform,
+                    window=self.sliding_window, **scales)
             else:
                 out = attn_ops.cached_attention(q, store_k, store_v, offset,
                                                 length,

@@ -28,7 +28,7 @@ _NEG_INF = -1e30
 
 def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
                   page_size: int, num_queries: int, pages_per_seq: int,
-                  sm_scale: float, quantized: bool = False):
+                  sm_scale: float, quantized: bool = False, window=None):
     if quantized:  # int8 pools carry per-token scale pages
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -44,7 +44,12 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    @pl.when(j * page_size < total)
+    live = j * page_size < total
+    if window is not None:
+        # pages entirely below every query's window contribute nothing
+        live &= (j + 1) * page_size - 1 > offset - window
+
+    @pl.when(live)
     def _attend_page():
         q = q_ref[0, 0]          # (GT, D)
         k = k_ref[0]             # (page_size, D)
@@ -61,13 +66,20 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
             % num_queries
         k_pos = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (gt, page_size), 1)
-        s = jnp.where(k_pos <= offset + t, s, _NEG_INF)
+        mask = k_pos <= offset + t
+        if window is not None:
+            mask &= k_pos > offset + t - window
+        s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_ref[:, 0]
         l_prev = l_ref[:, 0]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
+        if window is not None:
+            # _NEG_INF is finite: fully-masked rows in early pages would
+            # otherwise get p = exp(-1e30 - -1e30) = 1
+            p = jnp.where(mask, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -84,7 +96,7 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
 
 def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
                            offset, length, k_scale=None, v_scale=None,
-                           interpret: bool = False):
+                           interpret: bool = False, window=None):
     """Cached attention over a paged pool.
 
     q: (B, Hq, T, D) new queries; flat_k/flat_v: (Hkv, num_pages *
@@ -110,11 +122,25 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
 
     kernel = functools.partial(_paged_kernel, page_size=page_size,
                                num_queries=T, pages_per_seq=pages_per_seq,
-                               sm_scale=sm_scale, quantized=quantized)
+                               sm_scale=sm_scale, quantized=quantized,
+                               window=int(window) if window is not None
+                               else None)
+
+    def page_lookup(b, j, len_ref, table_ref):
+        # Clamp out-of-band steps to the nearest in-band logical page: same
+        # physical index ⇒ the DMA is elided, so pages past the occupancy
+        # (and below the window band) are never fetched.
+        hi = jax.lax.div(len_ref[0] + page_size - 1, page_size)
+        j_eff = jnp.minimum(j, hi - 1)
+        if window is not None:
+            lo_pos = jnp.maximum(len_ref[0] - T - int(window) + 1, 0)
+            j_eff = jnp.maximum(j_eff, jax.lax.div(lo_pos, page_size))
+        return table_ref[b * pages_per_seq + j_eff]
+
     page_spec = pl.BlockSpec(
         (1, page_size, D),
         lambda b, h, j, len_ref, table_ref:
-            (h, table_ref[b * pages_per_seq + j], 0),
+            (h, page_lookup(b, j, len_ref, table_ref), 0),
         memory_space=pltpu.VMEM)
     in_specs = [
         pl.BlockSpec((1, 1, group * T, D),
@@ -128,7 +154,7 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
         scale_spec = pl.BlockSpec(
             (1, page_size, 1),
             lambda b, h, j, len_ref, table_ref:
-                (h, table_ref[b * pages_per_seq + j], 0),
+                (h, page_lookup(b, j, len_ref, table_ref), 0),
             memory_space=pltpu.VMEM)
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]

@@ -520,12 +520,11 @@ def test_decode_kernel_window_with_int8_scales_interpret():
                                    atol=3e-5, err_msg=f"offset={offset}")
 
 
-def test_window_rejects_unsupported_combos(monkeypatch):
-    """Paged cache and ring attention with a window must raise loudly, not
-    silently attend full causal."""
+def test_window_with_paged_cache_generates(monkeypatch):
+    """Paged cache + sliding window: windowed generation through the paged
+    pool must equal the contiguous-cache result at T=0."""
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
-    from penroz_tpu.ops import modules as M
     layers = [
         {"embedding": {"num_embeddings": 32, "embedding_dim": 16}},
         {"residual": [
@@ -537,16 +536,78 @@ def test_window_rejects_unsupported_combos(monkeypatch):
                 {"linear": {"in_features": 16, "out_features": 16}}]}]},
         {"linear": {"in_features": 16, "out_features": 32}},
         {"softmaxlast": {"dim": -1}}]
-    monkeypatch.setenv("PAGED_KV_CACHE", "1")
     model = NeuralNetworkModel("wcombo", Mapper(layers, {"sgd": {"lr": 0.1}}))
-    with pytest.raises(Exception, match="sliding_window"):
-        model.generate_tokens([[1, 2]], block_size=16, max_new_tokens=2,
-                              temperature=0.0)
+    plain = model.generate_tokens([[1, 2]], block_size=16, max_new_tokens=6,
+                                  temperature=0.0)
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    paged = model.generate_tokens([[1, 2]], block_size=16, max_new_tokens=6,
+                                  temperature=0.0)
+    assert paged == plain
 
-    # ring attention (sequence-parallel) + window: the guard fires before
-    # any mesh machinery, so a truthy sp_mesh sentinel suffices
+
+def test_window_rejects_ring_attention():
+    """Ring (sequence-parallel) attention with a window must raise loudly,
+    not silently attend full causal.  The guard fires before any mesh
+    machinery, so a truthy sp_mesh sentinel suffices."""
+    from penroz_tpu.ops import modules as M
     attn = M.CausalSelfAttention(num_heads=2, sliding_window=4, dropout=0.0)
     ctx = M.Ctx({}, sp_mesh=object())
     qkv = jnp.zeros((1, 8, 48), jnp.float32)
     with pytest.raises(ValueError, match="sliding_window"):
         attn.apply(qkv, ctx)
+
+
+def test_paged_kernel_int8_window_matches_oracle_interpret():
+    """int8 paged pool + sliding window: the scale pages must ride the SAME
+    clamped page lookup as K/V — a divergence would dequantize with wrong
+    per-token scales (this is the only combo exercising that branch)."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.ops.pallas import paged_attention as PA
+    rng = np.random.default_rng(43)
+    Hkv, D, page = 2, 64, 8
+    state = KV.QuantPagedKVState.create([(Hkv, D)], 1, 128, jnp.float32,
+                                        page_size=page)
+    fill = jnp.asarray(rng.normal(size=(1, Hkv, 90, D)).astype(np.float32))
+    state.append_rows(0, fill, fill * 0.3 - 0.5)
+    window = 16
+    for offset, T in [(89, 1), (40, 4)]:
+        q = jnp.asarray(rng.normal(size=(1, 4, T, D)).astype(np.float32))
+        off = jnp.asarray(offset, jnp.int32)
+        length = jnp.asarray(offset + T, jnp.int32)
+        ref = A.paged_cached_attention(
+            q, state.k[0], state.v[0], state.block_table, page, off, length,
+            platform="cpu", window=window,
+            k_scale=state.k_scale[0], v_scale=state.v_scale[0])
+        out = PA.paged_decode_attention(
+            q, state.k[0], state.v[0], state.block_table, page, off, length,
+            interpret=True, window=window,
+            k_scale=state.k_scale[0], v_scale=state.v_scale[0])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, err_msg=f"offset={offset}")
+
+
+def test_paged_kernel_window_matches_oracle_interpret():
+    """Windowed paged kernel (interpret) vs the dense-gather windowed
+    oracle, incl. occupancies where whole pages sit below the band."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.ops.pallas import paged_attention as PA
+    rng = np.random.default_rng(41)
+    Hkv, D, page = 2, 64, 8
+    state = KV.PagedKVState.create([(Hkv, D)], 1, 128, jnp.float32,
+                                   page_size=page)
+    fill = jnp.asarray(rng.normal(size=(1, Hkv, 100, D)).astype(np.float32))
+    state.append_rows(0, fill, fill * 0.5)
+    window = 16
+    for offset, T in [(99, 1), (50, 4)]:
+        q = jnp.asarray(rng.normal(size=(1, 4, T, D)).astype(np.float32))
+        off = jnp.asarray(offset, jnp.int32)
+        length = jnp.asarray(offset + T, jnp.int32)
+        ref = A.paged_cached_attention(
+            q, state.k[0], state.v[0], state.block_table, page, off, length,
+            platform="cpu", window=window)
+        out = PA.paged_decode_attention(
+            q, state.k[0], state.v[0], state.block_table, page, off, length,
+            interpret=True, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, err_msg=f"offset={offset}")

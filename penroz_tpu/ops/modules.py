@@ -768,12 +768,12 @@ class CausalSelfAttention(Module):
                                                 window=self.sliding_window,
                                                 **scales)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
-            if self.sliding_window is not None:
-                raise ValueError("sliding_window attention is not supported "
-                                 "with ring (sequence-parallel) attention")
-            # Sequence-parallel training: ring attention over ICI.
+            # Sequence-parallel training: ring attention over ICI (windowed
+            # when the model slides — long-context SP is exactly where
+            # windows matter).
             from penroz_tpu.parallel.ring_attention import ring_attention
-            out = ring_attention(q, k, v, ctx.sp_mesh, causal=True)
+            out = ring_attention(q, k, v, ctx.sp_mesh, causal=True,
+                                 window=self.sliding_window)
         else:
             out = attn_ops.causal_attention(q, k, v, dropout_rate=dropout_rate,
                                             dropout_rng=dropout_rng,

@@ -32,7 +32,8 @@ from penroz_tpu.parallel.mesh import SEQ_AXIS
 _NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          window=None):
     """Per-shard body. q/k/v: (B, H, T_local, D) — the local blocks."""
     B, Hq, Tl, D = q.shape
     Hkv = k.shape[1]
@@ -43,6 +44,15 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
 
     qg = q.reshape(B, Hkv, group, Tl, D)
     q_pos = my_idx * Tl + jnp.arange(Tl, dtype=jnp.int32)
+    # A static window bounds how many ring steps can contribute: step i
+    # brings the K block i hops back, and blocks more than
+    # ceil((window-1)/Tl) hops back lie entirely below every local row's
+    # band (on every device — steps beyond the bound are acausal for the
+    # low-index devices anyway), so the rotation stops there.  Uniform
+    # SPMD: the count is the same on all devices.
+    num_steps = n
+    if window is not None:
+        num_steps = min(n, -(-(window - 1) // Tl) + 1)
 
     def step(i, carry):
         m, l, acc, k_cur, v_cur = carry
@@ -53,6 +63,13 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
                        preferred_element_type=jnp.float32) * scale
         if causal:
             mask = k_pos[None, :] <= q_pos[:, None]
+            if window is not None:
+                # sliding band; ring steps whose K block lies fully outside
+                # a row's window leave that row at m == _NEG_INF, and the
+                # online rescaling (alpha -> 0 once a live block arrives —
+                # each row's own position is always in-band) cancels the
+                # uniform exp(0) contribution those steps would add.
+                mask &= k_pos[None, :] > q_pos[:, None] - window
             s = jnp.where(mask[None, None, None], s, _NEG_INF)
         s_max = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, s_max)
@@ -78,7 +95,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     # carry type matches what the ring rotation produces.
     m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,),
                                  to="varying")
-    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    m, l, acc, _, _ = jax.lax.fori_loop(0, num_steps, step,
+                                        (m0, l0, acc0, k, v))
 
     l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l[..., None]).astype(q.dtype)
@@ -86,15 +104,22 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
-                   axis_name: str = SEQ_AXIS):
+                   axis_name: str = SEQ_AXIS, window=None):
     """Sequence-parallel attention over ``mesh``'s sequence axis.
 
     q: (B, Hq, T, D); k/v: (B, Hkv, T, D), all sharded (or shardable) on the
     T dimension.  Returns attention output with the same sharding.
+    ``window``: sliding-window width — query t attends keys in
+    ``(t - window, t]`` (same band as the flash kernels); requires
+    ``causal=True`` (a bidirectional band has no defined semantics here).
     """
+    if window is not None and not causal:
+        raise ValueError("ring_attention window requires causal=True")
     spec = P(None, None, axis_name, None)
     body = functools.partial(_ring_attention_local, axis_name=axis_name,
-                             causal=causal)
+                             causal=causal,
+                             window=int(window) if window is not None
+                             else None)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(q, k, v)

@@ -546,16 +546,6 @@ def test_window_with_paged_cache_generates(monkeypatch):
     assert paged == plain
 
 
-def test_window_rejects_ring_attention():
-    """Ring (sequence-parallel) attention with a window must raise loudly,
-    not silently attend full causal.  The guard fires before any mesh
-    machinery, so a truthy sp_mesh sentinel suffices."""
-    from penroz_tpu.ops import modules as M
-    attn = M.CausalSelfAttention(num_heads=2, sliding_window=4, dropout=0.0)
-    ctx = M.Ctx({}, sp_mesh=object())
-    qkv = jnp.zeros((1, 8, 48), jnp.float32)
-    with pytest.raises(ValueError, match="sliding_window"):
-        attn.apply(qkv, ctx)
 
 
 def test_paged_kernel_int8_window_matches_oracle_interpret():

@@ -363,6 +363,27 @@ def test_compute_output_flat_tokens_clear_error(workdir, toy_gpt_layers):
     assert cost is None and len(out) == 1
 
 
+def test_generate_dispatch_count(workdir, toy_gpt_layers, monkeypatch):
+    """96 tokens at budget 128 must cost exactly ONE prefill + ONE chunk
+    dispatch (pow-2 ceiling with overshoot), not a descending pow-2
+    cascade — each extra dispatch is a full device round-trip."""
+    model = NeuralNetworkModel("gdc", Mapper(toy_gpt_layers, SGD))
+    calls = []
+    orig = type(model.arch).decode_chunk
+
+    def counting(self, *a, chunk, **kw):
+        calls.append(chunk)
+        return orig(self, *a, chunk=chunk, **kw)
+
+    monkeypatch.setattr(type(model.arch), "decode_chunk", counting)
+    monkeypatch.setenv("PENROZ_DECODE_CHUNK", "128")  # pin the budget
+    # block_size leaves room for the 128 ceiling (prompt occupies 2 slots)
+    tokens = model.generate_tokens([[1, 2]], block_size=256,
+                                   max_new_tokens=96, temperature=0.0)
+    assert len(tokens) == 98
+    assert calls == [128]  # one chunk dispatch, 33 overshot steps discarded
+
+
 def test_generate_tail_overshoot_chunking(workdir, toy_gpt_layers,
                                           monkeypatch):
     """A tail shorter than its pow-2 ceiling dispatches the ceiling chunk

@@ -21,6 +21,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
+# If a sitecustomize imported jax before this conftest ran, the env write
+# above came too late (jax captured JAX_PLATFORMS at import).  Forcing the
+# config value makes the CPU pin effective either way.
+jax.config.update("jax_platforms", "cpu")
+
 # Persistent compilation cache: repeat test runs skip XLA recompiles.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

@@ -229,7 +229,8 @@ class CompiledArch:
 
     def train_epoch_fn(self, optimizer_config: dict, num_steps: int,
                        remat: bool = False, compute_dtype=None, sp_mesh=None,
-                       platform=None, with_ratios: bool = True):
+                       platform=None, with_ratios: bool = True,
+                       out_shardings=None):
         """One jitted epoch: ``num_steps`` grad-accumulation micro-steps via
         ``lax.scan`` then a single optax update (reference hot loop:
         neural_net_model.py:614-677; sync deferred to the final micro-step is
@@ -244,10 +245,24 @@ class CompiledArch:
         reference only needs them on progress-sampled epochs
         (neural_net_model.py:686-700), so the hot loop shouldn't pay them
         every step; the skipping variant returns ``ratios=None``.
+
+        ``out_shardings=(param_shardings, opt_shardings)`` pins the updated
+        params/optimizer state to the given layouts via
+        ``with_sharding_constraint``.  Without the pin, GSPMD propagates
+        whatever layout the update math ran in into the outputs — under
+        ZeRO-1 weight-update sharding (``PENROZ_WUS=1``) that would leave
+        the fresh params data-sharded instead of forcing the all-gather
+        back to the parameter layout, changing their aval between epochs
+        (recompile every call) and leaving cross-host-sharded params behind
+        after training.
         """
+        shard_key = None
+        if out_shardings is not None:
+            shard_key = (tuple(sorted(out_shardings[0].items())),
+                         tuple(jax.tree.leaves(out_shardings[1])))
         key = ("epoch", json.dumps(optimizer_config, sort_keys=True),
                int(num_steps), bool(remat), str(compute_dtype), sp_mesh,
-               platform, bool(with_ratios))
+               platform, bool(with_ratios), shard_key)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -300,6 +315,11 @@ class CompiledArch:
                 lambda g, p: (g * inv).astype(p.dtype), grads, params)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if out_shardings is not None:
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, out_shardings[0])
+                new_opt_state = jax.lax.with_sharding_constraint(
+                    new_opt_state, out_shardings[1])
             if not with_ratios:
                 return new_params, new_opt_state, new_buffers, cost, None
             # per-weight update ratio std(Δw)/std(w) (reference :686-700)
@@ -622,25 +642,39 @@ class NeuralNetworkModel:
                 self.serialize()
             mesh = self._training_mesh(batch_size, block_size)
             sp_mesh = None
+            epoch_out_shardings = None
             if mesh is not None:
                 log.info("Training over device mesh %s", dict(mesh.shape))
                 self.params = sharding_lib.shard_params(self.params, mesh)
                 # Optimizer moments follow the parameter TP layout so no
                 # host ever holds the full state (sharded checkpointing).
-                self.opt_state = jax.device_put(
-                    self.opt_state,
+                # PENROZ_WUS=1 additionally spreads them over the data axis
+                # (ZeRO-1 weight-update sharding, arXiv:2004.13336): each DP
+                # replica keeps 1/data of the moments and updates only its
+                # slice of the weights; the epoch fn's out_shardings pin
+                # then forces the all-gather back to the parameter layout.
+                wus = os.environ.get("PENROZ_WUS", "0") == "1"
+                epoch_out_shardings = (
+                    sharding_lib.param_shardings(self.params, mesh),
                     sharding_lib.opt_state_sharding_tree(self.opt_state,
-                                                         self.params, mesh))
+                                                         self.params, mesh,
+                                                         wus=wus))
+                self.opt_state = jax.device_put(self.opt_state,
+                                                epoch_out_shardings[1])
                 self.buffers = jax.device_put(self.buffers,
                                               mesh_lib.replicated(mesh))
                 if mesh.shape[mesh_lib.SEQ_AXIS] > 1:
                     sp_mesh = mesh
-            # With cross-host-sharded params every process must persist its
+            # With cross-host-sharded state every process must persist its
             # own shard file at each checkpoint; the master also writes the
             # metadata blob (serialize() handles the split internally).
+            # Checked over ALL persisted items — under PENROZ_WUS the params
+            # stay host-readable but the optimizer moments are cross-host
+            # data-sharded and need the same shard-file treatment.
             saves_shards = (mesh is not None and world > 1
                             and not all(self._is_host_readable(v)
-                                        for v in self.params.values()))
+                                        for v in
+                                        self._checkpoint_items().values()))
             # PENROZ_REMAT=1 rematerializes the forward inside the backward
             # (jax.checkpoint) — trades ~1/3 more FLOPs for activation memory,
             # the lever for configs that would otherwise exceed HBM.
@@ -660,11 +694,11 @@ class NeuralNetworkModel:
                 compute_dtype = jnp.bfloat16
             else:
                 compute_dtype = None
-            epoch_fn = self.arch.train_epoch_fn(self.optimizer_config,
-                                                num_steps, remat=remat,
-                                                compute_dtype=compute_dtype,
-                                                sp_mesh=sp_mesh,
-                                                platform=self._platform)
+            epoch_fn = self.arch.train_epoch_fn(
+                self.optimizer_config, num_steps, remat=remat,
+                compute_dtype=compute_dtype, sp_mesh=sp_mesh,
+                platform=self._platform,
+                out_shardings=epoch_out_shardings)
             # Non-sampled epochs skip the two full parameter passes the
             # update-ratio stds cost.  The choice is a pure function of the
             # epoch index so every host runs the same compiled program
@@ -676,7 +710,8 @@ class NeuralNetworkModel:
                                          compute_dtype=compute_dtype,
                                          sp_mesh=sp_mesh,
                                          platform=self._platform,
-                                         with_ratios=False)
+                                         with_ratios=False,
+                                         out_shardings=epoch_out_shardings)
                 if sample_every > 1 else epoch_fn)
             rng = jax.random.key(0)
             last_save = time.monotonic()

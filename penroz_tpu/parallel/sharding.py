@@ -105,7 +105,33 @@ def global_batch(batch, mesh: Mesh, *, leading_steps: bool = False,
         sharding, np.asarray(batch), tuple(global_shape))
 
 
-def opt_state_sharding_tree(opt_state, params: dict, mesh: Mesh):
+def _wus_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Add the ``data`` axis to a moment leaf's spec on the first dim the
+    param layout leaves unsharded (ZeRO-1 / XLA weight-update sharding,
+    Xu et al. 2020, arXiv:2004.13336): the optimizer moments — which DP
+    otherwise replicates — are distributed over the data axis and each
+    replica updates only its slice of the weights.  The training step must
+    pin its param outputs back to the parameter layout
+    (``train_epoch_fn(out_shardings=...)``) — that pin is what makes XLA
+    all-gather the fresh params; without it GSPMD propagates the moment
+    sharding into them."""
+    if mesh.shape[DATA_AXIS] <= 1 or not shape:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for dim, axis in enumerate(entries):
+        # A dim is free if unsharded OR held by a trivial size-1 axis
+        # (param_spec emits e.g. P('model', None) even when model=1; the
+        # size-1 partition is a no-op, so the moments may claim the dim).
+        free = axis is None or (isinstance(axis, str)
+                                and mesh.shape[axis] == 1)
+        if free and _divides(shape[dim], mesh, DATA_AXIS):
+            entries[dim] = DATA_AXIS
+            return P(*entries)
+    return spec
+
+
+def opt_state_sharding_tree(opt_state, params: dict, mesh: Mesh,
+                            wus: bool = False):
     """Sharding pytree for an optax state matching the param layout.
 
     optax moment trees (e.g. AdamW's ``mu``/``nu``) mirror the flat param
@@ -114,6 +140,14 @@ def opt_state_sharding_tree(opt_state, params: dict, mesh: Mesh):
     (step counts) and anything unrecognized stay replicated.  Keeping the
     moments sharded like the weights is what makes TP across hosts
     checkpointable — no host ever needs the full optimizer state.
+
+    ``wus=True`` additionally shards every moment leaf over the ``data``
+    axis on a dim the param layout leaves free (ZeRO-1 weight-update
+    sharding): under pure DP this cuts optimizer memory by the data-axis
+    size and distributes the update math, at the cost of an all-gather of
+    the fresh params per optimizer step.  Pair it with
+    ``train_epoch_fn(out_shardings=(param_shardings, this tree))`` so the
+    updated params are pinned back to the parameter layout.
     """
     import jax
     from jax.tree_util import DictKey
@@ -127,7 +161,10 @@ def opt_state_sharding_tree(opt_state, params: dict, mesh: Mesh):
         for entry in reversed(path):
             if (isinstance(entry, DictKey) and entry.key in pspecs
                     and shape == tuple(params[entry.key].shape)):
-                return NamedSharding(mesh, pspecs[entry.key])
+                spec = pspecs[entry.key]
+                if wus:
+                    spec = _wus_spec(spec, shape, mesh)
+                return NamedSharding(mesh, spec)
         return repl
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, opt_state)

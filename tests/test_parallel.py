@@ -272,6 +272,74 @@ def test_global_batch_multihost_lifts_local_rows(cpu_devices, monkeypatch):
     assert captured["sharding"].spec == P(None, "data", None)
 
 
+def test_wus_opt_state_specs(cpu_devices):
+    """ZeRO-1 weight-update sharding (arXiv:2004.13336): moment leaves gain
+    the data axis on a dim the TP layout leaves free; indivisible shapes and
+    step counters stay replicated."""
+    import optax
+    mesh = mesh_lib.make_mesh(cpu_devices, model=2)  # data=4, model=2
+    params = {"w.qkv": jnp.zeros((96, 32)),   # column-parallel
+              "w.sq": jnp.zeros((32, 32)),    # replicated square
+              "w.b": jnp.zeros((32,)),        # vector
+              "w.odd": jnp.zeros((33, 7))}    # indivisible
+    state = optax.adamw(1e-3).init(params)
+    tree = sharding.opt_state_sharding_tree(state, params, mesh, wus=True)
+    mu = tree[0].mu
+    assert mu["w.qkv"].spec == P("model", "data")
+    assert mu["w.sq"].spec == P("data", None)
+    assert mu["w.b"].spec == P("data")
+    assert mu["w.odd"].spec == P()
+    # the scalar step count stays replicated
+    assert tree[0].count.spec == P()
+    # wus=False keeps the round-1 behavior (TP layout only)
+    tree_off = sharding.opt_state_sharding_tree(state, params, mesh)
+    assert tree_off[0].mu["w.sq"].spec == P()
+    # a dim held by a trivial size-1 model axis is free for the data axis
+    # (pure-DP mesh: param_spec still emits P('model', None) there)
+    dp_mesh = mesh_lib.make_mesh(cpu_devices)  # data=8, model=1
+    assert sharding._wus_spec(sharding.param_spec("w.q", (16, 4), dp_mesh),
+                              (16, 4), dp_mesh) == P("data", None)
+
+
+def test_train_model_wus_matches_replicated(workdir, toy_gpt_layers,
+                                            toy_shards, monkeypatch):
+    """PENROZ_WUS=1 training == replicated-moment training numerically
+    (same mesh, so gradient reduction order is identical and the only
+    change is where the elementwise AdamW update runs), while each device
+    holds only 1/data of the moments."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"adamw": {"lr": 1e-3, "betas": [0.9, 0.95], "eps": 1e-8}}
+    wus = NeuralNetworkModel("wus8",
+                             Mapper(toy_gpt_layers, optim)).to_device("cpu")
+    plain = NeuralNetworkModel("wusoff",
+                               Mapper(toy_gpt_layers, optim)).to_device("cpu")
+    monkeypatch.setenv("PENROZ_WUS", "1")
+    wus.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                    step_size=8)
+    monkeypatch.delenv("PENROZ_WUS")
+    plain.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                      step_size=8)
+    assert wus.status["code"] == "Trained"
+    for k in wus.params:
+        np.testing.assert_allclose(np.asarray(wus.params[k], np.float32),
+                                   np.asarray(plain.params[k], np.float32),
+                                   atol=1e-5)
+    # the out_shardings pin forced the fresh params back to the parameter
+    # layout — without it GSPMD leaves them data-sharded after the update
+    assert all(v.sharding.is_fully_replicated for v in wus.params.values())
+    # moments stayed data-sharded through the donating epoch calls: each
+    # device's shard of a divisible moment leaf is 1/8 of the full array
+    mu = jax.tree.leaves(wus.opt_state)
+    sharded = [leaf for leaf in mu
+               if hasattr(leaf, "sharding") and leaf.ndim >= 1
+               and "data" in (leaf.sharding.spec or ())]
+    assert sharded, "no moment leaf kept the data axis"
+    for leaf in sharded:
+        shard = leaf.addressable_shards[0]
+        assert np.prod(shard.data.shape) == leaf.size // 8
+
+
 def test_multihost_training_mesh(workdir, toy_gpt_layers, monkeypatch):
     """process_count>1 yields a global mesh; the TP/SP/EP env knobs carve
     axes out of the global device set (sharded checkpointing lifted the

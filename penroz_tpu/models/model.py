@@ -645,17 +645,20 @@ class NeuralNetworkModel:
             epoch_out_shardings = None
             if mesh is not None:
                 log.info("Training over device mesh %s", dict(mesh.shape))
-                self.params = sharding_lib.shard_params(self.params, mesh)
-                # Optimizer moments follow the parameter TP layout so no
-                # host ever holds the full state (sharded checkpointing).
-                # PENROZ_WUS=1 additionally spreads them over the data axis
-                # (ZeRO-1 weight-update sharding, arXiv:2004.13336): each DP
-                # replica keeps 1/data of the moments and updates only its
-                # slice of the weights; the epoch fn's out_shardings pin
-                # then forces the all-gather back to the parameter layout.
-                wus = os.environ.get("PENROZ_WUS", "0") == "1"
+                # ZeRO ladder on top of the TP layout (arXiv:2004.13336):
+                # PENROZ_WUS=1 spreads the optimizer moments over the data
+                # axis (each DP replica updates 1/data of the weights);
+                # PENROZ_FSDP=1 also shards the params themselves (ZeRO-3 —
+                # XLA all-gathers each weight just-in-time per matmul).
+                # The epoch fn's out_shardings pin keeps both layouts
+                # stable across steps instead of whatever GSPMD propagates.
+                fsdp = os.environ.get("PENROZ_FSDP", "0") == "1"
+                wus = fsdp or os.environ.get("PENROZ_WUS", "0") == "1"
+                self.params = sharding_lib.shard_params(self.params, mesh,
+                                                        fsdp=fsdp)
                 epoch_out_shardings = (
-                    sharding_lib.param_shardings(self.params, mesh),
+                    sharding_lib.param_shardings(self.params, mesh,
+                                                 fsdp=fsdp),
                     sharding_lib.opt_state_sharding_tree(self.opt_state,
                                                          self.params, mesh,
                                                          wus=wus))
@@ -668,9 +671,11 @@ class NeuralNetworkModel:
             # With cross-host-sharded state every process must persist its
             # own shard file at each checkpoint; the master also writes the
             # metadata blob (serialize() handles the split internally).
-            # Checked over ALL persisted items — under PENROZ_WUS the params
-            # stay host-readable but the optimizer moments are cross-host
-            # data-sharded and need the same shard-file treatment.
+            # Checked over ALL persisted items: under PENROZ_WUS only the
+            # optimizer moments are cross-host data-sharded (params stay
+            # host-readable), and under PENROZ_FSDP the params are too —
+            # both need the shard-file treatment, so a params-only check
+            # would tear either checkpoint.
             saves_shards = (mesh is not None and world > 1
                             and not all(self._is_host_readable(v)
                                         for v in

@@ -51,15 +51,30 @@ def param_spec(key: str, shape: tuple, mesh: Mesh) -> P:
     return P()
 
 
-def param_shardings(params: dict, mesh: Mesh) -> dict:
-    return {k: NamedSharding(mesh, param_spec(k, tuple(v.shape), mesh))
-            for k, v in params.items()}
+def param_shardings(params: dict, mesh: Mesh, fsdp: bool = False) -> dict:
+    """NamedShardings for a flat param dict under the TP layout.
+
+    ``fsdp=True`` (ZeRO-3 / fully-sharded data parallel) additionally
+    spreads every param over the ``data`` axis on a dim the TP layout
+    leaves free — XLA all-gathers each weight just-in-time for its matmul
+    and discards it after, so per-device param memory drops by the
+    data-axis size.  Pair with ``opt_state_sharding_tree(wus=True)`` (the
+    moments follow the same rule) and pin the training step's outputs via
+    ``train_epoch_fn(out_shardings=...)``.
+    """
+    out = {}
+    for k, v in params.items():
+        spec = param_spec(k, tuple(v.shape), mesh)
+        if fsdp:
+            spec = _data_axis_spec(spec, tuple(v.shape), mesh)
+        out[k] = NamedSharding(mesh, spec)
+    return out
 
 
-def shard_params(params: dict, mesh: Mesh) -> dict:
-    """Place a flat param dict onto the mesh under the TP layout."""
+def shard_params(params: dict, mesh: Mesh, fsdp: bool = False) -> dict:
+    """Place a flat param dict onto the mesh under the TP (+FSDP) layout."""
     import jax
-    shardings = param_shardings(params, mesh)
+    shardings = param_shardings(params, mesh, fsdp=fsdp)
     return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
 
 
@@ -105,16 +120,23 @@ def global_batch(batch, mesh: Mesh, *, leading_steps: bool = False,
         sharding, np.asarray(batch), tuple(global_shape))
 
 
-def _wus_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
-    """Add the ``data`` axis to a moment leaf's spec on the first dim the
-    param layout leaves unsharded (ZeRO-1 / XLA weight-update sharding,
-    Xu et al. 2020, arXiv:2004.13336): the optimizer moments — which DP
-    otherwise replicates — are distributed over the data axis and each
-    replica updates only its slice of the weights.  The training step must
-    pin its param outputs back to the parameter layout
-    (``train_epoch_fn(out_shardings=...)``) — that pin is what makes XLA
-    all-gather the fresh params; without it GSPMD propagates the moment
-    sharding into them."""
+def _data_axis_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Add the ``data`` axis to a spec on the first dim the TP layout
+    leaves free — the ZeRO sharding rule (Xu et al. 2020,
+    arXiv:2004.13336), applied to BOTH sides of the ladder:
+
+    - optimizer moments (``opt_state_sharding_tree(wus=True)``, ZeRO-1):
+      each DP replica stores 1/data of the moments and updates only its
+      slice of the weights;
+    - the params themselves (``param_shardings(fsdp=True)``, ZeRO-3):
+      1/data per device as the persistent layout, all-gathered
+      just-in-time per matmul.
+
+    Param and moment specs MUST stay identical for a given leaf (the
+    update math is elementwise across them); both callers route through
+    this one function to keep that invariant.  The training step pins its
+    outputs to these layouts via ``train_epoch_fn(out_shardings=...)`` —
+    without the pin GSPMD propagates whatever the update ran in."""
     if mesh.shape[DATA_AXIS] <= 1 or not shape:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
@@ -163,7 +185,7 @@ def opt_state_sharding_tree(opt_state, params: dict, mesh: Mesh,
                     and shape == tuple(params[entry.key].shape)):
                 spec = pspecs[entry.key]
                 if wus:
-                    spec = _wus_spec(spec, shape, mesh)
+                    spec = _data_axis_spec(spec, shape, mesh)
                 return NamedSharding(mesh, spec)
         return repl
 

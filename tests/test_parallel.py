@@ -297,7 +297,7 @@ def test_wus_opt_state_specs(cpu_devices):
     # a dim held by a trivial size-1 model axis is free for the data axis
     # (pure-DP mesh: param_spec still emits P('model', None) there)
     dp_mesh = mesh_lib.make_mesh(cpu_devices)  # data=8, model=1
-    assert sharding._wus_spec(sharding.param_spec("w.q", (16, 4), dp_mesh),
+    assert sharding._data_axis_spec(sharding.param_spec("w.q", (16, 4), dp_mesh),
                               (16, 4), dp_mesh) == P("data", None)
 
 
@@ -338,6 +338,63 @@ def test_train_model_wus_matches_replicated(workdir, toy_gpt_layers,
     for leaf in sharded:
         shard = leaf.addressable_shards[0]
         assert np.prod(shard.data.shape) == leaf.size // 8
+
+
+def test_fsdp_param_specs(cpu_devices):
+    """ZeRO-3: params themselves gain the data axis on a free dim; TP dims
+    are preserved; indivisible shapes stay as the TP layout alone."""
+    mesh = mesh_lib.make_mesh(cpu_devices, model=2)  # data=4, model=2
+    params = {"w.qkv": jnp.zeros((96, 32)), "w.sq": jnp.zeros((32, 32)),
+              "w.b": jnp.zeros((32,)), "w.odd": jnp.zeros((33, 7))}
+    sh = sharding.param_shardings(params, mesh, fsdp=True)
+    assert sh["w.qkv"].spec == P("model", "data")
+    assert sh["w.sq"].spec == P("data", None)
+    assert sh["w.b"].spec == P("data")
+    assert sh["w.odd"].spec == P()
+    # fsdp=False unchanged
+    assert sharding.param_shardings(params, mesh)["w.sq"].spec == P()
+
+
+def test_train_model_fsdp_matches_replicated(workdir, toy_gpt_layers,
+                                             toy_shards, monkeypatch):
+    """PENROZ_FSDP=1 training == replicated training numerically, with the
+    params themselves living 1/data-sharded on device."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"adamw": {"lr": 1e-3, "betas": [0.9, 0.95], "eps": 1e-8}}
+    fsdp = NeuralNetworkModel("fsdp8",
+                              Mapper(toy_gpt_layers, optim)).to_device("cpu")
+    plain = NeuralNetworkModel("fsdpoff",
+                               Mapper(toy_gpt_layers, optim)).to_device("cpu")
+    monkeypatch.setenv("PENROZ_FSDP", "1")
+    fsdp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                     step_size=8)
+    monkeypatch.delenv("PENROZ_FSDP")
+    plain.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                      step_size=8)
+    assert fsdp.status["code"] == "Trained"
+    for k in fsdp.params:
+        np.testing.assert_allclose(np.asarray(fsdp.params[k], np.float32),
+                                   np.asarray(plain.params[k], np.float32),
+                                   atol=1e-5)
+    # the params stayed FSDP-sharded (not replicated back): divisible leaves
+    # hold 1/8 per device
+    sharded = [v for v in fsdp.params.values()
+               if v.ndim >= 1 and not v.sharding.is_fully_replicated]
+    assert sharded, "no param leaf is data-sharded under FSDP"
+    for v in sharded:
+        assert v.addressable_shards[0].data.size == v.size // 8
+    # FSDP implies WUS: the AdamW moments are 1/data-sharded as well
+    assert any(getattr(leaf, "ndim", 0) >= 1
+               and not leaf.sharding.is_fully_replicated
+               for leaf in jax.tree.leaves(fsdp.opt_state)), \
+        "FSDP did not shard the optimizer moments (implied WUS lost)"
+    # serialize → deserialize reassembles full arrays regardless
+    fsdp.serialize(sync_flush=True)
+    restored = NeuralNetworkModel.deserialize("fsdp8")
+    for k in fsdp.params:
+        np.testing.assert_array_equal(np.asarray(restored.params[k]),
+                                      np.asarray(fsdp.params[k]))
 
 
 def test_multihost_training_mesh(workdir, toy_gpt_layers, monkeypatch):

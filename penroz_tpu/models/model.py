@@ -147,10 +147,10 @@ class CompiledArch:
 
     def _apply(self, params, buffers, x, *, training=False, rng=None, kv=None,
                pos_offset=None, skip_softmax=False, compute_dtype=None,
-               sp_mesh=None, platform=None):
+               sp_mesh=None, platform=None, sp_mode="ring"):
         ctx = M.Ctx(params, buffers, training=training, rng=rng, kv=kv,
                     pos_offset=pos_offset, compute_dtype=compute_dtype,
-                    sp_mesh=sp_mesh, platform=platform)
+                    sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode)
         acts = []
         h = x
         logits = None
@@ -182,7 +182,7 @@ class CompiledArch:
     def forward(self, params, buffers, tokens, targets=None, *,
                 training=False, rng=None, kv=None, pos_offset=None,
                 skip_softmax=False, compute_dtype=None, sp_mesh=None,
-                platform=None):
+                platform=None, sp_mode="ring"):
         """Full forward collecting every top-level activation.
 
         Returns ``(activations, cost, buffer_updates, new_kv)``; ``cost`` is
@@ -191,7 +191,8 @@ class CompiledArch:
         acts, logits, ctx = self._apply(
             params, buffers, tokens, training=training, rng=rng, kv=kv,
             pos_offset=pos_offset, skip_softmax=skip_softmax,
-            compute_dtype=compute_dtype, sp_mesh=sp_mesh, platform=platform)
+            compute_dtype=compute_dtype, sp_mesh=sp_mesh, platform=platform,
+            sp_mode=sp_mode)
         cost = (self._cost_from_logits(logits, targets, platform=platform)
                 if targets is not None else None)
         if cost is not None and ctx.aux_losses:
@@ -230,7 +231,7 @@ class CompiledArch:
     def train_epoch_fn(self, optimizer_config: dict, num_steps: int,
                        remat: bool = False, compute_dtype=None, sp_mesh=None,
                        platform=None, with_ratios: bool = True,
-                       out_shardings=None):
+                       out_shardings=None, sp_mode: str = "ring"):
         """One jitted epoch: ``num_steps`` grad-accumulation micro-steps via
         ``lax.scan`` then a single optax update (reference hot loop:
         neural_net_model.py:614-677; sync deferred to the final micro-step is
@@ -262,7 +263,7 @@ class CompiledArch:
                          tuple(jax.tree.leaves(out_shardings[1])))
         key = ("epoch", json.dumps(optimizer_config, sort_keys=True),
                int(num_steps), bool(remat), str(compute_dtype), sp_mesh,
-               platform, bool(with_ratios), shard_key)
+               platform, bool(with_ratios), shard_key, sp_mode)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -273,7 +274,7 @@ class CompiledArch:
             _, cost, buf_upd, _ = self.forward(
                 params, buffers, x, y, training=True, rng=rng,
                 skip_softmax=True, compute_dtype=compute_dtype,
-                sp_mesh=sp_mesh, platform=platform)
+                sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode)
             return cost, buf_upd
 
         if remat:
@@ -699,11 +700,29 @@ class NeuralNetworkModel:
                 compute_dtype = jnp.bfloat16
             else:
                 compute_dtype = None
+            # PENROZ_SP_MODE selects the sequence-parallel attention:
+            # 'ring' (ppermute rotation, default) or 'alltoall' (Ulysses
+            # head re-partitioning; needs heads divisible by the axis).
+            sp_mode = os.environ.get("PENROZ_SP_MODE", "ring")
+            if sp_mode not in ("ring", "alltoall"):
+                raise ValueError(f"PENROZ_SP_MODE={sp_mode!r}; expected "
+                                 "'ring' or 'alltoall'")
+            if sp_mode == "alltoall" and sp_mesh is not None:
+                from penroz_tpu.parallel import alltoall_attention as a2a
+                undiv = [i for i, mod in enumerate(self.arch.attn_layers)
+                         if not a2a.alltoall_supported(
+                             mod.num_heads, mod.num_kv_heads, sp_mesh)]
+                if undiv:
+                    log.warning(
+                        "PENROZ_SP_MODE=alltoall: attention layer(s) %s "
+                        "have head counts not divisible by the sequence "
+                        "axis (%d) and fall back to ring attention",
+                        undiv, sp_mesh.shape[mesh_lib.SEQ_AXIS])
             epoch_fn = self.arch.train_epoch_fn(
                 self.optimizer_config, num_steps, remat=remat,
                 compute_dtype=compute_dtype, sp_mesh=sp_mesh,
                 platform=self._platform,
-                out_shardings=epoch_out_shardings)
+                out_shardings=epoch_out_shardings, sp_mode=sp_mode)
             # Non-sampled epochs skip the two full parameter passes the
             # update-ratio stds cost.  The choice is a pure function of the
             # epoch index so every host runs the same compiled program
@@ -716,7 +735,8 @@ class NeuralNetworkModel:
                                          sp_mesh=sp_mesh,
                                          platform=self._platform,
                                          with_ratios=False,
-                                         out_shardings=epoch_out_shardings)
+                                         out_shardings=epoch_out_shardings,
+                                         sp_mode=sp_mode)
                 if sample_every > 1 else epoch_fn)
             rng = jax.random.key(0)
             last_save = time.monotonic()

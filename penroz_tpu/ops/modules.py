@@ -47,7 +47,7 @@ class Ctx:
 
     def __init__(self, params, buffers=None, *, training=False, rng=None,
                  kv=None, pos_offset=None, compute_dtype=None, sp_mesh=None,
-                 platform=None):
+                 platform=None, sp_mode="ring"):
         self.params = params
         self.buffers = buffers or {}
         self.training = training
@@ -55,7 +55,8 @@ class Ctx:
         self.kv = kv  # ops.kv_cache.KVState or None
         self.pos_offset = pos_offset  # scalar int32 array or None
         self.compute_dtype = compute_dtype
-        self.sp_mesh = sp_mesh  # Mesh with a >1 'sequence' axis → ring attn
+        self.sp_mesh = sp_mesh  # Mesh with a >1 'sequence' axis → SP attn
+        self.sp_mode = sp_mode  # 'ring' (ppermute) | 'alltoall' (Ulysses)
         self.platform = platform  # execution platform hint for kernel gates
         self.buffer_updates = {}
         self.aux_losses = []  # auxiliary training losses (e.g. MoE balance)
@@ -768,12 +769,24 @@ class CausalSelfAttention(Module):
                                                 window=self.sliding_window,
                                                 **scales)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
-            # Sequence-parallel training: ring attention over ICI (windowed
-            # when the model slides — long-context SP is exactly where
-            # windows matter).
+            # Sequence-parallel training over ICI (windowed when the model
+            # slides — long-context SP is exactly where windows matter).
+            # Two modes: 'ring' rotates K/V via ppermute; 'alltoall'
+            # (Ulysses) re-partitions seq→head sharding so each device runs
+            # the ordinary fused kernel on the full sequence for its heads
+            # (falls back to ring when heads don't divide the axis).
+            from penroz_tpu.parallel import alltoall_attention as a2a
             from penroz_tpu.parallel.ring_attention import ring_attention
-            out = ring_attention(q, k, v, ctx.sp_mesh, causal=True,
-                                 window=self.sliding_window)
+            if (ctx.sp_mode == "alltoall"
+                    and a2a.alltoall_supported(q.shape[1], k.shape[1],
+                                               ctx.sp_mesh)):
+                out = a2a.alltoall_attention(q, k, v, ctx.sp_mesh,
+                                             causal=True,
+                                             window=self.sliding_window,
+                                             platform=ctx.platform)
+            else:
+                out = ring_attention(q, k, v, ctx.sp_mesh, causal=True,
+                                     window=self.sliding_window)
         else:
             out = attn_ops.causal_attention(q, k, v, dropout_rate=dropout_rate,
                                             dropout_rng=dropout_rng,

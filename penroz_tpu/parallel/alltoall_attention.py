@@ -1,0 +1,87 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second SP mode next to ring attention (``PENROZ_SP_MODE=alltoall``,
+DeepSpeed-Ulysses, arXiv:2309.14509 pattern): instead of rotating K/V blocks
+around the ring, one ``lax.all_to_all`` re-partitions the activations from
+sequence-sharded to **head**-sharded — each device then holds the FULL
+sequence for ``H/n`` heads, runs ordinary causal attention locally (the
+Pallas flash kernel on TPU), and a second all-to-all restores sequence
+sharding.  Communication volume is two all-to-alls of the activations,
+independent of the number of ring steps, which favors meshes whose
+sequence axis is large relative to the per-step compute; ring attention
+keeps peak activation memory at O(T/n) and wins when T/n·T/n scores
+dominate, so both modes stay available.
+
+The reference has no long-context support at all (SURVEY.md §5); like ring
+attention this is an extension point, not a parity item.
+
+Constraint: the head dims must split evenly — ``Hq % n == 0`` and
+``Hkv % n == 0`` (GQA grouping is preserved because every head chunk
+contains whole query groups when both divide).  Ring attention has no such
+constraint; the dispatcher falls back accordingly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from penroz_tpu.parallel.mesh import SEQ_AXIS
+
+
+def alltoall_supported(num_heads: int, num_kv_heads: int, mesh: Mesh,
+                       axis_name: str = SEQ_AXIS) -> bool:
+    """Whether the Ulysses head split is possible on this mesh."""
+    n = mesh.shape[axis_name]
+    return num_heads % n == 0 and num_kv_heads % n == 0
+
+
+def _alltoall_local(q, k, v, *, axis_name: str, window, platform):
+    """Per-shard body. q/k/v: (B, H, T_local, D) sequence-sharded blocks."""
+    from penroz_tpu.ops import attention as attn_ops
+
+    # seq-sharded → head-sharded: split heads n ways, gather the sequence.
+    # tiled=True concatenates blocks in axis-index order, so positions stay
+    # sorted and ordinary causal masking is correct on the gathered axis.
+    q = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    out = attn_ops.causal_attention(q, k, v, platform=platform,
+                                    window=window)
+    # head-sharded → seq-sharded.
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def alltoall_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                       axis_name: str = SEQ_AXIS, window=None,
+                       platform=None):
+    """Sequence-parallel attention via head/sequence all-to-alls.
+
+    q: (B, Hq, T, D); k/v: (B, Hkv, T, D), sharded (or shardable) on T.
+    Same contract as :func:`ring_attention.ring_attention`; requires the
+    head counts to be divisible by the sequence-axis size.
+    """
+    if not causal:
+        raise ValueError("alltoall_attention supports causal=True only "
+                         "(the local pass reuses the causal kernel); use "
+                         "ring_attention for bidirectional SP")
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n or k.shape[1] % n:
+        raise ValueError(
+            f"alltoall (Ulysses) SP needs heads divisible by the sequence "
+            f"axis: Hq={q.shape[1]}, Hkv={k.shape[1]}, {axis_name}={n}; "
+            f"use PENROZ_SP_MODE=ring for this config")
+    spec = P(None, None, axis_name, None)
+    body = functools.partial(
+        _alltoall_local, axis_name=axis_name,
+        window=int(window) if window is not None else None,
+        platform=platform)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return fn(q, k, v)

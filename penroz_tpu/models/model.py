@@ -59,15 +59,18 @@ def _resolve_device(device: Optional[str]):
     if device is None:
         return None
     device = device.lower()
+    # local_devices, not devices: under multi-host the global list leads
+    # with process 0's devices, and device_put onto another process's
+    # device is an error ("Cannot copy array to non-addressable device").
     if device == "cpu":
-        return jax.devices("cpu")[0]
+        return jax.local_devices(backend="cpu")[0]
     if device in ("tpu", "cuda", "gpu", "axon", "accelerator"):
         for backend in ("tpu", "axon", "gpu"):
             try:
-                return jax.devices(backend)[0]
+                return jax.local_devices(backend=backend)[0]
             except RuntimeError:
                 continue
-        return jax.devices()[0]
+        return jax.local_devices()[0]
     raise ValueError(f"Unknown device {device!r}; expected 'cpu', 'tpu', "
                      f"'gpu', 'cuda', 'axon' or 'accelerator'")
 
@@ -663,10 +666,11 @@ class NeuralNetworkModel:
                     sharding_lib.opt_state_sharding_tree(self.opt_state,
                                                          self.params, mesh,
                                                          wus=wus))
-                self.opt_state = jax.device_put(self.opt_state,
-                                                epoch_out_shardings[1])
-                self.buffers = jax.device_put(self.buffers,
-                                              mesh_lib.replicated(mesh))
+                self.opt_state = sharding_lib.place_tree(
+                    self.opt_state, epoch_out_shardings[1])
+                self.buffers = {
+                    k: sharding_lib.place(v, mesh_lib.replicated(mesh))
+                    for k, v in self.buffers.items()}
                 if mesh.shape[mesh_lib.SEQ_AXIS] > 1:
                     sp_mesh = mesh
             # With cross-host-sharded state every process must persist its

@@ -71,11 +71,42 @@ def param_shardings(params: dict, mesh: Mesh, fsdp: bool = False) -> dict:
     return out
 
 
+def place(value, sharding):
+    """``device_put`` that also works host→non-addressable.
+
+    Under a multi-host mesh the target sharding spans devices this process
+    cannot address, and ``device_put`` of a committed process-local array
+    would demand a cross-host transfer (unsupported on CPU/gloo, and
+    pointless here: every process holds the identical full value after a
+    deterministic init or checkpoint load).  Route through host memory and
+    let each process contribute exactly its local shards.
+    """
+    import jax
+    if getattr(value, "sharding", None) == sharding:
+        return value
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(value, sharding)
+    if not (getattr(value, "is_fully_addressable", True)
+            or getattr(value, "is_fully_replicated", False)):
+        # already cross-host sharded (e.g. FSDP params from a previous
+        # train run): only a device-side reshard can express this
+        return jax.device_put(value, sharding)
+    host = np.asarray(value)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
+def place_tree(tree, sharding_tree):
+    """Leaf-wise :func:`place` over matching pytrees."""
+    import jax
+    return jax.tree.map(place, tree, sharding_tree,
+                        is_leaf=lambda x: x is None)
+
+
 def shard_params(params: dict, mesh: Mesh, fsdp: bool = False) -> dict:
     """Place a flat param dict onto the mesh under the TP (+FSDP) layout."""
-    import jax
     shardings = param_shardings(params, mesh, fsdp=fsdp)
-    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+    return {k: place(v, shardings[k]) for k, v in params.items()}
 
 
 def batch_spec(mesh: Mesh, *, leading_steps: bool = False,

@@ -1,0 +1,152 @@
+"""REAL multi-host training: two OS processes, cross-process collectives.
+
+Round-1 recorded multi-host as "only mock-tested (unavoidable here)"
+(VERDICT.md §coverage row 25).  It is avoidable: ``jax.distributed`` works
+on the CPU backend across local processes, so these tests launch two
+workers with the production env wiring (coordinator address + process ids,
+two virtual CPU devices each → a 4-device global mesh) and drive the full
+``train_model`` / ``evaluate_model`` stack — gradient psum across
+processes, rank-strided loaders, ``all_reduce_mean``, and (FSDP case)
+cross-host shard-file checkpointing all execute for real.
+
+The subprocess env is scrubbed of the accelerator plugin (sitecustomize on
+PYTHONPATH would capture JAX_PLATFORMS before the worker can force cpu —
+same failure mode conftest.py guards against in-process).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_LAYERS = [
+    {"summation": [
+        {"embedding": {"num_embeddings": 64, "embedding_dim": 32},
+         "normal": {"mean": 0.0, "std": 0.02}},
+        {"position": {"num_embeddings": 16, "embedding_dim": 32},
+         "normal": {"mean": 0.0, "std": 0.02}}]},
+    {"residual": [
+        {"sequential": [
+            {"layernorm": {"normalized_shape": 32}},
+            {"linear": {"in_features": 32, "out_features": 96}},
+            {"attention": {"num_heads": 4, "dropout": 0.0}},
+            {"linear": {"in_features": 32, "out_features": 32}}]},
+        {"sequential": [
+            {"layernorm": {"normalized_shape": 32}},
+            {"linear": {"in_features": 32, "out_features": 64}},
+            {"gelu": {}},
+            {"linear": {"in_features": 64, "out_features": 32}}]}]},
+    {"layernorm": {"normalized_shape": 32}},
+    {"linear": {"in_features": 32, "out_features": 64, "bias": False}},
+    {"softmaxlast": {"dim": -1}},
+]
+_OPT = {"adamw": {"lr": 1e-3, "betas": [0.9, 0.95], "eps": 1e-8}}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(port: int, proc_id: int, extra: dict) -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "PALLAS_", "PENROZ_",
+                                "TURBO_", "PAGED_"))}
+    env.pop("PYTHONPATH", None)  # drop the accelerator-plugin site dir
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+        "JAX_PROCESS_ID": str(proc_id),
+        "JAX_COMPILATION_CACHE_DIR": "/tmp/jax_test_cache",
+    })
+    env.update(extra)
+    return env
+
+
+def _run_pair(tmp_path, model_id: str, extra_env: dict, epochs: int = 2):
+    data_dir = tmp_path / "data"
+    data_dir.mkdir(exist_ok=True)
+    rng = np.random.default_rng(0)
+    np.save(data_dir / "mh_000000",
+            rng.integers(0, 64, 8000).astype(np.uint16))
+    cfg = {"workdir": str(tmp_path), "model_id": model_id, "dataset": "mh",
+           "layers": _LAYERS, "optimizer": _OPT, "epochs": epochs,
+           "batch_size": 8, "block_size": 16, "step_size": 8}
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "_multihost_worker.py"),
+         json.dumps(cfg)],
+        env=_worker_env(port, i, extra_env), cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+    return outs
+
+
+def test_real_two_process_dp_training(tmp_path):
+    """Two processes, 4-device global DP mesh: gradient sync across OS
+    processes keeps the replicas bit-identical, and the eval cost
+    all_reduce_mean agrees on both hosts."""
+    _run_pair(tmp_path, "mhdp", {})
+    d0 = np.load(tmp_path / "proc0.npz")
+    d1 = np.load(tmp_path / "proc1.npz")
+    # same eval cost on every host (the reference's ddp_all_reduce contract,
+    # neural_net_model.py:352-354)
+    assert float(d0["cost"]) == pytest.approx(float(d1["cost"]), abs=1e-6)
+    # replicas did not diverge: cross-process grad psum really synced them
+    keys = [k for k in d0.files if k != "cost"]
+    assert keys, "workers dumped no params"
+    for k in keys:
+        np.testing.assert_array_equal(d0[k], d1[k])
+
+
+def test_real_two_process_fsdp_checkpoint(tmp_path):
+    """FSDP across processes: params are cross-host sharded, every process
+    writes its shard file, and a fresh single process reassembles the full
+    checkpoint (the saves_shards-over-all-items path, for real)."""
+    _run_pair(tmp_path, "mhfsdp", {"PENROZ_FSDP": "1"})
+    shard_files = list(tmp_path.glob("models/*.shard*.ckpt"))
+    assert len(shard_files) == 2, \
+        f"expected one shard file per process, got {shard_files}"
+    # a fresh single process must reassemble the cross-host-sharded state
+    code = (
+        "import os, json, numpy as np\n"
+        f"os.chdir({str(tmp_path)!r})\n"
+        "from penroz_tpu.utils import checkpoint\n"
+        f"checkpoint.SHM_PATH = os.path.join({str(tmp_path)!r}, 'shm')\n"
+        "from penroz_tpu.models.model import NeuralNetworkModel\n"
+        "m = NeuralNetworkModel.deserialize('mhfsdp')\n"
+        "assert m.status['code'] == 'Trained', m.status\n"
+        "for k, v in m.params.items():\n"
+        "    a = np.asarray(v)\n"
+        "    assert np.isfinite(a).all(), k\n"
+        "print('reassembled', len(m.params))\n")
+    env = _worker_env(_free_port(), 0, {})
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID"):
+        env.pop(k)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd=str(tmp_path), capture_output=True, text=True,
+                         timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "reassembled" in out.stdout

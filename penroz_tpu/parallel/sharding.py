@@ -147,8 +147,20 @@ def global_batch(batch, mesh: Mesh, *, leading_steps: bool = False,
     batch_axis = 1 if leading_steps else 0
     global_shape = list(np.shape(batch))
     global_shape[batch_axis] *= world
-    return jax.make_array_from_process_local_data(
-        sharding, np.asarray(batch), tuple(global_shape))
+    if mesh.shape[DATA_AXIS] % world == 0:
+        # Each process's rows are exactly the slice its data-axis devices
+        # address — stitch without any host traffic.
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(batch), tuple(global_shape))
+    # The data axis does not span every process (e.g. pure TP across
+    # hosts, data=1): devices address more batch rows than this host
+    # loaded, so materialize the full global batch on every host first
+    # (rank-order concat matches the loader's rank striding).
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(np.asarray(batch))
+    full = np.concatenate(list(gathered), axis=batch_axis)
+    assert list(full.shape) == global_shape, (full.shape, global_shape)
+    return place(full, sharding)
 
 
 def _data_axis_spec(spec: P, shape: tuple, mesh: Mesh) -> P:

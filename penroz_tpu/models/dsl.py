@@ -36,6 +36,7 @@ _CONTAINER_ALGOS = {
     "sequential": M.Sequential,
     "summation": M.Summation,
     "residual": M.ResidualConnection,
+    "parallelresidual": M.ParallelResidual,
 }
 
 _LEAF_ALGOS = {
@@ -241,6 +242,8 @@ class Mapper:
             return _gemma_dsl_from_config(config, n_layer_override)
         if model_type in _LLAMA_FAMILY:
             return _llama_dsl_from_config(config, n_layer_override)
+        if model_type == "gpt_neox":
+            return _neox_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -251,7 +254,8 @@ class Mapper:
         (reference: mappers.py:276-302)."""
         import re
         pattern = re.compile(
-            r"(?:transformer\.h|model\.(?:language_model\.)?layers)\.(\d+)\.")
+            r"(?:transformer\.h|gpt_neox\.layers"
+            r"|model\.(?:language_model\.)?layers)\.(\d+)\.")
         n = 0
         for key in state_dict:
             m = pattern.match(key)
@@ -266,6 +270,8 @@ class Mapper:
         (reference: mappers.py:304-448)."""
         if "transformer.wte.weight" in state_dict:
             return _map_gpt2_state_dict(state_dict, n_layer)
+        if "gpt_neox.embed_in.weight" in state_dict:
+            return _map_neox_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") in _LLAMA_FAMILY:
             return _map_llama_state_dict(state_dict, n_layer, config)
         return _map_gemma_state_dict(state_dict, n_layer, config)
@@ -637,6 +643,111 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
         {"softmaxlast": {"dim": -1}},
     ]
     return layers
+
+
+def _neox_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """GPT-NeoX/Pythia HF config → layer DSL.
+
+    Two capabilities beyond the other families: the ``parallelresidual``
+    container (``use_parallel_residual``: attention and MLP branches both
+    read the pre-block activations, HF ``modeling_gpt_neox`` forward) and
+    partial rotary (``rotary_pct`` → the attention module's ``rope_pct``).
+    ``use_parallel_residual=False`` checkpoints get the ordinary
+    sequential-residual block.
+    """
+    cfg = _llama_text_config(config)
+    scaling = getattr(cfg, "rope_scaling", None) or None
+    if scaling and (scaling.get("rope_type") or scaling.get("type")
+                    or "default") != "default":
+        # Same guard as the llama builder: importing with an active scaling
+        # silently ignored would produce wrong logits.
+        raise ValueError(
+            f"gpt_neox rope_scaling {scaling!r} is not supported; importing "
+            "would produce wrong logits")
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "layer_norm_eps", 1e-5))
+    rope = float(getattr(cfg, "rope_theta", None)
+                 or getattr(cfg, "rotary_emb_base", None) or 10000.0)
+    rope_pct = float(getattr(cfg, "rotary_pct", 0.25) or 0.25)
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    hidden_drop = float(getattr(cfg, "hidden_dropout", 0.0) or 0.0)
+    act = getattr(cfg, "hidden_act", "gelu")
+    if act in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"):
+        act_entry = {"gelu": {"approximate": "tanh"}}
+    elif act == "gelu":
+        act_entry = {"gelu": {}}
+    elif act == "relu":
+        act_entry = {"relu": {}}
+    else:
+        raise ValueError(f"Unsupported gpt_neox hidden_act: {act!r}")
+    parallel = bool(getattr(cfg, "use_parallel_residual", True))
+    inter = int(getattr(cfg, "intermediate_size", None) or 4 * d)
+
+    attn_args = {"num_heads": heads, "rope_theta": rope,
+                 "rope_pct": rope_pct, "dropout": attn_drop}
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for _ in range(n):
+        attn_branch = {"sequential": [
+            {"layernorm": {"normalized_shape": d, "eps": eps}},
+            {"linear": {"in_features": d, "out_features": 3 * d}},
+            {"attention": dict(attn_args)},
+            {"linear": {"in_features": d, "out_features": d}}]
+            + ([{"dropout": {"p": hidden_drop}}] if hidden_drop else [])}
+        mlp_branch = {"sequential": [
+            {"layernorm": {"normalized_shape": d, "eps": eps}},
+            {"linear": {"in_features": d, "out_features": inter}},
+            act_entry,
+            {"linear": {"in_features": inter, "out_features": d}}]
+            + ([{"dropout": {"p": hidden_drop}}] if hidden_drop else [])}
+        container = "parallelresidual" if parallel else "residual"
+        layers.append({container: [attn_branch, mlp_branch]})
+    layers += [
+        {"layernorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _neox_deinterleave_qkv(w: np.ndarray, heads: int) -> np.ndarray:
+    """GPT-NeoX fuses QKV per head ([q_h; k_h; v_h] stacked head-major,
+    HF ``modeling_gpt_neox`` view (H, 3, hd, ...)); our attention expects
+    [all q; all k; all v].  Works for (3d, d) weights and (3d,) biases."""
+    w = np.asarray(w)
+    hd3 = w.shape[0] // heads
+    return (w.reshape((heads, 3, hd3 // 3) + w.shape[1:])
+            .swapaxes(0, 1)
+            .reshape((w.shape[0],) + w.shape[1:]))
+
+
+def _map_neox_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """GPT-NeoX HF keys → ours: per-head-interleaved QKV de-interleaved,
+    LayerNorms with biases copied straight, untied ``embed_out`` head."""
+    heads = int(getattr(_llama_text_config(config), "num_attention_heads"))
+    out = {"layers.0.weight": sd["gpt_neox.embed_in.weight"]}
+    for i in range(n_layer):
+        src = f"gpt_neox.layers.{i}"
+        dst = f"layers.{1 + i}"
+        for name in ("weight", "bias"):
+            out[f"{dst}.0.0.{name}"] = sd[f"{src}.input_layernorm.{name}"]
+            out[f"{dst}.0.1.{name}"] = _neox_deinterleave_qkv(
+                sd[f"{src}.attention.query_key_value.{name}"], heads)
+            out[f"{dst}.0.3.{name}"] = sd[f"{src}.attention.dense.{name}"]
+            out[f"{dst}.1.0.{name}"] = \
+                sd[f"{src}.post_attention_layernorm.{name}"]
+            out[f"{dst}.1.1.{name}"] = sd[f"{src}.mlp.dense_h_to_4h.{name}"]
+            out[f"{dst}.1.3.{name}"] = sd[f"{src}.mlp.dense_4h_to_h.{name}"]
+    out[f"layers.{1 + n_layer}.weight"] = sd["gpt_neox.final_layer_norm.weight"]
+    out[f"layers.{1 + n_layer}.bias"] = sd["gpt_neox.final_layer_norm.bias"]
+    out[f"layers.{2 + n_layer}.weight"] = sd.get(
+        "embed_out.weight", sd["gpt_neox.embed_in.weight"])
+    return out
 
 
 def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:

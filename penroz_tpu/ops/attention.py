@@ -58,15 +58,30 @@ def _rotate_half(x):
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
-def apply_rope(q, k, theta: float, offset, scaling: Optional[dict] = None):
-    """Apply rotary embeddings to (B, H, T, D) query/key tensors."""
+def apply_rope(q, k, theta: float, offset, scaling: Optional[dict] = None,
+               rotary_dim: Optional[int] = None):
+    """Apply rotary embeddings to (B, H, T, D) query/key tensors.
+
+    ``rotary_dim`` < D applies partial rotary (GPT-NeoX/Pythia
+    ``rotary_pct``): only the first ``rotary_dim`` feature dims are
+    rotated, the rest pass through unchanged."""
     head_dim = q.shape[-1]
-    cos, sin = rope_cos_sin(head_dim, theta, offset, q.shape[2], q.dtype,
+    if rotary_dim is None or rotary_dim >= head_dim:
+        cos, sin = rope_cos_sin(head_dim, theta, offset, q.shape[2], q.dtype,
+                                scaling=scaling)
+        cos, sin = cos[None, None], sin[None, None]
+        q = q * cos + _rotate_half(q) * sin
+        k = k * cos + _rotate_half(k) * sin
+        return q, k
+    cos, sin = rope_cos_sin(rotary_dim, theta, offset, q.shape[2], q.dtype,
                             scaling=scaling)
     cos, sin = cos[None, None], sin[None, None]
-    q = q * cos + _rotate_half(q) * sin
-    k = k * cos + _rotate_half(k) * sin
-    return q, k
+    q_rot, q_pass = q[..., :rotary_dim], q[..., rotary_dim:]
+    k_rot, k_pass = k[..., :rotary_dim], k[..., rotary_dim:]
+    q_rot = q_rot * cos + _rotate_half(q_rot) * sin
+    k_rot = k_rot * cos + _rotate_half(k_rot) * sin
+    return (jnp.concatenate([q_rot, q_pass], axis=-1),
+            jnp.concatenate([k_rot, k_pass], axis=-1))
 
 
 def _group_query_heads(q, num_kv_heads: int):

@@ -458,6 +458,27 @@ class ResidualConnection(Sequential):
         return x
 
 
+class ParallelResidual(Sequential):
+    """x = x + Σ child(x): every child reads the SAME input.
+
+    The GPT-NeoX/Pythia ``use_parallel_residual`` block — attention and MLP
+    branches run on the same pre-block activations and their outputs are
+    summed onto the residual stream (HF ``modeling_gpt_neox`` forward),
+    unlike :class:`ResidualConnection` where each child sees the previous
+    child's residual sum.
+
+    Composable as ``residual([summation([...branches])])``, but the
+    dedicated container keeps branch params one level flatter
+    (``layers.i.{branch}.*``), which the NeoX HF key remap relies on.
+    """
+
+    def apply(self, x, ctx):
+        out = x
+        for layer in self.layers:
+            out = out + layer.apply(x, ctx)
+        return out
+
+
 class TransformerBlock(Module):
     """Pre-norm decoder block with optional Gemma-style post-norms.
 
@@ -663,7 +684,8 @@ class CausalSelfAttention(Module):
                  rope_theta: Optional[float] = None,
                  head_dim: Optional[int] = None,
                  rope_scaling: Optional[dict] = None,
-                 sliding_window: Optional[int] = None):
+                 sliding_window: Optional[int] = None,
+                 rope_pct: Optional[float] = None):
         if sliding_window is not None and int(sliding_window) < 1:
             raise ValueError(f"sliding_window must be >= 1, "
                              f"got {sliding_window}")
@@ -674,6 +696,11 @@ class CausalSelfAttention(Module):
         self.dropout = float(dropout)
         self.rope_theta = float(rope_theta) if rope_theta is not None else None
         self.head_dim = int(head_dim) if head_dim is not None else None
+        # Partial rotary (GPT-NeoX rotary_pct): rotate only the first
+        # int(head_dim * rope_pct) feature dims (rounded to even).
+        if rope_pct is not None and not 0.0 < float(rope_pct) <= 1.0:
+            raise ValueError(f"rope_pct must be in (0, 1], got {rope_pct}")
+        self.rope_pct = float(rope_pct) if rope_pct is not None else None
         # llama3-type inverse-frequency rescaling (ops/attention.rope_cos_sin).
         # Validated HERE, at model build time (→ HTTP 400 on POST /model/):
         # the DSL reaches this module directly, so the HF importer's guard
@@ -728,8 +755,12 @@ class CausalSelfAttention(Module):
 
         offset = ctx.offset()
         if self.rope_theta is not None:
+            rotary_dim = None
+            if self.rope_pct is not None and self.rope_pct < 1.0:
+                rotary_dim = int(head_dim * self.rope_pct) // 2 * 2
             q, k = attn_ops.apply_rope(q, k, self.rope_theta, offset,
-                                       scaling=self.rope_scaling)
+                                       scaling=self.rope_scaling,
+                                       rotary_dim=rotary_dim)
 
         dropout_rate = self.dropout if ctx.training else 0.0
         dropout_rng = ctx.next_rng() if (dropout_rate > 0.0 and ctx.training) else None

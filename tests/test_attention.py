@@ -36,6 +36,25 @@ def test_rope_offset_shifts_positions():
                                np.asarray(part_q), rtol=1e-5)
 
 
+def test_partial_rope_rotates_only_leading_dims():
+    """rotary_dim < D (GPT-NeoX rotary_pct): trailing dims pass through
+    untouched, leading dims match a full-rope call at that width."""
+    q, k, _ = _qkv(D=16)
+    q2, k2 = A.apply_rope(q, k, 10000.0, jnp.asarray(0), rotary_dim=8)
+    np.testing.assert_array_equal(np.asarray(q2)[..., 8:],
+                                  np.asarray(q)[..., 8:])
+    np.testing.assert_array_equal(np.asarray(k2)[..., 8:],
+                                  np.asarray(k)[..., 8:])
+    q_ref, k_ref = A.apply_rope(q[..., :8], k[..., :8], 10000.0,
+                                jnp.asarray(0))
+    np.testing.assert_allclose(np.asarray(q2)[..., :8], np.asarray(q_ref),
+                               rtol=1e-6)
+    # rotary_dim == D is exactly the full rotation
+    q_full, _ = A.apply_rope(q, k, 10000.0, jnp.asarray(0))
+    q_full2, _ = A.apply_rope(q, k, 10000.0, jnp.asarray(0), rotary_dim=16)
+    np.testing.assert_array_equal(np.asarray(q_full), np.asarray(q_full2))
+
+
 def test_gqa_matches_expanded_heads():
     """Grouped einsum == explicit KV head expansion."""
     q, k, v = _qkv(Hq=4, Hkv=2)

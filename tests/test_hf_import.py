@@ -335,3 +335,97 @@ def test_rope_scaling_numeric_validation():
     with pytest.raises(ValueError, match="factor must be"):
         CausalSelfAttention(num_heads=2, rope_theta=1e4,
                             rope_scaling={**base, "factor": 0.5})
+
+
+def _tiny_neox(parallel=True):
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+    config = GPTNeoXConfig(vocab_size=96, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           intermediate_size=64, rotary_pct=0.25,
+                           max_position_embeddings=64,
+                           use_parallel_residual=parallel,
+                           hidden_act="gelu", attention_dropout=0.0,
+                           hidden_dropout=0.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, GPTNeoXForCausalLM(config).eval()
+
+
+def test_neox_import_logit_parity(workdir):
+    """GPT-NeoX/Pythia: parallel-residual blocks, partial rotary
+    (rotary_pct), per-head-interleaved QKV de-interleaved, untied
+    embed_out (beyond reference parity)."""
+    config, torch_model = _tiny_neox()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "neox-tiny")
+    assert model.status["code"] == "Imported"
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+
+def test_neox_sequential_residual_logit_parity(workdir):
+    """use_parallel_residual=False checkpoints get the ordinary
+    sequential-residual block and still match torch."""
+    config, torch_model = _tiny_neox(parallel=False)
+    tokens = np.array([[5, 1, 60, 22]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "neox-seq")
+    import jax.numpy as jnp
+    assert "parallelresidual" not in str(model.layers_dsl)
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+
+
+def test_neox_cached_generate_matches_uncached(workdir):
+    """Partial rotary must behave identically through the KV-cached decode
+    path (rope offset applied to the rotary dims only): greedy cached
+    generation must equal a token-by-token UNCACHED argmax rollout."""
+    import jax.numpy as jnp
+    config, torch_model = _tiny_neox()
+    model = _import_model(workdir, config, torch_model, "neox-gen")
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert len(toks) == 9
+    ctx = [1, 2, 3]
+    for _ in range(6):
+        acts, _, _, _ = model.arch.jit_forward(
+            model.params, model.buffers,
+            jnp.asarray([ctx[-16:]], jnp.int32), skip_softmax=True)
+        logits = np.asarray(acts[-1], np.float32)
+        if logits.ndim == 3:
+            logits = logits[:, -1, :]
+        ctx.append(int(logits.argmax(-1)[0]))
+    assert toks == ctx
+
+
+def test_neox_rope_scaling_rejected():
+    """Active rope_scaling on gpt_neox is unsupported — reject at DSL build
+    rather than importing with it silently ignored (wrong logits)."""
+    from penroz_tpu.models.dsl import Mapper
+
+    class Cfg:
+        model_type = "gpt_neox"
+        hidden_size = 32
+        num_hidden_layers = 1
+        num_attention_heads = 2
+        vocab_size = 96
+        rope_scaling = {"type": "linear", "factor": 2.0}
+
+    with pytest.raises(ValueError, match="rope_scaling"):
+        Mapper.from_hf_config(Cfg())

@@ -88,14 +88,39 @@ def _child(n_devices: int) -> None:
     float(cost)
     elapsed = time.perf_counter() - t0
     tokens = TIMED * STEPS * batch * BLOCK
-    print(json.dumps({"devices": n_devices,
-                      "tokens_per_sec": tokens / elapsed}))
+    rec = {"devices": n_devices, "tokens_per_sec": tokens / elapsed}
+
+    if os.environ.get("BENCH_SCALING_ZERO") == "1" and n_devices > 1:
+        # ZeRO ladder memory: bytes of params + optimizer state resident on
+        # device 0 under the replicated/TP layout vs FSDP+WUS
+        # (PENROZ_FSDP=1).  The training-math equivalence is test-asserted
+        # (tests/test_parallel.py); this records the memory win.
+        def dev0_bytes(tree):
+            total = 0
+            for leaf in jax.tree.leaves(tree):
+                for s in getattr(leaf, "addressable_shards", []):
+                    if s.device == devices[0] and s.data is not None:
+                        total += s.data.size * s.data.dtype.itemsize
+            return total
+
+        repl = dev0_bytes(params) + dev0_bytes(opt_state)
+        f_params = jax.device_put(
+            params, sharding_lib.param_shardings(params, mesh, fsdp=True))
+        f_opt = jax.device_put(opt_state, sharding_lib.opt_state_sharding_tree(
+            opt_state, f_params, mesh, wus=True))
+        jax.block_until_ready((f_params, f_opt))
+        rec["state_bytes_per_device"] = repl
+        rec["zero_state_bytes_per_device"] = dev0_bytes(f_params) \
+            + dev0_bytes(f_opt)
+    print(json.dumps(rec))
 
 
 def main() -> None:
     points = []
     for n in MESH_SIZES:
         env = dict(os.environ)
+        if n == MESH_SIZES[-1]:
+            env["BENCH_SCALING_ZERO"] = "1"
         env["JAX_PLATFORMS"] = env.get("BENCH_SCALING_PLATFORM", "cpu")
         if env["JAX_PLATFORMS"] == "cpu":
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
@@ -130,7 +155,7 @@ def main() -> None:
     else:
         metric = f"train scaling efficiency @{top['devices']} devices"
         value = top["tokens_per_sec"] / (top["devices"] * base)
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": round(value, 4),
         "unit": "fraction of linear",
@@ -138,7 +163,12 @@ def main() -> None:
         "virtual_mesh": virtual,
         "points": [{k: (round(v, 1) if isinstance(v, float) else v)
                     for k, v in p.items()} for p in points],
-    }))
+    }
+    if "zero_state_bytes_per_device" in top:
+        out["zero_memory_reduction"] = round(
+            top["state_bytes_per_device"]
+            / max(top["zero_state_bytes_per_device"], 1), 2)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -671,7 +671,9 @@ def _neox_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     eps = float(getattr(cfg, "layer_norm_eps", 1e-5))
     rope = float(getattr(cfg, "rope_theta", None)
                  or getattr(cfg, "rotary_emb_base", None) or 10000.0)
-    rope_pct = float(getattr(cfg, "rotary_pct", 0.25) or 0.25)
+    rope_pct = getattr(cfg, "rotary_pct", None)
+    rope_pct = 0.25 if rope_pct is None else float(rope_pct)
+    attn_bias = bool(getattr(cfg, "attention_bias", True))
     attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
     hidden_drop = float(getattr(cfg, "hidden_dropout", 0.0) or 0.0)
     act = getattr(cfg, "hidden_act", "gelu")
@@ -686,8 +688,12 @@ def _neox_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     parallel = bool(getattr(cfg, "use_parallel_residual", True))
     inter = int(getattr(cfg, "intermediate_size", None) or 4 * d)
 
-    attn_args = {"num_heads": heads, "rope_theta": rope,
-                 "rope_pct": rope_pct, "dropout": attn_drop}
+    attn_args = {"num_heads": heads, "dropout": attn_drop}
+    if rope_pct > 0.0:
+        # rotary_pct=0.0 is a valid HF config (rotary_ndims=0, rope is a
+        # no-op) — omit rope entirely rather than rotating dims the torch
+        # original never rotated.
+        attn_args.update(rope_theta=rope, rope_pct=rope_pct)
     layers: list[dict] = [
         {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
          "normal": {"mean": 0.0, "std": 0.02}},
@@ -695,9 +701,11 @@ def _neox_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     for _ in range(n):
         attn_branch = {"sequential": [
             {"layernorm": {"normalized_shape": d, "eps": eps}},
-            {"linear": {"in_features": d, "out_features": 3 * d}},
+            {"linear": {"in_features": d, "out_features": 3 * d,
+                        "bias": attn_bias}},
             {"attention": dict(attn_args)},
-            {"linear": {"in_features": d, "out_features": d}}]
+            {"linear": {"in_features": d, "out_features": d,
+                        "bias": attn_bias}}]
             + ([{"dropout": {"p": hidden_drop}}] if hidden_drop else [])}
         mlp_branch = {"sequential": [
             {"layernorm": {"normalized_shape": d, "eps": eps}},
@@ -736,9 +744,13 @@ def _map_neox_state_dict(sd: dict, n_layer: int, config=None) -> dict:
         dst = f"layers.{1 + i}"
         for name in ("weight", "bias"):
             out[f"{dst}.0.0.{name}"] = sd[f"{src}.input_layernorm.{name}"]
-            out[f"{dst}.0.1.{name}"] = _neox_deinterleave_qkv(
-                sd[f"{src}.attention.query_key_value.{name}"], heads)
-            out[f"{dst}.0.3.{name}"] = sd[f"{src}.attention.dense.{name}"]
+            # attention_bias=False checkpoints carry no qkv/dense biases —
+            # the DSL builds bias-free linears for them (attn_bias above).
+            if f"{src}.attention.query_key_value.{name}" in sd:
+                out[f"{dst}.0.1.{name}"] = _neox_deinterleave_qkv(
+                    sd[f"{src}.attention.query_key_value.{name}"], heads)
+            if f"{src}.attention.dense.{name}" in sd:
+                out[f"{dst}.0.3.{name}"] = sd[f"{src}.attention.dense.{name}"]
             out[f"{dst}.1.0.{name}"] = \
                 sd[f"{src}.post_attention_layernorm.{name}"]
             out[f"{dst}.1.1.{name}"] = sd[f"{src}.mlp.dense_h_to_4h.{name}"]

@@ -429,3 +429,32 @@ def test_neox_rope_scaling_rejected():
 
     with pytest.raises(ValueError, match="rope_scaling"):
         Mapper.from_hf_config(Cfg())
+
+
+def test_neox_attention_bias_false_logit_parity(workdir):
+    """attention_bias=False checkpoints carry no qkv/dense biases; the DSL
+    must build bias-free linears and still match torch."""
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+    config = GPTNeoXConfig(vocab_size=96, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           intermediate_size=64, rotary_pct=0.25,
+                           max_position_embeddings=64,
+                           use_parallel_residual=True, hidden_act="gelu",
+                           attention_bias=False, attention_dropout=0.0,
+                           hidden_dropout=0.0, tie_word_embeddings=False)
+    torch.manual_seed(1)
+    torch_model = GPTNeoXForCausalLM(config).eval()
+    tokens = np.array([[7, 30, 2, 19]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "neox-nobias")
+    import jax.numpy as jnp
+    assert "layers.1.0.1.bias" not in model.params
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)

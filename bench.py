@@ -44,13 +44,13 @@ def peak_flops(device) -> float:
 
 
 def bench_train(arch, mapper, params, batch=8, block=1024, steps_per_call=4,
-                warmup=2, timed=6):
+                warmup=2, timed=6, remat=False):
     import optax
     optimizer = mapper.to_optimizer()
     opt_state = optimizer.init(params)
     # Steady-state variant: /train/ computes the update-ratio stds only on
     # progress-sampled epochs (1 in epochs//100), so the hot loop skips them.
-    epoch_fn = arch.train_epoch_fn(mapper.optimizer, steps_per_call, False,
+    epoch_fn = arch.train_epoch_fn(mapper.optimizer, steps_per_call, remat,
                                    jnp.bfloat16, with_ratios=False)
     rng = jax.random.key(0)
     data_rng = np.random.default_rng(0)
@@ -164,6 +164,42 @@ def bench_paged_generate(arch, params, block=1024, tokens=64):
         os.environ.pop(KV.PAGED_ENV, None)
 
 
+def bench_long_context(depth=12, d_model=768, block=4096, batch=1,
+                       steps_per_call=2, timed=4):
+    """Long-context training throughput at T=4096 (flash fwd+bwd kernels
+    stream K/V through the grid, so the (T,S) score matrix never
+    materializes; the epoch runs with remat — ``jax.checkpoint`` around the
+    loss — bounding activation memory).  Returns (tokens_per_sec, mfu,
+    block) or None on any failure — this config is a showcase, not a
+    gate."""
+    from __graft_entry__ import OPTIMIZER
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import CompiledArch
+    from penroz_tpu.models import presets
+
+    try:
+        layers = presets.gpt2_custom(d=d_model, heads=12, depth=depth,
+                                     vocab=50304, block=block)
+        mapper = Mapper(layers, OPTIMIZER)
+        arch = CompiledArch.get(mapper.layers)
+        params, _ = mapper.init_params(arch.mods, seed=0)
+        n_params = sum(int(np.prod(p.shape)) for p in params.values())
+        n_matmul = n_params - sum(int(np.prod(p.shape))
+                                  for k, p in params.items()
+                                  if k.startswith("layers.0."))
+        tps, _ = bench_train(arch, mapper, params, batch=batch, block=block,
+                             steps_per_call=steps_per_call, timed=timed,
+                             remat=True)
+        mfu = (tps * _flops_per_token(n_matmul, depth, d_model, block)
+               / peak_flops(jax.devices()[0]))
+        return tps, mfu, block
+    except Exception as exc:  # noqa: BLE001 — optional showcase config
+        import logging
+        logging.getLogger(__name__).warning("long-context bench skipped: %s",
+                                            exc)
+        return None
+
+
 def bench_dispatch_floor():
     """p50 latency of a trivial jitted call — the harness/relay floor that
     bounds TTFT and per-dispatch decode on remotely attached TPUs."""
@@ -201,6 +237,7 @@ def main():
     decode_tps = bench_decode_throughput(arch, params, mapper, block=block)
     paged_tps, paged_assigned = bench_paged_generate(arch, params,
                                                      block=block)
+    long_ctx = bench_long_context()
     tokens_per_sec, cost = bench_train(arch, mapper, params)
     mfu = (tokens_per_sec
            * _flops_per_token(n_matmul_params, depth, d_model, block)
@@ -220,6 +257,9 @@ def main():
         "train_cost_sample": round(cost, 3),
         "device": str(device.device_kind),
         "n_params": n_params,
+        **({"long_ctx_tokens_per_sec": round(long_ctx[0], 1),
+            "long_ctx_mfu": round(long_ctx[1], 4),
+            "long_ctx_block": long_ctx[2]} if long_ctx else {}),
     }))
 
 

@@ -214,12 +214,31 @@ def bench_dispatch_floor():
     return statistics.median(times)
 
 
+def _devices_or_die(timeout_s: float = 180.0):
+    """First backend touch with a watchdog: a wedged remote-accelerator
+    relay makes ``jax.devices()`` block forever, which would hang the whole
+    bench run silently.  Fail fast with a diagnostic instead (stderr only —
+    never emit a fake metrics line)."""
+    import concurrent.futures
+    import os
+    import sys
+    pool = concurrent.futures.ThreadPoolExecutor(1)
+    fut = pool.submit(jax.devices)
+    try:
+        return fut.result(timeout=timeout_s)
+    except concurrent.futures.TimeoutError:
+        print(f"bench: accelerator backend unreachable after "
+              f"{timeout_s:.0f}s (relay/pool down?) — aborting without "
+              f"metrics", file=sys.stderr, flush=True)
+        os._exit(3)  # the blocked worker thread cannot be joined
+
+
 def main():
     from __graft_entry__ import OPTIMIZER, _gpt2_dsl
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import CompiledArch
 
-    device = jax.devices()[0]
+    device = _devices_or_die()[0]
     depth, d_model, block = 12, 768, 1024
     mapper = Mapper(_gpt2_dsl(depth=depth, d=d_model, block=block), OPTIMIZER)
     arch = CompiledArch.get(mapper.layers)

@@ -560,11 +560,25 @@ class MixtureOfExperts(Module):
     computes its expert shard for all tokens; the top-k-weighted combine is
     a contraction over E, which XLA turns into a psum over the axis).
 
-    Dispatch is dense: every expert processes every token and non-selected
-    contributions are zeroed by the router weights.  That keeps every shape
-    static for XLA (no token dropping, no capacity factor) at the cost of
-    E/top_k× extra MLP FLOPs — the right trade below ~16 experts; an
-    all_to_all token-dispatch path is the upgrade for larger E.
+    Two dispatch modes (``dispatch`` DSL arg):
+
+    - ``"dense"`` (default): every expert processes every token and
+      non-selected contributions are zeroed by the router weights.  No
+      token dropping, exact top-k math, at the cost of E/top_k× extra MLP
+      FLOPs — the right trade below ~16 experts.
+    - ``"capacity"`` (Switch/Mesh-TF style): the flattened batch splits
+      into fixed-size groups of ``DISPATCH_GROUP`` tokens (padded up with
+      masked rows when not divisible) and each group packs its tokens
+      into per-expert buffers of static capacity
+      ``C = ceil(top_k · DISPATCH_GROUP / E · capacity_factor)`` via
+      one-hot dispatch einsums; each expert computes only its (C, d)
+      buffer per group and a combine einsum scatters results back.  MLP
+      FLOPs drop by ~E/top_k× (the point of sparse MoE); tokens routed
+      past their group's per-expert capacity lose that expert's
+      contribution (Switch token dropping, applied per group — uneven
+      routing across groups can drop tokens a single global buffer would
+      have served).  All shapes stay static for XLA, and the buffers
+      shard on the mesh ``expert`` axis like the stacked weights.
 
     No reference equivalent (the reference has no MoE; nearest is GatedMLP,
     neural_net_layers.py:158-174) — this is a capability extension wired
@@ -573,11 +587,20 @@ class MixtureOfExperts(Module):
 
     def __init__(self, in_features: int, intermediate_size: int,
                  num_experts: int, top_k: int = 2, bias: bool = False,
-                 activation: str = "silu", aux_loss_coef: float = 0.0):
+                 activation: str = "silu", aux_loss_coef: float = 0.0,
+                 dispatch: str = "dense", capacity_factor: float = 1.25):
         if top_k < 1 or top_k > num_experts:
             raise ValueError(f"top_k={top_k} outside [1, {num_experts}]")
         if bias:
             raise ValueError("MixtureOfExperts does not support bias yet")
+        if dispatch not in ("dense", "capacity"):
+            raise ValueError(f"dispatch must be 'dense' or 'capacity', "
+                             f"got {dispatch!r}")
+        if float(capacity_factor) <= 0.0:
+            raise ValueError(f"capacity_factor must be > 0, "
+                             f"got {capacity_factor}")
+        self.dispatch = dispatch
+        self.capacity_factor = float(capacity_factor)
         self.in_features = int(in_features)
         self.intermediate_size = int(intermediate_size)
         self.num_experts = int(num_experts)
@@ -658,11 +681,66 @@ class MixtureOfExperts(Module):
         w_up = self._p(ctx, "experts.up_proj.weight")
         w_down = self._p(ctx, "experts.down_proj.weight")
         weights = self.router_weights(x, ctx).astype(x.dtype)
+        if self.dispatch == "capacity":
+            return self._apply_capacity(x, weights, w_gate, w_up, w_down)
         g = jnp.einsum("btd,ehd->bteh", x, w_gate)
         u = jnp.einsum("btd,ehd->bteh", x, w_up)
         hidden = self._act(g) * u
         y = jnp.einsum("bteh,edh->bted", hidden, w_down)
         return jnp.einsum("bted,bte->btd", y, weights)
+
+    # Tokens per dispatch group.  One-hot dispatch costs
+    # O(group_size · E · C) with C ∝ group_size/E, i.e. quadratic in the
+    # group size — fixed-size groups (Mesh-TF/Switch "G groups of S
+    # tokens") keep dispatch linear in total tokens and a small fraction
+    # of the expert-MLP FLOPs (ratio ≈ group/(3·intermediate)).
+    DISPATCH_GROUP = 512
+
+    def _apply_capacity(self, x, weights, w_gate, w_up, w_down):
+        """Capacity-packed dispatch: one-hot buffer einsums, static shapes.
+
+        ``weights``: (B, T, E) dense combine weights (zeros off the top-k).
+        The flattened batch splits into fixed-size groups; within each
+        group a selected token takes the next slot in its expert's queue
+        (cumsum order) and tokens past the per-group capacity
+        ``C = ceil(top_k · group / E · capacity_factor)`` get an all-zero
+        dispatch row, silently losing that expert's contribution (Switch
+        token dropping, applied per group).
+        """
+        B, T, d = x.shape
+        E = self.num_experts
+        tokens = B * T
+        group = min(tokens, self.DISPATCH_GROUP)
+        # Pad up to a group multiple with masked rows (weights 0 → never
+        # selected, never dispatched) so group size stays fixed for any
+        # B·T — a shrinking-divisor fallback would silently degrade to
+        # dense-level dispatch FLOPs on awkward (e.g. prime) token counts.
+        padded = -(-tokens // group) * group
+        n_groups = padded // group
+        cap = int(math.ceil(self.top_k * group / E * self.capacity_factor))
+        cap = max(1, min(cap, group))
+        flat_x = x.reshape(tokens, d)
+        flat_w = weights.reshape(tokens, E)
+        if padded != tokens:
+            pad = padded - tokens
+            flat_x = jnp.concatenate(
+                [flat_x, jnp.zeros((pad, d), flat_x.dtype)])
+            flat_w = jnp.concatenate(
+                [flat_w, jnp.zeros((pad, E), flat_w.dtype)])
+        gx = flat_x.reshape(n_groups, group, d)
+        gw = flat_w.reshape(n_groups, group, E)
+        sel = gw > 0
+        pos = jnp.cumsum(sel.astype(jnp.int32), axis=1) - 1  # slot in queue
+        # one_hot of an out-of-range class (cap) is all zeros → dropped.
+        slot = jnp.where(sel & (pos < cap), pos, cap)
+        disp = jax.nn.one_hot(slot, cap, dtype=x.dtype)      # (G, S, E, C)
+        expert_in = jnp.einsum("gsec,gsd->gecd", disp, gx)
+        gate = jnp.einsum("gecd,ehd->gech", expert_in, w_gate)
+        up = jnp.einsum("gecd,ehd->gech", expert_in, w_up)
+        out_e = jnp.einsum("gech,edh->gecd", self._act(gate) * up, w_down)
+        combine = disp * gw[..., None]                       # (G, S, E, C)
+        y = jnp.einsum("gsec,gecd->gsd", combine, out_e)
+        return y.reshape(padded, d)[:tokens].reshape(B, T, d)
 
 
 # ---------------------------------------------------------------------------

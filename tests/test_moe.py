@@ -233,3 +233,94 @@ def test_moe_train_epoch_and_checkpoint_migration(workdir):
     frac = np.asarray(
         next(v for k, v in buffers.items() if "router_fraction" in k))
     np.testing.assert_allclose(frac.sum(), 1.0, atol=1e-5)
+
+
+def _moe_cap(d=8, h=16, e=4, k=2, cf=8.0):
+    mod = M.MixtureOfExperts(in_features=d, intermediate_size=h,
+                             num_experts=e, top_k=k, dispatch="capacity",
+                             capacity_factor=cf)
+    mod.bind("moe")
+    # identical params to the dense module (same init key)
+    params = mod.init(jax.random.key(0))
+    return mod, params
+
+
+def test_moe_capacity_matches_dense_when_roomy():
+    """With capacity >= tokens no token drops, so the packed dispatch is
+    numerically the dense dispatch."""
+    dense, params = _moe()
+    cap, _ = _moe_cap(cf=8.0)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 5, 8)),
+                    jnp.float32)
+    np.testing.assert_allclose(np.asarray(cap.apply(x, M.Ctx(params))),
+                               np.asarray(dense.apply(x, M.Ctx(params))),
+                               atol=1e-5)
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """A starving capacity factor loses expert contributions (Switch
+    semantics): outputs differ from dense, and forcing every token onto
+    one expert caps the number served."""
+    dense, params = _moe(k=1)
+    cap, _ = _moe_cap(k=1, cf=0.25)  # C = ceil(1*10/4*0.25) = 1 slot/expert
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(1, 10, 8)),
+                    jnp.float32)
+    out_cap = np.asarray(cap.apply(x, M.Ctx(params)))
+    out_dense = np.asarray(dense.apply(x, M.Ctx(params)))
+    assert not np.allclose(out_cap, out_dense, atol=1e-5)
+    # dropped tokens produce exactly zero rows (top-1: sole contribution
+    # lost); served tokens match dense exactly
+    zero_rows = np.all(np.abs(out_cap) < 1e-7, axis=-1)[0]
+    assert zero_rows.sum() >= 10 - 4  # ≥ tokens - E·C rows dropped
+    served = ~zero_rows
+    np.testing.assert_allclose(out_cap[0][served], out_dense[0][served],
+                               atol=1e-5)
+
+
+def test_moe_capacity_gradients_flow():
+    mod, params = _moe_cap(cf=8.0)
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(1, 4, 8)),
+                    jnp.float32)
+
+    def loss(p):
+        return jnp.sum(mod.apply(x, M.Ctx(p)) ** 2)
+
+    grads = jax.grad(loss)(params)
+    total = sum(float(jnp.sum(jnp.abs(g))) for g in grads.values())
+    assert np.isfinite(total) and total > 0
+
+
+def test_moe_capacity_expert_parallel_matches_replicated(cpu_devices):
+    """Capacity dispatch under the expert axis == single-device result."""
+    mod, params = _moe_cap(e=4, cf=8.0)
+    mesh = mesh_lib.make_mesh(cpu_devices[:4], expert=4)
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(2, 4, 8)),
+                    jnp.float32)
+    expected = mod.apply(x, M.Ctx(params))
+    sharded = {k: jax.device_put(v, jax.sharding.NamedSharding(
+        mesh, sharding.param_spec(k, tuple(v.shape), mesh)))
+        for k, v in params.items()}
+    got = jax.jit(lambda p, xx: mod.apply(xx, M.Ctx(p)))(sharded, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=1e-5)
+
+
+def test_moe_capacity_dsl_validation():
+    with pytest.raises(ValueError, match="dispatch"):
+        M.MixtureOfExperts(8, 16, 4, dispatch="alltoall")
+    with pytest.raises(ValueError, match="capacity_factor"):
+        M.MixtureOfExperts(8, 16, 4, dispatch="capacity",
+                           capacity_factor=0.0)
+
+
+def test_moe_capacity_pads_awkward_token_counts():
+    """Non-divisible (incl. prime) B*T pads with masked rows instead of
+    shrinking the dispatch group; numerics still match dense."""
+    dense, params = _moe()
+    cap, _ = _moe_cap(cf=8.0)
+    for T in (7, 521):  # sub-group prime; prime above DISPATCH_GROUP (pads)
+        x = jnp.asarray(np.random.default_rng(5).normal(size=(1, T, 8)),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(cap.apply(x, M.Ctx(params))),
+                                   np.asarray(dense.apply(x, M.Ctx(params))),
+                                   atol=1e-5)

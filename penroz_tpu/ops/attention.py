@@ -193,11 +193,26 @@ def cached_attention(q, k_full, v_full, offset, length,
     S = k_full.shape[2]
     num_kv_heads = k_full.shape[1]
     qg = _group_query_heads(q, num_kv_heads)
-    q_pos = offset + jnp.arange(T, dtype=jnp.int32)
     key_idx = jnp.arange(S, dtype=jnp.int32)
-    mask = key_idx[None, :] <= q_pos[:, None]  # (T, S)
-    if window is not None:
-        mask &= key_idx[None, :] > q_pos[:, None] - int(window)
+    lengths = jnp.asarray(length, jnp.int32)
+    if lengths.ndim >= 1:
+        # Ragged batch (same contract as the kernels — an ARRAY length of
+        # any size opts in, so a (1,) length with B=1 behaves identically
+        # on the kernel and oracle paths): per-sequence valid lengths,
+        # each row's queries sit at positions length_b - T + t; ``offset``
+        # is ignored, exactly as the kernels derive it from length.
+        from penroz_tpu.ops.pallas.decode_attention import normalize_lengths
+        lengths = normalize_lengths(lengths, B)
+        q_pos = (lengths[:, None] - T) + jnp.arange(T, dtype=jnp.int32)
+        mask = key_idx[None, None, :] <= q_pos[:, :, None]  # (B, T, S)
+        if window is not None:
+            mask &= key_idx[None, None, :] > q_pos[:, :, None] - int(window)
+        mask = mask[:, None, None]  # (B, 1, 1, T, S)
+    else:
+        q_pos = offset + jnp.arange(T, dtype=jnp.int32)
+        mask = key_idx[None, :] <= q_pos[:, None]  # (T, S)
+        if window is not None:
+            mask &= key_idx[None, :] > q_pos[:, None] - int(window)
     out = _attend(qg, k_full, v_full, mask, dropout_rate, dropout_rng)
     return out.reshape(B, Hq, T, D)
 

@@ -42,6 +42,19 @@ DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
 
 
+def normalize_lengths(length, batch: int):
+    """(B,) int32 valid lengths from a scalar (broadcast) or (B,) input —
+    the shared ragged-length contract of both decode kernels and the jnp
+    oracles."""
+    total = jnp.asarray(length, jnp.int32).reshape(-1)
+    if total.shape[0] == 1 and batch > 1:
+        total = jnp.broadcast_to(total, (batch,))
+    if total.shape[0] != batch:
+        raise ValueError(f"length must be scalar or (B,); got "
+                         f"{total.shape[0]} lengths for batch {batch}")
+    return total
+
+
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
                    num_k: int, num_queries: int, sm_scale: float,
                    quantized: bool, window=None):
@@ -52,17 +65,19 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
     With ``quantized`` two extra (1, 1, block_k, 1) refs carry the int8
     tiles' per-token scales and dequantization happens here in VMEM — the
     full-precision cache never exists in HBM.
-    len_ref[0] = offset + T (valid entries).  Scratch carries the online-
-    softmax state across the sequential j dimension.
+    len_ref[b] = that sequence's offset + T valid entries ((B,) prefetch —
+    ragged batches).  Scratch carries the online-softmax state across the
+    sequential j dimension.
     """
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
     else:
         ks_ref = vs_ref = None
         o_ref, m_scr, l_scr, acc_scr = refs
+    b = pl.program_id(0)
     j = pl.program_id(2)
     gt = q_ref.shape[2]
-    total = len_ref[0]
+    total = len_ref[b]  # ragged: per-sequence valid length
     offset = total - num_queries
     hi = jax.lax.div(total + block_k - 1, block_k)
     live = j < hi
@@ -127,7 +142,9 @@ def decode_attention(q, k_full, v_full, offset, length,
                      k_scale=None, v_scale=None, window=None):
     """Fused cached attention.  Same contract as the jnp oracle
     ``cached_attention``: q (B, Hq, T, D); k_full/v_full (B, Hkv, S_max, D);
-    ``length`` = offset + T valid entries (post-append).  With
+    ``length`` = offset + T valid entries (post-append) — a shared scalar
+    or a ``(B,)`` vector for RAGGED batches (each sequence attends only
+    its own occupancy).  With
     ``k_scale``/``v_scale`` (B, Hkv, S_max, 1) the cache is int8 (TurboQuant)
     and tiles dequantize in VMEM."""
     B, Hq, T, D = q.shape
@@ -146,16 +163,17 @@ def decode_attention(q, k_full, v_full, offset, length,
     # Fold the GQA group into the query-row dimension: head order is kv-major
     # (matches _group_query_heads), so this is a pure reshape.
     q_rows = q.reshape(B, Hkv, group * T, D)
-    total = jnp.asarray(length, jnp.int32).reshape(1)
+    total = normalize_lengths(length, B)
 
     def kv_index(b, h, j, len_ref):
         # Clamp out-of-band steps to the nearest band tile: same index ⇒
-        # Pallas elides the copy, so tiles past the occupancy (and, with a
-        # window, tiles below the band) are never fetched from HBM.
-        hi = jax.lax.div(len_ref[0] + block_k - 1, block_k)
-        j_eff = jnp.minimum(j, hi - 1)
+        # Pallas elides the copy, so tiles past the sequence's own
+        # occupancy (and, with a window, tiles below the band) are never
+        # fetched from HBM.
+        hi = jax.lax.div(len_ref[b] + block_k - 1, block_k)
+        j_eff = jnp.minimum(j, jnp.maximum(hi - 1, 0))
         if window is not None:
-            lo_pos = jnp.maximum(len_ref[0] - T - window + 1, 0)
+            lo_pos = jnp.maximum(len_ref[b] - T - window + 1, 0)
             j_eff = jnp.maximum(j_eff, jax.lax.div(lo_pos, block_k))
         return (b, h, j_eff, 0)
 

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from penroz_tpu.ops.pallas.decode_attention import normalize_lengths
+
 _NEG_INF = -1e30
 
 
@@ -33,8 +35,9 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
+    b = pl.program_id(0)
     j = pl.program_id(2)
-    total = len_ref[0]
+    total = len_ref[b]  # ragged: each sequence has its own valid length
     offset = total - num_queries
     gt = q_ref.shape[2]
 
@@ -101,8 +104,11 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
 
     q: (B, Hq, T, D) new queries; flat_k/flat_v: (Hkv, num_pages *
     page_size, D) shared head-major pools; block_table: (B, pages_per_seq)
-    physical page per
-    logical page (-1 = unassigned); ``length`` = offset + T valid tokens.
+    physical page per logical page (-1 = unassigned); ``length`` = offset +
+    T valid tokens — a scalar shared by the batch, or a ``(B,)`` vector for
+    RAGGED batches (each sequence attends only its own occupancy; pages
+    past a shorter sequence's length are skipped per-sequence, the
+    ragged-paged-attention serving layout).
     With ``k_scale``/``v_scale`` (``(Hkv, rows, 1)`` fp32 per-token scales)
     the pools are int8 and each page is dequantized in VMEM (TurboQuant +
     paged).  Matches the jnp oracle (gather + ``cached_attention``) exactly.
@@ -115,7 +121,7 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
     quantized = k_scale is not None
 
     q_rows = q.reshape(B, Hkv, group * T, D)
-    total = jnp.asarray(length, jnp.int32).reshape(1)
+    total = normalize_lengths(length, B)
     # Unassigned pages (-1) sit past the valid length; clamp them onto page
     # 0 so the DMA index is in-pool — their keys are masked by k_pos>total.
     table = jnp.maximum(block_table, 0).astype(jnp.int32).reshape(-1)
@@ -128,12 +134,12 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
 
     def page_lookup(b, j, len_ref, table_ref):
         # Clamp out-of-band steps to the nearest in-band logical page: same
-        # physical index ⇒ the DMA is elided, so pages past the occupancy
-        # (and below the window band) are never fetched.
-        hi = jax.lax.div(len_ref[0] + page_size - 1, page_size)
-        j_eff = jnp.minimum(j, hi - 1)
+        # physical index ⇒ the DMA is elided, so pages past the sequence's
+        # own occupancy (and below the window band) are never fetched.
+        hi = jax.lax.div(len_ref[b] + page_size - 1, page_size)
+        j_eff = jnp.minimum(j, jnp.maximum(hi - 1, 0))
         if window is not None:
-            lo_pos = jnp.maximum(len_ref[0] - T - int(window) + 1, 0)
+            lo_pos = jnp.maximum(len_ref[b] - T - int(window) + 1, 0)
             j_eff = jnp.maximum(j_eff, jax.lax.div(lo_pos, page_size))
         return table_ref[b * pages_per_seq + j_eff]
 

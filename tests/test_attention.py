@@ -620,3 +620,98 @@ def test_paged_kernel_window_matches_oracle_interpret():
             interpret=True, window=window)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, err_msg=f"offset={offset}")
+
+
+def test_decode_kernel_ragged_lengths_interpret():
+    """Per-sequence (B,) lengths: each ragged row matches its own
+    single-sequence scalar-length call."""
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    rng = np.random.default_rng(11)
+    B, Hq, Hkv, T, D, S = 3, 4, 2, 1, 64, 256
+    lengths = np.array([40, 129, 256], np.int32)
+    q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    out = DA.decode_attention(q, k, v, None, jnp.asarray(lengths),
+                              interpret=True)
+    for b in range(B):
+        ref = DA.decode_attention(q[b:b + 1], k[b:b + 1], v[b:b + 1], None,
+                                  int(lengths[b]), interpret=True)
+        np.testing.assert_allclose(np.asarray(out[b]), np.asarray(ref[0]),
+                                   atol=2e-5)
+    # a scalar length still broadcasts over the batch
+    out_s = DA.decode_attention(q, k, v, None, 129, interpret=True)
+    ref_s = DA.decode_attention(q, k, v, None,
+                                jnp.full((B,), 129, jnp.int32),
+                                interpret=True)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(ref_s),
+                               atol=1e-6)
+    with pytest.raises(ValueError, match="scalar or"):
+        DA.decode_attention(q, k, v, None, jnp.ones((2,), jnp.int32),
+                            interpret=True)
+
+
+def test_paged_kernel_ragged_lengths_interpret():
+    """Ragged paged decode: each sequence attends only its own page
+    occupancy (serving-batch layout)."""
+    from penroz_tpu.ops.pallas import paged_attention as PA
+    from penroz_tpu.ops import kv_cache as KV
+    rng = np.random.default_rng(12)
+    B, Hq, Hkv, D, P, pages = 3, 4, 2, 64, 16, 12
+    S_max = P * pages // 2  # pool shared; per-seq capacity 6 pages
+    state = KV.PagedKVState.create([(Hkv, D)], batch=B, max_len=S_max,
+                                   page_size=P)
+    fill = 2 * P + 3
+    k_fill = jnp.asarray(rng.normal(size=(B, Hkv, fill, D)).astype(np.float32))
+    v_fill = jnp.asarray(rng.normal(size=(B, Hkv, fill, D)).astype(np.float32))
+    flat_k, flat_v, _ = state.append_rows(0, k_fill, v_fill)
+    # ragged: sequence b has (fill - 7b) valid tokens (everyone's pages are
+    # allocated to `fill`, shorter rows just stop attending earlier)
+    lengths = jnp.asarray([fill, fill - 7, fill - 14], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, Hq, 1, D)).astype(np.float32))
+    out = PA.paged_decode_attention(q, flat_k, flat_v, state.block_table, P,
+                                    None, lengths, interpret=True)
+    for b in range(B):
+        ref = PA.paged_decode_attention(
+            q[b:b + 1], flat_k, flat_v, state.block_table[b:b + 1], P,
+            None, int(lengths[b]), interpret=True)
+        np.testing.assert_allclose(np.asarray(out[b]), np.asarray(ref[0]),
+                                   atol=2e-5)
+
+
+def test_cached_attention_oracle_ragged_lengths():
+    """The jnp fallback honors the same ragged (B,) length contract as the
+    kernels: each row matches its own scalar-length call (both windowed
+    and full)."""
+    rng = np.random.default_rng(13)
+    B, Hq, Hkv, T, D, S = 3, 4, 2, 1, 16, 64
+    lengths = np.array([9, 33, 64], np.int32)
+    q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    for window in (None, 16):
+        out = A.cached_attention(q, k, v, None, jnp.asarray(lengths),
+                                 platform="cpu", window=window)
+        for b in range(B):
+            ref = A.cached_attention(
+                q[b:b + 1], k[b:b + 1], v[b:b + 1],
+                jnp.asarray(int(lengths[b]) - T), int(lengths[b]),
+                platform="cpu", window=window)
+            np.testing.assert_allclose(np.asarray(out[b]),
+                                       np.asarray(ref[0]), atol=1e-5)
+    with pytest.raises(ValueError, match="scalar or"):
+        A.cached_attention(q, k, v, None, jnp.ones((2,), jnp.int32),
+                           platform="cpu")
+
+
+def test_cached_attention_oracle_ragged_b1():
+    """A (1,)-shaped length with B=1 takes the ragged path (offset=None
+    accepted) and matches the scalar call — kernel/oracle contract parity."""
+    rng = np.random.default_rng(14)
+    q = jnp.asarray(rng.normal(size=(1, 4, 1, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 2, 64, 16)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, 2, 64, 16)).astype(np.float32))
+    out = A.cached_attention(q, k, v, None, jnp.asarray([17], jnp.int32),
+                             platform="cpu")
+    ref = A.cached_attention(q, k, v, jnp.asarray(16), 17, platform="cpu")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)

@@ -352,6 +352,13 @@ class CompiledArch:
         logits = acts[-1]
         if logits.ndim == 3:
             logits = logits[:, -1, :]
+        tok = self._sample(logits, rng, temp, greedy=greedy, top_k=top_k)
+        return tok[:, None], new_kv
+
+    @staticmethod
+    def _sample(logits, rng, temp, *, greedy, top_k):
+        """(B,) next tokens from (B, V) logits: argmax | top-k | categorical
+        (reference sampling: neural_net_model.py:393-405, on-device)."""
         logits = logits.astype(jnp.float32)
         if greedy:
             tok = jnp.argmax(logits, axis=-1)
@@ -363,7 +370,7 @@ class CompiledArch:
                 tok = jnp.take_along_axis(idx, choice[..., None], -1)[..., 0]
             else:
                 tok = jax.random.categorical(rng, logits)
-        return tok.astype(jnp.int32)[:, None], new_kv
+        return tok.astype(jnp.int32)
 
     def decode_fn(self):
         """Dispatcher for single decode/prefill steps (jits per static
@@ -1027,10 +1034,7 @@ class NeuralNetworkModel:
         64+16+8+4+2+1).  ``ramp=True`` (streaming) starts at 8 and doubles
         per dispatch so early tokens flow without waiting on a full chunk.
         """
-        greedy = temperature is None or float(temperature) == 0.0
-        temp = jnp.asarray(float(temperature) if temperature else 1.0,
-                           jnp.float32)
-        self._sample_rng, call_rng = jax.random.split(self._sample_rng)
+        greedy, temp, call_rng = self._sampling_setup(temperature)
         chunk_budget = max(1, int(os.environ.get(DECODE_CHUNK_ENV, "128")))
         ramp_budget = 8 if ramp else chunk_budget
         decode = self.arch.decode_fn()
@@ -1128,6 +1132,124 @@ class NeuralNetworkModel:
             pending = new_pending
         if pending is not None and produced < max_new_tokens:
             yield from flush(pending)
+
+    def generate_tokens_batched(self, inputs, block_size, max_new_tokens,
+                                temperature=1.0, top_k=None,
+                                stop_token=None) -> list[list[int]]:
+        """RAGGED batched generation — N prompts of different lengths share
+        one forward per step (beyond the reference, whose generate path is
+        single-sequence: neural_net_model.py:457-479).
+
+        Right-padded batched prefill (each row samples at its own last
+        prompt position), then per-sequence cache lengths drive ragged
+        decode: every row's K/V append, RoPE/position offset, and
+        attention mask use that row's own length (ops/kv_cache.py
+        ``with_lengths``, the ragged kernels/oracle).  Greedy outputs are
+        bit-identical to N separate ``generate_tokens`` calls (tested).
+
+        Contract: ``max(prompt) + max_new_tokens <= block_size`` — the
+        batched path has no overflow crop/re-prefill.  Uses the plain fp
+        cache regardless of the paged/int8 env flags (shared-length pools
+        don't do ragged yet).
+        """
+        prompts = [[int(t) for t in (row if isinstance(row, (list, tuple))
+                                     else [row])] for row in inputs]
+        if not prompts or any(not p for p in prompts):
+            raise ValueError("each batched prompt needs at least one token")
+        B = len(prompts)
+        lens = [len(p) for p in prompts]
+        max_p = max(lens)
+        if max_p + max_new_tokens > block_size:
+            raise ValueError(
+                f"batched generation needs max prompt ({max_p}) + "
+                f"max_new_tokens ({max_new_tokens}) <= block_size "
+                f"({block_size}); crop prompts first")
+        greedy, temp, call_rng = self._sampling_setup(temperature)
+        # Same compute dtype as the single-sequence decode path (its
+        # decode_fn default) — anything else would break the documented
+        # batched ≡ single greedy parity on near-tied logits.
+        compute_dtype = None
+        arch = self.arch
+
+        key = ("bprefill", bool(greedy), top_k, str(compute_dtype),
+               self._platform)
+        prefill = arch._jit_cache.get(key)
+        if prefill is None:
+            def prefill_fn(p, bufs, kv0, toks, lengths, r, tmp):
+                acts, _, _, kv1 = arch.forward(
+                    p, bufs, toks, None, kv=kv0, skip_softmax=True,
+                    compute_dtype=compute_dtype, platform=self._platform)
+                logits = acts[-1]
+                last = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                tok = arch._sample(last, r, tmp, greedy=greedy, top_k=top_k)
+                return tok, kv1.with_lengths(lengths)
+            prefill = arch._jit_cache[key] = jax.jit(
+                prefill_fn, donate_argnums=(2,))
+
+        key_d = ("bdecode", bool(greedy), top_k, str(compute_dtype),
+                 self._platform)
+        decode = arch._jit_cache.get(key_d)
+        if decode is None:
+            def decode_fn(p, bufs, kv0, tok, r, tmp):
+                acts, _, _, kv1 = arch.forward(
+                    p, bufs, tok[:, None], None, kv=kv0, skip_softmax=True,
+                    compute_dtype=compute_dtype, platform=self._platform)
+                logits = acts[-1]
+                if logits.ndim == 3:
+                    logits = logits[:, -1]
+                nxt = arch._sample(logits, r, tmp, greedy=greedy,
+                                   top_k=top_k)
+                return nxt, kv1
+            decode = arch._jit_cache[key_d] = jax.jit(
+                decode_fn, donate_argnums=(2,))
+
+        outs = [list(p) for p in prompts]
+        if max_new_tokens <= 0:
+            return outs
+        padded = np.zeros((B, max_p), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, :len(p)] = p
+        kv = KV.KVState.create(arch.kv_specs, B, block_size,
+                               self._kv_dtype())
+        lengths = jnp.asarray(lens, jnp.int32)
+        done = [False] * B
+
+        def absorb(arr):
+            for i, t in enumerate(arr):
+                if not done[i]:
+                    outs[i].append(int(t))
+                    done[i] = (stop_token is not None
+                               and int(t) == stop_token)
+
+        prev, kv = prefill(self.params, self.buffers, kv,
+                           jnp.asarray(padded), lengths,
+                           jax.random.fold_in(call_rng, 0), temp)
+        # Pipeline depth 1: dispatch the next step, then read the previous
+        # step's tokens while the device runs — the host transfer never
+        # blocks fresh compute (a step dispatched past an all-rows stop is
+        # simply abandoned, as in _generate_iter).
+        for step in range(1, max_new_tokens):
+            nxt, kv = decode(self.params, self.buffers, kv, prev,
+                             jax.random.fold_in(call_rng, step), temp)
+            absorb(np.asarray(prev))
+            if all(done):
+                prev = None
+                break
+            prev = nxt
+        if prev is not None:
+            absorb(np.asarray(prev))
+        return outs
+
+    def _sampling_setup(self, temperature):
+        """Shared generation preamble: (greedy, temp scalar, call rng).
+        None/0.0 temperature means greedy; falsy maps the scalar to 1.0
+        (reference sampling knobs: neural_net_model.py:393-405)."""
+        greedy = temperature is None or float(temperature) == 0.0
+        temp = jnp.asarray(float(temperature) if temperature else 1.0,
+                           jnp.float32)
+        self._sample_rng, call_rng = jax.random.split(self._sample_rng)
+        return greedy, temp, call_rng
 
     @staticmethod
     def _prompt_tokens(input) -> list[int]:

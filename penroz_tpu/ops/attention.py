@@ -40,15 +40,22 @@ def _llama3_scale_inv_freq(inv_freq, scaling: dict):
 
 def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype,
                  scaling: Optional[dict] = None):
-    """cos/sin tables of shape (length, head_dim) starting at ``offset``.
+    """cos/sin tables of shape (length, head_dim) starting at ``offset`` —
+    or (B, length, head_dim) when ``offset`` is a (B,) vector (ragged
+    batches: each sequence rotates from its own position).
 
     ``scaling``: an HF ``rope_scaling`` dict with ``rope_type='llama3'``
     rescales the inverse frequencies (Llama 3.1+ long-context models)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     if scaling:
         inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
-    t = offset.astype(jnp.float32) + jnp.arange(length, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv_freq)
+    steps = jnp.arange(length, dtype=jnp.float32)
+    offset = jnp.asarray(offset)
+    if offset.ndim >= 1:
+        t = offset.astype(jnp.float32)[:, None] + steps  # (B, length)
+    else:
+        t = offset.astype(jnp.float32) + steps
+    freqs = t[..., None] * inv_freq
     emb = jnp.concatenate([freqs, freqs], axis=-1)
     return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
 
@@ -66,16 +73,21 @@ def apply_rope(q, k, theta: float, offset, scaling: Optional[dict] = None,
     ``rotary_pct``): only the first ``rotary_dim`` feature dims are
     rotated, the rest pass through unchanged."""
     head_dim = q.shape[-1]
+
+    def expand(tbl):
+        # (L, rd) → (1, 1, L, rd); (B, L, rd) ragged → (B, 1, L, rd)
+        return tbl[:, None] if tbl.ndim == 3 else tbl[None, None]
+
     if rotary_dim is None or rotary_dim >= head_dim:
         cos, sin = rope_cos_sin(head_dim, theta, offset, q.shape[2], q.dtype,
                                 scaling=scaling)
-        cos, sin = cos[None, None], sin[None, None]
+        cos, sin = expand(cos), expand(sin)
         q = q * cos + _rotate_half(q) * sin
         k = k * cos + _rotate_half(k) * sin
         return q, k
     cos, sin = rope_cos_sin(rotary_dim, theta, offset, q.shape[2], q.dtype,
                             scaling=scaling)
-    cos, sin = cos[None, None], sin[None, None]
+    cos, sin = expand(cos), expand(sin)
     q_rot, q_pass = q[..., :rotary_dim], q[..., rotary_dim:]
     k_rot, k_pass = k[..., :rotary_dim], k[..., rotary_dim:]
     q_rot = q_rot * cos + _rotate_half(q_rot) * sin

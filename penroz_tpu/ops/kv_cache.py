@@ -106,12 +106,33 @@ class KVState:
 
         Does NOT advance ``length`` — the model runtime advances it once per
         step via ``advanced(T)`` after all layers have appended.
+
+        With RAGGED (B,) lengths (``with_lengths``) each sequence's row is
+        written at its own position; restricted to single-token appends
+        (T = 1) — the batched decode hot loop.
         """
-        start = (0, 0, self.length, 0)
-        self.k[layer_idx] = jax.lax.dynamic_update_slice(
-            self.k[layer_idx], k_new.astype(self.k[layer_idx].dtype), start)
-        self.v[layer_idx] = jax.lax.dynamic_update_slice(
-            self.v[layer_idx], v_new.astype(self.v[layer_idx].dtype), start)
+        ragged = jnp.ndim(self.length) >= 1
+        if ragged:
+            T = k_new.shape[2]
+            if T != 1:
+                raise ValueError(
+                    f"ragged KVState appends require T=1 (per-sequence "
+                    f"write positions); got T={T}")
+            b_idx = jnp.arange(k_new.shape[0])
+            self.k[layer_idx] = self.k[layer_idx].at[
+                b_idx, :, self.length].set(
+                k_new[:, :, 0].astype(self.k[layer_idx].dtype))
+            self.v[layer_idx] = self.v[layer_idx].at[
+                b_idx, :, self.length].set(
+                v_new[:, :, 0].astype(self.v[layer_idx].dtype))
+        else:
+            start = (0, 0, self.length, 0)
+            self.k[layer_idx] = jax.lax.dynamic_update_slice(
+                self.k[layer_idx], k_new.astype(self.k[layer_idx].dtype),
+                start)
+            self.v[layer_idx] = jax.lax.dynamic_update_slice(
+                self.v[layer_idx], v_new.astype(self.v[layer_idx].dtype),
+                start)
         new_length = self.length + k_new.shape[2]
         return self.k[layer_idx], self.v[layer_idx], new_length
 
@@ -121,6 +142,17 @@ class KVState:
 
     def reset(self):
         return self._with_length(jnp.zeros((), jnp.int32))
+
+    def with_lengths(self, lengths):
+        """State with RAGGED per-sequence (B,) valid lengths — installed
+        after a right-padded batched prefill (rows past a sequence's
+        length hold garbage that the per-sequence masks never attend);
+        subsequent appends write each row at its own position."""
+        if type(self) is not KVState:
+            raise NotImplementedError(
+                "ragged per-sequence lengths are supported on the plain fp "
+                "KVState only (int8/paged pools keep a shared length)")
+        return self._with_length(jnp.asarray(lengths, jnp.int32))
 
     def _with_length(self, length):
         return KVState(list(self.k), list(self.v), length)

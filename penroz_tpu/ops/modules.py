@@ -226,8 +226,12 @@ class PositionEmbedding(Embedding):
         # Per-index clamping (jnp.take) — a dynamic slice would shift the
         # whole window on overflow, corrupting still-valid positions.  The
         # scatter in this VJP touches at most num_positions contiguous rows,
-        # which XLA handles fine.
-        positions = ctx.offset() + jnp.arange(num_positions, dtype=jnp.int32)
+        # which XLA handles fine.  A (B,) offset (ragged batches) yields
+        # per-sequence position rows (B, T) → (B, T, d).
+        offset = jnp.asarray(ctx.offset())
+        steps = jnp.arange(num_positions, dtype=jnp.int32)
+        positions = (offset[:, None] + steps if offset.ndim >= 1
+                     else offset + steps)
         return jnp.take(self._p(ctx, "weight"), positions, axis=0)
 
 

@@ -511,3 +511,96 @@ def test_generate_paged_overflow_reprefills(workdir, toy_gpt_layers,
     assert len(tokens) == 13
 
 
+
+
+def test_batched_generate_matches_single(workdir, toy_gpt_layers):
+    """Ragged batched greedy generation == per-prompt single-sequence
+    generation, for prompts of different lengths (the per-sequence cache
+    lengths / RoPE offsets / masks must reproduce the B=1 math exactly)."""
+    model = NeuralNetworkModel("bg", Mapper(toy_gpt_layers, SGD))
+    prompts = [[1, 2, 3, 4, 5], [7, 8], [9, 10, 11]]
+    batched = model.generate_tokens_batched(prompts, block_size=16,
+                                            max_new_tokens=6,
+                                            temperature=0.0)
+    for p, out in zip(prompts, batched):
+        single = model.generate_tokens([p], block_size=16, max_new_tokens=6,
+                                       temperature=0.0)
+        assert out == single, (p, out, single)
+
+
+def test_batched_generate_stop_token_and_validation(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("bg2", Mapper(toy_gpt_layers, SGD))
+    # a stop token freezes only that row; others keep generating
+    ref = model.generate_tokens_batched([[1, 2], [3, 4, 5]], block_size=16,
+                                        max_new_tokens=5, temperature=0.0)
+    stop = ref[0][2]  # first generated token of row 0
+    out = model.generate_tokens_batched([[1, 2], [3, 4, 5]], block_size=16,
+                                        max_new_tokens=5, temperature=0.0,
+                                        stop_token=int(stop))
+    cut0 = ref[0].index(stop) + 1
+    assert out[0] == ref[0][:cut0]  # row 0 halted at its stop token
+    # row 1 halts at ITS OWN first stop occurrence (or not at all) — by
+    # greedy determinism this proves row 0's stop never froze row 1 early
+    gen1 = ref[1][3:]
+    if stop in gen1:
+        cut1 = 3 + gen1.index(stop) + 1
+        assert out[1] == ref[1][:cut1]
+    else:
+        assert out[1] == ref[1]
+    # max_new_tokens=0 generates nothing (single-path parity)
+    assert model.generate_tokens_batched([[1, 2]], block_size=16,
+                                         max_new_tokens=0,
+                                         temperature=0.0) == [[1, 2]]
+    with pytest.raises(ValueError, match="block_size"):
+        model.generate_tokens_batched([[1] * 14], block_size=16,
+                                      max_new_tokens=6, temperature=0.0)
+    with pytest.raises(ValueError, match="at least one token"):
+        model.generate_tokens_batched([[1], []], block_size=16,
+                                      max_new_tokens=2, temperature=0.0)
+
+
+def test_batched_generate_sampled_ranges(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("bg3", Mapper(toy_gpt_layers, SGD))
+    outs = model.generate_tokens_batched([[1], [2, 3]], block_size=16,
+                                         max_new_tokens=4, temperature=0.9,
+                                         top_k=8)
+    assert len(outs) == 2
+    assert outs[0][:1] == [1] and outs[1][:2] == [2, 3]
+    for o in outs:
+        assert all(0 <= t < 64 for t in o)
+
+
+def test_batched_generate_matches_single_rope_gqa(workdir):
+    """Batched == single for a RoPE+GQA stack (per-sequence rotary offsets
+    through the ragged decode path)."""
+    d, heads, kv, vocab = 32, 4, 2, 64
+    layers = ([{"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+                "normal": {"mean": 0.0, "std": 0.05}}]
+              + [{"transformerblock": {
+                  "attn_block": {"sequential": [
+                      {"rmsnorm": {"normalized_shape": d}},
+                      {"linear": {"in_features": d,
+                                  "out_features": (heads + 2 * kv) * 8,
+                                  "bias": False}},
+                      {"attention": {"num_heads": heads, "num_kv_heads": kv,
+                                     "rope_theta": 10000.0, "head_dim": 8}},
+                      {"linear": {"in_features": heads * 8,
+                                  "out_features": d, "bias": False}}]},
+                  "mlp_block": {"sequential": [
+                      {"rmsnorm": {"normalized_shape": d}},
+                      {"gatedmlp": {"in_features": d,
+                                    "intermediate_size": 2 * d}}]},
+                  "post_norm_on_residual": False}} for _ in range(2)]
+              + [{"rmsnorm": {"normalized_shape": d}},
+                 {"linear": {"in_features": d, "out_features": vocab,
+                             "bias": False}},
+                 {"softmaxlast": {"dim": -1}}])
+    model = NeuralNetworkModel("bgrope", Mapper(layers, SGD))
+    prompts = [[5, 6, 7, 8], [11, 12]]
+    batched = model.generate_tokens_batched(prompts, block_size=16,
+                                            max_new_tokens=5,
+                                            temperature=0.0)
+    for p, out in zip(prompts, batched):
+        single = model.generate_tokens([p], block_size=16, max_new_tokens=5,
+                                       temperature=0.0)
+        assert out == single, (p, out, single)

@@ -253,6 +253,20 @@ async def model_generate(request: web.Request):
     return _json({"tokens": tokens})
 
 
+async def model_generate_batch(request: web.Request):
+    """Ragged batched generation — N prompts share one forward per step
+    (beyond the reference surface; its /generate/ is single-sequence)."""
+    body = await _parse(request, schemas.GenerateBatchRequest)
+    log.info("Batch-generating %d sequence(s) using model %s",
+             len(body.inputs), body.model_id)
+    model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
+    sequences = await _run_blocking(
+        lambda: model.generate_tokens_batched(
+            body.inputs, body.block_size, body.max_new_tokens,
+            body.temperature, body.top_k, body.stop_token))
+    return _json({"sequences": sequences})
+
+
 async def decode_tokens(request: web.Request):
     body = await _parse(request, schemas.DecodeTokensRequest)
     log.info("Requesting decoding of %d token(s)", len(body.tokens))
@@ -424,6 +438,7 @@ def create_app() -> web.Application:
     app.router.add_post("/output/", compute_model_output)
     app.router.add_post("/evaluate/", evaluate_model)
     app.router.add_post("/generate/", model_generate)
+    app.router.add_post("/generate_batch/", model_generate_batch)
     app.router.add_post("/decode/", decode_tokens)
     app.router.add_put("/train/", train_model)
     app.router.add_post("/profile/", profile)

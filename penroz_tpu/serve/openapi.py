@@ -119,6 +119,13 @@ def _routes() -> list[dict]:
              summary="Generate tokens (set stream:true for one per line)",
              body=_body("GenerateRequest"),
              responses=dict([ok, _resp(404, "Unknown model")])),
+        dict(method="post", path="/generate_batch/",
+             summary="Ragged batched generation: N prompts of different "
+                     "lengths share one forward per step",
+             body=_body("GenerateBatchRequest"),
+             responses=dict([ok, _resp(404, "Unknown model"),
+                             _resp(400, "Prompt + max_new_tokens exceeds "
+                                        "block_size, or an empty prompt")])),
         dict(method="post", path="/decode/", summary="Decode token ids",
              body=_body("DecodeTokensRequest"), responses=dict([ok])),
         dict(method="put", path="/train/",
@@ -150,7 +157,8 @@ def build_spec() -> dict:
         schemas.CreateModelRequest, schemas.ImportModelRequest,
         schemas.DownloadDatasetRequest, schemas.TokenizeTextRequest,
         schemas.OutputRequest, schemas.EvaluateRequest,
-        schemas.GenerateRequest, schemas.DecodeTokensRequest,
+        schemas.GenerateRequest, schemas.GenerateBatchRequest,
+        schemas.DecodeTokensRequest,
         schemas.TrainingRequest, schemas.ProfileRequest,
     ]
     _, defs = models_json_schema(

@@ -70,6 +70,19 @@ class GenerateRequest(ModelRequest):
     stream: bool = Field(False, description="Stream tokens as produced")
 
 
+class GenerateBatchRequest(ModelRequest):
+    inputs: list[list[int]] = Field(
+        ..., description="N prompt token lists (different lengths allowed — "
+        "ragged batched decode shares one forward per step)")
+    block_size: int = Field(..., description="Max context length; must fit "
+                            "max prompt + max_new_tokens")
+    max_new_tokens: int = Field(..., description="Max tokens per sequence")
+    temperature: float = Field(1.0, description="Logits temperature")
+    top_k: Optional[int] = Field(None, description="Top-K sampling")
+    stop_token: Optional[int] = Field(None, description="Per-row early-stop "
+                                      "token id")
+
+
 class DecodeTokensRequest(TokenizerRequest):
     tokens: list[int] = Field(..., description="Token ids to decode")
 

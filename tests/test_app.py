@@ -97,6 +97,29 @@ def test_generate_route(client):
     assert len(body["tokens"]) == 5
 
 
+def test_generate_batch_route(client):
+    """/generate_batch/: ragged prompts, per-row greedy outputs equal the
+    single-sequence route."""
+    _create_model(client)
+    status, body = client.json("POST", "/generate_batch/", json={
+        "model_id": "m1", "inputs": [[1, 2, 3], [5]], "block_size": 8,
+        "max_new_tokens": 3, "temperature": 0.0})
+    assert status == 200
+    assert len(body["sequences"]) == 2
+    assert body["sequences"][0][:3] == [1, 2, 3]
+    assert body["sequences"][1][:1] == [5]
+    for row in body["sequences"]:
+        _, single = client.json("POST", "/generate/", json={
+            "model_id": "m1", "input": [row[:len(row) - 3]], "block_size": 8,
+            "max_new_tokens": 3, "temperature": 0.0})
+        assert single["tokens"] == row
+    # oversized request → 400
+    status, _ = client.json("POST", "/generate_batch/", json={
+        "model_id": "m1", "inputs": [[1] * 7], "block_size": 8,
+        "max_new_tokens": 3, "temperature": 0.0})
+    assert status == 400
+
+
 def test_generate_streaming(client):
     _create_model(client)
     resp, body = client.request("POST", "/generate/", json={

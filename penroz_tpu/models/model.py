@@ -50,6 +50,21 @@ log = logging.getLogger(__name__)
 DECODE_CHUNK_ENV = "PENROZ_DECODE_CHUNK"
 
 
+def _chunk_budget() -> int:
+    """Decode steps fused per dispatch (PENROZ_DECODE_CHUNK, default 128)."""
+    return max(1, int(os.environ.get(DECODE_CHUNK_ENV, "128")))
+
+
+def _decode_chunk_size(remaining: int, cap: int) -> int:
+    """Pow-2 ceiling of the remaining tail, clipped by ``cap`` (a non-pow-2
+    cap floors back down) — the bounded-program-set chunk policy shared by
+    the single-sequence and batched decode loops."""
+    chunk = min(1 << (remaining - 1).bit_length(), cap)
+    if chunk & (chunk - 1):
+        chunk = 1 << (chunk.bit_length() - 1)
+    return chunk
+
+
 def _resolve_device(device: Optional[str]):
     """Map an API device string to a jax.Device (None = leave placement).
 
@@ -1035,7 +1050,7 @@ class NeuralNetworkModel:
         per dispatch so early tokens flow without waiting on a full chunk.
         """
         greedy, temp, call_rng = self._sampling_setup(temperature)
-        chunk_budget = max(1, int(os.environ.get(DECODE_CHUNK_ENV, "128")))
+        chunk_budget = _chunk_budget()
         ramp_budget = 8 if ramp else chunk_budget
         decode = self.arch.decode_fn()
         # Cache layout (contiguous / paged / int8) is env-configured; the
@@ -1104,12 +1119,8 @@ class NeuralNetworkModel:
                     with profiling.span("penroz/decode_chunk"):
                         room = block_size - cache_len
                         remaining = max_new_tokens - dispatched
-                        cap = min(chunk_budget, ramp_budget, room)
-                        # pow-2 ceiling of the tail, clipped by the cap;
-                        # a non-pow-2 cap floors back down.
-                        chunk = min(1 << (remaining - 1).bit_length(), cap)
-                        if chunk & (chunk - 1):
-                            chunk = 1 << (chunk.bit_length() - 1)
+                        chunk = _decode_chunk_size(
+                            remaining, min(chunk_budget, ramp_budget, room))
                         count = min(chunk, remaining)
                         toks_arr, kv = self.arch.decode_chunk(
                             self.params, self.buffers, kv,
@@ -1187,23 +1198,6 @@ class NeuralNetworkModel:
             prefill = arch._jit_cache[key] = jax.jit(
                 prefill_fn, donate_argnums=(2,))
 
-        key_d = ("bdecode", bool(greedy), top_k, str(compute_dtype),
-                 self._platform)
-        decode = arch._jit_cache.get(key_d)
-        if decode is None:
-            def decode_fn(p, bufs, kv0, tok, r, tmp):
-                acts, _, _, kv1 = arch.forward(
-                    p, bufs, tok[:, None], None, kv=kv0, skip_softmax=True,
-                    compute_dtype=compute_dtype, platform=self._platform)
-                logits = acts[-1]
-                if logits.ndim == 3:
-                    logits = logits[:, -1]
-                nxt = arch._sample(logits, r, tmp, greedy=greedy,
-                                   top_k=top_k)
-                return nxt, kv1
-            decode = arch._jit_cache[key_d] = jax.jit(
-                decode_fn, donate_argnums=(2,))
-
         outs = [list(p) for p in prompts]
         if max_new_tokens <= 0:
             return outs
@@ -1225,20 +1219,37 @@ class NeuralNetworkModel:
         prev, kv = prefill(self.params, self.buffers, kv,
                            jnp.asarray(padded), lengths,
                            jax.random.fold_in(call_rng, 0), temp)
-        # Pipeline depth 1: dispatch the next step, then read the previous
-        # step's tokens while the device runs — the host transfer never
-        # blocks fresh compute (a step dispatched past an all-rows stop is
-        # simply abandoned, as in _generate_iter).
-        for step in range(1, max_new_tokens):
-            nxt, kv = decode(self.params, self.buffers, kv, prev,
-                             jax.random.fold_in(call_rng, step), temp)
-            absorb(np.asarray(prev))
-            if all(done):
-                prev = None
-                break
-            prev = nxt
-        if prev is not None:
-            absorb(np.asarray(prev))
+        absorb(np.asarray(prev))
+        # Fused chunked decode (same scan programs as _generate_iter's
+        # decode_chunk, same pow-2-ceiling tails): up to PENROZ_DECODE_CHUNK
+        # steps per dispatch instead of one.  The overshoot bound uses the
+        # longest prompt, which every row's capacity satisfies (validated
+        # above); tokens scanned past an all-rows stop are abandoned.
+        # With a stop_token, ramp from 8 doubling per dispatch (as the
+        # streaming path does) so an early stop wastes at most the current
+        # ramp chunk, not a full budget of fused steps.
+        chunk_budget = _chunk_budget()
+        ramp_budget = 8 if stop_token is not None else chunk_budget
+        last = prev[:, None]
+        dispatched = 1
+        while dispatched < max_new_tokens and not all(done):
+            remaining = max_new_tokens - dispatched
+            room = block_size - max_p - dispatched
+            chunk = _decode_chunk_size(remaining,
+                                       min(chunk_budget, ramp_budget, room))
+            count = min(chunk, remaining)
+            ramp_budget = min(ramp_budget * 2, chunk_budget)
+            toks, kv = arch.decode_chunk(
+                self.params, self.buffers, kv, last,
+                jax.random.fold_in(call_rng, dispatched), temp, chunk=chunk,
+                greedy=greedy, top_k=top_k, platform=self._platform)
+            arr = np.asarray(toks)[:, :count]
+            for col in range(count):
+                absorb(arr[:, col])
+                if all(done):
+                    break
+            last = toks[:, -1:]
+            dispatched += count
         return outs
 
     def _sampling_setup(self, temperature):

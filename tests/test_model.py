@@ -604,3 +604,34 @@ def test_batched_generate_matches_single_rope_gqa(workdir):
         single = model.generate_tokens([p], block_size=16, max_new_tokens=5,
                                        temperature=0.0)
         assert out == single, (p, out, single)
+
+
+def test_batched_generate_matches_single_sliding_window(workdir):
+    """Batched == single for a sliding-window attention stack (per-sequence
+    ragged masks combined with the window band)."""
+    d, heads, vocab = 32, 4, 64
+    layers = ([{"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+                "normal": {"mean": 0.0, "std": 0.05}}]
+              + [{"residual": [
+                  {"sequential": [
+                      {"rmsnorm": {"normalized_shape": d}},
+                      {"linear": {"in_features": d, "out_features": 3 * d,
+                                  "bias": False}},
+                      {"attention": {"num_heads": heads,
+                                     "rope_theta": 10000.0,
+                                     "sliding_window": 6}},
+                      {"linear": {"in_features": d, "out_features": d,
+                                  "bias": False}}]}]} for _ in range(2)]
+              + [{"rmsnorm": {"normalized_shape": d}},
+                 {"linear": {"in_features": d, "out_features": vocab,
+                             "bias": False}},
+                 {"softmaxlast": {"dim": -1}}])
+    model = NeuralNetworkModel("bgwin", Mapper(layers, SGD))
+    prompts = [[5, 6, 7, 8, 9, 10, 11], [21, 22]]
+    batched = model.generate_tokens_batched(prompts, block_size=16,
+                                            max_new_tokens=6,
+                                            temperature=0.0)
+    for p, out in zip(prompts, batched):
+        single = model.generate_tokens([p], block_size=16, max_new_tokens=6,
+                                       temperature=0.0)
+        assert out == single, (p, out, single)

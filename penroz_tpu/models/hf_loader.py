@@ -1,0 +1,135 @@
+"""Torch-free HuggingFace weight loading.
+
+Loads model weights straight from safetensors files — single-file
+``model.safetensors`` or sharded via ``model.safetensors.index.json`` —
+into float32 numpy arrays.  No torch anywhere in this path: the
+reference loads through torch because it *is* torch
+(reference: neural_net_model.py:200-206); on TPU the natural load is
+safetensors → numpy → jnp pytree (SURVEY §2.3).  bf16 tensors come out
+as ml_dtypes.bfloat16 numpy arrays and are upcast to float32 for the
+mapper's transpose/concat work (the model casts back to bf16 on load).
+
+Torch ``pytorch_model.bin`` checkpoints are handled only as a fallback
+when the repo ships no safetensors AND torch happens to be importable.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SAFETENSORS_PATTERNS = ["*.safetensors", "*.safetensors.index.json",
+                         "config.json", "generation_config.json"]
+_BIN_PATTERNS = ["pytorch_model*.bin", "pytorch_model.bin.index.json"]
+
+
+def resolve_checkpoint_dir(repo_or_path: str,
+                           revision: Optional[str] = None) -> str:
+    """Local directory containing the checkpoint: a path is used as-is,
+    anything else is fetched from the HF hub (config + weights only).
+    Safetensors are fetched first; torch ``pytorch_model*.bin`` only when
+    the repo ships no safetensors (avoids doubling the transfer for repos
+    carrying both formats)."""
+    if os.path.isdir(repo_or_path):
+        return repo_or_path
+    from huggingface_hub import snapshot_download
+    local = snapshot_download(repo_or_path, revision=revision,
+                              allow_patterns=_SAFETENSORS_PATTERNS)
+    if not any(f.endswith(".safetensors") for f in os.listdir(local)):
+        local = snapshot_download(repo_or_path, revision=revision,
+                                  allow_patterns=_SAFETENSORS_PATTERNS
+                                  + _BIN_PATTERNS)
+    return local
+
+
+def _load_safetensors_file(path: str) -> dict:
+    from safetensors.numpy import load_file
+    return load_file(path)
+
+
+def _to_f32(sd: dict) -> dict:
+    out = {}
+    for key, value in sd.items():
+        arr = np.asarray(value)
+        if arr.dtype != np.float32 and arr.dtype.kind in ("f", "V"):
+            # 'V' covers ml_dtypes custom dtypes (bfloat16, fp8) seen as
+            # void by older numpy introspection; astype handles both.
+            arr = arr.astype(np.float32)
+        out[key] = arr
+    return out
+
+
+def load_state_dict(local_dir: str) -> dict:
+    """Checkpoint dir → {name: float32 numpy array}.
+
+    Preference order: sharded safetensors index, single
+    ``model.safetensors``, any ``*.safetensors`` files, then the torch
+    fallback (requires torch; loads ``*.bin``)."""
+    index = os.path.join(local_dir, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        shards = sorted(set(weight_map.values()))
+    elif os.path.exists(os.path.join(local_dir, "model.safetensors")):
+        shards = ["model.safetensors"]
+    else:
+        shards = sorted(f for f in os.listdir(local_dir)
+                        if f.endswith(".safetensors"))
+    if not shards:
+        return _normalize(_load_torch_fallback(local_dir))
+    sd = {}
+    for shard in shards:
+        # convert per shard so the bf16 copy is freed before the next load
+        sd.update(_to_f32(_load_safetensors_file(
+            os.path.join(local_dir, shard))))
+    return _normalize(sd)
+
+
+def _normalize(sd: dict) -> dict:
+    """Canonicalize raw-checkpoint key layouts to the ForCausalLM naming
+    the mapper dispatches on.  The original ``gpt2`` hub checkpoints were
+    saved from the bare base model, so their keys lack the
+    ``transformer.`` prefix (``wte.weight``, ``h.0.ln_1.weight``, no
+    ``lm_head``); transformers' from_pretrained papers over that with
+    base_model_prefix retrying — we do the same normalization here."""
+    if "wte.weight" in sd and "transformer.wte.weight" not in sd:
+        sd = {(k if k.startswith("lm_head.") else f"transformer.{k}"): v
+              for k, v in sd.items()}
+    return sd
+
+
+def _load_torch_fallback(local_dir: str) -> dict:
+    # Only weight files — a bare *.bin glob would also pick up non-weight
+    # pickles like training_args.bin and fail under weights_only=True.
+    bin_index = os.path.join(local_dir, "pytorch_model.bin.index.json")
+    if os.path.exists(bin_index):
+        with open(bin_index) as f:
+            bins = sorted(set(json.load(f)["weight_map"].values()))
+    else:
+        bins = sorted(f for f in os.listdir(local_dir)
+                      if f.startswith("pytorch_model") and
+                      f.endswith(".bin"))
+    if not bins:
+        raise FileNotFoundError(
+            f"no safetensors or pytorch_model*.bin weight files in "
+            f"{local_dir}")
+    try:
+        import torch
+    except ImportError as e:
+        raise RuntimeError(
+            f"{local_dir} has only torch .bin weights and torch is not "
+            f"installed; re-export the checkpoint as safetensors") from e
+    log.warning("No safetensors in %s — falling back to torch .bin load",
+                local_dir)
+    sd = {}
+    for name in bins:
+        blob = torch.load(os.path.join(local_dir, name), map_location="cpu",
+                          weights_only=True)
+        for key, value in blob.items():
+            sd[key] = value.detach().cpu().float().numpy()
+    return _to_f32(sd)

@@ -47,6 +47,9 @@ from penroz_tpu.utils import checkpoint, profiling, stats as stats_lib
 
 log = logging.getLogger(__name__)
 
+# Warn-once latch: batched generation ignores the paged/int8 KV env flags.
+_WARNED_BATCHED_KV_FLAGS = False
+
 DECODE_CHUNK_ENV = "PENROZ_DECODE_CHUNK"
 
 
@@ -1167,6 +1170,29 @@ class NeuralNetworkModel:
                                      else [row])] for row in inputs]
         if not prompts or any(not p for p in prompts):
             raise ValueError("each batched prompt needs at least one token")
+        try:
+            max_batch = max(1, int(
+                os.environ.get("PENROZ_MAX_GENERATE_BATCH", "64")))
+        except ValueError:
+            log.warning("Unparseable PENROZ_MAX_GENERATE_BATCH=%r; "
+                        "using default 64",
+                        os.environ.get("PENROZ_MAX_GENERATE_BATCH"))
+            max_batch = 64
+        if len(prompts) > max_batch:
+            raise ValueError(
+                f"batched generation accepts at most {max_batch} prompts "
+                f"(got {len(prompts)}; raise PENROZ_MAX_GENERATE_BATCH to "
+                f"override) — each row allocates a block_size KV cache per "
+                f"layer")
+        if KV.turbo_quant_enabled() or KV.paged_enabled():
+            global _WARNED_BATCHED_KV_FLAGS
+            if not _WARNED_BATCHED_KV_FLAGS:
+                _WARNED_BATCHED_KV_FLAGS = True
+                log.warning(
+                    "paged/int8 KV env flags are set but batched generation "
+                    "always uses the plain fp cache (shared-length pools "
+                    "don't do ragged); measurements here reflect the fp "
+                    "cache")
         B = len(prompts)
         lens = [len(p) for p in prompts]
         max_p = max(lens)
@@ -1512,15 +1538,20 @@ class NeuralNetworkModel:
                          device: Optional[str] = None
                          ) -> "NeuralNetworkModel":
         """Import GPT-2/Gemma weights into the flat param pytree as bf16
-        (reference: neural_net_model.py:176-237)."""
-        import transformers
+        (reference: neural_net_model.py:176-237).
 
-        config = transformers.AutoConfig.from_pretrained(hf_repo_id,
-                                                         revision=revision)
-        hf_model = transformers.AutoModelForCausalLM.from_pretrained(
-            hf_repo_id, revision=revision, low_cpu_mem_usage=True)
-        sd = _torch_state_dict_to_numpy(hf_model.state_dict())
-        del hf_model
+        Torch-free: weights come from safetensors files via
+        ``hf_loader`` (numpy arrays, no torch graph materialized — the
+        reference routes through torch because it *is* torch); only the
+        config is read through transformers.  Repos shipping nothing but
+        torch ``.bin`` weights fall back to torch when it is installed.
+        """
+        import transformers
+        from . import hf_loader
+
+        local_dir = hf_loader.resolve_checkpoint_dir(hf_repo_id, revision)
+        config = transformers.AutoConfig.from_pretrained(local_dir)
+        sd = hf_loader.load_state_dict(local_dir)
 
         n_layer = Mapper.detect_hf_n_layer(sd)
         if not n_layer:
@@ -1554,14 +1585,3 @@ class NeuralNetworkModel:
         return model
 
 
-def _torch_state_dict_to_numpy(sd: dict) -> dict:
-    """Torch tensors → float32 numpy (bf16 has no direct numpy view)."""
-    out = {}
-    for key, value in sd.items():
-        if hasattr(value, "detach"):
-            value = value.detach().cpu()
-            if hasattr(value, "float"):
-                value = value.float()
-            value = value.numpy()
-        out[key] = np.asarray(value)
-    return out

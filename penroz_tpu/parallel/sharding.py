@@ -175,11 +175,15 @@ def _data_axis_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
       1/data per device as the persistent layout, all-gathered
       just-in-time per matmul.
 
-    Param and moment specs MUST stay identical for a given leaf (the
-    update math is elementwise across them); both callers route through
-    this one function to keep that invariant.  The training step pins its
-    outputs to these layouts via ``train_epoch_fn(out_shardings=...)`` —
-    without the pin GSPMD propagates whatever the update ran in."""
+    When both sides opt in (``fsdp=True`` pairs with ``wus=True``), param
+    and moment specs come out identical for a given leaf (the update math
+    is elementwise across them) because both callers route through this
+    one function.  WUS-only mode (``PENROZ_WUS=1`` without FSDP) is the
+    deliberate exception: moments are data-sharded here while params keep
+    the TP layout — GSPMD inserts the gather/scatter around the update.
+    The training step pins its outputs to these layouts via
+    ``train_epoch_fn(out_shardings=...)`` — without the pin GSPMD
+    propagates whatever the update ran in."""
     if mesh.shape[DATA_AXIS] <= 1 or not shape:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))

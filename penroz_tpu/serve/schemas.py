@@ -73,7 +73,9 @@ class GenerateRequest(ModelRequest):
 class GenerateBatchRequest(ModelRequest):
     inputs: list[list[int]] = Field(
         ..., description="N prompt token lists (different lengths allowed — "
-        "ragged batched decode shares one forward per step)")
+        "ragged batched decode shares one forward per step). Capped at "
+        "PENROZ_MAX_GENERATE_BATCH (default 64) server-side; exceeding "
+        "it is a 400.")
     block_size: int = Field(..., description="Max context length; must fit "
                             "max prompt + max_new_tokens")
     max_new_tokens: int = Field(..., description="Max tokens per sequence")

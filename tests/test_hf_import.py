@@ -4,8 +4,6 @@ Goes beyond the reference's key-set assertions (test_neural_net_model.py HF
 mocks): imports weights through the real mapping path and checks our JAX
 forward produces the same logits as the torch model."""
 
-from unittest.mock import patch
-
 import numpy as np
 import pytest
 
@@ -39,12 +37,20 @@ def _tiny_gemma2():
     return config, Gemma2ForCausalLM(config).eval()
 
 
+def _save_checkpoint(workdir, torch_model, name) -> str:
+    """Serialize the oracle model as a real safetensors checkpoint dir —
+    every import test then exercises the torch-free load path end to end
+    (config.json + model.safetensors, tied weights omitted by HF)."""
+    ckpt = str(workdir / f"hf_{name}")
+    torch_model.to(torch.bfloat16).save_pretrained(ckpt,
+                                                   safe_serialization=True)
+    return ckpt
+
+
 def _import_model(workdir, config, torch_model, model_id):
-    with patch("transformers.AutoConfig.from_pretrained",
-               return_value=config), \
-         patch("transformers.AutoModelForCausalLM.from_pretrained",
-               return_value=torch_model.to(torch.bfloat16)):
-        return NeuralNetworkModel.from_huggingface(model_id, "fake/repo")
+    del config  # read back from the checkpoint's config.json
+    ckpt = _save_checkpoint(workdir, torch_model, model_id)
+    return NeuralNetworkModel.from_huggingface(model_id, ckpt)
 
 
 def test_gpt2_import_logit_parity(workdir):
@@ -99,20 +105,67 @@ def test_gemma2_import_logit_parity(workdir):
 
 
 def test_import_rejects_mismatched_state_dict(workdir):
-    config, torch_model = _tiny_gpt2()
-    sd = torch_model.state_dict()
+    """A checkpoint missing a param key fails loudly (strict key-set
+    equality, reference load_state_dict(strict=True) analog)."""
+    from safetensors.numpy import load_file, save_file
+    _, torch_model = _tiny_gpt2()
+    ckpt = _save_checkpoint(workdir, torch_model, "broken")
+    path = f"{ckpt}/model.safetensors"
+    sd = load_file(path)
     sd.pop("transformer.h.1.mlp.c_proj.bias")
+    save_file(sd, path)
+    with pytest.raises(KeyError):
+        NeuralNetworkModel.from_huggingface("broken", ckpt)
 
-    class Broken(torch.nn.Module):
-        def state_dict(self):
-            return sd
 
-    with patch("transformers.AutoConfig.from_pretrained",
-               return_value=config), \
-         patch("transformers.AutoModelForCausalLM.from_pretrained",
-               return_value=Broken()):
-        with pytest.raises(KeyError):
-            NeuralNetworkModel.from_huggingface("broken", "fake/repo")
+def test_import_is_torch_free(workdir, monkeypatch):
+    """/import/ of a local safetensors GPT-2 succeeds with torch import
+    blocked — the VERDICT r2 acceptance bar (safetensors→numpy direct
+    load, SURVEY §2.3; torch remains only this file's oracle)."""
+    import sys
+    import transformers.configuration_utils as tcu
+    _, torch_model = _tiny_gpt2()
+    ckpt = _save_checkpoint(workdir, torch_model, "notorch")
+    # None in sys.modules makes any fresh `import torch` raise ImportError;
+    # is_torch_available must lie too or transformers eagerly converts the
+    # config.json torch_dtype string (it skips that in a real no-torch env)
+    monkeypatch.setitem(sys.modules, "torch", None)
+    monkeypatch.setattr(tcu, "is_torch_available", lambda: False)
+    model = NeuralNetworkModel.from_huggingface("notorch", ckpt)
+    assert model.status["code"] == "Imported"
+    tokens = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                   max_new_tokens=3, temperature=0.0)
+    assert len(tokens) == 6
+
+
+def test_import_unprefixed_base_model_checkpoint(workdir):
+    """The original ``gpt2`` hub checkpoints were saved from the bare base
+    model — keys lack the ``transformer.`` prefix and carry extra mask
+    buffers; the loader canonicalizes them (hf_loader._normalize)."""
+    from safetensors.numpy import load_file, save_file
+    _, torch_model = _tiny_gpt2()
+    ckpt = _save_checkpoint(workdir, torch_model, "rawgpt2")
+    path = f"{ckpt}/model.safetensors"
+    sd = load_file(path)
+    raw = {k.removeprefix("transformer."): v for k, v in sd.items()
+           if not k.startswith("lm_head.")}
+    raw["h.0.attn.bias"] = np.tril(np.ones((32, 32), np.float32))[None, None]
+    save_file(raw, path)
+    model = NeuralNetworkModel.from_huggingface("rawgpt2", ckpt)
+    assert model.status["code"] == "Imported"
+    assert model.params["layers.0.0.weight"].shape == (96, 16)
+
+
+def test_bin_only_checkpoint_without_torch_is_clear_error(workdir,
+                                                          monkeypatch):
+    import sys
+    from penroz_tpu.models import hf_loader
+    _, torch_model = _tiny_gpt2()
+    ckpt = str(workdir / "binonly")
+    torch_model.save_pretrained(ckpt, safe_serialization=False)
+    monkeypatch.setitem(sys.modules, "torch", None)
+    with pytest.raises(RuntimeError, match="safetensors"):
+        hf_loader.load_state_dict(ckpt)
 
 
 def _tiny_llama():

@@ -528,7 +528,8 @@ def test_batched_generate_matches_single(workdir, toy_gpt_layers):
         assert out == single, (p, out, single)
 
 
-def test_batched_generate_stop_token_and_validation(workdir, toy_gpt_layers):
+def test_batched_generate_stop_token_and_validation(workdir, toy_gpt_layers,
+                                                    monkeypatch):
     model = NeuralNetworkModel("bg2", Mapper(toy_gpt_layers, SGD))
     # a stop token freezes only that row; others keep generating
     ref = model.generate_tokens_batched([[1, 2], [3, 4, 5]], block_size=16,
@@ -557,6 +558,16 @@ def test_batched_generate_stop_token_and_validation(workdir, toy_gpt_layers):
     with pytest.raises(ValueError, match="at least one token"):
         model.generate_tokens_batched([[1], []], block_size=16,
                                       max_new_tokens=2, temperature=0.0)
+    # batch-size cap guards the HTTP-reachable KV allocation (ADVICE r2)
+    monkeypatch.setenv("PENROZ_MAX_GENERATE_BATCH", "2")
+    with pytest.raises(ValueError, match="at most 2 prompts"):
+        model.generate_tokens_batched([[1], [2], [3]], block_size=16,
+                                      max_new_tokens=1, temperature=0.0)
+    # unparseable cap falls back to the default instead of 400ing clients
+    monkeypatch.setenv("PENROZ_MAX_GENERATE_BATCH", "not-a-number")
+    assert model.generate_tokens_batched([[1, 2]], block_size=16,
+                                         max_new_tokens=0,
+                                         temperature=0.0) == [[1, 2]]
 
 
 def test_batched_generate_sampled_ranges(workdir, toy_gpt_layers):

@@ -44,7 +44,7 @@ def peak_flops(device) -> float:
 
 
 def bench_train(arch, mapper, params, batch=8, block=1024, steps_per_call=4,
-                warmup=2, timed=6, remat=False):
+                warmup=2, timed=6, remat=False, buffers=None):
     import optax
     optimizer = mapper.to_optimizer()
     opt_state = optimizer.init(params)
@@ -58,7 +58,7 @@ def bench_train(arch, mapper, params, batch=8, block=1024, steps_per_call=4,
                                       dtype=np.int32))
     y = jnp.asarray(data_rng.integers(0, 50304, (steps_per_call, batch, block),
                                       dtype=np.int32))
-    buffers = {}
+    buffers = buffers or {}
 
     for _ in range(warmup):
         params, opt_state, buffers, cost, _ = epoch_fn(params, opt_state,
@@ -124,6 +124,82 @@ def bench_decode_throughput(arch, params, mapper, block=1024, tokens=96):
     t0 = time.perf_counter()
     model.generate_tokens(prompt, block, tokens, temperature=1.0)
     return tokens / (time.perf_counter() - t0)
+
+
+def bench_batched_decode(arch, params, block=1024, tokens=64, batch=8):
+    """Aggregate tokens/sec of the ragged batched serving path
+    (POST /generate_batch/, models/model.py::generate_tokens_batched):
+    ``batch`` prompts of different lengths share one forward per step."""
+    from penroz_tpu.models.model import NeuralNetworkModel
+    model = NeuralNetworkModel.__new__(NeuralNetworkModel)
+    model.params = params
+    model.buffers = {}
+    model.arch = arch
+    model.device = None
+    model._sample_rng = jax.random.key(0)
+    model._pipe_layout = None
+    rng = np.random.default_rng(0)
+    # ragged lengths spanning 32..128 — the shape the feature exists for
+    prompts = [list(rng.integers(0, 50304, int(n)))
+               for n in np.linspace(32, 128, batch)]
+    model.generate_tokens_batched(prompts, block, tokens, temperature=1.0)
+    t0 = time.perf_counter()
+    model.generate_tokens_batched(prompts, block, tokens, temperature=1.0)
+    return batch * tokens / (time.perf_counter() - t0)
+
+
+def bench_moe_dispatch(d=512, experts=8, top_k=2, depth=4, batch=8,
+                       block=512, steps=2, timed=4):
+    """Dense vs capacity-packed MoE dispatch on the same stack: tokens/sec
+    each way.  Capacity dispatch computes only ``C = top_k·T/E·1.25``
+    tokens per expert instead of all T per expert (ops/modules.py MoE) —
+    this measures the realized speedup, not the claimed FLOP ratio.
+    Returns (dense_tps, capacity_tps) or None on failure (showcase)."""
+    from __graft_entry__ import OPTIMIZER
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import CompiledArch
+
+    def stack(dispatch):
+        layers = [{"summation": [
+            {"embedding": {"num_embeddings": 50304, "embedding_dim": d},
+             "normal": {"mean": 0.0, "std": 0.02}},
+            {"position": {"num_embeddings": block, "embedding_dim": d},
+             "normal": {"mean": 0.0, "std": 0.02}}]}]
+        layers += [{"residual": [
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d, "out_features": 3 * d},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"attention": {"num_heads": 8, "dropout": 0.0}},
+                {"linear": {"in_features": d, "out_features": d}}]},
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"moe": {"in_features": d, "intermediate_size": 4 * d,
+                         "num_experts": experts, "top_k": top_k,
+                         "dispatch": dispatch}}]}]}
+            for _ in range(depth)]
+        layers += [{"layernorm": {"normalized_shape": d}},
+                   {"linear": {"in_features": d, "out_features": 50304,
+                               "bias": False}},
+                   {"softmax": {"dim": -1}}]
+        return layers
+
+    try:
+        out = []
+        for dispatch in ("dense", "capacity"):
+            mapper = Mapper(stack(dispatch), OPTIMIZER)
+            arch = CompiledArch.get(mapper.layers)
+            params, buffers = mapper.init_params(arch.mods, seed=0)
+            tps, _ = bench_train(arch, mapper, params, batch=batch,
+                                 block=block, steps_per_call=steps,
+                                 warmup=2, timed=timed, buffers=buffers)
+            out.append(tps)
+        return tuple(out)
+    except Exception as exc:  # noqa: BLE001 — optional showcase config
+        import logging
+        logging.getLogger(__name__).warning("MoE dispatch bench skipped: %s",
+                                            exc)
+        return None
 
 
 def bench_paged_generate(arch, params, block=1024, tokens=64):
@@ -214,11 +290,58 @@ def bench_dispatch_floor():
     return statistics.median(times)
 
 
-def _devices_or_die(timeout_s: float = 180.0):
-    """First backend touch with a watchdog: a wedged remote-accelerator
-    relay makes ``jax.devices()`` block forever, which would hang the whole
-    bench run silently.  Fail fast with a diagnostic instead (stderr only —
-    never emit a fake metrics line)."""
+def _wait_for_backend():
+    """Survive a flaky accelerator pool: probe the backend in short-lived
+    CHILD processes (a wedged in-process ``jax.devices()`` can never be
+    retried — backend init poisons the caller) with exponential backoff
+    until it answers or the total budget (``PENROZ_BENCH_WAIT_S``, default
+    900 s) runs out.  Round-2's official bench died rc=3 on the first
+    180 s relay outage (BENCH_r02.json); this keeps retrying through
+    transient pool failures and only then gives up."""
+    import os
+    import subprocess
+    import sys
+    budget = float(os.environ.get("PENROZ_BENCH_WAIT_S", "900"))
+    probe_timeout = float(os.environ.get("PENROZ_BENCH_PROBE_S", "150"))
+    deadline = time.monotonic() + budget
+    attempt = 0
+    probe = ("import jax; d = jax.devices(); "
+             "print('BACKEND_OK', d[0].device_kind, len(d), flush=True)")
+    while True:
+        attempt += 1
+        try:
+            out = subprocess.run([sys.executable, "-c", probe],
+                                 capture_output=True, text=True,
+                                 timeout=probe_timeout)
+            if out.returncode == 0 and "BACKEND_OK" in out.stdout:
+                print(f"bench: backend up (probe attempt {attempt}): "
+                      f"{out.stdout.strip().split('BACKEND_OK ')[-1]}",
+                      file=sys.stderr, flush=True)
+                return
+            detail = (out.stderr or out.stdout).strip().splitlines()
+            detail = detail[-1] if detail else f"rc={out.returncode}"
+        except subprocess.TimeoutExpired:
+            detail = f"probe timed out after {probe_timeout:.0f}s"
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            print(f"bench: accelerator backend unreachable after "
+                  f"{budget:.0f}s / {attempt} probe attempts (last: "
+                  f"{detail}) — aborting without metrics",
+                  file=sys.stderr, flush=True)
+            os._exit(3)
+        delay = min(min(2.0 ** attempt, 60.0), max(remaining, 1.0))
+        print(f"bench: backend probe {attempt} failed ({detail}); "
+              f"retrying in {delay:.0f}s ({remaining:.0f}s left)",
+              file=sys.stderr, flush=True)
+        time.sleep(delay)
+
+
+def _devices_or_die(timeout_s: float = 300.0):
+    """First in-process backend touch with a watchdog (after
+    ``_wait_for_backend`` proved a child can attach): a wedged relay makes
+    ``jax.devices()`` block forever, which would hang the whole bench run
+    silently.  Fail fast with a diagnostic instead (stderr only — never
+    emit a fake metrics line)."""
     import concurrent.futures
     import os
     import sys
@@ -238,6 +361,7 @@ def main():
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import CompiledArch
 
+    _wait_for_backend()
     device = _devices_or_die()[0]
     depth, d_model, block = 12, 768, 1024
     mapper = Mapper(_gpt2_dsl(depth=depth, d=d_model, block=block), OPTIMIZER)
@@ -254,9 +378,11 @@ def main():
     dispatch_floor = bench_dispatch_floor()
     ttft_ms = bench_ttft(arch, params, block=block)
     decode_tps = bench_decode_throughput(arch, params, mapper, block=block)
+    batched_tps = bench_batched_decode(arch, params, block=block)
     paged_tps, paged_assigned = bench_paged_generate(arch, params,
                                                      block=block)
     long_ctx = bench_long_context()
+    moe = bench_moe_dispatch()
     tokens_per_sec, cost = bench_train(arch, mapper, params)
     mfu = (tokens_per_sec
            * _flops_per_token(n_matmul_params, depth, d_model, block)
@@ -270,6 +396,8 @@ def main():
         "mfu": round(mfu, 4),
         "ttft_ms_p50": round(ttft_ms, 2),
         "decode_tokens_per_sec": round(decode_tps, 1),
+        "batched_decode_tokens_per_sec": round(batched_tps, 1),
+        "batched_decode_batch": 8,
         "paged_decode_tokens_per_sec": round(paged_tps, 1),
         "paged_assigned_mb": round(paged_assigned / 2 ** 20, 2),
         "dispatch_floor_ms": round(dispatch_floor, 2),
@@ -279,6 +407,9 @@ def main():
         **({"long_ctx_tokens_per_sec": round(long_ctx[0], 1),
             "long_ctx_mfu": round(long_ctx[1], 4),
             "long_ctx_block": long_ctx[2]} if long_ctx else {}),
+        **({"moe_dense_tokens_per_sec": round(moe[0], 1),
+            "moe_capacity_tokens_per_sec": round(moe[1], 1),
+            "moe_speedup": round(moe[1] / moe[0], 3)} if moe else {}),
     }))
 
 

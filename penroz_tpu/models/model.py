@@ -252,7 +252,8 @@ class CompiledArch:
     def train_epoch_fn(self, optimizer_config: dict, num_steps: int,
                        remat: bool = False, compute_dtype=None, sp_mesh=None,
                        platform=None, with_ratios: bool = True,
-                       out_shardings=None, sp_mode: str = "ring"):
+                       out_shardings=None, sp_mode: str = "ring",
+                       pipe_cfg=None):
         """One jitted epoch: ``num_steps`` grad-accumulation micro-steps via
         ``lax.scan`` then a single optax update (reference hot loop:
         neural_net_model.py:614-677; sync deferred to the final micro-step is
@@ -284,19 +285,25 @@ class CompiledArch:
                          tuple(jax.tree.leaves(out_shardings[1])))
         key = ("epoch", json.dumps(optimizer_config, sort_keys=True),
                int(num_steps), bool(remat), str(compute_dtype), sp_mesh,
-               platform, bool(with_ratios), shard_key, sp_mode)
+               platform, bool(with_ratios), shard_key, sp_mode,
+               (pipe_cfg[0], pipe_cfg[1], pipe_cfg[2], pipe_cfg[3])
+               if pipe_cfg else None)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
 
         optimizer = dsl.build_optimizer(optimizer_config)
 
-        def loss_fn(params, buffers, x, y, rng):
-            _, cost, buf_upd, _ = self.forward(
-                params, buffers, x, y, training=True, rng=rng,
-                skip_softmax=True, compute_dtype=compute_dtype,
-                sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode)
-            return cost, buf_upd
+        if pipe_cfg is None:
+            def loss_fn(params, buffers, x, y, rng):
+                _, cost, buf_upd, _ = self.forward(
+                    params, buffers, x, y, training=True, rng=rng,
+                    skip_softmax=True, compute_dtype=compute_dtype,
+                    sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode)
+                return cost, buf_upd
+        else:
+            loss_fn = self._pipelined_loss_fn(pipe_cfg, compute_dtype,
+                                              platform)
 
         if remat:
             loss_fn = jax.checkpoint(loss_fn)
@@ -345,17 +352,85 @@ class CompiledArch:
             if not with_ratios:
                 return new_params, new_opt_state, new_buffers, cost, None
             # per-weight update ratio std(Δw)/std(w) (reference :686-700)
-            ratios = []
-            for k in self.param_order:
-                dw = jnp.std((new_params[k] - params[k]).astype(jnp.float32))
-                denom = jnp.std(params[k].astype(jnp.float32))
-                ratios.append(jnp.where(denom > 0, dw / (denom + 1e-12), 0.0))
-            ratios = jnp.stack(ratios) if ratios else jnp.zeros((0,))
+
+            def ratio(dw_src, w_src, stacked=False):
+                std = (jax.vmap(lambda a: jnp.std(a.astype(jnp.float32)))
+                       if stacked else
+                       lambda a: jnp.std(a.astype(jnp.float32)))
+                dw, denom = std(dw_src), std(w_src)
+                return jnp.where(denom > 0, dw / (denom + 1e-12), 0.0)
+
+            if pipe_cfg is None:
+                ratio_map = {k: ratio(new_params[k] - params[k], params[k])
+                             for k in self.param_order}
+            else:
+                # Stacked leaves yield one std per layer (vmap over the
+                # leading L dim) so the dashboard's per-weight curves keep
+                # the canonical flat ordering.
+                _, start, count, _ = pipe_cfg
+                ratio_map = {}
+                for k in params:
+                    if k.startswith("__pipe__."):
+                        r = ratio(new_params[k] - params[k], params[k],
+                                  stacked=True)
+                        suffix = k[len("__pipe__."):]
+                        for j in range(count):
+                            ratio_map[f"layers.{start + j}.{suffix}"] = r[j]
+                    else:
+                        ratio_map[k] = ratio(new_params[k] - params[k],
+                                             params[k])
+            ratios = (jnp.stack([ratio_map[k] for k in self.param_order])
+                      if self.param_order else jnp.zeros((0,)))
             return new_params, new_opt_state, new_buffers, cost, ratios
 
         fn = jax.jit(epoch, donate_argnums=(0, 1))
         self._jit_cache[key] = fn
         return fn
+
+    def _pipelined_loss_fn(self, pipe_cfg, compute_dtype, platform):
+        """Loss for the GPipe training layout: pre-block modules run on the
+        full batch, the stacked blocks stream microbatches through the
+        pipe-axis stages (``parallel/pipeline.gpipe_apply``), post-block
+        modules + fused CE close the loss.  Params arrive in the mixed
+        layout built by ``NeuralNetworkModel._enter_pipe_layout``:
+        ``__pipe__.<suffix>`` stacked leaves plus flat non-block keys.
+
+        Extends the reference's single DDP strategy (SURVEY §2.4 — it has
+        no PP) as a depth sharding inside the same compiled program.
+        """
+        from penroz_tpu.parallel import pipeline
+        pmesh, start, count, micro = pipe_cfg
+        block_fn = pipeline.block_fn_from_arch(
+            self, start, training=True, compute_dtype=compute_dtype,
+            platform=platform)
+        pre = self.mods[:start]
+        post = self.mods[start + count:]
+
+        def loss_fn(params, buffers, x, y, rng):
+            ctx = M.Ctx(params, buffers, training=True, rng=rng,
+                        compute_dtype=compute_dtype, platform=platform)
+            h = x
+            for mod in pre:
+                h = mod.apply(h, ctx)
+            stacked = {k[len("__pipe__."):]: v for k, v in params.items()
+                       if k.startswith("__pipe__.")}
+            h = pipeline.gpipe_apply(block_fn, stacked, h, pmesh, micro,
+                                     rng=jax.random.fold_in(rng, 0x9e3779))
+            logits = None
+            for mod in post:
+                if isinstance(mod, M.Softmax):
+                    if logits is None:
+                        logits = h  # skip_softmax semantics (cost on logits)
+                    continue
+                h = mod.apply(h, ctx)
+            if logits is None:
+                logits = h
+            cost = self._cost_from_logits(logits, y, platform=platform)
+            if ctx.aux_losses:
+                cost = cost + sum(ctx.aux_losses)
+            return cost, ctx.buffer_updates
+
+        return loss_fn
 
     # -- decode -------------------------------------------------------------
 
@@ -488,6 +563,8 @@ class NeuralNetworkModel:
         self.status = {"code": "Created", "message": "Model created"}
         self.device = None
         self._sample_rng = jax.random.key(0)
+        # (start, count) while params live in the GPipe stacked layout
+        self._pipe_layout: Optional[tuple] = None
 
     # -- introspection ------------------------------------------------------
 
@@ -672,7 +749,15 @@ class NeuralNetworkModel:
             mesh = self._training_mesh(batch_size, block_size)
             sp_mesh = None
             epoch_out_shardings = None
-            if mesh is not None:
+            pipe_cfg = None
+            if mesh is not None and mesh.shape[mesh_lib.PIPE_AXIS] > 1:
+                log.info("Training over device mesh %s", dict(mesh.shape))
+                pipe_cfg, epoch_out_shardings = self._enter_pipe_layout(
+                    mesh, batch_size)
+                self.buffers = {
+                    k: sharding_lib.place(v, mesh_lib.replicated(mesh))
+                    for k, v in self.buffers.items()}
+            elif mesh is not None:
                 log.info("Training over device mesh %s", dict(mesh.shape))
                 # ZeRO ladder on top of the TP layout (arXiv:2004.13336):
                 # PENROZ_WUS=1 spreads the optimizer moments over the data
@@ -751,7 +836,8 @@ class NeuralNetworkModel:
                 self.optimizer_config, num_steps, remat=remat,
                 compute_dtype=compute_dtype, sp_mesh=sp_mesh,
                 platform=self._platform,
-                out_shardings=epoch_out_shardings, sp_mode=sp_mode)
+                out_shardings=epoch_out_shardings, sp_mode=sp_mode,
+                pipe_cfg=pipe_cfg)
             # Non-sampled epochs skip the two full parameter passes the
             # update-ratio stds cost.  The choice is a pure function of the
             # epoch index so every host runs the same compiled program
@@ -765,7 +851,8 @@ class NeuralNetworkModel:
                                          platform=self._platform,
                                          with_ratios=False,
                                          out_shardings=epoch_out_shardings,
-                                         sp_mode=sp_mode)
+                                         sp_mode=sp_mode,
+                                         pipe_cfg=pipe_cfg)
                 if sample_every > 1 else epoch_fn)
             rng = jax.random.key(0)
             last_save = time.monotonic()
@@ -836,6 +923,7 @@ class NeuralNetworkModel:
                     if master or saves_shards:
                         self.serialize(tag=epoch)
                     last_save = time.monotonic()
+            self._exit_pipe_layout()
             self.status = {"code": "Trained",
                            "message": f"Trained {epochs} epoch(s)"}
             if master:
@@ -843,6 +931,10 @@ class NeuralNetworkModel:
             if master or saves_shards:
                 self.serialize(tag=epochs)
         except Exception as e:  # noqa: BLE001
+            try:
+                self._exit_pipe_layout()
+            except Exception:  # noqa: BLE001
+                log.exception("Failed to restore flat param layout")
             self.status = {"code": "Error", "message": str(e)}
             # Untagged on purpose: hosts reach this handler independently
             # (possibly at different epochs, possibly only one of them), so
@@ -909,23 +1001,33 @@ class NeuralNetworkModel:
             model = int(os.environ.get("PENROZ_MESH_MODEL", "1"))
             seq = int(os.environ.get("PENROZ_MESH_SEQUENCE", "1"))
             expert = int(os.environ.get("PENROZ_MESH_EXPERT", "1"))
+            pipe = int(os.environ.get("PENROZ_MESH_PIPE", "1"))
         except ValueError:
             log.warning("Invalid PENROZ_MESH_MODEL/PENROZ_MESH_SEQUENCE/"
-                        "PENROZ_MESH_EXPERT; falling back to single device")
+                        "PENROZ_MESH_EXPERT/PENROZ_MESH_PIPE; falling back "
+                        "to single device")
             return None
-        if model < 1 or seq < 1 or expert < 1:
+        if model < 1 or seq < 1 or expert < 1 or pipe < 1:
             return None
+        if pipe > 1 and (model > 1 or seq > 1 or expert > 1):
+            # The GPipe schedule composes with data parallelism (its
+            # microbatch spec shards rows over `data`); TP/SP/EP inside a
+            # stage would need per-suffix specs on the stacked leaves —
+            # refuse loudly rather than silently mis-shard.
+            raise RuntimeError(
+                "PENROZ_MESH_PIPE>1 currently composes only with data "
+                "parallelism; unset PENROZ_MESH_MODEL/SEQUENCE/EXPERT")
         n = len(devices)
-        if n <= 1 or n % (model * seq * expert):
+        if n <= 1 or n % (model * seq * expert * pipe):
             return None
-        data = n // (model * seq * expert)
+        data = n // (model * seq * expert * pipe)
         if micro_batch % data or (seq > 1 and block_size % seq):
             log.info("Mesh fallback to single device: micro-batch %d / "
                      "sequence %d not divisible by data=%d / sequence=%d",
                      micro_batch, block_size, data, seq)
             return None
         return mesh_lib.make_mesh(devices, model=model, sequence=seq,
-                                  expert=expert)
+                                  expert=expert, pipe=pipe)
 
     def _multihost_mesh(self, micro_batch: int, block_size: int = 0):
         """Global mesh spanning every host's devices.
@@ -969,8 +1071,139 @@ class NeuralNetworkModel:
             raise ValueError(
                 f"multi-host training: block_size {block_size} must be "
                 f"divisible by the sequence axis ({seq})")
+        if os.environ.get("PENROZ_MESH_PIPE", "1") not in ("", "1"):
+            raise RuntimeError(
+                "PENROZ_MESH_PIPE>1 is single-host only for now (the GPipe "
+                "stages ride ICI; cross-host stage handoffs and sharded "
+                "stacked checkpoints are not supported yet)")
         return mesh_lib.make_mesh(devices, model=model, sequence=seq,
                                   expert=expert)
+
+    # -- pipeline-parallel training layout ----------------------------------
+
+    def _enter_pipe_layout(self, mesh, batch_size: int):
+        """Switch params/opt_state to the GPipe stacked layout.
+
+        The repeated transformer blocks' per-layer params
+        ``layers.{i}.<suffix>`` become ``__pipe__.<suffix>`` leaves with a
+        leading ``(L, ...)`` dim sharded over the mesh's ``pipe`` axis —
+        each stage physically holds only its ``L/P`` blocks (the depth
+        analog of TP's width sharding).  Optimizer moment dicts get the
+        identical restructuring so the elementwise update math lines up.
+        The checkpoint format stays canonical flat: :meth:`serialize`
+        converts back via :meth:`_canonical_state`.
+
+        Returns ``(pipe_cfg, epoch_out_shardings)`` where ``pipe_cfg =
+        (mesh, start, count, num_microbatches)`` feeds
+        :meth:`CompiledArch.train_epoch_fn`.
+        """
+        from penroz_tpu.parallel import pipeline
+        pipe = mesh.shape[mesh_lib.PIPE_AXIS]
+        data = mesh.shape[mesh_lib.DATA_AXIS]
+        if (os.environ.get("PENROZ_FSDP", "0") == "1"
+                or os.environ.get("PENROZ_WUS", "0") == "1"):
+            raise RuntimeError(
+                "PENROZ_MESH_PIPE>1 does not compose with PENROZ_FSDP/"
+                "PENROZ_WUS yet: the ZeRO ladder shards the flat layout, "
+                "the pipeline shards the stacked one")
+        start, count = pipeline.pipeline_block_range(self.layers_dsl)
+        if count < pipe or count % pipe:
+            raise RuntimeError(
+                f"PENROZ_MESH_PIPE={pipe}: the longest run of identical "
+                f"blocks is {count} (need a multiple of the pipe axis); "
+                f"this DSL cannot pipeline at that depth")
+        for i in range(start, start + count):
+            for sub in self.arch.mods[i].walk():
+                if isinstance(sub, (M.BatchNorm1d, M.MixtureOfExperts)):
+                    raise RuntimeError(
+                        f"PENROZ_MESH_PIPE>1 cannot pipeline blocks with "
+                        f"{type(sub).__name__}: buffer updates/aux losses "
+                        f"do not cross the stage boundary yet")
+        base = batch_size // data
+        env_m = os.environ.get("PENROZ_PIPE_MICROBATCHES", "")
+        if env_m:
+            micro = int(env_m)
+            if micro < 1 or base % micro:
+                raise RuntimeError(
+                    f"PENROZ_PIPE_MICROBATCHES={micro} must divide the "
+                    f"per-data-shard batch ({base})")
+        else:
+            # GPipe bubble is (P-1)/(M+P-1): aim for M ≈ 4P, constrained
+            # to divide the per-data-shard batch so rows split evenly.
+            target = min(base, 4 * pipe)
+            micro = next(m for m in range(target, 0, -1) if base % m == 0)
+        idx = list(range(start, start + count))
+        stacked = pipeline.stack_block_params(self.params, idx)
+        block_keys = {f"layers.{i}.{s}" for i in idx for s in stacked}
+        mixed = {k: v for k, v in self.params.items() if k not in block_keys}
+        mixed.update({f"__pipe__.{s}": v for s, v in stacked.items()})
+        pkeys = set(self.params)
+
+        def mix(d: dict) -> dict:
+            st = pipeline.stack_block_params(d, idx)
+            out = {k: v for k, v in d.items() if k not in block_keys}
+            out.update({f"__pipe__.{s}": v for s, v in st.items()})
+            return out
+
+        opt_mixed = jax.tree.map(
+            lambda n: mix(n) if isinstance(n, dict) and set(n) == pkeys
+            else n,
+            self.opt_state,
+            is_leaf=lambda n: isinstance(n, dict) and set(n) == pkeys)
+        stacked_shd = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(mesh_lib.PIPE_AXIS))
+        repl = mesh_lib.replicated(mesh)
+        param_shd = {k: (stacked_shd if k.startswith("__pipe__.") else repl)
+                     for k in mixed}
+        opt_shd = jax.tree.map(
+            lambda n: ({k: param_shd[k] for k in n}
+                       if isinstance(n, dict) and set(n) == set(mixed)
+                       else repl),
+            opt_mixed,
+            is_leaf=lambda n: isinstance(n, dict) and set(n) == set(mixed))
+        self.params = {k: jax.device_put(v, param_shd[k])
+                       for k, v in mixed.items()}
+        self.opt_state = sharding_lib.place_tree(opt_mixed, opt_shd)
+        self._pipe_layout = (start, count)
+        log.info("Pipeline layout: blocks %d..%d stacked over pipe=%d, "
+                 "%d microbatch(es)", start, start + count - 1, pipe, micro)
+        return (mesh, start, count, micro), (param_shd, opt_shd)
+
+    def _canonical_params(self, params=None) -> dict:
+        """Flat per-layer param dict regardless of an active pipeline
+        layout (the canonical checkpoint/serving key naming)."""
+        from penroz_tpu.parallel import pipeline
+        params = self.params if params is None else params
+        if self._pipe_layout is None:
+            return params
+        start, count = self._pipe_layout
+        idx = list(range(start, start + count))
+        stacked = {k[len("__pipe__."):]: v for k, v in params.items()
+                   if k.startswith("__pipe__.")}
+        flat = {k: v for k, v in params.items()
+                if not k.startswith("__pipe__.")}
+        flat.update(pipeline.unstack_block_params(stacked, idx))
+        return flat
+
+    def _canonical_state(self):
+        """(params, opt_state) in the canonical flat layout."""
+        if self._pipe_layout is None:
+            return self.params, self.opt_state
+        mixed_keys = set(self.params)
+        opt = jax.tree.map(
+            lambda n: (self._canonical_params(n)
+                       if isinstance(n, dict) and set(n) == mixed_keys
+                       else n),
+            self.opt_state,
+            is_leaf=lambda n: isinstance(n, dict) and set(n) == mixed_keys)
+        return self._canonical_params(), opt
+
+    def _exit_pipe_layout(self):
+        """Restore the canonical flat layout after a pipelined train run."""
+        if self._pipe_layout is None:
+            return
+        self.params, self.opt_state = self._canonical_state()
+        self._pipe_layout = None
 
     @classmethod
     def train_model_on_device(cls, model_id, device, dataset_id, shard,
@@ -994,7 +1227,7 @@ class NeuralNetworkModel:
         produces stats on master (neural_net_model.py:705-709), so a
         master-local sample preserves the feature instead of skipping it.
         """
-        params, buffers = self.params, self.buffers
+        params, buffers = self._canonical_params(), self.buffers
         if any(not getattr(v, "is_fully_addressable", True)
                for v in params.values()):
             if not all(getattr(v, "is_fully_replicated", True)
@@ -1338,11 +1571,15 @@ class NeuralNetworkModel:
     def _checkpoint_items(self):
         """Flat name → array view of everything persisted (params, buffers,
         optimizer leaves) so sharding-aware save/load handles them
-        uniformly.  Optimizer leaves get synthetic ``__opt__{i}`` names."""
-        items = dict(self.params)
+        uniformly.  Optimizer leaves get synthetic ``__opt__{i}`` names.
+        An active pipeline-stacked training layout is converted back to the
+        canonical flat layout here, so the checkpoint format (and
+        :meth:`deserialize`) never sees stacked keys."""
+        params, opt_state = self._canonical_state()
+        items = dict(params)
         items.update({f"__buf__{k}": v for k, v in self.buffers.items()})
         items.update({f"__opt__{i}": leaf for i, leaf
-                      in enumerate(jax.tree.leaves(self.opt_state))})
+                      in enumerate(jax.tree.leaves(opt_state))})
         return items
 
     def serialize(self, sync_flush: bool = False, tag=None):
@@ -1393,12 +1630,15 @@ class NeuralNetworkModel:
         # discard them would waste seconds per checkpoint at scale.
         host_arrays = {name: np.asarray(v) for name, v in items.items()
                        if self._is_host_readable(v)}
-        params = {k: host_arrays[k] for k in self.params
-                  if k in host_arrays}
+        # Key/leaf sets come from the canonical layout (== items), not
+        # self.params/opt_state, which may be pipeline-stacked mid-training.
+        n_opt = sum(1 for name in items if name.startswith("__opt__"))
+        params = {k: host_arrays[k] for k in items
+                  if not k.startswith(("__buf__", "__opt__"))
+                  and k in host_arrays}
         buffers = {k: host_arrays[f"__buf__{k}"] for k in self.buffers
                    if f"__buf__{k}" in host_arrays}
-        opt_leaves = {i: host_arrays[f"__opt__{i}"]
-                      for i in range(len(jax.tree.leaves(self.opt_state)))
+        opt_leaves = {i: host_arrays[f"__opt__{i}"] for i in range(n_opt)
                       if f"__opt__{i}" in host_arrays}
         data = {
             "layers": self.layers_dsl,
@@ -1524,6 +1764,7 @@ class NeuralNetworkModel:
         model.status = data.get("status", {"code": "Created", "message": None})
         model.device = None
         model._sample_rng = jax.random.key(0)
+        model._pipe_layout = None
         return model
 
     @classmethod

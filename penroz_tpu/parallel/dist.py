@@ -50,7 +50,46 @@ def initialize(coordinator_address: str | None = None,
                                num_processes=num_processes,
                                process_id=process_id)
     _initialized = True
+    reconfig_logging()
     return True
+
+
+def reconfig_logging(log_dir: str | None = None) -> str | None:
+    """Per-process log files for multi-host runs.
+
+    The reference reconfigures per-rank rotating file handlers so DDP
+    workers stay distinguishable (ddp.py:87-114); the analog here is one
+    process per host, so each process mirrors its records into
+    ``<log_dir>/penroz_rank{i}.log`` (``PENROZ_LOG_DIR``, default
+    ``logs/``) with the rank baked into the format.  Idempotent —
+    re-calling replaces the previously installed handler.  Single-host is
+    a no-op (the console handler already tells the whole story).
+    Returns the installed path, or None.
+    """
+    if process_count() <= 1:
+        return None
+    import logging.handlers
+    log_dir = log_dir or os.environ.get("PENROZ_LOG_DIR", "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    rank = process_index()
+    path = os.path.join(log_dir, f"penroz_rank{rank}.log")
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        if getattr(h, "_penroz_rank_handler", False):
+            root.removeHandler(h)
+            h.close()
+    handler = logging.handlers.RotatingFileHandler(
+        path, maxBytes=10_000_000, backupCount=3)
+    handler.setFormatter(logging.Formatter(
+        f"%(asctime)s %(levelname)s [rank{rank}/{process_count()}] "
+        f"%(name)s: %(message)s"))
+    handler._penroz_rank_handler = True
+    root.addHandler(handler)
+    if root.level > logging.INFO or root.level == logging.NOTSET:
+        root.setLevel(logging.INFO)
+    log.info("Per-rank logging for process %d/%d -> %s", rank,
+             process_count(), path)
+    return path
 
 
 def _env_int(name: str):

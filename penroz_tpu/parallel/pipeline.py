@@ -26,6 +26,30 @@ from jax.sharding import PartitionSpec as P
 from penroz_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
 
 
+def pipeline_block_range(layers_dsl: list[dict]) -> tuple[int, int]:
+    """Longest contiguous run of *identical* top-level DSL entries — the
+    repeated transformer blocks a GPipe schedule can stack and shard over
+    the ``pipe`` axis.  Returns ``(start, count)``; ``count`` is 1 when no
+    entry repeats (then PP has nothing to pipeline).
+
+    Identity is full-config equality: heterogeneous stacks (e.g. Gemma
+    sliding/full alternating dims) only pipeline their equal sub-runs.
+    """
+    import json
+    keys = [json.dumps(entry, sort_keys=True, default=str)
+            for entry in layers_dsl]
+    best_start, best_count = 0, 1
+    i = 0
+    while i < len(keys):
+        j = i
+        while j + 1 < len(keys) and keys[j + 1] == keys[i]:
+            j += 1
+        if j - i + 1 > best_count:
+            best_start, best_count = i, j - i + 1
+        i = j + 1
+    return best_start, best_count
+
+
 def stack_block_params(params: dict, block_indices, prefix="layers") -> dict:
     """Stack per-block params ``layers.{i}.<suffix>`` into ``(L, ...)`` leaves.
 
@@ -61,7 +85,7 @@ def gpipe_spec(mesh):
 
 
 def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
-                num_microbatches: int):
+                num_microbatches: int, rng=None):
     """Apply ``L`` stacked blocks to ``x`` with a ``P``-stage GPipe schedule.
 
     ``block_fn(block_params: dict, h) -> h`` applies ONE block given its
@@ -69,6 +93,12 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     dim with ``L % P == 0``; ``x`` is ``(B, T, D)`` with
     ``B % num_microbatches == 0``.  Output equals applying the ``L`` blocks
     sequentially (same math, pipelined schedule).
+
+    With ``rng`` set, ``block_fn`` is instead called as
+    ``block_fn(block_params, h, key)`` where ``key`` is folded from the
+    global layer index and the schedule tick — every (layer, microbatch)
+    application gets a distinct dropout stream, like the sequential path's
+    per-call ``Ctx.next_rng`` folding.
     """
     pipe = mesh.shape[PIPE_AXIS]
     num_layers = next(iter(stacked_params.values())).shape[0]
@@ -86,10 +116,23 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
 
     def stage_fn(params_stage, mbs_local):
         stage = jax.lax.axis_index(PIPE_AXIS)
+        layers_per_stage = num_layers // pipe
 
-        def apply_blocks(h):
-            h, _ = jax.lax.scan(
-                lambda hh, pl: (block_fn(pl, hh), None), h, params_stage)
+        def apply_blocks(h, t):
+            if rng is None:
+                h, _ = jax.lax.scan(
+                    lambda hh, pl: (block_fn(pl, hh), None), h, params_stage)
+                return h
+
+            def body(hh, idx_and_params):
+                idx, pl = idx_and_params
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng, stage * layers_per_stage + idx),
+                    t)
+                return block_fn(pl, hh, key), None
+
+            h, _ = jax.lax.scan(body, h,
+                                (jnp.arange(layers_per_stage), params_stage))
             return h
 
         def tick(carry, t):
@@ -97,7 +140,7 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
             # Stage 0 ingests a fresh microbatch; others consume the
             # activation handed over by the previous stage last tick.
             feed = mbs_local[jnp.clip(t, 0, m - 1)]
-            h = apply_blocks(jnp.where(stage == 0, feed, state))
+            h = apply_blocks(jnp.where(stage == 0, feed, state), t)
             # Stage s works on microbatch t - s; the last stage commits it.
             out_mb = t - stage
             valid = (out_mb >= 0) & (out_mb < m) & (stage == pipe - 1)
@@ -124,20 +167,25 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     return out.reshape(batch, *x.shape[1:])
 
 
-def block_fn_from_arch(arch, block_index: int):
+def block_fn_from_arch(arch, block_index: int, *, training=False,
+                       compute_dtype=None, platform=None):
     """``block_fn`` for :func:`gpipe_apply` from one bound DSL block module.
 
     Uses the module tree of block ``block_index`` with params rebound from
     the un-stacked leaf dict (all stacked blocks are structurally identical,
-    so one module tree serves every layer).
+    so one module tree serves every layer).  The optional ``key`` third
+    argument carries the per-(layer, tick) dropout stream gpipe_apply folds
+    when given an ``rng``.
     """
     from penroz_tpu.ops import modules as M
     mod = arch.mods[block_index]
     prefix = f"layers.{block_index}."
 
-    def block_fn(block_params: dict, h):
+    def block_fn(block_params: dict, h, key=None):
         ctx = M.Ctx({prefix + suffix: leaf
-                     for suffix, leaf in block_params.items()})
+                     for suffix, leaf in block_params.items()},
+                    training=training, rng=key,
+                    compute_dtype=compute_dtype, platform=platform)
         return mod.apply(h, ctx)
 
     return block_fn

@@ -318,8 +318,7 @@ def save_shard(model_id: str, process_index: int, data: dict,
     if sync_flush:
         _flush(shm_path, rel)
     else:
-        threading.Thread(target=_flush, args=(shm_path, rel),
-                         daemon=True).start()
+        _spawn_flush(shm_path, rel)
     if process_index == 0 and world is not None:
         for idx in _shard_indices(model_id):
             if idx >= world:
@@ -374,8 +373,7 @@ def save(model_id: str, data: dict, sync_flush: bool = False):
         # Background flush: a thread, not a fork — os.fork() deadlocks under
         # JAX's thread pool, and the copy is pure file I/O anyway.
         log.info("Offload flushing model cache %s to %s...", shm_path, durable_path)
-        threading.Thread(target=_flush, args=(shm_path, durable_path),
-                         daemon=True).start()
+        _spawn_flush(shm_path, durable_path)
 
 
 def _mkstemp_for(path: str):
@@ -411,6 +409,27 @@ def _atomic_write(path: str, data: dict):
         if os.path.exists(tmp_path):
             os.remove(tmp_path)
         raise
+
+
+_FLUSH_THREADS: list = []
+
+
+def _spawn_flush(shm_path: str, durable_path: str):
+    """Background flush thread, tracked so callers can drain before
+    deleting the source (a delete racing an in-flight flush is harmless
+    but logs a 'source vanished' warning)."""
+    _FLUSH_THREADS[:] = [t for t in _FLUSH_THREADS if t.is_alive()]
+    t = threading.Thread(target=_flush, args=(shm_path, durable_path),
+                         daemon=True)
+    _FLUSH_THREADS.append(t)
+    t.start()
+
+
+def join_flushes(timeout: float = 10.0):
+    """Wait for in-flight background flushes (per-thread timeout)."""
+    for t in list(_FLUSH_THREADS):
+        t.join(timeout)
+    _FLUSH_THREADS[:] = [t for t in _FLUSH_THREADS if t.is_alive()]
 
 
 def _flush(shm_path: str, durable_path: str):

@@ -26,8 +26,15 @@ import jax  # noqa: E402
 # config value makes the CPU pin effective either way.
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: repeat test runs skip XLA recompiles.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+# Persistent compilation cache: repeat test runs skip XLA recompiles.  The
+# dir is keyed per CPU-feature fingerprint — XLA:CPU caches host-ISA-exact
+# AOT executables, and loading another machine's spams feature-mismatch
+# errors (then recompiles anyway).  One fingerprint implementation serves
+# the test and dryrun caches alike.
+from __graft_entry__ import _machine_cache_tag  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  f"/tmp/jax_test_cache_{_machine_cache_tag()}")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 # Pin computation to the (virtual 8-device) CPU backend even when an

@@ -427,3 +427,55 @@ def test_stats_exposes_moe_router_fractions(client, workdir):
     (fractions,) = routing.values()
     assert len(fractions) == 4
     assert abs(sum(fractions) - 1.0) < 1e-5
+
+
+
+def test_train_pipe_over_http(client, workdir, monkeypatch):
+    """API-driven GPipe training: PUT /train/ with PENROZ_MESH_PIPE=2
+    reaches Trained and the checkpoint serves /generate/ afterwards."""
+    import time
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    d, heads, vocab, block = 32, 4, 64, 16
+    layers = ([{"summation": [
+                  {"embedding": {"num_embeddings": vocab,
+                                 "embedding_dim": d},
+                   "normal": {"mean": 0.0, "std": 0.02}},
+                  {"position": {"num_embeddings": block,
+                                "embedding_dim": d},
+                   "normal": {"mean": 0.0, "std": 0.02}}]}]
+              + [{"residual": [
+                  {"sequential": [
+                      {"layernorm": {"normalized_shape": d}},
+                      {"linear": {"in_features": d, "out_features": 3 * d},
+                       "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                      {"attention": {"num_heads": heads, "dropout": 0.0}},
+                      {"linear": {"in_features": d, "out_features": d}}]}]}
+                 for _ in range(2)]
+              + [{"layernorm": {"normalized_shape": d}},
+                 {"linear": {"in_features": d, "out_features": vocab,
+                             "bias": False}},
+                 {"softmax": {"dim": -1}}])
+    status, _ = client.json("POST", "/model/", json={
+        "model_id": "ppapi", "layers": layers,
+        "optimizer": {"sgd": {"lr": 0.1}}})
+    assert status == 200
+    data_dir = workdir / "data"
+    data_dir.mkdir(exist_ok=True)
+    rng = np.random.default_rng(0)
+    np.save(data_dir / "ppds_000000",
+            rng.integers(0, vocab, 4000).astype(np.uint16))
+    status, body = client.json("PUT", "/train/", json={
+        "model_id": "ppapi", "device": "cpu", "dataset_id": "ppds",
+        "shard": 0, "epochs": 2, "batch_size": 8, "block_size": 16,
+        "step_size": 8})
+    assert status == 202
+    for _ in range(600):
+        status, body = client.json("GET", "/progress/?model_id=ppapi")
+        if body["status"]["code"] in ("Trained", "Error"):
+            break
+        time.sleep(0.2)
+    assert body["status"]["code"] == "Trained", body["status"]
+    status, gen = client.json("POST", "/generate/", json={
+        "model_id": "ppapi", "input": [1, 2, 3], "block_size": 16,
+        "max_new_tokens": 4, "temperature": 0.0})
+    assert status == 200 and len(gen["tokens"]) == 7

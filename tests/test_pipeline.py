@@ -134,3 +134,118 @@ def test_gpipe_validation_errors(cpu_devices):
     stacked = pipeline.stack_block_params(params, range(4))
     with pytest.raises(ValueError, match="microbatches"):
         pipeline.gpipe_apply(block_fn, stacked, x, mesh, 3)
+
+
+# -- pipeline parallelism wired into the product /train/ path ---------------
+
+
+def test_pipeline_block_range_detection():
+    layers = ([{"summation": [{"embedding": {"num_embeddings": 8,
+                                             "embedding_dim": 4}}]}]
+              + _blocks_dsl(depth=4)
+              + [{"layernorm": {"normalized_shape": 16}}])
+    assert pipeline.pipeline_block_range(layers) == (1, 4)
+    assert pipeline.pipeline_block_range([{"relu": {}}]) == (0, 1)
+    # heterogeneous runs pick the longest equal sub-run
+    het = _blocks_dsl(d=16, depth=2) + _blocks_dsl(d=32, depth=3)
+    assert pipeline.pipeline_block_range(het) == (2, 3)
+
+
+def test_train_model_pipe_matches_sequential(workdir, toy_gpt_layers,
+                                             toy_shards, monkeypatch):
+    """PENROZ_MESH_PIPE=2 trains through the GPipe layout and matches the
+    single-device run numerically; the model exits in flat layout."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"sgd": {"lr": 0.1}}
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    pp = NeuralNetworkModel("pp2", Mapper(toy_gpt_layers, optim)).to_device("cpu")
+    pp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                   step_size=8)
+    assert pp.status["code"] == "Trained", pp.status
+    assert pp._pipe_layout is None
+    assert not any(k.startswith("__pipe__") for k in pp.params)
+
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "1")
+    monkeypatch.setenv("PENROZ_TRAIN_MESH", "0")
+    seq = NeuralNetworkModel("seq1", Mapper(toy_gpt_layers, optim)).to_device("cpu")
+    seq.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                    step_size=8)
+    np.testing.assert_allclose(pp.progress[-1]["cost"],
+                               seq.progress[-1]["cost"], rtol=1e-4)
+    assert set(pp.params) == set(seq.params)
+    for k in pp.params:
+        np.testing.assert_allclose(np.asarray(pp.params[k], np.float32),
+                                   np.asarray(seq.params[k], np.float32),
+                                   atol=1e-5, err_msg=k)
+    # update-ratio vector keeps the canonical per-weight ordering/length
+    assert (len(pp.progress[-1]["weight_upd_ratio"])
+            == len(seq.progress[-1]["weight_upd_ratio"]))
+
+
+def test_train_pipe_checkpoint_roundtrip(workdir, toy_gpt_layers, toy_shards,
+                                         monkeypatch):
+    """Mid-training checkpoints written from the stacked layout deserialize
+    into the canonical flat layout with matching optimizer state."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"adamw": {"lr": 1e-3, "betas": [0.9, 0.95], "eps": 1e-8}}
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    model = NeuralNetworkModel("ppck", Mapper(toy_gpt_layers, optim))
+    model.to_device("cpu")
+    mesh = model._training_mesh(micro_batch=8, block_size=16)
+    assert mesh is not None and mesh.shape["pipe"] == 2
+    # enter the stacked layout and serialize from it (the mid-training path)
+    model._enter_pipe_layout(mesh, batch_size=8)
+    assert model._pipe_layout is not None
+    assert any(k.startswith("__pipe__") for k in model.params)
+    model.serialize(sync_flush=True, tag=0)
+    loaded = NeuralNetworkModel.deserialize("ppck")
+    fresh = NeuralNetworkModel("ref", Mapper(toy_gpt_layers, optim))
+    assert set(loaded.params) == set(fresh.params)
+    model._exit_pipe_layout()
+    for k in loaded.params:
+        np.testing.assert_array_equal(np.asarray(loaded.params[k]),
+                                      np.asarray(model.params[k]), err_msg=k)
+    l_leaves = jax.tree.leaves(loaded.opt_state)
+    m_leaves = jax.tree.leaves(model.opt_state)
+    assert len(l_leaves) == len(m_leaves)
+    for a, b in zip(l_leaves, m_leaves):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=0)
+    # and a full train run with pipe>1 round-trips through /progress/ state
+    model2 = NeuralNetworkModel.deserialize("ppck")
+    model2.to_device("cpu")
+    model2.train_model("toy", shard=0, epochs=1, batch_size=8,
+                       block_size=16, step_size=8)
+    assert model2.status["code"] == "Trained"
+    again = NeuralNetworkModel.deserialize("ppck")
+    for k in again.params:
+        np.testing.assert_array_equal(np.asarray(again.params[k]),
+                                      np.asarray(model2.params[k]), err_msg=k)
+
+
+def test_train_pipe_refusals(workdir, toy_gpt_layers, toy_shards,
+                             monkeypatch):
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"sgd": {"lr": 0.1}}
+    # pipe × TP is refused loudly, not silently mis-sharded
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    monkeypatch.setenv("PENROZ_MESH_MODEL", "2")
+    model = NeuralNetworkModel("ppref", Mapper(toy_gpt_layers, optim))
+    model.to_device("cpu")
+    with pytest.raises(RuntimeError, match="data parallelism"):
+        model._training_mesh(micro_batch=8, block_size=16)
+    monkeypatch.delenv("PENROZ_MESH_MODEL")
+    # ZeRO ladder does not compose with the stacked layout yet
+    monkeypatch.setenv("PENROZ_FSDP", "1")
+    mesh = model._training_mesh(micro_batch=8, block_size=16)
+    with pytest.raises(RuntimeError, match="ZeRO"):
+        model._enter_pipe_layout(mesh, batch_size=8)
+    monkeypatch.delenv("PENROZ_FSDP")
+    # a DSL whose longest identical-block run is too short for the axis
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "4")
+    with pytest.raises(RuntimeError, match="longest run"):
+        model._enter_pipe_layout(
+            model._training_mesh(micro_batch=8, block_size=16), batch_size=8)

@@ -145,7 +145,7 @@ def bench_batched_decode(arch, params, block=1024, tokens=64, batch=8):
     model.generate_tokens_batched(prompts, block, tokens, temperature=1.0)
     t0 = time.perf_counter()
     model.generate_tokens_batched(prompts, block, tokens, temperature=1.0)
-    return batch * tokens / (time.perf_counter() - t0)
+    return batch * tokens / (time.perf_counter() - t0), batch
 
 
 def bench_moe_dispatch(d=512, experts=8, top_k=2, depth=4, batch=8,
@@ -378,7 +378,7 @@ def main():
     dispatch_floor = bench_dispatch_floor()
     ttft_ms = bench_ttft(arch, params, block=block)
     decode_tps = bench_decode_throughput(arch, params, mapper, block=block)
-    batched_tps = bench_batched_decode(arch, params, block=block)
+    batched_tps, batched_n = bench_batched_decode(arch, params, block=block)
     paged_tps, paged_assigned = bench_paged_generate(arch, params,
                                                      block=block)
     long_ctx = bench_long_context()
@@ -397,7 +397,7 @@ def main():
         "ttft_ms_p50": round(ttft_ms, 2),
         "decode_tokens_per_sec": round(decode_tps, 1),
         "batched_decode_tokens_per_sec": round(batched_tps, 1),
-        "batched_decode_batch": 8,
+        "batched_decode_batch": batched_n,
         "paged_decode_tokens_per_sec": round(paged_tps, 1),
         "paged_assigned_mb": round(paged_assigned / 2 ** 20, 2),
         "dispatch_floor_ms": round(dispatch_floor, 2),

@@ -362,7 +362,10 @@ def _multihost_point():
             out, _ = p.communicate(timeout=1200)
             outs.append(out)
         if any(p.returncode != 0 for p in procs):
-            print(outs[0][-1500:], file=sys.stderr)
+            for i, (p, out) in enumerate(zip(procs, outs)):
+                if p.returncode != 0:
+                    print(f"multi-host worker {i} rc={p.returncode}:\n"
+                          f"{out[-1500:]}", file=sys.stderr)
             return None
         for out in outs:
             for line in out.splitlines():
@@ -426,15 +429,19 @@ def main() -> None:
                             + " --xla_force_host_platform_device_count=8"
                             ).strip()
         env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
-        out_c = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--comm"],
-            env=env, capture_output=True, text=True, timeout=1800)
-        if out_c.returncode == 0:
-            lines = [l for l in out_c.stdout.splitlines()
-                     if l.startswith("[")]
-            comm = json.loads(lines[-1]) if lines else None
-        else:
-            print(out_c.stderr[-1500:], file=sys.stderr)
+        try:
+            out_c = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--comm"],
+                env=env, capture_output=True, text=True, timeout=1800)
+            if out_c.returncode == 0:
+                lines = [l for l in out_c.stdout.splitlines()
+                         if l.startswith("[")]
+                comm = json.loads(lines[-1]) if lines else None
+            else:
+                print(out_c.stderr[-1500:], file=sys.stderr)
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as exc:
+            # optional enrichment: never lose the collected scaling points
+            print(f"comm breakdown skipped: {exc}", file=sys.stderr)
 
     mh = None
     if os.environ.get("BENCH_SCALING_MULTIHOST", "1") == "1":

@@ -47,9 +47,6 @@ from penroz_tpu.utils import checkpoint, profiling, stats as stats_lib
 
 log = logging.getLogger(__name__)
 
-# Warn-once latch: batched generation ignores the paged/int8 KV env flags.
-_WARNED_BATCHED_KV_FLAGS = False
-
 DECODE_CHUNK_ENV = "PENROZ_DECODE_CHUNK"
 
 
@@ -1071,7 +1068,11 @@ class NeuralNetworkModel:
             raise ValueError(
                 f"multi-host training: block_size {block_size} must be "
                 f"divisible by the sequence axis ({seq})")
-        if os.environ.get("PENROZ_MESH_PIPE", "1") not in ("", "1"):
+        try:
+            pipe_req = int(os.environ.get("PENROZ_MESH_PIPE", "1") or "1")
+        except ValueError:
+            pipe_req = 1
+        if pipe_req > 1:
             raise RuntimeError(
                 "PENROZ_MESH_PIPE>1 is single-host only for now (the GPipe "
                 "stages ride ICI; cross-host stage handoffs and sharded "
@@ -1395,9 +1396,9 @@ class NeuralNetworkModel:
         bit-identical to N separate ``generate_tokens`` calls (tested).
 
         Contract: ``max(prompt) + max_new_tokens <= block_size`` — the
-        batched path has no overflow crop/re-prefill.  Uses the plain fp
-        cache regardless of the paged/int8 env flags (shared-length pools
-        don't do ragged yet).
+        batched path has no overflow crop/re-prefill.  Honors the same
+        paged/int8 env flags as the single-sequence path (every cache
+        variant supports ragged per-sequence lengths).
         """
         prompts = [[int(t) for t in (row if isinstance(row, (list, tuple))
                                      else [row])] for row in inputs]
@@ -1417,15 +1418,6 @@ class NeuralNetworkModel:
                 f"(got {len(prompts)}; raise PENROZ_MAX_GENERATE_BATCH to "
                 f"override) — each row allocates a block_size KV cache per "
                 f"layer")
-        if KV.turbo_quant_enabled() or KV.paged_enabled():
-            global _WARNED_BATCHED_KV_FLAGS
-            if not _WARNED_BATCHED_KV_FLAGS:
-                _WARNED_BATCHED_KV_FLAGS = True
-                log.warning(
-                    "paged/int8 KV env flags are set but batched generation "
-                    "always uses the plain fp cache (shared-length pools "
-                    "don't do ragged); measurements here reflect the fp "
-                    "cache")
         B = len(prompts)
         lens = [len(p) for p in prompts]
         max_p = max(lens)
@@ -1463,8 +1455,11 @@ class NeuralNetworkModel:
         padded = np.zeros((B, max_p), np.int32)
         for i, p in enumerate(prompts):
             padded[i, :len(p)] = p
-        kv = KV.KVState.create(arch.kv_specs, B, block_size,
-                               self._kv_dtype())
+        # Same env-flag factory as the single-sequence path: paged / int8
+        # pools do ragged batches too (per-sequence lengths thread through
+        # the allocator, appends, and the ragged kernels).
+        kv = KV.create_kv_state(arch.kv_specs, B, block_size,
+                                self._kv_dtype())
         lengths = jnp.asarray(lens, jnp.int32)
         done = [False] * B
 

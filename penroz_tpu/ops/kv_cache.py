@@ -147,11 +147,8 @@ class KVState:
         """State with RAGGED per-sequence (B,) valid lengths — installed
         after a right-padded batched prefill (rows past a sequence's
         length hold garbage that the per-sequence masks never attend);
-        subsequent appends write each row at its own position."""
-        if type(self) is not KVState:
-            raise NotImplementedError(
-                "ragged per-sequence lengths are supported on the plain fp "
-                "KVState only (int8/paged pools keep a shared length)")
+        subsequent appends write each row at its own position.  Supported
+        by every cache variant (fp/int8 × contiguous/paged)."""
         return self._with_length(jnp.asarray(lengths, jnp.int32))
 
     def _with_length(self, length):
@@ -208,11 +205,22 @@ class QuantKVState(KVState):
         """
         qk, sk = _quantize_int8(k_new)
         qv, sv = _quantize_int8(v_new)
-        start = (0, 0, self.length, 0)
-        self.k[layer_idx] = jax.lax.dynamic_update_slice(self.k[layer_idx], qk, start)
-        self.v[layer_idx] = jax.lax.dynamic_update_slice(self.v[layer_idx], qv, start)
-        self.k_scale[layer_idx] = jax.lax.dynamic_update_slice(self.k_scale[layer_idx], sk, start)
-        self.v_scale[layer_idx] = jax.lax.dynamic_update_slice(self.v_scale[layer_idx], sv, start)
+        if jnp.ndim(self.length) >= 1:  # ragged: per-sequence positions
+            if k_new.shape[2] != 1:
+                raise ValueError(
+                    f"ragged int8 appends require T=1 (per-sequence write "
+                    f"positions); got T={k_new.shape[2]}")
+            b_idx = jnp.arange(k_new.shape[0])
+            for buf, new in ((self.k, qk), (self.v, qv),
+                             (self.k_scale, sk), (self.v_scale, sv)):
+                buf[layer_idx] = buf[layer_idx].at[
+                    b_idx, :, self.length].set(new[:, :, 0])
+        else:
+            start = (0, 0, self.length, 0)
+            for buf, new in ((self.k, qk), (self.v, qv),
+                             (self.k_scale, sk), (self.v_scale, sv)):
+                buf[layer_idx] = jax.lax.dynamic_update_slice(
+                    buf[layer_idx], new, start)
         return (self.k[layer_idx], self.v[layer_idx],
                 self.length + k_new.shape[2])
 
@@ -271,18 +279,24 @@ class PagedKVState(KVState):
 
     # ``counters`` packs (length, next_free, assigned_pages) into one int32
     # array: a single buffer cannot alias itself when the state is donated.
+    # RAGGED batches carry a separate ``ragged_lengths`` (B,) child (the
+    # packed scalar slot cannot hold a vector); when present it supersedes
+    # ``counters[0]``.
 
     def __init__(self, k, v, counters, block_table,
-                 page_size: int, pages_per_seq: int):
+                 page_size: int, pages_per_seq: int, ragged_lengths=None):
         self.k = list(k)
         self.v = list(v)
         self.counters = counters
         self.block_table = block_table
         self.page_size = int(page_size)
         self.pages_per_seq = int(pages_per_seq)
+        self.ragged_lengths = ragged_lengths
 
     @property
     def length(self):
+        if self.ragged_lengths is not None:
+            return self.ragged_lengths
         return self.counters[0]
 
     @property
@@ -296,14 +310,15 @@ class PagedKVState(KVState):
 
     def tree_flatten(self):
         children = (tuple(self.k), tuple(self.v), self.counters,
-                    self.block_table)
+                    self.block_table, self.ragged_lengths)
         return children, (self.page_size, self.pages_per_seq)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, counters, block_table = children
+        k, v, counters, block_table, ragged = children
         return cls(list(k), list(v), counters, block_table,
-                   page_size=aux[0], pages_per_seq=aux[1])
+                   page_size=aux[0], pages_per_seq=aux[1],
+                   ragged_lengths=ragged)
 
     @classmethod
     def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32,
@@ -337,9 +352,16 @@ class PagedKVState(KVState):
         the same ``new_length``; ``assigned_pages`` (not ``length``, which
         only advances post-step) tracks what the first call handed out, so
         subsequent calls see ``delta == 0``.
+
+        RAGGED (B,) lengths allocate uniformly to the longest sequence —
+        a shorter sequence's write position is always below the longest's,
+        so its page is covered; the over-assignment is bounded by one page
+        per sequence ahead of need.
         """
         P, S = self.page_size, self.pages_per_seq
         B = self.block_table.shape[0]
+        if jnp.ndim(new_length) >= 1:
+            new_length = jnp.max(new_length)
         assigned = self.assigned_pages
         needed = jnp.minimum((new_length + P - 1) // P, S)
         delta = needed - assigned
@@ -360,9 +382,24 @@ class PagedKVState(KVState):
 
     def _allocate_rows(self, T: int):
         """Bump-allocate pages for ``T`` new tokens; returns the flat pool
-        row index per (batch, token) plus the new valid length."""
+        row index per (batch, token) plus the new valid length.
+
+        RAGGED (B,) lengths (``with_lengths``): each sequence's row lands
+        at its own position — T must be 1, mirroring the contiguous
+        ragged-append contract (the batched decode hot loop)."""
         new_length = self.length + T
         self._allocate(new_length)
+        if jnp.ndim(self.length) >= 1:
+            if T != 1:
+                raise ValueError(
+                    f"ragged paged appends require T=1 (per-sequence "
+                    f"write positions); got T={T}")
+            P = self.page_size
+            page = jnp.clip(self.length // P, 0, self.pages_per_seq - 1)
+            phys = jnp.take_along_axis(self.block_table, page[:, None],
+                                       axis=1)[:, 0]         # (B,)
+            rows = phys * P + self.length % P
+            return rows, new_length                          # rows: (B,)
         pos = self.length + jnp.arange(T, dtype=jnp.int32)
         return self._rows(pos).reshape(-1), new_length  # rows: (B*T,)
 
@@ -405,6 +442,12 @@ class PagedKVState(KVState):
                         mode="clip").transpose(1, 0, 2, 3)
 
     def _with_length(self, length):
+        if jnp.ndim(length) >= 1:
+            return PagedKVState(list(self.k), list(self.v), self.counters,
+                                self.block_table, self.page_size,
+                                self.pages_per_seq,
+                                ragged_lengths=jnp.asarray(length,
+                                                           jnp.int32))
         counters = self.counters.at[0].set(length)
         return PagedKVState(list(self.k), list(self.v), counters,
                             self.block_table,
@@ -454,9 +497,9 @@ class QuantPagedKVState(PagedKVState):
 
     def __init__(self, k, v, counters, block_table, page_size: int,
                  pages_per_seq: int, k_scale, v_scale,
-                 out_dtype=jnp.float32):
+                 out_dtype=jnp.float32, ragged_lengths=None):
         super().__init__(k, v, counters, block_table, page_size,
-                         pages_per_seq)
+                         pages_per_seq, ragged_lengths=ragged_lengths)
         self.k_scale = list(k_scale)
         self.v_scale = list(v_scale)
         self.out_dtype = out_dtype
@@ -464,16 +507,16 @@ class QuantPagedKVState(PagedKVState):
     def tree_flatten(self):
         children = (tuple(self.k), tuple(self.v), self.counters,
                     self.block_table, tuple(self.k_scale),
-                    tuple(self.v_scale))
+                    tuple(self.v_scale), self.ragged_lengths)
         return children, (self.page_size, self.pages_per_seq, self.out_dtype)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, counters, block_table, k_scale, v_scale = children
+        k, v, counters, block_table, k_scale, v_scale, ragged = children
         return cls(list(k), list(v), counters, block_table,
                    page_size=aux[0], pages_per_seq=aux[1],
                    k_scale=list(k_scale), v_scale=list(v_scale),
-                   out_dtype=aux[2])
+                   out_dtype=aux[2], ragged_lengths=ragged)
 
     @classmethod
     def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32,
@@ -516,6 +559,12 @@ class QuantPagedKVState(PagedKVState):
         return k_full, v_full, new_length
 
     def _with_length(self, length):
+        if jnp.ndim(length) >= 1:
+            return QuantPagedKVState(
+                list(self.k), list(self.v), self.counters, self.block_table,
+                self.page_size, self.pages_per_seq, list(self.k_scale),
+                list(self.v_scale), out_dtype=self.out_dtype,
+                ragged_lengths=jnp.asarray(length, jnp.int32))
         counters = self.counters.at[0].set(length)
         return QuantPagedKVState(list(self.k), list(self.v), counters,
                                  self.block_table, self.page_size,

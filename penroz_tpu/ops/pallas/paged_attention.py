@@ -28,18 +28,34 @@ from penroz_tpu.ops.pallas.decode_attention import normalize_lengths
 _NEG_INF = -1e30
 
 
-def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
-                  page_size: int, num_queries: int, pages_per_seq: int,
-                  sm_scale: float, quantized: bool = False, window=None):
+def _paged_kernel(len_ref, table_ref, q_ref, *rest,
+                  page_size: int, num_queries: int, grid_pages: int,
+                  fetch_pages: int, sm_scale: float,
+                  quantized: bool = False, window=None):
+    """One grid step attends ``fetch_pages`` consecutive logical pages.
+
+    Walking one page per step makes per-step DMA latency and scalar-core
+    bookkeeping the decode bottleneck (the contiguous kernel streams
+    512-row tiles; a lone 128-row page is 4× the step count for the same
+    bytes).  Fetching G pages per step — each through its own
+    scalar-prefetched BlockSpec, so the G DMAs overlap — restores
+    contiguous-sized tiles while keeping the vLLM-style pool layout.
+    """
+    G = fetch_pages
+    k_refs = rest[:G]
+    v_refs = rest[G:2 * G]
+    rest = rest[2 * G:]
     if quantized:  # int8 pools carry per-token scale pages
-        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        o_ref, acc_ref, m_ref, l_ref = rest
+        ks_refs = rest[:G]
+        vs_refs = rest[G:2 * G]
+        rest = rest[2 * G:]
+    o_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
     total = len_ref[b]  # ragged: each sequence has its own valid length
     offset = total - num_queries
     gt = q_ref.shape[2]
+    span = G * page_size  # tokens covered by one grid step
 
     @pl.when(j == 0)
     def _init():
@@ -47,28 +63,37 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    live = j * page_size < total
+    live = j * span < total
     if window is not None:
-        # pages entirely below every query's window contribute nothing
-        live &= (j + 1) * page_size - 1 > offset - window
+        # steps entirely below every query's window contribute nothing
+        live &= (j + 1) * span - 1 > offset - window
 
     @pl.when(live)
-    def _attend_page():
+    def _attend_pages():
         q = q_ref[0, 0]          # (GT, D)
-        k = k_ref[0]             # (page_size, D)
-        v = v_ref[0]
-        if quantized:
-            # Dequantize the page in VMEM: int8 values × per-token scales
-            # (TurboQuant layout, ops/kv_cache.py:_quantize_int8).
-            k = (k.astype(jnp.float32) * ks_ref[0]).astype(q.dtype)
-            v = (v.astype(jnp.float32) * vs_ref[0]).astype(q.dtype)
+        ks, vs = [], []
+        for g in range(G):
+            k = k_refs[g][0]     # (page_size, D)
+            v = v_refs[g][0]
+            if quantized:
+                # Dequantize the page in VMEM: int8 values × per-token
+                # scales (TurboQuant layout, ops/kv_cache.py:_quantize_int8).
+                k = (k.astype(jnp.float32) * ks_refs[g][0]).astype(q.dtype)
+                v = (v.astype(jnp.float32) * vs_refs[g][0]).astype(q.dtype)
+            ks.append(k)
+            vs.append(v)
+        k = ks[0] if G == 1 else jnp.concatenate(ks, axis=0)  # (span, D)
+        v = vs[0] if G == 1 else jnp.concatenate(vs, axis=0)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale  # (GT, P)
-        t = jax.lax.broadcasted_iota(jnp.int32, (gt, page_size), 0) \
+            preferred_element_type=jnp.float32) * sm_scale  # (GT, span)
+        t = jax.lax.broadcasted_iota(jnp.int32, (gt, span), 0) \
             % num_queries
-        k_pos = j * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (gt, page_size), 1)
+        k_pos = j * span + jax.lax.broadcasted_iota(
+            jnp.int32, (gt, span), 1)
+        # Positions past the sequence's occupancy — including clamped
+        # re-fetches of in-band pages standing in for out-of-band ones —
+        # carry logical k_pos > the causal bound, so this mask kills them.
         mask = k_pos <= offset + t
         if window is not None:
             mask &= k_pos > offset + t - window
@@ -79,10 +104,10 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
-        if window is not None:
-            # _NEG_INF is finite: fully-masked rows in early pages would
-            # otherwise get p = exp(-1e30 - -1e30) = 1
-            p = jnp.where(mask, p, 0.0)
+        # _NEG_INF is finite: fully-masked rows (early windowed pages, or
+        # steps whose pages all sit past the occupancy) would otherwise
+        # get p = exp(-1e30 - -1e30) = 1
+        p = jnp.where(mask, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -90,16 +115,31 @@ def _paged_kernel(len_ref, table_ref, q_ref, k_ref, v_ref, *rest,
         m_ref[:, 0] = m_new
         l_ref[:, 0] = l_new
 
-    @pl.when(j == pages_per_seq - 1)
+    @pl.when(j == grid_pages - 1)
     def _finalize():
         l = l_ref[:, 0]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
 
 
+def default_fetch_pages() -> int:
+    """Logical pages fetched per kernel grid step
+    (``PENROZ_PAGED_FETCH_PAGES``, default 4 → 512-token effective tiles
+    at the default 128-token page, matching the contiguous decode
+    kernel's tile size)."""
+    import os
+    raw = os.environ.get("PENROZ_PAGED_FETCH_PAGES", "4")
+    try:
+        n = int(raw)
+        return n if n >= 1 else 4
+    except ValueError:
+        return 4
+
+
 def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
                            offset, length, k_scale=None, v_scale=None,
-                           interpret: bool = False, window=None):
+                           interpret: bool = False, window=None,
+                           fetch_pages: int | None = None):
     """Cached attention over a paged pool.
 
     q: (B, Hq, T, D) new queries; flat_k/flat_v: (Hkv, num_pages *
@@ -119,6 +159,9 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
     pages_per_seq = block_table.shape[1]
     sm_scale = 1.0 / (D ** 0.5)
     quantized = k_scale is not None
+    G = fetch_pages if fetch_pages is not None else default_fetch_pages()
+    G = max(1, min(int(G), pages_per_seq))
+    grid_pages = (pages_per_seq + G - 1) // G
 
     q_rows = q.reshape(B, Hkv, group * T, D)
     total = normalize_lengths(length, B)
@@ -127,46 +170,47 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
     table = jnp.maximum(block_table, 0).astype(jnp.int32).reshape(-1)
 
     kernel = functools.partial(_paged_kernel, page_size=page_size,
-                               num_queries=T, pages_per_seq=pages_per_seq,
-                               sm_scale=sm_scale, quantized=quantized,
+                               num_queries=T, grid_pages=grid_pages,
+                               fetch_pages=G, sm_scale=sm_scale,
+                               quantized=quantized,
                                window=int(window) if window is not None
                                else None)
 
-    def page_lookup(b, j, len_ref, table_ref):
+    def page_lookup(b, logical, len_ref, table_ref):
         # Clamp out-of-band steps to the nearest in-band logical page: same
         # physical index ⇒ the DMA is elided, so pages past the sequence's
         # own occupancy (and below the window band) are never fetched.
         hi = jax.lax.div(len_ref[b] + page_size - 1, page_size)
-        j_eff = jnp.minimum(j, jnp.maximum(hi - 1, 0))
+        j_eff = jnp.minimum(logical, jnp.maximum(hi - 1, 0))
         if window is not None:
             lo_pos = jnp.maximum(len_ref[b] - T - int(window) + 1, 0)
             j_eff = jnp.maximum(j_eff, jax.lax.div(lo_pos, page_size))
         return table_ref[b * pages_per_seq + j_eff]
 
-    page_spec = pl.BlockSpec(
-        (1, page_size, D),
-        lambda b, h, j, len_ref, table_ref:
-            (h, page_lookup(b, j, len_ref, table_ref), 0),
-        memory_space=pltpu.VMEM)
+    def page_spec(g, width):
+        # One BlockSpec per sub-page: the G DMAs of a grid step issue
+        # together and overlap, while each keeps its own block-table slot.
+        return pl.BlockSpec(
+            (1, page_size, width),
+            lambda b, h, j, len_ref, table_ref:
+                (h, page_lookup(b, j * G + g, len_ref, table_ref), 0),
+            memory_space=pltpu.VMEM)
+
     in_specs = [
         pl.BlockSpec((1, 1, group * T, D),
                      lambda b, h, j, len_ref, table_ref: (b, h, 0, 0),
                      memory_space=pltpu.VMEM),
-        page_spec,
-        page_spec,
     ]
-    operands = [q_rows, flat_k, flat_v]
+    in_specs += [page_spec(g, D) for g in range(G)]  # k pages
+    in_specs += [page_spec(g, D) for g in range(G)]  # v pages
+    operands = [q_rows] + [flat_k] * G + [flat_v] * G
     if quantized:
-        scale_spec = pl.BlockSpec(
-            (1, page_size, 1),
-            lambda b, h, j, len_ref, table_ref:
-                (h, page_lookup(b, j, len_ref, table_ref), 0),
-            memory_space=pltpu.VMEM)
-        in_specs += [scale_spec, scale_spec]
-        operands += [k_scale, v_scale]
+        in_specs += [page_spec(g, 1) for g in range(G)]
+        in_specs += [page_spec(g, 1) for g in range(G)]
+        operands += [k_scale] * G + [v_scale] * G
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, Hkv, pages_per_seq),
+        grid=(B, Hkv, grid_pages),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group * T, D),
                                lambda b, h, j, len_ref, table_ref:

@@ -85,7 +85,12 @@ def reconfig_logging(log_dir: str | None = None) -> str | None:
         f"%(name)s: %(message)s"))
     handler._penroz_rank_handler = True
     root.addHandler(handler)
-    if root.level > logging.INFO or root.level == logging.NOTSET:
+    # An unconfigured root (NOTSET, or the stock WARNING default with no
+    # explicit PENROZ_LOG_CONFIG) is lowered so training records reach the
+    # rank files; an operator-configured level stays authoritative.
+    if root.level == logging.NOTSET or (
+            root.level == logging.WARNING
+            and "PENROZ_LOG_CONFIG" not in os.environ):
         root.setLevel(logging.INFO)
     log.info("Per-rank logging for process %d/%d -> %s", rank,
              process_count(), path)

@@ -715,3 +715,37 @@ def test_cached_attention_oracle_ragged_b1():
                              platform="cpu")
     ref = A.cached_attention(q, k, v, jnp.asarray(16), 17, platform="cpu")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_paged_kernel_fetch_pages_parity_interpret():
+    """Multi-page fetch (G pages per grid step) is numerically identical to
+    the single-page walk across G values, incl. non-dividing G (ceil
+    padding), ragged lengths, and a partially filled last page."""
+    from penroz_tpu.ops.pallas import paged_attention as PA
+    from penroz_tpu.ops import kv_cache as KV
+    rng = np.random.default_rng(11)
+    B, Hq, Hkv, D, P, pages = 2, 4, 2, 64, 16, 8
+    state = KV.PagedKVState.create([(Hkv, D)], batch=B, max_len=P * pages,
+                                   page_size=P)
+    fill = 5 * P + 7
+    k_fill = jnp.asarray(rng.normal(size=(B, Hkv, fill, D)), jnp.float32)
+    v_fill = jnp.asarray(rng.normal(size=(B, Hkv, fill, D)), jnp.float32)
+    state.append_rows(0, k_fill, v_fill)
+    state = state.advanced(fill)
+    q = jnp.asarray(rng.normal(size=(B, Hq, 1, D)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(B, Hkv, 1, D)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, Hkv, 1, D)), jnp.float32)
+    flat_k, flat_v, length = state.append_rows(0, k_new, v_new)
+    # ragged: second sequence pretends to be shorter
+    lengths = jnp.asarray([int(length), int(length) - P - 3], jnp.int32)
+    for window in (None, 2 * P + 5):
+        base = PA.paged_decode_attention(
+            q, flat_k, flat_v, state.block_table, P, state.length, lengths,
+            interpret=True, window=window, fetch_pages=1)
+        for G in (2, 3, 4, 8):
+            out = PA.paged_decode_attention(
+                q, flat_k, flat_v, state.block_table, P, state.length,
+                lengths, interpret=True, window=window, fetch_pages=G)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(base), atol=2e-5,
+                err_msg=f"G={G} window={window}")

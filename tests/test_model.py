@@ -528,6 +528,28 @@ def test_batched_generate_matches_single(workdir, toy_gpt_layers):
         assert out == single, (p, out, single)
 
 
+@pytest.mark.parametrize("paged,quant", [("1", "0"), ("0", "1"), ("1", "1")])
+def test_batched_generate_matches_single_env_caches(workdir, toy_gpt_layers,
+                                                    monkeypatch, paged,
+                                                    quant):
+    """Batched ≡ single parity holds under the paged / int8 / int8-paged
+    cache variants too — every pool supports ragged per-sequence lengths
+    (allocator, appends, kernels/oracles)."""
+    monkeypatch.setenv("PAGED_KV_CACHE", paged)
+    monkeypatch.setenv("TURBO_QUANT_KV_CACHE", quant)
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    model = NeuralNetworkModel(f"bgc{paged}{quant}",
+                               Mapper(toy_gpt_layers, SGD))
+    prompts = [[1, 2, 3, 4, 5], [7, 8], [9, 10, 11]]
+    batched = model.generate_tokens_batched(prompts, block_size=16,
+                                            max_new_tokens=6,
+                                            temperature=0.0)
+    for p, out in zip(prompts, batched):
+        single = model.generate_tokens([p], block_size=16, max_new_tokens=6,
+                                       temperature=0.0)
+        assert out == single, (paged, quant, p, out, single)
+
+
 def test_batched_generate_stop_token_and_validation(workdir, toy_gpt_layers,
                                                     monkeypatch):
     model = NeuralNetworkModel("bg2", Mapper(toy_gpt_layers, SGD))

@@ -12,12 +12,34 @@ path: vs_baseline = measured_MFU / 0.35.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+PARTIAL_PATH = os.environ.get("PENROZ_BENCH_PARTIAL", "BENCH_PARTIAL.json")
+_partial: dict = {}
+
+
+def emit(**metrics):
+    """Write each metric to ``BENCH_PARTIAL.json`` the moment its phase
+    completes.  Round-3's bench printed one line at the very end after ~7
+    sequential phases; a pool that answered probes but died mid-run lost
+    every number (BENCH_r03.json rc=3).  With per-phase flushes, a pool
+    that lives five minutes still yields the headline metrics."""
+    import sys
+    _partial.update({k: v for k, v in metrics.items() if v is not None})
+    tmp = PARTIAL_PATH + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(_partial, fh, indent=1, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, PARTIAL_PATH)
+    keys = ", ".join(sorted(metrics))
+    print(f"bench: phase done -> {keys}", file=sys.stderr, flush=True)
 
 
 def _flops_per_token(n_matmul_params: int, depth: int, d_model: int,
@@ -241,7 +263,7 @@ def bench_paged_generate(arch, params, block=1024, tokens=64):
 
 
 def bench_long_context(depth=12, d_model=768, block=4096, batch=1,
-                       steps_per_call=2, timed=4):
+                       steps_per_call=2, timed=4, heads=12):
     """Long-context training throughput at T=4096 (flash fwd+bwd kernels
     stream K/V through the grid, so the (T,S) score matrix never
     materializes; the epoch runs with remat — ``jax.checkpoint`` around the
@@ -254,7 +276,7 @@ def bench_long_context(depth=12, d_model=768, block=4096, batch=1,
     from penroz_tpu.models import presets
 
     try:
-        layers = presets.gpt2_custom(d=d_model, heads=12, depth=depth,
+        layers = presets.gpt2_custom(d=d_model, heads=heads, depth=depth,
                                      vocab=50304, block=block)
         mapper = Mapper(layers, OPTIMIZER)
         arch = CompiledArch.get(mapper.layers)
@@ -361,10 +383,18 @@ def main():
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import CompiledArch
 
+    # PENROZ_BENCH_SMOKE=1: tiny shapes/counts so the whole phase pipeline
+    # (ordering, partial emission, params re-init after donation) can be
+    # validated on CPU without a chip.  Numbers produced under smoke are
+    # meaningless and the artifact says so.
+    smoke = os.environ.get("PENROZ_BENCH_SMOKE") == "1"
     _wait_for_backend()
     device = _devices_or_die()[0]
-    depth, d_model, block = 12, 768, 1024
-    mapper = Mapper(_gpt2_dsl(depth=depth, d=d_model, block=block), OPTIMIZER)
+    depth, d_model, block = (2, 64, 256) if smoke else (12, 768, 1024)
+    if smoke:
+        emit(smoke=True)
+    mapper = Mapper(_gpt2_dsl(depth=depth, d=d_model, block=block,
+                              heads=4 if smoke else 12), OPTIMIZER)
     arch = CompiledArch.get(mapper.layers)
     params, _ = mapper.init_params(arch.mods, seed=0)
     params = jax.device_put(params, device)
@@ -373,43 +403,61 @@ def main():
     n_matmul_params = n_params - sum(
         int(np.prod(p.shape)) for k, p in params.items()
         if k.startswith("layers.0."))
+    emit(device=str(device.device_kind), n_params=n_params)
 
-    # TTFT/decode first — the training benchmark donates (consumes) params.
-    dispatch_floor = bench_dispatch_floor()
-    ttft_ms = bench_ttft(arch, params, block=block)
-    decode_tps = bench_decode_throughput(arch, params, mapper, block=block)
-    batched_tps, batched_n = bench_batched_decode(arch, params, block=block)
-    paged_tps, paged_assigned = bench_paged_generate(arch, params,
-                                                     block=block)
-    long_ctx = bench_long_context()
-    moe = bench_moe_dispatch()
-    tokens_per_sec, cost = bench_train(arch, mapper, params)
+    # Headline phases first: a pool that dies mid-run must still yield the
+    # numbers that matter (train MFU, then TTFT).  The train benchmark
+    # donates (consumes) params, so it runs on its own freshly-initialized
+    # copy and the decode phases re-init afterwards.
+    train_params = jax.device_put(mapper.init_params(arch.mods, seed=0)[0],
+                                  device)
+    train_kw = (dict(batch=2, block=block, steps_per_call=2, warmup=1,
+                     timed=2) if smoke else {})
+    tokens_per_sec, cost = bench_train(arch, mapper, train_params, **train_kw)
     mfu = (tokens_per_sec
            * _flops_per_token(n_matmul_params, depth, d_model, block)
            / peak_flops(device))
+    emit(value=round(tokens_per_sec, 1), mfu=round(mfu, 4),
+         vs_baseline=round(mfu / 0.35, 3), train_cost_sample=round(cost, 3))
+
+    ttft_ms = bench_ttft(arch, params, block=block,
+                         trials=3 if smoke else 10)
+    emit(ttft_ms_p50=round(ttft_ms, 2))
+    dispatch_floor = bench_dispatch_floor()
+    emit(dispatch_floor_ms=round(dispatch_floor, 2))
+
+    decode_tps = bench_decode_throughput(arch, params, mapper, block=block,
+                                         tokens=8 if smoke else 96)
+    emit(decode_tokens_per_sec=round(decode_tps, 1))
+    paged_tps, paged_assigned = bench_paged_generate(
+        arch, params, block=block, tokens=8 if smoke else 64)
+    emit(paged_decode_tokens_per_sec=round(paged_tps, 1),
+         paged_assigned_mb=round(paged_assigned / 2 ** 20, 2),
+         paged_vs_contiguous=round(paged_tps / decode_tps, 3))
+    batched_tps, batched_n = bench_batched_decode(
+        arch, params, block=block, tokens=4 if smoke else 64,
+        batch=3 if smoke else 8)
+    emit(batched_decode_tokens_per_sec=round(batched_tps, 1),
+         batched_decode_batch=batched_n)
+
+    long_ctx = bench_long_context(**(dict(depth=2, d_model=64, block=512,
+                                          timed=1, heads=4)
+                                     if smoke else {}))
+    if long_ctx:
+        emit(long_ctx_tokens_per_sec=round(long_ctx[0], 1),
+             long_ctx_mfu=round(long_ctx[1], 4), long_ctx_block=long_ctx[2])
+    moe = bench_moe_dispatch(**(dict(d=64, experts=4, top_k=2, depth=2,
+                                     batch=2, block=64, timed=1)
+                                if smoke else {}))
+    if moe:
+        emit(moe_dense_tokens_per_sec=round(moe[0], 1),
+             moe_capacity_tokens_per_sec=round(moe[1], 1),
+             moe_speedup=round(moe[1] / moe[0], 3))
 
     print(json.dumps({
         "metric": "gpt2-124M train tokens/sec/chip",
-        "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(mfu / 0.35, 3),
-        "mfu": round(mfu, 4),
-        "ttft_ms_p50": round(ttft_ms, 2),
-        "decode_tokens_per_sec": round(decode_tps, 1),
-        "batched_decode_tokens_per_sec": round(batched_tps, 1),
-        "batched_decode_batch": batched_n,
-        "paged_decode_tokens_per_sec": round(paged_tps, 1),
-        "paged_assigned_mb": round(paged_assigned / 2 ** 20, 2),
-        "dispatch_floor_ms": round(dispatch_floor, 2),
-        "train_cost_sample": round(cost, 3),
-        "device": str(device.device_kind),
-        "n_params": n_params,
-        **({"long_ctx_tokens_per_sec": round(long_ctx[0], 1),
-            "long_ctx_mfu": round(long_ctx[1], 4),
-            "long_ctx_block": long_ctx[2]} if long_ctx else {}),
-        **({"moe_dense_tokens_per_sec": round(moe[0], 1),
-            "moe_capacity_tokens_per_sec": round(moe[1], 1),
-            "moe_speedup": round(moe[1] / moe[0], 3)} if moe else {}),
+        **_partial,
     }))
 
 

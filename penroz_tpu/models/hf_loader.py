@@ -40,7 +40,13 @@ def resolve_checkpoint_dir(repo_or_path: str,
     from huggingface_hub import snapshot_download
     local = snapshot_download(repo_or_path, revision=revision,
                               allow_patterns=_SAFETENSORS_PATTERNS)
-    if not any(f.endswith(".safetensors") for f in os.listdir(local)):
+    # Walk the whole snapshot: repos storing weights under a subfolder
+    # would otherwise trigger the redundant second download that also
+    # pulls pytorch_model*.bin — the exact double transfer avoided here.
+    has_safetensors = any(
+        f.endswith(".safetensors")
+        for _, _, files in os.walk(local) for f in files)
+    if not has_safetensors:
         local = snapshot_download(repo_or_path, revision=revision,
                                   allow_patterns=_SAFETENSORS_PATTERNS
                                   + _BIN_PATTERNS)
@@ -80,6 +86,26 @@ def load_state_dict(local_dir: str) -> dict:
     else:
         shards = sorted(f for f in os.listdir(local_dir)
                         if f.endswith(".safetensors"))
+        if not shards:
+            # Weights may live in a subfolder (resolve_checkpoint_dir's
+            # safetensors detection walks recursively, so loading must
+            # too, or detection outpaces what this function can consume).
+            # A subfolder index wins over loose nested files.
+            for root, _, files in os.walk(local_dir):
+                if root != local_dir and "model.safetensors.index.json" \
+                        in files:
+                    with open(os.path.join(
+                            root, "model.safetensors.index.json")) as f:
+                        weight_map = json.load(f)["weight_map"]
+                    rel = os.path.relpath(root, local_dir)
+                    shards = sorted({os.path.join(rel, v)
+                                     for v in weight_map.values()})
+                    break
+            else:
+                shards = sorted(
+                    os.path.relpath(os.path.join(root, f), local_dir)
+                    for root, _, files in os.walk(local_dir)
+                    for f in files if f.endswith(".safetensors"))
     if not shards:
         return _normalize(_load_torch_fallback(local_dir))
     sd = {}

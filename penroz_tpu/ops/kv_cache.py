@@ -73,22 +73,38 @@ def _dequantize_int8(q, scale, dtype):
 
 @jax.tree_util.register_pytree_node_class
 class KVState:
-    """Preallocated functional KV buffers: per-layer (B, Hkv, S_max, D)."""
+    """Preallocated functional KV buffers: per-layer (B, Hkv, S_max, D).
+
+    RAGGED batches carry a separate ``ragged_lengths`` (B,) child next to
+    the scalar ``_length`` slot rather than replacing it — the scalar leaf
+    must survive into the ragged state so a donated input cache's scalar
+    buffer has a matching output to alias (otherwise every batched prefill
+    emits "donated buffers were not usable: int32[]").  The stale scalar is
+    poisoned to -1 so a direct read fails loudly; ``length`` masks it.
+    """
 
     quantized = False
 
-    def __init__(self, k, v, length):
+    def __init__(self, k, v, length, ragged_lengths=None):
         self.k = list(k)
         self.v = list(v)
-        self.length = length
+        self._length = length
+        self.ragged_lengths = ragged_lengths
+
+    @property
+    def length(self):
+        if self.ragged_lengths is not None:
+            return self.ragged_lengths
+        return self._length
 
     def tree_flatten(self):
-        return (tuple(self.k), tuple(self.v), self.length), len(self.k)
+        return (tuple(self.k), tuple(self.v), self._length,
+                self.ragged_lengths), len(self.k)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, length = children
-        return cls(list(k), list(v), length)
+        k, v, length, ragged = children
+        return cls(list(k), list(v), length, ragged_lengths=ragged)
 
     @classmethod
     def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32):
@@ -152,6 +168,10 @@ class KVState:
         return self._with_length(jnp.asarray(lengths, jnp.int32))
 
     def _with_length(self, length):
+        if jnp.ndim(length) >= 1:
+            return KVState(list(self.k), list(self.v),
+                           jnp.full_like(self._length, -1),
+                           ragged_lengths=jnp.asarray(length, jnp.int32))
         return KVState(list(self.k), list(self.v), length)
 
     # Observability: bytes resident in HBM for this cache.
@@ -169,22 +189,24 @@ class QuantKVState(KVState):
 
     quantized = True
 
-    def __init__(self, k, v, length, k_scale, v_scale, out_dtype=jnp.float32):
-        super().__init__(k, v, length)
+    def __init__(self, k, v, length, k_scale, v_scale, out_dtype=jnp.float32,
+                 ragged_lengths=None):
+        super().__init__(k, v, length, ragged_lengths=ragged_lengths)
         self.k_scale = list(k_scale)
         self.v_scale = list(v_scale)
         self.out_dtype = out_dtype
 
     def tree_flatten(self):
-        children = (tuple(self.k), tuple(self.v), self.length,
-                    tuple(self.k_scale), tuple(self.v_scale))
+        children = (tuple(self.k), tuple(self.v), self._length,
+                    tuple(self.k_scale), tuple(self.v_scale),
+                    self.ragged_lengths)
         return children, (len(self.k), self.out_dtype)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, length, k_scale, v_scale = children
+        k, v, length, k_scale, v_scale, ragged = children
         return cls(list(k), list(v), length, list(k_scale), list(v_scale),
-                   out_dtype=aux[1])
+                   out_dtype=aux[1], ragged_lengths=ragged)
 
     @classmethod
     def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32):
@@ -234,6 +256,12 @@ class QuantKVState(KVState):
         return k_full, v_full, new_length
 
     def _with_length(self, length):
+        if jnp.ndim(length) >= 1:
+            return QuantKVState(list(self.k), list(self.v),
+                                jnp.full_like(self._length, -1),
+                                list(self.k_scale), list(self.v_scale),
+                                out_dtype=self.out_dtype,
+                                ragged_lengths=jnp.asarray(length, jnp.int32))
         return QuantKVState(list(self.k), list(self.v), length,
                             list(self.k_scale), list(self.v_scale),
                             out_dtype=self.out_dtype)
@@ -443,7 +471,11 @@ class PagedKVState(KVState):
 
     def _with_length(self, length):
         if jnp.ndim(length) >= 1:
-            return PagedKVState(list(self.k), list(self.v), self.counters,
+            # counters[0] would go stale behind ragged_lengths; poison it
+            # so any future direct read fails loudly instead of returning
+            # the prefill-time scalar.
+            counters = self.counters.at[0].set(-1)
+            return PagedKVState(list(self.k), list(self.v), counters,
                                 self.block_table, self.page_size,
                                 self.pages_per_seq,
                                 ragged_lengths=jnp.asarray(length,
@@ -560,8 +592,9 @@ class QuantPagedKVState(PagedKVState):
 
     def _with_length(self, length):
         if jnp.ndim(length) >= 1:
+            counters = self.counters.at[0].set(-1)  # poisoned; see base class
             return QuantPagedKVState(
-                list(self.k), list(self.v), self.counters, self.block_table,
+                list(self.k), list(self.v), counters, self.block_table,
                 self.page_size, self.pages_per_seq, list(self.k_scale),
                 list(self.v_scale), out_dtype=self.out_dtype,
                 ragged_lengths=jnp.asarray(length, jnp.int32))

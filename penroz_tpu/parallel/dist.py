@@ -78,6 +78,10 @@ def reconfig_logging(log_dir: str | None = None) -> str | None:
         if getattr(h, "_penroz_rank_handler", False):
             root.removeHandler(h)
             h.close()
+    # Handlers present now (before ours goes in) mean an operator configured
+    # logging deliberately (basicConfig / dictConfig); their level is
+    # authoritative even if it happens to equal the stock WARNING default.
+    operator_configured = bool(root.handlers)
     handler = logging.handlers.RotatingFileHandler(
         path, maxBytes=10_000_000, backupCount=3)
     handler.setFormatter(logging.Formatter(
@@ -85,11 +89,12 @@ def reconfig_logging(log_dir: str | None = None) -> str | None:
         f"%(name)s: %(message)s"))
     handler._penroz_rank_handler = True
     root.addHandler(handler)
-    # An unconfigured root (NOTSET, or the stock WARNING default with no
-    # explicit PENROZ_LOG_CONFIG) is lowered so training records reach the
-    # rank files; an operator-configured level stays authoritative.
+    # An unconfigured root (NOTSET, or the stock handler-less WARNING
+    # default with no explicit PENROZ_LOG_CONFIG) is lowered so training
+    # records reach the rank files; an operator-configured level — any
+    # pre-existing handler implies one — stays authoritative.
     if root.level == logging.NOTSET or (
-            root.level == logging.WARNING
+            root.level == logging.WARNING and not operator_configured
             and "PENROZ_LOG_CONFIG" not in os.environ):
         root.setLevel(logging.INFO)
     log.info("Per-rank logging for process %d/%d -> %s", rank,

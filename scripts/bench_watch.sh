@@ -1,0 +1,27 @@
+#!/bin/bash
+# Persistent accelerator watcher: probe the backend in short-lived child
+# processes; on the first success, run the full bench with per-phase
+# partials written into the repo (BENCH_PARTIAL.json) and the final line
+# into BENCH_MIDROUND.out.  A pool window that opens for five minutes
+# mid-round is converted into committed evidence instead of being missed
+# (rounds 2 and 3 both ended rc=3 with zero driver-captured numbers).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p logs
+PROBE_S="${PENROZ_WATCH_PROBE_S:-120}"
+SLEEP_S="${PENROZ_WATCH_SLEEP_S:-60}"
+while true; do
+  if timeout "$PROBE_S" python -c \
+      "import jax; d=jax.devices(); print('BACKEND_OK', d[0].device_kind, len(d), flush=True)" \
+      >> logs/bench_watch.log 2>&1; then
+    echo "$(date -u +%FT%TZ) backend up -> running bench" >> logs/bench_watch.log
+    PENROZ_BENCH_PARTIAL=BENCH_PARTIAL.json PENROZ_BENCH_WAIT_S=300 \
+      python bench.py > BENCH_MIDROUND.out 2>> logs/bench_watch.log
+    rc=$?
+    echo "$(date -u +%FT%TZ) bench rc=$rc" >> logs/bench_watch.log
+    if [ "$rc" -eq 0 ]; then
+      exit 0
+    fi
+  fi
+  sleep "$SLEEP_S"
+done

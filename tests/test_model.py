@@ -516,12 +516,20 @@ def test_generate_paged_overflow_reprefills(workdir, toy_gpt_layers,
 def test_batched_generate_matches_single(workdir, toy_gpt_layers):
     """Ragged batched greedy generation == per-prompt single-sequence
     generation, for prompts of different lengths (the per-sequence cache
-    lengths / RoPE offsets / masks must reproduce the B=1 math exactly)."""
+    lengths / RoPE offsets / masks must reproduce the B=1 math exactly).
+
+    Also pins the path donation-clean: the prefill donates the KV pool, and
+    the scalar length leaf must alias through into the ragged output state
+    (KVState keeps the scalar slot next to ragged_lengths) — a "donated
+    buffers were not usable" UserWarning here is a donation regression."""
     model = NeuralNetworkModel("bg", Mapper(toy_gpt_layers, SGD))
     prompts = [[1, 2, 3, 4, 5], [7, 8], [9, 10, 11]]
-    batched = model.generate_tokens_batched(prompts, block_size=16,
-                                            max_new_tokens=6,
-                                            temperature=0.0)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.filterwarnings("error", message=".*[Dd]onated buffers.*")
+        batched = model.generate_tokens_batched(prompts, block_size=16,
+                                                max_new_tokens=6,
+                                                temperature=0.0)
     for p, out in zip(prompts, batched):
         single = model.generate_tokens([p], block_size=16, max_new_tokens=6,
                                        temperature=0.0)

@@ -90,6 +90,18 @@ def _child(n_devices: int) -> None:
     tokens = TIMED * STEPS * batch * BLOCK
     rec = {"devices": n_devices, "tokens_per_sec": tokens / elapsed}
 
+    # Mesh-aware /evaluate/ throughput: the forward-only cost program over
+    # the same data-sharded batch (evaluate_model routes through
+    # _eval_mesh + eval_cost_fn; pre-round-4 it used one device per
+    # process regardless of host capacity).
+    ex, ey = xs[0], ys[0]
+    float(arch.eval_cost_fn(params, buffers, ex, ey))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(TIMED):
+        float(arch.eval_cost_fn(params, buffers, ex, ey))
+    rec["eval_tokens_per_sec"] = (TIMED * batch * BLOCK
+                                  / (time.perf_counter() - t0))
+
     if os.environ.get("BENCH_SCALING_ZERO") == "1" and n_devices > 1:
         # ZeRO ladder memory: bytes of params + optimizer state resident on
         # device 0 under the replicated/TP layout vs FSDP+WUS

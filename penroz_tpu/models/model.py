@@ -244,6 +244,31 @@ class CompiledArch:
             return fn(params, buffers, tokens)
         return fn(params, buffers, tokens, targets)
 
+    def eval_cost_fn(self, params, buffers, tokens, targets, *,
+                     platform=None, sp_mesh=None, sp_mode="ring"):
+        """Cost-only jitted forward for ``/evaluate/``.
+
+        Returning just the scalar lets XLA dead-code-eliminate every
+        intermediate activation that :meth:`jit_forward` would materialize
+        as an output; with mesh-placed params and a data-sharded batch the
+        same program evaluates across every chip (the reference evaluates
+        DDP-sharded across all workers, neural_net_model.py:319-354 — "no
+        grad" here is simply not calling ``value_and_grad``).  ``sp_mesh``
+        enables the same ring/all-to-all sequence-parallel attention the
+        training epoch uses, for sequence-sharded eval batches.
+        """
+        key = ("evalcost", platform, sp_mesh, sp_mode)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def fwd(p, b, t, y):
+                _, cost, _, _ = self.forward(p, b, t, y, skip_softmax=True,
+                                             sp_mesh=sp_mesh,
+                                             sp_mode=sp_mode,
+                                             platform=platform)
+                return cost
+            fn = self._jit_cache[key] = jax.jit(fwd)
+        return fn(params, buffers, tokens, targets)
+
     # -- training -----------------------------------------------------------
 
     def train_epoch_fn(self, optimizer_config: dict, num_steps: int,
@@ -688,6 +713,31 @@ class NeuralNetworkModel:
                                    begin_idx=buffer_size * rank,
                                    buffer_size=buffer_size,
                                    idx_offset=buffer_size * world)
+        mesh = self._eval_mesh(batch_size, block_size)
+        sp_mesh = None
+        sp_mode = os.environ.get("PENROZ_SP_MODE", "ring")
+        if mesh is not None:
+            log.info("Evaluating over device mesh %s", dict(mesh.shape))
+            if mesh.shape[mesh_lib.SEQ_AXIS] > 1:
+                # Sequence-parallel eval: shard the block over the seq
+                # axis and run the ring/all-to-all attention, same as the
+                # training epoch — without this the seq-axis chips would
+                # do purely redundant replicated work.
+                if sp_mode not in ("ring", "alltoall"):
+                    raise ValueError(f"PENROZ_SP_MODE={sp_mode!r}; "
+                                     "expected 'ring' or 'alltoall'")
+                sp_mesh = mesh
+            # Mirror the training layout (TP over `model`, experts over
+            # `expert`, ZeRO-3 over `data` when PENROZ_FSDP=1) so an
+            # already-mesh-placed model is a no-op and a freshly loaded one
+            # gets the layout its size may require (a TP-trained model
+            # larger than one chip cannot evaluate single-device at all).
+            fsdp = os.environ.get("PENROZ_FSDP", "0") == "1"
+            self.params = sharding_lib.shard_params(self.params, mesh,
+                                                    fsdp=fsdp)
+            self.buffers = {
+                k: sharding_lib.place(v, mesh_lib.replicated(mesh))
+                for k, v in self.buffers.items()}
         avg_cost = 0.0
         for _ in range(epochs):
             if target_loader is not None:
@@ -695,12 +745,24 @@ class NeuralNetworkModel:
                 y, _ = target_loader.next_batch(target_offset=0)
             else:
                 x, y = loader.next_batch()
-            x = jnp.asarray(x.reshape(batch_size, block_size))
-            y = jnp.asarray(y.reshape(batch_size, block_size))
-            _, cost, _, _ = self.arch.jit_forward(
-                self.params, self.buffers, x, y, skip_softmax=True,
-                platform=self._platform)
+            x = x.reshape(batch_size, block_size)
+            y = y.reshape(batch_size, block_size)
+            if mesh is not None:
+                x = sharding_lib.global_batch(
+                    x, mesh, shard_sequence=sp_mesh is not None)
+                y = sharding_lib.global_batch(
+                    y, mesh, shard_sequence=sp_mesh is not None)
+            else:
+                x = jnp.asarray(x)
+                y = jnp.asarray(y)
+            cost = self.arch.eval_cost_fn(self.params, self.buffers, x, y,
+                                          platform=self._platform,
+                                          sp_mesh=sp_mesh, sp_mode=sp_mode)
             avg_cost += float(cost) / epochs
+        # Under a global multi-host mesh the compiled cost is already the
+        # global-batch mean (identical on every process), so this reduce is
+        # an identity; it remains load-bearing for the mesh-less multi-host
+        # path, where each process averaged only its own stride.
         return dist.all_reduce_mean(avg_cost)
 
     # -- training -----------------------------------------------------------
@@ -988,6 +1050,16 @@ class NeuralNetworkModel:
             return None
         if dist.process_count() > 1:
             return self._multihost_mesh(micro_batch, block_size)
+        return self._local_mesh(micro_batch, block_size, fold_pipe=False)
+
+    def _local_mesh(self, micro_batch: int, block_size: int, *,
+                    fold_pipe: bool):
+        """Single-host mesh from the ``PENROZ_MESH_*`` env family (None =
+        single device).  ``fold_pipe=True`` folds the pipe axis into
+        ``data`` (forward-only callers: no pipeline schedule to run, so
+        the pipe-stage chips serve as extra data-parallel capacity);
+        ``fold_pipe=False`` keeps it as a mesh axis.
+        """
         try:
             platform = self.device.platform if self.device is not None else None
             devices = (jax.local_devices(backend=platform) if platform
@@ -1006,7 +1078,9 @@ class NeuralNetworkModel:
             return None
         if model < 1 or seq < 1 or expert < 1 or pipe < 1:
             return None
-        if pipe > 1 and (model > 1 or seq > 1 or expert > 1):
+        if fold_pipe:
+            pipe = 1
+        elif pipe > 1 and (model > 1 or seq > 1 or expert > 1):
             # The GPipe schedule composes with data parallelism (its
             # microbatch spec shards rows over `data`); TP/SP/EP inside a
             # stage would need per-suffix specs on the stacked leaves —
@@ -1020,11 +1094,28 @@ class NeuralNetworkModel:
         data = n // (model * seq * expert * pipe)
         if micro_batch % data or (seq > 1 and block_size % seq):
             log.info("Mesh fallback to single device: micro-batch %d / "
-                     "sequence %d not divisible by data=%d / sequence=%d",
+                     "block %d not divisible by data=%d / sequence=%d",
                      micro_batch, block_size, data, seq)
             return None
         return mesh_lib.make_mesh(devices, model=model, sequence=seq,
                                   expert=expert, pipe=pipe)
+
+    def _eval_mesh(self, batch_size: int, block_size: int):
+        """Device mesh for forward-only evaluation (None = single device).
+
+        Same axes as :meth:`_training_mesh` except the ``pipe`` axis is
+        folded into ``data``.  Falls back to a single device (never
+        raises) on divisibility misses single-host; the multi-host path
+        keeps :meth:`_multihost_mesh`'s raise-don't-degrade contract.
+        """
+        if os.environ.get("PENROZ_TRAIN_MESH", "1") == "0":
+            # Unlike training, the mesh-less multi-host eval is still
+            # exact: each process averages its own stride and
+            # all_reduce_mean combines them — no gradient sync to lose.
+            return None
+        if dist.process_count() > 1:
+            return self._multihost_mesh(batch_size, block_size)
+        return self._local_mesh(batch_size, block_size, fold_pipe=True)
 
     def _multihost_mesh(self, micro_batch: int, block_size: int = 0):
         """Global mesh spanning every host's devices.

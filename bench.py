@@ -22,16 +22,29 @@ import numpy as np
 
 PARTIAL_PATH = os.environ.get("PENROZ_BENCH_PARTIAL", "BENCH_PARTIAL.json")
 _partial: dict = {}
-# Seed from a previous attempt's file so a retrying watcher loop can only
-# ever ADD metrics: run 1 capturing the headline MFU then dying mid-decode
-# must not have run 2's first emit() clobber the file down to {device}.
-if os.path.exists(PARTIAL_PATH):
+
+
+def seed_partial(smoke: bool):
+    """Seed from a previous attempt's file so a retrying watcher loop can
+    only ever ADD metrics: run 1 capturing the headline MFU then dying
+    mid-decode must not have run 2's first emit() clobber the file down to
+    {device}.  Smoke runs neither seed nor get seeded from — their numbers
+    are meaningless and must not brand (or be branded by) real-chip
+    metrics.  ``resumed_keys`` lists the metrics still carried from the
+    prior attempt; emit() retires entries as fresh values land, so a fully
+    successful run reports no residue."""
+    if smoke or not os.path.exists(PARTIAL_PATH):
+        return
     try:
-        with open(PARTIAL_PATH) as _fh:
-            _partial.update(json.load(_fh))
-        _partial["resumed_partial"] = True
+        with open(PARTIAL_PATH) as fh:
+            prior = json.load(fh)
     except (OSError, ValueError):
-        pass
+        return
+    if not isinstance(prior, dict) or prior.get("smoke"):
+        return
+    prior.pop("resumed_keys", None)
+    _partial.update(prior)
+    _partial["resumed_keys"] = sorted(prior)
 
 
 def emit(**metrics):
@@ -41,7 +54,14 @@ def emit(**metrics):
     every number (BENCH_r03.json rc=3).  With per-phase flushes, a pool
     that lives five minutes still yields the headline metrics."""
     import sys
-    _partial.update({k: v for k, v in metrics.items() if v is not None})
+    fresh = {k: v for k, v in metrics.items() if v is not None}
+    _partial.update(fresh)
+    if "resumed_keys" in _partial:
+        left = [k for k in _partial["resumed_keys"] if k not in fresh]
+        if left:
+            _partial["resumed_keys"] = left
+        else:
+            del _partial["resumed_keys"]
     tmp = PARTIAL_PATH + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(_partial, fh, indent=1, sort_keys=True)
@@ -138,6 +158,69 @@ def bench_ttft(arch, params, block=1024, prompt_len=128, trials=10):
         int(np.asarray(tok)[0, 0])  # host transfer forces execution
         times.append((time.perf_counter() - t0) * 1000)
     return statistics.median(times[2:])  # drop compile/warmup trials
+
+
+def bench_ttft_under_train(arch, params, mapper, block=1024, trials=8,
+                           train_batch=8, train_steps=4):
+    """p50 TTFT of a decode issued while a training epoch loop occupies the
+    same chip — the serving-under-training case: the API process trains and
+    serves on one device (serve/app.py runs both through its executor), so
+    a /generate/ arriving mid-/train/ waits for the in-flight epoch
+    program.  Worst-case added latency is one epoch's device occupancy;
+    this measures the realized p50, not the bound.  The trainer thread uses
+    its own params/optimizer state, mirroring the server (generate
+    deserializes the checkpoint, it never shares the training params)."""
+    import threading
+
+    t_params, t_bufs = mapper.init_params(arch.mods, seed=1)
+    optimizer = mapper.to_optimizer()
+    opt_state = optimizer.init(t_params)
+    epoch_fn = arch.train_epoch_fn(mapper.optimizer, train_steps, False,
+                                   jnp.bfloat16, with_ratios=False)
+    data_rng = np.random.default_rng(1)
+    x = jnp.asarray(data_rng.integers(
+        0, 50304, (train_steps, train_batch, block), dtype=np.int32))
+    y = jnp.asarray(data_rng.integers(
+        0, 50304, (train_steps, train_batch, block), dtype=np.int32))
+    rng = jax.random.key(1)
+    # compile the epoch program before the contention window opens
+    t_params, opt_state, t_bufs, cost, _ = epoch_fn(t_params, opt_state,
+                                                    t_bufs, x, y, rng)
+    float(cost)
+
+    stop = threading.Event()
+    died = []
+
+    def trainer():
+        nonlocal t_params, opt_state, t_bufs
+        try:
+            while not stop.is_set():
+                t_params, opt_state, t_bufs, c, _ = epoch_fn(
+                    t_params, opt_state, t_bufs, x, y, rng)
+                # One epoch in flight at a time, like the real /train/
+                # loop (per-epoch progress bookkeeping syncs on the cost):
+                # without this the thread enqueues an unbounded backlog
+                # and the decode would starve behind it instead of
+                # waiting <= 1 epoch.
+                float(c)
+        except Exception as exc:  # noqa: BLE001 — surfaced via `died`
+            died.append(exc)
+
+    th = threading.Thread(target=trainer, name="bench-train-bg")
+    th.start()
+    try:
+        ttft = bench_ttft(arch, params, block=block, trials=trials)
+    finally:
+        stop.set()
+        th.join()
+    if died:
+        # The contention never (fully) happened — reporting this TTFT as
+        # "under train" would be an invisibly wrong idle number.
+        import sys
+        print(f"bench: background trainer died ({died[0]!r}); dropping "
+              f"ttft_under_train", file=sys.stderr, flush=True)
+        return None
+    return ttft
 
 
 def bench_decode_throughput(arch, params, mapper, block=1024, tokens=96):
@@ -398,6 +481,7 @@ def main():
     # validated on CPU without a chip.  Numbers produced under smoke are
     # meaningless and the artifact says so.
     smoke = os.environ.get("PENROZ_BENCH_SMOKE") == "1"
+    seed_partial(smoke)
     _wait_for_backend()
     device = _devices_or_die()[0]
     depth, d_model, block = (2, 64, 256) if smoke else (12, 768, 1024)
@@ -434,6 +518,11 @@ def main():
     emit(ttft_ms_p50=round(ttft_ms, 2))
     dispatch_floor = bench_dispatch_floor()
     emit(dispatch_floor_ms=round(dispatch_floor, 2))
+    ttft_busy = bench_ttft_under_train(
+        arch, params, mapper, block=block,
+        **(dict(trials=3, train_batch=2, train_steps=2) if smoke else {}))
+    if ttft_busy is not None:
+        emit(ttft_under_train_ms_p50=round(ttft_busy, 2))
 
     decode_tps = bench_decode_throughput(arch, params, mapper, block=block,
                                          tokens=8 if smoke else 96)

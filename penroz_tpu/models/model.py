@@ -1080,14 +1080,17 @@ class NeuralNetworkModel:
             return None
         if fold_pipe:
             pipe = 1
-        elif pipe > 1 and (model > 1 or seq > 1 or expert > 1):
+        elif pipe > 1 and (seq > 1 or expert > 1):
             # The GPipe schedule composes with data parallelism (its
-            # microbatch spec shards rows over `data`); TP/SP/EP inside a
-            # stage would need per-suffix specs on the stacked leaves —
-            # refuse loudly rather than silently mis-shard.
+            # microbatch spec shards rows over `data`) and with tensor
+            # parallelism (stacked leaves carry P(pipe, model, …) specs and
+            # the stage body leaves the model axis GSPMD-automatic).
+            # SP/EP inside a stage would additionally need the ring/
+            # dispatch collectives threaded through the schedule — refuse
+            # loudly rather than silently mis-shard.
             raise RuntimeError(
-                "PENROZ_MESH_PIPE>1 currently composes only with data "
-                "parallelism; unset PENROZ_MESH_MODEL/SEQUENCE/EXPERT")
+                "PENROZ_MESH_PIPE>1 composes with data and tensor "
+                "parallelism only; unset PENROZ_MESH_SEQUENCE/EXPERT")
         n = len(devices)
         if n <= 1 or n % (model * seq * expert * pipe):
             return None
@@ -1242,11 +1245,29 @@ class NeuralNetworkModel:
             else n,
             self.opt_state,
             is_leaf=lambda n: isinstance(n, dict) and set(n) == pkeys)
-        stacked_shd = jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec(mesh_lib.PIPE_AXIS))
         repl = mesh_lib.replicated(mesh)
-        param_shd = {k: (stacked_shd if k.startswith("__pipe__.") else repl)
-                     for k in mixed}
+
+        def pipe_sharding(suffix: str):
+            # Stacked leaves: leading L dim over `pipe`, trailing dims in
+            # the Megatron TP layout of the per-layer leaf (a no-op spec
+            # when the model axis is 1) — this is what lets pipe×model
+            # meshes train; gpipe_apply leaves the model axis
+            # GSPMD-automatic inside the stage body.
+            base = sharding_lib.param_spec(
+                f"layers.{idx[0]}.{suffix}",
+                tuple(stacked[suffix].shape[1:]), mesh)
+            return jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(mesh_lib.PIPE_AXIS, *base))
+
+        param_shd = {}
+        for k, v in mixed.items():
+            if k.startswith("__pipe__."):
+                param_shd[k] = pipe_sharding(k[len("__pipe__."):])
+            else:
+                # Non-block params (embeddings, final LN, lm head) take
+                # their flat TP layout; replicated when model == 1.
+                param_shd[k] = jax.sharding.NamedSharding(
+                    mesh, sharding_lib.param_spec(k, tuple(v.shape), mesh))
         opt_shd = jax.tree.map(
             lambda n: ({k: param_shd[k] for k in n}
                        if isinstance(n, dict) and set(n) == set(mixed)

@@ -162,8 +162,16 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
         mine = jnp.where(stage == pipe - 1, buf, jnp.zeros_like(buf))
         return jax.lax.psum(mine, PIPE_AXIS)
 
+    # Partial-manual shard_map: only the pipe and data axes are manual
+    # (the schedule's ppermute/psum/axis_index live on them); the model/
+    # sequence/expert axes stay GSPMD-automatic, so stacked leaves carrying
+    # a tensor-parallel sharding on their trailing dims (P(pipe, model, …)
+    # from _enter_pipe_layout) get their TP collectives inserted by XLA
+    # inside each stage — that is what lets pipe×model meshes train.
     out = jax.shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
-                    out_specs=out_spec)(stacked_params, mbs)
+                        out_specs=out_spec,
+                        axis_names={PIPE_AXIS, DATA_AXIS})(stacked_params,
+                                                           mbs)
     return out.reshape(batch, *x.shape[1:])
 
 

@@ -154,6 +154,40 @@ def test_train_route_202_and_progress(client, workdir):
     assert len(stats["layers"]) >= 2
 
 
+def test_generate_while_training(client, workdir):
+    """Serving-under-training policy: a /generate/ arriving mid-/train/ is
+    served from the latest checkpoint (it never shares the training
+    thread's in-memory params) while the epoch loop owns the device; it
+    must return 200 with valid tokens, and training must still complete.
+    The latency cost of the device contention is measured on-chip by
+    bench.py (ttft_under_train_ms_p50); see README "Serving while
+    training"."""
+    import time
+    _create_model(client)
+    _make_shards(workdir)
+    status, _ = client.json("PUT", "/train/", json={
+        "model_id": "m1", "device": "cpu", "dataset_id": "ds", "shard": 0,
+        "epochs": 400, "batch_size": 2, "block_size": 8, "step_size": 1})
+    assert status == 202
+    served_during = 0
+    code = None
+    for _ in range(600):
+        _, body = client.json("GET", "/progress/?model_id=m1")
+        code = body["status"]["code"]
+        if code == "Training":
+            gs, gb = client.json("POST", "/generate/", json={
+                "model_id": "m1", "input": [[1, 2]], "block_size": 8,
+                "max_new_tokens": 2, "temperature": 0.0})
+            assert gs == 200, gb
+            assert len(gb["tokens"]) == 4
+            served_during += 1
+        if code in ("Trained", "Error"):
+            break
+        time.sleep(0.05)
+    assert code == "Trained", code
+    assert served_during > 0, "training finished before any mid-run generate"
+
+
 def test_train_unknown_model_404(client):
     status, body = client.json("PUT", "/train/", json={
         "model_id": "nope", "device": "cpu", "dataset_id": "ds", "shard": 0,

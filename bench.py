@@ -272,12 +272,18 @@ def bench_batched_decode(arch, params, block=1024, tokens=64, batch=8):
 
 
 def bench_moe_dispatch(d=512, experts=8, top_k=2, depth=4, batch=8,
-                       block=512, steps=2, timed=4):
+                       block=512, steps=2, timed=12):
     """Dense vs capacity-packed MoE dispatch on the same stack: tokens/sec
     each way.  Capacity dispatch computes only ``C = top_k·T/E·1.25``
     tokens per expert instead of all T per expert (ops/modules.py MoE) —
     this measures the realized speedup, not the claimed FLOP ratio.
-    Returns (dense_tps, capacity_tps) or None on failure (showcase)."""
+    Returns (dense_tps, capacity_tps) or None on failure (showcase).
+
+    ``timed=12``: each call is only ~80ms of device work at these shapes,
+    and the relay's dispatch floor has been observed near 107ms — a short
+    timed window buries the dense/capacity delta under transport RTT
+    (r04's first capture read 0.996x where an amortized probe read 1.73x).
+    """
     from __graft_entry__ import OPTIMIZER
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import CompiledArch

@@ -277,9 +277,11 @@ def _comm_child() -> None:
                                                      OPTIMIZER))
         mesh = model._training_mesh(batch, BLOCK)
         pipe_cfg, out_shardings = model._enter_pipe_layout(mesh, batch)
+        # pipe_remat pinned so recorded step times don't silently shift if
+        # the training default changes: 'block' is what /train/ ships.
         epoch_fn = model.arch.train_epoch_fn(
             OPTIMIZER, STEPS, out_shardings=out_shardings,
-            pipe_cfg=pipe_cfg)
+            pipe_cfg=pipe_cfg, pipe_remat="block")
         rng = np.random.default_rng(0)
         import jax.numpy as jnp  # noqa: F401
         x = rng.integers(0, vocab, (STEPS, batch, BLOCK), dtype=np.int32)

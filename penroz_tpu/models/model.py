@@ -275,7 +275,7 @@ class CompiledArch:
                        remat: bool = False, compute_dtype=None, sp_mesh=None,
                        platform=None, with_ratios: bool = True,
                        out_shardings=None, sp_mode: str = "ring",
-                       pipe_cfg=None):
+                       pipe_cfg=None, pipe_remat: str = "block"):
         """One jitted epoch: ``num_steps`` grad-accumulation micro-steps via
         ``lax.scan`` then a single optax update (reference hot loop:
         neural_net_model.py:614-677; sync deferred to the final micro-step is
@@ -301,6 +301,13 @@ class CompiledArch:
         (recompile every call) and leaving cross-host-sharded params behind
         after training.
         """
+        # PENROZ_REMAT=1 and pipe_remat='block' compose rather than exclude:
+        # the whole-loss checkpoint discards pre/post-block residuals but
+        # its backward REPLAYS the forward, and without per-block remat that
+        # replay materializes every (layer, tick) block internal at once —
+        # the exact residency the OOM lever exists to avoid.  Stacked, the
+        # blocks run once more (fwd, outer replay, per-block replay) in
+        # exchange for the bound holding everywhere.
         shard_key = None
         if out_shardings is not None:
             shard_key = (tuple(sorted(out_shardings[0].items())),
@@ -309,7 +316,8 @@ class CompiledArch:
                int(num_steps), bool(remat), str(compute_dtype), sp_mesh,
                platform, bool(with_ratios), shard_key, sp_mode,
                (pipe_cfg[0], pipe_cfg[1], pipe_cfg[2], pipe_cfg[3])
-               if pipe_cfg else None)
+               if pipe_cfg else None,
+               pipe_remat if pipe_cfg is not None else None)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -325,7 +333,8 @@ class CompiledArch:
                 return cost, buf_upd
         else:
             loss_fn = self._pipelined_loss_fn(pipe_cfg, compute_dtype,
-                                              platform)
+                                              platform,
+                                              pipe_remat=pipe_remat)
 
         if remat:
             loss_fn = jax.checkpoint(loss_fn)
@@ -409,7 +418,8 @@ class CompiledArch:
         self._jit_cache[key] = fn
         return fn
 
-    def _pipelined_loss_fn(self, pipe_cfg, compute_dtype, platform):
+    def _pipelined_loss_fn(self, pipe_cfg, compute_dtype, platform,
+                           pipe_remat: str = "block"):
         """Loss for the GPipe training layout: pre-block modules run on the
         full batch, the stacked blocks stream microbatches through the
         pipe-axis stages (``parallel/pipeline.gpipe_apply``), post-block
@@ -437,7 +447,8 @@ class CompiledArch:
             stacked = {k[len("__pipe__."):]: v for k, v in params.items()
                        if k.startswith("__pipe__.")}
             h = pipeline.gpipe_apply(block_fn, stacked, h, pmesh, micro,
-                                     rng=jax.random.fold_in(rng, 0x9e3779))
+                                     rng=jax.random.fold_in(rng, 0x9e3779),
+                                     remat=pipe_remat)
             logits = None
             for mod in post:
                 if isinstance(mod, M.Softmax):
@@ -858,6 +869,14 @@ class NeuralNetworkModel:
             # (jax.checkpoint) — trades ~1/3 more FLOPs for activation memory,
             # the lever for configs that would otherwise exceed HBM.
             remat = os.environ.get("PENROZ_REMAT", "0") == "1"
+            # PENROZ_PIPE_REMAT selects the pipelined path's activation
+            # schedule: 'block' (default — backward recomputes each block
+            # tick-by-tick, bounding stage memory to live microbatch
+            # activations the way 1F1B does) or 'none' (save everything).
+            pipe_remat = os.environ.get("PENROZ_PIPE_REMAT", "block")
+            if pipe_remat not in ("none", "block"):
+                raise ValueError(f"PENROZ_PIPE_REMAT={pipe_remat!r}; "
+                                 "expected 'none' or 'block'")
             # Reference parity: training autocasts to bf16 on CUDA
             # (neural_net_model.py:567-578) and stays full-precision on CPU.
             # The TPU-native equivalent is bf16 compute on TPU — params and
@@ -896,7 +915,7 @@ class NeuralNetworkModel:
                 compute_dtype=compute_dtype, sp_mesh=sp_mesh,
                 platform=self._platform,
                 out_shardings=epoch_out_shardings, sp_mode=sp_mode,
-                pipe_cfg=pipe_cfg)
+                pipe_cfg=pipe_cfg, pipe_remat=pipe_remat)
             # Non-sampled epochs skip the two full parameter passes the
             # update-ratio stds cost.  The choice is a pure function of the
             # epoch index so every host runs the same compiled program
@@ -911,7 +930,8 @@ class NeuralNetworkModel:
                                          with_ratios=False,
                                          out_shardings=epoch_out_shardings,
                                          sp_mode=sp_mode,
-                                         pipe_cfg=pipe_cfg)
+                                         pipe_cfg=pipe_cfg,
+                                         pipe_remat=pipe_remat)
                 if sample_every > 1 else epoch_fn)
             rng = jax.random.key(0)
             last_save = time.monotonic()

@@ -85,7 +85,7 @@ def gpipe_spec(mesh):
 
 
 def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
-                num_microbatches: int, rng=None):
+                num_microbatches: int, rng=None, remat: str = "none"):
     """Apply ``L`` stacked blocks to ``x`` with a ``P``-stage GPipe schedule.
 
     ``block_fn(block_params: dict, h) -> h`` applies ONE block given its
@@ -99,7 +99,25 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     global layer index and the schedule tick — every (layer, microbatch)
     application gets a distinct dropout stream, like the sequential path's
     per-call ``Ctx.next_rng`` folding.
+
+    ``remat="block"`` wraps each block application in ``jax.checkpoint``:
+    the backward pass saves only the per-(layer, tick) block *inputs* and
+    recomputes block internals tick-by-tick in reverse schedule order —
+    the reverse of a GPipe schedule is itself a pipelined schedule, so the
+    recomputation stays distributed over the stages.  This bounds the
+    activation residency the way a hand-scheduled 1F1B does (O(live
+    microbatch activations) instead of O(all block internals)) while
+    keeping exact numerics; the schedule/memory trade is the compiler's,
+    which is the TPU-idiomatic split.  ``remat="none"`` keeps everything.
     """
+    if remat not in ("none", "block"):
+        raise ValueError(f"remat={remat!r}: expected 'none' or 'block'")
+    if remat == "block":
+        # prevent_cse=False: the checkpointed block only ever runs inside
+        # lax.scan, where the CSE hazard checkpoint guards against cannot
+        # occur — skipping the optimization_barrier keeps XLA free to fuse
+        # across the block boundary in the forward ticks.
+        block_fn = jax.checkpoint(block_fn, prevent_cse=False)
     pipe = mesh.shape[PIPE_AXIS]
     num_layers = next(iter(stacked_params.values())).shape[0]
     if num_layers % pipe:

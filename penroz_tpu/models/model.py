@@ -49,6 +49,25 @@ log = logging.getLogger(__name__)
 
 DECODE_CHUNK_ENV = "PENROZ_DECODE_CHUNK"
 
+# Per-model count of /train/ requests this process has started — feeds the
+# train-end barrier id, so it must advance in lockstep on every host and
+# survive the per-request model deserialization (see train_model).
+_TRAIN_SEQ: dict = {}
+
+
+def _check_pipe_composition(pipe: int, seq: int, expert: int) -> None:
+    """The GPipe schedule composes with data parallelism (its microbatch
+    spec shards rows over ``data``) and with tensor parallelism (stacked
+    leaves carry P(pipe, model, …) specs and the stage body leaves the
+    model axis GSPMD-automatic).  SP/EP inside a stage would additionally
+    need the ring/dispatch collectives threaded through the schedule —
+    refuse loudly rather than silently mis-shard.  Shared by the single-
+    and multi-host mesh builders so the contract cannot diverge."""
+    if pipe > 1 and (seq > 1 or expert > 1):
+        raise RuntimeError(
+            "PENROZ_MESH_PIPE>1 composes with data and tensor "
+            "parallelism only; unset PENROZ_MESH_SEQUENCE/EXPERT")
+
 
 def _chunk_budget() -> int:
     """Decode steps fused per dispatch (PENROZ_DECODE_CHUNK, default 128)."""
@@ -800,23 +819,47 @@ class NeuralNetworkModel:
         master = dist.master_proc()
         saves_shards = False
         epoch = 0
+        # Bumped at request START so it advances in lockstep on every host
+        # regardless of how this run ends (multi-host contract: every host
+        # receives the same requests) — the train-end barrier id derives
+        # from it and must never desynchronize.  Module-level (not an
+        # instance attribute): /train/ deserializes a fresh model object
+        # per request, but the counter must survive across them for the
+        # process lifetime.  A single host restarting would reset only its
+        # own counters, but that state is unreachable: jax.distributed
+        # requires every process alive, so one host restarting forces a
+        # fleet-wide restart that resets all counters together.
+        _TRAIN_SEQ[self.model_id] = train_seq = \
+            _TRAIN_SEQ.get(self.model_id, 0) + 1
         try:
             world = dist.process_count()
             rank = dist.process_index()
             buffer_size = batch_size * block_size
-            num_steps = max(1, buffer_size
-                            // (step_size * block_size * world))
-            loader = Loader(dataset_id, begin_shard=shard,
-                            begin_idx=buffer_size * rank,
-                            buffer_size=buffer_size,
-                            idx_offset=buffer_size * world)
+            # Reset run state before anything that can raise (mesh config,
+            # missing dataset): an Error from THIS request must not present
+            # the previous run's progress as its own.
             self.progress = []
             self.stats = None
+            mesh = self._training_mesh(batch_size, block_size)
+            # When pipeline stages span processes, what's distributed
+            # across hosts is the MODEL, not the data: every process feeds
+            # the same batch (rank striding off, DP width 1 in the
+            # reference buffer math) and the within-stage data axis shards
+            # those rows locally.
+            pipe_over_hosts = (world > 1 and mesh is not None
+                               and mesh.shape[mesh_lib.PIPE_AXIS] > 1)
+            dp_world = 1 if pipe_over_hosts else world
+            dp_rank = 0 if pipe_over_hosts else rank
+            num_steps = max(1, buffer_size
+                            // (step_size * block_size * dp_world))
+            loader = Loader(dataset_id, begin_shard=shard,
+                            begin_idx=buffer_size * dp_rank,
+                            buffer_size=buffer_size,
+                            idx_offset=buffer_size * dp_world)
             self.status = {"code": "Training",
                            "message": f"Training on {dataset_id}"}
             if master:
                 self.serialize()
-            mesh = self._training_mesh(batch_size, block_size)
             sp_mesh = None
             epoch_out_shardings = None
             pipe_cfg = None
@@ -966,10 +1009,12 @@ class NeuralNetworkModel:
                 if mesh is not None:
                     xs = sharding_lib.global_batch(
                         xs, mesh, leading_steps=True,
-                        shard_sequence=sp_mesh is not None)
+                        shard_sequence=sp_mesh is not None,
+                        process_replicated=pipe_over_hosts)
                     ys = sharding_lib.global_batch(
                         ys, mesh, leading_steps=True,
-                        shard_sequence=sp_mesh is not None)
+                        shard_sequence=sp_mesh is not None,
+                        process_replicated=pipe_over_hosts)
                 sampled = epoch % sample_every == 0
                 fn = epoch_fn if sampled else epoch_fn_fast
                 with profiling.span("penroz/train_epoch"):
@@ -1009,9 +1054,26 @@ class NeuralNetworkModel:
                 self._record_overall_progress(last_batch)
             if master or saves_shards:
                 self.serialize(tag=epochs)
+            # Fence the run's end across processes: the master's post-train
+            # bookkeeping (stats capture compiles a fresh program) can take
+            # minutes, and a peer racing ahead into the next collective
+            # (e.g. /evaluate/) would hit the ~30s lazy comm-group init
+            # timeout waiting for this host.  RPC barrier, so it tolerates
+            # the wait without any device group existing yet.  The id
+            # comes from the train-start counter (in lockstep on every
+            # host even if a peer errored mid-run); a failure here is a
+            # pacing miss, not a training failure — the run is already
+            # Trained and checkpointed, so never regress it to Error.
+            try:
+                dist.barrier(f"train_end_{self.model_id}_{train_seq}")
+            except Exception:  # noqa: BLE001
+                log.warning("train-end barrier failed; a peer may have "
+                            "errored mid-run", exc_info=True)
         except Exception as e:  # noqa: BLE001
             try:
-                self._exit_pipe_layout()
+                # Hosts reach this handler independently — never run the
+                # (collective) cross-host unstack one-sided.
+                self._exit_pipe_layout(local_only=dist.is_distributed())
             except Exception:  # noqa: BLE001
                 log.exception("Failed to restore flat param layout")
             self.status = {"code": "Error", "message": str(e)}
@@ -1026,6 +1088,17 @@ class NeuralNetworkModel:
                     self.serialize(sync_flush=True)
                 except Exception:  # noqa: BLE001
                     log.exception("Failed to persist error status")
+            # Best-effort join of the train-end fence so healthy peers are
+            # released promptly instead of eating the full barrier timeout
+            # waiting for this (failed) host.  Short timeout: if the peers
+            # are themselves far from the barrier, give up and let the
+            # original error surface.
+            try:
+                dist.barrier(f"train_end_{self.model_id}_{train_seq}",
+                             timeout_s=60.0)
+            except Exception:  # noqa: BLE001
+                log.warning("train-end barrier join from error path "
+                            "failed", exc_info=True)
             raise
 
     def _record_overall_progress(self, last_batch):
@@ -1100,17 +1173,8 @@ class NeuralNetworkModel:
             return None
         if fold_pipe:
             pipe = 1
-        elif pipe > 1 and (seq > 1 or expert > 1):
-            # The GPipe schedule composes with data parallelism (its
-            # microbatch spec shards rows over `data`) and with tensor
-            # parallelism (stacked leaves carry P(pipe, model, …) specs and
-            # the stage body leaves the model axis GSPMD-automatic).
-            # SP/EP inside a stage would additionally need the ring/
-            # dispatch collectives threaded through the schedule — refuse
-            # loudly rather than silently mis-shard.
-            raise RuntimeError(
-                "PENROZ_MESH_PIPE>1 composes with data and tensor "
-                "parallelism only; unset PENROZ_MESH_SEQUENCE/EXPERT")
+        else:
+            _check_pipe_composition(pipe, seq, expert)
         n = len(devices)
         if n <= 1 or n % (model * seq * expert * pipe):
             return None
@@ -1137,10 +1201,12 @@ class NeuralNetworkModel:
             # all_reduce_mean combines them — no gradient sync to lose.
             return None
         if dist.process_count() > 1:
-            return self._multihost_mesh(batch_size, block_size)
+            return self._multihost_mesh(batch_size, block_size,
+                                        fold_pipe=True)
         return self._local_mesh(batch_size, block_size, fold_pipe=True)
 
-    def _multihost_mesh(self, micro_batch: int, block_size: int = 0):
+    def _multihost_mesh(self, micro_batch: int, block_size: int = 0,
+                        fold_pipe: bool = False):
         """Global mesh spanning every host's devices.
 
         The data axis is ordered by process (jax.devices() groups by
@@ -1149,6 +1215,14 @@ class NeuralNetworkModel:
         PENROZ_MESH_EXPERT carve TP/SP/EP axes out of the global device set;
         the resulting cross-host-sharded params/optimizer are persisted via
         per-host shard files (see :meth:`serialize`).
+
+        ``PENROZ_MESH_PIPE>1`` builds the pipe axis *outermost* so each
+        GPipe stage occupies a contiguous host group and the stage handoff
+        rides DCN (``fold_pipe=True`` — forward-only callers — folds it
+        into data capacity instead).  Stages spanning hosts means every
+        process feeds the SAME batch (the model, not the data, is what's
+        distributed across hosts); train() switches the loader off rank
+        striding accordingly.
         """
         world = dist.process_count()
         # Every failure here RAISES: falling back to mesh=None under
@@ -1167,13 +1241,42 @@ class NeuralNetworkModel:
             expert = int(os.environ.get("PENROZ_MESH_EXPERT", "1"))
         except ValueError as e:
             raise ValueError(f"Invalid mesh-axis env knob: {e}")
-        denom = model * seq * expert
+        try:
+            pipe = int(os.environ.get("PENROZ_MESH_PIPE", "1") or "1")
+        except ValueError as e:
+            raise ValueError(f"Invalid mesh-axis env knob: {e}")
+        if pipe < 1:
+            raise ValueError(f"PENROZ_MESH_PIPE={pipe} must be >= 1")
+        if fold_pipe:
+            pipe = 1
+        if pipe > 1:
+            _check_pipe_composition(pipe, seq, expert)
+            if pipe % world and world % pipe:
+                # Stages are contiguous global device ranges (pipe
+                # outermost); alignment with process boundaries keeps each
+                # ppermute hop a single DCN (or pure-ICI) transfer instead
+                # of a shuffle that splits one stage across host fractions.
+                raise RuntimeError(
+                    f"PENROZ_MESH_PIPE={pipe} must divide or be a multiple "
+                    f"of the process count ({world}) so pipeline stages "
+                    f"align with host boundaries")
+        denom = model * seq * expert * pipe
         if model < 1 or seq < 1 or expert < 1 or n % denom:
             raise ValueError(
                 f"multi-host training: {n} global devices not divisible by "
-                f"model={model} × sequence={seq} × expert={expert}")
+                f"model={model} × sequence={seq} × expert={expert} × "
+                f"pipe={pipe}")
         data = n // denom
-        if (micro_batch * world) % data:
+        if pipe > 1:
+            # Every process feeds the same global batch (no rank striding
+            # — see train()); the data axis shards those rows within each
+            # stage's host group.
+            if micro_batch % data:
+                raise ValueError(
+                    f"multi-host training: batch_size {micro_batch} must "
+                    f"be divisible by the data axis ({data}) under "
+                    f"PENROZ_MESH_PIPE={pipe}")
+        elif (micro_batch * world) % data:
             raise ValueError(
                 f"multi-host training: global micro-batch "
                 f"{micro_batch * world} (batch_size × processes) must be "
@@ -1182,17 +1285,9 @@ class NeuralNetworkModel:
             raise ValueError(
                 f"multi-host training: block_size {block_size} must be "
                 f"divisible by the sequence axis ({seq})")
-        try:
-            pipe_req = int(os.environ.get("PENROZ_MESH_PIPE", "1") or "1")
-        except ValueError:
-            pipe_req = 1
-        if pipe_req > 1:
-            raise RuntimeError(
-                "PENROZ_MESH_PIPE>1 is single-host only for now (the GPipe "
-                "stages ride ICI; cross-host stage handoffs and sharded "
-                "stacked checkpoints are not supported yet)")
         return mesh_lib.make_mesh(devices, model=model, sequence=seq,
-                                  expert=expert)
+                                  expert=expert, pipe=pipe,
+                                  pipe_outermost=pipe > 1)
 
     # -- pipeline-parallel training layout ----------------------------------
 
@@ -1294,7 +1389,7 @@ class NeuralNetworkModel:
                        else repl),
             opt_mixed,
             is_leaf=lambda n: isinstance(n, dict) and set(n) == set(mixed))
-        self.params = {k: jax.device_put(v, param_shd[k])
+        self.params = {k: sharding_lib.place(v, param_shd[k])
                        for k, v in mixed.items()}
         self.opt_state = sharding_lib.place_tree(opt_mixed, opt_shd)
         self._pipe_layout = (start, count)
@@ -1331,9 +1426,22 @@ class NeuralNetworkModel:
             is_leaf=lambda n: isinstance(n, dict) and set(n) == mixed_keys)
         return self._canonical_params(), opt
 
-    def _exit_pipe_layout(self):
-        """Restore the canonical flat layout after a pipelined train run."""
+    def _exit_pipe_layout(self, local_only: bool = False):
+        """Restore the canonical flat layout after a pipelined train run.
+
+        ``local_only=True`` (the error path, where hosts arrive
+        independently): skip the conversion when stacked leaves are
+        cross-host sharded — unstacking them is a collective, and running
+        it one-sided would hang until the comm timeout.  The model object
+        keeps its stacked layout; the next operation reloads from the last
+        coordinated checkpoint.
+        """
         if self._pipe_layout is None:
+            return
+        if local_only and not all(self._is_host_readable(v)
+                                  for v in self.params.values()):
+            log.warning("Keeping pipeline-stacked layout: cross-host "
+                        "shards cannot be restored one-sidedly")
             return
         self.params, self.opt_state = self._canonical_state()
         self._pipe_layout = None
@@ -1360,6 +1468,14 @@ class NeuralNetworkModel:
         produces stats on master (neural_net_model.py:705-709), so a
         master-local sample preserves the feature instead of skipping it.
         """
+        # Raw-layout readability check BEFORE the canonical conversion:
+        # with a pipeline-stacked layout active on a multi-host mesh, the
+        # unstack is itself a collective and stats run master-only — a
+        # one-sided dispatch would hang against peers that never join.
+        if any(not self._is_host_readable(v)
+               for v in self.params.values()):
+            log.info("Skipping stats capture: params sharded across hosts")
+            return self.stats
         params, buffers = self._canonical_params(), self.buffers
         if any(not getattr(v, "is_fully_addressable", True)
                for v in params.values()):
@@ -1726,7 +1842,19 @@ class NeuralNetworkModel:
         train start, the error path, a serve-side save) is not coordinated
         across hosts, so it must not rewrite shard files — one host's write
         would permanently tear the last consistent checkpoint.  Such calls
-        degrade to a master-only metadata update of the existing blob."""
+        degrade to a master-only metadata update of the existing blob.
+        The raw-layout check runs BEFORE the canonical conversion: with a
+        pipeline-stacked layout still active, unstacking cross-host leaves
+        is itself a collective, and an uncoordinated call must not launch
+        one one-sided."""
+        raw_sharded = (
+            not all(self._is_host_readable(v) for v in self.params.values())
+            or not all(self._is_host_readable(leaf) for leaf
+                       in jax.tree.leaves(self.opt_state)))
+        if raw_sharded and tag is None:
+            if dist.master_proc():
+                self._serialize_meta_only(sync_flush)
+            return
         items = self._checkpoint_items()
         any_sharded = not all(self._is_host_readable(v)
                               for v in items.values())

@@ -123,6 +123,28 @@ def is_distributed() -> bool:
     return process_count() > 1
 
 
+def barrier(name: str, timeout_s: float = 600.0) -> None:
+    """Rendezvous every process at a named point — coordination-service RPC
+    only, no device collective (a gloo/ICI group might not exist yet, and
+    lazily creating one times out in ~30s if the peer is busy in
+    process-local work; the RPC barrier tolerates the full ``timeout_s``).
+
+    ``name`` must be identical on every process AND unique per rendezvous:
+    derive it from state that advances in lockstep on all hosts (e.g. a
+    counter bumped at request *start*, which stays synchronized even when
+    one host errors out mid-run) — a process-local call counter would
+    desynchronize permanently after any one-sided failure.
+    """
+    if process_count() == 1:
+        return
+    from jax._src import distributed
+    client = getattr(distributed.global_state, "client", None)
+    if client is None:
+        return
+    client.wait_at_barrier(f"penroz_{name}",
+                           timeout_in_ms=int(timeout_s * 1000))
+
+
 def all_reduce_mean(value: float) -> float:
     """Average a host-local scalar across processes.
 

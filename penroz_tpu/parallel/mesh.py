@@ -39,9 +39,22 @@ PIPE_AXIS = "pipe"
 
 
 def make_mesh(devices=None, *, data: Optional[int] = None, model: int = 1,
-              sequence: int = 1, expert: int = 1, pipe: int = 1) -> Mesh:
+              sequence: int = 1, expert: int = 1, pipe: int = 1,
+              pipe_outermost: bool = False) -> Mesh:
     """Build a (data, model, sequence, expert, pipe) mesh over the given
-    (default: all) devices.  ``data`` defaults to whatever is left over."""
+    (default: all) devices.  ``data`` defaults to whatever is left over.
+
+    ``pipe_outermost=True`` makes ``pipe`` the slowest-varying axis of the
+    device assignment: stage ``s`` occupies the contiguous global device
+    range ``[s·n/P, (s+1)·n/P)``.  ``jax.devices()`` orders devices by
+    process, so under multi-host this maps each pipeline stage onto a
+    contiguous group of hosts — the stage handoff (``ppermute``) crosses
+    DCN once per tick while the within-stage axes stay on ICI.  The
+    default (pipe fastest-varying) keeps whole pipelines inside a host:
+    right when PP is used for schedule overlap rather than to fit a model
+    across hosts.  Axis *names* are identical either way; only the
+    device→coordinate assignment differs.
+    """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     denom = model * sequence * expert * pipe
@@ -54,7 +67,12 @@ def make_mesh(devices=None, *, data: Optional[int] = None, model: int = 1,
     if data * denom != n:
         raise ValueError(f"mesh {data}×{model}×{sequence}×{expert}×{pipe} "
                          f"!= {n} devices")
-    arr = np.array(devices).reshape(data, model, sequence, expert, pipe)
+    if pipe_outermost:
+        arr = np.moveaxis(
+            np.array(devices).reshape(pipe, data, model, sequence, expert),
+            0, -1)
+    else:
+        arr = np.array(devices).reshape(data, model, sequence, expert, pipe)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS,
                       PIPE_AXIS))
 

@@ -125,7 +125,8 @@ def shard_batch(batch, mesh: Mesh, **kw):
 
 
 def global_batch(batch, mesh: Mesh, *, leading_steps: bool = False,
-                 shard_sequence: bool = False):
+                 shard_sequence: bool = False,
+                 process_replicated: bool = False):
     """Place a batch on the mesh, lifting process-local rows to a global
     array under multi-host (SURVEY.md §7.1: the rank-strided Loader feeds
     each host its slice; ``jax.make_array_from_process_local_data`` stitches
@@ -134,6 +135,11 @@ def global_batch(batch, mesh: Mesh, *, leading_steps: bool = False,
 
     Single-process this is exactly :func:`shard_batch`.  The batch dim is
     axis 1 with ``leading_steps`` (num_steps, B, T), else axis 0.
+
+    ``process_replicated=True``: every process already holds the SAME,
+    complete batch (pipeline stages spanning hosts — the loader does not
+    rank-stride), so the global shape equals the local shape and each
+    process just serves its devices' slices via callback.
     """
     import jax
     from penroz_tpu.parallel import dist
@@ -144,6 +150,8 @@ def global_batch(batch, mesh: Mesh, *, leading_steps: bool = False,
     spec = batch_spec(mesh, leading_steps=leading_steps,
                       shard_sequence=shard_sequence)
     sharding = NamedSharding(mesh, spec)
+    if process_replicated:
+        return place(np.asarray(batch), sharding)
     batch_axis = 1 if leading_steps else 0
     global_shape = list(np.shape(batch))
     global_shape[batch_axis] *= world

@@ -1,0 +1,48 @@
+"""On-chip probe: headline train throughput vs batch / steps_per_call.
+
+float()-synced via bench_train; each result lands in
+scripts/probe_results.json immediately.  Throwaway instrumentation.
+"""
+import json
+import os
+
+import jax
+
+OUT = os.path.join(os.path.dirname(__file__), "probe_results.json")
+try:
+    results = json.load(open(OUT))
+except (OSError, ValueError):
+    results = {}
+
+
+def emit(**kv):
+    results.update(kv)
+    with open(OUT, "w") as fh:
+        json.dump(results, fh, indent=1, sort_keys=True)
+    print("probe:", kv, flush=True)
+
+
+def main():
+    from __graft_entry__ import OPTIMIZER, _gpt2_dsl
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import CompiledArch
+    import bench as B
+
+    for batch, steps in [(8, 4), (16, 4), (16, 2), (24, 2), (32, 2)]:
+        mapper = Mapper(_gpt2_dsl(depth=12, d=768, block=1024, heads=12),
+                        OPTIMIZER)
+        arch = CompiledArch.get(mapper.layers)
+        params, _ = mapper.init_params(arch.mods, seed=0)
+        params = jax.device_put(params, jax.devices()[0])
+        try:
+            tps, _ = B.bench_train(arch, mapper, params, batch=batch,
+                                   block=1024, steps_per_call=steps,
+                                   warmup=2, timed=4)
+            emit(**{f"train_b{batch}_s{steps}_tps": round(tps, 1)})
+        except Exception as exc:  # noqa: BLE001
+            emit(**{f"train_b{batch}_s{steps}_error": str(exc)[:160]})
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -165,6 +165,69 @@ def test_multihost_mesh_allows_tensor_parallel(workdir, monkeypatch,
         model._multihost_mesh(micro_batch=8)
 
 
+def test_pipe_layout_error_path_stays_one_sided_safe(workdir, monkeypatch):
+    """Error-path cleanup under multi-host pipe: unstacking cross-host
+    stacked leaves is a collective, so a host arriving alone must keep the
+    stacked layout (local_only) and an untagged serialize must degrade to
+    master metadata BEFORE attempting the canonical conversion."""
+    model = _make_model("pipeerr")
+    model.serialize(sync_flush=True)  # a blob for the meta-only update
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    full = np.arange(32, dtype=np.float32).reshape(4, 8)
+    model.params = dict(model.params)
+    model.params["__pipe__.mlp.weight"] = _FakeShardedArray(full, (0, 2))
+    model._pipe_layout = (0, 4)
+
+    model._exit_pipe_layout(local_only=True)
+    assert model._pipe_layout == (0, 4)  # layout kept, no collective
+
+    # untagged save: meta-only path, never touches _canonical_state (which
+    # would raise on the fake array's missing __getitem__)
+    model.status = {"code": "Error", "message": "boom"}
+    model.serialize(sync_flush=True)
+    restored = NeuralNetworkModel.deserialize("pipeerr")
+    assert restored.status["code"] == "Error"
+
+
+def test_multihost_mesh_pipe_axis(workdir, monkeypatch, cpu_devices):
+    """PENROZ_MESH_PIPE under a (mocked) 2-process world builds the pipe
+    axis outermost: stage s occupies a contiguous global device range, so
+    stages align with host groups and the handoff rides DCN."""
+    model = _make_model("pipemesh")
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    mesh = model._multihost_mesh(micro_batch=8)
+    assert mesh.shape[mesh_lib.PIPE_AXIS] == 2
+    assert mesh.shape[mesh_lib.DATA_AXIS] == len(cpu_devices) // 2
+    # outermost: the first half of jax.devices() is stage 0, second stage 1
+    devs = mesh.devices  # (data, model, seq, expert, pipe)
+    n = len(cpu_devices)
+    stage0 = {d.id for d in devs[..., 0].ravel()}
+    stage1 = {d.id for d in devs[..., 1].ravel()}
+    assert stage0 == {d.id for d in cpu_devices[: n // 2]}
+    assert stage1 == {d.id for d in cpu_devices[n // 2:]}
+
+    # forward-only callers fold pipe into data capacity
+    folded = model._multihost_mesh(micro_batch=8, fold_pipe=True)
+    assert folded.shape[mesh_lib.PIPE_AXIS] == 1
+    assert folded.shape[mesh_lib.DATA_AXIS] == len(cpu_devices)
+
+    # batch must divide the within-stage data axis
+    with pytest.raises(ValueError, match="divisible by the data axis"):
+        model._multihost_mesh(micro_batch=3)
+
+    # stage/host misalignment refused (3 stages over 2 processes)
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "3")
+    with pytest.raises(RuntimeError, match="align with host boundaries"):
+        model._multihost_mesh(micro_batch=8)
+
+    # SP/EP composition refused, same contract as single-host
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
+    with pytest.raises(RuntimeError, match="data and tensor"):
+        model._multihost_mesh(micro_batch=8)
+
+
 def test_master_prunes_stale_higher_rank_shards(workdir, monkeypatch):
     """Retraining with a smaller world must remove leftover shard files from
     the larger run, or reassembly would overwrite fresh weights with stale

@@ -451,9 +451,14 @@ class CompiledArch:
         """
         from penroz_tpu.parallel import pipeline
         pmesh, start, count, micro = pipe_cfg
+        # MoE blocks route their balance loss + router-fraction buffers
+        # through the schedule's aux channel (bubble-masked, see
+        # gpipe_apply); blocks without stateful modules skip the plumbing.
+        with_aux = any(isinstance(sub, M.MixtureOfExperts)
+                       for sub in self.mods[start].walk())
         block_fn = pipeline.block_fn_from_arch(
             self, start, training=True, compute_dtype=compute_dtype,
-            platform=platform)
+            platform=platform, with_aux=with_aux)
         pre = self.mods[:start]
         post = self.mods[start + count:]
 
@@ -465,9 +470,26 @@ class CompiledArch:
                 h = mod.apply(h, ctx)
             stacked = {k[len("__pipe__."):]: v for k, v in params.items()
                        if k.startswith("__pipe__.")}
-            h = pipeline.gpipe_apply(block_fn, stacked, h, pmesh, micro,
-                                     rng=jax.random.fold_in(rng, 0x9e3779),
-                                     remat=pipe_remat)
+            res = pipeline.gpipe_apply(block_fn, stacked, h, pmesh, micro,
+                                       rng=jax.random.fold_in(rng, 0x9e3779),
+                                       remat=pipe_remat, with_aux=with_aux)
+            if with_aux:
+                h, aux_sums = res
+                # Per-(layer, microbatch) sums -> mean over microbatches.
+                # Microbatches partition the rows, so the fraction means
+                # equal the sequential whole-batch fractions exactly; the
+                # balance loss matches the grad-accum path where each
+                # micro-step's aux joins its own cost and costs average.
+                ctx.aux_losses.append(jnp.sum(aux_sums["loss"]) / micro)
+                for key, leaf in aux_sums.items():
+                    if key == "loss":
+                        continue
+                    suffix = key[len("buf."):]
+                    for j in range(count):
+                        ctx.buffer_updates[
+                            f"layers.{start + j}.{suffix}"] = leaf[j] / micro
+            else:
+                h = res
             logits = None
             for mod in post:
                 if isinstance(mod, M.Softmax):
@@ -1322,13 +1344,18 @@ class NeuralNetworkModel:
                 f"PENROZ_MESH_PIPE={pipe}: the longest run of identical "
                 f"blocks is {count} (need a multiple of the pipe axis); "
                 f"this DSL cannot pipeline at that depth")
+        # MoE blocks pipeline: balance loss + router fractions travel the
+        # schedule's aux channel (gpipe_apply with_aux).  BatchNorm stays
+        # refused — its running stats are read AND written per microbatch,
+        # a sequential dependency the parallel schedule cannot honor.
         for i in range(start, start + count):
             for sub in self.arch.mods[i].walk():
-                if isinstance(sub, (M.BatchNorm1d, M.MixtureOfExperts)):
+                if isinstance(sub, M.BatchNorm1d):
                     raise RuntimeError(
                         f"PENROZ_MESH_PIPE>1 cannot pipeline blocks with "
-                        f"{type(sub).__name__}: buffer updates/aux losses "
-                        f"do not cross the stage boundary yet")
+                        f"{type(sub).__name__}: running statistics are "
+                        f"read and written per microbatch, which the "
+                        f"parallel schedule cannot order")
         base = batch_size // data
         env_m = os.environ.get("PENROZ_PIPE_MICROBATCHES", "")
         if env_m:
@@ -1847,21 +1874,20 @@ class NeuralNetworkModel:
         pipeline-stacked layout still active, unstacking cross-host leaves
         is itself a collective, and an uncoordinated call must not launch
         one one-sided."""
-        raw_sharded = (
-            not all(self._is_host_readable(v) for v in self.params.values())
-            or not all(self._is_host_readable(leaf) for leaf
-                       in jax.tree.leaves(self.opt_state)))
-        if raw_sharded and tag is None:
-            if dist.master_proc():
-                self._serialize_meta_only(sync_flush)
-            return
+        if tag is None:
+            # Buffers are always placed replicated, so raw params +
+            # optimizer leaves cover every state whose canonical
+            # conversion or persistence would be cross-host.
+            raw_sharded = (
+                not all(self._is_host_readable(v)
+                        for v in self.params.values())
+                or not all(self._is_host_readable(leaf) for leaf
+                           in jax.tree.leaves(self.opt_state)))
+            if raw_sharded:
+                if dist.master_proc():
+                    self._serialize_meta_only(sync_flush)
+                return
         items = self._checkpoint_items()
-        any_sharded = not all(self._is_host_readable(v)
-                              for v in items.values())
-        if any_sharded and tag is None:
-            if dist.master_proc():
-                self._serialize_meta_only(sync_flush)
-            return
         sharded_meta: dict = {}
         shard_pieces: dict = {}
         for name, v in items.items():

@@ -85,7 +85,8 @@ def gpipe_spec(mesh):
 
 
 def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
-                num_microbatches: int, rng=None, remat: str = "none"):
+                num_microbatches: int, rng=None, remat: str = "none",
+                with_aux: bool = False):
     """Apply ``L`` stacked blocks to ``x`` with a ``P``-stage GPipe schedule.
 
     ``block_fn(block_params: dict, h) -> h`` applies ONE block given its
@@ -109,6 +110,21 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     microbatch activations) instead of O(all block internals)) while
     keeping exact numerics; the schedule/memory trade is the compiler's,
     which is the TPU-idiomatic split.  ``remat="none"`` keeps everything.
+
+    ``with_aux=True``: ``block_fn`` returns ``(h, aux)`` where ``aux`` is a
+    flat dict — key ``"loss"`` is a per-block scalar (e.g. the MoE balance
+    loss) and every other key a per-block statistic (e.g.
+    ``"buf.<suffix>"`` router fractions).  The schedule masks the pipeline
+    bubble (warmup/drain ticks process garbage activations that must not
+    pollute the sums) and SUMS every key over real (layer, microbatch)
+    applications; the caller divides by ``num_microbatches``.  Because
+    microbatches partition the batch rows equally, that mean is EXACTLY
+    the whole-batch value for row-mean statistics like router fractions —
+    identical to the sequential path computing them on the full batch.
+    Returns ``(out, sums)`` with ``sums`` ``{key: (L, ...)}`` leaves,
+    pmean'd over the data axis (again exact for row-mean statistics; the
+    nonlinear balance loss becomes the mean of per-shard losses — the
+    standard per-group/local Switch formulation).
     """
     if remat not in ("none", "block"):
         raise ValueError(f"remat={remat!r}: expected 'none' or 'block'")
@@ -132,41 +148,62 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     param_spec, mb_spec, out_spec = gpipe_spec(mesh)
     in_specs = (jax.tree.map(lambda _: param_spec, stacked_params), mb_spec)
 
+    aux_struct = None
+    if with_aux:
+        # Aux key set / shapes, needed to build the scan carry and the
+        # shard_map out_specs before tracing the schedule.  Row counts
+        # never reach aux shapes (scalars / per-expert vectors), so the
+        # global microbatch shape stands in for the per-shard one.
+        p0 = {k: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+              for k, v in stacked_params.items()}
+        h0 = jax.ShapeDtypeStruct(mbs.shape[1:], x.dtype)
+        args = (p0, h0) if rng is None else (p0, h0, rng)
+        _, aux_struct = jax.eval_shape(block_fn, *args)
+        if "loss" not in aux_struct:
+            raise ValueError("with_aux block_fn must return a 'loss' key")
+
     def stage_fn(params_stage, mbs_local):
         stage = jax.lax.axis_index(PIPE_AXIS)
         layers_per_stage = num_layers // pipe
 
         def apply_blocks(h, t):
-            if rng is None:
-                h, _ = jax.lax.scan(
-                    lambda hh, pl: (block_fn(pl, hh), None), h, params_stage)
-                return h
-
             def body(hh, idx_and_params):
                 idx, pl = idx_and_params
-                key = jax.random.fold_in(
-                    jax.random.fold_in(rng, stage * layers_per_stage + idx),
-                    t)
-                return block_fn(pl, hh, key), None
+                if rng is None:
+                    res = block_fn(pl, hh)
+                else:
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(
+                            rng, stage * layers_per_stage + idx), t)
+                    res = block_fn(pl, hh, key)
+                if with_aux:
+                    return res
+                return res, None
 
-            h, _ = jax.lax.scan(body, h,
-                                (jnp.arange(layers_per_stage), params_stage))
-            return h
+            h, auxs = jax.lax.scan(body, h,
+                                   (jnp.arange(layers_per_stage),
+                                    params_stage))
+            return h, auxs
 
         def tick(carry, t):
-            state, buf = carry
+            state, buf, aux_acc = carry
             # Stage 0 ingests a fresh microbatch; others consume the
             # activation handed over by the previous stage last tick.
             feed = mbs_local[jnp.clip(t, 0, m - 1)]
-            h = apply_blocks(jnp.where(stage == 0, feed, state), t)
+            h, auxs = apply_blocks(jnp.where(stage == 0, feed, state), t)
             # Stage s works on microbatch t - s; the last stage commits it.
             out_mb = t - stage
-            valid = (out_mb >= 0) & (out_mb < m) & (stage == pipe - 1)
+            computing = (out_mb >= 0) & (out_mb < m)
+            valid = computing & (stage == pipe - 1)
             committed = buf.at[jnp.clip(out_mb, 0, m - 1)].set(h)
             buf = jnp.where(valid, committed, buf)
+            if with_aux:
+                # Bubble ticks process garbage — mask them out of the sums.
+                aux_acc = {k: acc + jnp.where(computing, auxs[k], 0.0)
+                           for k, acc in aux_acc.items()}
             state = jax.lax.ppermute(
                 h, PIPE_AXIS, [(i, (i + 1) % pipe) for i in range(pipe)])
-            return (state, buf), None
+            return (state, buf, aux_acc), None
 
         # The carry is device-varying over both `data` (inherited from the
         # sharded microbatches via zeros_like) and `pipe` (each stage's state
@@ -174,11 +211,27 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
         zero_buf = jax.lax.pcast(jnp.zeros_like(mbs_local), (PIPE_AXIS,),
                                  to="varying")
         zero_state = zero_buf[0]
-        (_, buf), _ = jax.lax.scan(tick, (zero_state, zero_buf),
-                                   jnp.arange(m + pipe - 1))
+        aux0 = None
+        if with_aux:
+            def zinit(sd):
+                # Fresh zeros are axis-invariant; the accumulated values
+                # derive from pipe- and data-varying activations.
+                return jax.lax.pcast(
+                    jnp.zeros((layers_per_stage,) + tuple(sd.shape),
+                              jnp.float32),
+                    (PIPE_AXIS, DATA_AXIS), to="varying")
+            aux0 = {k: zinit(v) for k, v in aux_struct.items()}
+        (_, buf, aux_final), _ = jax.lax.scan(
+            tick, (zero_state, zero_buf, aux0), jnp.arange(m + pipe - 1))
         # Only the last stage holds real outputs; broadcast them to all.
         mine = jnp.where(stage == pipe - 1, buf, jnp.zeros_like(buf))
-        return jax.lax.psum(mine, PIPE_AXIS)
+        out = jax.lax.psum(mine, PIPE_AXIS)
+        if not with_aux:
+            return out
+        # Row-mean statistics (router fractions) are exact under the data
+        # pmean; the balance loss becomes the mean of per-shard losses.
+        return out, {k: jax.lax.pmean(v, DATA_AXIS)
+                     for k, v in aux_final.items()}
 
     # Partial-manual shard_map: only the pipe and data axes are manual
     # (the schedule's ppermute/psum/axis_index live on them); the model/
@@ -186,15 +239,23 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     # a tensor-parallel sharding on their trailing dims (P(pipe, model, …)
     # from _enter_pipe_layout) get their TP collectives inserted by XLA
     # inside each stage — that is what lets pipe×model meshes train.
-    out = jax.shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
-                        out_specs=out_spec,
+    if with_aux:
+        out_specs = (out_spec, {k: P(PIPE_AXIS) for k in aux_struct})
+    else:
+        out_specs = out_spec
+    res = jax.shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs,
                         axis_names={PIPE_AXIS, DATA_AXIS})(stacked_params,
                                                            mbs)
-    return out.reshape(batch, *x.shape[1:])
+    if not with_aux:
+        return res.reshape(batch, *x.shape[1:])
+    out, sums = res
+    return out.reshape(batch, *x.shape[1:]), sums
 
 
 def block_fn_from_arch(arch, block_index: int, *, training=False,
-                       compute_dtype=None, platform=None):
+                       compute_dtype=None, platform=None,
+                       with_aux: bool = False):
     """``block_fn`` for :func:`gpipe_apply` from one bound DSL block module.
 
     Uses the module tree of block ``block_index`` with params rebound from
@@ -202,6 +263,12 @@ def block_fn_from_arch(arch, block_index: int, *, training=False,
     so one module tree serves every layer).  The optional ``key`` third
     argument carries the per-(layer, tick) dropout stream gpipe_apply folds
     when given an ``rng``.
+
+    ``with_aux=True`` returns ``(h, aux)`` in the gpipe_apply aux protocol:
+    ``aux["loss"]`` sums the block's auxiliary losses (MoE balance) and
+    ``aux["buf.<suffix>"]`` carries its buffer updates (router fractions),
+    suffixes relative to the block prefix so the caller can re-key them per
+    unstacked layer.
     """
     from penroz_tpu.ops import modules as M
     mod = arch.mods[block_index]
@@ -212,6 +279,14 @@ def block_fn_from_arch(arch, block_index: int, *, training=False,
                      for suffix, leaf in block_params.items()},
                     training=training, rng=key,
                     compute_dtype=compute_dtype, platform=platform)
-        return mod.apply(h, ctx)
+        out = mod.apply(h, ctx)
+        if not with_aux:
+            return out
+        loss = (sum(ctx.aux_losses) if ctx.aux_losses
+                else jnp.zeros((), jnp.float32))
+        aux = {"loss": jnp.asarray(loss, jnp.float32)}
+        for k, v in ctx.buffer_updates.items():
+            aux["buf." + k[len(prefix):]] = v
+        return out, aux
 
     return block_fn

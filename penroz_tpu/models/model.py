@@ -1875,14 +1875,16 @@ class NeuralNetworkModel:
         is itself a collective, and an uncoordinated call must not launch
         one one-sided."""
         if tag is None:
-            # Buffers are always placed replicated, so raw params +
-            # optimizer leaves cover every state whose canonical
-            # conversion or persistence would be cross-host.
-            raw_sharded = (
-                not all(self._is_host_readable(v)
-                        for v in self.params.values())
-                or not all(self._is_host_readable(leaf) for leaf
-                           in jax.tree.leaves(self.opt_state)))
+            # Raw-layout check over params + buffers + optimizer leaves:
+            # buffers are placed replicated at train start, but epoch
+            # OUTPUTS (e.g. pipelined MoE router fractions from the aux
+            # channel) carry whatever sharding GSPMD propagated, so they
+            # must be checked, not assumed.
+            raw_sharded = not all(
+                self._is_host_readable(v) for v in (
+                    list(self.params.values())
+                    + list(self.buffers.values())
+                    + jax.tree.leaves(self.opt_state)))
             if raw_sharded:
                 if dist.master_proc():
                     self._serialize_meta_only(sync_flush)

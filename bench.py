@@ -400,10 +400,11 @@ def bench_long_context(depth=12, d_model=768, block=4096, batch=1,
             tps, _ = bench_train(arch, mapper, params, batch=batch,
                                  block=block, steps_per_call=steps_per_call,
                                  timed=timed, remat=False)
-        except Exception:  # noqa: BLE001 — OOM etc.: pay the replay
+        except Exception as no_remat_exc:  # noqa: BLE001 — OOM: pay replay
             import logging
             logging.getLogger(__name__).warning(
-                "long-context no-remat run failed; retrying with remat")
+                "long-context no-remat run failed (%s); retrying with "
+                "remat", no_remat_exc)
             params, _ = mapper.init_params(arch.mods, seed=0)
             params = jax.device_put(params, jax.devices()[0])
             tps, _ = bench_train(arch, mapper, params, batch=batch,

@@ -1332,12 +1332,15 @@ class NeuralNetworkModel:
         from penroz_tpu.parallel import pipeline
         pipe = mesh.shape[mesh_lib.PIPE_AXIS]
         data = mesh.shape[mesh_lib.DATA_AXIS]
-        if (os.environ.get("PENROZ_FSDP", "0") == "1"
-                or os.environ.get("PENROZ_WUS", "0") == "1"):
-            raise RuntimeError(
-                "PENROZ_MESH_PIPE>1 does not compose with PENROZ_FSDP/"
-                "PENROZ_WUS yet: the ZeRO ladder shards the flat layout, "
-                "the pipeline shards the stacked one")
+        # ZeRO ladder over the stacked layout: PENROZ_WUS=1 data-shards
+        # the optimizer moments on a dim the pipe/TP layout leaves free;
+        # PENROZ_FSDP=1 shards the stacked params' storage the same way —
+        # gpipe_apply's shard_map in_spec (P(pipe), replicated over data)
+        # then forces a just-in-time all-gather at the schedule boundary,
+        # and its AD transpose reduce-scatters the gradients: ZeRO-3
+        # semantics from the resharding rule, no bespoke gather code.
+        fsdp = os.environ.get("PENROZ_FSDP", "0") == "1"
+        wus = fsdp or os.environ.get("PENROZ_WUS", "0") == "1"
         start, count = pipeline.pipeline_block_range(self.layers_dsl)
         if count < pipe or count % pipe:
             raise RuntimeError(
@@ -1389,7 +1392,7 @@ class NeuralNetworkModel:
             is_leaf=lambda n: isinstance(n, dict) and set(n) == pkeys)
         repl = mesh_lib.replicated(mesh)
 
-        def pipe_sharding(suffix: str):
+        def pipe_spec(suffix: str):
             # Stacked leaves: leading L dim over `pipe`, trailing dims in
             # the Megatron TP layout of the per-layer leaf (a no-op spec
             # when the model axis is 1) — this is what lets pipe×model
@@ -1398,20 +1401,33 @@ class NeuralNetworkModel:
             base = sharding_lib.param_spec(
                 f"layers.{idx[0]}.{suffix}",
                 tuple(stacked[suffix].shape[1:]), mesh)
-            return jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec(mesh_lib.PIPE_AXIS, *base))
+            return jax.sharding.PartitionSpec(mesh_lib.PIPE_AXIS, *base)
 
-        param_shd = {}
+        base_spec = {}
         for k, v in mixed.items():
             if k.startswith("__pipe__."):
-                param_shd[k] = pipe_sharding(k[len("__pipe__."):])
+                base_spec[k] = pipe_spec(k[len("__pipe__."):])
             else:
                 # Non-block params (embeddings, final LN, lm head) take
                 # their flat TP layout; replicated when model == 1.
-                param_shd[k] = jax.sharding.NamedSharding(
-                    mesh, sharding_lib.param_spec(k, tuple(v.shape), mesh))
+                base_spec[k] = sharding_lib.param_spec(k, tuple(v.shape),
+                                                       mesh)
+
+        def with_data(k):
+            # ZeRO rule: data axis on the first dim the pipe/TP layout
+            # leaves free (sharding._data_axis_spec; no-op when data==1
+            # or no dim divides).
+            return sharding_lib._data_axis_spec(
+                base_spec[k], tuple(mixed[k].shape), mesh)
+
+        param_shd = {k: jax.sharding.NamedSharding(
+                         mesh, with_data(k) if fsdp else base_spec[k])
+                     for k in mixed}
+        moment_shd = {k: jax.sharding.NamedSharding(
+                          mesh, with_data(k) if wus else base_spec[k])
+                      for k in mixed}
         opt_shd = jax.tree.map(
-            lambda n: ({k: param_shd[k] for k in n}
+            lambda n: ({k: moment_shd[k] for k in n}
                        if isinstance(n, dict) and set(n) == set(mixed)
                        else repl),
             opt_mixed,
@@ -1421,7 +1437,9 @@ class NeuralNetworkModel:
         self.opt_state = sharding_lib.place_tree(opt_mixed, opt_shd)
         self._pipe_layout = (start, count)
         log.info("Pipeline layout: blocks %d..%d stacked over pipe=%d, "
-                 "%d microbatch(es)", start, start + count - 1, pipe, micro)
+                 "%d microbatch(es)%s", start, start + count - 1, pipe,
+                 micro,
+                 " + FSDP" if fsdp else (" + WUS" if wus else ""))
         return (mesh, start, count, micro), (param_shd, opt_shd)
 
     def _canonical_params(self, params=None) -> dict:

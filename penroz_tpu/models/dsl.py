@@ -244,6 +244,8 @@ class Mapper:
             return _llama_dsl_from_config(config, n_layer_override)
         if model_type == "gpt_neox":
             return _neox_dsl_from_config(config, n_layer_override)
+        if model_type == "phi":
+            return _phi_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -272,6 +274,8 @@ class Mapper:
             return _map_gpt2_state_dict(state_dict, n_layer)
         if "gpt_neox.embed_in.weight" in state_dict:
             return _map_neox_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "phi":
+            return _map_phi_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") in _LLAMA_FAMILY:
             return _map_llama_state_dict(state_dict, n_layer, config)
         return _map_gemma_state_dict(state_dict, n_layer, config)
@@ -536,7 +540,7 @@ def _map_gemma_state_dict(sd: dict, n_layer: int, config=None) -> dict:
 # modules with pre-norm blocks, no +1 norm offset and no embedding scale)
 # ---------------------------------------------------------------------------
 
-_LLAMA_FAMILY = ("llama", "mistral", "qwen2")
+_LLAMA_FAMILY = ("llama", "mistral", "qwen2", "qwen3")
 
 
 def _llama_text_config(config):
@@ -555,7 +559,7 @@ def _llama_biases(model_type: str, cfg) -> tuple[bool, bool]:
 
 
 def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
-    """Llama/Mistral/Qwen2 HF config → layer DSL.
+    """Llama/Mistral/Qwen2/Qwen3 HF config → layer DSL.
 
     ``rope_scaling`` with ``rope_type='llama3'`` (Llama 3.1+) is applied as
     an inverse-frequency rescale (ops/attention.rope_cos_sin); other active
@@ -613,6 +617,10 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                  "head_dim": hd, "dropout": attn_drop}
     if scaling:
         attn_args["rope_scaling"] = scaling
+    if model_type == "qwen3":
+        # Qwen3 RMS-normalizes q and k per head before RoPE with learned
+        # (head_dim,) weights (HF Qwen3Attention q_norm/k_norm).
+        attn_args.update(qk_norm=True, qk_norm_eps=eps)
     layers: list[dict] = [
         {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
          "normal": {"mean": 0.0, "std": 0.02}},
@@ -723,6 +731,100 @@ def _neox_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     return layers
 
 
+def _phi_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """Phi-1/1.5/2 HF config → layer DSL.
+
+    Phi blocks are parallel-residual with ONE shared input LayerNorm
+    feeding both branches (HF ``modeling_phi`` forward: attention and MLP
+    both read ``input_layernorm(x)`` and their outputs sum onto the
+    residual — cf. NeoX, where each branch carries its own norm), so the
+    block nests as ``residual([sequential([ln, summation([attn, mlp])])])``.
+    Partial rotary via ``partial_rotary_factor`` (default 0.5), biases on
+    every projection, biased final lm_head, LayerNorm (not RMSNorm).
+    """
+    cfg = _llama_text_config(config)
+    if getattr(cfg, "qk_layernorm", False):
+        raise ValueError("qk_layernorm Phi checkpoints are not supported")
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    kv = int(getattr(cfg, "num_key_value_heads", None) or heads)
+    hd = d // heads
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "layer_norm_eps", 1e-5))
+    rope = float(getattr(cfg, "rope_theta", 10000.0) or 10000.0)
+    rope_pct = float(getattr(cfg, "partial_rotary_factor", 0.5) or 0.5)
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    resid_drop = float(getattr(cfg, "resid_pdrop", 0.0) or 0.0)
+    embd_drop = float(getattr(cfg, "embd_pdrop", 0.0) or 0.0)
+    inter = int(getattr(cfg, "intermediate_size", None) or 4 * d)
+    act = getattr(cfg, "hidden_act", "gelu_new")
+    if act in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"):
+        act_entry = {"gelu": {"approximate": "tanh"}}
+    elif act == "gelu":
+        act_entry = {"gelu": {}}
+    else:
+        raise ValueError(f"Unsupported phi hidden_act: {act!r}")
+
+    attn_args = {"num_heads": heads, "num_kv_heads": kv, "dropout": attn_drop,
+                 "rope_theta": rope, "rope_pct": rope_pct}
+    tail_drop = [{"dropout": {"p": resid_drop}}] if resid_drop else []
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    if embd_drop:
+        layers.append({"dropout": {"p": embd_drop}})
+    for _ in range(n):
+        attn_branch = {"sequential": [
+            {"linear": {"in_features": d,
+                        "out_features": (heads + 2 * kv) * hd}},
+            {"attention": dict(attn_args)},
+            {"linear": {"in_features": heads * hd, "out_features": d}}]
+            + tail_drop}
+        mlp_branch = {"sequential": [
+            {"linear": {"in_features": d, "out_features": inter}},
+            act_entry,
+            {"linear": {"in_features": inter, "out_features": d}}]
+            + tail_drop}
+        layers.append({"residual": [{"sequential": [
+            {"layernorm": {"normalized_shape": d, "eps": eps}},
+            {"summation": [attn_branch, mlp_branch]}]}]})
+    layers += [
+        {"layernorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": True}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_phi_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """Phi HF keys → ours: QKV (+bias) concat like llama, the block's
+    single input_layernorm lands inside the residual container
+    (``layers.{i}.0.0``), branch projections under the summation
+    (``layers.{i}.0.1.{branch}.{item}``), biased final head kept."""
+    cfg = _llama_text_config(config)
+    base = 1 + (1 if float(getattr(cfg, "embd_pdrop", 0.0) or 0.0) else 0)
+    out = {"layers.0.weight": sd["model.embed_tokens.weight"]}
+    for i in range(n_layer):
+        src = f"model.layers.{i}"
+        dst = f"layers.{base + i}.0"
+        for name in ("weight", "bias"):
+            out[f"{dst}.0.{name}"] = sd[f"{src}.input_layernorm.{name}"]
+            out[f"{dst}.1.0.0.{name}"] = np.concatenate(
+                [np.asarray(sd[f"{src}.self_attn.q_proj.{name}"]),
+                 np.asarray(sd[f"{src}.self_attn.k_proj.{name}"]),
+                 np.asarray(sd[f"{src}.self_attn.v_proj.{name}"])], axis=0)
+            out[f"{dst}.1.0.2.{name}"] = sd[f"{src}.self_attn.dense.{name}"]
+            out[f"{dst}.1.1.0.{name}"] = sd[f"{src}.mlp.fc1.{name}"]
+            out[f"{dst}.1.1.2.{name}"] = sd[f"{src}.mlp.fc2.{name}"]
+    for name in ("weight", "bias"):
+        out[f"layers.{base + n_layer}.{name}"] = \
+            sd[f"model.final_layernorm.{name}"]
+        out[f"layers.{base + n_layer + 1}.{name}"] = sd[f"lm_head.{name}"]
+    return out
+
+
 def _neox_deinterleave_qkv(w: np.ndarray, heads: int) -> np.ndarray:
     """GPT-NeoX fuses QKV per head ([q_h; k_h; v_h] stacked head-major,
     HF ``modeling_gpt_neox`` view (H, 3, hd, ...)); our attention expects
@@ -785,6 +887,11 @@ def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:
         out[f"{dst}.attn_block.3.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
         if f"{src}.self_attn.o_proj.bias" in sd:
             out[f"{dst}.attn_block.3.bias"] = sd[f"{src}.self_attn.o_proj.bias"]
+        if f"{src}.self_attn.q_norm.weight" in sd:  # qwen3 per-head qk-norm
+            out[f"{dst}.attn_block.2.q_norm.weight"] = \
+                sd[f"{src}.self_attn.q_norm.weight"]
+            out[f"{dst}.attn_block.2.k_norm.weight"] = \
+                sd[f"{src}.self_attn.k_norm.weight"]
         out[f"{dst}.mlp_block.0.weight"] = \
             sd[f"{src}.post_attention_layernorm.weight"]
         for proj in ("gate_proj", "up_proj", "down_proj"):

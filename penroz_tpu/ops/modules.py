@@ -767,10 +767,19 @@ class CausalSelfAttention(Module):
                  head_dim: Optional[int] = None,
                  rope_scaling: Optional[dict] = None,
                  sliding_window: Optional[int] = None,
-                 rope_pct: Optional[float] = None):
+                 rope_pct: Optional[float] = None,
+                 qk_norm: bool = False, qk_norm_eps: float = 1e-6):
         if sliding_window is not None and int(sliding_window) < 1:
             raise ValueError(f"sliding_window must be >= 1, "
                              f"got {sliding_window}")
+        # Per-head RMS normalization of q and k before RoPE (Qwen3/OLMo-2
+        # style: HF Qwen3Attention applies RMSNorm(head_dim) to the
+        # reshaped projections).  Learned (head_dim,) weights, so the
+        # module needs head_dim at build time.
+        self.qk_norm = bool(qk_norm)
+        self.qk_norm_eps = float(qk_norm_eps)
+        if self.qk_norm and head_dim is None:
+            raise ValueError("qk_norm=True requires an explicit head_dim")
         self.sliding_window = (int(sliding_window)
                                if sliding_window is not None else None)
         self.num_heads = int(num_heads)
@@ -823,6 +832,27 @@ class CausalSelfAttention(Module):
             self.rope_scaling = None
         self.layer_idx = 0  # assigned by the model builder
 
+    def param_shapes(self):
+        if not self.qk_norm:
+            return {}
+        return {"q_norm.weight": (self.head_dim,),
+                "k_norm.weight": (self.head_dim,)}
+
+    def init(self, rng):
+        if not self.qk_norm:
+            return {}
+        return {self.key("q_norm.weight"): jnp.ones((self.head_dim,),
+                                                    jnp.float32),
+                self.key("k_norm.weight"): jnp.ones((self.head_dim,),
+                                                    jnp.float32)}
+
+    def _head_rmsnorm(self, x, w):
+        """fp32 RMS over the head dim, learned multiplicative weight."""
+        xf = x.astype(jnp.float32)
+        norm = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                             + self.qk_norm_eps)
+        return ((xf * norm).astype(x.dtype) * w).astype(x.dtype)
+
     def apply(self, qkv, ctx):
         B, T, total_dim = qkv.shape
         head_dim = total_dim // (self.num_heads + 2 * self.num_kv_heads)
@@ -834,6 +864,10 @@ class CausalSelfAttention(Module):
         v = qkv[..., q_dim + kv_dim:].reshape(B, T, self.num_kv_heads, head_dim)
         # to (B, H, T, D)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+
+        if self.qk_norm:
+            q = self._head_rmsnorm(q, self._p(ctx, "q_norm.weight"))
+            k = self._head_rmsnorm(k, self._p(ctx, "k_norm.weight"))
 
         offset = ctx.offset()
         if self.rope_theta is not None:

@@ -445,6 +445,22 @@ def test_neox_sequential_residual_logit_parity(workdir):
     np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
 
 
+def _greedy_rollout(model, ctx, steps, block=16):
+    """Token-by-token UNCACHED argmax continuation of ``ctx`` (the oracle
+    the KV-cached greedy generate must match)."""
+    import jax.numpy as jnp
+    ctx = list(ctx)
+    for _ in range(steps):
+        acts, _, _, _ = model.arch.jit_forward(
+            model.params, model.buffers,
+            jnp.asarray([ctx[-block:]], jnp.int32), skip_softmax=True)
+        logits = np.asarray(acts[-1], np.float32)
+        if logits.ndim == 3:
+            logits = logits[:, -1, :]
+        ctx.append(int(logits.argmax(-1)[0]))
+    return ctx
+
+
 def test_neox_cached_generate_matches_uncached(workdir):
     """Partial rotary must behave identically through the KV-cached decode
     path (rope offset applied to the rotary dims only): greedy cached
@@ -455,16 +471,7 @@ def test_neox_cached_generate_matches_uncached(workdir):
     toks = model.generate_tokens([[1, 2, 3]], block_size=16,
                                  max_new_tokens=6, temperature=0.0)
     assert len(toks) == 9
-    ctx = [1, 2, 3]
-    for _ in range(6):
-        acts, _, _, _ = model.arch.jit_forward(
-            model.params, model.buffers,
-            jnp.asarray([ctx[-16:]], jnp.int32), skip_softmax=True)
-        logits = np.asarray(acts[-1], np.float32)
-        if logits.ndim == 3:
-            logits = logits[:, -1, :]
-        ctx.append(int(logits.argmax(-1)[0]))
-    assert toks == ctx
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
 
 
 def test_neox_rope_scaling_rejected():
@@ -511,3 +518,98 @@ def test_neox_attention_bias_false_logit_parity(workdir):
     ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
     ours_c = ours - ours.mean(-1, keepdims=True)
     np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+
+
+def _tiny_phi():
+    from transformers import PhiConfig, PhiForCausalLM
+    config = PhiConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=2, intermediate_size=64,
+                       partial_rotary_factor=0.5,
+                       max_position_embeddings=64, hidden_act="gelu_new",
+                       attention_dropout=0.0, resid_pdrop=0.0,
+                       embd_pdrop=0.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, PhiForCausalLM(config).eval()
+
+
+def test_phi_import_logit_parity(workdir):
+    """Phi-1/1.5/2: parallel attn+MLP branches sharing ONE input LayerNorm
+    (residual -> ln -> summation nesting), partial rotary, biased
+    projections and a biased lm_head (beyond reference parity)."""
+    config, torch_model = _tiny_phi()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "phi-tiny")
+    assert model.status["code"] == "Imported"
+    assert "summation" in str(model.layers_dsl)
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+
+def test_phi_cached_generate_matches_uncached(workdir):
+    """Phi partial rotary + biased fused QKV through the KV-cached decode
+    path: greedy cached generation == uncached argmax rollout."""
+    import jax.numpy as jnp
+    config, torch_model = _tiny_phi()
+    model = _import_model(workdir, config, torch_model, "phi-gen")
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert len(toks) == 9
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_phi_qk_layernorm_rejected():
+    from transformers import PhiConfig
+    from penroz_tpu.models.dsl import Mapper
+    config = PhiConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                       num_attention_heads=2, qk_layernorm=True)
+    with pytest.raises(ValueError, match="qk_layernorm"):
+        Mapper.from_hf_config(config)
+
+
+def _tiny_qwen3():
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+    config = Qwen3Config(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                         num_attention_heads=2, num_key_value_heads=1,
+                         head_dim=16, intermediate_size=64,
+                         max_position_embeddings=64, rope_theta=10000.0,
+                         attention_dropout=0.0, tie_word_embeddings=False,
+                         use_sliding_window=False)
+    torch.manual_seed(0)
+    return config, Qwen3ForCausalLM(config).eval()
+
+
+def test_qwen3_import_logit_parity_and_generate(workdir):
+    """Qwen3: llama family + per-head RMS qk-norm (learned (head_dim,)
+    weights applied before RoPE) and GQA; cached greedy generation must
+    match the uncached argmax rollout through the normalized path."""
+    import jax.numpy as jnp
+    config, torch_model = _tiny_qwen3()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "qwen3-tiny")
+    assert model.status["code"] == "Imported"
+    assert any("q_norm" in k for k in model.params), model.params.keys()
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)

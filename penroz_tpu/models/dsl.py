@@ -540,7 +540,7 @@ def _map_gemma_state_dict(sd: dict, n_layer: int, config=None) -> dict:
 # modules with pre-norm blocks, no +1 norm offset and no embedding scale)
 # ---------------------------------------------------------------------------
 
-_LLAMA_FAMILY = ("llama", "mistral", "qwen2", "qwen3")
+_LLAMA_FAMILY = ("llama", "mistral", "mixtral", "qwen2", "qwen3")
 
 
 def _llama_text_config(config):
@@ -640,9 +640,31 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                             "bias": o_bias}}]},
             "mlp_block": {"sequential": [
                 {"rmsnorm": {"normalized_shape": d, "eps": eps}},
-                {"gatedmlp": {"in_features": d,
-                              "intermediate_size": int(cfg.intermediate_size),
-                              "activation": activation}}]},
+                # Mixtral: sparse MoE MLP.  Routing math matches our
+                # module exactly (HF MixtralSparseMoeBlock: softmax over
+                # ALL experts -> top-k -> renormalize); dense dispatch
+                # reproduces it bit-for-bit, capacity dispatch stays an
+                # opt-in.  The aux coefficient is normalized to HF's
+                # load_balancing_loss_func semantics: HF computes ONE loss
+                # averaged over all layers' tokens with expert fractions
+                # summed over the top-k slots (uniform minimum top_k),
+                # while our Switch form divides fractions by top_k
+                # (minimum 1) and sums per layer — coef × top_k / n_layers
+                # makes the total balance gradient equal.
+                ({"moe": {"in_features": d,
+                          "intermediate_size": int(cfg.intermediate_size),
+                          "num_experts": int(cfg.num_local_experts),
+                          "top_k": int(cfg.num_experts_per_tok),
+                          "activation": activation,
+                          "aux_loss_coef": (
+                              float(getattr(cfg, "router_aux_loss_coef",
+                                            0.0) or 0.0)
+                              * int(cfg.num_experts_per_tok) / n)}}
+                 if model_type == "mixtral" else
+                 {"gatedmlp": {"in_features": d,
+                               "intermediate_size":
+                                   int(cfg.intermediate_size),
+                               "activation": activation}})]},
             "post_norm_on_residual": False,
         }})
     layers += [
@@ -745,6 +767,12 @@ def _phi_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     cfg = _llama_text_config(config)
     if getattr(cfg, "qk_layernorm", False):
         raise ValueError("qk_layernorm Phi checkpoints are not supported")
+    if getattr(cfg, "tie_word_embeddings", False):
+        # HF drops tied weights on save, and the biased head the phi DSL
+        # builds has no tied-bias analogue — reject with a clear message
+        # instead of a KeyError mid-import.
+        raise ValueError("tie_word_embeddings=True phi checkpoints are "
+                         "not supported")
     d = int(cfg.hidden_size)
     n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
     heads = int(cfg.num_attention_heads)
@@ -753,7 +781,8 @@ def _phi_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     vocab = int(cfg.vocab_size)
     eps = float(getattr(cfg, "layer_norm_eps", 1e-5))
     rope = float(getattr(cfg, "rope_theta", 10000.0) or 10000.0)
-    rope_pct = float(getattr(cfg, "partial_rotary_factor", 0.5) or 0.5)
+    rope_pct = getattr(cfg, "partial_rotary_factor", None)
+    rope_pct = 0.5 if rope_pct is None else float(rope_pct)
     attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
     resid_drop = float(getattr(cfg, "resid_pdrop", 0.0) or 0.0)
     embd_drop = float(getattr(cfg, "embd_pdrop", 0.0) or 0.0)
@@ -766,8 +795,12 @@ def _phi_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     else:
         raise ValueError(f"Unsupported phi hidden_act: {act!r}")
 
-    attn_args = {"num_heads": heads, "num_kv_heads": kv, "dropout": attn_drop,
-                 "rope_theta": rope, "rope_pct": rope_pct}
+    attn_args = {"num_heads": heads, "num_kv_heads": kv, "dropout": attn_drop}
+    if rope_pct > 0.0:
+        # partial_rotary_factor=0.0 disables rope entirely (rotary_ndims=0
+        # in the torch original) — rotating dims it never rotated would
+        # silently diverge the logits.
+        attn_args.update(rope_theta=rope, rope_pct=rope_pct)
     tail_drop = [{"dropout": {"p": resid_drop}}] if resid_drop else []
     layers: list[dict] = [
         {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
@@ -894,9 +927,26 @@ def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:
                 sd[f"{src}.self_attn.k_norm.weight"]
         out[f"{dst}.mlp_block.0.weight"] = \
             sd[f"{src}.post_attention_layernorm.weight"]
-        for proj in ("gate_proj", "up_proj", "down_proj"):
-            out[f"{dst}.mlp_block.1.{proj}.weight"] = \
-                sd[f"{src}.mlp.{proj}.weight"]
+        if f"{src}.block_sparse_moe.gate.weight" in sd:
+            # Mixtral sparse MoE: per-expert w1/w3/w2 stack onto our
+            # leading-E gate/up/down layout; router gate copies straight.
+            out[f"{dst}.mlp_block.1.router.weight"] = \
+                sd[f"{src}.block_sparse_moe.gate.weight"]
+            # Sized from config, not key-probing: a truncated checkpoint
+            # missing expert e then fails on its precise absent key
+            # instead of a downstream shape mismatch.
+            n_exp = int(getattr(_llama_text_config(config),
+                                "num_local_experts"))
+            for ours, theirs in (("gate_proj", "w1"), ("up_proj", "w3"),
+                                 ("down_proj", "w2")):
+                out[f"{dst}.mlp_block.1.experts.{ours}.weight"] = np.stack(
+                    [np.asarray(sd[f"{src}.block_sparse_moe.experts."
+                                   f"{e}.{theirs}.weight"])
+                     for e in range(n_exp)])
+        else:
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                out[f"{dst}.mlp_block.1.{proj}.weight"] = \
+                    sd[f"{src}.mlp.{proj}.weight"]
     out[f"layers.{1 + n_layer}.weight"] = sd[f"{prefix}.norm.weight"]
     out[f"layers.{2 + n_layer}.weight"] = sd.get(
         "lm_head.weight", sd[f"{prefix}.embed_tokens.weight"])

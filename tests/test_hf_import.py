@@ -574,6 +574,15 @@ def test_phi_qk_layernorm_rejected():
                        num_attention_heads=2, qk_layernorm=True)
     with pytest.raises(ValueError, match="qk_layernorm"):
         Mapper.from_hf_config(config)
+    tied = PhiConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                     num_attention_heads=2, tie_word_embeddings=True)
+    with pytest.raises(ValueError, match="tie_word_embeddings"):
+        Mapper.from_hf_config(tied)
+    # partial_rotary_factor=0.0 disables rope instead of being coerced
+    norope = PhiConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                       num_attention_heads=2, partial_rotary_factor=0.0)
+    dsl = Mapper.from_hf_config(norope)
+    assert "rope_theta" not in __import__("json").dumps(dsl)
 
 
 def _tiny_qwen3():
@@ -601,6 +610,52 @@ def test_qwen3_import_logit_parity_and_generate(workdir):
     model = _import_model(workdir, config, torch_model, "qwen3-tiny")
     assert model.status["code"] == "Imported"
     assert any("q_norm" in k for k in model.params), model.params.keys()
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def _tiny_mixtral():
+    from transformers import MixtralConfig, MixtralForCausalLM
+    config = MixtralConfig(vocab_size=96, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           num_key_value_heads=1, intermediate_size=48,
+                           num_local_experts=4, num_experts_per_tok=2,
+                           max_position_embeddings=64, rope_theta=10000.0,
+                           sliding_window=None, attention_dropout=0.0,
+                           router_aux_loss_coef=0.02,
+                           tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, MixtralForCausalLM(config).eval()
+
+
+def test_mixtral_import_logit_parity_and_generate(workdir):
+    """Mixtral: sparse-MoE MLPs land on our stacked-expert module (dense
+    dispatch reproduces HF's softmax->top-k->renormalize routing exactly);
+    per-expert w1/w3/w2 stack onto gate/up/down, router gate copies, and
+    router_aux_loss_coef carries into the DSL for fine-tuning parity."""
+    config, torch_model = _tiny_mixtral()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "mixtral-tiny")
+    assert model.status["code"] == "Imported"
+    assert any("router.weight" in k for k in model.params)
+    # router_aux_loss_coef normalized to HF semantics:
+    # 0.02 * top_k(2) / n_layers(2) = 0.02
+    assert '"aux_loss_coef": 0.02' in __import__("json").dumps(
+        model.layers_dsl)
+    import jax.numpy as jnp
     acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
                                            jnp.asarray(tokens, jnp.int32),
                                            skip_softmax=True)

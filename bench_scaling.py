@@ -81,14 +81,23 @@ def _child(n_devices: int) -> None:
         params, opt_state, buffers, cost, _ = epoch_fn(params, opt_state,
                                                        buffers, xs, ys, key)
     float(cost)
-    t0 = time.perf_counter()
-    for _ in range(TIMED):
-        params, opt_state, buffers, cost, _ = epoch_fn(params, opt_state,
-                                                       buffers, xs, ys, key)
-    float(cost)
-    elapsed = time.perf_counter() - t0
+    # Best-of-3 timed windows: the virtual-device points run on one
+    # contended CPU, and a single window is hostage to whatever else the
+    # host is doing (r03's retention read 645/166/122 tok/s/dev at 2/4/8
+    # with the 4-point below the 8-point).  Min-elapsed is the standard
+    # contended-environment estimator; the artifact stays labeled a
+    # contention-bound proxy either way.
+    elapsed = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(TIMED):
+            params, opt_state, buffers, cost, _ = epoch_fn(
+                params, opt_state, buffers, xs, ys, key)
+        float(cost)
+        elapsed = min(elapsed, time.perf_counter() - t0)
     tokens = TIMED * STEPS * batch * BLOCK
-    rec = {"devices": n_devices, "tokens_per_sec": tokens / elapsed}
+    rec = {"devices": n_devices, "tokens_per_sec": tokens / elapsed,
+           "timing": "best_of_3_windows"}
 
     # Mesh-aware /evaluate/ throughput: the forward-only cost program over
     # the same data-sharded batch (evaluate_model routes through
@@ -96,11 +105,13 @@ def _child(n_devices: int) -> None:
     # process regardless of host capacity).
     ex, ey = xs[0], ys[0]
     float(arch.eval_cost_fn(params, buffers, ex, ey))  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(TIMED):
-        float(arch.eval_cost_fn(params, buffers, ex, ey))
-    rec["eval_tokens_per_sec"] = (TIMED * batch * BLOCK
-                                  / (time.perf_counter() - t0))
+    eval_elapsed = float("inf")
+    for _ in range(3):  # best-of-3, same contention rationale as above
+        t0 = time.perf_counter()
+        for _ in range(TIMED):
+            float(arch.eval_cost_fn(params, buffers, ex, ey))
+        eval_elapsed = min(eval_elapsed, time.perf_counter() - t0)
+    rec["eval_tokens_per_sec"] = TIMED * batch * BLOCK / eval_elapsed
 
     if os.environ.get("BENCH_SCALING_ZERO") == "1" and n_devices > 1:
         # ZeRO ladder memory: bytes of params + optimizer state resident on
@@ -220,12 +231,15 @@ def _comm_child() -> None:
             params, opt_state, buffers, cost, _ = epoch_fn(
                 params, opt_state, buffers, xs, ys, key)
         float(cost)
-        t0 = time.perf_counter()
-        for _ in range(TIMED):
-            params, opt_state, buffers, cost, _ = epoch_fn(
-                params, opt_state, buffers, xs, ys, key)
-        float(cost)
-        step_ms = (time.perf_counter() - t0) * 1000 / (TIMED * STEPS)
+        best = float("inf")
+        for _ in range(3):  # best-of-3: see the retention-point comment
+            t0 = time.perf_counter()
+            for _ in range(TIMED):
+                params, opt_state, buffers, cost, _ = epoch_fn(
+                    params, opt_state, buffers, xs, ys, key)
+            float(cost)
+            best = min(best, time.perf_counter() - t0)
+        step_ms = best * 1000 / (TIMED * STEPS)
         return stats, step_ms
 
     configs = [

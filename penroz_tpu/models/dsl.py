@@ -246,6 +246,8 @@ class Mapper:
             return _neox_dsl_from_config(config, n_layer_override)
         if model_type == "phi":
             return _phi_dsl_from_config(config, n_layer_override)
+        if model_type == "olmo2":
+            return _olmo2_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -276,6 +278,8 @@ class Mapper:
             return _map_neox_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") == "phi":
             return _map_phi_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "olmo2":
+            return _map_olmo2_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") in _LLAMA_FAMILY:
             return _map_llama_state_dict(state_dict, n_layer, config)
         return _map_gemma_state_dict(state_dict, n_layer, config)
@@ -644,13 +648,15 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                 # module exactly (HF MixtralSparseMoeBlock: softmax over
                 # ALL experts -> top-k -> renormalize); dense dispatch
                 # reproduces it bit-for-bit, capacity dispatch stays an
-                # opt-in.  The aux coefficient is normalized to HF's
-                # load_balancing_loss_func semantics: HF computes ONE loss
-                # averaged over all layers' tokens with expert fractions
-                # summed over the top-k slots (uniform minimum top_k),
-                # while our Switch form divides fractions by top_k
-                # (minimum 1) and sums per layer — coef × top_k / n_layers
-                # makes the total balance gradient equal.
+                # opt-in.  The aux coefficient is rescaled toward HF's
+                # load_balancing_loss_func: HF computes ONE loss from
+                # fractions POOLED across all layers with top-k-summed
+                # slots (uniform minimum top_k); our Switch form divides
+                # by top_k (minimum 1) and applies per layer.  coef ×
+                # top_k / n_layers matches the coefficient SCALE (equal
+                # when routing statistics are layer-uniform); the
+                # per-layer-vs-pooled structural difference remains — the
+                # Switch formulation, not a bug.
                 ({"moe": {"in_features": d,
                           "intermediate_size": int(cfg.intermediate_size),
                           "num_experts": int(cfg.num_local_experts),
@@ -855,6 +861,102 @@ def _map_phi_state_dict(sd: dict, n_layer: int, config=None) -> dict:
         out[f"layers.{base + n_layer}.{name}"] = \
             sd[f"model.final_layernorm.{name}"]
         out[f"layers.{base + n_layer + 1}.{name}"] = sd[f"lm_head.{name}"]
+    return out
+
+
+def _olmo2_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """OLMo-2 HF config → layer DSL.
+
+    OLMo-2 blocks are POST-norm only (HF ``modeling_olmo2``: no input
+    norm; ``post_attention_layernorm`` wraps the attention branch output
+    and ``post_feedforward_layernorm`` the MLP's, each BEFORE the residual
+    add), with flat q/k RMS normalization — ``Olmo2Attention`` normalizes
+    the whole (H·hd) projection before the head split (``qk_norm_scope=
+    'flat'``, unlike Qwen3's per-head norm).  Expressed with the generic
+    residual container: each branch ends in its rmsnorm.
+    """
+    cfg = _llama_text_config(config)
+    scaling = getattr(cfg, "rope_scaling", None) or None
+    if scaling and (scaling.get("rope_type") or scaling.get("type")
+                    or "default") != "default":
+        # Same guard as the llama/neox builders: importing with an active
+        # scaling silently ignored would produce wrong logits.
+        raise ValueError(
+            f"olmo2 rope_scaling {scaling!r} is not supported; importing "
+            "would produce wrong logits")
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    kv = int(getattr(cfg, "num_key_value_heads", None) or heads)
+    hd = d // heads
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "rms_norm_eps", 1e-6))
+    rope = float(getattr(cfg, "rope_theta", 10000.0) or 10000.0)
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    bias = bool(getattr(cfg, "attention_bias", False) or False)
+    inter = int(cfg.intermediate_size)
+    activation = getattr(cfg, "hidden_act", "silu")
+
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for _ in range(n):
+        layers.append({"residual": [
+            {"sequential": [
+                {"linear": {"in_features": d,
+                            "out_features": (heads + 2 * kv) * hd,
+                            "bias": bias}},
+                {"attention": {"num_heads": heads, "num_kv_heads": kv,
+                               "rope_theta": rope, "head_dim": hd,
+                               "dropout": attn_drop, "qk_norm": True,
+                               "qk_norm_scope": "flat",
+                               "qk_norm_eps": eps}},
+                {"linear": {"in_features": heads * hd, "out_features": d,
+                            "bias": bias}},
+                {"rmsnorm": {"normalized_shape": d, "eps": eps}}]},
+            {"sequential": [
+                {"gatedmlp": {"in_features": d, "intermediate_size": inter,
+                              "activation": activation}},
+                {"rmsnorm": {"normalized_shape": d, "eps": eps}}]}]})
+    layers += [
+        {"rmsnorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_olmo2_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """OLMo-2 HF keys → ours: QKV concat, flat q/k-norm weights onto the
+    attention module, branch-tail norms from post_attention/
+    post_feedforward_layernorm, tied-or-untied lm_head."""
+    out = {"layers.0.weight": sd["model.embed_tokens.weight"]}
+    for i in range(n_layer):
+        src = f"model.layers.{i}"
+        dst = f"layers.{1 + i}"
+        out[f"{dst}.0.0.weight"] = np.concatenate(
+            [np.asarray(sd[f"{src}.self_attn.q_proj.weight"]),
+             np.asarray(sd[f"{src}.self_attn.k_proj.weight"]),
+             np.asarray(sd[f"{src}.self_attn.v_proj.weight"])], axis=0)
+        if f"{src}.self_attn.q_proj.bias" in sd:
+            out[f"{dst}.0.0.bias"] = np.concatenate(
+                [np.asarray(sd[f"{src}.self_attn.q_proj.bias"]),
+                 np.asarray(sd[f"{src}.self_attn.k_proj.bias"]),
+                 np.asarray(sd[f"{src}.self_attn.v_proj.bias"])], axis=0)
+        out[f"{dst}.0.1.q_norm.weight"] = sd[f"{src}.self_attn.q_norm.weight"]
+        out[f"{dst}.0.1.k_norm.weight"] = sd[f"{src}.self_attn.k_norm.weight"]
+        out[f"{dst}.0.2.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
+        if f"{src}.self_attn.o_proj.bias" in sd:
+            out[f"{dst}.0.2.bias"] = sd[f"{src}.self_attn.o_proj.bias"]
+        out[f"{dst}.0.3.weight"] = sd[f"{src}.post_attention_layernorm.weight"]
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            out[f"{dst}.1.0.{proj}.weight"] = sd[f"{src}.mlp.{proj}.weight"]
+        out[f"{dst}.1.1.weight"] = \
+            sd[f"{src}.post_feedforward_layernorm.weight"]
+    out[f"layers.{1 + n_layer}.weight"] = sd["model.norm.weight"]
+    out[f"layers.{2 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd["model.embed_tokens.weight"])
     return out
 
 

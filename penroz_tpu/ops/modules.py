@@ -768,16 +768,23 @@ class CausalSelfAttention(Module):
                  rope_scaling: Optional[dict] = None,
                  sliding_window: Optional[int] = None,
                  rope_pct: Optional[float] = None,
-                 qk_norm: bool = False, qk_norm_eps: float = 1e-6):
+                 qk_norm: bool = False, qk_norm_eps: float = 1e-6,
+                 qk_norm_scope: str = "head"):
         if sliding_window is not None and int(sliding_window) < 1:
             raise ValueError(f"sliding_window must be >= 1, "
                              f"got {sliding_window}")
-        # Per-head RMS normalization of q and k before RoPE (Qwen3/OLMo-2
-        # style: HF Qwen3Attention applies RMSNorm(head_dim) to the
-        # reshaped projections).  Learned (head_dim,) weights, so the
-        # module needs head_dim at build time.
+        # RMS normalization of q and k before RoPE.  scope="head" (Qwen3:
+        # RMSNorm(head_dim) applied per head after the reshape, learned
+        # (head_dim,) weights); scope="flat" (OLMo-2: RMSNorm over the
+        # WHOLE projection before the head split, learned (H*hd,) /
+        # (KV*hd,) weights).  Either way the module needs head_dim at
+        # build time to size the weights.
+        if qk_norm_scope not in ("head", "flat"):
+            raise ValueError(f"qk_norm_scope must be 'head' or 'flat', "
+                             f"got {qk_norm_scope!r}")
         self.qk_norm = bool(qk_norm)
         self.qk_norm_eps = float(qk_norm_eps)
+        self.qk_norm_scope = qk_norm_scope
         if self.qk_norm and head_dim is None:
             raise ValueError("qk_norm=True requires an explicit head_dim")
         self.sliding_window = (int(sliding_window)
@@ -835,16 +842,15 @@ class CausalSelfAttention(Module):
     def param_shapes(self):
         if not self.qk_norm:
             return {}
+        if self.qk_norm_scope == "flat":
+            return {"q_norm.weight": (self.num_heads * self.head_dim,),
+                    "k_norm.weight": (self.num_kv_heads * self.head_dim,)}
         return {"q_norm.weight": (self.head_dim,),
                 "k_norm.weight": (self.head_dim,)}
 
     def init(self, rng):
-        if not self.qk_norm:
-            return {}
-        return {self.key("q_norm.weight"): jnp.ones((self.head_dim,),
-                                                    jnp.float32),
-                self.key("k_norm.weight"): jnp.ones((self.head_dim,),
-                                                    jnp.float32)}
+        return {self.key(name): jnp.ones(shape, jnp.float32)
+                for name, shape in self.param_shapes().items()}
 
     def _head_rmsnorm(self, x, w):
         """fp32 RMS over the head dim, learned multiplicative weight."""
@@ -859,13 +865,19 @@ class CausalSelfAttention(Module):
         q_dim = self.num_heads * head_dim
         kv_dim = self.num_kv_heads * head_dim
 
-        q = qkv[..., :q_dim].reshape(B, T, self.num_heads, head_dim)
-        k = qkv[..., q_dim:q_dim + kv_dim].reshape(B, T, self.num_kv_heads, head_dim)
+        q_flat = qkv[..., :q_dim]
+        k_flat = qkv[..., q_dim:q_dim + kv_dim]
+        if self.qk_norm and self.qk_norm_scope == "flat":
+            # OLMo-2: normalize the whole projection BEFORE the head split.
+            q_flat = self._head_rmsnorm(q_flat, self._p(ctx, "q_norm.weight"))
+            k_flat = self._head_rmsnorm(k_flat, self._p(ctx, "k_norm.weight"))
+        q = q_flat.reshape(B, T, self.num_heads, head_dim)
+        k = k_flat.reshape(B, T, self.num_kv_heads, head_dim)
         v = qkv[..., q_dim + kv_dim:].reshape(B, T, self.num_kv_heads, head_dim)
         # to (B, H, T, D)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
-        if self.qk_norm:
+        if self.qk_norm and self.qk_norm_scope == "head":
             q = self._head_rmsnorm(q, self._p(ctx, "q_norm.weight"))
             k = self._head_rmsnorm(k, self._p(ctx, "k_norm.weight"))
 

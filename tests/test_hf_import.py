@@ -642,7 +642,7 @@ def test_mixtral_import_logit_parity_and_generate(workdir):
     """Mixtral: sparse-MoE MLPs land on our stacked-expert module (dense
     dispatch reproduces HF's softmax->top-k->renormalize routing exactly);
     per-expert w1/w3/w2 stack onto gate/up/down, router gate copies, and
-    router_aux_loss_coef carries into the DSL for fine-tuning parity."""
+    router_aux_loss_coef rescales (x top_k / n_layers) onto our per-layer Switch form."""
     config, torch_model = _tiny_mixtral()
     tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
     with torch.no_grad():
@@ -668,3 +668,52 @@ def test_mixtral_import_logit_parity_and_generate(workdir):
     toks = model.generate_tokens([[1, 2, 3]], block_size=16,
                                  max_new_tokens=6, temperature=0.0)
     assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def _tiny_olmo2():
+    from transformers import Olmo2Config, Olmo2ForCausalLM
+    config = Olmo2Config(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                         num_attention_heads=2, num_key_value_heads=1,
+                         intermediate_size=64, max_position_embeddings=64,
+                         rope_theta=10000.0, attention_dropout=0.0,
+                         tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, Olmo2ForCausalLM(config).eval()
+
+
+def test_olmo2_import_logit_parity_and_generate(workdir):
+    """OLMo-2: post-norm-only blocks (branch-tail rmsnorms, no input
+    norms) and FLAT q/k RMS normalization over the whole projection before
+    the head split — cached greedy generate must match the uncached argmax
+    rollout through that path."""
+    config, torch_model = _tiny_olmo2()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "olmo2-tiny")
+    assert model.status["code"] == "Imported"
+    assert any("q_norm" in k for k in model.params)
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_olmo2_rope_scaling_rejected():
+    from transformers import Olmo2Config
+    from penroz_tpu.models.dsl import Mapper
+    config = Olmo2Config(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                         num_attention_heads=2,
+                         rope_scaling={"type": "linear", "factor": 2.0})
+    with pytest.raises(ValueError, match="olmo2 rope_scaling"):
+        Mapper.from_hf_config(config)

@@ -55,18 +55,23 @@ DECODE_CHUNK_ENV = "PENROZ_DECODE_CHUNK"
 _TRAIN_SEQ: dict = {}
 
 
-def _check_pipe_composition(pipe: int, seq: int, expert: int) -> None:
+def _check_pipe_composition(pipe: int, seq: int) -> None:
     """The GPipe schedule composes with data parallelism (its microbatch
-    spec shards rows over ``data``) and with tensor parallelism (stacked
-    leaves carry P(pipe, model, …) specs and the stage body leaves the
-    model axis GSPMD-automatic).  SP/EP inside a stage would additionally
-    need the ring/dispatch collectives threaded through the schedule —
-    refuse loudly rather than silently mis-shard.  Shared by the single-
-    and multi-host mesh builders so the contract cannot diverge."""
-    if pipe > 1 and (seq > 1 or expert > 1):
+    spec shards rows over ``data``), with tensor parallelism, AND with
+    expert parallelism: stacked leaves carry P(pipe, <tp/ep>, …) specs and
+    the stage body leaves the model/expert axes GSPMD-automatic, so XLA
+    inserts the TP collectives and the MoE dispatch/combine psums inside
+    each stage (EP×pipe parity: costs and router fractions match the
+    sequential run to fp tolerance — test_train_model_pipe_composes_with_
+    expert_parallel).  Sequence parallelism stays refused: ring attention
+    runs its own shard_map over the sequence axis, which cannot nest
+    inside the schedule's — refuse loudly rather than silently mis-shard.
+    Shared by the single- and multi-host mesh builders so the contract
+    cannot diverge."""
+    if pipe > 1 and seq > 1:
         raise RuntimeError(
-            "PENROZ_MESH_PIPE>1 composes with data and tensor "
-            "parallelism only; unset PENROZ_MESH_SEQUENCE/EXPERT")
+            "PENROZ_MESH_PIPE>1 composes with data, tensor, and expert "
+            "parallelism only; unset PENROZ_MESH_SEQUENCE")
 
 
 def _chunk_budget() -> int:
@@ -1196,7 +1201,7 @@ class NeuralNetworkModel:
         if fold_pipe:
             pipe = 1
         else:
-            _check_pipe_composition(pipe, seq, expert)
+            _check_pipe_composition(pipe, seq)
         n = len(devices)
         if n <= 1 or n % (model * seq * expert * pipe):
             return None
@@ -1272,7 +1277,7 @@ class NeuralNetworkModel:
         if fold_pipe:
             pipe = 1
         if pipe > 1:
-            _check_pipe_composition(pipe, seq, expert)
+            _check_pipe_composition(pipe, seq)
             if pipe % world and world % pipe:
                 # Stages are contiguous global device ranges (pipe
                 # outermost); alignment with process boundaries keeps each

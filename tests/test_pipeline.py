@@ -313,6 +313,43 @@ def test_train_model_pipe_with_moe_blocks(workdir, toy_shards, monkeypatch):
                                    atol=8e-3, err_msg=k)
 
 
+def test_train_model_pipe_composes_with_expert_parallel(workdir, toy_shards,
+                                                        monkeypatch):
+    """pipe=2 × expert=2 × data=2: the expert axis stays GSPMD-automatic
+    inside the stage body, so the MoE dispatch/combine psums ride inside
+    each stage like TP's collectives.  Costs must match the sequential run
+    to fp noise and router fractions to fp tolerance (the aux channel's
+    fractions are row-means, untouched by expert sharding)."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    from penroz_tpu.parallel import mesh as mesh_lib
+    optim = {"sgd": {"lr": 0.1}}
+    layers = _moe_gpt_layers()
+
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    monkeypatch.setenv("PENROZ_MESH_EXPERT", "2")
+    pp = NeuralNetworkModel("ppep", Mapper(layers, optim)).to_device("cpu")
+    mesh = pp._training_mesh(8, 16)
+    assert mesh is not None and mesh.shape[mesh_lib.PIPE_AXIS] == 2 \
+        and mesh.shape[mesh_lib.EXPERT_AXIS] == 2
+    pp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                   step_size=8)
+    assert pp.status["code"] == "Trained", pp.status
+    monkeypatch.delenv("PENROZ_MESH_PIPE")
+    monkeypatch.delenv("PENROZ_MESH_EXPERT")
+
+    monkeypatch.setenv("PENROZ_TRAIN_MESH", "0")
+    seq = NeuralNetworkModel("seqep", Mapper(layers, optim)).to_device("cpu")
+    seq.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                    step_size=8)
+    for p_run, s_run in zip(pp.progress, seq.progress):
+        np.testing.assert_allclose(p_run["cost"], s_run["cost"], rtol=2e-3)
+    for k in (k for k in pp.buffers if "router_fraction" in k):
+        np.testing.assert_allclose(np.asarray(pp.buffers[k], np.float32),
+                                   np.asarray(seq.buffers[k], np.float32),
+                                   atol=1e-6, err_msg=k)
+
+
 @pytest.mark.parametrize("knob", ["PENROZ_WUS", "PENROZ_FSDP"])
 def test_train_model_pipe_composes_with_zero_ladder(workdir, toy_gpt_layers,
                                                     toy_shards, monkeypatch,
@@ -461,14 +498,14 @@ def test_train_pipe_refusals(workdir, toy_gpt_layers, toy_shards,
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
     optim = {"sgd": {"lr": 0.1}}
-    # pipe × SP/EP is refused loudly, not silently mis-sharded (pipe × TP
-    # composes as of round 4 — test_train_model_pipe_composes_with_tensor_
-    # parallel covers it)
+    # pipe × SP is refused loudly, not silently mis-sharded (pipe × TP/EP
+    # compose as of round 4 — test_train_model_pipe_composes_with_tensor_
+    # parallel / _expert_parallel cover them)
     monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
     monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
     model = NeuralNetworkModel("ppref", Mapper(toy_gpt_layers, optim))
     model.to_device("cpu")
-    with pytest.raises(RuntimeError, match="tensor parallelism only"):
+    with pytest.raises(RuntimeError, match="unset PENROZ_MESH_SEQUENCE"):
         model._training_mesh(micro_batch=8, block_size=16)
     monkeypatch.delenv("PENROZ_MESH_SEQUENCE")
     # (the ZeRO ladder composes with the stacked layout as of round 4 —

@@ -12,6 +12,20 @@ in-flight activations are masked at the output buffer, never observed.
 The whole schedule is differentiable (``ppermute`` has a transpose), so the
 same function sits under ``jax.grad`` for pipeline-parallel training.
 
+On 1F1B: a hand-scheduled one-forward-one-backward interleave bounds
+in-flight activations to ``P`` microbatches; this implementation reaches
+the same memory class compositionally instead.  ``remat="block"`` bounds
+per-tick residency to block *inputs* (backward replays internals in
+reverse schedule order — itself a pipelined schedule), and the grad-accum
+scan above this function already chunks a step into micro-steps whose
+activations are released between chunks: ``PENROZ_PIPE_MICROBATCHES``
+trades bubble fraction ``(P-1)/(M+P-1)`` against per-chunk activation
+memory exactly the way 1F1B's schedule depth does, with the compiler
+owning the interleave.  A literal 1F1B would additionally need the loss
+fused per-microbatch inside the schedule (cotangents before the last
+microbatch finishes) — a restructuring whose win over remat+chunking is
+a constant factor, not a complexity class.
+
 No reference equivalent (the reference's only strategy is single-node DDP,
 SURVEY.md §2.4) — this is capability extension shaped by the mesh design:
 PP is a sharding of the *depth* dimension the way TP shards width.

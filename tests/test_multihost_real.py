@@ -103,7 +103,10 @@ def _run_pair(tmp_path, model_id: str, extra_env: dict, epochs: int = 2,
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=420)
+            # 600s: these workers compile real multi-process programs on a
+            # shared CPU that may concurrently run other suites/benches —
+            # 420s flaked under load (r04) with both workers healthy.
+            out, _ = p.communicate(timeout=600)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()

@@ -63,15 +63,20 @@ def _check_pipe_composition(pipe: int, seq: int) -> None:
     inserts the TP collectives and the MoE dispatch/combine psums inside
     each stage (EP×pipe parity: costs and router fractions match the
     sequential run to fp tolerance — test_train_model_pipe_composes_with_
-    expert_parallel).  Sequence parallelism stays refused: ring attention
-    runs its own shard_map over the sequence axis, which cannot nest
-    inside the schedule's — refuse loudly rather than silently mis-shard.
-    Shared by the single- and multi-host mesh builders so the contract
-    cannot diverge."""
-    if pipe > 1 and seq > 1:
+    expert_parallel).  Sequence parallelism composes in Ulysses mode only
+    (PENROZ_SP_MODE=alltoall): the schedule's shard_map binds the sequence
+    axis as a manual axis and the attention modules run the all-to-all
+    body on it directly (Ctx.sp_manual_axis).  Ring attention stays
+    refused — it wraps its own shard_map, which cannot nest inside the
+    schedule's; refuse loudly rather than silently mis-shard.  Shared by
+    the single- and multi-host mesh builders so the contract cannot
+    diverge."""
+    if pipe > 1 and seq > 1 and \
+            os.environ.get("PENROZ_SP_MODE", "ring") != "alltoall":
         raise RuntimeError(
-            "PENROZ_MESH_PIPE>1 composes with data, tensor, and expert "
-            "parallelism only; unset PENROZ_MESH_SEQUENCE")
+            "PENROZ_MESH_PIPE>1 composes with sequence parallelism only "
+            "in Ulysses mode; set PENROZ_SP_MODE=alltoall or unset "
+            "PENROZ_MESH_SEQUENCE")
 
 
 def _chunk_budget() -> int:
@@ -461,9 +466,14 @@ class CompiledArch:
         # gpipe_apply); blocks without stateful modules skip the plumbing.
         with_aux = any(isinstance(sub, M.MixtureOfExperts)
                        for sub in self.mods[start].walk())
+        # Ulysses SP inside the stages: the sequence axis joins the
+        # schedule's manual set and attention runs the all-to-all body on
+        # it directly (validated at layout entry: alltoall mode, divisible
+        # heads, no MoE blocks).
+        seq_shard = pmesh.shape[mesh_lib.SEQ_AXIS] > 1
         block_fn = pipeline.block_fn_from_arch(
             self, start, training=True, compute_dtype=compute_dtype,
-            platform=platform, with_aux=with_aux)
+            platform=platform, with_aux=with_aux, sp_manual=seq_shard)
         pre = self.mods[:start]
         post = self.mods[start + count:]
 
@@ -477,7 +487,8 @@ class CompiledArch:
                        if k.startswith("__pipe__.")}
             res = pipeline.gpipe_apply(block_fn, stacked, h, pmesh, micro,
                                        rng=jax.random.fold_in(rng, 0x9e3779),
-                                       remat=pipe_remat, with_aux=with_aux)
+                                       remat=pipe_remat, with_aux=with_aux,
+                                       seq_shard=seq_shard)
             if with_aux:
                 h, aux_sums = res
                 # Per-(layer, microbatch) sums -> mean over microbatches.
@@ -1356,6 +1367,21 @@ class NeuralNetworkModel:
         # schedule's aux channel (gpipe_apply with_aux).  BatchNorm stays
         # refused — its running stats are read AND written per microbatch,
         # a sequential dependency the parallel schedule cannot honor.
+        seq = mesh.shape[mesh_lib.SEQ_AXIS]
+        if seq > 1 and any(
+                jnp.issubdtype(v.dtype, jnp.floating)
+                and v.dtype != jnp.float32 for v in self.params.values()):
+            # XLA CHECK-fails ("Invalid binary instruction opcode copy",
+            # hlo_instruction.cc) compiling the manual pipe×seq program
+            # with bf16 parameter leaves — an UNCATCHABLE process abort,
+            # reproduced on the CPU backend with a minimal rope stack.
+            # Refuse until the toolchain moves; fp32 storage (the
+            # non-imported default) is unaffected.
+            raise RuntimeError(
+                "PENROZ_MESH_PIPE>1 with PENROZ_MESH_SEQUENCE>1 requires "
+                "float32 parameter storage (bf16-imported models trip an "
+                "XLA compiler abort on this composition); convert the "
+                "model or drop one axis")
         for i in range(start, start + count):
             for sub in self.arch.mods[i].walk():
                 if isinstance(sub, M.BatchNorm1d):
@@ -1364,6 +1390,30 @@ class NeuralNetworkModel:
                         f"{type(sub).__name__}: running statistics are "
                         f"read and written per microbatch, which the "
                         f"parallel schedule cannot order")
+                if seq > 1 and isinstance(sub, M.MixtureOfExperts):
+                    raise RuntimeError(
+                        "PENROZ_MESH_PIPE>1 with PENROZ_MESH_SEQUENCE>1 "
+                        "cannot pipeline MoE blocks yet: the aux channel's "
+                        "reductions do not fold the sequence axis")
+                if seq > 1 and isinstance(sub, M.CausalSelfAttention):
+                    from penroz_tpu.parallel import alltoall_attention as a2a
+                    if not a2a.alltoall_supported(sub.num_heads,
+                                                  sub.num_kv_heads, mesh):
+                        raise RuntimeError(
+                            f"PENROZ_MESH_PIPE>1 with sequence axis {seq}: "
+                            f"Ulysses SP needs head counts divisible by "
+                            f"the axis (Hq={sub.num_heads}, "
+                            f"Hkv={sub.num_kv_heads})")
+                    if sub.dropout > 0.0:
+                        # The manual Ulysses branch requires dropout-free
+                        # attention (same constraint as the sp_mesh path),
+                        # but here falling through would run SHARD-LOCAL
+                        # attention — silently wrong, so refuse.
+                        raise RuntimeError(
+                            "PENROZ_MESH_PIPE>1 with PENROZ_MESH_SEQUENCE"
+                            ">1 cannot pipeline attention with dropout>0: "
+                            "the sequence-parallel attention path is "
+                            "dropout-free")
         base = batch_size // data
         env_m = os.environ.get("PENROZ_PIPE_MICROBATCHES", "")
         if env_m:

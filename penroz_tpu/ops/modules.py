@@ -47,7 +47,7 @@ class Ctx:
 
     def __init__(self, params, buffers=None, *, training=False, rng=None,
                  kv=None, pos_offset=None, compute_dtype=None, sp_mesh=None,
-                 platform=None, sp_mode="ring"):
+                 platform=None, sp_mode="ring", sp_manual_axis=None):
         self.params = params
         self.buffers = buffers or {}
         self.training = training
@@ -57,6 +57,10 @@ class Ctx:
         self.compute_dtype = compute_dtype
         self.sp_mesh = sp_mesh  # Mesh with a >1 'sequence' axis → SP attn
         self.sp_mode = sp_mode  # 'ring' (ppermute) | 'alltoall' (Ulysses)
+        # Set when the caller is ALREADY inside a manual region binding the
+        # sequence axis (GPipe schedule with seq manual): attention calls
+        # the Ulysses body directly instead of wrapping its own shard_map.
+        self.sp_manual_axis = sp_manual_axis
         self.platform = platform  # execution platform hint for kernel gates
         self.buffer_updates = {}
         self.aux_losses = []  # auxiliary training losses (e.g. MoE balance)
@@ -883,6 +887,11 @@ class CausalSelfAttention(Module):
 
         offset = ctx.offset()
         if self.rope_theta is not None:
+            if ctx.sp_manual_axis is not None:
+                # Manual sequence sharding (GPipe×Ulysses): this shard
+                # holds rows r·T_local..(r+1)·T_local-1 of the global
+                # sequence — rotate with GLOBAL positions, not 0..T_local.
+                offset = offset + jax.lax.axis_index(ctx.sp_manual_axis) * T
             rotary_dim = None
             if self.rope_pct is not None and self.rope_pct < 1.0:
                 rotary_dim = int(head_dim * self.rope_pct) // 2 * 2
@@ -927,6 +936,14 @@ class CausalSelfAttention(Module):
                                                 platform=ctx.platform,
                                                 window=self.sliding_window,
                                                 **scales)
+        elif ctx.sp_manual_axis is not None and dropout_rate == 0.0:
+            # Inside the GPipe schedule with the sequence axis manual: the
+            # Ulysses body runs on the ambient axis (a nested shard_map is
+            # impossible); divisibility is validated at layout entry.
+            from penroz_tpu.parallel import alltoall_attention as a2a
+            out = a2a.alltoall_attention_manual(
+                q, k, v, axis_name=ctx.sp_manual_axis,
+                window=self.sliding_window, platform=ctx.platform)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
             # Sequence-parallel training over ICI (windowed when the model
             # slides — long-context SP is exactly where windows matter).

@@ -38,6 +38,19 @@ def alltoall_supported(num_heads: int, num_kv_heads: int, mesh: Mesh,
     return num_heads % n == 0 and num_kv_heads % n == 0
 
 
+def alltoall_attention_manual(q, k, v, *, axis_name: str = SEQ_AXIS,
+                              window=None, platform=None):
+    """Ulysses attention for callers ALREADY inside a manual region that
+    binds ``axis_name`` (e.g. the GPipe schedule's shard_map with the
+    sequence axis manual) — same math as :func:`alltoall_attention`, minus
+    the shard_map wrapper (nesting one inside another is not possible).
+    q/k/v: per-shard (B, H, T_local, D) blocks."""
+    return _alltoall_local(q, k, v, axis_name=axis_name,
+                           window=int(window) if window is not None
+                           else None,
+                           platform=platform)
+
+
 def _alltoall_local(q, k, v, *, axis_name: str, window, platform):
     """Per-shard body. q/k/v: (B, H, T_local, D) sequence-sharded blocks."""
     from penroz_tpu.ops import attention as attn_ops

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from penroz_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+from penroz_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, SEQ_AXIS
 
 
 def pipeline_block_range(layers_dsl: list[dict]) -> tuple[int, int]:
@@ -91,16 +91,21 @@ def unstack_block_params(stacked: dict, block_indices, prefix="layers") -> dict:
     return out
 
 
-def gpipe_spec(mesh):
-    """(stacked-params spec, microbatch spec, output spec) for gpipe_apply."""
+def gpipe_spec(mesh, seq_shard: bool = False):
+    """(stacked-params spec, microbatch spec, output spec) for gpipe_apply.
+
+    ``seq_shard=True`` additionally shards the microbatch T dim over the
+    ``sequence`` axis (Ulysses SP inside the stages)."""
     param_spec = P(PIPE_AXIS)
-    mb_spec = P(None, DATA_AXIS)     # (M, B_mb, T, D): batch over data
+    # (M, B_mb, T, D): batch over data (+ T over sequence when SP)
+    mb_spec = (P(None, DATA_AXIS, SEQ_AXIS) if seq_shard
+               else P(None, DATA_AXIS))
     return param_spec, mb_spec, mb_spec
 
 
 def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
                 num_microbatches: int, rng=None, remat: str = "none",
-                with_aux: bool = False):
+                with_aux: bool = False, seq_shard: bool = False):
     """Apply ``L`` stacked blocks to ``x`` with a ``P``-stage GPipe schedule.
 
     ``block_fn(block_params: dict, h) -> h`` applies ONE block given its
@@ -139,7 +144,16 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     pmean'd over the data axis (again exact for row-mean statistics; the
     nonlinear balance loss becomes the mean of per-shard losses — the
     standard per-group/local Switch formulation).
+
+    ``seq_shard=True``: the ``sequence`` axis joins the manual set and the
+    microbatch T dim shards over it — ``block_fn`` must then handle its
+    own sequence-parallel attention on the ambient axis (the Ctx's
+    ``sp_manual_axis``, Ulysses all-to-alls inside the stage).  Not
+    composable with ``with_aux`` (the aux pmean would need the seq axis
+    folded in; refused upstream).
     """
+    if seq_shard and with_aux:
+        raise ValueError("seq_shard does not compose with with_aux")
     if remat not in ("none", "block"):
         raise ValueError(f"remat={remat!r}: expected 'none' or 'block'")
     if remat == "block":
@@ -159,8 +173,10 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     mbs = x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:])
     m = num_microbatches
 
-    param_spec, mb_spec, out_spec = gpipe_spec(mesh)
+    param_spec, mb_spec, out_spec = gpipe_spec(mesh, seq_shard=seq_shard)
     in_specs = (jax.tree.map(lambda _: param_spec, stacked_params), mb_spec)
+    manual_axes = ({PIPE_AXIS, DATA_AXIS, SEQ_AXIS} if seq_shard
+                   else {PIPE_AXIS, DATA_AXIS})
 
     aux_struct = None
     if with_aux:
@@ -189,6 +205,12 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
                     key = jax.random.fold_in(
                         jax.random.fold_in(
                             rng, stage * layers_per_stage + idx), t)
+                    if seq_shard:
+                        # Distinct dropout streams per sequence shard —
+                        # without the fold every shard would reuse one
+                        # mask pattern across different T positions.
+                        key = jax.random.fold_in(
+                            key, jax.lax.axis_index(SEQ_AXIS))
                     res = block_fn(pl, hh, key)
                 if with_aux:
                     return res
@@ -259,8 +281,7 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
         out_specs = out_spec
     res = jax.shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs,
-                        axis_names={PIPE_AXIS, DATA_AXIS})(stacked_params,
-                                                           mbs)
+                        axis_names=manual_axes)(stacked_params, mbs)
     if not with_aux:
         return res.reshape(batch, *x.shape[1:])
     out, sums = res
@@ -269,7 +290,7 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
 
 def block_fn_from_arch(arch, block_index: int, *, training=False,
                        compute_dtype=None, platform=None,
-                       with_aux: bool = False):
+                       with_aux: bool = False, sp_manual: bool = False):
     """``block_fn`` for :func:`gpipe_apply` from one bound DSL block module.
 
     Uses the module tree of block ``block_index`` with params rebound from
@@ -292,7 +313,8 @@ def block_fn_from_arch(arch, block_index: int, *, training=False,
         ctx = M.Ctx({prefix + suffix: leaf
                      for suffix, leaf in block_params.items()},
                     training=training, rng=key,
-                    compute_dtype=compute_dtype, platform=platform)
+                    compute_dtype=compute_dtype, platform=platform,
+                    sp_manual_axis=SEQ_AXIS if sp_manual else None)
         out = mod.apply(h, ctx)
         if not with_aux:
             return out

@@ -221,9 +221,10 @@ def test_multihost_mesh_pipe_axis(workdir, monkeypatch, cpu_devices):
     with pytest.raises(RuntimeError, match="align with host boundaries"):
         model._multihost_mesh(micro_batch=8)
 
-    # SP composition refused, same contract as single-host (TP/EP compose)
+    # ring-SP composition refused, same contract as single-host
     monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
     monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
+    monkeypatch.setenv("PENROZ_SP_MODE", "ring")
     with pytest.raises(RuntimeError, match="unset PENROZ_MESH_SEQUENCE"):
         model._multihost_mesh(micro_batch=8)
 

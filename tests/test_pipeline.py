@@ -313,6 +313,142 @@ def test_train_model_pipe_with_moe_blocks(workdir, toy_shards, monkeypatch):
                                    atol=8e-3, err_msg=k)
 
 
+def test_train_model_pipe_composes_with_ulysses_sp(workdir, toy_gpt_layers,
+                                                   toy_shards, monkeypatch):
+    """pipe=2 × sequence=2 × data=2 with PENROZ_SP_MODE=alltoall: the
+    sequence axis joins the schedule's manual set, the microbatch T dim
+    shards over it, and the attention modules run the Ulysses all-to-all
+    body on the ambient axis.  Costs must match the sequential run."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    from penroz_tpu.parallel import mesh as mesh_lib
+    optim = {"sgd": {"lr": 0.1}}
+
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
+    monkeypatch.setenv("PENROZ_SP_MODE", "alltoall")
+    pp = NeuralNetworkModel("ppsp", Mapper(toy_gpt_layers,
+                                           optim)).to_device("cpu")
+    mesh = pp._training_mesh(8, 16)
+    assert mesh is not None and mesh.shape[mesh_lib.PIPE_AXIS] == 2 \
+        and mesh.shape[mesh_lib.SEQ_AXIS] == 2
+    pp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                   step_size=8)
+    assert pp.status["code"] == "Trained", pp.status
+    monkeypatch.delenv("PENROZ_MESH_PIPE")
+    monkeypatch.delenv("PENROZ_MESH_SEQUENCE")
+    monkeypatch.delenv("PENROZ_SP_MODE")
+
+    monkeypatch.setenv("PENROZ_TRAIN_MESH", "0")
+    seq = NeuralNetworkModel("seqsp", Mapper(toy_gpt_layers,
+                                             optim)).to_device("cpu")
+    seq.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                    step_size=8)
+    for p_run, s_run in zip(pp.progress, seq.progress):
+        np.testing.assert_allclose(p_run["cost"], s_run["cost"], rtol=2e-3)
+
+
+def _rope_gpt_layers(heads=4, attn_dropout=0.0):
+    """RoPE stack (no learned position embedding): positions enter ONLY
+    through the rotary embedding inside the blocks, so sequence-sharded
+    schedules must rotate with global offsets to match."""
+    d, vocab, hd = 32, 64, 8
+    blk = {"residual": [{"sequential": [
+        {"layernorm": {"normalized_shape": d}},
+        {"linear": {"in_features": d, "out_features": 3 * heads * hd}},
+        {"attention": {"num_heads": heads, "dropout": attn_dropout,
+                       "rope_theta": 10000.0}},
+        {"linear": {"in_features": heads * hd, "out_features": d}}]}]}
+    return ([{"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+              "normal": {"mean": 0.0, "std": 0.02}}]
+            + [blk, blk]
+            + [{"layernorm": {"normalized_shape": d}},
+               {"linear": {"in_features": d, "out_features": vocab,
+                           "bias": False}},
+               {"softmaxlast": {"dim": -1}}])
+
+
+def test_train_model_pipe_sp_rope_global_positions(workdir, toy_shards,
+                                                   monkeypatch):
+    """RoPE under pipe×seq must rotate with GLOBAL positions: each shard
+    holds rows r·T/seq.. of the sequence, so an offset of axis_index·T_loc
+    is folded in (without it every shard would encode positions 0..T/seq
+    and logits silently diverge).  Costs must match the sequential run."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"sgd": {"lr": 0.1}}
+    layers = _rope_gpt_layers()
+
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
+    monkeypatch.setenv("PENROZ_SP_MODE", "alltoall")
+    pp = NeuralNetworkModel("ppropesp",
+                            Mapper(layers, optim)).to_device("cpu")
+    pp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                   step_size=8)
+    assert pp.status["code"] == "Trained", pp.status
+    monkeypatch.delenv("PENROZ_MESH_PIPE")
+    monkeypatch.delenv("PENROZ_MESH_SEQUENCE")
+    monkeypatch.delenv("PENROZ_SP_MODE")
+
+    monkeypatch.setenv("PENROZ_TRAIN_MESH", "0")
+    seq = NeuralNetworkModel("seqrope",
+                             Mapper(layers, optim)).to_device("cpu")
+    seq.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                    step_size=8)
+    for p_run, s_run in zip(pp.progress, seq.progress):
+        np.testing.assert_allclose(p_run["cost"], s_run["cost"], rtol=2e-3)
+
+
+def test_pipe_sp_refusals(workdir, toy_gpt_layers, toy_shards, monkeypatch):
+    """Ring mode with pipe×seq refuses at mesh build; MoE blocks,
+    indivisible heads, and attention dropout refuse at layout entry."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"sgd": {"lr": 0.1}}
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
+    # pin ring mode: ambient PENROZ_SP_MODE=alltoall would defeat the
+    # refusal under test
+    monkeypatch.setenv("PENROZ_SP_MODE", "ring")
+    model = NeuralNetworkModel("spref", Mapper(toy_gpt_layers, optim))
+    model.to_device("cpu")
+    with pytest.raises(RuntimeError, match="Ulysses mode"):
+        model._training_mesh(micro_batch=8, block_size=16)
+
+    monkeypatch.setenv("PENROZ_SP_MODE", "alltoall")
+    moe = NeuralNetworkModel("sprefm", Mapper(_moe_gpt_layers(), optim))
+    moe.to_device("cpu")
+    mesh = moe._training_mesh(micro_batch=8, block_size=16)
+    with pytest.raises(RuntimeError, match="aux channel"):
+        moe._enter_pipe_layout(mesh, batch_size=8)
+
+    # heads (3) not divisible by the sequence axis (2)
+    odd = NeuralNetworkModel(
+        "sprefh", Mapper(_rope_gpt_layers(heads=3), optim)).to_device("cpu")
+    mesh = odd._training_mesh(micro_batch=8, block_size=16)
+    with pytest.raises(RuntimeError, match="divisible by"):
+        odd._enter_pipe_layout(mesh, batch_size=8)
+
+    # attention dropout > 0 would fall through to shard-local attention
+    dp = NeuralNetworkModel(
+        "sprefd", Mapper(_rope_gpt_layers(attn_dropout=0.1),
+                         optim)).to_device("cpu")
+    mesh = dp._training_mesh(micro_batch=8, block_size=16)
+    with pytest.raises(RuntimeError, match="dropout"):
+        dp._enter_pipe_layout(mesh, batch_size=8)
+
+    # bf16 parameter storage trips an UNCATCHABLE XLA abort on this
+    # composition (hlo_instruction.cc CHECK) — must refuse, not crash
+    bf = NeuralNetworkModel(
+        "sprefb", Mapper(_rope_gpt_layers(), optim)).to_device("cpu")
+    import jax.numpy as jnp
+    bf.params = {k: v.astype(jnp.bfloat16) for k, v in bf.params.items()}
+    mesh = bf._training_mesh(micro_batch=8, block_size=16)
+    with pytest.raises(RuntimeError, match="float32 parameter storage"):
+        bf._enter_pipe_layout(mesh, batch_size=8)
+
+
 def test_train_model_pipe_composes_with_expert_parallel(workdir, toy_shards,
                                                         monkeypatch):
     """pipe=2 × expert=2 × data=2: the expert axis stays GSPMD-automatic
@@ -498,11 +634,11 @@ def test_train_pipe_refusals(workdir, toy_gpt_layers, toy_shards,
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
     optim = {"sgd": {"lr": 0.1}}
-    # pipe × SP is refused loudly, not silently mis-sharded (pipe × TP/EP
-    # compose as of round 4 — test_train_model_pipe_composes_with_tensor_
-    # parallel / _expert_parallel cover them)
+    # pipe × ring-SP is refused loudly, not silently mis-sharded (pipe ×
+    # TP/EP/Ulysses-SP compose as of round 4)
     monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
     monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
+    monkeypatch.setenv("PENROZ_SP_MODE", "ring")
     model = NeuralNetworkModel("ppref", Mapper(toy_gpt_layers, optim))
     model.to_device("cpu")
     with pytest.raises(RuntimeError, match="unset PENROZ_MESH_SEQUENCE"):

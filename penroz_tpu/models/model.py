@@ -469,11 +469,18 @@ class CompiledArch:
         # Ulysses SP inside the stages: the sequence axis joins the
         # schedule's manual set and attention runs the all-to-all body on
         # it directly (validated at layout entry: alltoall mode, divisible
-        # heads, no MoE blocks).
+        # heads, dropout-free attention, fp32 parameter storage; MoE
+        # blocks compose — the aux channel folds the seq axis).
         seq_shard = pmesh.shape[mesh_lib.SEQ_AXIS] > 1
         block_fn = pipeline.block_fn_from_arch(
             self, start, training=True, compute_dtype=compute_dtype,
             platform=platform, with_aux=with_aux, sp_manual=seq_shard)
+        # Shape probe for the aux channel: the real block_fn references
+        # the manual sequence axis, unbound outside the schedule.
+        aux_probe_fn = (pipeline.block_fn_from_arch(
+            self, start, training=True, compute_dtype=compute_dtype,
+            platform=platform, with_aux=True)
+            if (with_aux and seq_shard) else None)
         pre = self.mods[:start]
         post = self.mods[start + count:]
 
@@ -488,7 +495,8 @@ class CompiledArch:
             res = pipeline.gpipe_apply(block_fn, stacked, h, pmesh, micro,
                                        rng=jax.random.fold_in(rng, 0x9e3779),
                                        remat=pipe_remat, with_aux=with_aux,
-                                       seq_shard=seq_shard)
+                                       seq_shard=seq_shard,
+                                       aux_probe_fn=aux_probe_fn)
             if with_aux:
                 h, aux_sums = res
                 # Per-(layer, microbatch) sums -> mean over microbatches.
@@ -1390,11 +1398,6 @@ class NeuralNetworkModel:
                         f"{type(sub).__name__}: running statistics are "
                         f"read and written per microbatch, which the "
                         f"parallel schedule cannot order")
-                if seq > 1 and isinstance(sub, M.MixtureOfExperts):
-                    raise RuntimeError(
-                        "PENROZ_MESH_PIPE>1 with PENROZ_MESH_SEQUENCE>1 "
-                        "cannot pipeline MoE blocks yet: the aux channel's "
-                        "reductions do not fold the sequence axis")
                 if seq > 1 and isinstance(sub, M.CausalSelfAttention):
                     from penroz_tpu.parallel import alltoall_attention as a2a
                     if not a2a.alltoall_supported(sub.num_heads,

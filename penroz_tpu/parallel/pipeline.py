@@ -105,7 +105,8 @@ def gpipe_spec(mesh, seq_shard: bool = False):
 
 def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
                 num_microbatches: int, rng=None, remat: str = "none",
-                with_aux: bool = False, seq_shard: bool = False):
+                with_aux: bool = False, seq_shard: bool = False,
+                aux_probe_fn=None):
     """Apply ``L`` stacked blocks to ``x`` with a ``P``-stage GPipe schedule.
 
     ``block_fn(block_params: dict, h) -> h`` applies ONE block given its
@@ -148,12 +149,10 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
     ``seq_shard=True``: the ``sequence`` axis joins the manual set and the
     microbatch T dim shards over it — ``block_fn`` must then handle its
     own sequence-parallel attention on the ambient axis (the Ctx's
-    ``sp_manual_axis``, Ulysses all-to-alls inside the stage).  Not
-    composable with ``with_aux`` (the aux pmean would need the seq axis
-    folded in; refused upstream).
+    ``sp_manual_axis``, Ulysses all-to-alls inside the stage).  The aux
+    channel folds the sequence axis into its pmean alongside data, so
+    row-mean statistics stay exact over the full (rows × positions) set.
     """
-    if seq_shard and with_aux:
-        raise ValueError("seq_shard does not compose with with_aux")
     if remat not in ("none", "block"):
         raise ValueError(f"remat={remat!r}: expected 'none' or 'block'")
     if remat == "block":
@@ -184,11 +183,16 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
         # shard_map out_specs before tracing the schedule.  Row counts
         # never reach aux shapes (scalars / per-expert vectors), so the
         # global microbatch shape stands in for the per-shard one.
+        # ``aux_probe_fn``: shape-probe variant of block_fn for callers
+        # whose real block_fn references manual axes (sequence-parallel
+        # attention) that are unbound outside the shard_map — aux shapes
+        # do not depend on the sharding, so a non-SP twin serves.
+        probe = aux_probe_fn if aux_probe_fn is not None else block_fn
         p0 = {k: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
               for k, v in stacked_params.items()}
         h0 = jax.ShapeDtypeStruct(mbs.shape[1:], x.dtype)
         args = (p0, h0) if rng is None else (p0, h0, rng)
-        _, aux_struct = jax.eval_shape(block_fn, *args)
+        _, aux_struct = jax.eval_shape(probe, *args)
         if "loss" not in aux_struct:
             raise ValueError("with_aux block_fn must return a 'loss' key")
 
@@ -205,10 +209,13 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
                     key = jax.random.fold_in(
                         jax.random.fold_in(
                             rng, stage * layers_per_stage + idx), t)
+                    # Distinct dropout streams per manual shard — without
+                    # the folds, every data (and sequence) shard would
+                    # reuse one mask pattern across DIFFERENT rows or T
+                    # positions, correlating the regularization.
+                    key = jax.random.fold_in(
+                        key, jax.lax.axis_index(DATA_AXIS))
                     if seq_shard:
-                        # Distinct dropout streams per sequence shard —
-                        # without the fold every shard would reuse one
-                        # mask pattern across different T positions.
                         key = jax.random.fold_in(
                             key, jax.lax.axis_index(SEQ_AXIS))
                     res = block_fn(pl, hh, key)
@@ -249,13 +256,16 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
         zero_state = zero_buf[0]
         aux0 = None
         if with_aux:
+            vary_axes = ((PIPE_AXIS, DATA_AXIS, SEQ_AXIS) if seq_shard
+                         else (PIPE_AXIS, DATA_AXIS))
+
             def zinit(sd):
                 # Fresh zeros are axis-invariant; the accumulated values
-                # derive from pipe- and data-varying activations.
+                # derive from activations varying over every manual axis.
                 return jax.lax.pcast(
                     jnp.zeros((layers_per_stage,) + tuple(sd.shape),
                               jnp.float32),
-                    (PIPE_AXIS, DATA_AXIS), to="varying")
+                    vary_axes, to="varying")
             aux0 = {k: zinit(v) for k, v in aux_struct.items()}
         (_, buf, aux_final), _ = jax.lax.scan(
             tick, (zero_state, zero_buf, aux0), jnp.arange(m + pipe - 1))
@@ -264,9 +274,11 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
         out = jax.lax.psum(mine, PIPE_AXIS)
         if not with_aux:
             return out
-        # Row-mean statistics (router fractions) are exact under the data
-        # pmean; the balance loss becomes the mean of per-shard losses.
-        return out, {k: jax.lax.pmean(v, DATA_AXIS)
+        # Row-mean statistics (router fractions) are exact under the
+        # data(+sequence) pmean; the balance loss becomes the mean of
+        # per-shard losses.
+        aux_axes = ((DATA_AXIS, SEQ_AXIS) if seq_shard else DATA_AXIS)
+        return out, {k: jax.lax.pmean(v, aux_axes)
                      for k, v in aux_final.items()}
 
     # Partial-manual shard_map: only the pipe and data axes are manual

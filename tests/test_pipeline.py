@@ -401,8 +401,8 @@ def test_train_model_pipe_sp_rope_global_positions(workdir, toy_shards,
 
 
 def test_pipe_sp_refusals(workdir, toy_gpt_layers, toy_shards, monkeypatch):
-    """Ring mode with pipe×seq refuses at mesh build; MoE blocks,
-    indivisible heads, and attention dropout refuse at layout entry."""
+    """Ring mode with pipe×seq refuses at mesh build; indivisible heads,
+    attention dropout, and bf16 storage refuse at layout entry."""
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
     optim = {"sgd": {"lr": 0.1}}
@@ -417,12 +417,6 @@ def test_pipe_sp_refusals(workdir, toy_gpt_layers, toy_shards, monkeypatch):
         model._training_mesh(micro_batch=8, block_size=16)
 
     monkeypatch.setenv("PENROZ_SP_MODE", "alltoall")
-    moe = NeuralNetworkModel("sprefm", Mapper(_moe_gpt_layers(), optim))
-    moe.to_device("cpu")
-    mesh = moe._training_mesh(micro_batch=8, block_size=16)
-    with pytest.raises(RuntimeError, match="aux channel"):
-        moe._enter_pipe_layout(mesh, batch_size=8)
-
     # heads (3) not divisible by the sequence axis (2)
     odd = NeuralNetworkModel(
         "sprefh", Mapper(_rope_gpt_layers(heads=3), optim)).to_device("cpu")
@@ -651,3 +645,40 @@ def test_train_pipe_refusals(workdir, toy_gpt_layers, toy_shards,
     with pytest.raises(RuntimeError, match="longest run"):
         model._enter_pipe_layout(
             model._training_mesh(micro_batch=8, block_size=16), batch_size=8)
+
+
+def test_train_model_pipe_sp_with_moe_blocks(workdir, toy_shards,
+                                             monkeypatch):
+    """MoE blocks pipeline under pipe×seq: the aux channel's pmean folds
+    the sequence axis, so router fractions remain exact whole-batch
+    statistics and the balance loss stays the per-shard Switch mean.
+    Costs and fractions must match the sequential run."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"sgd": {"lr": 0.1}}
+    layers = _moe_gpt_layers()
+
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
+    monkeypatch.setenv("PENROZ_SP_MODE", "alltoall")
+    pp = NeuralNetworkModel("ppspm", Mapper(layers, optim)).to_device("cpu")
+    pp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                   step_size=8)
+    assert pp.status["code"] == "Trained", pp.status
+    monkeypatch.delenv("PENROZ_MESH_PIPE")
+    monkeypatch.delenv("PENROZ_MESH_SEQUENCE")
+    monkeypatch.delenv("PENROZ_SP_MODE")
+
+    monkeypatch.setenv("PENROZ_TRAIN_MESH", "0")
+    seq = NeuralNetworkModel("seqspm",
+                             Mapper(layers, optim)).to_device("cpu")
+    seq.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                    step_size=8)
+    for p_run, s_run in zip(pp.progress, seq.progress):
+        np.testing.assert_allclose(p_run["cost"], s_run["cost"], rtol=2e-3)
+    fr = [k for k in pp.buffers if "router_fraction" in k]
+    assert fr
+    for k in fr:
+        np.testing.assert_allclose(np.asarray(pp.buffers[k], np.float32),
+                                   np.asarray(seq.buffers[k], np.float32),
+                                   atol=8e-3, err_msg=k)

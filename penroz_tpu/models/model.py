@@ -63,20 +63,15 @@ def _check_pipe_composition(pipe: int, seq: int) -> None:
     inserts the TP collectives and the MoE dispatch/combine psums inside
     each stage (EP×pipe parity: costs and router fractions match the
     sequential run to fp tolerance — test_train_model_pipe_composes_with_
-    expert_parallel).  Sequence parallelism composes in Ulysses mode only
-    (PENROZ_SP_MODE=alltoall): the schedule's shard_map binds the sequence
-    axis as a manual axis and the attention modules run the all-to-all
-    body on it directly (Ctx.sp_manual_axis).  Ring attention stays
-    refused — it wraps its own shard_map, which cannot nest inside the
-    schedule's; refuse loudly rather than silently mis-shard.  Shared by
-    the single- and multi-host mesh builders so the contract cannot
-    diverge."""
-    if pipe > 1 and seq > 1 and \
-            os.environ.get("PENROZ_SP_MODE", "ring") != "alltoall":
-        raise RuntimeError(
-            "PENROZ_MESH_PIPE>1 composes with sequence parallelism only "
-            "in Ulysses mode; set PENROZ_SP_MODE=alltoall or unset "
-            "PENROZ_MESH_SEQUENCE")
+    expert_parallel) — and with sequence parallelism in BOTH modes: the
+    schedule's shard_map binds the sequence axis as a manual axis and the
+    attention modules run the ring or Ulysses body on it directly
+    (Ctx.sp_manual_axis; their shard_map wrappers cannot nest, the manual
+    entry points skip them).  Every mesh axis now composes with pipe;
+    the per-model constraints (attention dropout, bf16 storage) are
+    validated at layout entry.  Kept as the shared seam between the
+    single- and multi-host mesh builders."""
+    del pipe, seq  # every composition valid at mesh level
 
 
 def _chunk_budget() -> int:
@@ -363,7 +358,8 @@ class CompiledArch:
         else:
             loss_fn = self._pipelined_loss_fn(pipe_cfg, compute_dtype,
                                               platform,
-                                              pipe_remat=pipe_remat)
+                                              pipe_remat=pipe_remat,
+                                              sp_mode=sp_mode)
 
         if remat:
             loss_fn = jax.checkpoint(loss_fn)
@@ -448,7 +444,8 @@ class CompiledArch:
         return fn
 
     def _pipelined_loss_fn(self, pipe_cfg, compute_dtype, platform,
-                           pipe_remat: str = "block"):
+                           pipe_remat: str = "block",
+                           sp_mode: str = "ring"):
         """Loss for the GPipe training layout: pre-block modules run on the
         full batch, the stacked blocks stream microbatches through the
         pipe-axis stages (``parallel/pipeline.gpipe_apply``), post-block
@@ -466,15 +463,17 @@ class CompiledArch:
         # gpipe_apply); blocks without stateful modules skip the plumbing.
         with_aux = any(isinstance(sub, M.MixtureOfExperts)
                        for sub in self.mods[start].walk())
-        # Ulysses SP inside the stages: the sequence axis joins the
-        # schedule's manual set and attention runs the all-to-all body on
-        # it directly (validated at layout entry: alltoall mode, divisible
-        # heads, dropout-free attention, fp32 parameter storage; MoE
-        # blocks compose — the aux channel folds the seq axis).
+        # SP inside the stages (both modes): the sequence axis joins the
+        # schedule's manual set and attention runs the ring or Ulysses
+        # body on it directly.  Layout entry validates dropout-free
+        # attention and fp32 parameter storage; indivisible heads fall
+        # back from alltoall to ring with a trace-time warning; MoE
+        # blocks compose (the aux channel folds the seq axis).
         seq_shard = pmesh.shape[mesh_lib.SEQ_AXIS] > 1
         block_fn = pipeline.block_fn_from_arch(
             self, start, training=True, compute_dtype=compute_dtype,
-            platform=platform, with_aux=with_aux, sp_manual=seq_shard)
+            platform=platform, with_aux=with_aux, sp_manual=seq_shard,
+            sp_mode=sp_mode)
         # Shape probe for the aux channel: the real block_fn references
         # the manual sequence axis, unbound outside the schedule.
         aux_probe_fn = (pipeline.block_fn_from_arch(
@@ -1399,19 +1398,12 @@ class NeuralNetworkModel:
                         f"read and written per microbatch, which the "
                         f"parallel schedule cannot order")
                 if seq > 1 and isinstance(sub, M.CausalSelfAttention):
-                    from penroz_tpu.parallel import alltoall_attention as a2a
-                    if not a2a.alltoall_supported(sub.num_heads,
-                                                  sub.num_kv_heads, mesh):
-                        raise RuntimeError(
-                            f"PENROZ_MESH_PIPE>1 with sequence axis {seq}: "
-                            f"Ulysses SP needs head counts divisible by "
-                            f"the axis (Hq={sub.num_heads}, "
-                            f"Hkv={sub.num_kv_heads})")
                     if sub.dropout > 0.0:
-                        # The manual Ulysses branch requires dropout-free
-                        # attention (same constraint as the sp_mesh path),
-                        # but here falling through would run SHARD-LOCAL
-                        # attention — silently wrong, so refuse.
+                        # The manual SP branch (ring or Ulysses)
+                        # requires dropout-free attention (same constraint
+                        # as the sp_mesh path), but here falling through
+                        # would run SHARD-LOCAL attention — silently
+                        # wrong, so refuse.
                         raise RuntimeError(
                             "PENROZ_MESH_PIPE>1 with PENROZ_MESH_SEQUENCE"
                             ">1 cannot pipeline attention with dropout>0: "

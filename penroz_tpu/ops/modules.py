@@ -27,6 +27,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import functools
+import logging
 import math
 from typing import Any, Optional, Sequence
 
@@ -938,12 +939,29 @@ class CausalSelfAttention(Module):
                                                 **scales)
         elif ctx.sp_manual_axis is not None and dropout_rate == 0.0:
             # Inside the GPipe schedule with the sequence axis manual: the
-            # Ulysses body runs on the ambient axis (a nested shard_map is
-            # impossible); divisibility is validated at layout entry.
+            # SP bodies run on the ambient axis (a nested shard_map is
+            # impossible).  Same mode dispatch + divisibility fallback as
+            # the sp_mesh path below.
             from penroz_tpu.parallel import alltoall_attention as a2a
-            out = a2a.alltoall_attention_manual(
-                q, k, v, axis_name=ctx.sp_manual_axis,
-                window=self.sliding_window, platform=ctx.platform)
+            from penroz_tpu.parallel import ring_attention as ring
+            n_seq = jax.lax.axis_size(ctx.sp_manual_axis)
+            if ctx.sp_mode == "alltoall" and a2a.alltoall_supported(
+                    q.shape[1], k.shape[1], n=n_seq):
+                out = a2a.alltoall_attention_manual(
+                    q, k, v, axis_name=ctx.sp_manual_axis,
+                    window=self.sliding_window, platform=ctx.platform)
+            else:
+                if ctx.sp_mode == "alltoall":
+                    # Trace-time (shapes are static), so the operator gets
+                    # a signal — mirrors the sp_mesh path's warning.
+                    logging.getLogger(__name__).warning(
+                        "alltoall SP requested but head counts (Hq=%d, "
+                        "Hkv=%d) do not divide the sequence axis (%d); "
+                        "falling back to ring attention",
+                        q.shape[1], k.shape[1], n_seq)
+                out = ring.ring_attention_manual(
+                    q, k, v, axis_name=ctx.sp_manual_axis,
+                    window=self.sliding_window)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
             # Sequence-parallel training over ICI (windowed when the model
             # slides — long-context SP is exactly where windows matter).

@@ -31,10 +31,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from penroz_tpu.parallel.mesh import SEQ_AXIS
 
 
-def alltoall_supported(num_heads: int, num_kv_heads: int, mesh: Mesh,
-                       axis_name: str = SEQ_AXIS) -> bool:
-    """Whether the Ulysses head split is possible on this mesh."""
-    n = mesh.shape[axis_name]
+def alltoall_supported(num_heads: int, num_kv_heads: int, mesh=None,
+                       axis_name: str = SEQ_AXIS, n: int = None) -> bool:
+    """Whether the Ulysses head split is possible on this mesh (or for an
+    explicit axis size ``n`` — the manual in-schedule dispatch has no Mesh
+    object, only the ambient axis)."""
+    if n is None:
+        n = mesh.shape[axis_name]
     return num_heads % n == 0 and num_kv_heads % n == 0
 
 

@@ -302,7 +302,8 @@ def gpipe_apply(block_fn, stacked_params: dict, x, mesh,
 
 def block_fn_from_arch(arch, block_index: int, *, training=False,
                        compute_dtype=None, platform=None,
-                       with_aux: bool = False, sp_manual: bool = False):
+                       with_aux: bool = False, sp_manual: bool = False,
+                       sp_mode: str = "ring"):
     """``block_fn`` for :func:`gpipe_apply` from one bound DSL block module.
 
     Uses the module tree of block ``block_index`` with params rebound from
@@ -326,7 +327,8 @@ def block_fn_from_arch(arch, block_index: int, *, training=False,
                      for suffix, leaf in block_params.items()},
                     training=training, rng=key,
                     compute_dtype=compute_dtype, platform=platform,
-                    sp_manual_axis=SEQ_AXIS if sp_manual else None)
+                    sp_manual_axis=SEQ_AXIS if sp_manual else None,
+                    sp_mode=sp_mode)
         out = mod.apply(h, ctx)
         if not with_aux:
             return out

@@ -88,19 +88,34 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         return m_new, l_new, acc_new, k_next, v_next
 
-    m0 = jnp.full((B, Hkv, group, Tl), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, Hkv, group, Tl), jnp.float32)
-    acc0 = jnp.zeros((B, Hkv, group, Tl, D), jnp.float32)
-    # Mark the replicated-initialized carries as device-varying so the loop
-    # carry type matches what the ring rotation produces.
-    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,),
-                                 to="varying")
+    # Derive the carry inits from qg so they inherit EVERY manual axis the
+    # inputs vary over — under the GPipe schedule that set is
+    # {pipe, data, sequence}, not just the ring axis, and a fixed pcast
+    # list would mismatch the loop-carry types there.
+    m0 = jnp.full_like(qg[..., 0], -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros_like(qg[..., 0], dtype=jnp.float32)
+    acc0 = jnp.zeros_like(qg, dtype=jnp.float32)
     m, l, acc, _, _ = jax.lax.fori_loop(0, num_steps, step,
                                         (m0, l0, acc0, k, v))
 
     l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l[..., None]).astype(q.dtype)
     return out.reshape(B, Hq, Tl, D)
+
+
+def ring_attention_manual(q, k, v, *, axis_name: str = SEQ_AXIS,
+                          causal: bool = True, window=None):
+    """Ring attention for callers ALREADY inside a manual region binding
+    ``axis_name`` (e.g. the GPipe schedule's shard_map with the sequence
+    axis manual) — same math as :func:`ring_attention`, minus the
+    shard_map wrapper (nesting one inside another is not possible).
+    q/k/v: per-shard (B, H, T_local, D) blocks."""
+    if window is not None and not causal:
+        raise ValueError("ring_attention window requires causal=True")
+    return _ring_attention_local(q, k, v, axis_name=axis_name,
+                                 causal=causal,
+                                 window=int(window) if window is not None
+                                 else None)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,

@@ -313,12 +313,13 @@ def test_train_model_pipe_with_moe_blocks(workdir, toy_shards, monkeypatch):
                                    atol=8e-3, err_msg=k)
 
 
-def test_train_model_pipe_composes_with_ulysses_sp(workdir, toy_gpt_layers,
-                                                   toy_shards, monkeypatch):
-    """pipe=2 × sequence=2 × data=2 with PENROZ_SP_MODE=alltoall: the
-    sequence axis joins the schedule's manual set, the microbatch T dim
-    shards over it, and the attention modules run the Ulysses all-to-all
-    body on the ambient axis.  Costs must match the sequential run."""
+@pytest.mark.parametrize("mode", ["alltoall", "ring"])
+def test_train_model_pipe_composes_with_sp(workdir, toy_gpt_layers,
+                                           toy_shards, monkeypatch, mode):
+    """pipe=2 × sequence=2 × data=2 in BOTH SP modes: the sequence axis
+    joins the schedule's manual set, the microbatch T dim shards over it,
+    and the attention modules run the ring or Ulysses body on the ambient
+    axis.  Costs must match the sequential run."""
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
     from penroz_tpu.parallel import mesh as mesh_lib
@@ -326,9 +327,9 @@ def test_train_model_pipe_composes_with_ulysses_sp(workdir, toy_gpt_layers,
 
     monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
     monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
-    monkeypatch.setenv("PENROZ_SP_MODE", "alltoall")
-    pp = NeuralNetworkModel("ppsp", Mapper(toy_gpt_layers,
-                                           optim)).to_device("cpu")
+    monkeypatch.setenv("PENROZ_SP_MODE", mode)
+    pp = NeuralNetworkModel("ppsp" + mode, Mapper(toy_gpt_layers,
+                                                  optim)).to_device("cpu")
     mesh = pp._training_mesh(8, 16)
     assert mesh is not None and mesh.shape[mesh_lib.PIPE_AXIS] == 2 \
         and mesh.shape[mesh_lib.SEQ_AXIS] == 2
@@ -340,8 +341,8 @@ def test_train_model_pipe_composes_with_ulysses_sp(workdir, toy_gpt_layers,
     monkeypatch.delenv("PENROZ_SP_MODE")
 
     monkeypatch.setenv("PENROZ_TRAIN_MESH", "0")
-    seq = NeuralNetworkModel("seqsp", Mapper(toy_gpt_layers,
-                                             optim)).to_device("cpu")
+    seq = NeuralNetworkModel("seqsp" + mode, Mapper(toy_gpt_layers,
+                                                    optim)).to_device("cpu")
     seq.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
                     step_size=8)
     for p_run, s_run in zip(pp.progress, seq.progress):
@@ -401,28 +402,15 @@ def test_train_model_pipe_sp_rope_global_positions(workdir, toy_shards,
 
 
 def test_pipe_sp_refusals(workdir, toy_gpt_layers, toy_shards, monkeypatch):
-    """Ring mode with pipe×seq refuses at mesh build; indivisible heads,
-    attention dropout, and bf16 storage refuse at layout entry."""
+    """Attention dropout and bf16 storage refuse at layout entry under
+    pipe×seq (ring and Ulysses both compose; indivisible heads fall back
+    to ring like the non-pipe dispatcher)."""
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
     optim = {"sgd": {"lr": 0.1}}
     monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
     monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
-    # pin ring mode: ambient PENROZ_SP_MODE=alltoall would defeat the
-    # refusal under test
-    monkeypatch.setenv("PENROZ_SP_MODE", "ring")
-    model = NeuralNetworkModel("spref", Mapper(toy_gpt_layers, optim))
-    model.to_device("cpu")
-    with pytest.raises(RuntimeError, match="Ulysses mode"):
-        model._training_mesh(micro_batch=8, block_size=16)
-
     monkeypatch.setenv("PENROZ_SP_MODE", "alltoall")
-    # heads (3) not divisible by the sequence axis (2)
-    odd = NeuralNetworkModel(
-        "sprefh", Mapper(_rope_gpt_layers(heads=3), optim)).to_device("cpu")
-    mesh = odd._training_mesh(micro_batch=8, block_size=16)
-    with pytest.raises(RuntimeError, match="divisible by"):
-        odd._enter_pipe_layout(mesh, batch_size=8)
 
     # attention dropout > 0 would fall through to shard-local attention
     dp = NeuralNetworkModel(
@@ -628,18 +616,12 @@ def test_train_pipe_refusals(workdir, toy_gpt_layers, toy_shards,
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
     optim = {"sgd": {"lr": 0.1}}
-    # pipe × ring-SP is refused loudly, not silently mis-sharded (pipe ×
-    # TP/EP/Ulysses-SP compose as of round 4)
+    # (every mesh axis composes with pipe as of round 4 — the SP/ZeRO
+    # parity tests cover seq/expert/model and WUS/FSDP; per-model
+    # constraints are validated at layout entry, test_pipe_sp_refusals)
     monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
-    monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
-    monkeypatch.setenv("PENROZ_SP_MODE", "ring")
     model = NeuralNetworkModel("ppref", Mapper(toy_gpt_layers, optim))
     model.to_device("cpu")
-    with pytest.raises(RuntimeError, match="unset PENROZ_MESH_SEQUENCE"):
-        model._training_mesh(micro_batch=8, block_size=16)
-    monkeypatch.delenv("PENROZ_MESH_SEQUENCE")
-    # (the ZeRO ladder composes with the stacked layout as of round 4 —
-    # test_train_model_pipe_composes_with_zero_ladder covers it)
     # a DSL whose longest identical-block run is too short for the axis
     monkeypatch.setenv("PENROZ_MESH_PIPE", "4")
     with pytest.raises(RuntimeError, match="longest run"):
@@ -682,3 +664,33 @@ def test_train_model_pipe_sp_with_moe_blocks(workdir, toy_shards,
         np.testing.assert_allclose(np.asarray(pp.buffers[k], np.float32),
                                    np.asarray(seq.buffers[k], np.float32),
                                    atol=8e-3, err_msg=k)
+
+
+def test_pipe_sp_indivisible_heads_fall_back_to_ring(workdir, toy_shards,
+                                                     monkeypatch):
+    """alltoall requested but heads (3) don't divide the sequence axis
+    (2): the manual dispatcher falls back to ring (with a trace-time
+    warning) instead of refusing — and the numerics still match the
+    sequential run, proving the ring body actually ran correctly."""
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    optim = {"sgd": {"lr": 0.1}}
+    layers = _rope_gpt_layers(heads=3)
+
+    monkeypatch.setenv("PENROZ_MESH_PIPE", "2")
+    monkeypatch.setenv("PENROZ_MESH_SEQUENCE", "2")
+    monkeypatch.setenv("PENROZ_SP_MODE", "alltoall")
+    pp = NeuralNetworkModel("ppfb", Mapper(layers, optim)).to_device("cpu")
+    pp.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                   step_size=8)
+    assert pp.status["code"] == "Trained", pp.status
+    monkeypatch.delenv("PENROZ_MESH_PIPE")
+    monkeypatch.delenv("PENROZ_MESH_SEQUENCE")
+    monkeypatch.delenv("PENROZ_SP_MODE")
+
+    monkeypatch.setenv("PENROZ_TRAIN_MESH", "0")
+    seq = NeuralNetworkModel("seqfb", Mapper(layers, optim)).to_device("cpu")
+    seq.train_model("toy", shard=0, epochs=2, batch_size=8, block_size=16,
+                    step_size=8)
+    for p_run, s_run in zip(pp.progress, seq.progress):
+        np.testing.assert_allclose(p_run["cost"], s_run["cost"], rtol=2e-3)

@@ -59,6 +59,7 @@ _LEAF_ALGOS = {
     "attention": M.CausalSelfAttention,
     "gatedmlp": M.GatedMLP,
     "moe": M.MixtureOfExperts,
+    "clamp": M.Clamp,
 }
 
 _OPTIMIZERS = ("adamw", "adam", "sgd")
@@ -248,6 +249,8 @@ class Mapper:
             return _phi_dsl_from_config(config, n_layer_override)
         if model_type == "olmo2":
             return _olmo2_dsl_from_config(config, n_layer_override)
+        if model_type == "olmo":
+            return _olmo_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -280,6 +283,8 @@ class Mapper:
             return _map_phi_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") == "olmo2":
             return _map_olmo2_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "olmo":
+            return _map_olmo_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") in _LLAMA_FAMILY:
             return _map_llama_state_dict(state_dict, n_layer, config)
         return _map_gemma_state_dict(state_dict, n_layer, config)
@@ -848,12 +853,9 @@ def _map_phi_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     for i in range(n_layer):
         src = f"model.layers.{i}"
         dst = f"layers.{base + i}.0"
+        _concat_qkv(sd, src, out, f"{dst}.1.0.0")
         for name in ("weight", "bias"):
             out[f"{dst}.0.{name}"] = sd[f"{src}.input_layernorm.{name}"]
-            out[f"{dst}.1.0.0.{name}"] = np.concatenate(
-                [np.asarray(sd[f"{src}.self_attn.q_proj.{name}"]),
-                 np.asarray(sd[f"{src}.self_attn.k_proj.{name}"]),
-                 np.asarray(sd[f"{src}.self_attn.v_proj.{name}"])], axis=0)
             out[f"{dst}.1.0.2.{name}"] = sd[f"{src}.self_attn.dense.{name}"]
             out[f"{dst}.1.1.0.{name}"] = sd[f"{src}.mlp.fc1.{name}"]
             out[f"{dst}.1.1.2.{name}"] = sd[f"{src}.mlp.fc2.{name}"]
@@ -927,6 +929,92 @@ def _olmo2_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     return layers
 
 
+def _olmo_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """OLMo v1 HF config → layer DSL.
+
+    Llama-like pre-norm blocks with two quirks: NON-PARAMETRIC LayerNorm
+    (HF ``OlmoLayerNorm``: elementwise_affine=False, no weights at all)
+    and optional ``clip_qkv`` — the fused QKV projection output clamps to
+    ±clip before attention (a ``clamp`` DSL entry).
+    """
+    cfg = _llama_text_config(config)
+    scaling = getattr(cfg, "rope_scaling", None) or None
+    if scaling and (scaling.get("rope_type") or scaling.get("type")
+                    or "default") != "default":
+        raise ValueError(
+            f"olmo rope_scaling {scaling!r} is not supported; importing "
+            "would produce wrong logits")
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    kv = int(getattr(cfg, "num_key_value_heads", None) or heads)
+    hd = d // heads
+    vocab = int(cfg.vocab_size)
+    rope = float(getattr(cfg, "rope_theta", 10000.0) or 10000.0)
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    bias = bool(getattr(cfg, "attention_bias", False) or False)
+    clip = getattr(cfg, "clip_qkv", None)
+    inter = int(cfg.intermediate_size)
+    activation = getattr(cfg, "hidden_act", "silu")
+    ln = {"layernorm": {"normalized_shape": d, "eps": 1e-5,
+                        "elementwise_affine": False}}
+
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for _ in range(n):
+        attn_seq = [dict(ln),
+                    {"linear": {"in_features": d,
+                                "out_features": (heads + 2 * kv) * hd,
+                                "bias": bias}}]
+        if clip is not None:
+            attn_seq.append({"clamp": {"min": -float(clip),
+                                       "max": float(clip)}})
+        attn_seq += [{"attention": {"num_heads": heads, "num_kv_heads": kv,
+                                    "rope_theta": rope, "head_dim": hd,
+                                    "dropout": attn_drop}},
+                     {"linear": {"in_features": heads * hd,
+                                 "out_features": d, "bias": bias}}]
+        layers.append({"residual": [
+            {"sequential": attn_seq},
+            {"sequential": [dict(ln),
+                            {"gatedmlp": {"in_features": d,
+                                          "intermediate_size": inter,
+                                          "activation": activation}}]}]})
+    layers += [
+        dict(ln),
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_olmo_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """OLMo v1 HF keys → ours.  The non-parametric LayerNorms carry no
+    weights, so only projections and embeddings map; the clamp entry
+    shifts the attention branch's item indices when clip_qkv is set."""
+    cfg = _llama_text_config(config)
+    has_clip = getattr(cfg, "clip_qkv", None) is not None
+    # attn branch items: [ln, qkv, (clamp,) attention, o_proj]
+    i_attn_out = 4 if has_clip else 3
+    out = {"layers.0.weight": sd["model.embed_tokens.weight"]}
+    for i in range(n_layer):
+        src = f"model.layers.{i}"
+        dst = f"layers.{1 + i}"
+        _concat_qkv(sd, src, out, f"{dst}.0.1")
+        out[f"{dst}.0.{i_attn_out}.weight"] = \
+            sd[f"{src}.self_attn.o_proj.weight"]
+        if f"{src}.self_attn.o_proj.bias" in sd:
+            out[f"{dst}.0.{i_attn_out}.bias"] = \
+                sd[f"{src}.self_attn.o_proj.bias"]
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            out[f"{dst}.1.1.{proj}.weight"] = sd[f"{src}.mlp.{proj}.weight"]
+    out[f"layers.{2 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd["model.embed_tokens.weight"])
+    return out
+
+
 def _map_olmo2_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     """OLMo-2 HF keys → ours: QKV concat, flat q/k-norm weights onto the
     attention module, branch-tail norms from post_attention/
@@ -935,15 +1023,7 @@ def _map_olmo2_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     for i in range(n_layer):
         src = f"model.layers.{i}"
         dst = f"layers.{1 + i}"
-        out[f"{dst}.0.0.weight"] = np.concatenate(
-            [np.asarray(sd[f"{src}.self_attn.q_proj.weight"]),
-             np.asarray(sd[f"{src}.self_attn.k_proj.weight"]),
-             np.asarray(sd[f"{src}.self_attn.v_proj.weight"])], axis=0)
-        if f"{src}.self_attn.q_proj.bias" in sd:
-            out[f"{dst}.0.0.bias"] = np.concatenate(
-                [np.asarray(sd[f"{src}.self_attn.q_proj.bias"]),
-                 np.asarray(sd[f"{src}.self_attn.k_proj.bias"]),
-                 np.asarray(sd[f"{src}.self_attn.v_proj.bias"])], axis=0)
+        _concat_qkv(sd, src, out, f"{dst}.0.0")
         out[f"{dst}.0.1.q_norm.weight"] = sd[f"{src}.self_attn.q_norm.weight"]
         out[f"{dst}.0.1.k_norm.weight"] = sd[f"{src}.self_attn.k_norm.weight"]
         out[f"{dst}.0.2.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
@@ -999,6 +1079,22 @@ def _map_neox_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     return out
 
 
+def _concat_qkv(sd: dict, src: str, out: dict, dst_key: str,
+                q="q_proj", k="k_proj", v="v_proj") -> None:
+    """Fuse separate q/k/v projections onto our single QKV linear:
+    weights (and biases when present) concatenate on the output dim."""
+    attn = f"{src}.self_attn"
+    out[f"{dst_key}.weight"] = np.concatenate(
+        [np.asarray(sd[f"{attn}.{q}.weight"]),
+         np.asarray(sd[f"{attn}.{k}.weight"]),
+         np.asarray(sd[f"{attn}.{v}.weight"])], axis=0)
+    if f"{attn}.{q}.bias" in sd:
+        out[f"{dst_key}.bias"] = np.concatenate(
+            [np.asarray(sd[f"{attn}.{q}.bias"]),
+             np.asarray(sd[f"{attn}.{k}.bias"]),
+             np.asarray(sd[f"{attn}.{v}.bias"])], axis=0)
+
+
 def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     """Llama/Mistral/Qwen2 HF keys → ours: QKV (+bias) concat, straight
     RMSNorm copy (no Gemma +1 offset), tied-or-untied lm_head."""
@@ -1010,15 +1106,7 @@ def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:
         src = f"{prefix}.layers.{i}"
         dst = f"layers.{1 + i}"
         out[f"{dst}.attn_block.0.weight"] = sd[f"{src}.input_layernorm.weight"]
-        out[f"{dst}.attn_block.1.weight"] = np.concatenate(
-            [np.asarray(sd[f"{src}.self_attn.q_proj.weight"]),
-             np.asarray(sd[f"{src}.self_attn.k_proj.weight"]),
-             np.asarray(sd[f"{src}.self_attn.v_proj.weight"])], axis=0)
-        if f"{src}.self_attn.q_proj.bias" in sd:
-            out[f"{dst}.attn_block.1.bias"] = np.concatenate(
-                [np.asarray(sd[f"{src}.self_attn.q_proj.bias"]),
-                 np.asarray(sd[f"{src}.self_attn.k_proj.bias"]),
-                 np.asarray(sd[f"{src}.self_attn.v_proj.bias"])], axis=0)
+        _concat_qkv(sd, src, out, f"{dst}.attn_block.1")
         out[f"{dst}.attn_block.3.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
         if f"{src}.self_attn.o_proj.bias" in sd:
             out[f"{dst}.attn_block.3.bias"] = sd[f"{src}.self_attn.o_proj.bias"]

@@ -326,33 +326,62 @@ class BatchNorm1d(Module):
 
 
 class LayerNorm(Module):
-    def __init__(self, normalized_shape, eps: float = 1e-5, bias: bool = True):
+    def __init__(self, normalized_shape, eps: float = 1e-5, bias: bool = True,
+                 elementwise_affine: bool = True):
         if isinstance(normalized_shape, int):
             normalized_shape = (normalized_shape,)
         self.normalized_shape = tuple(int(d) for d in normalized_shape)
         self.eps = float(eps)
-        self.use_bias = bool(bias)
+        # Non-parametric mode (OLMo v1): normalize only, no learned scale
+        # or shift (torch LayerNorm(elementwise_affine=False)).
+        self.affine = bool(elementwise_affine)
+        self.use_bias = bool(bias) and self.affine
 
     def param_shapes(self):
+        if not self.affine:
+            return {}
         shapes = {"weight": self.normalized_shape}
         if self.use_bias:
             shapes["bias"] = self.normalized_shape
         return shapes
 
     def init(self, rng):
+        if not self.affine:
+            return {}
         params = {self.key("weight"): jnp.ones(self.normalized_shape, jnp.float32)}
         if self.use_bias:
             params[self.key("bias")] = jnp.zeros(self.normalized_shape, jnp.float32)
         return params
 
     def apply(self, x, ctx):
+        # fp32-internal normalization like torch F.layer_norm (and HF's
+        # OlmoLayerNorm, which upcasts explicitly): bf16 mean/var over the
+        # large pre-norm activations would drift imported-model numerics.
         axes = tuple(range(x.ndim - len(self.normalized_shape), x.ndim))
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        var = jnp.var(x, axis=axes, keepdims=True)
-        out = (x - mean) * jax.lax.rsqrt(var + self.eps) * self._p(ctx, "weight")
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        out = ((xf - mean) * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
+        if self.affine:
+            out = out * self._p(ctx, "weight")
         if self.use_bias:
             out = out + self._p(ctx, "bias")
         return out
+
+
+class Clamp(Module):
+    """Elementwise value clipping (OLMo v1 ``clip_qkv``: the fused QKV
+    projection output is clamped to ±clip before attention)."""
+
+    def __init__(self, min: Optional[float] = None,
+                 max: Optional[float] = None):
+        if min is None and max is None:
+            raise ValueError("clamp needs at least one of min/max")
+        self.min = float(min) if min is not None else None
+        self.max = float(max) if max is not None else None
+
+    def apply(self, x, ctx):
+        return jnp.clip(x, self.min, self.max)
 
 
 class RMSNorm(Module):

@@ -717,3 +717,47 @@ def test_olmo2_rope_scaling_rejected():
                          rope_scaling={"type": "linear", "factor": 2.0})
     with pytest.raises(ValueError, match="olmo2 rope_scaling"):
         Mapper.from_hf_config(config)
+
+
+def _tiny_olmo(clip_qkv=None):
+    from transformers import OlmoConfig, OlmoForCausalLM
+    config = OlmoConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=2, num_key_value_heads=1,
+                        intermediate_size=64, max_position_embeddings=64,
+                        rope_theta=10000.0, attention_dropout=0.0,
+                        clip_qkv=clip_qkv, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, OlmoForCausalLM(config).eval()
+
+
+@pytest.mark.parametrize("clip_qkv", [None, 0.5])
+def test_olmo_import_logit_parity(workdir, clip_qkv):
+    """OLMo v1: NON-PARAMETRIC LayerNorms (no weights to map at all) and
+    optional clip_qkv (fused QKV output clamped to ±clip via the clamp
+    DSL entry, shifting the branch's item indices)."""
+    config, torch_model = _tiny_olmo(clip_qkv=clip_qkv)
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    tag = "olmo-clip" if clip_qkv else "olmo-tiny"
+    model = _import_model(workdir, config, torch_model, tag)
+    assert model.status["code"] == "Imported"
+    assert not any("layernorm" in k.lower() or ".0.0." in k
+                   for k in model.params), \
+        [k for k in model.params if ".0.0." in k]
+    assert ('"clamp"' in __import__("json").dumps(model.layers_dsl)) == \
+        (clip_qkv is not None)
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)

@@ -135,8 +135,15 @@ def bench_train(arch, mapper, params, batch=8, block=1024, steps_per_call=4,
     return tokens / elapsed, last_cost
 
 
-def bench_ttft(arch, params, block=1024, prompt_len=128, trials=10):
-    """p50 time-to-first-token: prefill(prompt) + sample, steady state."""
+def bench_ttft(arch, params, block=1024, prompt_len=128, trials=10,
+               per_trial_priority=False):
+    """p50 time-to-first-token: prefill(prompt) + sample, steady state.
+
+    ``per_trial_priority=True``: each timed decode individually marks
+    itself in flight (models.model.decode_priority) — the production
+    shape, where priority is held per request, NOT across the whole
+    benchmark (which would park a background trainer continuously and
+    measure near-idle TTFT)."""
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import NeuralNetworkModel
     from penroz_tpu.ops import kv_cache as KV
@@ -155,16 +162,24 @@ def bench_ttft(arch, params, block=1024, prompt_len=128, trials=10):
         0, 50304, (1, prompt_len), dtype=np.int32))
     temp = jnp.asarray(1.0, jnp.float32)
 
+    import contextlib
+    if per_trial_priority:
+        from penroz_tpu.models import model as model_mod
+        priority = model_mod.decode_priority
+    else:
+        priority = contextlib.nullcontext
+
     times = []
     for i in range(trials + 2):
         kv = KV.create_kv_state(specs, 1, block, model.dtype)
         rng = jax.random.key(i)
-        t0 = time.perf_counter()
-        tok, kv = decode(model.params, model.buffers, kv, prompt, rng, temp,
-                         compute_dtype=compute_dtype, greedy=False,
-                         top_k=None)
-        int(np.asarray(tok)[0, 0])  # host transfer forces execution
-        times.append((time.perf_counter() - t0) * 1000)
+        with priority():
+            t0 = time.perf_counter()
+            tok, kv = decode(model.params, model.buffers, kv, prompt, rng,
+                             temp, compute_dtype=compute_dtype, greedy=False,
+                             top_k=None)
+            int(np.asarray(tok)[0, 0])  # host transfer forces execution
+            times.append((time.perf_counter() - t0) * 1000)
     return statistics.median(times[2:])  # drop compile/warmup trials
 
 
@@ -201,8 +216,12 @@ def bench_ttft_under_train(arch, params, mapper, block=1024, trials=8,
 
     def trainer():
         nonlocal t_params, opt_state, t_bufs
+        from penroz_tpu.models import model as model_mod
         try:
             while not stop.is_set():
+                # Decode-priority window, same rule as the real /train/
+                # loop: queued decodes get the chip between epochs.
+                model_mod._yield_to_decodes()
                 t_params, opt_state, t_bufs, c, _ = epoch_fn(
                     t_params, opt_state, t_bufs, x, y, rng)
                 # One epoch in flight at a time, like the real /train/
@@ -217,7 +236,8 @@ def bench_ttft_under_train(arch, params, mapper, block=1024, trials=8,
     th = threading.Thread(target=trainer, name="bench-train-bg")
     th.start()
     try:
-        ttft = bench_ttft(arch, params, block=block, trials=trials)
+        ttft = bench_ttft(arch, params, block=block, trials=trials,
+                          per_trial_priority=True)
     finally:
         stop.set()
         th.join()

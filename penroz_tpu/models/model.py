@@ -24,9 +24,11 @@ bounding per-token dispatch overhead, compile variants, and host round-trips.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
+import threading
 import time
 from typing import Optional
 
@@ -53,6 +55,46 @@ DECODE_CHUNK_ENV = "PENROZ_DECODE_CHUNK"
 # train-end barrier id, so it must advance in lockstep on every host and
 # survive the per-request model deserialization (see train_model).
 _TRAIN_SEQ: dict = {}
+
+# Decode-priority dispatch: /generate/ handlers wrap their device work in
+# decode_priority(); the training loop consults decode_pending() between
+# epochs and briefly yields the chip so queued decodes slip in ahead of
+# the next epoch program (the reference sidesteps the contention by
+# forking training into separate processes/devices, main.py:461-464).
+_DECODE_PENDING = 0
+_DECODE_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def decode_priority():
+    """Mark a decode request in flight for the duration of its device work."""
+    global _DECODE_PENDING
+    with _DECODE_LOCK:
+        _DECODE_PENDING += 1
+    try:
+        yield
+    finally:
+        with _DECODE_LOCK:
+            _DECODE_PENDING -= 1
+
+
+def decode_pending() -> int:
+    return _DECODE_PENDING
+
+
+def _yield_to_decodes():
+    """Between-epoch decode-priority window (single-process only: a
+    one-sided pause under a multi-host mesh would just stall the peers'
+    collectives).  Caps at PENROZ_DECODE_PRIORITY_MS (default 1000; 0
+    disables) so a decode storm cannot starve training."""
+    if dist.process_count() > 1:
+        return
+    cap_ms = float(os.environ.get("PENROZ_DECODE_PRIORITY_MS", "1000"))
+    if cap_ms <= 0 or decode_pending() <= 0:
+        return
+    deadline = time.monotonic() + cap_ms / 1000.0
+    while decode_pending() > 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
 
 
 def _check_pipe_composition(pipe: int, seq: int) -> None:
@@ -1032,6 +1074,9 @@ class NeuralNetworkModel:
                 os.environ.get("PENROZ_STATS_INTERVAL", "60"))
             last_batch = None  # host-local numpy micro-batch for /stats/
             for epoch in range(epochs):
+                # Decode-priority window: queued /generate/ dispatches get
+                # the chip before the next epoch program is enqueued.
+                _yield_to_decodes()
                 t0 = time.monotonic()
                 long_training = t0 - last_save >= 10
                 if saves_shards:
@@ -1813,40 +1858,43 @@ class NeuralNetworkModel:
                     done[i] = (stop_token is not None
                                and int(t) == stop_token)
 
-        prev, kv = prefill(self.params, self.buffers, kv,
-                           jnp.asarray(padded), lengths,
-                           jax.random.fold_in(call_rng, 0), temp)
-        absorb(np.asarray(prev))
-        # Fused chunked decode (same scan programs as _generate_iter's
-        # decode_chunk, same pow-2-ceiling tails): up to PENROZ_DECODE_CHUNK
-        # steps per dispatch instead of one.  The overshoot bound uses the
-        # longest prompt, which every row's capacity satisfies (validated
-        # above); tokens scanned past an all-rows stop are abandoned.
-        # With a stop_token, ramp from 8 doubling per dispatch (as the
-        # streaming path does) so an early stop wastes at most the current
-        # ramp chunk, not a full budget of fused steps.
-        chunk_budget = _chunk_budget()
-        ramp_budget = 8 if stop_token is not None else chunk_budget
-        last = prev[:, None]
-        dispatched = 1
-        while dispatched < max_new_tokens and not all(done):
-            remaining = max_new_tokens - dispatched
-            room = block_size - max_p - dispatched
-            chunk = _decode_chunk_size(remaining,
-                                       min(chunk_budget, ramp_budget, room))
-            count = min(chunk, remaining)
-            ramp_budget = min(ramp_budget * 2, chunk_budget)
-            toks, kv = arch.decode_chunk(
-                self.params, self.buffers, kv, last,
-                jax.random.fold_in(call_rng, dispatched), temp, chunk=chunk,
-                greedy=greedy, top_k=top_k, platform=self._platform)
-            arr = np.asarray(toks)[:, :count]
-            for col in range(count):
-                absorb(arr[:, col])
-                if all(done):
-                    break
-            last = toks[:, -1:]
-            dispatched += count
+        with decode_priority():
+            prev, kv = prefill(self.params, self.buffers, kv,
+                               jnp.asarray(padded), lengths,
+                               jax.random.fold_in(call_rng, 0), temp)
+            absorb(np.asarray(prev))
+            # Fused chunked decode (same scan programs as _generate_iter's
+            # decode_chunk, same pow-2-ceiling tails): up to
+            # PENROZ_DECODE_CHUNK steps per dispatch instead of one.  The
+            # overshoot bound uses the longest prompt, which every row's
+            # capacity satisfies (validated above); tokens scanned past an
+            # all-rows stop are abandoned.  With a stop_token, ramp from 8
+            # doubling per dispatch (as the streaming path does) so an
+            # early stop wastes at most the current ramp chunk, not a full
+            # budget of fused steps.
+            chunk_budget = _chunk_budget()
+            ramp_budget = 8 if stop_token is not None else chunk_budget
+            last = prev[:, None]
+            dispatched = 1
+            while dispatched < max_new_tokens and not all(done):
+                remaining = max_new_tokens - dispatched
+                room = block_size - max_p - dispatched
+                chunk = _decode_chunk_size(
+                    remaining, min(chunk_budget, ramp_budget, room))
+                count = min(chunk, remaining)
+                ramp_budget = min(ramp_budget * 2, chunk_budget)
+                toks, kv = arch.decode_chunk(
+                    self.params, self.buffers, kv, last,
+                    jax.random.fold_in(call_rng, dispatched), temp,
+                    chunk=chunk, greedy=greedy, top_k=top_k,
+                    platform=self._platform)
+                arr = np.asarray(toks)[:, :count]
+                for col in range(count):
+                    absorb(arr[:, col])
+                    if all(done):
+                        break
+                last = toks[:, -1:]
+                dispatched += count
         return outs
 
     def _sampling_setup(self, temperature):
@@ -1872,11 +1920,12 @@ class NeuralNetworkModel:
         context = self._prompt_tokens(input)
         metrics = KV.create_kv_cache(len(self.arch.attn_layers))
         try:
-            for tok in self._generate_iter(context, block_size,
-                                           max_new_tokens, temperature, top_k,
-                                           metrics):
-                if stop_token is not None and tok == stop_token:
-                    break
+            with decode_priority():
+                for tok in self._generate_iter(context, block_size,
+                                               max_new_tokens, temperature,
+                                               top_k, metrics):
+                    if stop_token is not None and tok == stop_token:
+                        break
         finally:
             metrics.log_metrics()
         return context
@@ -1887,10 +1936,19 @@ class NeuralNetworkModel:
         neural_net_model.py:481-514)."""
         context = self._prompt_tokens(input)
         metrics = KV.create_kv_cache(len(self.arch.attn_layers))
+        it = self._generate_iter(context, block_size, max_new_tokens,
+                                 temperature, top_k, metrics, ramp=True)
         try:
-            for tok in self._generate_iter(context, block_size,
-                                           max_new_tokens, temperature, top_k,
-                                           metrics, ramp=True):
+            while True:
+                # Mark only the device-work advance, not the consumer's
+                # wall time between yields — a slow stream reader must not
+                # keep training parked at the priority window with an
+                # idle chip.
+                with decode_priority():
+                    try:
+                        tok = next(it)
+                    except StopIteration:
+                        break
                 yield tok
                 if stop_token is not None and tok == stop_token:
                     return

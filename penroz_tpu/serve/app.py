@@ -224,6 +224,8 @@ async def model_generate(request: web.Request):
 
         def produce():
             try:
+                # decode-priority marking lives inside the generate
+                # methods themselves (models.model.decode_priority)
                 for token in model.generate_tokens_stream(
                         body.input, body.block_size, body.max_new_tokens,
                         body.temperature, body.top_k, body.stop_token):

@@ -676,3 +676,54 @@ def test_batched_generate_matches_single_sliding_window(workdir):
         single = model.generate_tokens([p], block_size=16, max_new_tokens=6,
                                        temperature=0.0)
         assert out == single, (p, out, single)
+
+
+def test_decode_priority_yield(monkeypatch):
+    """The between-epoch decode-priority window waits while decodes are
+    pending (bounded by PENROZ_DECODE_PRIORITY_MS), no-ops when idle, and
+    never pauses under multi-host (a one-sided stall)."""
+    import time as _time
+    from penroz_tpu.models import model as model_mod
+
+    # idle: returns immediately
+    t0 = _time.monotonic()
+    model_mod._yield_to_decodes()
+    assert _time.monotonic() - t0 < 0.05
+
+    # pending: waits until the decode finishes
+    monkeypatch.setenv("PENROZ_DECODE_PRIORITY_MS", "2000")
+    import threading
+
+    def decode():
+        with model_mod.decode_priority():
+            _time.sleep(0.15)
+
+    th = threading.Thread(target=decode)
+    th.start()
+    # poll until the decode registers — a fixed sleep flakes on loaded
+    # hosts where the thread may not have started within the window
+    deadline = _time.monotonic() + 2.0
+    while model_mod.decode_pending() == 0 and _time.monotonic() < deadline:
+        _time.sleep(0.002)
+    assert model_mod.decode_pending() > 0
+    t0 = _time.monotonic()
+    model_mod._yield_to_decodes()
+    waited = _time.monotonic() - t0
+    th.join()
+    assert 0.05 < waited < 1.5, waited
+
+    # cap: a stuck decode cannot starve training past the budget
+    monkeypatch.setenv("PENROZ_DECODE_PRIORITY_MS", "100")
+    with model_mod.decode_priority():
+        t0 = _time.monotonic()
+        model_mod._yield_to_decodes()
+        waited = _time.monotonic() - t0
+    assert 0.05 < waited < 1.0, waited
+
+    # multi-host: never pauses (one-sided stall of peer collectives)
+    from penroz_tpu.parallel import dist
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    with model_mod.decode_priority():
+        t0 = _time.monotonic()
+        model_mod._yield_to_decodes()
+        assert _time.monotonic() - t0 < 0.05

@@ -567,9 +567,24 @@ def main():
     emit(ttft_ms_p50=round(ttft_ms, 2))
     dispatch_floor = bench_dispatch_floor()
     emit(dispatch_floor_ms=round(dispatch_floor, 2))
-    ttft_busy = bench_ttft_under_train(
-        arch, params, mapper, block=block,
-        **(dict(trials=3, train_batch=2, train_steps=2) if smoke else {}))
+    busy_kw = dict(trials=3, train_batch=2, train_steps=2) if smoke else {}
+    # Policy off first (PENROZ_DECODE_PRIORITY_MS=0 disables the trainer's
+    # between-epoch yield), then on: the delta quantifies decode-priority
+    # dispatch on-chip rather than asserting it.
+    prev_priority = os.environ.get("PENROZ_DECODE_PRIORITY_MS")
+    os.environ["PENROZ_DECODE_PRIORITY_MS"] = "0"
+    try:
+        ttft_nopriority = bench_ttft_under_train(arch, params, mapper,
+                                                 block=block, **busy_kw)
+    finally:
+        if prev_priority is None:
+            os.environ.pop("PENROZ_DECODE_PRIORITY_MS", None)
+        else:
+            os.environ["PENROZ_DECODE_PRIORITY_MS"] = prev_priority
+    if ttft_nopriority is not None:
+        emit(ttft_under_train_nopriority_ms_p50=round(ttft_nopriority, 2))
+    ttft_busy = bench_ttft_under_train(arch, params, mapper, block=block,
+                                       **busy_kw)
     if ttft_busy is not None:
         emit(ttft_under_train_ms_p50=round(ttft_busy, 2))
 

@@ -251,6 +251,8 @@ class Mapper:
             return _olmo2_dsl_from_config(config, n_layer_override)
         if model_type == "olmo":
             return _olmo_dsl_from_config(config, n_layer_override)
+        if model_type == "stablelm":
+            return _stablelm_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -285,6 +287,8 @@ class Mapper:
             return _map_olmo2_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") == "olmo":
             return _map_olmo_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "stablelm":
+            return _map_stablelm_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") in _LLAMA_FAMILY:
             return _map_llama_state_dict(state_dict, n_layer, config)
         return _map_gemma_state_dict(state_dict, n_layer, config)
@@ -988,6 +992,87 @@ def _olmo_dsl_from_config(config, n_layer_override=None) -> list[dict]:
         {"softmaxlast": {"dim": -1}},
     ]
     return layers
+
+
+def _stablelm_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """StableLM HF config → layer DSL: the llama block structure with
+    LayerNorm (weight+bias) instead of RMSNorm, partial rotary
+    (``partial_rotary_factor``, default 0.25), gated silu MLP, optional
+    qkv bias (``use_qkv_bias``), untied-or-tied head.
+    ``use_parallel_residual`` / ``qk_layernorm`` variants are refused
+    rather than silently mis-structured."""
+    cfg = _llama_text_config(config)
+    if getattr(cfg, "use_parallel_residual", False):
+        raise ValueError("use_parallel_residual StableLM checkpoints are "
+                         "not supported")
+    if getattr(cfg, "qk_layernorm", False):
+        raise ValueError("qk_layernorm StableLM checkpoints are not "
+                         "supported")
+    scaling = getattr(cfg, "rope_scaling", None) or None
+    if scaling and (scaling.get("rope_type") or scaling.get("type")
+                    or "default") != "default":
+        raise ValueError(
+            f"stablelm rope_scaling {scaling!r} is not supported; "
+            "importing would produce wrong logits")
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    kv = int(getattr(cfg, "num_key_value_heads", None) or heads)
+    hd = d // heads
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "layer_norm_eps", 1e-5))
+    rope = float(getattr(cfg, "rope_theta", 10000.0) or 10000.0)
+    rope_pct = getattr(cfg, "partial_rotary_factor", None)
+    rope_pct = 0.25 if rope_pct is None else float(rope_pct)
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    qkv_bias = bool(getattr(cfg, "use_qkv_bias", False))
+    inter = int(cfg.intermediate_size)
+    activation = getattr(cfg, "hidden_act", "silu")
+
+    attn_args = {"num_heads": heads, "num_kv_heads": kv, "head_dim": hd,
+                 "dropout": attn_drop}
+    if rope_pct > 0.0:
+        attn_args.update(rope_theta=rope, rope_pct=rope_pct)
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for _ in range(n):
+        layers.append({"transformerblock": {
+            "attn_block": {"sequential": [
+                {"layernorm": {"normalized_shape": d, "eps": eps}},
+                {"linear": {"in_features": d,
+                            "out_features": (heads + 2 * kv) * hd,
+                            "bias": qkv_bias}},
+                {"attention": dict(attn_args)},
+                {"linear": {"in_features": heads * hd, "out_features": d,
+                            "bias": False}}]},
+            "mlp_block": {"sequential": [
+                {"layernorm": {"normalized_shape": d, "eps": eps}},
+                {"gatedmlp": {"in_features": d, "intermediate_size": inter,
+                              "activation": activation}}]},
+            "post_norm_on_residual": False,
+        }})
+    layers += [
+        {"layernorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_stablelm_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """StableLM HF keys → ours: the llama mapping verbatim (same block
+    key layout) plus the LayerNorm biases llama's RMSNorms don't have."""
+    out = _map_llama_state_dict(sd, n_layer, config)
+    for i in range(n_layer):
+        src = f"model.layers.{i}"
+        dst = f"layers.{1 + i}"
+        out[f"{dst}.attn_block.0.bias"] = sd[f"{src}.input_layernorm.bias"]
+        out[f"{dst}.mlp_block.0.bias"] = \
+            sd[f"{src}.post_attention_layernorm.bias"]
+    out[f"layers.{1 + n_layer}.bias"] = sd["model.norm.bias"]
+    return out
 
 
 def _map_olmo_state_dict(sd: dict, n_layer: int, config=None) -> dict:

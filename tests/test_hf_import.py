@@ -761,3 +761,62 @@ def test_olmo_import_logit_parity(workdir, clip_qkv):
     toks = model.generate_tokens([[1, 2, 3]], block_size=16,
                                  max_new_tokens=6, temperature=0.0)
     assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def _tiny_stablelm(use_qkv_bias=True):
+    from transformers import StableLmConfig, StableLmForCausalLM
+    config = StableLmConfig(vocab_size=96, hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=2,
+                            num_key_value_heads=1, intermediate_size=64,
+                            partial_rotary_factor=0.5,
+                            max_position_embeddings=64,
+                            use_qkv_bias=use_qkv_bias,
+                            attention_dropout=0.0,
+                            tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, StableLmForCausalLM(config).eval()
+
+
+@pytest.mark.parametrize("use_qkv_bias", [True, False])
+def test_stablelm_import_logit_parity_and_generate(workdir, use_qkv_bias):
+    """StableLM: llama-shaped blocks with LayerNorm (weight+bias) norms,
+    partial rotary, qkv bias on and off (the DSL bias flag is config-
+    driven while the mapper keys off presence — both must stay in sync);
+    cached greedy == uncached rollout."""
+    config, torch_model = _tiny_stablelm(use_qkv_bias=use_qkv_bias)
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model,
+                          f"stablelm-{'b' if use_qkv_bias else 'nb'}")
+    assert model.status["code"] == "Imported"
+    assert any(k.endswith("attn_block.0.bias") for k in model.params)
+    assert any(k.endswith("attn_block.1.bias")
+               for k in model.params) == use_qkv_bias
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_stablelm_variant_rejections():
+    from transformers import StableLmConfig
+    from penroz_tpu.models.dsl import Mapper
+    par = StableLmConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                         num_attention_heads=2, use_parallel_residual=True)
+    with pytest.raises(ValueError, match="use_parallel_residual"):
+        Mapper.from_hf_config(par)
+    qk = StableLmConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                        num_attention_heads=2, qk_layernorm=True)
+    with pytest.raises(ValueError, match="qk_layernorm"):
+        Mapper.from_hf_config(qk)

@@ -253,6 +253,8 @@ class Mapper:
             return _olmo_dsl_from_config(config, n_layer_override)
         if model_type == "stablelm":
             return _stablelm_dsl_from_config(config, n_layer_override)
+        if model_type == "gptj":
+            return _gptj_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -277,6 +279,9 @@ class Mapper:
                                     config=None) -> dict:
         """Remap an HF state dict (numpy arrays) onto our flat param keys
         (reference: mappers.py:304-448)."""
+        if getattr(config, "model_type", "") == "gptj" or \
+                "transformer.h.0.attn.q_proj.weight" in state_dict:
+            return _map_gptj_state_dict(state_dict, n_layer, config)
         if "transformer.wte.weight" in state_dict:
             return _map_gpt2_state_dict(state_dict, n_layer)
         if "gpt_neox.embed_in.weight" in state_dict:
@@ -690,6 +695,18 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     return layers
 
 
+def _gelu_entry(act: str, family: str) -> dict:
+    """HF activation string → DSL entry (shared by the NeoX/Phi/GPT-J
+    builders; GPT-2 keeps its own historical mapping)."""
+    if act in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"):
+        return {"gelu": {"approximate": "tanh"}}
+    if act == "gelu":
+        return {"gelu": {}}
+    if act == "relu":
+        return {"relu": {}}
+    raise ValueError(f"Unsupported {family} activation: {act!r}")
+
+
 def _neox_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     """GPT-NeoX/Pythia HF config → layer DSL.
 
@@ -721,15 +738,7 @@ def _neox_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     attn_bias = bool(getattr(cfg, "attention_bias", True))
     attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
     hidden_drop = float(getattr(cfg, "hidden_dropout", 0.0) or 0.0)
-    act = getattr(cfg, "hidden_act", "gelu")
-    if act in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"):
-        act_entry = {"gelu": {"approximate": "tanh"}}
-    elif act == "gelu":
-        act_entry = {"gelu": {}}
-    elif act == "relu":
-        act_entry = {"relu": {}}
-    else:
-        raise ValueError(f"Unsupported gpt_neox hidden_act: {act!r}")
+    act_entry = _gelu_entry(getattr(cfg, "hidden_act", "gelu"), "gpt_neox")
     parallel = bool(getattr(cfg, "use_parallel_residual", True))
     inter = int(getattr(cfg, "intermediate_size", None) or 4 * d)
 
@@ -802,13 +811,7 @@ def _phi_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     resid_drop = float(getattr(cfg, "resid_pdrop", 0.0) or 0.0)
     embd_drop = float(getattr(cfg, "embd_pdrop", 0.0) or 0.0)
     inter = int(getattr(cfg, "intermediate_size", None) or 4 * d)
-    act = getattr(cfg, "hidden_act", "gelu_new")
-    if act in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"):
-        act_entry = {"gelu": {"approximate": "tanh"}}
-    elif act == "gelu":
-        act_entry = {"gelu": {}}
-    else:
-        raise ValueError(f"Unsupported phi hidden_act: {act!r}")
+    act_entry = _gelu_entry(getattr(cfg, "hidden_act", "gelu_new"), "phi")
 
     attn_args = {"num_heads": heads, "num_kv_heads": kv, "dropout": attn_drop}
     if rope_pct > 0.0:
@@ -1059,6 +1062,121 @@ def _stablelm_dsl_from_config(config, n_layer_override=None) -> list[dict]:
         {"softmaxlast": {"dim": -1}},
     ]
     return layers
+
+
+def _gptj_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """GPT-J HF config → layer DSL.
+
+    Parallel attention+MLP branches sharing ONE ``ln_1`` per block (the
+    Phi nesting: ``residual([sequential([ln, summation([attn, mlp])])])``
+    — HF ``modeling_gptj`` forward sums both branch outputs onto the
+    residual), bias-free q/k/v/out projections, biased fc_in/fc_out MLP
+    with gelu_new, biased lm_head, and partial INTERLEAVED rotary
+    (``rotary_dim`` dims, rotate-every-two pairs).  The interleave is
+    handled entirely at import: the mapper de-interleaves each head's
+    q/k projection rows into the half-split layout our rope uses — q·k
+    dot products are invariant to a consistent feature permutation, so
+    no runtime rope variant is needed.
+    """
+    cfg = _llama_text_config(config)
+    if getattr(cfg, "tie_word_embeddings", False):
+        # HF drops tied weights on save and the biased head the gptj DSL
+        # builds has no tied analogue — reject with a clear message.
+        raise ValueError("tie_word_embeddings=True gptj checkpoints are "
+                         "not supported")
+    d = int(cfg.hidden_size if hasattr(cfg, "hidden_size") else cfg.n_embd)
+    n = int(n_layer_override if n_layer_override
+            else getattr(cfg, "num_hidden_layers", None) or cfg.n_layer)
+    heads = int(getattr(cfg, "num_attention_heads", None) or cfg.n_head)
+    hd = d // heads
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "layer_norm_epsilon", 1e-5))
+    rotary_dim = int(getattr(cfg, "rotary_dim", None) or hd)
+    attn_drop = float(getattr(cfg, "attn_pdrop", 0.0) or 0.0)
+    resid_drop = float(getattr(cfg, "resid_pdrop", 0.0) or 0.0)
+    embd_drop = float(getattr(cfg, "embd_pdrop", 0.0) or 0.0)
+    inter = int(getattr(cfg, "n_inner", None) or 4 * d)
+    act_entry = _gelu_entry(
+        getattr(cfg, "activation_function", "gelu_new"), "gptj")
+
+    attn_args = {"num_heads": heads, "dropout": attn_drop,
+                 "rope_theta": 10000.0, "rope_dim": rotary_dim}
+    tail_drop = [{"dropout": {"p": resid_drop}}] if resid_drop else []
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    if embd_drop:
+        layers.append({"dropout": {"p": embd_drop}})
+    for _ in range(n):
+        attn_branch = {"sequential": [
+            {"linear": {"in_features": d, "out_features": 3 * d,
+                        "bias": False}},
+            {"attention": dict(attn_args)},
+            {"linear": {"in_features": d, "out_features": d,
+                        "bias": False}}] + tail_drop}
+        mlp_branch = {"sequential": [
+            {"linear": {"in_features": d, "out_features": inter}},
+            act_entry,
+            {"linear": {"in_features": inter, "out_features": d}}]
+            + tail_drop}
+        layers.append({"residual": [{"sequential": [
+            {"layernorm": {"normalized_shape": d, "eps": eps}},
+            {"summation": [attn_branch, mlp_branch]}]}]})
+    layers += [
+        {"layernorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": True}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _gptj_deinterleave(w: np.ndarray, heads: int, rotary_dim: int
+                       ) -> np.ndarray:
+    """Per head, reorder the first ``rotary_dim`` projection rows from
+    GPT-J's interleaved pair layout (x0,x1),(x2,x3)… to the half-split
+    layout (x_even… then x_odd…) our rope rotates; pass-through rows stay
+    put.  Works for (d, d) weights (row-major per-head blocks)."""
+    w = np.asarray(w)
+    hd = w.shape[0] // heads
+    out = w.copy()
+    for h in range(heads):
+        base = h * hd
+        rot = w[base:base + rotary_dim]
+        out[base:base + rotary_dim] = np.concatenate([rot[0::2], rot[1::2]])
+    return out
+
+
+def _map_gptj_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """GPT-J HF keys → ours: q/k rows de-interleaved into half-split
+    rotary layout (see ``_gptj_dsl_from_config``), v untouched, shared
+    ``ln_1`` re-keyed under the residual/summation nesting, biased head
+    kept."""
+    cfg = _llama_text_config(config)
+    d = int(cfg.hidden_size if hasattr(cfg, "hidden_size") else cfg.n_embd)
+    heads = int(getattr(cfg, "num_attention_heads", None) or cfg.n_head)
+    rotary_dim = int(getattr(cfg, "rotary_dim", None) or d // heads)
+    base = 1 + (1 if float(getattr(cfg, "embd_pdrop", 0.0) or 0.0) else 0)
+    out = {"layers.0.weight": sd["transformer.wte.weight"]}
+    for i in range(n_layer):
+        src = f"transformer.h.{i}"
+        dst = f"layers.{base + i}.0"
+        for name in ("weight", "bias"):
+            out[f"{dst}.0.{name}"] = sd[f"{src}.ln_1.{name}"]
+            out[f"{dst}.1.1.0.{name}"] = sd[f"{src}.mlp.fc_in.{name}"]
+            out[f"{dst}.1.1.2.{name}"] = sd[f"{src}.mlp.fc_out.{name}"]
+        out[f"{dst}.1.0.0.weight"] = np.concatenate(
+            [_gptj_deinterleave(sd[f"{src}.attn.q_proj.weight"], heads,
+                                rotary_dim),
+             _gptj_deinterleave(sd[f"{src}.attn.k_proj.weight"], heads,
+                                rotary_dim),
+             np.asarray(sd[f"{src}.attn.v_proj.weight"])], axis=0)
+        out[f"{dst}.1.0.2.weight"] = sd[f"{src}.attn.out_proj.weight"]
+    for name in ("weight", "bias"):
+        out[f"layers.{base + n_layer}.{name}"] = \
+            sd[f"transformer.ln_f.{name}"]
+        out[f"layers.{base + n_layer + 1}.{name}"] = sd[f"lm_head.{name}"]
+    return out
 
 
 def _map_stablelm_state_dict(sd: dict, n_layer: int, config=None) -> dict:

@@ -803,7 +803,7 @@ class CausalSelfAttention(Module):
                  sliding_window: Optional[int] = None,
                  rope_pct: Optional[float] = None,
                  qk_norm: bool = False, qk_norm_eps: float = 1e-6,
-                 qk_norm_scope: str = "head"):
+                 qk_norm_scope: str = "head", rope_dim=None):
         if sliding_window is not None and int(sliding_window) < 1:
             raise ValueError(f"sliding_window must be >= 1, "
                              f"got {sliding_window}")
@@ -833,6 +833,13 @@ class CausalSelfAttention(Module):
         if rope_pct is not None and not 0.0 < float(rope_pct) <= 1.0:
             raise ValueError(f"rope_pct must be in (0, 1], got {rope_pct}")
         self.rope_pct = float(rope_pct) if rope_pct is not None else None
+        # Exact integer rotary width (GPT-J rotary_dim): overrides the
+        # pct-derived value, whose float round-trip can drop 2 dims for
+        # awkward (head_dim, rotary_dim) pairs.
+        if rope_dim is not None and (int(rope_dim) < 2 or int(rope_dim) % 2):
+            raise ValueError(f"rope_dim must be even and >= 2, "
+                             f"got {rope_dim}")
+        self.rope_dim = int(rope_dim) if rope_dim is not None else None
         # llama3-type inverse-frequency rescaling (ops/attention.rope_cos_sin).
         # Validated HERE, at model build time (→ HTTP 400 on POST /model/):
         # the DSL reaches this module directly, so the HF importer's guard
@@ -923,7 +930,10 @@ class CausalSelfAttention(Module):
                 # sequence — rotate with GLOBAL positions, not 0..T_local.
                 offset = offset + jax.lax.axis_index(ctx.sp_manual_axis) * T
             rotary_dim = None
-            if self.rope_pct is not None and self.rope_pct < 1.0:
+            if self.rope_dim is not None:
+                rotary_dim = None if self.rope_dim >= head_dim \
+                    else self.rope_dim
+            elif self.rope_pct is not None and self.rope_pct < 1.0:
                 rotary_dim = int(head_dim * self.rope_pct) // 2 * 2
             q, k = attn_ops.apply_rope(q, k, self.rope_theta, offset,
                                        scaling=self.rope_scaling,

@@ -820,3 +820,43 @@ def test_stablelm_variant_rejections():
                         num_attention_heads=2, qk_layernorm=True)
     with pytest.raises(ValueError, match="qk_layernorm"):
         Mapper.from_hf_config(qk)
+
+
+def _tiny_gptj():
+    from transformers import GPTJConfig, GPTJForCausalLM
+    config = GPTJConfig(vocab_size=96, n_positions=64, n_embd=32, n_layer=2,
+                        n_head=2, rotary_dim=8, n_inner=None,
+                        activation_function="gelu_new", resid_pdrop=0.0,
+                        embd_pdrop=0.0, attn_pdrop=0.0,
+                        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, GPTJForCausalLM(config).eval()
+
+
+def test_gptj_import_logit_parity_and_generate(workdir):
+    """GPT-J: parallel branches sharing one ln_1, bias-free projections,
+    biased head, and partial INTERLEAVED rotary — handled entirely at
+    import by de-interleaving each head's q/k rows into the half-split
+    layout (q·k dot products are permutation-invariant, so no runtime
+    rope variant exists); cached greedy == uncached rollout."""
+    config, torch_model = _tiny_gptj()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "gptj-tiny")
+    assert model.status["code"] == "Imported"
+    assert "summation" in str(model.layers_dsl)
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)

@@ -255,6 +255,8 @@ class Mapper:
             return _stablelm_dsl_from_config(config, n_layer_override)
         if model_type == "gptj":
             return _gptj_dsl_from_config(config, n_layer_override)
+        if model_type == "falcon":
+            return _falcon_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -294,6 +296,8 @@ class Mapper:
             return _map_olmo_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") == "stablelm":
             return _map_stablelm_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "falcon":
+            return _map_falcon_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") in _LLAMA_FAMILY:
             return _map_llama_state_dict(state_dict, n_layer, config)
         return _map_gemma_state_dict(state_dict, n_layer, config)
@@ -1144,6 +1148,178 @@ def _gptj_deinterleave(w: np.ndarray, heads: int, rotary_dim: int
         base = h * hd
         rot = w[base:base + rotary_dim]
         out[base:base + rotary_dim] = np.concatenate([rot[0::2], rot[1::2]])
+    return out
+
+
+def _falcon_arch(cfg) -> tuple[bool, int]:
+    """(new_decoder_architecture, effective num_kv_heads) — HF
+    ``FalconAttention``: kv heads are ``num_kv_heads`` under the new
+    architecture (or when multi_query is off), else 1 (MQA)."""
+    new_arch = bool(getattr(cfg, "new_decoder_architecture", False))
+    if new_arch or not getattr(cfg, "multi_query", True):
+        kv = int(getattr(cfg, "num_kv_heads", None)
+                 or cfg.num_attention_heads)
+    else:
+        kv = 1
+    return new_arch, kv
+
+
+def _falcon_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """Falcon HF config → layer DSL, both decoder architectures:
+
+    - 40B-style (``new_decoder_architecture``): two norms feed PARALLEL
+      attention/MLP branches (``ln_attn``/``ln_mlp``) — the NeoX
+      ``parallelresidual`` container; GQA via ``num_kv_heads``.
+    - 7B-style (``multi_query`` + ``parallel_attn``): ONE
+      ``input_layernorm`` shared by both parallel branches (the Phi
+      nesting) with MQA (1 kv head).
+
+    Full NeoX-style rotary, bias-free projections (``bias``), erf gelu
+    MLP, tied head by default.  Alibi, non-rotary, sequential
+    (``parallel_attn=False``) and single-ln-new-arch
+    (``num_ln_in_parallel_attn=1``) variants are refused loudly.
+    """
+    cfg = _llama_text_config(config)
+    if getattr(cfg, "alibi", False):
+        raise ValueError("alibi Falcon checkpoints are not supported "
+                         "(rotary only)")
+    scaling = getattr(cfg, "rope_scaling", None) or None
+    if scaling and (scaling.get("rope_type") or scaling.get("type")
+                    or "default") != "default":
+        raise ValueError(
+            f"falcon rope_scaling {scaling!r} is not supported; importing "
+            "would produce wrong logits")
+    if not getattr(cfg, "rotary", True):
+        raise ValueError("non-rotary Falcon checkpoints are not supported")
+    new_arch, kv = _falcon_arch(cfg)
+    if not new_arch and not getattr(cfg, "parallel_attn", True):
+        raise ValueError("sequential (parallel_attn=False) Falcon "
+                         "checkpoints are not supported")
+    if new_arch and getattr(cfg, "num_ln_in_parallel_attn", None) == 1:
+        raise ValueError("num_ln_in_parallel_attn=1 Falcon checkpoints "
+                         "are not supported")
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    hd = d // heads
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "layer_norm_epsilon", 1e-5))
+    rope = float(getattr(cfg, "rope_theta", 10000.0) or 10000.0)
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    hidden_drop = float(getattr(cfg, "hidden_dropout", 0.0) or 0.0)
+    bias = bool(getattr(cfg, "bias", False))
+    ffn = int(getattr(cfg, "ffn_hidden_size", None) or 4 * d)
+    act_entry = _gelu_entry(getattr(cfg, "activation", "gelu"), "falcon")
+
+    attn_args = {"num_heads": heads, "num_kv_heads": kv, "head_dim": hd,
+                 "dropout": attn_drop, "rope_theta": rope}
+    tail_drop = [{"dropout": {"p": hidden_drop}}] if hidden_drop else []
+    qkv = {"linear": {"in_features": d,
+                      "out_features": (heads + 2 * kv) * hd, "bias": bias}}
+    dense = {"linear": {"in_features": heads * hd, "out_features": d,
+                        "bias": bias}}
+    h4h = {"linear": {"in_features": d, "out_features": ffn, "bias": bias}}
+    fh = {"linear": {"in_features": ffn, "out_features": d, "bias": bias}}
+    ln = {"layernorm": {"normalized_shape": d, "eps": eps}}
+
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for _ in range(n):
+        if new_arch:
+            layers.append({"parallelresidual": [
+                {"sequential": [dict(ln), qkv, {"attention": dict(attn_args)},
+                                dense] + tail_drop},
+                {"sequential": [dict(ln), h4h, dict(act_entry), fh]
+                 + tail_drop}]})
+        else:
+            layers.append({"residual": [{"sequential": [
+                dict(ln),
+                {"summation": [
+                    {"sequential": [qkv, {"attention": dict(attn_args)},
+                                    dense] + tail_drop},
+                    {"sequential": [h4h, dict(act_entry), fh]
+                     + tail_drop}]},
+            ]}]})
+    layers += [
+        dict(ln),
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _falcon_defuse_qkv(w: np.ndarray, heads: int, kv: int, new_arch: bool,
+                       multi_query: bool) -> np.ndarray:
+    """Falcon fused query_key_value → our [all q; all k; all v] layout.
+
+    - new architecture: per-kv-group blocks [q_0..q_{g-1}, k, v];
+    - old MQA: already [all q, k, v] (kv=1) — identity;
+    - old non-MQA (falcon-rw): per-head [q, k, v] — NeoX interleave.
+    Works for weights (rows, d) and biases (rows,)."""
+    w = np.asarray(w)
+    if new_arch:
+        group = heads // kv
+        hd = w.shape[0] // (kv * (group + 2))
+        blk = w.reshape((kv, group + 2, hd) + w.shape[1:])
+        q = blk[:, :group].reshape((heads * hd,) + w.shape[1:])
+        k = blk[:, group].reshape((kv * hd,) + w.shape[1:])
+        v = blk[:, group + 1].reshape((kv * hd,) + w.shape[1:])
+        return np.concatenate([q, k, v])
+    if multi_query:
+        return w
+    return _neox_deinterleave_qkv(w, heads)
+
+
+def _map_falcon_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """Falcon HF keys → ours: fused QKV de-fused per architecture, the
+    norm layout following the block nesting (parallelresidual for the new
+    architecture, the shared-norm Phi nesting for 7B-style), tied head."""
+    cfg = _llama_text_config(config)
+    new_arch, kv = _falcon_arch(cfg)
+    heads = int(cfg.num_attention_heads)
+    multi_query = bool(getattr(cfg, "multi_query", True))
+    out = {"layers.0.weight": sd["transformer.word_embeddings.weight"]}
+    for i in range(n_layer):
+        src = f"transformer.h.{i}"
+        dst = f"layers.{1 + i}"
+        qkv_w = _falcon_defuse_qkv(
+            sd[f"{src}.self_attention.query_key_value.weight"], heads, kv,
+            new_arch, multi_query)
+        qkv_b = None
+        if f"{src}.self_attention.query_key_value.bias" in sd:
+            qkv_b = _falcon_defuse_qkv(
+                sd[f"{src}.self_attention.query_key_value.bias"], heads, kv,
+                new_arch, multi_query)
+        if new_arch:
+            attn, mlp = f"{dst}.0", f"{dst}.1"
+            for name in ("weight", "bias"):
+                out[f"{attn}.0.{name}"] = sd[f"{src}.ln_attn.{name}"]
+                out[f"{mlp}.0.{name}"] = sd[f"{src}.ln_mlp.{name}"]
+            qkv_at, dense_at, h4h_at, fh_at = (f"{attn}.1", f"{attn}.3",
+                                               f"{mlp}.1", f"{mlp}.3")
+        else:
+            for name in ("weight", "bias"):
+                out[f"{dst}.0.0.{name}"] = \
+                    sd[f"{src}.input_layernorm.{name}"]
+            qkv_at, dense_at, h4h_at, fh_at = (f"{dst}.0.1.0.0",
+                                               f"{dst}.0.1.0.2",
+                                               f"{dst}.0.1.1.0",
+                                               f"{dst}.0.1.1.2")
+        out[f"{qkv_at}.weight"] = qkv_w
+        if qkv_b is not None:
+            out[f"{qkv_at}.bias"] = qkv_b
+        for at, hf in ((dense_at, "self_attention.dense"),
+                       (h4h_at, "mlp.dense_h_to_4h"),
+                       (fh_at, "mlp.dense_4h_to_h")):
+            out[f"{at}.weight"] = sd[f"{src}.{hf}.weight"]
+            if f"{src}.{hf}.bias" in sd:
+                out[f"{at}.bias"] = sd[f"{src}.{hf}.bias"]
+    for name in ("weight", "bias"):
+        out[f"layers.{1 + n_layer}.{name}"] = sd[f"transformer.ln_f.{name}"]
+    out[f"layers.{2 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd["transformer.word_embeddings.weight"])
     return out
 
 

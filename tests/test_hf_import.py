@@ -860,3 +860,64 @@ def test_gptj_import_logit_parity_and_generate(workdir):
     toks = model.generate_tokens([[1, 2, 3]], block_size=16,
                                  max_new_tokens=6, temperature=0.0)
     assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def _tiny_falcon(new_arch=False):
+    from transformers import FalconConfig, FalconForCausalLM
+    kwargs = dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=2, bias=False, alibi=False,
+                  attention_dropout=0.0, hidden_dropout=0.0,
+                  max_position_embeddings=64, tie_word_embeddings=True)
+    if new_arch:
+        kwargs.update(new_decoder_architecture=True, num_kv_heads=1)
+    else:
+        kwargs.update(multi_query=True, parallel_attn=True,
+                      new_decoder_architecture=False)
+    config = FalconConfig(**kwargs)
+    torch.manual_seed(0)
+    return config, FalconForCausalLM(config).eval()
+
+
+@pytest.mark.parametrize("new_arch", [False, True])
+def test_falcon_import_logit_parity_and_generate(workdir, new_arch):
+    """Falcon, both decoder architectures: 7B-style MQA with one shared
+    input_layernorm feeding parallel branches, and 40B-style GQA with
+    separate ln_attn/ln_mlp (NeoX parallelresidual); fused
+    query_key_value de-fused per architecture; tied head."""
+    config, torch_model = _tiny_falcon(new_arch=new_arch)
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    tag = "falcon-new" if new_arch else "falcon-7b"
+    model = _import_model(workdir, config, torch_model, tag)
+    assert model.status["code"] == "Imported"
+    dsl_s = str(model.layers_dsl)
+    assert ("parallelresidual" in dsl_s) == new_arch
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_falcon_variant_rejections():
+    from transformers import FalconConfig
+    from penroz_tpu.models.dsl import Mapper
+    ali = FalconConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                       num_attention_heads=2, alibi=True)
+    with pytest.raises(ValueError, match="alibi"):
+        Mapper.from_hf_config(ali)
+    seqv = FalconConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                        num_attention_heads=2, parallel_attn=False,
+                        new_decoder_architecture=False)
+    with pytest.raises(ValueError, match="parallel_attn"):
+        Mapper.from_hf_config(seqv)

@@ -257,6 +257,8 @@ class Mapper:
             return _gptj_dsl_from_config(config, n_layer_override)
         if model_type == "falcon":
             return _falcon_dsl_from_config(config, n_layer_override)
+        if model_type == "gpt_bigcode":
+            return _bigcode_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -284,6 +286,10 @@ class Mapper:
         if getattr(config, "model_type", "") == "gptj" or \
                 "transformer.h.0.attn.q_proj.weight" in state_dict:
             return _map_gptj_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "gpt_bigcode":
+            # checked BEFORE the gpt2 key sniff: bigcode checkpoints also
+            # carry transformer.wte.weight but use plain nn.Linear layouts
+            return _map_bigcode_state_dict(state_dict, n_layer, config)
         if "transformer.wte.weight" in state_dict:
             return _map_gpt2_state_dict(state_dict, n_layer)
         if "gpt_neox.embed_in.weight" in state_dict:
@@ -359,6 +365,104 @@ def _gpt2_dsl_from_config(config, n_layer_override=None) -> list[dict]:
         {"softmaxlast": {"dim": -1}},
     ]
     return layers
+
+
+def _bigcode_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """GPT-BigCode (StarCoder/SantaCoder) HF config → layer DSL: the
+    GPT-2 structure (learned positions, pre-LN sequential residual,
+    biased projections) with MULTI-QUERY attention — the fused ``c_attn``
+    is ``[all q, k, v]`` with one kv head, exactly our layout — and
+    ``nn.Linear`` weights (no Conv1D transpose, unlike GPT-2).
+    ``multi_query=False`` checkpoints keep all heads."""
+    cfg = _llama_text_config(config)
+    if not getattr(cfg, "scale_attn_weights", True):
+        raise ValueError("scale_attn_weights=False gpt_bigcode "
+                         "checkpoints are not supported; importing would "
+                         "produce wrong logits")
+    d = int(cfg.n_embd if hasattr(cfg, "n_embd") else cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override
+            else getattr(cfg, "num_hidden_layers", None) or cfg.n_layer)
+    heads = int(getattr(cfg, "num_attention_heads", None) or cfg.n_head)
+    kv = 1 if bool(getattr(cfg, "multi_query", True)) else heads
+    hd = d // heads
+    vocab = int(cfg.vocab_size)
+    block = int(getattr(cfg, "n_positions", None)
+                or getattr(cfg, "max_position_embeddings", 1024))
+    eps = float(getattr(cfg, "layer_norm_epsilon", 1e-5))
+    attn_drop = float(getattr(cfg, "attn_pdrop", 0.0) or 0.0)
+    resid_drop = float(getattr(cfg, "resid_pdrop", 0.0) or 0.0)
+    embd_drop = float(getattr(cfg, "embd_pdrop", 0.0) or 0.0)
+    inter = int(getattr(cfg, "n_inner", None) or 4 * d)
+    gelu = _gelu_entry(getattr(cfg, "activation_function",
+                               "gelu_pytorch_tanh"), "gpt_bigcode")
+
+    layers: list[dict] = [
+        {"summation": [
+            {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+             "normal": {"mean": 0.0, "std": 0.02}},
+            {"position": {"num_embeddings": block, "embedding_dim": d},
+             "normal": {"mean": 0.0, "std": 0.02}}]},
+        {"dropout": {"p": embd_drop}},
+    ]
+    for _ in range(n):
+        layers.append({"residual": [
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d, "eps": eps}},
+                {"linear": {"in_features": d,
+                            "out_features": (heads + 2 * kv) * hd}},
+                {"attention": {"num_heads": heads, "num_kv_heads": kv,
+                               "dropout": attn_drop}},
+                {"linear": {"in_features": heads * hd, "out_features": d}},
+                {"dropout": {"p": resid_drop}}]},
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d, "eps": eps}},
+                {"linear": {"in_features": d, "out_features": inter}},
+                gelu,
+                {"linear": {"in_features": inter, "out_features": d}},
+                {"dropout": {"p": resid_drop}}]}]})
+    layers += [
+        {"layernorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_bigcode_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """GPT-BigCode HF keys → ours: plain nn.Linear copies (no Conv1D
+    transpose), tied head fallback.  The fused ``c_attn`` is [all q, k,
+    v] under multi_query (our layout), but multi_query=False checkpoints
+    store it PER-HEAD interleaved [q_h; k_h; v_h] (HF views it as
+    (num_heads, 3·head_dim)) — the NeoX de-interleave reorders it."""
+    cfg = _llama_text_config(config)
+    multi_query = bool(getattr(cfg, "multi_query", True))
+    heads = int(getattr(cfg, "num_attention_heads", None) or cfg.n_head)
+
+    def fix_qkv(w):
+        return w if multi_query else _neox_deinterleave_qkv(w, heads)
+
+    out = {"layers.0.0.weight": sd["transformer.wte.weight"],
+           "layers.0.1.weight": sd["transformer.wpe.weight"]}
+    for i in range(n_layer):
+        src = f"transformer.h.{i}"
+        dst = f"layers.{2 + i}"
+        for at, hf, fix in (
+                (f"{dst}.0.0", "ln_1", None),
+                (f"{dst}.0.1", "attn.c_attn", fix_qkv),
+                (f"{dst}.0.3", "attn.c_proj", None),
+                (f"{dst}.1.0", "ln_2", None),
+                (f"{dst}.1.1", "mlp.c_fc", None),
+                (f"{dst}.1.3", "mlp.c_proj", None)):
+            w = sd[f"{src}.{hf}.weight"]
+            out[f"{at}.weight"] = fix(w) if fix else w
+            if f"{src}.{hf}.bias" in sd:
+                b = sd[f"{src}.{hf}.bias"]
+                out[f"{at}.bias"] = fix(b) if fix else b
+    for name in ("weight", "bias"):
+        out[f"layers.{2 + n_layer}.{name}"] = sd[f"transformer.ln_f.{name}"]
+    out[f"layers.{3 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd["transformer.wte.weight"])
+    return out
 
 
 def _map_gpt2_state_dict(sd: dict, n_layer: int) -> dict:

@@ -666,7 +666,7 @@ def _map_gemma_state_dict(sd: dict, n_layer: int, config=None) -> dict:
 # modules with pre-norm blocks, no +1 norm offset and no embedding scale)
 # ---------------------------------------------------------------------------
 
-_LLAMA_FAMILY = ("llama", "mistral", "mixtral", "qwen2", "qwen3")
+_LLAMA_FAMILY = ("llama", "mistral", "mixtral", "phi3", "qwen2", "qwen3")
 
 
 def _llama_text_config(config):
@@ -741,6 +741,12 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
 
     attn_args = {"num_heads": heads, "num_kv_heads": kv, "rope_theta": rope,
                  "head_dim": hd, "dropout": attn_drop}
+    rope_pct = float(getattr(cfg, "partial_rotary_factor", 1.0) or 1.0)
+    if rope_pct < 1.0:
+        # Phi-3-family configs (e.g. Phi-4-mini ships model_type 'phi3'
+        # with 0.75) rotate only the first pct of each head's dims —
+        # ignoring it would import with silently wrong logits.
+        attn_args["rope_pct"] = rope_pct
     if scaling:
         attn_args["rope_scaling"] = scaling
     if model_type == "qwen3":
@@ -1589,7 +1595,15 @@ def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:
         src = f"{prefix}.layers.{i}"
         dst = f"layers.{1 + i}"
         out[f"{dst}.attn_block.0.weight"] = sd[f"{src}.input_layernorm.weight"]
-        _concat_qkv(sd, src, out, f"{dst}.attn_block.1")
+        if f"{src}.self_attn.qkv_proj.weight" in sd:
+            # Phi-3 stores qkv pre-fused in [q; k; v] order — our layout.
+            out[f"{dst}.attn_block.1.weight"] = \
+                sd[f"{src}.self_attn.qkv_proj.weight"]
+            if f"{src}.self_attn.qkv_proj.bias" in sd:
+                out[f"{dst}.attn_block.1.bias"] = \
+                    sd[f"{src}.self_attn.qkv_proj.bias"]
+        else:
+            _concat_qkv(sd, src, out, f"{dst}.attn_block.1")
         out[f"{dst}.attn_block.3.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
         if f"{src}.self_attn.o_proj.bias" in sd:
             out[f"{dst}.attn_block.3.bias"] = sd[f"{src}.self_attn.o_proj.bias"]
@@ -1600,7 +1614,15 @@ def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:
                 sd[f"{src}.self_attn.k_norm.weight"]
         out[f"{dst}.mlp_block.0.weight"] = \
             sd[f"{src}.post_attention_layernorm.weight"]
-        if f"{src}.block_sparse_moe.gate.weight" in sd:
+        if f"{src}.mlp.gate_up_proj.weight" in sd:
+            # Phi-3 fuses [gate; up] on the output dim; split in half.
+            gu = np.asarray(sd[f"{src}.mlp.gate_up_proj.weight"])
+            half = gu.shape[0] // 2
+            out[f"{dst}.mlp_block.1.gate_proj.weight"] = gu[:half]
+            out[f"{dst}.mlp_block.1.up_proj.weight"] = gu[half:]
+            out[f"{dst}.mlp_block.1.down_proj.weight"] = \
+                sd[f"{src}.mlp.down_proj.weight"]
+        elif f"{src}.block_sparse_moe.gate.weight" in sd:
             # Mixtral sparse MoE: per-expert w1/w3/w2 stack onto our
             # leading-E gate/up/down layout; router gate copies straight.
             out[f"{dst}.mlp_block.1.router.weight"] = \

@@ -963,3 +963,46 @@ def test_bigcode_import_logit_parity_and_generate(workdir, multi_query):
     toks = model.generate_tokens([[1, 2, 3]], block_size=16,
                                  max_new_tokens=6, temperature=0.0)
     assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def _tiny_phi3(partial_rotary_factor=1.0):
+    from transformers import Phi3Config, Phi3ForCausalLM
+    config = Phi3Config(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=2, num_key_value_heads=1,
+                        intermediate_size=64, max_position_embeddings=64,
+                        rope_theta=10000.0, attention_dropout=0.0,
+                        partial_rotary_factor=partial_rotary_factor,
+                        pad_token_id=0,  # default 32000 >= tiny vocab
+                        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return config, Phi3ForCausalLM(config).eval()
+
+
+@pytest.mark.parametrize("partial_rotary_factor", [1.0, 0.5])
+def test_phi3_import_logit_parity_and_generate(workdir,
+                                               partial_rotary_factor):
+    """Phi-3: llama block structure with PRE-FUSED projections — qkv_proj
+    already in our [q; k; v] layout, gate_up_proj split in half onto
+    gate/up; GQA, RMSNorm, silu.  partial_rotary_factor<1 (the Phi-4-mini
+    config shape) must rotate only that fraction of each head's dims."""
+    config, torch_model = _tiny_phi3(partial_rotary_factor)
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    tag = f"phi3-r{int(partial_rotary_factor * 100)}"
+    model = _import_model(workdir, config, torch_model, tag)
+    assert model.status["code"] == "Imported"
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.05)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)

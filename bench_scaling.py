@@ -160,6 +160,7 @@ def _collective_stats(hlo_text: str) -> dict:
                 "pred": 1, "s16": 2, "u16": 2}
     shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
     ops: dict = {}
+    op_bytes: dict = {}
     total = 0
     for m in _COLLECTIVE_RE.finditer(hlo_text):
         shapes, op, started = m.group(1), m.group(2), m.group(3)
@@ -180,8 +181,9 @@ def _collective_stats(hlo_text: str) -> dict:
             # CPU backend emits need no correction.
             nbytes //= 2
         ops[op] = ops.get(op, 0) + 1
+        op_bytes[op] = op_bytes.get(op, 0) + nbytes
         total += nbytes
-    return {"ops": ops, "bytes": total}
+    return {"ops": ops, "bytes": total, "bytes_per_op": op_bytes}
 
 
 def _comm_child() -> None:
@@ -212,12 +214,18 @@ def _comm_child() -> None:
                                    vocab=vocab, block=BLOCK)
 
     def moe_layers():
+        # capacity dispatch: the EP-scalable mode — tokens route to their
+        # expert's owning device via all_to_all (ops/modules.
+        # _apply_capacity_ep) instead of every device computing its
+        # experts for every token and psum-combining (the r04 census
+        # pathology: 34 all-reduces, 11.1s step, zero all-to-all).
         layers = dense_layers()
         moe_mlp = {"sequential": [
             {"layernorm": {"normalized_shape": D_MODEL}},
             {"moe": {"in_features": D_MODEL,
                      "intermediate_size": 2 * D_MODEL,
-                     "num_experts": 4, "top_k": 2}}]}
+                     "num_experts": 4, "top_k": 2,
+                     "dispatch": "capacity"}}]}
         for i in range(2, 2 + DEPTH):
             layers[i]["residual"][1] = moe_mlp
         return layers
@@ -246,11 +254,16 @@ def _comm_child() -> None:
         ("dp", {}, dense_layers, False, False),
         ("tp", {"model": 4}, dense_layers, False, False),
         ("sp", {"sequence": 4}, dense_layers, True, False),
+        # moe_dp: the SAME MoE model on pure data parallelism — the fair
+        # step-time denominator for the ep row (the dense `dp` row runs a
+        # smaller model; capacity-MoE carries ~2.5x its MLP FLOPs).
+        ("moe_dp", {}, moe_layers, False, False),
         ("ep", {"expert": 4}, moe_layers, False, False),
         ("fsdp", {}, dense_layers, False, True),
     ]
     out = []
     for name, axes, layer_fn, use_sp, fsdp in configs:
+        use_ep = "expert" in axes
         mapper = Mapper(layer_fn(), OPTIMIZER)
         arch = CompiledArch.get(mapper.layers)
         params, buffers = mapper.init_params(arch.mods, seed=0)
@@ -276,11 +289,13 @@ def _comm_child() -> None:
                                       shard_sequence=use_sp)
         epoch_fn = arch.train_epoch_fn(
             mapper.optimizer, STEPS, sp_mesh=mesh if use_sp else None,
-            out_shardings=out_shardings)
+            out_shardings=out_shardings,
+            ep_mesh=mesh if use_ep else None)
         stats, step_ms = measure(epoch_fn, params, opt_state, buffers,
                                  xs, ys, jax.random.key(0))
         out.append({"strategy": name, "mesh": dict(mesh.shape),
                     "collective_ops": stats["ops"],
+                    "collective_bytes_per_op": stats["bytes_per_op"],
                     "collective_bytes_per_epoch": stats["bytes"],
                     "step_time_ms": round(step_ms, 2)})
 
@@ -306,6 +321,7 @@ def _comm_child() -> None:
                                  model.buffers, xs, ys, jax.random.key(0))
         out.append({"strategy": "pp", "mesh": dict(mesh.shape),
                     "collective_ops": stats["ops"],
+                    "collective_bytes_per_op": stats["bytes_per_op"],
                     "collective_bytes_per_epoch": stats["bytes"],
                     "step_time_ms": round(step_ms, 2)})
     finally:

@@ -291,6 +291,18 @@ class Mapper:
             # carry transformer.wte.weight but use plain nn.Linear layouts
             return _map_bigcode_state_dict(state_dict, n_layer, config)
         if "transformer.wte.weight" in state_dict:
+            # Config-less safety sniff: GPT-2 Conv1D stores c_attn as
+            # (d, 3d); gpt_bigcode/falcon-style nn.Linear layouts are
+            # (out, in) and would be silently transposed into garbage by
+            # the GPT-2 branch.  Refuse loudly instead of mis-mapping.
+            w = state_dict.get("transformer.h.0.attn.c_attn.weight")
+            if config is None and w is not None \
+                    and w.shape[1] != 3 * w.shape[0]:
+                raise ValueError(
+                    "state dict has transformer.wte.weight but c_attn is "
+                    f"not Conv1D-shaped ({tuple(w.shape)}); pass the HF "
+                    "config so the family (gpt_bigcode/falcon/...) can be "
+                    "dispatched correctly")
             return _map_gpt2_state_dict(state_dict, n_layer)
         if "gpt_neox.embed_in.weight" in state_dict:
             return _map_neox_state_dict(state_dict, n_layer, config)
@@ -1034,6 +1046,7 @@ def _olmo2_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                                "rope_theta": rope, "head_dim": hd,
                                "dropout": attn_drop, "qk_norm": True,
                                "qk_norm_scope": "flat",
+                               "qk_norm_fp32_weight": True,
                                "qk_norm_eps": eps}},
                 {"linear": {"in_features": heads * hd, "out_features": d,
                             "bias": bias}},

@@ -24,6 +24,7 @@ bounding per-token dispatch overhead, compile variants, and host round-trips.
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import json
 import logging
@@ -64,6 +65,22 @@ _TRAIN_SEQ: dict = {}
 _DECODE_PENDING = 0
 _DECODE_LOCK = threading.Lock()
 
+# Live training-worker subprocesses by model id (PENROZ_TRAIN_WORKER=1):
+# observability + test hook; entries removed as workers exit.  The atexit
+# sweep covers clean parent shutdown; the worker also self-terminates on
+# parent death (train_worker._watch_parent) so a SIGKILLed server never
+# leaves an orphan racing checkpoint writes against its replacement.
+_TRAIN_WORKERS: dict = {}
+
+
+def _kill_train_workers():
+    for proc in list(_TRAIN_WORKERS.values()):
+        if proc.poll() is None:
+            proc.kill()
+
+
+atexit.register(_kill_train_workers)
+
 
 @contextlib.contextmanager
 def decode_priority():
@@ -95,6 +112,50 @@ def _yield_to_decodes():
     deadline = time.monotonic() + cap_ms / 1000.0
     while decode_pending() > 0 and time.monotonic() < deadline:
         time.sleep(0.005)
+
+
+def _sharded_zero_grads(params: dict) -> dict:
+    """fp32 zero-gradient tree laid out like ``params`` — shard-local
+    allocation via ``make_array_from_callback``, so a ZeRO-3/TP-sharded
+    model never materializes its full unsharded gradient tree on one
+    device (the fused epoch's zeros are born inside jit under GSPMD;
+    this is the eager-side equivalent for the micro-step driver)."""
+    out = {}
+    for k, v in params.items():
+        sharding = getattr(v, "sharding", None)
+        if sharding is None:
+            out[k] = jnp.zeros(v.shape, jnp.float32)
+            continue
+
+        def shard_zeros(idx, shape=v.shape):
+            dims = tuple((sl.stop if sl.stop is not None else d)
+                         - (sl.start or 0) for sl, d in zip(idx, shape))
+            return np.zeros(dims, np.float32)
+
+        out[k] = jax.make_array_from_callback(v.shape, sharding,
+                                              shard_zeros)
+    return out
+
+
+def run_microstepped_epoch(micro_fn, finalize_fn, params, opt_state,
+                           buffers, xs, ys, rng, num_steps: int,
+                           yield_cb=None):
+    """Drive one epoch through ``CompiledArch.train_micro_fns`` programs:
+    one device dispatch per micro-step with a decode-priority window
+    (``yield_cb``, default :func:`_yield_to_decodes`) opened between
+    them.  Shared by the /train/ path and bench.py's background trainer
+    so the TTFT benchmark measures exactly the production policy."""
+    if yield_cb is None:
+        yield_cb = _yield_to_decodes
+    grads = _sharded_zero_grads(params)
+    cost = jnp.zeros((), jnp.float32)
+    bufs = buffers
+    for i in range(num_steps):
+        if i:
+            yield_cb()
+        bufs, grads, cost = micro_fn(params, bufs, grads, cost,
+                                     xs[i], ys[i], rng, i)
+    return finalize_fn(params, opt_state, grads, bufs, cost)
 
 
 def _check_pipe_composition(pipe: int, seq: int) -> None:
@@ -231,10 +292,11 @@ class CompiledArch:
 
     def _apply(self, params, buffers, x, *, training=False, rng=None, kv=None,
                pos_offset=None, skip_softmax=False, compute_dtype=None,
-               sp_mesh=None, platform=None, sp_mode="ring"):
+               sp_mesh=None, platform=None, sp_mode="ring", ep_mesh=None):
         ctx = M.Ctx(params, buffers, training=training, rng=rng, kv=kv,
                     pos_offset=pos_offset, compute_dtype=compute_dtype,
-                    sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode)
+                    sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode,
+                    ep_mesh=ep_mesh)
         acts = []
         h = x
         logits = None
@@ -266,7 +328,7 @@ class CompiledArch:
     def forward(self, params, buffers, tokens, targets=None, *,
                 training=False, rng=None, kv=None, pos_offset=None,
                 skip_softmax=False, compute_dtype=None, sp_mesh=None,
-                platform=None, sp_mode="ring"):
+                platform=None, sp_mode="ring", ep_mesh=None):
         """Full forward collecting every top-level activation.
 
         Returns ``(activations, cost, buffer_updates, new_kv)``; ``cost`` is
@@ -276,7 +338,7 @@ class CompiledArch:
             params, buffers, tokens, training=training, rng=rng, kv=kv,
             pos_offset=pos_offset, skip_softmax=skip_softmax,
             compute_dtype=compute_dtype, sp_mesh=sp_mesh, platform=platform,
-            sp_mode=sp_mode)
+            sp_mode=sp_mode, ep_mesh=ep_mesh)
         cost = (self._cost_from_logits(logits, targets, platform=platform)
                 if targets is not None else None)
         if cost is not None and ctx.aux_losses:
@@ -311,7 +373,8 @@ class CompiledArch:
         return fn(params, buffers, tokens, targets)
 
     def eval_cost_fn(self, params, buffers, tokens, targets, *,
-                     platform=None, sp_mesh=None, sp_mode="ring"):
+                     platform=None, sp_mesh=None, sp_mode="ring",
+                     ep_mesh=None):
         """Cost-only jitted forward for ``/evaluate/``.
 
         Returning just the scalar lets XLA dead-code-eliminate every
@@ -323,14 +386,15 @@ class CompiledArch:
         enables the same ring/all-to-all sequence-parallel attention the
         training epoch uses, for sequence-sharded eval batches.
         """
-        key = ("evalcost", platform, sp_mesh, sp_mode)
+        key = ("evalcost", platform, sp_mesh, sp_mode, ep_mesh)
         fn = self._jit_cache.get(key)
         if fn is None:
             def fwd(p, b, t, y):
                 _, cost, _, _ = self.forward(p, b, t, y, skip_softmax=True,
                                              sp_mesh=sp_mesh,
                                              sp_mode=sp_mode,
-                                             platform=platform)
+                                             platform=platform,
+                                             ep_mesh=ep_mesh)
                 return cost
             fn = self._jit_cache[key] = jax.jit(fwd)
         return fn(params, buffers, tokens, targets)
@@ -341,7 +405,8 @@ class CompiledArch:
                        remat: bool = False, compute_dtype=None, sp_mesh=None,
                        platform=None, with_ratios: bool = True,
                        out_shardings=None, sp_mode: str = "ring",
-                       pipe_cfg=None, pipe_remat: str = "block"):
+                       pipe_cfg=None, pipe_remat: str = "block",
+                       ep_mesh=None):
         """One jitted epoch: ``num_steps`` grad-accumulation micro-steps via
         ``lax.scan`` then a single optax update (reference hot loop:
         neural_net_model.py:614-677; sync deferred to the final micro-step is
@@ -383,7 +448,7 @@ class CompiledArch:
                platform, bool(with_ratios), shard_key, sp_mode,
                (pipe_cfg[0], pipe_cfg[1], pipe_cfg[2], pipe_cfg[3])
                if pipe_cfg else None,
-               pipe_remat if pipe_cfg is not None else None)
+               pipe_remat if pipe_cfg is not None else None, ep_mesh)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -395,7 +460,8 @@ class CompiledArch:
                 _, cost, buf_upd, _ = self.forward(
                     params, buffers, x, y, training=True, rng=rng,
                     skip_softmax=True, compute_dtype=compute_dtype,
-                    sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode)
+                    sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode,
+                    ep_mesh=ep_mesh)
                 return cost, buf_upd
         else:
             loss_fn = self._pipelined_loss_fn(pipe_cfg, compute_dtype,
@@ -436,6 +502,23 @@ class CompiledArch:
             init = (zeros, buffers, jnp.zeros((), jnp.float32), 0)
             (grads, new_buffers, cost_sum, _), _ = jax.lax.scan(
                 micro, init, (xs, ys))
+            return finalize(params, opt_state, grads, new_buffers, cost_sum)
+
+        finalize = self._finalize_update_fn(optimizer, num_steps,
+                                            out_shardings, with_ratios,
+                                            pipe_cfg)
+        fn = jax.jit(epoch, donate_argnums=(0, 1))
+        self._jit_cache[key] = fn
+        return fn
+
+    def _finalize_update_fn(self, optimizer, num_steps: int, out_shardings,
+                            with_ratios: bool, pipe_cfg):
+        """Pure epoch tail shared by the fused epoch program and the
+        micro-chunked decode-priority path: average the accumulated
+        grads, apply the optax update (+sharding pins), derive the
+        update-ratio stds."""
+
+        def finalize(params, opt_state, grads, new_buffers, cost_sum):
             inv = 1.0 / num_steps
             cost = cost_sum * inv
             grads = jax.tree.map(
@@ -481,9 +564,93 @@ class CompiledArch:
                       if self.param_order else jnp.zeros((0,)))
             return new_params, new_opt_state, new_buffers, cost, ratios
 
-        fn = jax.jit(epoch, donate_argnums=(0, 1))
-        self._jit_cache[key] = fn
-        return fn
+        return finalize
+
+    def train_micro_fns(self, optimizer_config: dict, num_steps: int,
+                        remat: bool = False, compute_dtype=None,
+                        sp_mesh=None, platform=None,
+                        with_ratios: bool = True, out_shardings=None,
+                        sp_mode: str = "ring", ep_mesh=None):
+        """The fused :meth:`train_epoch_fn` program split at grad-accum
+        micro-step boundaries for decode-priority dispatch: with the epoch
+        issued one micro-step per device program, a pending ``/generate/``
+        dispatch slips onto the chip between micro-steps instead of
+        waiting out the whole epoch — worst-case added TTFT drops from
+        one epoch to one micro-step (the reference bounds this with
+        process isolation instead: main.py:461-464).
+
+        Returns ``(micro_fn, finalize_fn)``:
+
+        - ``micro_fn(params, buffers, grads, cost, x, y, rng, i)`` →
+          ``(buffers, grads, cost)`` — one micro-step's grads accumulated
+          in fp32.
+        - ``finalize_fn(params, opt_state, grads, buffers, cost)`` → the
+          epoch fn's 5-tuple.
+
+        Numerics match the fused epoch to fp tolerance: same
+        ``fold_in(rng, i)`` stream, same fp32 accumulation order, the
+        identical finalize body (``_finalize_update_fn``) — bitwise
+        equality is NOT guaranteed (the standalone micro program fuses
+        differently than the scanned epoch body).  The params'
+        compute-dtype cast runs
+        once per micro dispatch instead of once per epoch — identical
+        values, ``num_steps-1`` extra cast passes, the price of
+        preemptibility.  Pipelined (``pipe_cfg``) training keeps the
+        fused path: its schedule is one shard_map program by design.
+        """
+        key = ("microstep", json.dumps(optimizer_config, sort_keys=True),
+               int(num_steps), bool(remat), str(compute_dtype), sp_mesh,
+               platform, bool(with_ratios),
+               (tuple(sorted(out_shardings[0].items())),
+                tuple(jax.tree.leaves(out_shardings[1])))
+               if out_shardings is not None else None, sp_mode, ep_mesh)
+        cached = self._jit_cache.get(key)
+        if cached is not None:
+            return cached
+
+        optimizer = dsl.build_optimizer(optimizer_config)
+
+        def loss_fn(params, buffers, x, y, rng):
+            _, cost, buf_upd, _ = self.forward(
+                params, buffers, x, y, training=True, rng=rng,
+                skip_softmax=True, compute_dtype=compute_dtype,
+                sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode,
+                ep_mesh=ep_mesh)
+            return cost, buf_upd
+
+        if remat:
+            loss_fn = jax.checkpoint(loss_fn)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def micro(params, bufs, grads_acc, cost_acc, x, y, rng, i):
+            if compute_dtype is not None:
+                params_c = {
+                    k: v.astype(compute_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v
+                    for k, v in params.items()}
+            else:
+                params_c = params
+            (cost, upd), grads = grad_fn(params_c, bufs, x, y,
+                                         jax.random.fold_in(rng, i))
+            bufs = {**bufs, **upd}
+            grads_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
+            return bufs, grads_acc, cost_acc + cost
+
+        finalize = self._finalize_update_fn(optimizer, num_steps,
+                                            out_shardings, with_ratios,
+                                            None)
+        # Donation is restricted to carries a concurrent decode can never
+        # see (grads/cost accumulators, the optimizer state): the whole
+        # point of this path is /generate/ reading self.params and
+        # self.buffers BETWEEN micro dispatches, so neither may be donated
+        # (the fused epoch donates params safely because nothing yields
+        # mid-program).  The price is one transient extra params copy at
+        # finalize.
+        fns = (jax.jit(micro, donate_argnums=(2, 3)),
+               jax.jit(finalize, donate_argnums=(1, 2)))
+        self._jit_cache[key] = fns
+        return fns
 
     def _pipelined_loss_fn(self, pipe_cfg, compute_dtype, platform,
                            pipe_remat: str = "block",
@@ -832,6 +999,7 @@ class NeuralNetworkModel:
                                    idx_offset=buffer_size * world)
         mesh = self._eval_mesh(batch_size, block_size)
         sp_mesh = None
+        ep_mesh = None
         sp_mode = os.environ.get("PENROZ_SP_MODE", "ring")
         if mesh is not None:
             log.info("Evaluating over device mesh %s", dict(mesh.shape))
@@ -844,6 +1012,8 @@ class NeuralNetworkModel:
                     raise ValueError(f"PENROZ_SP_MODE={sp_mode!r}; "
                                      "expected 'ring' or 'alltoall'")
                 sp_mesh = mesh
+            if mesh.shape[mesh_lib.EXPERT_AXIS] > 1:
+                ep_mesh = mesh
             # Mirror the training layout (TP over `model`, experts over
             # `expert`, ZeRO-3 over `data` when PENROZ_FSDP=1) so an
             # already-mesh-placed model is a no-op and a freshly loaded one
@@ -874,7 +1044,8 @@ class NeuralNetworkModel:
                 y = jnp.asarray(y)
             cost = self.arch.eval_cost_fn(self.params, self.buffers, x, y,
                                           platform=self._platform,
-                                          sp_mesh=sp_mesh, sp_mode=sp_mode)
+                                          sp_mesh=sp_mesh, sp_mode=sp_mode,
+                                          ep_mesh=ep_mesh)
             avg_cost += float(cost) / epochs
         # Under a global multi-host mesh the compiled cost is already the
         # global-batch mean (identical on every process), so this reduce is
@@ -948,6 +1119,7 @@ class NeuralNetworkModel:
             if master:
                 self.serialize()
             sp_mesh = None
+            ep_mesh = None
             epoch_out_shardings = None
             pipe_cfg = None
             if mesh is not None and mesh.shape[mesh_lib.PIPE_AXIS] > 1:
@@ -983,6 +1155,11 @@ class NeuralNetworkModel:
                     for k, v in self.buffers.items()}
                 if mesh.shape[mesh_lib.SEQ_AXIS] > 1:
                     sp_mesh = mesh
+                if mesh.shape[mesh_lib.EXPERT_AXIS] > 1:
+                    # MoE capacity dispatch routes tokens over the expert
+                    # axis via all_to_all (ops/modules._apply_capacity_ep)
+                    # instead of the dense-combine psum.
+                    ep_mesh = mesh
             # With cross-host-sharded state every process must persist its
             # own shard file at each checkpoint; the master also writes the
             # metadata blob (serialize() handles the split internally).
@@ -1045,7 +1222,7 @@ class NeuralNetworkModel:
                 compute_dtype=compute_dtype, sp_mesh=sp_mesh,
                 platform=self._platform,
                 out_shardings=epoch_out_shardings, sp_mode=sp_mode,
-                pipe_cfg=pipe_cfg, pipe_remat=pipe_remat)
+                pipe_cfg=pipe_cfg, pipe_remat=pipe_remat, ep_mesh=ep_mesh)
             # Non-sampled epochs skip the two full parameter passes the
             # update-ratio stds cost.  The choice is a pure function of the
             # epoch index so every host runs the same compiled program
@@ -1061,7 +1238,8 @@ class NeuralNetworkModel:
                                          out_shardings=epoch_out_shardings,
                                          sp_mode=sp_mode,
                                          pipe_cfg=pipe_cfg,
-                                         pipe_remat=pipe_remat)
+                                         pipe_remat=pipe_remat,
+                                         ep_mesh=ep_mesh)
                 if sample_every > 1 else epoch_fn)
             rng = jax.random.key(0)
             last_save = time.monotonic()
@@ -1107,10 +1285,30 @@ class NeuralNetworkModel:
                         process_replicated=pipe_over_hosts)
                 sampled = epoch % sample_every == 0
                 fn = epoch_fn if sampled else epoch_fn_fast
+                # Micro-step granularity when a decode is in flight: the
+                # fused epoch is one device program a /generate/ can only
+                # wait out; chunked dispatch bounds the decode's wait to
+                # one micro-step (+ its own work).  Fused otherwise — the
+                # chunked path pays per-dispatch overhead num_steps times.
+                use_micro = (pipe_cfg is None and world == 1
+                             and num_steps > 1 and decode_pending() > 0
+                             and float(os.environ.get(
+                                 "PENROZ_DECODE_PRIORITY_MS", "1000")) > 0)
                 with profiling.span("penroz/train_epoch"):
-                    self.params, self.opt_state, self.buffers, cost, ratios = \
-                        fn(self.params, self.opt_state, self.buffers,
-                           xs, ys, jax.random.fold_in(rng, epoch))
+                    if use_micro:
+                        (self.params, self.opt_state, self.buffers, cost,
+                         ratios) = self._train_epoch_microstepped(
+                            xs, ys, jax.random.fold_in(rng, epoch),
+                            num_steps, remat=remat,
+                            compute_dtype=compute_dtype, sp_mesh=sp_mesh,
+                            out_shardings=epoch_out_shardings,
+                            sp_mode=sp_mode, ep_mesh=ep_mesh,
+                            with_ratios=sampled)
+                    else:
+                        (self.params, self.opt_state, self.buffers, cost,
+                         ratios) = fn(self.params, self.opt_state,
+                                      self.buffers, xs, ys,
+                                      jax.random.fold_in(rng, epoch))
                 cost = float(cost)
                 duration = time.monotonic() - t0
                 if master:
@@ -1206,6 +1404,23 @@ class NeuralNetworkModel:
                 self.avg_cost_history.pop(random.randint(1, 98))
         if last_batch is not None:
             self.stats = self._compute_stats(*last_batch)
+
+    def _train_epoch_microstepped(self, xs, ys, call_rng, num_steps: int, *,
+                                  remat, compute_dtype, sp_mesh,
+                                  out_shardings, sp_mode, ep_mesh,
+                                  with_ratios: bool):
+        """Decode-priority epoch: one device program per micro-step, with a
+        priority window opened before each so pending ``/generate/``
+        dispatches interleave at micro-step granularity (see
+        ``CompiledArch.train_micro_fns`` for the numerics contract)."""
+        micro_fn, finalize_fn = self.arch.train_micro_fns(
+            self.optimizer_config, num_steps, remat=remat,
+            compute_dtype=compute_dtype, sp_mesh=sp_mesh,
+            platform=self._platform, with_ratios=with_ratios,
+            out_shardings=out_shardings, sp_mode=sp_mode, ep_mesh=ep_mesh)
+        return run_microstepped_epoch(micro_fn, finalize_fn, self.params,
+                                      self.opt_state, self.buffers, xs, ys,
+                                      call_rng, num_steps)
 
     def _training_mesh(self, micro_batch: int, block_size: int):
         """Device mesh for the training run (None = single device).
@@ -1591,12 +1806,79 @@ class NeuralNetworkModel:
                               epochs, batch_size, block_size, step_size):
         """Worker entry: deserialize → place → train (reference DDP worker:
         neural_net_model.py:516-550, minus the process tree — one process
-        owns the TPU runtime and the mesh handles per-chip parallelism)."""
+        owns the TPU runtime and the mesh handles per-chip parallelism).
+
+        ``PENROZ_TRAIN_WORKER=1`` (single-host only) instead trains in a
+        CHILD process — the reference's crash-containment shape
+        (main.py:461-464 spawns ``mp.Process``): a native crash in
+        training (XLA abort, OOM kill, libtpu segfault) kills the worker,
+        never the serving process.  State flows through the existing
+        checkpoint stream (the worker serializes every ~10s; every API
+        route deserializes), so /progress/ and /stats/ keep updating
+        while the worker runs.  Caveat: a real TPU chip is single-process
+        — worker mode fits deployments where training owns the
+        accelerator and the parent serves from CPU/another chip, or
+        relay backends that multiplex; it is opt-in for exactly that
+        reason.
+        """
+        if (os.environ.get("PENROZ_TRAIN_WORKER", "0") == "1"
+                and dist.process_count() == 1):
+            return cls._train_in_worker_process(
+                model_id, device, dataset_id, shard, epochs, batch_size,
+                block_size, step_size)
         model = cls.deserialize(model_id)
         model.to_device(device)
         model.train_model(dataset_id, shard=shard, epochs=epochs,
                           batch_size=batch_size, block_size=block_size,
                           step_size=step_size)
+        return model
+
+    @classmethod
+    def _train_in_worker_process(cls, model_id, device, dataset_id, shard,
+                                 epochs, batch_size, block_size, step_size):
+        """Run the training job in a subprocess and contain its crashes.
+
+        The parent blocks (callers already run this on an executor
+        thread), watches the worker, and post-mortems the checkpoint: a
+        worker that died mid-run leaves status ``Training`` behind, which
+        the parent rewrites to ``Error`` — the same contract as the
+        startup orphan sweep (serve/app.py::_sweep_orphaned_training),
+        applied the moment the death is observed instead of at the next
+        restart."""
+        import subprocess
+        import sys
+        args = {"model_id": model_id, "device": device,
+                "dataset_id": dataset_id, "shard": shard, "epochs": epochs,
+                "batch_size": batch_size, "block_size": block_size,
+                "step_size": step_size}
+        env = dict(os.environ)
+        env.pop("PENROZ_TRAIN_WORKER", None)  # the child trains in-process
+        from penroz_tpu.utils import checkpoint
+        env["PENROZ_SHM_PATH"] = checkpoint.SHM_PATH
+        # The child runs in the parent's cwd (model/data folders are
+        # relative), which need not contain the package — resolve imports
+        # from this install's location.
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        prev = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = repo + (os.pathsep + prev if prev else "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "penroz_tpu.models.train_worker",
+             json.dumps(args)], env=env, cwd=os.getcwd())
+        _TRAIN_WORKERS[model_id] = proc
+        try:
+            rc = proc.wait()
+        finally:
+            _TRAIN_WORKERS.pop(model_id, None)
+        model = cls.deserialize(model_id)
+        if rc != 0 and model.status.get("code") == "Training":
+            log.error("Training worker for model %s died (rc=%s); marking "
+                      "Error", model_id, rc)
+            model.status = {
+                "code": "Error",
+                "message": f"Training worker died (rc={rc}); last "
+                           f"checkpoint retained"}
+            model.serialize(sync_flush=True)
         return model
 
     def _compute_stats(self, x, y) -> dict:
@@ -1646,6 +1928,95 @@ class NeuralNetworkModel:
         dt = self.dtype
         return dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
 
+    def _decode_mesh(self):
+        """Device mesh for generation (None = single-device decode).
+
+        TP-sharded decode: attention-head K/V buffers and the Megatron
+        weight layout shard over ``model``, stacked MoE expert weights
+        over ``expert``, sampling replicated — so an imported model larger
+        than one chip's HBM can *serve*, not just train/evaluate
+        (reference decode is single-device too: neural_net_model.py:
+        360-406; this is the beyond-parity axis).  Uses the first
+        model×expert local devices; generation has no data axis (a single
+        stream cannot batch-shard, and the batched path's rows arrive
+        ragged).  Gated to the contiguous fp/bf16 cache — the paged and
+        int8 layouts keep single-device decode (their block tables and
+        scale planes have no mesh layout yet).
+        """
+        if dist.process_count() > 1:
+            return None  # serving is per-host; the API serves local chips
+        if os.environ.get("PENROZ_TRAIN_MESH", "1") == "0":
+            return None
+        if KV.paged_enabled() or KV.turbo_quant_enabled():
+            return None
+        try:
+            model = int(os.environ.get("PENROZ_MESH_MODEL", "1"))
+            expert = int(os.environ.get("PENROZ_MESH_EXPERT", "1"))
+        except ValueError:
+            log.warning("Invalid PENROZ_MESH_MODEL/PENROZ_MESH_EXPERT; "
+                        "falling back to single-device decode")
+            return None
+        if model < 1 or expert < 1 or model * expert <= 1:
+            return None
+        try:
+            platform = (self.device.platform if self.device is not None
+                        else None)
+            devices = (jax.local_devices(backend=platform) if platform
+                       else jax.local_devices())
+        except RuntimeError:
+            return None
+        if len(devices) < model * expert:
+            return None
+        return mesh_lib.make_mesh(devices[:model * expert], model=model,
+                                  expert=expert)
+
+    def _kv_sharding_tree(self, kv, mesh):
+        """Sharding pytree for a contiguous KVState: (B, Hkv, S, D) leaves
+        shard heads over ``model`` when every attention layer's KV head
+        count divides the axis (GQA models with few KV heads stay
+        replicated — a torn head is worse than a copied cache); lengths
+        and scalars replicate."""
+        from jax.sharding import PartitionSpec as P
+        tp = mesh.shape[mesh_lib.MODEL_AXIS]
+        heads_ok = all(h % tp == 0 for h, _ in self.arch.kv_specs)
+        kv_spec = P(None, mesh_lib.MODEL_AXIS if heads_ok and tp > 1
+                    else None, None, None)
+
+        def leaf_sharding(leaf):
+            spec = kv_spec if getattr(leaf, "ndim", 0) == 4 else P()
+            return jax.sharding.NamedSharding(mesh, spec)
+
+        return jax.tree.map(leaf_sharding, kv)
+
+    def _enter_decode_mesh(self, kv):
+        """Place params/buffers/cache for mesh decode; returns the placed
+        cache (identity when no decode mesh is configured)."""
+        mesh = self._decode_mesh()
+        if mesh is None:
+            return kv
+        if any(k.startswith("__pipe__") for k in self.params):
+            return kv  # mid-pipeline-training layout: leave decode alone
+        live = [v for v in self.params.values()
+                if isinstance(getattr(v, "sharding", None),
+                              jax.sharding.NamedSharding)
+                and len(v.sharding.device_set) > 1]
+        if live:
+            # Params already live on a (training/eval) mesh — do NOT
+            # reshard them: gathering ZeRO-3 storage onto the decode
+            # submesh could OOM the exact models FSDP exists for, and a
+            # decode interleaving with mesh training would flip layouts
+            # every time (full param copy + micro-step recompile).  GSPMD
+            # decodes fine on the existing layout; only the fresh KV
+            # cache follows that mesh.
+            return jax.device_put(
+                kv, self._kv_sharding_tree(kv, live[0].sharding.mesh))
+        log.info("Generating over device mesh %s", dict(mesh.shape))
+        self.params = sharding_lib.shard_params(self.params, mesh)
+        self.buffers = {
+            k: sharding_lib.place(v, mesh_lib.replicated(mesh))
+            for k, v in self.buffers.items()}
+        return jax.device_put(kv, self._kv_sharding_tree(kv, mesh))
+
     def _kv_specs(self, batch: int = 1, max_len: int = 0):
         return self.arch.kv_specs
 
@@ -1683,6 +2054,7 @@ class NeuralNetworkModel:
         # long contexts need no auto-paging heuristic.
         kv = KV.create_kv_state(self.arch.kv_specs, 1, block_size,
                                 self._kv_dtype())
+        kv = self._enter_decode_mesh(kv)
         cache_len = 0
         produced = 0    # tokens yielded to the caller
         dispatched = 0  # tokens sampled on-device (may run one chunk ahead)
@@ -1848,6 +2220,7 @@ class NeuralNetworkModel:
         # the allocator, appends, and the ragged kernels).
         kv = KV.create_kv_state(arch.kv_specs, B, block_size,
                                 self._kv_dtype())
+        kv = self._enter_decode_mesh(kv)
         lengths = jnp.asarray(lens, jnp.int32)
         done = [False] * B
 

@@ -1,0 +1,53 @@
+"""Training-worker subprocess entry (``PENROZ_TRAIN_WORKER=1``).
+
+The serving parent spawns ``python -m penroz_tpu.models.train_worker
+'<json args>'`` so a native crash in training (XLA CHECK-abort, OOM kill,
+accelerator runtime segfault) kills THIS process, never the API server —
+the reference's containment shape (``/root/reference/main.py:461-464``
+forks an ``mp.Process`` per training run).  All state flows through the
+checkpoint stream: the trainer serializes every ~10 s and on completion;
+the parent post-mortems the final status
+(``NeuralNetworkModel._train_in_worker_process``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _watch_parent(parent_pid: int) -> None:
+    """Exit when the serving parent dies (reparented to init/subreaper).
+
+    The parent's atexit sweep covers clean shutdowns; this covers the
+    SIGKILLed server: an orphaned worker would keep serializing status
+    'Training' every ~10 s and race checkpoint writes against a
+    restarted server's orphan sweep (status flip-flop, torn files)."""
+    while True:
+        if os.getppid() != parent_pid:
+            print("train_worker: parent died; exiting", file=sys.stderr,
+                  flush=True)
+            os._exit(1)
+        time.sleep(2.0)
+
+
+def main(argv: list[str]) -> int:
+    args = json.loads(argv[0])
+    threading.Thread(target=_watch_parent, args=(os.getppid(),),
+                     daemon=True).start()
+    from penroz_tpu.models.model import NeuralNetworkModel
+    model = NeuralNetworkModel.train_model_on_device(
+        args["model_id"], args["device"], args["dataset_id"], args["shard"],
+        args["epochs"], args["batch_size"], args["block_size"],
+        args["step_size"])
+    # In-process training records failures as status Error and returns;
+    # propagate that as a nonzero exit so the parent logs the death even
+    # when it was a clean Python-level failure.
+    return 0 if model.status.get("code") == "Trained" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -137,9 +137,25 @@ def barrier(name: str, timeout_s: float = 600.0) -> None:
     """
     if process_count() == 1:
         return
-    from jax._src import distributed
-    client = getattr(distributed.global_state, "client", None)
+    client = None
+    try:
+        from jax._src import distributed
+        client = getattr(distributed.global_state, "client", None)
+    except ImportError:
+        pass
     if client is None:
+        # The private coordination-service client moved or was never
+        # initialised.  A silent no-op here would reintroduce the lazy
+        # comm-group timeout race this fence exists to prevent — fall back
+        # to the public device-collective barrier and say so loudly.
+        import logging
+        logging.getLogger(__name__).error(
+            "dist.barrier(%s): jax coordination-service client unavailable "
+            "(private jax._src.distributed API changed?) — falling back to "
+            "multihost_utils.sync_global_devices; expect ~30s lazy "
+            "comm-group setup on first use", name)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"penroz_{name}")
         return
     client.wait_at_barrier(f"penroz_{name}",
                            timeout_in_ms=int(timeout_s * 1000))

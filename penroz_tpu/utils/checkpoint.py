@@ -259,7 +259,15 @@ def _decode(buf: bytes):
 
 
 def detect_shm_path() -> str:
-    """Best shared-memory directory for this OS (fallback: tempdir)."""
+    """Best shared-memory directory for this OS (fallback: tempdir).
+
+    ``PENROZ_SHM_PATH`` overrides — the training worker subprocess
+    (models/train_worker.py) must write through the SAME shm dir as the
+    serving parent even when a test has repointed the parent's
+    ``SHM_PATH`` attribute at a tmpdir."""
+    override = os.environ.get("PENROZ_SHM_PATH")
+    if override:
+        return override
     system = platform.system()
     if system == "Linux" and os.path.isdir("/dev/shm") and os.access("/dev/shm", os.W_OK):
         return "/dev/shm"

@@ -1,26 +1,69 @@
 #!/bin/bash
 # Persistent accelerator watcher: probe the backend in short-lived child
-# processes; on the first success, run the full bench with per-phase
-# partials written into the repo (BENCH_PARTIAL.json) and the final line
-# into BENCH_MIDROUND.out.  A pool window that opens for five minutes
-# mid-round is converted into committed evidence instead of being missed
-# (rounds 2 and 3 both ended rc=3 with zero driver-captured numbers).
+# processes; on every success, run the full bench with per-phase partials
+# written into the repo (BENCH_PARTIAL.json), snapshot the result to a
+# round-stamped artifact, and COMMIT it.  Then re-arm: a pool that opens
+# twice yields two captures (rounds 2 and 3 both ended rc=3 with zero
+# driver-captured numbers; round 4's single-shot watcher fired once and
+# the final driver capture still missed).  Evidence must land in git the
+# moment it exists.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p logs
 PROBE_S="${PENROZ_WATCH_PROBE_S:-120}"
 SLEEP_S="${PENROZ_WATCH_SLEEP_S:-60}"
+RESLEEP_S="${PENROZ_WATCH_RESLEEP_S:-1800}"   # between successful re-runs
+ROUND="${PENROZ_ROUND:-05}"
+SNAP="BENCH_MIDROUND_r${ROUND}.json"
+attempt=0
 while true; do
   if timeout "$PROBE_S" python -c \
       "import jax; d=jax.devices(); print('BACKEND_OK', d[0].device_kind, len(d), flush=True)" \
       >> logs/bench_watch.log 2>&1; then
-    echo "$(date -u +%FT%TZ) backend up -> running bench" >> logs/bench_watch.log
+    attempt=$((attempt + 1))
+    echo "$(date -u +%FT%TZ) backend up -> running bench (attempt $attempt)" >> logs/bench_watch.log
     PENROZ_BENCH_PARTIAL=BENCH_PARTIAL.json PENROZ_BENCH_WAIT_S=300 \
-      python bench.py > BENCH_MIDROUND.out 2>> logs/bench_watch.log
+      timeout 3600 python bench.py > BENCH_MIDROUND.out 2>> logs/bench_watch.log
     rc=$?
     echo "$(date -u +%FT%TZ) bench rc=$rc" >> logs/bench_watch.log
     if [ "$rc" -eq 0 ]; then
-      exit 0
+      python - "$SNAP" "$attempt" <<'EOF' 2>> logs/bench_watch.log
+import json, sys, time
+snap, attempt = sys.argv[1], int(sys.argv[2])
+with open("BENCH_PARTIAL.json") as fh:
+    partial = json.load(fh)
+out = {"rc": 0,
+       "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+       "round": int(snap.split("_r")[1].split(".")[0]),
+       "attempt": f"watcher run {attempt}",
+       "metric": "gpt2-124M train tokens/sec/chip",
+       "unit": "tokens/sec/chip"}
+out.update(partial)
+with open(snap, "w") as fh:
+    json.dump(out, fh, indent=1)
+EOF
+      # Commit ONLY the bench artifacts.  `git add` first: the
+      # round-stamped snapshot starts untracked and a pathspec-mode
+      # commit of an untracked file fails outright.  Retry covers a
+      # foreground git operation holding the lock at this instant.
+      committed=0
+      for _ in 1 2; do
+        if git add -- "$SNAP" BENCH_PARTIAL.json BENCH_MIDROUND.out \
+              >> logs/bench_watch.log 2>&1 \
+            && git commit -m "bench watcher: on-chip capture (attempt $attempt, rc=0)" \
+              -- "$SNAP" BENCH_PARTIAL.json BENCH_MIDROUND.out >> logs/bench_watch.log 2>&1; then
+          committed=1
+          break
+        fi
+        sleep 10
+      done
+      if [ "$committed" -eq 1 ]; then
+        echo "$(date -u +%FT%TZ) snapshot committed -> $SNAP; re-arming in ${RESLEEP_S}s" >> logs/bench_watch.log
+      else
+        echo "$(date -u +%FT%TZ) COMMIT FAILED for $SNAP (left in worktree); re-arming in ${RESLEEP_S}s" >> logs/bench_watch.log
+      fi
+      sleep "$RESLEEP_S"
+      continue
     fi
   fi
   sleep "$SLEEP_S"

@@ -12,6 +12,9 @@ from aiohttp.test_utils import TestClient, TestServer
 
 from penroz_tpu.serve import app as app_mod
 
+# CI tier: heavier compiles (see pyproject markers / ci.yml shards).
+pytestmark = pytest.mark.runtime
+
 TOY_LAYERS = [
     {"embedding": {"num_embeddings": 32, "embedding_dim": 8}},
     {"linear": {"in_features": 8, "out_features": 32}},

@@ -21,6 +21,9 @@ from penroz_tpu.parallel import mesh as mesh_lib
 from penroz_tpu.parallel import sharding as sharding_lib
 from penroz_tpu.utils import checkpoint
 
+# CI tier: heavier compiles (see pyproject markers / ci.yml shards).
+pytestmark = pytest.mark.runtime
+
 
 @dataclasses.dataclass
 class _FakeShard:

@@ -328,3 +328,16 @@ def test_gemma1_post_attention_norm_is_pre_mlp():
     assert "layers.1.post_attn_norm.weight" not in mapped
     np.testing.assert_array_equal(mapped["layers.1.mlp_block.0.weight"],
                                   np.ones(8, np.float32))
+
+
+def test_configless_linear_layout_refused():
+    """A gpt_bigcode/falcon-style dict (wte present, nn.Linear c_attn)
+    without a config must error loudly instead of silently taking the
+    GPT-2 Conv1D-transpose branch (wrong params, no error)."""
+    d, kv = 8, 2
+    sd = {"transformer.wte.weight": np.zeros((20, d), np.float32),
+          # nn.Linear (out, in) = (d + 2*kv, d) — not Conv1D (d, 3d)
+          "transformer.h.0.attn.c_attn.weight":
+              np.zeros((d + 2 * kv, d), np.float32)}
+    with pytest.raises(ValueError, match="Conv1D"):
+        Mapper.map_hf_state_dict_to_custom(sd, 1)

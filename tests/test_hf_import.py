@@ -12,6 +12,9 @@ torch = pytest.importorskip("torch")
 from penroz_tpu.models.dsl import Mapper
 from penroz_tpu.models.model import NeuralNetworkModel
 
+# CI tier: heavier compiles (see pyproject markers / ci.yml shards).
+pytestmark = pytest.mark.runtime
+
 
 def _tiny_gpt2():
     from transformers import GPT2Config, GPT2LMHeadModel

@@ -234,3 +234,34 @@ def test_gather_rows_backward_chunking():
     np.testing.assert_allclose(np.asarray(g, np.float32),
                                np.asarray(want_f32, np.float32),
                                rtol=0.02, atol=0.05)
+
+
+def test_head_rmsnorm_bf16_weight_order_per_family():
+    """qk-norm weight-multiply order is per-family: OLMo-2
+    (qk_norm_fp32_weight=True) multiplies the fp32 weight in fp32 with a
+    single final downcast; Qwen3 (default) downcasts the normalized
+    activations FIRST and multiplies in the storage dtype — each matching
+    its HF RMSNorm exactly (Olmo2RMSNorm vs Qwen3RMSNorm cast orders)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 3, 2, 8)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(loc=1.0, size=(8,)), jnp.bfloat16)
+    xf = np.asarray(x, np.float32)
+    wf = np.asarray(w, np.float32)
+    eps = 1e-6
+    norm = 1.0 / np.sqrt((xf * xf).mean(-1, keepdims=True) + eps)
+
+    olmo = M.CausalSelfAttention(num_heads=2, head_dim=8, qk_norm=True,
+                                 qk_norm_scope="flat",
+                                 qk_norm_fp32_weight=True)
+    got = olmo._head_rmsnorm(x, w)
+    assert got.dtype == jnp.bfloat16
+    want = jnp.asarray(xf * norm * wf).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))
+
+    qwen = M.CausalSelfAttention(num_heads=2, head_dim=8, qk_norm=True)
+    got = qwen._head_rmsnorm(x, w)
+    want = (jnp.asarray(xf * norm).astype(jnp.bfloat16) * w
+            ).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))

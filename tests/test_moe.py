@@ -12,6 +12,9 @@ from penroz_tpu.models.model import CompiledArch, NeuralNetworkModel
 from penroz_tpu.ops import modules as M
 from penroz_tpu.parallel import mesh as mesh_lib, sharding
 
+# CI tier: heavier compiles (see pyproject markers / ci.yml shards).
+pytestmark = pytest.mark.runtime
+
 SGD = {"sgd": {"lr": 0.1}}
 
 
@@ -324,3 +327,76 @@ def test_moe_capacity_pads_awkward_token_counts():
         np.testing.assert_allclose(np.asarray(cap.apply(x, M.Ctx(params))),
                                    np.asarray(dense.apply(x, M.Ctx(params))),
                                    atol=1e-5)
+
+
+def _capacity_moe(d=8, h=16, e=4, k=2, **kw):
+    mod = M.MixtureOfExperts(in_features=d, intermediate_size=h,
+                             num_experts=e, top_k=k, dispatch="capacity",
+                             **kw)
+    mod.bind("moe")
+    return mod, mod.init(jax.random.key(0))
+
+
+def test_moe_capacity_ep_alltoall_matches_single_device(cpu_devices):
+    """all_to_all token routing (ep_mesh set) == the single-device packed
+    dispatch: same grouping/slot math via the shared _dispatch_plan, so
+    routing AND drops are identical — only the comm schedule differs."""
+    mesh = mesh_lib.make_mesh(cpu_devices[:4], expert=4)
+    mod, params = _capacity_moe()
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(2, 6, 8)),
+                    jnp.float32)
+    expected = np.asarray(mod.apply(x, M.Ctx(params)))
+    sharded = sharding.shard_params(params, mesh)
+    out = jax.jit(lambda p, xb: mod.apply(xb, M.Ctx(p, ep_mesh=mesh)))(
+        sharded, x)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_moe_capacity_ep_alltoall_composes_with_dp(cpu_devices):
+    """data x expert mesh: the expert axis goes manual inside shard_map
+    while the data axis stays GSPMD-automatic."""
+    mesh = mesh_lib.make_mesh(cpu_devices, data=2, expert=4)
+    mod, params = _capacity_moe()
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(4, 6, 8)),
+                    jnp.float32)
+    expected = np.asarray(mod.apply(x, M.Ctx(params)))
+    sharded = sharding.shard_params(params, mesh)
+    xs = jax.device_put(x, jax.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("data")))
+    out = jax.jit(lambda p, xb: mod.apply(xb, M.Ctx(p, ep_mesh=mesh)))(
+        sharded, xs)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_moe_capacity_ep_gradients_match(cpu_devices):
+    """Param gradients through the two all_to_alls == replicated grads."""
+    mesh = mesh_lib.make_mesh(cpu_devices[:4], expert=4)
+    mod, params = _capacity_moe()
+    x = jnp.asarray(np.random.default_rng(7).normal(size=(2, 5, 8)),
+                    jnp.float32)
+
+    def loss(p, ctx_kw):
+        return (mod.apply(x, M.Ctx(p, **ctx_kw)) ** 2).sum()
+
+    want = jax.grad(lambda p: loss(p, {}))(params)
+    sharded = sharding.shard_params(params, mesh)
+    got = jax.jit(jax.grad(lambda p: loss(p, {"ep_mesh": mesh})))(sharded)
+    for key in want:
+        np.testing.assert_allclose(np.asarray(got[key]),
+                                   np.asarray(want[key]),
+                                   atol=2e-4, rtol=1e-4,
+                                   err_msg=key)
+
+
+def test_moe_capacity_ep_compiles_to_alltoall(cpu_devices):
+    """The compiled HLO routes tokens via all-to-all and carries NO
+    all-reduce of the full activation (the r04 EP census pathology: 34
+    all-reduces, zero all-to-all — dense combine over the expert axis)."""
+    mesh = mesh_lib.make_mesh(cpu_devices[:4], expert=4)
+    mod, params = _capacity_moe()
+    x = jnp.asarray(np.random.default_rng(8).normal(size=(2, 6, 8)),
+                    jnp.float32)
+    sharded = sharding.shard_params(params, mesh)
+    fn = jax.jit(lambda p, xb: mod.apply(xb, M.Ctx(p, ep_mesh=mesh)))
+    hlo = fn.lower(sharded, x).compile().as_text()
+    assert "all-to-all" in hlo

@@ -25,6 +25,9 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# CI tier: real multi-process jax.distributed runs (slowest shard).
+pytestmark = pytest.mark.multihost
+
 _LAYERS = [
     {"summation": [
         {"embedding": {"num_embeddings": 64, "embedding_dim": 32},

@@ -10,6 +10,9 @@ from jax.sharding import PartitionSpec as P
 
 from penroz_tpu.parallel import dist, mesh as mesh_lib, sharding
 
+# CI tier: heavier compiles (see pyproject markers / ci.yml shards).
+pytestmark = pytest.mark.runtime
+
 
 def test_virtual_device_count(cpu_devices):
     assert len(cpu_devices) == 8
@@ -598,3 +601,32 @@ def test_ring_attention_window_requires_causal(cpu_devices):
     q = jnp.zeros((1, 2, 32, 8), jnp.float32)
     with pytest.raises(ValueError, match="causal"):
         ring_attention(q, q, q, mesh, causal=False, window=8)
+
+
+def test_barrier_private_api_pin():
+    """dist.barrier depends on jax._src.distributed.global_state.client
+    (no public coordination-service API exists).  Pin the attribute so a
+    JAX upgrade that moves it fails HERE, loudly, instead of silently
+    degrading the train-end fence to its fallback path."""
+    from jax._src import distributed
+    assert hasattr(distributed.global_state, "client")
+
+
+def test_barrier_fallback_logs_loudly(monkeypatch, caplog):
+    """When the private client is unavailable the barrier must NOT
+    silently no-op (that reintroduces the lazy comm-group timeout race);
+    it falls back to the public sync_global_devices and logs an error."""
+    import logging
+    from penroz_tpu.parallel import dist
+    import jax._src.distributed as jd
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setattr(jd.global_state, "client", None)
+    called = []
+    from jax.experimental import multihost_utils
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: called.append(name))
+    with caplog.at_level(logging.ERROR, "penroz_tpu.parallel.dist"):
+        dist.barrier("unit_test_fence")
+    assert called == ["penroz_unit_test_fence"]
+    assert any("coordination-service client unavailable" in r.message
+               for r in caplog.records)

@@ -10,6 +10,9 @@ from penroz_tpu.models.dsl import Mapper
 from penroz_tpu.models.model import CompiledArch
 from penroz_tpu.parallel import mesh as mesh_lib, pipeline
 
+# CI tier: heavier compiles (see pyproject markers / ci.yml shards).
+pytestmark = pytest.mark.runtime
+
 
 def _blocks_dsl(d=16, depth=4):
     """depth identical pre-norm MLP residual blocks over (B, T, d)."""

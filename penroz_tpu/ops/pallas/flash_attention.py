@@ -25,6 +25,7 @@ the jnp oracle (:func:`dropout_keep_mask_reference`) for equivalence tests.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -524,9 +525,20 @@ def _flash_bwd_rule(causal, block_q, block_k, dropout_rate, interpret,
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _env_block(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        import logging
+        logging.getLogger(__name__).warning(
+            "Invalid %s=%r; using default block %d", name,
+            os.environ.get(name), default)
+        return default
+
+
 def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
                     dropout_rate: float = 0.0, seed=None,
                     interpret: bool = False, window=None):
     """Flash attention with a fused flash backward.
@@ -536,7 +548,16 @@ def flash_attention(q, k, v, causal: bool = True,
     (mask derived from ``seed`` — pass a fresh int32 scalar per step).
     ``window``: sliding-window width (causal only) — query t attends keys
     in ``(t - window, t]``; off-band blocks are skipped in the grid.
+
+    Block sizes default to ``PENROZ_FLASH_BLOCK_Q`` / ``PENROZ_FLASH_
+    BLOCK_K`` (else 512) — read at TRACE time, so a long-context tuning
+    sweep (bench.bench_long_context) can vary them per compiled program;
+    an already-jitted caller does not re-read the env.
     """
+    if block_q is None:
+        block_q = _env_block("PENROZ_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q)
+    if block_k is None:
+        block_k = _env_block("PENROZ_FLASH_BLOCK_K", DEFAULT_BLOCK_K)
     if seed is None:
         seed = jnp.zeros((), jnp.int32)
     return _flash(q, k, v, jnp.asarray(seed, jnp.int32), causal,

@@ -26,6 +26,15 @@ while true; do
       timeout 3600 python bench.py > BENCH_MIDROUND.out 2>> logs/bench_watch.log
     rc=$?
     echo "$(date -u +%FT%TZ) bench rc=$rc" >> logs/bench_watch.log
+    if [ "$rc" -ne 0 ]; then
+      # Even a died/timed-out run leaves per-phase metrics in the
+      # partial — commit the evidence rather than waiting for a clean
+      # pass that may never come (r02/r03 ended with zero numbers).
+      git add -- BENCH_PARTIAL.json >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: partial capture (rc=$rc)" \
+          -- BENCH_PARTIAL.json >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) partial committed (rc=$rc)" >> logs/bench_watch.log
+    fi
     if [ "$rc" -eq 0 ]; then
       python - "$SNAP" "$attempt" <<'EOF' 2>> logs/bench_watch.log
 import json, sys, time

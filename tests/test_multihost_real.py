@@ -140,7 +140,7 @@ def _assert_reassembles(tmp_path, model_id: str):
         env.pop(k)
     out = subprocess.run([sys.executable, "-c", code], env=env,
                          cwd=str(tmp_path), capture_output=True, text=True,
-                         timeout=180)
+                         timeout=600)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "reassembled" in out.stdout
 
@@ -245,7 +245,7 @@ def _single_process_costs(tmp_path, model_id: str, epochs: int = 2):
         env.pop(k)
     out = subprocess.run([sys.executable, "-c", code], env=env,
                          cwd=str(tmp_path), capture_output=True, text=True,
-                         timeout=300)
+                         timeout=600)
     assert out.returncode == 0, out.stdout + out.stderr
     return json.loads(out.stdout.strip().splitlines()[-1])
 
@@ -284,7 +284,7 @@ def test_real_pipeline_stages_across_hosts(tmp_path):
         env.pop(k)
     out = subprocess.run([sys.executable, "-c", code], env=env,
                          cwd=str(tmp_path), capture_output=True, text=True,
-                         timeout=180)
+                         timeout=600)
     assert out.returncode == 0, out.stdout + out.stderr
     pipe_costs = json.loads(out.stdout.strip().splitlines()[-1])
     assert len(pipe_costs) == len(ref_costs) and pipe_costs

@@ -612,10 +612,13 @@ def test_barrier_private_api_pin():
     assert hasattr(distributed.global_state, "client")
 
 
-def test_barrier_fallback_logs_loudly(monkeypatch, caplog):
+def test_barrier_fallback_logs_loudly(monkeypatch):
     """When the private client is unavailable the barrier must NOT
     silently no-op (that reintroduces the lazy comm-group timeout race);
-    it falls back to the public sync_global_devices and logs an error."""
+    it falls back to the public sync_global_devices and logs an error.
+    (The error is asserted by spying the logger method, not caplog —
+    other tests in the suite reconfigure logging handlers/propagation,
+    which silently empties caplog.)"""
     import logging
     from penroz_tpu.parallel import dist
     import jax._src.distributed as jd
@@ -625,8 +628,11 @@ def test_barrier_fallback_logs_loudly(monkeypatch, caplog):
     from jax.experimental import multihost_utils
     monkeypatch.setattr(multihost_utils, "sync_global_devices",
                         lambda name: called.append(name))
-    with caplog.at_level(logging.ERROR, "penroz_tpu.parallel.dist"):
-        dist.barrier("unit_test_fence")
+    errors = []
+    logger = logging.getLogger("penroz_tpu.parallel.dist")
+    monkeypatch.setattr(logger, "error",
+                        lambda msg, *a: errors.append(msg % a))
+    dist.barrier("unit_test_fence")
     assert called == ["penroz_unit_test_fence"]
-    assert any("coordination-service client unavailable" in r.message
-               for r in caplog.records)
+    assert any("coordination-service client unavailable" in e
+               for e in errors)

@@ -517,15 +517,28 @@ def bench_long_context(depth=12, d_model=768, block=4096, batch=1,
     try:
         sweep_on = (os.environ.get("PENROZ_BENCH_LONGCTX_SWEEP", "1") == "1"
                     and os.environ.get("PENROZ_BENCH_SMOKE") != "1")
-        best = (512, 512, batch)
+
+        def envint(name, default):
+            try:
+                return int(os.environ.get(name) or default)
+            except ValueError:
+                return default
+
+        # Seed from the operator's pinned env config (sweep off / smoke:
+        # honor it verbatim instead of clobbering it with literals).
+        best = (envint("PENROZ_FLASH_BLOCK_Q", 512),
+                envint("PENROZ_FLASH_BLOCK_K", 512), batch)
         if sweep_on:
             sweep = {}
-            # (block_q, block_k, batch): defaults first, then narrower q
-            # blocks (more grid parallelism for the dq pass), wider k
+            # (block_q, block_k, batch): env/defaults first, then narrower
+            # q blocks (more grid parallelism for the dq pass), wider k
             # streams (fewer carry updates), and batch=2 (row headroom).
-            for bq, bk, b in ((512, 512, batch), (256, 512, batch),
-                              (512, 1024, batch), (1024, 512, batch),
-                              (512, 512, 2 * batch)):
+            cands = [best, (256, 512, batch), (512, 1024, batch),
+                     (1024, 512, batch), (512, 512, 2 * batch)]
+            seen = set()
+            cands = [c for c in cands
+                     if not (c in seen or seen.add(c))]
+            for bq, bk, b in cands:
                 try:
                     tps, mfu = run_cfg(bq, bk, b, tsteps=steps_per_call,
                                        twarm=1, ttimed=2)

@@ -1928,7 +1928,7 @@ class NeuralNetworkModel:
         dt = self.dtype
         return dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
 
-    def _decode_mesh(self):
+    def _decode_mesh(self, batch: int = 1):
         """Device mesh for generation (None = single-device decode).
 
         TP-sharded decode: attention-head K/V buffers and the Megatron
@@ -1936,12 +1936,14 @@ class NeuralNetworkModel:
         over ``expert``, sampling replicated — so an imported model larger
         than one chip's HBM can *serve*, not just train/evaluate
         (reference decode is single-device too: neural_net_model.py:
-        360-406; this is the beyond-parity axis).  Uses the first
-        model×expert local devices; generation has no data axis (a single
-        stream cannot batch-shard, and the batched path's rows arrive
-        ragged).  Gated to the contiguous fp/bf16 cache — the paged and
-        int8 layouts keep single-device decode (their block tables and
-        scale planes have no mesh layout yet).
+        360-406; this is the beyond-parity axis).  A single stream has no
+        data axis; the BATCHED path additionally shards rows over ``data``
+        when ``PENROZ_DECODE_DP=1`` and the batch divides the leftover
+        devices (throughput scaling for /generate_batch/ — opt-in so
+        multi-device hosts don't silently change decode placement).
+        Gated to the contiguous fp/bf16 cache — the paged and int8
+        layouts keep single-device decode (their block tables and scale
+        planes have no mesh layout yet).
         """
         if dist.process_count() > 1:
             return None  # serving is per-host; the API serves local chips
@@ -1956,7 +1958,7 @@ class NeuralNetworkModel:
             log.warning("Invalid PENROZ_MESH_MODEL/PENROZ_MESH_EXPERT; "
                         "falling back to single-device decode")
             return None
-        if model < 1 or expert < 1 or model * expert <= 1:
+        if model < 1 or expert < 1:
             return None
         try:
             platform = (self.device.platform if self.device is not None
@@ -1967,20 +1969,37 @@ class NeuralNetworkModel:
             return None
         if len(devices) < model * expert:
             return None
-        return mesh_lib.make_mesh(devices[:model * expert], model=model,
-                                  expert=expert)
+        dp = 1
+        if (batch > 1
+                and os.environ.get("PENROZ_DECODE_DP", "0") == "1"):
+            leftover = len(devices) // (model * expert)
+            dp = next((d for d in range(min(leftover, batch), 0, -1)
+                       if batch % d == 0), 1)
+        if model * expert * dp <= 1:
+            return None
+        return mesh_lib.make_mesh(devices[:model * expert * dp],
+                                  model=model, expert=expert)
 
-    def _kv_sharding_tree(self, kv, mesh):
+    def _kv_sharding_tree(self, kv, mesh, batch: int = 1):
         """Sharding pytree for a contiguous KVState: (B, Hkv, S, D) leaves
         shard heads over ``model`` when every attention layer's KV head
         count divides the axis (GQA models with few KV heads stay
-        replicated — a torn head is worse than a copied cache); lengths
-        and scalars replicate."""
+        replicated — a torn head is worse than a copied cache) and rows
+        over ``data`` when the batch divides it; lengths and scalars
+        replicate."""
         from jax.sharding import PartitionSpec as P
         tp = mesh.shape[mesh_lib.MODEL_AXIS]
+        dp = mesh.shape[mesh_lib.DATA_AXIS]
         heads_ok = all(h % tp == 0 for h, _ in self.arch.kv_specs)
-        kv_spec = P(None, mesh_lib.MODEL_AXIS if heads_ok and tp > 1
-                    else None, None, None)
+        # Row sharding stays behind the PENROZ_DECODE_DP opt-in even here:
+        # the live branch hands this a TRAINING mesh whose data axis the
+        # decode-mesh gate never saw, and rows silently sharding over it
+        # is exactly the placement surprise the opt-in exists to prevent.
+        dp_rows = (dp > 1 and batch % dp == 0
+                   and os.environ.get("PENROZ_DECODE_DP", "0") == "1")
+        kv_spec = P(mesh_lib.DATA_AXIS if dp_rows else None,
+                    mesh_lib.MODEL_AXIS if heads_ok and tp > 1 else None,
+                    None, None)
 
         def leaf_sharding(leaf):
             spec = kv_spec if getattr(leaf, "ndim", 0) == 4 else P()
@@ -1988,10 +2007,10 @@ class NeuralNetworkModel:
 
         return jax.tree.map(leaf_sharding, kv)
 
-    def _enter_decode_mesh(self, kv):
+    def _enter_decode_mesh(self, kv, batch: int = 1):
         """Place params/buffers/cache for mesh decode; returns the placed
         cache (identity when no decode mesh is configured)."""
-        mesh = self._decode_mesh()
+        mesh = self._decode_mesh(batch)
         if mesh is None:
             return kv
         if any(k.startswith("__pipe__") for k in self.params):
@@ -2009,13 +2028,14 @@ class NeuralNetworkModel:
             # decodes fine on the existing layout; only the fresh KV
             # cache follows that mesh.
             return jax.device_put(
-                kv, self._kv_sharding_tree(kv, live[0].sharding.mesh))
+                kv, self._kv_sharding_tree(kv, live[0].sharding.mesh,
+                                           batch))
         log.info("Generating over device mesh %s", dict(mesh.shape))
         self.params = sharding_lib.shard_params(self.params, mesh)
         self.buffers = {
             k: sharding_lib.place(v, mesh_lib.replicated(mesh))
             for k, v in self.buffers.items()}
-        return jax.device_put(kv, self._kv_sharding_tree(kv, mesh))
+        return jax.device_put(kv, self._kv_sharding_tree(kv, mesh, batch))
 
     def _kv_specs(self, batch: int = 1, max_len: int = 0):
         return self.arch.kv_specs
@@ -2220,7 +2240,7 @@ class NeuralNetworkModel:
         # the allocator, appends, and the ragged kernels).
         kv = KV.create_kv_state(arch.kv_specs, B, block_size,
                                 self._kv_dtype())
-        kv = self._enter_decode_mesh(kv)
+        kv = self._enter_decode_mesh(kv, batch=B)
         lengths = jnp.asarray(lens, jnp.int32)
         done = [False] * B
 

@@ -527,7 +527,10 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def _env_block(name: str, default: int) -> int:
     try:
-        return int(os.environ.get(name, default))
+        value = int(os.environ.get(name, default))
+        if value <= 0:
+            raise ValueError(value)
+        return value
     except ValueError:
         import logging
         logging.getLogger(__name__).warning(

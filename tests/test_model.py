@@ -895,3 +895,37 @@ def test_generate_mesh_preserves_training_layout(workdir, toy_gpt_layers,
                                    temperature=0.0)
     assert len(tokens) == 5
     assert {k: v.sharding for k, v in model.params.items()} == before
+
+
+def test_generate_batched_dp_mesh_parity(workdir, toy_gpt_layers,
+                                         monkeypatch):
+    """PENROZ_DECODE_DP=1: batched decode rows shard over the data axis
+    (pure DP — no TP configured) and greedy outputs stay identical."""
+    model = NeuralNetworkModel("gdp", Mapper(toy_gpt_layers, SGD))
+    prompts = [[1, 2, 3], [4], [5, 6], [7]]
+    want = model.generate_tokens_batched(prompts, block_size=16,
+                                         max_new_tokens=5, temperature=0.0)
+    monkeypatch.setenv("PENROZ_DECODE_DP", "1")
+    assert model._decode_mesh(batch=4) is not None
+    assert model._decode_mesh() is None  # single-stream: no DP axis
+    got = model.generate_tokens_batched(prompts, block_size=16,
+                                        max_new_tokens=5, temperature=0.0)
+    assert got == want
+
+
+def test_generate_batched_dp_with_tp_parity(workdir, toy_gpt_layers,
+                                            monkeypatch):
+    """DP x TP decode mesh: rows over `data`, weights/KV heads over
+    `model`, same greedy tokens."""
+    model = NeuralNetworkModel("gdptp", Mapper(toy_gpt_layers, SGD))
+    prompts = [[1, 2, 3], [4]]
+    want = model.generate_tokens_batched(prompts, block_size=16,
+                                         max_new_tokens=4, temperature=0.0)
+    monkeypatch.setenv("PENROZ_DECODE_DP", "1")
+    monkeypatch.setenv("PENROZ_MESH_MODEL", "2")
+    mesh = model._decode_mesh(batch=2)
+    assert mesh is not None and mesh.shape["data"] == 2 \
+        and mesh.shape["model"] == 2
+    got = model.generate_tokens_batched(prompts, block_size=16,
+                                        max_new_tokens=4, temperature=0.0)
+    assert got == want

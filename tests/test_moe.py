@@ -400,3 +400,20 @@ def test_moe_capacity_ep_compiles_to_alltoall(cpu_devices):
     fn = jax.jit(lambda p, xb: mod.apply(xb, M.Ctx(p, ep_mesh=mesh)))
     hlo = fn.lower(sharded, x).compile().as_text()
     assert "all-to-all" in hlo
+
+
+def test_moe_capacity_ep_alltoall_composes_with_sp(cpu_devices):
+    """sequence x expert mesh (the dryrun phase-1 shape): tokens arrive
+    sequence-sharded on T; the group reshape + expert-axis shard_map must
+    still produce the single-device result."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = mesh_lib.make_mesh(cpu_devices, sequence=2, expert=4)
+    mod, params = _capacity_moe()
+    x = jnp.asarray(np.random.default_rng(9).normal(size=(2, 8, 8)),
+                    jnp.float32)
+    expected = np.asarray(mod.apply(x, M.Ctx(params)))
+    sharded = sharding.shard_params(params, mesh)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "sequence")))
+    out = jax.jit(lambda p, xb: mod.apply(xb, M.Ctx(p, ep_mesh=mesh)))(
+        sharded, xs)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)

@@ -65,8 +65,11 @@ class Ctx:
         self.sp_manual_axis = sp_manual_axis
         # Mesh with a >1 'expert' axis → MoE capacity dispatch routes
         # tokens via lax.all_to_all over it instead of the dense-combine
-        # psum (set only on the non-pipelined path; inside the GPipe
-        # schedule the expert axis stays GSPMD-automatic).
+        # psum (set only on the non-pipelined path: nesting an
+        # expert-manual shard_map inside the GPipe schedule's manual
+        # region is rejected by the Shardy partitioner — "manual axes
+        # must come before free axes" on propagated dim shardings — so
+        # MoE under pipe keeps the dense-combine inside each stage).
         self.ep_mesh = ep_mesh
         self.platform = platform  # execution platform hint for kernel gates
         self.buffer_updates = {}
@@ -726,9 +729,9 @@ class MixtureOfExperts(Module):
         w_down = self._p(ctx, "experts.down_proj.weight")
         weights = self.router_weights(x, ctx).astype(x.dtype)
         if self.dispatch == "capacity":
+            from penroz_tpu.parallel.mesh import EXPERT_AXIS
             ep_mesh = getattr(ctx, "ep_mesh", None)
             if ep_mesh is not None:
-                from penroz_tpu.parallel.mesh import EXPERT_AXIS
                 ep = ep_mesh.shape.get(EXPERT_AXIS, 1)
                 if ep > 1 and self.num_experts % ep == 0:
                     return self._apply_capacity_ep(
@@ -840,14 +843,18 @@ class MixtureOfExperts(Module):
                 [flat_x, jnp.zeros((pad, d), flat_x.dtype)])
             flat_w = jnp.concatenate(
                 [flat_w, jnp.zeros((pad, E), flat_w.dtype)])
-        gx = flat_x.reshape(n_groups, group, d)
-        gw = flat_w.reshape(n_groups, group, E)
+        # The expert-manual split gets its OWN leading dim (ep, G/ep, …):
+        # Shardy rejects a dimension whose sharding mixes a free axis
+        # before a manual one (e.g. the group dim co-sharded (data,
+        # expert) inside the GPipe schedule), so no dim may carry both.
+        gx = flat_x.reshape(ep, n_groups // ep, group, d)
+        gw = flat_w.reshape(ep, n_groups // ep, group, E)
 
         def body(gx_l, gw_l, wg_l, wu_l, wd_l):
-            # gx_l: (G/ep, S, d); gw_l: (G/ep, S, E) — local groups, all
-            # experts.  wg_l/wu_l: (E/ep, h, d); wd_l: (E/ep, d, h).
-            disp, combine = self._dispatch_plan(gw_l, cap, gx_l.dtype)
-            expert_in = jnp.einsum("gsec,gsd->gecd", disp, gx_l)
+            # gx_l: (1, G/ep, S, d); gw_l: (1, G/ep, S, E) — local
+            # groups, all experts.  wg_l/wu_l: (E/ep, h, d).
+            disp, combine = self._dispatch_plan(gw_l[0], cap, gx_l.dtype)
+            expert_in = jnp.einsum("gsec,gsd->gecd", disp, gx_l[0])
             # Send expert chunk p to device p; receive every device's
             # groups for the local experts: (G, E/ep, C, d).
             expert_in = jax.lax.all_to_all(expert_in, EXPERT_AXIS, 1, 0,
@@ -857,12 +864,13 @@ class MixtureOfExperts(Module):
             out_e = jnp.einsum("gech,edh->gecd", self._act(gate) * up, wd_l)
             # Return each group's outputs to its owner: (G/ep, E, C, d).
             out_e = jax.lax.all_to_all(out_e, EXPERT_AXIS, 0, 1, tiled=True)
-            return jnp.einsum("gsec,gecd->gsd", combine, out_e)
+            return jnp.einsum("gsec,gecd->gsd", combine, out_e)[None]
 
-        spec = P(EXPERT_AXIS, None, None)
+        spec4 = P(EXPERT_AXIS, None, None, None)
+        spec3 = P(EXPERT_AXIS, None, None)
         y = jax.shard_map(body, mesh=mesh,
-                          in_specs=(spec, spec, spec, spec, spec),
-                          out_specs=spec,
+                          in_specs=(spec4, spec4, spec3, spec3, spec3),
+                          out_specs=spec4,
                           axis_names=frozenset({EXPERT_AXIS}))(
             gx, gw, w_gate, w_up, w_down)
         return y.reshape(padded, d)[:tokens].reshape(B, T, d)

@@ -259,6 +259,10 @@ class Mapper:
             return _falcon_dsl_from_config(config, n_layer_override)
         if model_type == "gpt_bigcode":
             return _bigcode_dsl_from_config(config, n_layer_override)
+        if model_type == "opt":
+            return _opt_dsl_from_config(config, n_layer_override)
+        if model_type == "bloom":
+            return _bloom_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -269,7 +273,7 @@ class Mapper:
         (reference: mappers.py:276-302)."""
         import re
         pattern = re.compile(
-            r"(?:transformer\.h|gpt_neox\.layers"
+            r"(?:transformer\.h|gpt_neox\.layers|model\.decoder\.layers"
             r"|model\.(?:language_model\.)?layers)\.(\d+)\.")
         n = 0
         for key in state_dict:
@@ -306,6 +310,14 @@ class Mapper:
             return _map_gpt2_state_dict(state_dict, n_layer)
         if "gpt_neox.embed_in.weight" in state_dict:
             return _map_neox_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "opt" or \
+                "model.decoder.embed_tokens.weight" in state_dict:
+            return _map_opt_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "bloom" or \
+                "transformer.word_embeddings_layernorm.weight" in state_dict:
+            # the embedding LayerNorm is BLOOM-unique; plain
+            # word_embeddings would also match Falcon checkpoints
+            return _map_bloom_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") == "phi":
             return _map_phi_state_dict(state_dict, n_layer, config)
         if getattr(config, "model_type", "") == "olmo2":
@@ -377,6 +389,216 @@ def _gpt2_dsl_from_config(config, n_layer_override=None) -> list[dict]:
         {"softmaxlast": {"dim": -1}},
     ]
     return layers
+
+
+def _bloom_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """BLOOM HF config → layer DSL: NO positional embedding at all —
+    ALiBi linear logit biases carry position (attention ``alibi`` arg) —
+    plus the embedding LayerNorm, pre-LN blocks with per-head-interleaved
+    fused QKV (de-interleaved at import), and tanh-GELU MLPs."""
+    d = int(config.hidden_size)
+    n = int(n_layer_override if n_layer_override else config.n_layer)
+    heads = int(config.n_head)
+    vocab = int(config.vocab_size)
+    if getattr(config, "apply_residual_connection_post_layernorm", False):
+        # HF adds the post-LN output (not the block input) to the
+        # residual for these checkpoints — structurally different blocks;
+        # refuse instead of importing wrong logits.
+        raise ValueError("BLOOM apply_residual_connection_post_layernorm="
+                         "True is not supported")
+    drop = float(getattr(config, "hidden_dropout", 0.0) or 0.0)
+    attn_drop = float(getattr(config, "attention_dropout", 0.0) or 0.0)
+
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+        {"layernorm": {"normalized_shape": d}},  # word_embeddings_layernorm
+    ]
+    for _ in range(n):
+        layers.append({"residual": [
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d, "out_features": 3 * d},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"attention": {"num_heads": heads, "dropout": attn_drop,
+                               "alibi": True}},
+                {"linear": {"in_features": d, "out_features": d},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"dropout": {"p": drop}}]},
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d, "out_features": 4 * d},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"gelu": {"approximate": "tanh"}},  # BloomGelu
+                {"linear": {"in_features": 4 * d, "out_features": d},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"dropout": {"p": drop}}]}]})
+    layers += [
+        {"layernorm": {"normalized_shape": d}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False},
+         "normal": {"mean": 0.0, "std": 0.02}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_bloom_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """BLOOM HF keys → ours.  The fused ``query_key_value`` is PER-HEAD
+    interleaved — rows grouped ``[h0: q,k,v | h1: q,k,v | …]`` as
+    ``(H, 3, D, d)`` — while our attention expects ``[all q | all k |
+    all v]``; the transpose happens here, at import, so no runtime
+    layout variant exists."""
+    pfx = "transformer"
+    cfg = _llama_text_config(config)
+    heads = int(getattr(cfg, "n_head"))
+
+    def deinterleave(arr):
+        a = np.asarray(arr)
+        if a.ndim == 2:  # (3·H·D, d)
+            h3d, d_in = a.shape
+            hd = h3d // 3 // heads
+            return a.reshape(heads, 3, hd, d_in).transpose(1, 0, 2, 3) \
+                    .reshape(h3d, d_in)
+        hd = a.shape[0] // 3 // heads
+        return a.reshape(heads, 3, hd).transpose(1, 0, 2).reshape(-1)
+
+    out = {
+        "layers.0.weight": sd[f"{pfx}.word_embeddings.weight"],
+        "layers.1.weight": sd[f"{pfx}.word_embeddings_layernorm.weight"],
+        "layers.1.bias": sd[f"{pfx}.word_embeddings_layernorm.bias"],
+    }
+    for i in range(n_layer):
+        src = f"{pfx}.h.{i}"
+        dst = f"layers.{2 + i}"
+        out[f"{dst}.0.0.weight"] = sd[f"{src}.input_layernorm.weight"]
+        out[f"{dst}.0.0.bias"] = sd[f"{src}.input_layernorm.bias"]
+        qkv = f"{src}.self_attention.query_key_value"
+        out[f"{dst}.0.1.weight"] = deinterleave(sd[f"{qkv}.weight"])
+        out[f"{dst}.0.1.bias"] = deinterleave(sd[f"{qkv}.bias"])
+        out[f"{dst}.0.3.weight"] = sd[f"{src}.self_attention.dense.weight"]
+        out[f"{dst}.0.3.bias"] = sd[f"{src}.self_attention.dense.bias"]
+        out[f"{dst}.1.0.weight"] = \
+            sd[f"{src}.post_attention_layernorm.weight"]
+        out[f"{dst}.1.0.bias"] = sd[f"{src}.post_attention_layernorm.bias"]
+        out[f"{dst}.1.1.weight"] = sd[f"{src}.mlp.dense_h_to_4h.weight"]
+        out[f"{dst}.1.1.bias"] = sd[f"{src}.mlp.dense_h_to_4h.bias"]
+        out[f"{dst}.1.3.weight"] = sd[f"{src}.mlp.dense_4h_to_h.weight"]
+        out[f"{dst}.1.3.bias"] = sd[f"{src}.mlp.dense_4h_to_h.bias"]
+    out[f"layers.{2 + n_layer}.weight"] = sd[f"{pfx}.ln_f.weight"]
+    out[f"layers.{2 + n_layer}.bias"] = sd[f"{pfx}.ln_f.bias"]
+    out[f"layers.{3 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd[f"{pfx}.word_embeddings.weight"])
+    return out
+
+
+def _opt_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """OPT HF config → layer DSL: GPT-2-shaped pre-LN blocks with
+    separate-then-fused biased QKV, ReLU MLPs, and LEARNED positions
+    whose +2 row offset (HF OPTLearnedPositionalEmbedding) is folded
+    away at import time by dropping the table's first two rows — no
+    runtime position hack survives.
+
+    Refused loudly: ``do_layer_norm_before=False`` (OPT-350m post-norm
+    ordering) and ``word_embed_proj_dim != hidden_size`` (the 350m
+    in/out projections) — silently approximating either would import
+    wrong logits.
+    """
+    d = int(config.hidden_size)
+    n = int(n_layer_override if n_layer_override else
+            config.num_hidden_layers)
+    if not getattr(config, "do_layer_norm_before", True):
+        raise ValueError("OPT do_layer_norm_before=False (350m post-norm "
+                         "ordering) is not supported")
+    proj_dim = getattr(config, "word_embed_proj_dim", d) or d
+    if int(proj_dim) != d:
+        raise ValueError("OPT word_embed_proj_dim != hidden_size "
+                         "(embedding in/out projections) is not supported")
+    heads = int(config.num_attention_heads)
+    vocab = int(config.vocab_size)
+    block = int(config.max_position_embeddings)
+    ffn = int(getattr(config, "ffn_dim", 4 * d))
+    bias = bool(getattr(config, "enable_bias", True))
+    act = str(getattr(config, "activation_function", "relu"))
+    act_entry = ({"relu": {}} if act == "relu" else _gpt2_gelu_entry(act))
+    # HF OPT applies `dropout` to the embedding and BOTH residual streams
+    # and `attention_dropout` to the attention probabilities — distinct
+    # knobs (opt-125m ships 0.1 / 0.0).
+    drop = float(getattr(config, "dropout", 0.0) or 0.0)
+    attn_drop = float(getattr(config, "attention_dropout", 0.0) or 0.0)
+
+    layers: list[dict] = [
+        {"summation": [
+            {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+             "normal": {"mean": 0.0, "std": 0.02}},
+            {"position": {"num_embeddings": block, "embedding_dim": d},
+             "normal": {"mean": 0.0, "std": 0.02}}]},
+        {"dropout": {"p": drop}},
+    ]
+    for _ in range(n):
+        layers.append({"residual": [
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d, "out_features": 3 * d,
+                            "bias": bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"attention": {"num_heads": heads, "dropout": attn_drop}},
+                {"linear": {"in_features": d, "out_features": d,
+                            "bias": bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"dropout": {"p": drop}}]},
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d, "out_features": ffn,
+                            "bias": bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                act_entry,
+                {"linear": {"in_features": ffn, "out_features": d,
+                            "bias": bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"dropout": {"p": drop}}]}]})
+    layers += [
+        {"layernorm": {"normalized_shape": d}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False},
+         "normal": {"mean": 0.0, "std": 0.02}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_opt_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """OPT HF keys → ours.  ``model.decoder.*`` layout, separate q/k/v
+    fused by concatenation, and the learned position table's first two
+    rows DROPPED (HF looks positions up at ``pos + 2``; with full
+    attention masks that is exactly a 0-based lookup into ``table[2:]``,
+    including cached decode where our offset is the cache length)."""
+    dec = "model.decoder"
+    out = {
+        "layers.0.0.weight": sd[f"{dec}.embed_tokens.weight"],
+        "layers.0.1.weight":
+            np.asarray(sd[f"{dec}.embed_positions.weight"])[2:],
+    }
+    for i in range(n_layer):
+        src = f"{dec}.layers.{i}"
+        dst = f"layers.{2 + i}"
+        _concat_qkv(sd, src, out, f"{dst}.0.1")
+        out[f"{dst}.0.0.weight"] = sd[f"{src}.self_attn_layer_norm.weight"]
+        out[f"{dst}.0.0.bias"] = sd[f"{src}.self_attn_layer_norm.bias"]
+        out[f"{dst}.0.3.weight"] = sd[f"{src}.self_attn.out_proj.weight"]
+        if f"{src}.self_attn.out_proj.bias" in sd:
+            out[f"{dst}.0.3.bias"] = sd[f"{src}.self_attn.out_proj.bias"]
+        out[f"{dst}.1.0.weight"] = sd[f"{src}.final_layer_norm.weight"]
+        out[f"{dst}.1.0.bias"] = sd[f"{src}.final_layer_norm.bias"]
+        out[f"{dst}.1.1.weight"] = sd[f"{src}.fc1.weight"]
+        out[f"{dst}.1.3.weight"] = sd[f"{src}.fc2.weight"]
+        if f"{src}.fc1.bias" in sd:
+            out[f"{dst}.1.1.bias"] = sd[f"{src}.fc1.bias"]
+            out[f"{dst}.1.3.bias"] = sd[f"{src}.fc2.bias"]
+    out[f"layers.{2 + n_layer}.weight"] = \
+        sd[f"{dec}.final_layer_norm.weight"]
+    out[f"layers.{2 + n_layer}.bias"] = sd[f"{dec}.final_layer_norm.bias"]
+    out[f"layers.{3 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd[f"{dec}.embed_tokens.weight"])
+    return out
 
 
 def _bigcode_dsl_from_config(config, n_layer_override=None) -> list[dict]:

@@ -96,6 +96,38 @@ def apply_rope(q, k, theta: float, offset, scaling: Optional[dict] = None,
             jnp.concatenate([k_rot, k_pass], axis=-1))
 
 
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (Press et al. 2022, the HF ``build_alibi_
+    tensor`` closed form): geometric sequence ``2^(-8/n)`` powers for
+    power-of-two head counts, interleaved from the next power of two
+    otherwise."""
+    import math
+
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        return np.asarray(pow2(num_heads), np.float32)
+    closest = 2 ** int(math.floor(math.log2(num_heads)))
+    extra = pow2(2 * closest)[0::2][:num_heads - closest]
+    return np.asarray(pow2(closest) + extra, np.float32)
+
+
+def _alibi_bias(slopes, q_pos, k_pos, num_kv_heads: int):
+    """(…, Hkv, G, T, S) additive logit bias ``slope_h · (k - q)``.
+
+    Softmax rows are shift-invariant, so this equals HF Bloom's
+    ``slope_h · k`` form while keeping the biases ≤ 0 in the causal
+    region (no large positive logits before masking).  ``q_pos``/
+    ``k_pos``: (T, S)-broadcastable int arrays, or (B, T, S) ragged."""
+    rel = (k_pos - q_pos).astype(jnp.float32)
+    s = jnp.asarray(slopes, jnp.float32).reshape(num_kv_heads, -1)
+    if rel.ndim == 3:  # ragged: (B, T, S) → (B, Hkv, G, T, S)
+        return s[None, :, :, None, None] * rel[:, None, None]
+    return s[:, :, None, None] * rel  # (Hkv, G, T, S)
+
+
 def _group_query_heads(q, num_kv_heads: int):
     """(B, Hq, T, D) -> (B, Hkv, G, T, D) where G = Hq // Hkv."""
     B, Hq, T, D = q.shape
@@ -103,11 +135,12 @@ def _group_query_heads(q, num_kv_heads: int):
     return q.reshape(B, num_kv_heads, group, T, D)
 
 
-def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None):
+def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None, bias=None):
     """Masked softmax attention with grouped query heads.
 
     q: (B, Hkv, G, T, D); k, v: (B, Hkv, S, D); mask: broadcastable to
-    (B, Hkv, G, T, S) with True = attend.
+    (B, Hkv, G, T, S) with True = attend; ``bias`` (same broadcast):
+    additive pre-softmax logits (ALiBi).
     """
     scale = 1.0 / (q.shape[-1] ** 0.5)
     # HIGHEST pins true-f32 dot precision for f32 inputs: attention softmax
@@ -118,6 +151,8 @@ def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None):
     logits = jnp.einsum("bhgtd,bhsd->bhgts", q, k,
                         preferred_element_type=jnp.float32,
                         precision=precision) * scale
+    if bias is not None:
+        logits = logits + bias
     logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     if dropout_rate > 0.0 and dropout_rng is not None:
@@ -128,12 +163,15 @@ def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None):
 
 
 def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None,
-                               window: Optional[int] = None):
+                               window: Optional[int] = None,
+                               alibi: Optional[np.ndarray] = None):
     """Pure-jnp causal attention. q: (B, Hq, T, D); k, v: (B, Hkv, T, D).
 
     ``window``: sliding-window width — query t attends keys in
     ``(t - window, t]`` (HF Mistral/Gemma-2 semantics: the window *includes*
-    the query position and the ``window - 1`` keys before it)."""
+    the query position and the ``window - 1`` keys before it).
+    ``alibi``: per-query-head slopes — linear position bias added to the
+    logits instead of any rotary/learned positions."""
     B, Hq, T, D = q.shape
     num_kv_heads = k.shape[1]
     qg = _group_query_heads(q, num_kv_heads)
@@ -142,19 +180,30 @@ def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None,
     mask = k_pos <= q_pos
     if window is not None:
         mask &= k_pos > q_pos - int(window)
-    out = _attend(qg, k, v, mask, dropout_rate, dropout_rng)
+    bias = (None if alibi is None
+            else _alibi_bias(alibi, q_pos, k_pos, num_kv_heads))
+    out = _attend(qg, k, v, mask, dropout_rate, dropout_rng, bias=bias)
     return out.reshape(B, Hq, T, D)
 
 
 def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
-                     platform=None, window: Optional[int] = None):
+                     platform=None, window: Optional[int] = None,
+                     alibi: Optional[np.ndarray] = None):
     """Causal self-attention; dispatches to the Pallas kernel on TPU.
 
     ``platform`` is the caller's execution-placement hint ('tpu'/'cpu'/...).
     Inside jit the arrays are tracers, so without the hint the gate can only
     guess from global config — and a model explicitly placed on CPU on a
     TPU-attached host would dispatch kernels that cannot lower for CPU.
+
+    ``alibi`` slopes currently route through the jnp path (the flash
+    kernels have no bias input yet — gating is explicit rather than a
+    silent wrong-math dispatch).
     """
+    if alibi is not None:
+        return causal_attention_reference(q, k, v, dropout_rate,
+                                          dropout_rng, window=window,
+                                          alibi=alibi)
     if _use_flash(q, k, platform):
         from penroz_tpu.ops.pallas import flash_attention as fa
         if dropout_rate > 0.0 and dropout_rng is not None:
@@ -176,7 +225,8 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
 def cached_attention(q, k_full, v_full, offset, length,
                      dropout_rate=0.0, dropout_rng=None, platform=None,
                      k_scale=None, v_scale=None,
-                     window: Optional[int] = None):
+                     window: Optional[int] = None,
+                     alibi: Optional[np.ndarray] = None):
     """Attention over a preallocated KV cache.
 
     q: (B, Hq, T, D) new queries at positions ``offset + [0, T)``.
@@ -193,7 +243,8 @@ def cached_attention(q, k_full, v_full, offset, length,
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be passed together "
                          "(int8 caches carry scales for both streams)")
-    if dropout_rate == 0.0 and _use_flash_decode(q, k_full, platform):
+    if (alibi is None and dropout_rate == 0.0
+            and _use_flash_decode(q, k_full, platform)):
         from penroz_tpu.ops.pallas import decode_attention as da
         return da.decode_attention(q, k_full, v_full, offset, length,
                                    k_scale=k_scale, v_scale=v_scale,
@@ -219,13 +270,20 @@ def cached_attention(q, k_full, v_full, offset, length,
         mask = key_idx[None, None, :] <= q_pos[:, :, None]  # (B, T, S)
         if window is not None:
             mask &= key_idx[None, None, :] > q_pos[:, :, None] - int(window)
+        bias = (None if alibi is None
+                else _alibi_bias(alibi, q_pos[:, :, None],
+                                 key_idx[None, None, :], num_kv_heads))
         mask = mask[:, None, None]  # (B, 1, 1, T, S)
     else:
         q_pos = offset + jnp.arange(T, dtype=jnp.int32)
         mask = key_idx[None, :] <= q_pos[:, None]  # (T, S)
         if window is not None:
             mask &= key_idx[None, :] > q_pos[:, None] - int(window)
-    out = _attend(qg, k_full, v_full, mask, dropout_rate, dropout_rng)
+        bias = (None if alibi is None
+                else _alibi_bias(alibi, q_pos[:, None], key_idx[None, :],
+                                 num_kv_heads))
+    out = _attend(qg, k_full, v_full, mask, dropout_rate, dropout_rng,
+                  bias=bias)
     return out.reshape(B, Hq, T, D)
 
 

@@ -899,7 +899,7 @@ class CausalSelfAttention(Module):
                  rope_pct: Optional[float] = None,
                  qk_norm: bool = False, qk_norm_eps: float = 1e-6,
                  qk_norm_scope: str = "head", rope_dim=None,
-                 qk_norm_fp32_weight: bool = False):
+                 qk_norm_fp32_weight: bool = False, alibi: bool = False):
         if sliding_window is not None and int(sliding_window) < 1:
             raise ValueError(f"sliding_window must be >= 1, "
                              f"got {sliding_window}")
@@ -929,6 +929,13 @@ class CausalSelfAttention(Module):
         self.num_heads = int(num_heads)
         self.num_kv_heads = int(num_kv_heads) if num_kv_heads is not None else int(num_heads)
         self.dropout = float(dropout)
+        # ALiBi (Press et al. 2022, BLOOM/MPT): per-head linear position
+        # bias on the attention logits instead of rotary/learned
+        # positions; slopes are a pure function of the head count.
+        self.alibi = bool(alibi)
+        if self.alibi and rope_theta is not None:
+            raise ValueError("alibi and rope_theta are mutually exclusive "
+                             "position encodings")
         self.rope_theta = float(rope_theta) if rope_theta is not None else None
         self.head_dim = int(head_dim) if head_dim is not None else None
         # Partial rotary (GPT-NeoX rotary_pct): rotate only the first
@@ -1049,6 +1056,18 @@ class CausalSelfAttention(Module):
         dropout_rate = self.dropout if ctx.training else 0.0
         dropout_rng = ctx.next_rng() if (dropout_rate > 0.0 and ctx.training) else None
 
+        alibi = attn_ops.alibi_slopes(self.num_heads) if self.alibi else None
+        if alibi is not None:
+            from penroz_tpu.ops import kv_cache as KVC
+            if (ctx.sp_mesh is not None or ctx.sp_manual_axis is not None
+                    or isinstance(ctx.kv, KVC.PagedKVState)):
+                # Explicit scope: the ring/Ulysses bodies and the paged
+                # kernel have no bias input yet — refuse loudly instead
+                # of silently attending without the position bias.
+                raise ValueError(
+                    "alibi attention does not compose with sequence "
+                    "parallelism or the paged KV cache yet")
+
         if ctx.kv is not None:
             from penroz_tpu.ops import kv_cache as KV
             paged = isinstance(ctx.kv, KV.PagedKVState)
@@ -1082,7 +1101,7 @@ class CausalSelfAttention(Module):
                                                 dropout_rng=dropout_rng,
                                                 platform=ctx.platform,
                                                 window=self.sliding_window,
-                                                **scales)
+                                                alibi=alibi, **scales)
         elif ctx.sp_manual_axis is not None and dropout_rate == 0.0:
             # Inside the GPipe schedule with the sequence axis manual: the
             # SP bodies run on the ambient axis (a nested shard_map is
@@ -1131,6 +1150,7 @@ class CausalSelfAttention(Module):
             out = attn_ops.causal_attention(q, k, v, dropout_rate=dropout_rate,
                                             dropout_rng=dropout_rng,
                                             platform=ctx.platform,
-                                            window=self.sliding_window)
+                                            window=self.sliding_window,
+                                            alibi=alibi)
 
         return out.transpose(0, 2, 1, 3).reshape(B, T, q_dim)

@@ -749,3 +749,52 @@ def test_paged_kernel_fetch_pages_parity_interpret():
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(base), atol=2e-5,
                 err_msg=f"G={G} window={window}")
+
+
+def test_alibi_slopes_match_hf_bloom():
+    """Slopes must equal HF's build_alibi_tensor head biases for power-of
+    -two and non-power-of-two head counts."""
+    import torch
+    from transformers.models.bloom.modeling_bloom import build_alibi_tensor
+    from penroz_tpu.ops import attention as attn_ops
+    for heads in (4, 8, 6, 12):
+        mask = torch.ones(1, 5, dtype=torch.long)
+        hf = build_alibi_tensor(mask, heads, torch.float32)  # (H, 1, 5)
+        hf_slopes = (hf[:, 0, 1] - hf[:, 0, 0]).numpy()  # per-key step
+        np.testing.assert_allclose(attn_ops.alibi_slopes(heads), hf_slopes,
+                                   rtol=1e-6, err_msg=str(heads))
+
+
+def test_alibi_attention_shift_invariance_vs_absolute_form():
+    """Our slope*(k-q) bias equals HF's slope*k form after softmax (rows
+    differ by a constant), on both the causal and the cached path."""
+    from penroz_tpu.ops import attention as attn_ops
+    rng = np.random.default_rng(0)
+    B, H, T, D = 2, 4, 6, 8
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    slopes = attn_ops.alibi_slopes(H)
+    ours = attn_ops.causal_attention_reference(q, k, v, alibi=slopes)
+
+    # absolute-form oracle: bias = slope * k_pos (HF Bloom)
+    scale = 1.0 / np.sqrt(D)
+    logits = np.einsum("bhtd,bhsd->bhts", np.asarray(q), np.asarray(k)) \
+        * scale
+    logits = logits + slopes[None, :, None, None] * np.arange(T)[None, None,
+                                                                 None, :]
+    mask = np.tril(np.ones((T, T), bool))
+    logits = np.where(mask, logits, -1e30)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.einsum("bhts,bhsd->bhtd", probs, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(ours), want, atol=2e-5)
+
+    # cached path: prefill T tokens then decode 1 == uncached row T-1
+    kf = jnp.zeros((B, H, 16, D), jnp.float32).at[:, :, :T].set(k)
+    vf = jnp.zeros((B, H, 16, D), jnp.float32).at[:, :, :T].set(v)
+    got = attn_ops.cached_attention(q[:, :, -1:], kf, vf,
+                                    jnp.asarray(T - 1), jnp.asarray(T),
+                                    alibi=slopes)
+    np.testing.assert_allclose(np.asarray(got)[:, :, 0], want[:, :, -1],
+                               atol=2e-5)

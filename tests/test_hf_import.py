@@ -1009,3 +1009,133 @@ def test_phi3_import_logit_parity_and_generate(workdir,
     toks = model.generate_tokens([[1, 2, 3]], block_size=16,
                                  max_new_tokens=6, temperature=0.0)
     assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def _tiny_opt(enable_bias=True):
+    from transformers import OPTConfig, OPTForCausalLM
+    config = OPTConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=2, ffn_dim=64,
+                       max_position_embeddings=64, do_layer_norm_before=True,
+                       word_embed_proj_dim=32, enable_bias=enable_bias,
+                       activation_function="relu", dropout=0.0,
+                       attention_dropout=0.0, layerdrop=0.0)
+    torch.manual_seed(11)
+    return config, OPTForCausalLM(config).eval()
+
+
+def test_opt_import_logit_parity_and_generate(workdir):
+    """OPT: model.decoder layout, separate-then-fused biased QKV, ReLU
+    MLPs, and the LEARNED position table's +2 row offset folded away at
+    import (table[2:] == 0-based lookups under full attention masks) —
+    cached greedy must equal the uncached rollout (positions ride the
+    cache-length offset)."""
+    config, torch_model = _tiny_opt()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "opt-tiny")
+    assert model.status["code"] == "Imported"
+    # position table lost its 2 offset rows
+    assert model.params["layers.0.1.weight"].shape[0] == 64
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_opt_unsupported_variants_refused(workdir):
+    """OPT-350m's post-norm ordering and embed projections must refuse
+    loudly instead of importing wrong logits."""
+    from penroz_tpu.models.dsl import Mapper
+    from types import SimpleNamespace
+    base = dict(model_type="opt", hidden_size=32, num_hidden_layers=1,
+                num_attention_heads=2, vocab_size=96, ffn_dim=64,
+                max_position_embeddings=64)
+    with pytest.raises(ValueError, match="do_layer_norm_before"):
+        Mapper.from_hf_config(SimpleNamespace(**base,
+                                              do_layer_norm_before=False))
+    with pytest.raises(ValueError, match="word_embed_proj_dim"):
+        Mapper.from_hf_config(SimpleNamespace(**base,
+                                              do_layer_norm_before=True,
+                                              word_embed_proj_dim=16))
+
+
+def _tiny_bloom():
+    from transformers import BloomConfig, BloomForCausalLM
+    config = BloomConfig(vocab_size=96, hidden_size=32, n_layer=2,
+                         n_head=4, hidden_dropout=0.0,
+                         attention_dropout=0.0)
+    torch.manual_seed(13)
+    return config, BloomForCausalLM(config).eval()
+
+
+def test_bloom_import_logit_parity_and_generate(workdir):
+    """BLOOM: no positional embedding at all — ALiBi logit biases carry
+    position — plus the embedding LayerNorm and the per-head-interleaved
+    fused QKV de-interleaved at import.  Cached greedy must equal the
+    uncached rollout (the bias rides the cache positions)."""
+    config, torch_model = _tiny_bloom()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "bloom-tiny")
+    assert model.status["code"] == "Imported"
+    # bare embedding + embedding-LayerNorm — no position table exists
+    assert "layers.0.weight" in model.params
+    assert model.params["layers.1.weight"].ndim == 1
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_bloom_post_layernorm_residual_refused():
+    from penroz_tpu.models.dsl import Mapper
+    from types import SimpleNamespace
+    cfg = SimpleNamespace(model_type="bloom", hidden_size=32, n_layer=1,
+                          n_head=4, vocab_size=96,
+                          apply_residual_connection_post_layernorm=True)
+    with pytest.raises(ValueError, match="post_layernorm"):
+        Mapper.from_hf_config(cfg)
+
+
+def test_opt_dropout_knobs_wired_separately():
+    """attention_dropout drives the attention probs; `dropout` the
+    embedding and both residual streams (opt-125m ships 0.1/0.0 — wiring
+    them together silently diverges fine-tuning from HF)."""
+    from penroz_tpu.models.dsl import Mapper
+    from types import SimpleNamespace
+    cfg = SimpleNamespace(model_type="opt", hidden_size=32,
+                          num_hidden_layers=1, num_attention_heads=2,
+                          vocab_size=96, ffn_dim=64,
+                          max_position_embeddings=64,
+                          do_layer_norm_before=True, word_embed_proj_dim=32,
+                          enable_bias=True, activation_function="relu",
+                          dropout=0.1, attention_dropout=0.0)
+    layers = Mapper.from_hf_config(cfg)
+    blk = layers[2]["residual"]
+    attn_entry = blk[0]["sequential"][2]["attention"]
+    assert attn_entry["dropout"] == 0.0
+    assert blk[0]["sequential"][-1] == {"dropout": {"p": 0.1}}
+    assert blk[1]["sequential"][-1] == {"dropout": {"p": 0.1}}
+    assert layers[1] == {"dropout": {"p": 0.1}}

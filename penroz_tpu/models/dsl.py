@@ -519,7 +519,7 @@ def _opt_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     ffn = int(getattr(config, "ffn_dim", 4 * d))
     bias = bool(getattr(config, "enable_bias", True))
     act = str(getattr(config, "activation_function", "relu"))
-    act_entry = ({"relu": {}} if act == "relu" else _gpt2_gelu_entry(act))
+    act_entry = _gelu_entry(act, "opt")  # raises on unsupported strings
     # HF OPT applies `dropout` to the embedding and BOTH residual streams
     # and `attention_dropout` to the attention probabilities — distinct
     # knobs (opt-125m ships 0.1 / 0.0).

@@ -196,14 +196,10 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
     guess from global config — and a model explicitly placed on CPU on a
     TPU-attached host would dispatch kernels that cannot lower for CPU.
 
-    ``alibi`` slopes currently route through the jnp path (the flash
-    kernels have no bias input yet — gating is explicit rather than a
-    silent wrong-math dispatch).
+    ``alibi``: per-query-head slopes — the kernels add the linear
+    position bias in-tile (SMEM slopes, same pattern as the dropout
+    seed), so BLOOM/MPT-class models keep the fused path.
     """
-    if alibi is not None:
-        return causal_attention_reference(q, k, v, dropout_rate,
-                                          dropout_rng, window=window,
-                                          alibi=alibi)
     if _use_flash(q, k, platform):
         from penroz_tpu.ops.pallas import flash_attention as fa
         if dropout_rate > 0.0 and dropout_rng is not None:
@@ -216,10 +212,12 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
                                       dtype=jnp.int32)
             return fa.flash_attention(q, k, v, causal=True,
                                       dropout_rate=float(dropout_rate),
-                                      seed=seed, window=window)
-        return fa.flash_attention(q, k, v, causal=True, window=window)
+                                      seed=seed, window=window,
+                                      alibi=alibi)
+        return fa.flash_attention(q, k, v, causal=True, window=window,
+                                  alibi=alibi)
     return causal_attention_reference(q, k, v, dropout_rate, dropout_rng,
-                                      window=window)
+                                      window=window, alibi=alibi)
 
 
 def cached_attention(q, k_full, v_full, offset, length,

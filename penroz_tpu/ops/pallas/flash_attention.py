@@ -121,10 +121,10 @@ def _live_block(qi, kj, block_q: int, block_k: int, causal: bool, window):
     return live
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(seed_ref, alibi_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, causal: bool, sm_scale: float,
                 block_q: int, block_k: int, num_k: int, num_heads: int,
-                dropout_rate: float, window=None):
+                dropout_rate: float, window=None, use_alibi: bool = False):
     b, h, qi, kj = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
                     pl.program_id(3))
 
@@ -146,6 +146,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32,
             precision=_dot_precision(q.dtype)) * sm_scale
         q_pos, k_pos = _block_positions(qi, kj, block_q, block_k)
+        if use_alibi:
+            # ALiBi: per-head linear position bias slope·(k−q), ≤ 0 in
+            # the causal region; slopes ride SMEM like the dropout seed.
+            s = s + alibi_ref[h] * (k_pos - q_pos).astype(jnp.float32)
         mask = _band_mask(q_pos, k_pos, causal, window)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
@@ -198,7 +202,7 @@ def _flash_forward(q, k, v, causal: bool = True,
                    block_k: int = DEFAULT_BLOCK_K,
                    dropout_rate: float = 0.0, seed=None,
                    interpret: bool = False, return_lse: bool = False,
-                   window=None):
+                   window=None, alibi=None):
     B, Hq, T, D = q.shape
     Hkv, S = k.shape[1], k.shape[2]
     group = Hq // Hkv
@@ -216,15 +220,19 @@ def _flash_forward(q, k, v, causal: bool = True,
     else:
         seed = jnp.asarray(seed, jnp.int32).reshape((1,))
 
+    use_alibi = alibi is not None
+    alibi_arr = (jnp.asarray(alibi, jnp.float32) if use_alibi
+                 else jnp.zeros((1,), jnp.float32))
     grid = (B, Hq, T // block_q, num_k)
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q,
         block_k=block_k, num_k=num_k, num_heads=Hq,
-        dropout_rate=dropout_rate, window=window)
+        dropout_rate=dropout_rate, window=window, use_alibi=use_alibi)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, i, j: (b, h, i, 0),
@@ -264,7 +272,7 @@ def _flash_forward(q, k, v, causal: bool = True,
                                * q.dtype.itemsize),
             transcendentals=int(B * Hq * T * S)),
         interpret=interpret,
-    )(seed, q, k, v)
+    )(seed, alibi_arr, q, k, v)
     return (out, lse) if return_lse else out
 
 
@@ -273,9 +281,11 @@ def _flash_forward(q, k, v, causal: bool = True,
 # ---------------------------------------------------------------------------
 
 
-def _recompute_probs(q, k, lse, qi, kj, seed_ref, b, h, *, causal: bool,
+def _recompute_probs(q, k, lse, qi, kj, seed_ref, alibi_ref, b, h, *,
+                     causal: bool,
                      sm_scale: float, block_q: int, block_k: int,
-                     num_heads: int, dropout_rate: float, window=None):
+                     num_heads: int, dropout_rate: float, window=None,
+                     use_alibi: bool = False):
     """Normalized probabilities p (and the dropout keep-scale) for one
     (query-block, key-block) tile, identical to the forward's math."""
     s = jax.lax.dot_general(
@@ -283,6 +293,8 @@ def _recompute_probs(q, k, lse, qi, kj, seed_ref, b, h, *, causal: bool,
         preferred_element_type=jnp.float32,
         precision=_dot_precision(q.dtype)) * sm_scale
     q_pos, k_pos = _block_positions(qi, kj, block_q, block_k)
+    if use_alibi:
+        s = s + alibi_ref[h] * (k_pos - q_pos).astype(jnp.float32)
     mask = _band_mask(q_pos, k_pos, causal, window)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
@@ -301,10 +313,10 @@ def _recompute_probs(q, k, lse, qi, kj, seed_ref, b, h, *, causal: bool,
     return p, drop_scale
 
 
-def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
-               dq_ref, dq_scr, *, causal: bool, sm_scale: float,
+def _dq_kernel(seed_ref, alibi_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref,
+               do_ref, dq_ref, dq_scr, *, causal: bool, sm_scale: float,
                block_q: int, block_k: int, num_k: int, num_heads: int,
-               dropout_rate: float, window=None):
+               dropout_rate: float, window=None, use_alibi: bool = False):
     b, h, qi, kj = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
                     pl.program_id(3))
 
@@ -321,9 +333,11 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         p, drop_scale = _recompute_probs(
-            q, k, lse_ref[0, 0][:, 0], qi, kj, seed_ref, b, h, causal=causal,
+            q, k, lse_ref[0, 0][:, 0], qi, kj, seed_ref, alibi_ref, b, h,
+            causal=causal,
             sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            num_heads=num_heads, dropout_rate=dropout_rate, window=window)
+            num_heads=num_heads, dropout_rate=dropout_rate, window=window,
+            use_alibi=use_alibi)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -341,10 +355,12 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
+def _dkv_kernel(seed_ref, alibi_ref, q_ref, k_ref, v_ref, lse_ref,
+                delta_ref, do_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
                 sm_scale: float, block_q: int, block_k: int, num_q: int,
-                num_heads: int, dropout_rate: float, window=None):
+                num_heads: int, dropout_rate: float, window=None,
+                use_alibi: bool = False):
     b, h, kj, qi = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
                     pl.program_id(3))
 
@@ -362,9 +378,11 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         p, drop_scale = _recompute_probs(
-            q, k, lse_ref[0, 0][:, 0], qi, kj, seed_ref, b, h, causal=causal,
+            q, k, lse_ref[0, 0][:, 0], qi, kj, seed_ref, alibi_ref, b, h,
+            causal=causal,
             sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            num_heads=num_heads, dropout_rate=dropout_rate, window=window)
+            num_heads=num_heads, dropout_rate=dropout_rate, window=window,
+            use_alibi=use_alibi)
         p_drop = p if drop_scale is None else p * drop_scale
         # dV += p̃ᵀ · dO
         dv_scr[...] += jax.lax.dot_general(
@@ -392,7 +410,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                     block_k: int, dropout_rate: float, seed,
-                    interpret: bool = False, window=None):
+                    interpret: bool = False, window=None, alibi=None):
     B, Hq, T, D = q.shape
     Hkv, S = k.shape[1], k.shape[2]
     group = Hq // Hkv
@@ -411,6 +429,9 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
+    use_alibi = alibi is not None
+    alibi_arr = (jnp.asarray(alibi, jnp.float32) if use_alibi
+                 else jnp.zeros((1,), jnp.float32))
     seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0),
                           memory_space=pltpu.VMEM)
@@ -425,10 +446,10 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
         functools.partial(_dq_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, num_k=num_k,
                           num_heads=Hq, dropout_rate=dropout_rate,
-                          window=window),
+                          window=window, use_alibi=use_alibi),
         grid=(B, Hq, num_q, num_k),
-        in_specs=[seed_spec, q_spec, kv_spec, kv_spec, row_spec, row_spec,
-                  q_spec],
+        in_specs=[seed_spec, seed_spec, q_spec, kv_spec, kv_spec, row_spec,
+                  row_spec, q_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
@@ -441,7 +462,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                                * q.dtype.itemsize),
             transcendentals=int(B * Hq * T * S)),
         interpret=interpret,
-    )(seed, q, k, v, lse, delta, g)
+    )(seed, alibi_arr, q, k, v, lse, delta, g)
 
     # K/V-resident kernel: Q, dO, lse, δ stream through the inner grid.
     # index maps take (b, h, kj, qi) — note q-row specs select on qi (dim 3).
@@ -461,10 +482,10 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
         functools.partial(_dkv_kernel, causal=causal, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k, num_q=num_q,
                           num_heads=Hq, dropout_rate=dropout_rate,
-                          window=window),
+                          window=window, use_alibi=use_alibi),
         grid=(B, Hq, num_k, num_q),
-        in_specs=[seed_spec, q_stream, kv_res, kv_res, row_stream,
-                  row_stream, q_stream],
+        in_specs=[seed_spec, seed_spec, q_stream, kv_res, kv_res,
+                  row_stream, row_stream, q_stream],
         out_specs=[dkv_out, dkv_out],
         out_shape=[jax.ShapeDtypeStruct((B, Hq, S, D), k.dtype),
                    jax.ShapeDtypeStruct((B, Hq, S, D), v.dtype)],
@@ -479,7 +500,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                                * q.dtype.itemsize),
             transcendentals=int(B * Hq * T * S)),
         interpret=interpret,
-    )(seed, q, k, v, lse, delta, g)
+    )(seed, alibi_arr, q, k, v, lse, delta, g)
 
     if group > 1:
         dk = dk_ph.reshape(B, Hkv, group, S, D).sum(axis=2).astype(k.dtype)
@@ -495,30 +516,31 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, seed, causal, block_q, block_k, dropout_rate, interpret,
-           window):
+           window, alibi):
     out = _flash_forward(q, k, v, causal, block_q, block_k,
                          dropout_rate=dropout_rate, seed=seed,
-                         interpret=interpret, window=window)
+                         interpret=interpret, window=window, alibi=alibi)
     return out
 
 
 def _flash_fwd_rule(q, k, v, seed, causal, block_q, block_k, dropout_rate,
-                    interpret, window):
+                    interpret, window, alibi):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
                               dropout_rate=dropout_rate, seed=seed,
                               interpret=interpret, return_lse=True,
-                              window=window)
+                              window=window, alibi=alibi)
     return out, (q, k, v, seed, out, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, dropout_rate, interpret,
-                    window, residuals, g):
+                    window, alibi, residuals, g):
     q, k, v, seed, out, lse = residuals
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, block_q,
                                  block_k, dropout_rate, seed,
-                                 interpret=interpret, window=window)
+                                 interpret=interpret, window=window,
+                                 alibi=alibi)
     return dq, dk, dv, np.zeros((), dtype=jax.dtypes.float0)
 
 
@@ -543,7 +565,7 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_q: int | None = None,
                     block_k: int | None = None,
                     dropout_rate: float = 0.0, seed=None,
-                    interpret: bool = False, window=None):
+                    interpret: bool = False, window=None, alibi=None):
     """Flash attention with a fused flash backward.
 
     q: (B, Hq, T, D); k, v: (B, Hkv, S, D) with Hq % Hkv == 0.
@@ -563,7 +585,15 @@ def flash_attention(q, k, v, causal: bool = True,
         block_k = _env_block("PENROZ_FLASH_BLOCK_K", DEFAULT_BLOCK_K)
     if seed is None:
         seed = jnp.zeros((), jnp.int32)
+    if alibi is not None:
+        # static tuple: slopes are a pure function of the head count, so
+        # baking them into the trace costs nothing and keeps the
+        # custom_vjp arity fixed
+        alibi = tuple(float(a) for a in np.asarray(alibi).reshape(-1))
+        if len(alibi) != q.shape[1]:
+            raise ValueError(f"alibi needs one slope per query head "
+                             f"({q.shape[1]}), got {len(alibi)}")
     return _flash(q, k, v, jnp.asarray(seed, jnp.int32), causal,
                   int(block_q), int(block_k), float(dropout_rate),
                   bool(interpret),
-                  int(window) if window is not None else None)
+                  int(window) if window is not None else None, alibi)

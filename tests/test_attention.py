@@ -798,3 +798,30 @@ def test_alibi_attention_shift_invariance_vs_absolute_form():
                                     alibi=slopes)
     np.testing.assert_allclose(np.asarray(got)[:, :, 0], want[:, :, -1],
                                atol=2e-5)
+
+
+def test_flash_kernel_alibi_matches_oracle_interpret():
+    """Flash kernels with ALiBi (interpret): forward AND dq/dk/dv match
+    the jnp oracle — the bias is added in-tile from SMEM slopes, and the
+    backward recompute must include it or p diverges from the forward."""
+    from penroz_tpu.ops.pallas import flash_attention as FA
+    B, Hq, Hkv, T, D = 1, 4, 2, 256, 64
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, Hkv, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, Hkv, T, D)).astype(np.float32))
+    slopes = A.alibi_slopes(Hq)
+    out = FA.flash_attention(q, k, v, True, 128, 128, interpret=True,
+                             alibi=slopes)
+    ref = A.causal_attention_reference(q, k, v, alibi=slopes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    gf = jax.grad(lambda q, k, v: FA.flash_attention(
+        q, k, v, True, 128, 128, interpret=True,
+        alibi=slopes).sum(), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: A.causal_attention_reference(
+        q, k, v, alibi=slopes).sum(), (0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        err = float(jnp.abs(a - b).max())
+        scale = max(float(jnp.abs(b).max()), 1.0)
+        assert err <= 2e-4 * scale, f"d{name}: {err}"

@@ -263,6 +263,8 @@ class Mapper:
             return _opt_dsl_from_config(config, n_layer_override)
         if model_type == "bloom":
             return _bloom_dsl_from_config(config, n_layer_override)
+        if model_type == "mpt":
+            return _mpt_dsl_from_config(config, n_layer_override)
         raise ValueError(f"Unsupported HuggingFace model type: {model_type}")
 
     # -- HF state-dict detection + remapping --------------------------------
@@ -273,7 +275,8 @@ class Mapper:
         (reference: mappers.py:276-302)."""
         import re
         pattern = re.compile(
-            r"(?:transformer\.h|gpt_neox\.layers|model\.decoder\.layers"
+            r"(?:transformer\.h|transformer\.blocks|gpt_neox\.layers"
+            r"|model\.decoder\.layers"
             r"|model\.(?:language_model\.)?layers)\.(\d+)\.")
         n = 0
         for key in state_dict:
@@ -294,6 +297,10 @@ class Mapper:
             # checked BEFORE the gpt2 key sniff: bigcode checkpoints also
             # carry transformer.wte.weight but use plain nn.Linear layouts
             return _map_bigcode_state_dict(state_dict, n_layer, config)
+        if getattr(config, "model_type", "") == "mpt" or \
+                "transformer.blocks.0.attn.Wqkv.weight" in state_dict:
+            # also before the gpt2 sniff: MPT carries transformer.wte too
+            return _map_mpt_state_dict(state_dict, n_layer, config)
         if "transformer.wte.weight" in state_dict:
             # Config-less safety sniff: GPT-2 Conv1D stores c_attn as
             # (d, 3d); gpt_bigcode/falcon-style nn.Linear layouts are
@@ -488,6 +495,125 @@ def _map_bloom_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     out[f"layers.{2 + n_layer}.bias"] = sd[f"{pfx}.ln_f.bias"]
     out[f"layers.{3 + n_layer}.weight"] = sd.get(
         "lm_head.weight", sd[f"{pfx}.word_embeddings.weight"])
+    return out
+
+
+def _mpt_dsl_from_config(config, n_layer_override=None) -> list[dict]:
+    """MPT HF config → layer DSL: ALiBi attention (no positional
+    embedding), weight-only LayerNorms, bias-free projections, fused
+    ``Wqkv`` already in our [q|k|v] layout, exact-GELU 4× MLPs, optional
+    ``clip_qkv`` clamp (the OLMo v1 mechanism).
+
+    Refused loudly (wrong math otherwise): ``alibi=False`` checkpoints
+    (learned-position MPTs), non-``multihead_attention`` attn types,
+    ``qk_ln``, custom ``softmax_scale``, and non-power-of-two head
+    counts — MPT's non-pow2 slope interleave differs from the standard
+    ALiBi formula our attention computes."""
+    import math as _math
+    d = int(config.d_model)
+    n = int(n_layer_override if n_layer_override else config.n_layers)
+    heads = int(config.n_heads)
+    vocab = int(config.vocab_size)
+    eps = float(getattr(config, "layer_norm_epsilon", 1e-5))
+    no_bias = bool(getattr(config, "no_bias", True))
+    expansion = int(getattr(config, "expansion_ratio", 4))
+    attn_cfg = getattr(config, "attn_config", None)
+    get = (attn_cfg.get if isinstance(attn_cfg, dict)
+           else lambda k, dflt=None: getattr(attn_cfg, k, dflt))
+    if attn_cfg is None or not get("alibi", False):
+        raise ValueError("MPT without alibi (learned-position variants) "
+                         "is not supported")
+    if get("attn_type", "multihead_attention") != "multihead_attention":
+        raise ValueError(f"MPT attn_type {get('attn_type')!r} is not "
+                         "supported (multihead_attention only)")
+    if get("qk_ln", False):
+        raise ValueError("MPT qk_ln is not supported")
+    if get("softmax_scale") is not None:
+        raise ValueError("MPT custom softmax_scale is not supported")
+    if not _math.log2(heads).is_integer():
+        raise ValueError(
+            f"MPT with non-power-of-two heads ({heads}) is not supported: "
+            "its slope interleave differs from the standard ALiBi formula")
+    clip = get("clip_qkv")
+    attn_drop = float(get("attn_pdrop", 0.0) or 0.0)
+
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for _ in range(n):
+        attn_items = [
+            {"layernorm": {"normalized_shape": d, "eps": eps,
+                           "bias": False}},
+            {"linear": {"in_features": d, "out_features": 3 * d,
+                        "bias": not no_bias},
+             "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+        ]
+        if clip is not None:
+            attn_items.append({"clamp": {"min": -float(clip),
+                                         "max": float(clip)}})
+        attn_items += [
+            # head_dim explicit: the optional clamp between the QKV
+            # linear and the attention breaks adjacency-based inference
+            {"attention": {"num_heads": heads, "dropout": attn_drop,
+                           "alibi": True, "head_dim": d // heads}},
+            {"linear": {"in_features": d, "out_features": d,
+                        "bias": not no_bias},
+             "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+        ]
+        layers.append({"residual": [
+            {"sequential": attn_items},
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d, "eps": eps,
+                               "bias": False}},
+                {"linear": {"in_features": d,
+                            "out_features": expansion * d,
+                            "bias": not no_bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"gelu": {}},  # MptMLP: nn.GELU(approximate="none")
+                {"linear": {"in_features": expansion * d,
+                            "out_features": d, "bias": not no_bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}}]}]})
+    layers += [
+        {"layernorm": {"normalized_shape": d, "eps": eps, "bias": False}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False},
+         "normal": {"mean": 0.0, "std": 0.02}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _map_mpt_state_dict(sd: dict, n_layer: int, config=None) -> dict:
+    """MPT HF keys → ours: straight copies — ``Wqkv`` is already fused in
+    our [q|k|v] row order, the LayerNorms carry weights only, and the
+    clamp entry (clip_qkv) shifts the attention branch's item indices
+    exactly like OLMo v1."""
+    cfg = _llama_text_config(config)
+    attn_cfg = getattr(cfg, "attn_config", None) if cfg is not None else None
+    get = (attn_cfg.get if isinstance(attn_cfg, dict)
+           else lambda k, dflt=None: getattr(attn_cfg, k, dflt))
+    has_clip = attn_cfg is not None and get("clip_qkv") is not None
+    i_out = 4 if has_clip else 3  # [ln, qkv, (clamp,) attention, out]
+    out = {"layers.0.weight": sd["transformer.wte.weight"]}
+    for i in range(n_layer):
+        src = f"transformer.blocks.{i}"
+        dst = f"layers.{1 + i}"
+        out[f"{dst}.0.0.weight"] = sd[f"{src}.norm_1.weight"]
+        out[f"{dst}.0.1.weight"] = sd[f"{src}.attn.Wqkv.weight"]
+        if f"{src}.attn.Wqkv.bias" in sd:
+            out[f"{dst}.0.1.bias"] = sd[f"{src}.attn.Wqkv.bias"]
+        out[f"{dst}.0.{i_out}.weight"] = sd[f"{src}.attn.out_proj.weight"]
+        if f"{src}.attn.out_proj.bias" in sd:
+            out[f"{dst}.0.{i_out}.bias"] = sd[f"{src}.attn.out_proj.bias"]
+        out[f"{dst}.1.0.weight"] = sd[f"{src}.norm_2.weight"]
+        out[f"{dst}.1.1.weight"] = sd[f"{src}.ffn.up_proj.weight"]
+        out[f"{dst}.1.3.weight"] = sd[f"{src}.ffn.down_proj.weight"]
+        if f"{src}.ffn.up_proj.bias" in sd:
+            out[f"{dst}.1.1.bias"] = sd[f"{src}.ffn.up_proj.bias"]
+            out[f"{dst}.1.3.bias"] = sd[f"{src}.ffn.down_proj.bias"]
+    out[f"layers.{1 + n_layer}.weight"] = sd["transformer.norm_f.weight"]
+    out[f"layers.{2 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd["transformer.wte.weight"])
     return out
 
 

@@ -1139,3 +1139,61 @@ def test_opt_dropout_knobs_wired_separately():
     assert blk[0]["sequential"][-1] == {"dropout": {"p": 0.1}}
     assert blk[1]["sequential"][-1] == {"dropout": {"p": 0.1}}
     assert layers[1] == {"dropout": {"p": 0.1}}
+
+
+def _tiny_mpt(clip_qkv=None):
+    from transformers import MptConfig, MptForCausalLM
+    config = MptConfig(d_model=32, n_heads=4, n_layers=2, vocab_size=96,
+                       expansion_ratio=4,
+                       attn_config={"alibi": True, "clip_qkv": clip_qkv,
+                                    "attn_pdrop": 0.0})
+    torch.manual_seed(17)
+    return config, MptForCausalLM(config).eval()
+
+
+@pytest.mark.parametrize("clip_qkv", [None, 4.0])
+def test_mpt_import_logit_parity_and_generate(workdir, clip_qkv):
+    """MPT: ALiBi (MPT's slope·(k−T+1) absolute form is softmax-shift-
+    equivalent to our slope·(k−q)), weight-only LayerNorms, bias-free
+    projections, Wqkv already in our fused layout, optional clip_qkv
+    clamp shifting the branch indices."""
+    config, torch_model = _tiny_mpt(clip_qkv=clip_qkv)
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    tag = "mpt-clip" if clip_qkv else "mpt-tiny"
+    model = _import_model(workdir, config, torch_model, tag)
+    assert model.status["code"] == "Imported"
+    assert not any(k.endswith(".bias") for k in model.params)  # no_bias
+    import json as _json
+    assert ('"clamp"' in _json.dumps(model.layers_dsl)) == \
+        (clip_qkv is not None)
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_mpt_unsupported_variants_refused():
+    from penroz_tpu.models.dsl import Mapper
+    from types import SimpleNamespace
+    base = dict(model_type="mpt", d_model=32, n_layers=1, vocab_size=96)
+    with pytest.raises(ValueError, match="alibi"):
+        Mapper.from_hf_config(SimpleNamespace(
+            **base, n_heads=4, attn_config={"alibi": False}))
+    with pytest.raises(ValueError, match="power-of-two"):
+        Mapper.from_hf_config(SimpleNamespace(
+            **base, n_heads=6, attn_config={"alibi": True}))
+    with pytest.raises(ValueError, match="qk_ln"):
+        Mapper.from_hf_config(SimpleNamespace(
+            **base, n_heads=4, attn_config={"alibi": True, "qk_ln": True}))

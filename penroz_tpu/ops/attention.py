@@ -241,12 +241,11 @@ def cached_attention(q, k_full, v_full, offset, length,
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be passed together "
                          "(int8 caches carry scales for both streams)")
-    if (alibi is None and dropout_rate == 0.0
-            and _use_flash_decode(q, k_full, platform)):
+    if dropout_rate == 0.0 and _use_flash_decode(q, k_full, platform):
         from penroz_tpu.ops.pallas import decode_attention as da
         return da.decode_attention(q, k_full, v_full, offset, length,
                                    k_scale=k_scale, v_scale=v_scale,
-                                   window=window)
+                                   window=window, alibi=alibi)
     if k_scale is not None:
         k_full = (k_full.astype(jnp.float32) * k_scale).astype(q.dtype)
         v_full = (v_full.astype(jnp.float32) * v_scale).astype(q.dtype)

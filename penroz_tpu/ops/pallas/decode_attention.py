@@ -32,6 +32,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -57,7 +58,7 @@ def normalize_lengths(length, batch: int):
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
                    num_k: int, num_queries: int, sm_scale: float,
-                   quantized: bool, window=None):
+                   quantized: bool, window=None, use_alibi: bool = False):
     """One (batch, kv-head, k-block) step: GT grouped query rows vs one tile.
 
     q_ref: (1, 1, GT, D) where GT = group * T, row r ↦ (g = r // T, t = r % T).
@@ -69,11 +70,15 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
     ragged batches).  Scratch carries the online-softmax state across the
     sequential j dimension.
     """
+    refs = list(refs)
+    ks_ref = vs_ref = slopes_ref = None
     if quantized:
-        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
-    else:
-        ks_ref = vs_ref = None
-        o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref, vs_ref = refs[:2]
+        refs = refs[2:]
+    if use_alibi:
+        slopes_ref = refs[0]
+        refs = refs[1:]
+    o_ref, m_scr, l_scr, acc_scr = refs
     b = pl.program_id(0)
     j = pl.program_id(2)
     gt = q_ref.shape[2]
@@ -109,6 +114,13 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
         t = jax.lax.broadcasted_iota(jnp.int32, (gt, block_k), 0) % num_queries
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gt, block_k), 1)
+        if use_alibi:
+            # per-query-row ALiBi slope (precomputed outside: row r ↦
+            # query head h·group + r // T): bias slope·(k − q) like the
+            # flash kernels and the jnp oracle
+            slope = slopes_ref[0][:, 0]
+            s = s + slope[:, None] * (
+                k_pos - (offset + t)).astype(jnp.float32)
         mask = k_pos <= offset + t
         if window is not None:
             mask &= k_pos > offset + t - window
@@ -139,7 +151,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
 
 def decode_attention(q, k_full, v_full, offset, length,
                      block_k: int = DEFAULT_BLOCK_K, interpret: bool = False,
-                     k_scale=None, v_scale=None, window=None):
+                     k_scale=None, v_scale=None, window=None, alibi=None):
     """Fused cached attention.  Same contract as the jnp oracle
     ``cached_attention``: q (B, Hq, T, D); k_full/v_full (B, Hkv, S_max, D);
     ``length`` = offset + T valid entries (post-append) — a shared scalar
@@ -177,11 +189,12 @@ def decode_attention(q, k_full, v_full, offset, length,
             j_eff = jnp.maximum(j_eff, jax.lax.div(lo_pos, block_k))
         return (b, h, j_eff, 0)
 
+    use_alibi = alibi is not None
     kernel = functools.partial(_decode_kernel, block_k=block_k, num_k=num_k,
                                num_queries=T, sm_scale=sm_scale,
                                quantized=quantized,
                                window=int(window) if window is not None
-                               else None)
+                               else None, use_alibi=use_alibi)
     in_specs = [
         pl.BlockSpec((1, 1, group * T, D),
                      lambda b, h, j, len_ref: (b, h, 0, 0),
@@ -198,6 +211,16 @@ def decode_attention(q, k_full, v_full, offset, length,
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale.astype(jnp.float32),
                      v_scale.astype(jnp.float32)]
+    if use_alibi:
+        # (Hkv, group·T, 1) per-query-row slopes — row r belongs to query
+        # head h·group + r // T, whose slope is constant across its rows
+        slope_rows = np.repeat(
+            np.asarray(alibi, np.float32).reshape(Hkv, group), T,
+            axis=1)[..., None]
+        in_specs += [pl.BlockSpec((1, group * T, 1),
+                                  lambda b, h, j, len_ref: (h, 0, 0),
+                                  memory_space=pltpu.VMEM)]
+        operands += [jnp.asarray(slope_rows)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, Hkv, num_k),

@@ -825,3 +825,27 @@ def test_flash_kernel_alibi_matches_oracle_interpret():
         err = float(jnp.abs(a - b).max())
         scale = max(float(jnp.abs(b).max()), 1.0)
         assert err <= 2e-4 * scale, f"d{name}: {err}"
+
+
+@pytest.mark.parametrize("ragged", [False, True])
+def test_decode_kernel_alibi_matches_oracle(ragged):
+    """Decode kernel with ALiBi (interpret) == the jnp cached oracle —
+    per-query-row slopes as a VMEM operand, scalar and ragged lengths."""
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    B, Hq, Hkv, T, D, S = 2, 4, 2, 1, 64, 256
+    rng = np.random.default_rng(21)
+    q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    slopes = A.alibi_slopes(Hq)
+    if ragged:
+        length = jnp.asarray([97, 41], jnp.int32)
+        offset = None
+    else:
+        length = jnp.asarray(97)
+        offset = jnp.asarray(96)
+    got = DA.decode_attention(q, k, v, offset, length, block_k=128,
+                              interpret=True, alibi=slopes)
+    want = A.cached_attention(q, k, v, offset, length, platform="cpu",
+                              alibi=slopes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)

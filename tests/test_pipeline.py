@@ -14,6 +14,22 @@ from penroz_tpu.parallel import mesh as mesh_lib, pipeline
 pytestmark = pytest.mark.runtime
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """XLA:CPU's AOT executable (de)serializer SEGFAULTS on the pipe x TP
+    shard_map programs this module compiles (observed on both the read
+    and the write path of the persistent cache; plain compilation and
+    execution are fine).  Opt this module out of the on-disk cache —
+    the in-process jit cache still amortizes across the module's tests.
+    NOTE: must flip ``jax_enable_compilation_cache`` (checked per
+    compile); clearing the dir does nothing once the cache object is
+    initialized."""
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", prev)
+
+
 def _blocks_dsl(d=16, depth=4):
     """depth identical pre-norm MLP residual blocks over (B, T, d)."""
     return [{"residual": [

@@ -288,7 +288,8 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
                            offset, length, dropout_rate=0.0,
                            dropout_rng=None, platform=None,
                            k_scale=None, v_scale=None,
-                           window: Optional[int] = None):
+                           window: Optional[int] = None,
+                           alibi: Optional[np.ndarray] = None):
     """Cached attention over a paged KV pool (block table indirection).
 
     On TPU dispatches to the paged Pallas kernel — one physical page of K/V
@@ -304,7 +305,7 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
         return pa.paged_decode_attention(q, flat_k, flat_v, block_table,
                                          page_size, offset, length,
                                          k_scale=k_scale, v_scale=v_scale,
-                                         window=window)
+                                         window=window, alibi=alibi)
     B = q.shape[0]
     pages_per_seq = block_table.shape[1]
     max_len = pages_per_seq * page_size
@@ -325,7 +326,7 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
     # decode kernel on the gathered views when shapes allow.
     return cached_attention(q, k_full, v_full, offset,
                             length, dropout_rate, dropout_rng,
-                            platform=platform, window=window)
+                            platform=platform, window=window, alibi=alibi)
 
 
 def _use_paged_kernel(q, flat_k, block_table, page_size: int,

@@ -1058,15 +1058,13 @@ class CausalSelfAttention(Module):
 
         alibi = attn_ops.alibi_slopes(self.num_heads) if self.alibi else None
         if alibi is not None:
-            from penroz_tpu.ops import kv_cache as KVC
-            if (ctx.sp_mesh is not None or ctx.sp_manual_axis is not None
-                    or isinstance(ctx.kv, KVC.PagedKVState)):
-                # Explicit scope: the ring/Ulysses bodies and the paged
-                # kernel have no bias input yet — refuse loudly instead
-                # of silently attending without the position bias.
+            if ctx.sp_mesh is not None or ctx.sp_manual_axis is not None:
+                # Explicit scope: the ring/Ulysses bodies have no bias
+                # input yet — refuse loudly instead of silently
+                # attending without the position bias.
                 raise ValueError(
                     "alibi attention does not compose with sequence "
-                    "parallelism or the paged KV cache yet")
+                    "parallelism yet")
 
         if ctx.kv is not None:
             from penroz_tpu.ops import kv_cache as KV
@@ -1093,7 +1091,7 @@ class CausalSelfAttention(Module):
                     q, store_k, store_v, ctx.kv.block_table, ctx.kv.page_size,
                     offset, length, dropout_rate=dropout_rate,
                     dropout_rng=dropout_rng, platform=ctx.platform,
-                    window=self.sliding_window, **scales)
+                    window=self.sliding_window, alibi=alibi, **scales)
             else:
                 out = attn_ops.cached_attention(q, store_k, store_v, offset,
                                                 length,

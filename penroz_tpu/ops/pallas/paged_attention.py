@@ -31,7 +31,8 @@ _NEG_INF = -1e30
 def _paged_kernel(len_ref, table_ref, q_ref, *rest,
                   page_size: int, num_queries: int, grid_pages: int,
                   fetch_pages: int, sm_scale: float,
-                  quantized: bool = False, window=None):
+                  quantized: bool = False, window=None,
+                  use_alibi: bool = False):
     """One grid step attends ``fetch_pages`` consecutive logical pages.
 
     Walking one page per step makes per-step DMA latency and scalar-core
@@ -49,6 +50,10 @@ def _paged_kernel(len_ref, table_ref, q_ref, *rest,
         ks_refs = rest[:G]
         vs_refs = rest[G:2 * G]
         rest = rest[2 * G:]
+    slopes_ref = None
+    if use_alibi:
+        slopes_ref = rest[0]
+        rest = rest[1:]
     o_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
@@ -94,6 +99,12 @@ def _paged_kernel(len_ref, table_ref, q_ref, *rest,
         # Positions past the sequence's occupancy — including clamped
         # re-fetches of in-band pages standing in for out-of-band ones —
         # carry logical k_pos > the causal bound, so this mask kills them.
+        if use_alibi:
+            # per-query-row ALiBi slope (row r ↦ query head h·group +
+            # r // T): bias slope·(k − q), same as the other kernels
+            slope = slopes_ref[0][:, 0]
+            s = s + slope[:, None] * (
+                k_pos - (offset + t)).astype(jnp.float32)
         mask = k_pos <= offset + t
         if window is not None:
             mask &= k_pos > offset + t - window
@@ -139,7 +150,7 @@ def default_fetch_pages() -> int:
 def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
                            offset, length, k_scale=None, v_scale=None,
                            interpret: bool = False, window=None,
-                           fetch_pages: int | None = None):
+                           fetch_pages: int | None = None, alibi=None):
     """Cached attention over a paged pool.
 
     q: (B, Hq, T, D) new queries; flat_k/flat_v: (Hkv, num_pages *
@@ -169,12 +180,13 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
     # 0 so the DMA index is in-pool — their keys are masked by k_pos>total.
     table = jnp.maximum(block_table, 0).astype(jnp.int32).reshape(-1)
 
+    use_alibi = alibi is not None
     kernel = functools.partial(_paged_kernel, page_size=page_size,
                                num_queries=T, grid_pages=grid_pages,
                                fetch_pages=G, sm_scale=sm_scale,
                                quantized=quantized,
                                window=int(window) if window is not None
-                               else None)
+                               else None, use_alibi=use_alibi)
 
     def page_lookup(b, logical, len_ref, table_ref):
         # Clamp out-of-band steps to the nearest in-band logical page: same
@@ -208,6 +220,16 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
         in_specs += [page_spec(g, 1) for g in range(G)]
         in_specs += [page_spec(g, 1) for g in range(G)]
         operands += [k_scale] * G + [v_scale] * G
+    if use_alibi:
+        import numpy as np
+        slope_rows = np.repeat(
+            np.asarray(alibi, np.float32).reshape(Hkv, group), T,
+            axis=1)[..., None]
+        in_specs += [pl.BlockSpec(
+            (1, group * T, 1),
+            lambda b, h, j, len_ref, table_ref: (h, 0, 0),
+            memory_space=pltpu.VMEM)]
+        operands += [jnp.asarray(slope_rows)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, Hkv, grid_pages),

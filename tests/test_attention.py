@@ -849,3 +849,41 @@ def test_decode_kernel_alibi_matches_oracle(ragged):
     want = A.cached_attention(q, k, v, offset, length, platform="cpu",
                               alibi=slopes)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_kernel_alibi_matches_oracle(quantized):
+    """Paged decode kernel with ALiBi (interpret) == the dense-gather jnp
+    oracle, fp and int8 pools, ragged lengths."""
+    from penroz_tpu.ops.pallas import paged_attention as PA
+    from penroz_tpu.ops import kv_cache as KV
+    B, Hq, Hkv, T, D = 2, 4, 2, 1, 64
+    page, pages_per_seq, num_pages = 128, 4, 12
+    rng = np.random.default_rng(23)
+    q = jnp.asarray(rng.normal(size=(B, Hq, T, D)).astype(np.float32))
+    rows = num_pages * page
+    slopes = A.alibi_slopes(Hq)
+    if quantized:
+        kq = jnp.asarray(rng.integers(-127, 127, (Hkv, rows, D)), jnp.int8)
+        vq = jnp.asarray(rng.integers(-127, 127, (Hkv, rows, D)), jnp.int8)
+        ks = jnp.asarray(rng.uniform(0.01, 0.02, (Hkv, rows, 1)),
+                         jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.01, 0.02, (Hkv, rows, 1)),
+                         jnp.float32)
+        scales = {"k_scale": ks, "v_scale": vs}
+        flat_k, flat_v = kq, vq
+    else:
+        flat_k = jnp.asarray(rng.normal(size=(Hkv, rows, D)), jnp.float32)
+        flat_v = jnp.asarray(rng.normal(size=(Hkv, rows, D)), jnp.float32)
+        scales = {}
+    table = jnp.asarray(rng.permutation(num_pages)[:B * pages_per_seq]
+                        .reshape(B, pages_per_seq), jnp.int32)
+    lengths = jnp.asarray([300, 170], jnp.int32)
+    got = PA.paged_decode_attention(q, flat_k, flat_v, table, page, None,
+                                    lengths, interpret=True, alibi=slopes,
+                                    **scales)
+    want = A.paged_cached_attention(q, flat_k, flat_v, table, page, None,
+                                    lengths, platform="cpu", alibi=slopes,
+                                    **scales)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5)

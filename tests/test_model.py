@@ -929,3 +929,30 @@ def test_generate_batched_dp_with_tp_parity(workdir, toy_gpt_layers,
     got = model.generate_tokens_batched(prompts, block_size=16,
                                         max_new_tokens=4, temperature=0.0)
     assert got == want
+
+
+def test_generate_alibi_paged_matches_contiguous(workdir, monkeypatch):
+    """ALiBi attention through the PAGED cache (block tables + in-jit
+    allocator) must produce the same greedy tokens as the contiguous
+    cache — the bias rides the cache positions in both layouts."""
+    d, heads, vocab = 16, 4, 32
+    layers = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d}},
+        {"residual": [
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d}},
+                {"linear": {"in_features": d, "out_features": 3 * d},
+                 "normal": {"mean": 0.0, "std": 0.2}},
+                {"attention": {"num_heads": heads, "dropout": 0.0,
+                               "alibi": True}},
+                {"linear": {"in_features": d, "out_features": d}}]}]},
+        {"linear": {"in_features": d, "out_features": vocab,
+                    "bias": False}},
+        {"softmaxlast": {"dim": -1}}]
+    model = NeuralNetworkModel("alibip", Mapper(layers, SGD))
+    want = model.generate_tokens([[1, 2, 3]], block_size=256,
+                                 max_new_tokens=6, temperature=0.0)
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    got = model.generate_tokens([[1, 2, 3]], block_size=256,
+                                max_new_tokens=6, temperature=0.0)
+    assert got == want

@@ -1057,14 +1057,6 @@ class CausalSelfAttention(Module):
         dropout_rng = ctx.next_rng() if (dropout_rate > 0.0 and ctx.training) else None
 
         alibi = attn_ops.alibi_slopes(self.num_heads) if self.alibi else None
-        if alibi is not None:
-            if ctx.sp_mesh is not None or ctx.sp_manual_axis is not None:
-                # Explicit scope: the ring/Ulysses bodies have no bias
-                # input yet — refuse loudly instead of silently
-                # attending without the position bias.
-                raise ValueError(
-                    "alibi attention does not compose with sequence "
-                    "parallelism yet")
 
         if ctx.kv is not None:
             from penroz_tpu.ops import kv_cache as KV
@@ -1108,8 +1100,9 @@ class CausalSelfAttention(Module):
             from penroz_tpu.parallel import alltoall_attention as a2a
             from penroz_tpu.parallel import ring_attention as ring
             n_seq = jax.lax.axis_size(ctx.sp_manual_axis)
-            if ctx.sp_mode == "alltoall" and a2a.alltoall_supported(
-                    q.shape[1], k.shape[1], n=n_seq):
+            if (ctx.sp_mode == "alltoall" and alibi is None
+                    and a2a.alltoall_supported(
+                        q.shape[1], k.shape[1], n=n_seq)):
                 out = a2a.alltoall_attention_manual(
                     q, k, v, axis_name=ctx.sp_manual_axis,
                     window=self.sliding_window, platform=ctx.platform)
@@ -1117,14 +1110,15 @@ class CausalSelfAttention(Module):
                 if ctx.sp_mode == "alltoall":
                     # Trace-time (shapes are static), so the operator gets
                     # a signal — mirrors the sp_mesh path's warning.
+                    # (ALiBi also lands here: the Ulysses body re-shards
+                    # HEADS, whose slopes would become device-dynamic.)
                     logging.getLogger(__name__).warning(
-                        "alltoall SP requested but head counts (Hq=%d, "
-                        "Hkv=%d) do not divide the sequence axis (%d); "
-                        "falling back to ring attention",
-                        q.shape[1], k.shape[1], n_seq)
+                        "alltoall SP unavailable (heads Hq=%d/Hkv=%d vs "
+                        "axis %d, or alibi bias); falling back to ring "
+                        "attention", q.shape[1], k.shape[1], n_seq)
                 out = ring.ring_attention_manual(
                     q, k, v, axis_name=ctx.sp_manual_axis,
-                    window=self.sliding_window)
+                    window=self.sliding_window, alibi=alibi)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
             # Sequence-parallel training over ICI (windowed when the model
             # slides — long-context SP is exactly where windows matter).
@@ -1134,7 +1128,7 @@ class CausalSelfAttention(Module):
             # (falls back to ring when heads don't divide the axis).
             from penroz_tpu.parallel import alltoall_attention as a2a
             from penroz_tpu.parallel.ring_attention import ring_attention
-            if (ctx.sp_mode == "alltoall"
+            if (ctx.sp_mode == "alltoall" and alibi is None
                     and a2a.alltoall_supported(q.shape[1], k.shape[1],
                                                ctx.sp_mesh)):
                 out = a2a.alltoall_attention(q, k, v, ctx.sp_mesh,
@@ -1142,8 +1136,14 @@ class CausalSelfAttention(Module):
                                              window=self.sliding_window,
                                              platform=ctx.platform)
             else:
+                if ctx.sp_mode == "alltoall" and alibi is not None:
+                    logging.getLogger(__name__).warning(
+                        "alltoall SP with alibi falls back to ring "
+                        "attention (the Ulysses body re-shards heads, "
+                        "whose slopes would become device-dynamic)")
                 out = ring_attention(q, k, v, ctx.sp_mesh, causal=True,
-                                     window=self.sliding_window)
+                                     window=self.sliding_window,
+                                     alibi=alibi)
         else:
             out = attn_ops.causal_attention(q, k, v, dropout_rate=dropout_rate,
                                             dropout_rng=dropout_rng,

@@ -33,8 +33,14 @@ _NEG_INF = -1e30
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          window=None):
-    """Per-shard body. q/k/v: (B, H, T_local, D) — the local blocks."""
+                          window=None, alibi=None):
+    """Per-shard body. q/k/v: (B, H, T_local, D) — the local blocks.
+
+    ``alibi``: per-query-head slopes — the ring already tracks GLOBAL
+    query/key positions for its causal masks, so the linear position
+    bias ``slope·(k − q)`` drops straight onto each rotation step's
+    score block (heads are never sharded by the ring, so the slope
+    table stays static per device)."""
     B, Hq, Tl, D = q.shape
     Hkv = k.shape[1]
     group = Hq // Hkv
@@ -44,6 +50,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
     qg = q.reshape(B, Hkv, group, Tl, D)
     q_pos = my_idx * Tl + jnp.arange(Tl, dtype=jnp.int32)
+    slopes_hg = (jnp.asarray(alibi, jnp.float32).reshape(Hkv, group)
+                 if alibi is not None else None)
     # A static window bounds how many ring steps can contribute: step i
     # brings the K block i hops back, and blocks more than
     # ceil((window-1)/Tl) hops back lie entirely below every local row's
@@ -61,6 +69,10 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         k_pos = src * Tl + jnp.arange(Tl, dtype=jnp.int32)
         s = jnp.einsum("bhgtd,bhsd->bhgts", qg, k_cur,
                        preferred_element_type=jnp.float32) * scale
+        if slopes_hg is not None:
+            rel = (k_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+            s = s + (slopes_hg[:, :, None, None]
+                     * rel[None, None])[None]
         if causal:
             mask = k_pos[None, :] <= q_pos[:, None]
             if window is not None:
@@ -104,7 +116,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
 
 def ring_attention_manual(q, k, v, *, axis_name: str = SEQ_AXIS,
-                          causal: bool = True, window=None):
+                          causal: bool = True, window=None, alibi=None):
     """Ring attention for callers ALREADY inside a manual region binding
     ``axis_name`` (e.g. the GPipe schedule's shard_map with the sequence
     axis manual) — same math as :func:`ring_attention`, minus the
@@ -115,11 +127,11 @@ def ring_attention_manual(q, k, v, *, axis_name: str = SEQ_AXIS,
     return _ring_attention_local(q, k, v, axis_name=axis_name,
                                  causal=causal,
                                  window=int(window) if window is not None
-                                 else None)
+                                 else None, alibi=alibi)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
-                   axis_name: str = SEQ_AXIS, window=None):
+                   axis_name: str = SEQ_AXIS, window=None, alibi=None):
     """Sequence-parallel attention over ``mesh``'s sequence axis.
 
     q: (B, Hq, T, D); k/v: (B, Hkv, T, D), all sharded (or shardable) on the
@@ -134,7 +146,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     body = functools.partial(_ring_attention_local, axis_name=axis_name,
                              causal=causal,
                              window=int(window) if window is not None
-                             else None)
+                             else None, alibi=alibi)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(q, k, v)

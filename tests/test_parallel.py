@@ -636,3 +636,74 @@ def test_barrier_fallback_logs_loudly(monkeypatch):
     assert called == ["penroz_unit_test_fence"]
     assert any("coordination-service client unavailable" in e
                for e in errors)
+
+
+def test_ring_attention_alibi_matches_reference(cpu_devices):
+    """Ring attention with ALiBi == the single-device biased oracle: the
+    global q/k positions the ring tracks for causal masks drive the
+    slope*(k-q) bias identically on every rotation step."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from penroz_tpu.parallel.ring_attention import ring_attention
+    from penroz_tpu.ops import attention as A
+    mesh = mesh_lib.make_mesh(cpu_devices[:4], sequence=4)
+    B, Hq, Hkv, T, D = 2, 4, 2, 32, 8
+    rng = np.random.default_rng(31)
+    q = jnp.asarray(rng.normal(size=(B, Hq, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, T, D)), jnp.float32)
+    slopes = A.alibi_slopes(Hq)
+    want = A.causal_attention_reference(q, k, v, alibi=slopes)
+    spec = NamedSharding(mesh, P(None, None, "sequence"))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    got = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh, causal=True, alibi=slopes))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_ring_attention_alibi_with_window(cpu_devices):
+    """ALiBi composes with the sliding-window band (MPT-style configs)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from penroz_tpu.parallel.ring_attention import ring_attention
+    from penroz_tpu.ops import attention as A
+    mesh = mesh_lib.make_mesh(cpu_devices[:4], sequence=4)
+    B, H, T, D = 1, 4, 32, 8
+    rng = np.random.default_rng(32)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    slopes = A.alibi_slopes(H)
+    want = A.causal_attention_reference(q, k, v, window=12, alibi=slopes)
+    spec = NamedSharding(mesh, P(None, None, "sequence"))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    got = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh, causal=True, window=12, alibi=slopes))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_sp_alibi_module_path_and_ulysses_fallback(cpu_devices, caplog):
+    """An ALiBi attention module under a sequence mesh runs ring SP (bias
+    == single-device math); requesting Ulysses falls back to ring with a
+    trace-time warning (its head re-partition would make the slope table
+    device-dynamic)."""
+    import logging
+    from penroz_tpu.ops import modules as M
+    from penroz_tpu.ops import attention as A
+    mesh = mesh_lib.make_mesh(cpu_devices[:4], sequence=4)
+    attn = M.CausalSelfAttention(num_heads=4, head_dim=8, alibi=True)
+    attn.bind("attn")
+    rng = np.random.default_rng(33)
+    B, T, d = 2, 32, 32
+    qkv = jnp.asarray(rng.normal(size=(B, T, 3 * d)), jnp.float32)
+    want = np.asarray(attn.apply(qkv, M.Ctx({})))
+    from jax.sharding import NamedSharding
+    qkv_s = jax.device_put(qkv, NamedSharding(mesh, P(None, "sequence")))
+    got = jax.jit(lambda x: attn.apply(
+        x, M.Ctx({}, sp_mesh=mesh, sp_mode="ring")))(qkv_s)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5)
+    with caplog.at_level(logging.WARNING, "penroz_tpu.ops.modules"):
+        got2 = jax.jit(lambda x: attn.apply(
+            x, M.Ctx({}, sp_mesh=mesh, sp_mode="alltoall")))(qkv_s)
+    np.testing.assert_allclose(np.asarray(got2), want, atol=2e-5)
+    assert any("falls back to ring" in r.message for r in caplog.records)

@@ -1026,7 +1026,8 @@ def _map_gemma_state_dict(sd: dict, n_layer: int, config=None) -> dict:
 # modules with pre-norm blocks, no +1 norm offset and no embedding scale)
 # ---------------------------------------------------------------------------
 
-_LLAMA_FAMILY = ("llama", "mistral", "mixtral", "phi3", "qwen2", "qwen3")
+_LLAMA_FAMILY = ("llama", "mistral", "mixtral", "phi3", "qwen2", "qwen3",
+                 "qwen2_moe")
 
 
 def _llama_text_config(config):
@@ -1034,11 +1035,58 @@ def _llama_text_config(config):
     return get() if callable(get) else config
 
 
+def _llama_moe_entry(model_type: str, cfg, d: int, n: int,
+                     activation: str) -> dict:
+    """Sparse-MoE MLP entry for the llama family.
+
+    Mixtral: softmax over ALL experts → top-k → renormalize; dense
+    dispatch reproduces HF bit-for-bit.  The aux coefficient is rescaled
+    toward HF's load_balancing_loss_func (ONE loss from fractions pooled
+    across layers with top-k-summed slots): coef × top_k / n_layers
+    matches the coefficient SCALE; the per-layer-vs-pooled structural
+    difference remains — the Switch formulation, not a bug.
+
+    Qwen2-MoE: fine-grained experts with ``norm_topk_prob`` (default
+    False — raw softmax mass on the selected experts) plus an always-on
+    shared expert behind a sigmoid token gate.  Non-default
+    ``decoder_sparse_step``/``mlp_only_layers`` (dense layers mixed into
+    the stack) are refused loudly — importing them as sparse would be
+    wrong math.
+    """
+    if model_type == "qwen2_moe":
+        if int(getattr(cfg, "decoder_sparse_step", 1) or 1) != 1 or                 list(getattr(cfg, "mlp_only_layers", []) or []):
+            raise ValueError(
+                "qwen2_moe with decoder_sparse_step != 1 or non-empty "
+                "mlp_only_layers (dense layers mixed into the stack) is "
+                "not supported")
+        return {"moe": {
+            "in_features": d,
+            "intermediate_size": int(cfg.moe_intermediate_size),
+            "num_experts": int(cfg.num_experts),
+            "top_k": int(cfg.num_experts_per_tok),
+            "activation": activation,
+            "norm_topk": bool(getattr(cfg, "norm_topk_prob", False)),
+            "shared_expert_size":
+                int(cfg.shared_expert_intermediate_size),
+            "aux_loss_coef": (
+                float(getattr(cfg, "router_aux_loss_coef", 0.0) or 0.0)
+                * int(cfg.num_experts_per_tok) / n)}}
+    return {"moe": {"in_features": d,
+                    "intermediate_size": int(cfg.intermediate_size),
+                    "num_experts": int(cfg.num_local_experts),
+                    "top_k": int(cfg.num_experts_per_tok),
+                    "activation": activation,
+                    "aux_loss_coef": (
+                        float(getattr(cfg, "router_aux_loss_coef",
+                                      0.0) or 0.0)
+                        * int(cfg.num_experts_per_tok) / n)}}
+
+
 def _llama_biases(model_type: str, cfg) -> tuple[bool, bool]:
     """(qkv_bias, o_bias).  Qwen2 hardcodes qkv bias on / o bias off in its
     attention module; Llama/Mistral follow ``attention_bias`` (default
     False) for all four projections."""
-    if model_type == "qwen2":
+    if model_type in ("qwen2", "qwen2_moe"):
         return True, False
     bias = bool(getattr(cfg, "attention_bias", False) or False)
     return bias, bias
@@ -1145,16 +1193,8 @@ def _llama_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                 # when routing statistics are layer-uniform); the
                 # per-layer-vs-pooled structural difference remains — the
                 # Switch formulation, not a bug.
-                ({"moe": {"in_features": d,
-                          "intermediate_size": int(cfg.intermediate_size),
-                          "num_experts": int(cfg.num_local_experts),
-                          "top_k": int(cfg.num_experts_per_tok),
-                          "activation": activation,
-                          "aux_loss_coef": (
-                              float(getattr(cfg, "router_aux_loss_coef",
-                                            0.0) or 0.0)
-                              * int(cfg.num_experts_per_tok) / n)}}
-                 if model_type == "mixtral" else
+                (_llama_moe_entry(model_type, cfg, d, n, activation)
+                 if model_type in ("mixtral", "qwen2_moe") else
                  {"gatedmlp": {"in_features": d,
                                "intermediate_size":
                                    int(cfg.intermediate_size),
@@ -1999,6 +2039,19 @@ def _map_llama_state_dict(sd: dict, n_layer: int, config=None) -> dict:
                     [np.asarray(sd[f"{src}.block_sparse_moe.experts."
                                    f"{e}.{theirs}.weight"])
                      for e in range(n_exp)])
+        elif f"{src}.mlp.gate.weight" in sd:
+            # Qwen2-MoE: fine experts + always-on shared expert.
+            out[f"{dst}.mlp_block.1.router.weight"] = \
+                sd[f"{src}.mlp.gate.weight"]
+            n_exp = int(getattr(_llama_text_config(config), "num_experts"))
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                out[f"{dst}.mlp_block.1.experts.{proj}.weight"] = np.stack(
+                    [np.asarray(sd[f"{src}.mlp.experts.{e}.{proj}.weight"])
+                     for e in range(n_exp)])
+                out[f"{dst}.mlp_block.1.shared_expert.{proj}.weight"] = \
+                    sd[f"{src}.mlp.shared_expert.{proj}.weight"]
+            out[f"{dst}.mlp_block.1.shared_expert_gate.weight"] = \
+                sd[f"{src}.mlp.shared_expert_gate.weight"]
         else:
             for proj in ("gate_proj", "up_proj", "down_proj"):
                 out[f"{dst}.mlp_block.1.{proj}.weight"] = \

@@ -635,7 +635,8 @@ class MixtureOfExperts(Module):
     def __init__(self, in_features: int, intermediate_size: int,
                  num_experts: int, top_k: int = 2, bias: bool = False,
                  activation: str = "silu", aux_loss_coef: float = 0.0,
-                 dispatch: str = "dense", capacity_factor: float = 1.25):
+                 dispatch: str = "dense", capacity_factor: float = 1.25,
+                 norm_topk: bool = True, shared_expert_size: int = 0):
         if top_k < 1 or top_k > num_experts:
             raise ValueError(f"top_k={top_k} outside [1, {num_experts}]")
         if bias:
@@ -648,6 +649,13 @@ class MixtureOfExperts(Module):
                              f"got {capacity_factor}")
         self.dispatch = dispatch
         self.capacity_factor = float(capacity_factor)
+        # Qwen2-MoE options: ``norm_topk=False`` keeps the raw softmax
+        # mass on the selected experts (HF ``norm_topk_prob`` default);
+        # ``shared_expert_size`` adds an always-on gated-MLP expert whose
+        # contribution is scaled by a sigmoid token gate and SUMMED with
+        # the routed output (Qwen2MoeSparseMoeBlock.shared_expert).
+        self.norm_topk = bool(norm_topk)
+        self.shared_expert_size = int(shared_expert_size)
         self.in_features = int(in_features)
         self.intermediate_size = int(intermediate_size)
         self.num_experts = int(num_experts)
@@ -662,29 +670,30 @@ class MixtureOfExperts(Module):
 
     def param_shapes(self):
         d, h, e = self.in_features, self.intermediate_size, self.num_experts
-        return {
+        shapes = {
             "router.weight": (e, d),
             "experts.gate_proj.weight": (e, h, d),
             "experts.up_proj.weight": (e, h, d),
             "experts.down_proj.weight": (e, d, h),
         }
+        if self.shared_expert_size:
+            hs = self.shared_expert_size
+            shapes.update({
+                "shared_expert.gate_proj.weight": (hs, d),
+                "shared_expert.up_proj.weight": (hs, d),
+                "shared_expert.down_proj.weight": (d, hs),
+                "shared_expert_gate.weight": (1, d),
+            })
+        return shapes
 
     def init(self, rng):
-        d = self.in_features
-        keys = jax.random.split(rng, 4)
-        bound = 1.0 / math.sqrt(d)
-        bound_h = 1.0 / math.sqrt(self.intermediate_size)
+        # torch-Linear-style U(-1/sqrt(fan_in), ·) per leaf; fan_in is the
+        # trailing (contraction) dim for every weight in this module.
         shapes = self.param_shapes()
-        return {
-            self.key("router.weight"):
-                _uniform(keys[0], shapes["router.weight"], bound),
-            self.key("experts.gate_proj.weight"):
-                _uniform(keys[1], shapes["experts.gate_proj.weight"], bound),
-            self.key("experts.up_proj.weight"):
-                _uniform(keys[2], shapes["experts.up_proj.weight"], bound),
-            self.key("experts.down_proj.weight"):
-                _uniform(keys[3], shapes["experts.down_proj.weight"], bound_h),
-        }
+        keys = jax.random.split(rng, len(shapes))
+        return {self.key(name): _uniform(k, shape,
+                                         1.0 / math.sqrt(shape[-1]))
+                for k, (name, shape) in zip(keys, shapes.items())}
 
     def _act(self, x):
         return _gated_activation(self.activation, x)
@@ -706,7 +715,8 @@ class MixtureOfExperts(Module):
                             router.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)
         top_vals, top_idx = jax.lax.top_k(probs, self.top_k)
-        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+        if self.norm_topk:
+            top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
         one_hot = jax.nn.one_hot(top_idx, self.num_experts,
                                  dtype=jnp.float32)  # (B, T, k, E)
         if ctx.training:
@@ -731,17 +741,37 @@ class MixtureOfExperts(Module):
         if self.dispatch == "capacity":
             from penroz_tpu.parallel.mesh import EXPERT_AXIS
             ep_mesh = getattr(ctx, "ep_mesh", None)
+            routed = None
             if ep_mesh is not None:
                 ep = ep_mesh.shape.get(EXPERT_AXIS, 1)
                 if ep > 1 and self.num_experts % ep == 0:
-                    return self._apply_capacity_ep(
+                    routed = self._apply_capacity_ep(
                         x, weights, w_gate, w_up, w_down, ep_mesh)
-            return self._apply_capacity(x, weights, w_gate, w_up, w_down)
-        g = jnp.einsum("btd,ehd->bteh", x, w_gate)
-        u = jnp.einsum("btd,ehd->bteh", x, w_up)
-        hidden = self._act(g) * u
-        y = jnp.einsum("bteh,edh->bted", hidden, w_down)
-        return jnp.einsum("bted,bte->btd", y, weights)
+            if routed is None:
+                routed = self._apply_capacity(x, weights, w_gate, w_up,
+                                              w_down)
+        else:
+            g = jnp.einsum("btd,ehd->bteh", x, w_gate)
+            u = jnp.einsum("btd,ehd->bteh", x, w_up)
+            hidden = self._act(g) * u
+            y = jnp.einsum("bteh,edh->bted", hidden, w_down)
+            routed = jnp.einsum("bted,bte->btd", y, weights)
+        if self.shared_expert_size:
+            # Always-on shared expert (Qwen2-MoE): ordinary gated MLP
+            # scaled by a per-token sigmoid gate, summed with the routed
+            # output.
+            sg = jnp.einsum("btd,hd->bth", x,
+                            self._p(ctx, "shared_expert.gate_proj.weight"))
+            su = jnp.einsum("btd,hd->bth", x,
+                            self._p(ctx, "shared_expert.up_proj.weight"))
+            shared = jnp.einsum(
+                "bth,dh->btd", self._act(sg) * su,
+                self._p(ctx, "shared_expert.down_proj.weight"))
+            gate = jax.nn.sigmoid(jnp.einsum(
+                "btd,od->bto", x,
+                self._p(ctx, "shared_expert_gate.weight")))
+            routed = routed + gate * shared
+        return routed
 
     # Tokens per dispatch group.  One-hot dispatch costs
     # O(group_size · E · C) with C ∝ group_size/E, i.e. quadratic in the

@@ -1197,3 +1197,57 @@ def test_mpt_unsupported_variants_refused():
     with pytest.raises(ValueError, match="qk_ln"):
         Mapper.from_hf_config(SimpleNamespace(
             **base, n_heads=4, attn_config={"alibi": True, "qk_ln": True}))
+
+
+def _tiny_qwen2_moe(norm_topk=False):
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+    config = Qwen2MoeConfig(vocab_size=96, hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            num_key_value_heads=2, intermediate_size=64,
+                            moe_intermediate_size=48,
+                            shared_expert_intermediate_size=80,
+                            num_experts=4, num_experts_per_tok=2,
+                            norm_topk_prob=norm_topk,
+                            decoder_sparse_step=1, mlp_only_layers=[],
+                            max_position_embeddings=64,
+                            attention_dropout=0.0)
+    torch.manual_seed(19)
+    return config, Qwen2MoeForCausalLM(config).eval()
+
+
+@pytest.mark.parametrize("norm_topk", [False, True])
+def test_qwen2_moe_import_logit_parity_and_generate(workdir, norm_topk):
+    """Qwen2-MoE: fine-grained routed experts (norm_topk_prob both ways —
+    the default False keeps raw softmax mass on the selected experts)
+    plus the always-on shared expert behind a sigmoid token gate; qwen2
+    qkv biases; cached greedy == uncached rollout."""
+    config, torch_model = _tiny_qwen2_moe(norm_topk=norm_topk)
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    tag = f"q2moe-{'n' if norm_topk else 'r'}"
+    model = _import_model(workdir, config, torch_model, tag)
+    assert model.status["code"] == "Imported"
+    assert any("shared_expert_gate" in k for k in model.params)
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_qwen2_moe_sparse_step_refused():
+    from penroz_tpu.models.dsl import Mapper
+    config, _ = _tiny_qwen2_moe()
+    config.decoder_sparse_step = 2
+    with pytest.raises(ValueError, match="decoder_sparse_step"):
+        Mapper.from_hf_config(config)

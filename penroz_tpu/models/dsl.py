@@ -60,6 +60,7 @@ _LEAF_ALGOS = {
     "gatedmlp": M.GatedMLP,
     "moe": M.MixtureOfExperts,
     "clamp": M.Clamp,
+    "softcap": M.Softcap,
 }
 
 _OPTIMIZERS = ("adamw", "adam", "sgd")
@@ -932,6 +933,22 @@ def _gemma_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                     {"num_heads": heads, "num_kv_heads": kv,
                      "rope_theta": _gemma_rope_theta(cfg, layer_type),
                      "head_dim": hd, "dropout": attn_drop},
+                    # Gemma-2: score soft-capping + the
+                    # query_pre_attn_scalar scale override (silently
+                    # dropping either imports wrong logits on real
+                    # checkpoints; tiny-model parity can't catch the cap
+                    # because random logits sit far below it)
+                    **({"logit_softcap": float(cfg.attn_logit_softcapping)}
+                       if getattr(cfg, "attn_logit_softcapping", None)
+                       else {}),
+                    # omitted when it equals the default head_dim
+                    # scaling (Gemma-2 9B, Gemma-3 released configs) so
+                    # downstream non-default-scale handling stays off
+                    **({"attn_scale":
+                        float(cfg.query_pre_attn_scalar) ** -0.5}
+                       if (getattr(cfg, "query_pre_attn_scalar", None)
+                           and float(cfg.query_pre_attn_scalar) != hd)
+                       else {}),
                     # sliding layers get REAL windowed attention (the
                     # reference keeps all attention full causal and maps
                     # layer_types to dims only, mappers.py:224-228)
@@ -955,8 +972,12 @@ def _gemma_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     layers += [
         {"rmsnorm": {"normalized_shape": d, "eps": eps}},
         {"linear": {"in_features": d, "out_features": vocab, "bias": False}},
-        {"softmaxlast": {"dim": -1}},
     ]
+    final_cap = getattr(cfg, "final_logit_softcapping", None)
+    if final_cap:
+        # Gemma-2 caps the lm-head logits too (HF final_logit_softcapping)
+        layers.append({"softcap": {"cap": float(final_cap)}})
+    layers.append({"softmaxlast": {"dim": -1}})
     return layers
 
 

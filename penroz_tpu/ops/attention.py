@@ -135,14 +135,19 @@ def _group_query_heads(q, num_kv_heads: int):
     return q.reshape(B, num_kv_heads, group, T, D)
 
 
-def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None, bias=None):
+def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None, bias=None,
+            scale=None, softcap=None):
     """Masked softmax attention with grouped query heads.
 
     q: (B, Hkv, G, T, D); k, v: (B, Hkv, S, D); mask: broadcastable to
     (B, Hkv, G, T, S) with True = attend; ``bias`` (same broadcast):
-    additive pre-softmax logits (ALiBi).
+    additive pre-softmax logits (ALiBi); ``scale`` overrides the
+    1/sqrt(D) score scaling (Gemma-2/3 ``query_pre_attn_scalar``);
+    ``softcap`` applies Gemma-2 logit soft-capping ``c·tanh(s/c)`` after
+    scaling, before bias/mask.
     """
-    scale = 1.0 / (q.shape[-1] ** 0.5)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
     # HIGHEST pins true-f32 dot precision for f32 inputs: attention softmax
     # is precision-sensitive and some backends default f32 dots to bf16-
     # class multiplies.  bf16 inputs keep the MXU-native default.
@@ -151,6 +156,8 @@ def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None, bias=None):
     logits = jnp.einsum("bhgtd,bhsd->bhgts", q, k,
                         preferred_element_type=jnp.float32,
                         precision=precision) * scale
+    if softcap is not None:
+        logits = softcap * jnp.tanh(logits / softcap)
     if bias is not None:
         logits = logits + bias
     logits = jnp.where(mask, logits, _NEG_INF)
@@ -164,7 +171,9 @@ def _attend(q, k, v, mask, dropout_rate=0.0, dropout_rng=None, bias=None):
 
 def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None,
                                window: Optional[int] = None,
-                               alibi: Optional[np.ndarray] = None):
+                               alibi: Optional[np.ndarray] = None,
+                               scale: Optional[float] = None,
+                               softcap: Optional[float] = None):
     """Pure-jnp causal attention. q: (B, Hq, T, D); k, v: (B, Hkv, T, D).
 
     ``window``: sliding-window width — query t attends keys in
@@ -182,13 +191,16 @@ def causal_attention_reference(q, k, v, dropout_rate=0.0, dropout_rng=None,
         mask &= k_pos > q_pos - int(window)
     bias = (None if alibi is None
             else _alibi_bias(alibi, q_pos, k_pos, num_kv_heads))
-    out = _attend(qg, k, v, mask, dropout_rate, dropout_rng, bias=bias)
+    out = _attend(qg, k, v, mask, dropout_rate, dropout_rng, bias=bias,
+                  scale=scale, softcap=softcap)
     return out.reshape(B, Hq, T, D)
 
 
 def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
                      platform=None, window: Optional[int] = None,
-                     alibi: Optional[np.ndarray] = None):
+                     alibi: Optional[np.ndarray] = None,
+                     scale: Optional[float] = None,
+                     softcap: Optional[float] = None):
     """Causal self-attention; dispatches to the Pallas kernel on TPU.
 
     ``platform`` is the caller's execution-placement hint ('tpu'/'cpu'/...).
@@ -198,8 +210,16 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
 
     ``alibi``: per-query-head slopes — the kernels add the linear
     position bias in-tile (SMEM slopes, same pattern as the dropout
-    seed), so BLOOM/MPT-class models keep the fused path.
+    seed), so BLOOM/MPT-class models keep the fused path.  ``softcap``
+    (Gemma-2 logit capping) routes the TRAINING path to the jnp
+    reference — the flash backward has no capped-gradient variant yet;
+    the decode kernels apply the cap in-tile, so serving stays fused.
     """
+    if softcap is not None:
+        return causal_attention_reference(q, k, v, dropout_rate,
+                                          dropout_rng, window=window,
+                                          alibi=alibi, scale=scale,
+                                          softcap=softcap)
     if _use_flash(q, k, platform):
         from penroz_tpu.ops.pallas import flash_attention as fa
         if dropout_rate > 0.0 and dropout_rng is not None:
@@ -213,18 +233,21 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
             return fa.flash_attention(q, k, v, causal=True,
                                       dropout_rate=float(dropout_rate),
                                       seed=seed, window=window,
-                                      alibi=alibi)
+                                      alibi=alibi, scale=scale)
         return fa.flash_attention(q, k, v, causal=True, window=window,
-                                  alibi=alibi)
+                                  alibi=alibi, scale=scale)
     return causal_attention_reference(q, k, v, dropout_rate, dropout_rng,
-                                      window=window, alibi=alibi)
+                                      window=window, alibi=alibi,
+                                      scale=scale)
 
 
 def cached_attention(q, k_full, v_full, offset, length,
                      dropout_rate=0.0, dropout_rng=None, platform=None,
                      k_scale=None, v_scale=None,
                      window: Optional[int] = None,
-                     alibi: Optional[np.ndarray] = None):
+                     alibi: Optional[np.ndarray] = None,
+                     scale: Optional[float] = None,
+                     softcap: Optional[float] = None):
     """Attention over a preallocated KV cache.
 
     q: (B, Hq, T, D) new queries at positions ``offset + [0, T)``.
@@ -245,7 +268,8 @@ def cached_attention(q, k_full, v_full, offset, length,
         from penroz_tpu.ops.pallas import decode_attention as da
         return da.decode_attention(q, k_full, v_full, offset, length,
                                    k_scale=k_scale, v_scale=v_scale,
-                                   window=window, alibi=alibi)
+                                   window=window, alibi=alibi,
+                                   scale=scale, softcap=softcap)
     if k_scale is not None:
         k_full = (k_full.astype(jnp.float32) * k_scale).astype(q.dtype)
         v_full = (v_full.astype(jnp.float32) * v_scale).astype(q.dtype)
@@ -280,7 +304,7 @@ def cached_attention(q, k_full, v_full, offset, length,
                 else _alibi_bias(alibi, q_pos[:, None], key_idx[None, :],
                                  num_kv_heads))
     out = _attend(qg, k_full, v_full, mask, dropout_rate, dropout_rng,
-                  bias=bias)
+                  bias=bias, scale=scale, softcap=softcap)
     return out.reshape(B, Hq, T, D)
 
 
@@ -289,7 +313,9 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
                            dropout_rng=None, platform=None,
                            k_scale=None, v_scale=None,
                            window: Optional[int] = None,
-                           alibi: Optional[np.ndarray] = None):
+                           alibi: Optional[np.ndarray] = None,
+                           scale: Optional[float] = None,
+                           softcap: Optional[float] = None):
     """Cached attention over a paged KV pool (block table indirection).
 
     On TPU dispatches to the paged Pallas kernel — one physical page of K/V
@@ -305,7 +331,8 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
         return pa.paged_decode_attention(q, flat_k, flat_v, block_table,
                                          page_size, offset, length,
                                          k_scale=k_scale, v_scale=v_scale,
-                                         window=window, alibi=alibi)
+                                         window=window, alibi=alibi,
+                                         scale=scale, softcap=softcap)
     B = q.shape[0]
     pages_per_seq = block_table.shape[1]
     max_len = pages_per_seq * page_size
@@ -326,7 +353,8 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
     # decode kernel on the gathered views when shapes allow.
     return cached_attention(q, k_full, v_full, offset,
                             length, dropout_rate, dropout_rng,
-                            platform=platform, window=window, alibi=alibi)
+                            platform=platform, window=window, alibi=alibi,
+                            scale=scale, softcap=softcap)
 
 
 def _use_paged_kernel(q, flat_k, block_table, page_size: int,

@@ -378,6 +378,20 @@ class LayerNorm(Module):
         return out
 
 
+class Softcap(Module):
+    """Gemma-2 logit soft-capping: ``cap · tanh(x / cap)`` (HF applies it
+    to the lm-head output via ``final_logit_softcapping``)."""
+
+    def __init__(self, cap: float):
+        if float(cap) <= 0.0:
+            raise ValueError(f"softcap must be > 0, got {cap}")
+        self.cap = float(cap)
+
+    def apply(self, x, ctx):
+        return (self.cap * jnp.tanh(x.astype(jnp.float32) / self.cap)
+                ).astype(x.dtype)
+
+
 class Clamp(Module):
     """Elementwise value clipping (OLMo v1 ``clip_qkv``: the fused QKV
     projection output is clamped to ±clip before attention)."""
@@ -929,7 +943,8 @@ class CausalSelfAttention(Module):
                  rope_pct: Optional[float] = None,
                  qk_norm: bool = False, qk_norm_eps: float = 1e-6,
                  qk_norm_scope: str = "head", rope_dim=None,
-                 qk_norm_fp32_weight: bool = False, alibi: bool = False):
+                 qk_norm_fp32_weight: bool = False, alibi: bool = False,
+                 logit_softcap=None, attn_scale=None):
         if sliding_window is not None and int(sliding_window) < 1:
             raise ValueError(f"sliding_window must be >= 1, "
                              f"got {sliding_window}")
@@ -966,6 +981,15 @@ class CausalSelfAttention(Module):
         if self.alibi and rope_theta is not None:
             raise ValueError("alibi and rope_theta are mutually exclusive "
                              "position encodings")
+        # Gemma-2: score soft-capping c·tanh(s/c) and the
+        # query_pre_attn_scalar^-0.5 scale override.
+        if logit_softcap is not None and float(logit_softcap) <= 0.0:
+            raise ValueError(f"logit_softcap must be > 0, "
+                             f"got {logit_softcap}")
+        self.logit_softcap = (float(logit_softcap)
+                              if logit_softcap is not None else None)
+        self.attn_scale = (float(attn_scale)
+                           if attn_scale is not None else None)
         self.rope_theta = float(rope_theta) if rope_theta is not None else None
         self.head_dim = int(head_dim) if head_dim is not None else None
         # Partial rotary (GPT-NeoX rotary_pct): rotate only the first
@@ -1113,7 +1137,9 @@ class CausalSelfAttention(Module):
                     q, store_k, store_v, ctx.kv.block_table, ctx.kv.page_size,
                     offset, length, dropout_rate=dropout_rate,
                     dropout_rng=dropout_rng, platform=ctx.platform,
-                    window=self.sliding_window, alibi=alibi, **scales)
+                    window=self.sliding_window, alibi=alibi,
+                    scale=self.attn_scale, softcap=self.logit_softcap,
+                    **scales)
             else:
                 out = attn_ops.cached_attention(q, store_k, store_v, offset,
                                                 length,
@@ -1121,7 +1147,10 @@ class CausalSelfAttention(Module):
                                                 dropout_rng=dropout_rng,
                                                 platform=ctx.platform,
                                                 window=self.sliding_window,
-                                                alibi=alibi, **scales)
+                                                alibi=alibi,
+                                                scale=self.attn_scale,
+                                                softcap=self.logit_softcap,
+                                                **scales)
         elif ctx.sp_manual_axis is not None and dropout_rate == 0.0:
             # Inside the GPipe schedule with the sequence axis manual: the
             # SP bodies run on the ambient axis (a nested shard_map is
@@ -1131,11 +1160,13 @@ class CausalSelfAttention(Module):
             from penroz_tpu.parallel import ring_attention as ring
             n_seq = jax.lax.axis_size(ctx.sp_manual_axis)
             if (ctx.sp_mode == "alltoall" and alibi is None
+                    and self.logit_softcap is None
                     and a2a.alltoall_supported(
                         q.shape[1], k.shape[1], n=n_seq)):
                 out = a2a.alltoall_attention_manual(
                     q, k, v, axis_name=ctx.sp_manual_axis,
-                    window=self.sliding_window, platform=ctx.platform)
+                    window=self.sliding_window, platform=ctx.platform,
+                    scale=self.attn_scale)
             else:
                 if ctx.sp_mode == "alltoall":
                     # Trace-time (shapes are static), so the operator gets
@@ -1148,7 +1179,8 @@ class CausalSelfAttention(Module):
                         "attention", q.shape[1], k.shape[1], n_seq)
                 out = ring.ring_attention_manual(
                     q, k, v, axis_name=ctx.sp_manual_axis,
-                    window=self.sliding_window, alibi=alibi)
+                    window=self.sliding_window, alibi=alibi,
+                    scale=self.attn_scale, softcap=self.logit_softcap)
         elif ctx.sp_mesh is not None and dropout_rate == 0.0:
             # Sequence-parallel training over ICI (windowed when the model
             # slides — long-context SP is exactly where windows matter).
@@ -1159,26 +1191,33 @@ class CausalSelfAttention(Module):
             from penroz_tpu.parallel import alltoall_attention as a2a
             from penroz_tpu.parallel.ring_attention import ring_attention
             if (ctx.sp_mode == "alltoall" and alibi is None
+                    and self.logit_softcap is None
                     and a2a.alltoall_supported(q.shape[1], k.shape[1],
                                                ctx.sp_mesh)):
                 out = a2a.alltoall_attention(q, k, v, ctx.sp_mesh,
                                              causal=True,
                                              window=self.sliding_window,
-                                             platform=ctx.platform)
+                                             platform=ctx.platform,
+                                             scale=self.attn_scale)
             else:
-                if ctx.sp_mode == "alltoall" and alibi is not None:
+                if ctx.sp_mode == "alltoall":
+                    # every fallback cause gets a trace-time signal, like
+                    # the manual-axis branch
                     logging.getLogger(__name__).warning(
-                        "alltoall SP with alibi falls back to ring "
-                        "attention (the Ulysses body re-shards heads, "
-                        "whose slopes would become device-dynamic)")
+                        "alltoall SP unavailable (indivisible heads, "
+                        "alibi, or logit softcap); falling back to ring "
+                        "attention")
                 out = ring_attention(q, k, v, ctx.sp_mesh, causal=True,
                                      window=self.sliding_window,
-                                     alibi=alibi)
+                                     alibi=alibi, scale=self.attn_scale,
+                                     softcap=self.logit_softcap)
         else:
             out = attn_ops.causal_attention(q, k, v, dropout_rate=dropout_rate,
                                             dropout_rng=dropout_rng,
                                             platform=ctx.platform,
                                             window=self.sliding_window,
-                                            alibi=alibi)
+                                            alibi=alibi,
+                                            scale=self.attn_scale,
+                                            softcap=self.logit_softcap)
 
         return out.transpose(0, 2, 1, 3).reshape(B, T, q_dim)

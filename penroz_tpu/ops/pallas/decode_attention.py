@@ -58,7 +58,8 @@ def normalize_lengths(length, batch: int):
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
                    num_k: int, num_queries: int, sm_scale: float,
-                   quantized: bool, window=None, use_alibi: bool = False):
+                   quantized: bool, window=None, use_alibi: bool = False,
+                   softcap=None):
     """One (batch, kv-head, k-block) step: GT grouped query rows vs one tile.
 
     q_ref: (1, 1, GT, D) where GT = group * T, row r ↦ (g = r // T, t = r % T).
@@ -108,6 +109,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
         # Row r is query token t = r % T at absolute position offset + t; it
         # may attend keys at positions ≤ offset + t (combined causal +
         # validity mask of the jnp oracle).
@@ -151,7 +154,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs, block_k: int,
 
 def decode_attention(q, k_full, v_full, offset, length,
                      block_k: int = DEFAULT_BLOCK_K, interpret: bool = False,
-                     k_scale=None, v_scale=None, window=None, alibi=None):
+                     k_scale=None, v_scale=None, window=None, alibi=None,
+                     scale=None, softcap=None):
     """Fused cached attention.  Same contract as the jnp oracle
     ``cached_attention``: q (B, Hq, T, D); k_full/v_full (B, Hkv, S_max, D);
     ``length`` = offset + T valid entries (post-append) — a shared scalar
@@ -165,7 +169,7 @@ def decode_attention(q, k_full, v_full, offset, length,
     block_k = _largest_dividing_block(S, block_k)
     if S % block_k != 0:
         raise ValueError(f"decode_attention requires S%{block_k}==0, got {S}")
-    sm_scale = 1.0 / (D ** 0.5)
+    sm_scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     num_k = S // block_k
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be passed together "
@@ -194,7 +198,9 @@ def decode_attention(q, k_full, v_full, offset, length,
                                num_queries=T, sm_scale=sm_scale,
                                quantized=quantized,
                                window=int(window) if window is not None
-                               else None, use_alibi=use_alibi)
+                               else None, use_alibi=use_alibi,
+                               softcap=float(softcap)
+                               if softcap is not None else None)
     in_specs = [
         pl.BlockSpec((1, 1, group * T, D),
                      lambda b, h, j, len_ref: (b, h, 0, 0),

@@ -202,7 +202,7 @@ def _flash_forward(q, k, v, causal: bool = True,
                    block_k: int = DEFAULT_BLOCK_K,
                    dropout_rate: float = 0.0, seed=None,
                    interpret: bool = False, return_lse: bool = False,
-                   window=None, alibi=None):
+                   window=None, alibi=None, scale=None):
     B, Hq, T, D = q.shape
     Hkv, S = k.shape[1], k.shape[2]
     group = Hq // Hkv
@@ -213,7 +213,7 @@ def _flash_forward(q, k, v, causal: bool = True,
     if T % block_q != 0 or S % block_k != 0:
         raise ValueError(f"flash_attention requires T%{block_q}==0 and "
                          f"S%{block_k}==0; got T={T}, S={S}")
-    sm_scale = 1.0 / (D ** 0.5)
+    sm_scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     num_k = S // block_k
     if seed is None:
         seed = jnp.zeros((1,), jnp.int32)
@@ -410,13 +410,14 @@ def _dkv_kernel(seed_ref, alibi_ref, q_ref, k_ref, v_ref, lse_ref,
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                     block_k: int, dropout_rate: float, seed,
-                    interpret: bool = False, window=None, alibi=None):
+                    interpret: bool = False, window=None, alibi=None,
+                    scale=None):
     B, Hq, T, D = q.shape
     Hkv, S = k.shape[1], k.shape[2]
     group = Hq // Hkv
     block_q = _largest_dividing_block(T, block_q)
     block_k = _largest_dividing_block(S, block_k)
-    sm_scale = 1.0 / (D ** 0.5)
+    sm_scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     num_q = T // block_q
     num_k = S // block_k
     if seed is None:
@@ -516,31 +517,33 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, seed, causal, block_q, block_k, dropout_rate, interpret,
-           window, alibi):
+           window, alibi, scale=None):
     out = _flash_forward(q, k, v, causal, block_q, block_k,
                          dropout_rate=dropout_rate, seed=seed,
-                         interpret=interpret, window=window, alibi=alibi)
+                         interpret=interpret, window=window, alibi=alibi,
+                         scale=scale)
     return out
 
 
 def _flash_fwd_rule(q, k, v, seed, causal, block_q, block_k, dropout_rate,
-                    interpret, window, alibi):
+                    interpret, window, alibi, scale=None):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
                               dropout_rate=dropout_rate, seed=seed,
                               interpret=interpret, return_lse=True,
-                              window=window, alibi=alibi)
+                              window=window, alibi=alibi, scale=scale)
     return out, (q, k, v, seed, out, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, dropout_rate, interpret,
-                    window, alibi, residuals, g):
+                    window, alibi, scale, residuals, g):
     q, k, v, seed, out, lse = residuals
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, block_q,
                                  block_k, dropout_rate, seed,
                                  interpret=interpret, window=window,
-                                 alibi=alibi)
+                                 alibi=alibi, scale=scale)
     return dq, dk, dv, np.zeros((), dtype=jax.dtypes.float0)
 
 
@@ -565,7 +568,8 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_q: int | None = None,
                     block_k: int | None = None,
                     dropout_rate: float = 0.0, seed=None,
-                    interpret: bool = False, window=None, alibi=None):
+                    interpret: bool = False, window=None, alibi=None,
+                    scale=None):
     """Flash attention with a fused flash backward.
 
     q: (B, Hq, T, D); k, v: (B, Hkv, S, D) with Hq % Hkv == 0.
@@ -596,4 +600,5 @@ def flash_attention(q, k, v, causal: bool = True,
     return _flash(q, k, v, jnp.asarray(seed, jnp.int32), causal,
                   int(block_q), int(block_k), float(dropout_rate),
                   bool(interpret),
-                  int(window) if window is not None else None, alibi)
+                  int(window) if window is not None else None, alibi,
+                  float(scale) if scale is not None else None)

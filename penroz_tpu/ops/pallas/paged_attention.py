@@ -32,7 +32,7 @@ def _paged_kernel(len_ref, table_ref, q_ref, *rest,
                   page_size: int, num_queries: int, grid_pages: int,
                   fetch_pages: int, sm_scale: float,
                   quantized: bool = False, window=None,
-                  use_alibi: bool = False):
+                  use_alibi: bool = False, softcap=None):
     """One grid step attends ``fetch_pages`` consecutive logical pages.
 
     Walking one page per step makes per-step DMA latency and scalar-core
@@ -92,6 +92,8 @@ def _paged_kernel(len_ref, table_ref, q_ref, *rest,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (GT, span)
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
         t = jax.lax.broadcasted_iota(jnp.int32, (gt, span), 0) \
             % num_queries
         k_pos = j * span + jax.lax.broadcasted_iota(
@@ -150,7 +152,8 @@ def default_fetch_pages() -> int:
 def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
                            offset, length, k_scale=None, v_scale=None,
                            interpret: bool = False, window=None,
-                           fetch_pages: int | None = None, alibi=None):
+                           fetch_pages: int | None = None, alibi=None,
+                           scale=None, softcap=None):
     """Cached attention over a paged pool.
 
     q: (B, Hq, T, D) new queries; flat_k/flat_v: (Hkv, num_pages *
@@ -168,7 +171,7 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
     Hkv = flat_k.shape[0]
     group = Hq // Hkv
     pages_per_seq = block_table.shape[1]
-    sm_scale = 1.0 / (D ** 0.5)
+    sm_scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     quantized = k_scale is not None
     G = fetch_pages if fetch_pages is not None else default_fetch_pages()
     G = max(1, min(int(G), pages_per_seq))
@@ -186,7 +189,9 @@ def paged_decode_attention(q, flat_k, flat_v, block_table, page_size: int,
                                fetch_pages=G, sm_scale=sm_scale,
                                quantized=quantized,
                                window=int(window) if window is not None
-                               else None, use_alibi=use_alibi)
+                               else None, use_alibi=use_alibi,
+                               softcap=float(softcap)
+                               if softcap is not None else None)
 
     def page_lookup(b, logical, len_ref, table_ref):
         # Clamp out-of-band steps to the nearest in-band logical page: same

@@ -42,19 +42,21 @@ def alltoall_supported(num_heads: int, num_kv_heads: int, mesh=None,
 
 
 def alltoall_attention_manual(q, k, v, *, axis_name: str = SEQ_AXIS,
-                              window=None, platform=None):
+                              window=None, platform=None, scale=None):
     """Ulysses attention for callers ALREADY inside a manual region that
     binds ``axis_name`` (e.g. the GPipe schedule's shard_map with the
     sequence axis manual) — same math as :func:`alltoall_attention`, minus
     the shard_map wrapper (nesting one inside another is not possible).
     q/k/v: per-shard (B, H, T_local, D) blocks."""
     return _alltoall_local(q, k, v, axis_name=axis_name,
+                           scale=scale,
                            window=int(window) if window is not None
                            else None,
                            platform=platform)
 
 
-def _alltoall_local(q, k, v, *, axis_name: str, window, platform):
+def _alltoall_local(q, k, v, *, axis_name: str, window, platform,
+                    scale=None):
     """Per-shard body. q/k/v: (B, H, T_local, D) sequence-sharded blocks."""
     from penroz_tpu.ops import attention as attn_ops
 
@@ -68,14 +70,14 @@ def _alltoall_local(q, k, v, *, axis_name: str, window, platform):
     v = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                            tiled=True)
     out = attn_ops.causal_attention(q, k, v, platform=platform,
-                                    window=window)
+                                    window=window, scale=scale)
     # head-sharded → seq-sharded.
     return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
 
 def alltoall_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
-                       axis_name: str = SEQ_AXIS, window=None,
+                       axis_name: str = SEQ_AXIS, window=None, scale=None,
                        platform=None):
     """Sequence-parallel attention via head/sequence all-to-alls.
 
@@ -96,7 +98,7 @@ def alltoall_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     spec = P(None, None, axis_name, None)
     body = functools.partial(
         _alltoall_local, axis_name=axis_name,
-        window=int(window) if window is not None else None,
+        window=int(window) if window is not None else None, scale=scale,
         platform=platform)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)

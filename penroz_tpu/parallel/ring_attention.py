@@ -33,7 +33,8 @@ _NEG_INF = -1e30
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          window=None, alibi=None):
+                          window=None, alibi=None, scale=None,
+                          softcap=None):
     """Per-shard body. q/k/v: (B, H, T_local, D) — the local blocks.
 
     ``alibi``: per-query-head slopes — the ring already tracks GLOBAL
@@ -46,7 +47,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     group = Hq // Hkv
     n = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    scale = 1.0 / (D ** 0.5)
+    scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
 
     qg = q.reshape(B, Hkv, group, Tl, D)
     q_pos = my_idx * Tl + jnp.arange(Tl, dtype=jnp.int32)
@@ -69,6 +70,11 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         k_pos = src * Tl + jnp.arange(Tl, dtype=jnp.int32)
         s = jnp.einsum("bhgtd,bhsd->bhgts", qg, k_cur,
                        preferred_element_type=jnp.float32) * scale
+        if softcap is not None:
+            # Gemma-2 score capping: applied per rotation step BEFORE the
+            # online-softmax update — tanh is elementwise, so capping
+            # block-by-block equals capping the full score matrix.
+            s = softcap * jnp.tanh(s / softcap)
         if slopes_hg is not None:
             rel = (k_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
             s = s + (slopes_hg[:, :, None, None]
@@ -116,7 +122,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
 
 def ring_attention_manual(q, k, v, *, axis_name: str = SEQ_AXIS,
-                          causal: bool = True, window=None, alibi=None):
+                          causal: bool = True, window=None, alibi=None,
+                          scale=None, softcap=None):
     """Ring attention for callers ALREADY inside a manual region binding
     ``axis_name`` (e.g. the GPipe schedule's shard_map with the sequence
     axis manual) — same math as :func:`ring_attention`, minus the
@@ -127,11 +134,13 @@ def ring_attention_manual(q, k, v, *, axis_name: str = SEQ_AXIS,
     return _ring_attention_local(q, k, v, axis_name=axis_name,
                                  causal=causal,
                                  window=int(window) if window is not None
-                                 else None, alibi=alibi)
+                                 else None, alibi=alibi, scale=scale,
+                                 softcap=softcap)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
-                   axis_name: str = SEQ_AXIS, window=None, alibi=None):
+                   axis_name: str = SEQ_AXIS, window=None, alibi=None,
+                   scale=None, softcap=None):
     """Sequence-parallel attention over ``mesh``'s sequence axis.
 
     q: (B, Hq, T, D); k/v: (B, Hkv, T, D), all sharded (or shardable) on the
@@ -146,7 +155,8 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     body = functools.partial(_ring_attention_local, axis_name=axis_name,
                              causal=causal,
                              window=int(window) if window is not None
-                             else None, alibi=alibi)
+                             else None, alibi=alibi, scale=scale,
+                             softcap=softcap)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(q, k, v)

@@ -887,3 +887,64 @@ def test_paged_kernel_alibi_matches_oracle(quantized):
                                     **scales)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=3e-5)
+
+
+def test_decode_kernel_softcap_and_scale_matches_oracle():
+    """Decode kernel with Gemma-2 soft-capping + scale override
+    (interpret) == the jnp cached oracle."""
+    from penroz_tpu.ops.pallas import decode_attention as DA
+    B, H, T, D, S = 2, 2, 1, 64, 256
+    rng = np.random.default_rng(41)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32)) * 4
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    got = DA.decode_attention(q, k, v, jnp.asarray(96), jnp.asarray(97),
+                              block_k=128, interpret=True, softcap=2.0,
+                              scale=0.05)
+    want = A.cached_attention(q, k, v, jnp.asarray(96), jnp.asarray(97),
+                              platform="cpu", softcap=2.0, scale=0.05)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_paged_kernel_softcap_matches_oracle():
+    from penroz_tpu.ops.pallas import paged_attention as PA
+    B, H, T, D = 1, 2, 1, 64
+    page, pages_per_seq, num_pages = 128, 3, 6
+    rng = np.random.default_rng(42)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32)) * 4
+    rows = num_pages * page
+    flat_k = jnp.asarray(rng.normal(size=(H, rows, D)), jnp.float32)
+    flat_v = jnp.asarray(rng.normal(size=(H, rows, D)), jnp.float32)
+    table = jnp.asarray(rng.permutation(num_pages)[:pages_per_seq][None],
+                        jnp.int32)
+    got = PA.paged_decode_attention(q, flat_k, flat_v, table, page,
+                                    jnp.asarray(200), jnp.asarray(201),
+                                    interpret=True, softcap=3.0, scale=0.07)
+    want = A.paged_cached_attention(q, flat_k, flat_v, table, page,
+                                    jnp.asarray(200), jnp.asarray(201),
+                                    platform="cpu", softcap=3.0, scale=0.07)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_kernel_scale_override_value_and_grads():
+    """Flash kernels honor the attention-scale override (Gemma-style
+    query_pre_attn_scalar) in the forward AND the dq/dkv recompute."""
+    from penroz_tpu.ops.pallas import flash_attention as FA
+    B, H, T, D = 1, 2, 256, 64
+    rng = np.random.default_rng(44)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    out = FA.flash_attention(q, k, v, True, 128, 128, interpret=True,
+                             scale=0.05)
+    ref = A.causal_attention_reference(q, k, v, scale=0.05)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    gf = jax.grad(lambda q, k, v: FA.flash_attention(
+        q, k, v, True, 128, 128, interpret=True,
+        scale=0.05).sum(), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: A.causal_attention_reference(
+        q, k, v, scale=0.05).sum(), (0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        err = float(jnp.abs(a - b).max())
+        scale = max(float(jnp.abs(b).max()), 1.0)
+        assert err <= 2e-4 * scale, f"d{name}: {err}"

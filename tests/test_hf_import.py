@@ -1251,3 +1251,42 @@ def test_qwen2_moe_sparse_step_refused():
     config.decoder_sparse_step = 2
     with pytest.raises(ValueError, match="decoder_sparse_step"):
         Mapper.from_hf_config(config)
+
+
+def test_gemma2_softcapping_and_query_scale_parity(workdir):
+    """Gemma-2's attn/final logit soft-capping and query_pre_attn_scalar
+    scaling — set AGGRESSIVELY here (caps ~ logit magnitude, scalar far
+    from head_dim) so the nonlinearity and the scale actually bite: a
+    build that drops either would fail this parity while passing the
+    neutralized `_tiny_gemma2` test."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+    config = Gemma2Config(vocab_size=96, hidden_size=16, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          head_dim=8, intermediate_size=32,
+                          max_position_embeddings=64, rope_theta=10000.0,
+                          attn_logit_softcapping=2.0,
+                          final_logit_softcapping=1.5,
+                          query_pre_attn_scalar=64, sliding_window=64,
+                          attention_dropout=0.0,
+                          hidden_activation="gelu_pytorch_tanh")
+    torch.manual_seed(5)
+    torch_model = Gemma2ForCausalLM(config).eval()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "gemma2-cap")
+    assert model.status["code"] == "Imported"
+    import json as _json
+    assert '"softcap"' in _json.dumps(model.layers_dsl)
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    # capped logits are small and bounded — compare directly, no centering
+    np.testing.assert_allclose(ours, ref_logits, atol=0.02)
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)

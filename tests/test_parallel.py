@@ -706,4 +706,27 @@ def test_sp_alibi_module_path_and_ulysses_fallback(cpu_devices, caplog):
         got2 = jax.jit(lambda x: attn.apply(
             x, M.Ctx({}, sp_mesh=mesh, sp_mode="alltoall")))(qkv_s)
     np.testing.assert_allclose(np.asarray(got2), want, atol=2e-5)
-    assert any("falls back to ring" in r.message for r in caplog.records)
+    assert any("falling back to ring" in r.message
+               for r in caplog.records)
+
+
+def test_ring_attention_softcap_and_scale(cpu_devices):
+    """Ring attention with Gemma-2 soft-capping + scale override == the
+    single-device oracle (tanh is elementwise, so per-rotation-step
+    capping equals capping the full score matrix)."""
+    from jax.sharding import NamedSharding
+    from penroz_tpu.parallel.ring_attention import ring_attention
+    from penroz_tpu.ops import attention as A
+    mesh = mesh_lib.make_mesh(cpu_devices[:4], sequence=4)
+    B, H, T, D = 1, 2, 32, 8
+    rng = np.random.default_rng(43)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32) * 4
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    want = A.causal_attention_reference(q, k, v, softcap=2.0, scale=0.2)
+    spec = NamedSharding(mesh, P(None, None, "sequence"))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    got = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh, causal=True, softcap=2.0, scale=0.2))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)

@@ -863,8 +863,14 @@ def _gemma_text_config(config):
 
 
 def _gemma_rope_theta(cfg, layer_type: str) -> float:
-    """Per-layer RoPE theta: prefer a matching ``rope_scaling`` entry, fall
-    back to any entry, then to ``rope_theta`` (reference: mappers.py:198-222)."""
+    """Per-layer RoPE theta: Gemma-3's ``rope_local_base_freq`` for
+    sliding layers, else prefer a matching per-layer-type
+    ``rope_scaling`` entry, fall back to any entry, then to
+    ``rope_theta`` (reference: mappers.py:198-222)."""
+    if layer_type == "sliding_attention":
+        local = getattr(cfg, "rope_local_base_freq", None)
+        if local:
+            return float(local)
     scaling = getattr(cfg, "rope_scaling", None)
     if isinstance(scaling, dict) and scaling:
         entry = scaling.get(layer_type)
@@ -897,7 +903,26 @@ def _gemma_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     # branch output; gemma3+: norms applied to the residual sum
     # (reference: neural_net_layers.py:188-225 block variants).
     has_post_norms = model_type != "gemma"
-    post_norm_on_residual = model_type not in ("gemma", "gemma2")
+    # HF Gemma3DecoderLayer norms the BRANCH OUTPUT before the residual
+    # add, exactly like Gemma-2 (verified against modeling_gemma3); the
+    # residual-sum placement is the later-variant convention the
+    # reference's block switch models (neural_net_layers.py:188-225).
+    post_norm_on_residual = model_type not in ("gemma", "gemma2",
+                                               "gemma3", "gemma3_text")
+    # Gemma-3 attention ALWAYS per-head-RMS-normalizes q and k (HF
+    # Gemma3Attention q_norm/k_norm — zero-centered weights, +1 at
+    # import) and its GLOBAL layers may carry linear rope scaling
+    # ({'rope_type': 'linear', 'factor': 8.0} on the released >1B
+    # configs); local layers rotate with rope_local_base_freq unscaled.
+    gemma3 = model_type in ("gemma3", "gemma3_text")
+    g3_scaling = None
+    if gemma3:
+        raw = getattr(cfg, "rope_scaling", None)
+        if isinstance(raw, dict) and raw and (
+                raw.get("rope_type") or raw.get("type")):
+            g3_scaling = {"rope_type": (raw.get("rope_type")
+                                        or raw.get("type")),
+                          "factor": float(raw.get("factor", 1.0))}
 
     def head_dim_for(layer_type: str) -> int:
         if layer_type == "full_attention" and \
@@ -948,6 +973,12 @@ def _gemma_dsl_from_config(config, n_layer_override=None) -> list[dict]:
                         float(cfg.query_pre_attn_scalar) ** -0.5}
                        if (getattr(cfg, "query_pre_attn_scalar", None)
                            and float(cfg.query_pre_attn_scalar) != hd)
+                       else {}),
+                    **({"qk_norm": True, "qk_norm_eps":
+                        eps, "qk_norm_fp32_weight": True}
+                       if gemma3 else {}),
+                    **({"rope_scaling": g3_scaling}
+                       if g3_scaling and layer_type == "full_attention"
                        else {}),
                     # sliding layers get REAL windowed attention (the
                     # reference keeps all attention full causal and maps
@@ -1020,6 +1051,13 @@ def _map_gemma_state_dict(sd: dict, n_layer: int, config=None) -> dict:
              np.asarray(sd[f"{kv_src}.self_attn.v_proj.weight"])], axis=0)
         out[f"{dst}.attn_block.0.weight"] = \
             _plus_one(sd[f"{src}.input_layernorm.weight"])
+        if f"{src}.self_attn.q_norm.weight" in sd:
+            # Gemma-3 per-head qk-norms (zero-centered like every gemma
+            # RMSNorm); K comes from the KV-source layer on shared layers
+            out[f"{dst}.attn_block.2.q_norm.weight"] = \
+                _plus_one(sd[f"{src}.self_attn.q_norm.weight"])
+            out[f"{dst}.attn_block.2.k_norm.weight"] = \
+                _plus_one(sd[f"{kv_src}.self_attn.k_norm.weight"])
         out[f"{dst}.attn_block.3.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
         if has_post_norms:
             out[f"{dst}.post_attn_norm.weight"] = \

@@ -48,7 +48,15 @@ def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype,
     rescales the inverse frequencies (Llama 3.1+ long-context models)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     if scaling:
-        inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
+        rope_type = (scaling.get("rope_type") or scaling.get("type")
+                     or "default")
+        if rope_type == "linear":
+            # HF LinearScalingRotaryEmbedding: positions divide by the
+            # factor, equivalently inv_freq /= factor (Gemma-3 global
+            # layers ship {'rope_type': 'linear', 'factor': 8.0}).
+            inv_freq = inv_freq / float(scaling["factor"])
+        else:
+            inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
     steps = jnp.arange(length, dtype=jnp.float32)
     offset = jnp.asarray(offset)
     if offset.ndim >= 1:

@@ -1009,12 +1009,20 @@ class CausalSelfAttention(Module):
         # the DSL reaches this module directly, so the HF importer's guard
         # alone would let a yarn dict silently run the llama3 formula or a
         # missing key crash opaquely at first jit trace.
-        if rope_scaling:
+        if rope_scaling and (rope_scaling.get("rope_type")
+                             or rope_scaling.get("type")) == "linear":
+            # HF linear scaling: positions divide by the factor (Gemma-3
+            # global layers); no band parameters to validate.
+            if float(rope_scaling.get("factor", 0.0)) < 1.0:
+                raise ValueError("linear rope_scaling needs factor >= 1")
+            self.rope_scaling = {"rope_type": "linear",
+                                 "factor": float(rope_scaling["factor"])}
+        elif rope_scaling:
             rope_type = (rope_scaling.get("rope_type")
                          or rope_scaling.get("type") or "default")
             if rope_type != "llama3":
                 raise ValueError(f"rope_scaling type {rope_type!r} is not "
-                                 "supported (only 'llama3')")
+                                 "supported (only 'llama3' and 'linear')")
             missing = [k for k in ("factor",
                                    "original_max_position_embeddings")
                        if k not in rope_scaling]

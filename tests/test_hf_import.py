@@ -1290,3 +1290,42 @@ def test_gemma2_softcapping_and_query_scale_parity(workdir):
     toks = model.generate_tokens([[1, 2, 3]], block_size=16,
                                  max_new_tokens=6, temperature=0.0)
     assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+
+def test_gemma3_import_logit_parity_and_generate(workdir):
+    """Gemma-3: per-head q/k RMS norms (zero-centered weights, +1 at
+    import), rope_local_base_freq on sliding layers, LINEAR rope scaling
+    on global layers, query_pre_attn_scalar scaling, sandwich norms —
+    every field set to a value that would show if dropped."""
+    from transformers import Gemma3TextConfig, Gemma3ForCausalLM
+    config = Gemma3TextConfig(
+        vocab_size=96, hidden_size=16, num_hidden_layers=2,
+        num_attention_heads=2, num_key_value_heads=1, head_dim=8,
+        intermediate_size=32, max_position_embeddings=64,
+        rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        layer_types=["sliding_attention", "full_attention"],
+        sliding_window=16, query_pre_attn_scalar=64,
+        attention_dropout=0.0, hidden_activation="gelu_pytorch_tanh")
+    torch.manual_seed(7)
+    torch_model = Gemma3ForCausalLM(config).eval()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "gemma3-tiny")
+    assert model.status["code"] == "Imported"
+    assert any(k.endswith("q_norm.weight") for k in model.params)
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=32,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6, block=32)

@@ -461,14 +461,7 @@ def _map_bloom_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     heads = int(getattr(cfg, "n_head"))
 
     def deinterleave(arr):
-        a = np.asarray(arr)
-        if a.ndim == 2:  # (3·H·D, d)
-            h3d, d_in = a.shape
-            hd = h3d // 3 // heads
-            return a.reshape(heads, 3, hd, d_in).transpose(1, 0, 2, 3) \
-                    .reshape(h3d, d_in)
-        hd = a.shape[0] // 3 // heads
-        return a.reshape(heads, 3, hd).transpose(1, 0, 2).reshape(-1)
+        return _deinterleave_per_head(arr, heads)
 
     out = {
         "layers.0.weight": sd[f"{pfx}.word_embeddings.weight"],
@@ -1751,8 +1744,18 @@ def _falcon_dsl_from_config(config, n_layer_override=None) -> list[dict]:
     """
     cfg = _llama_text_config(config)
     if getattr(cfg, "alibi", False):
-        raise ValueError("alibi Falcon checkpoints are not supported "
-                         "(rotary only)")
+        # falcon-rw shape: ALiBi + sequential pre-LN blocks + per-head-
+        # interleaved fused QKV (BLOOM's layout).  Other alibi combos
+        # (parallel branches, MQA/GQA) have no released checkpoints —
+        # refused rather than guessed.
+        if (getattr(cfg, "new_decoder_architecture", False)
+                or getattr(cfg, "multi_query", True)
+                or getattr(cfg, "parallel_attn", True)):
+            raise ValueError(
+                "alibi Falcon is supported only in the falcon-rw shape "
+                "(multi_query=False, parallel_attn=False, classic "
+                "decoder architecture)")
+        return _falcon_rw_dsl(cfg, n_layer_override)
     scaling = getattr(cfg, "rope_scaling", None) or None
     if scaling and (scaling.get("rope_type") or scaling.get("type")
                     or "default") != "default":
@@ -1842,10 +1845,111 @@ def _falcon_defuse_qkv(w: np.ndarray, heads: int, kv: int, new_arch: bool,
     return _neox_deinterleave_qkv(w, heads)
 
 
+def _falcon_rw_dsl(cfg, n_layer_override=None) -> list[dict]:
+    """falcon-rw (RefinedWeb) config → layer DSL: ALiBi attention, the
+    standard sequential pre-LN block, biased projections, exact-GELU
+    MLPs — structurally BLOOM minus the embedding LayerNorm."""
+    d = int(cfg.hidden_size)
+    n = int(n_layer_override if n_layer_override else cfg.num_hidden_layers)
+    heads = int(cfg.num_attention_heads)
+    vocab = int(cfg.vocab_size)
+    eps = float(getattr(cfg, "layer_norm_epsilon", 1e-5))
+    attn_drop = float(getattr(cfg, "attention_dropout", 0.0) or 0.0)
+    hidden_drop = float(getattr(cfg, "hidden_dropout", 0.0) or 0.0)
+    bias = bool(getattr(cfg, "bias", False))
+    ffn = int(getattr(cfg, "ffn_hidden_size", None) or 4 * d)
+    act_entry = _gelu_entry(getattr(cfg, "activation", "gelu"), "falcon")
+
+    layers: list[dict] = [
+        {"embedding": {"num_embeddings": vocab, "embedding_dim": d},
+         "normal": {"mean": 0.0, "std": 0.02}},
+    ]
+    for _ in range(n):
+        layers.append({"residual": [
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d, "eps": eps}},
+                {"linear": {"in_features": d, "out_features": 3 * d,
+                            "bias": bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"attention": {"num_heads": heads, "dropout": attn_drop,
+                               "alibi": True}},
+                {"linear": {"in_features": d, "out_features": d,
+                            "bias": bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"dropout": {"p": hidden_drop}}]},
+            {"sequential": [
+                {"layernorm": {"normalized_shape": d, "eps": eps}},
+                {"linear": {"in_features": d, "out_features": ffn,
+                            "bias": bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                act_entry,
+                {"linear": {"in_features": ffn, "out_features": d,
+                            "bias": bias},
+                 "normal": {"mean": 0.0, "std": 0.02}, "zeros": {}},
+                {"dropout": {"p": hidden_drop}}]}]})
+    layers += [
+        {"layernorm": {"normalized_shape": d, "eps": eps}},
+        {"linear": {"in_features": d, "out_features": vocab, "bias": False},
+         "normal": {"mean": 0.0, "std": 0.02}},
+        {"softmaxlast": {"dim": -1}},
+    ]
+    return layers
+
+
+def _deinterleave_per_head(arr, heads: int):
+    """BLOOM/falcon fused-QKV de-interleave: rows grouped per head as
+    ``[h0: q,k,v | h1: q,k,v | …]`` → our ``[all q | all k | all v]``."""
+    a = np.asarray(arr)
+    if a.ndim == 2:
+        h3d, d_in = a.shape
+        hd = h3d // 3 // heads
+        return a.reshape(heads, 3, hd, d_in).transpose(1, 0, 2, 3) \
+                .reshape(h3d, d_in)
+    hd = a.shape[0] // 3 // heads
+    return a.reshape(heads, 3, hd).transpose(1, 0, 2).reshape(-1)
+
+
+def _map_falcon_rw_state_dict(sd: dict, n_layer: int, heads: int) -> dict:
+    """falcon-rw HF keys → ours (sequential blocks, interleaved QKV)."""
+    pfx = "transformer"
+    out = {"layers.0.weight": sd[f"{pfx}.word_embeddings.weight"]}
+    for i in range(n_layer):
+        src = f"{pfx}.h.{i}"
+        dst = f"layers.{1 + i}"
+        out[f"{dst}.0.0.weight"] = sd[f"{src}.input_layernorm.weight"]
+        out[f"{dst}.0.0.bias"] = sd[f"{src}.input_layernorm.bias"]
+        qkv = f"{src}.self_attention.query_key_value"
+        out[f"{dst}.0.1.weight"] = _deinterleave_per_head(
+            sd[f"{qkv}.weight"], heads)
+        if f"{qkv}.bias" in sd:
+            out[f"{dst}.0.1.bias"] = _deinterleave_per_head(
+                sd[f"{qkv}.bias"], heads)
+        out[f"{dst}.0.3.weight"] = sd[f"{src}.self_attention.dense.weight"]
+        if f"{src}.self_attention.dense.bias" in sd:
+            out[f"{dst}.0.3.bias"] = sd[f"{src}.self_attention.dense.bias"]
+        out[f"{dst}.1.0.weight"] = \
+            sd[f"{src}.post_attention_layernorm.weight"]
+        out[f"{dst}.1.0.bias"] = sd[f"{src}.post_attention_layernorm.bias"]
+        out[f"{dst}.1.1.weight"] = sd[f"{src}.mlp.dense_h_to_4h.weight"]
+        out[f"{dst}.1.3.weight"] = sd[f"{src}.mlp.dense_4h_to_h.weight"]
+        if f"{src}.mlp.dense_h_to_4h.bias" in sd:
+            out[f"{dst}.1.1.bias"] = sd[f"{src}.mlp.dense_h_to_4h.bias"]
+            out[f"{dst}.1.3.bias"] = sd[f"{src}.mlp.dense_4h_to_h.bias"]
+    out[f"layers.{1 + n_layer}.weight"] = sd[f"{pfx}.ln_f.weight"]
+    out[f"layers.{1 + n_layer}.bias"] = sd[f"{pfx}.ln_f.bias"]
+    out[f"layers.{2 + n_layer}.weight"] = sd.get(
+        "lm_head.weight", sd[f"{pfx}.word_embeddings.weight"])
+    return out
+
+
 def _map_falcon_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     """Falcon HF keys → ours: fused QKV de-fused per architecture, the
     norm layout following the block nesting (parallelresidual for the new
     architecture, the shared-norm Phi nesting for 7B-style), tied head."""
+    cfg = _llama_text_config(config) if config is not None else None
+    if cfg is not None and getattr(cfg, "alibi", False):
+        return _map_falcon_rw_state_dict(
+            sd, n_layer, int(cfg.num_attention_heads))
     cfg = _llama_text_config(config)
     new_arch, kv = _falcon_arch(cfg)
     heads = int(cfg.num_attention_heads)

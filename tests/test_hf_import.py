@@ -1329,3 +1329,45 @@ def test_gemma3_import_logit_parity_and_generate(workdir):
     toks = model.generate_tokens([[1, 2, 3]], block_size=32,
                                  max_new_tokens=6, temperature=0.0)
     assert toks == _greedy_rollout(model, [1, 2, 3], 6, block=32)
+
+
+def test_falcon_rw_alibi_import_logit_parity_and_generate(workdir):
+    """falcon-rw (RefinedWeb): ALiBi + sequential pre-LN blocks + the
+    BLOOM-style per-head-interleaved fused QKV — previously refused,
+    supported since ALiBi attention landed.  Other alibi combos keep the
+    loud refusal."""
+    from transformers import FalconConfig, FalconForCausalLM
+    config = FalconConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          alibi=True, multi_query=False,
+                          parallel_attn=False,
+                          new_decoder_architecture=False, bias=True,
+                          attention_dropout=0.0, hidden_dropout=0.0)
+    torch.manual_seed(21)
+    torch_model = FalconForCausalLM(config).eval()
+    tokens = np.array([[3, 17, 42, 8, 11]], np.int64)
+    with torch.no_grad():
+        ref_logits = torch_model(torch.tensor(tokens)).logits.float().numpy()
+
+    model = _import_model(workdir, config, torch_model, "falcon-rw")
+    assert model.status["code"] == "Imported"
+    import jax.numpy as jnp
+    acts, _, _, _ = model.arch.jit_forward(model.params, model.buffers,
+                                           jnp.asarray(tokens, jnp.int32),
+                                           skip_softmax=True)
+    ours = np.asarray(acts[-1], np.float32)
+    ref_c = ref_logits - ref_logits.mean(-1, keepdims=True)
+    ours_c = ours - ours.mean(-1, keepdims=True)
+    np.testing.assert_allclose(ours_c, ref_c, atol=0.15)
+    assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
+
+    toks = model.generate_tokens([[1, 2, 3]], block_size=16,
+                                 max_new_tokens=6, temperature=0.0)
+    assert toks == _greedy_rollout(model, [1, 2, 3], 6)
+
+    # non-rw alibi combos stay refused
+    from penroz_tpu.models.dsl import Mapper
+    bad = FalconConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                       num_attention_heads=4, alibi=True, multi_query=True)
+    with pytest.raises(ValueError, match="falcon-rw"):
+        Mapper.from_hf_config(bad)

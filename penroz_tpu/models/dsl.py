@@ -241,6 +241,17 @@ class Mapper:
         if model_type == "gpt2":
             return _gpt2_dsl_from_config(config, n_layer_override)
         if model_type.startswith("gemma"):
+            if model_type.startswith("gemma3n"):
+                # Gemma-3n checkpoints carry AltUp, LAuReL, and per-layer
+                # input projections this builder does not implement —
+                # routing them through the generic gemma path would
+                # import with silently wrong logits.  (The reference's
+                # "gemma 4" dims-only surface — kv-shared layers,
+                # double-wide MLPs, per-type head dims — stays available
+                # for configs without those mechanisms.)
+                raise ValueError(
+                    "gemma3n checkpoints are not supported (AltUp/LAuReL "
+                    "architecture)")
             return _gemma_dsl_from_config(config, n_layer_override)
         if model_type in _LLAMA_FAMILY:
             return _llama_dsl_from_config(config, n_layer_override)

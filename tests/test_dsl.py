@@ -341,3 +341,16 @@ def test_configless_linear_layout_refused():
               np.zeros((d + 2 * kv, d), np.float32)}
     with pytest.raises(ValueError, match="Conv1D"):
         Mapper.map_hf_state_dict_to_custom(sd, 1)
+
+
+def test_gemma3n_refused_loudly():
+    """Real Gemma-3n carries AltUp/LAuReL mechanisms the gemma builder
+    does not implement; routing it through the generic path would import
+    silently wrong logits (the synthetic 'gemma4' dims-parity surface is
+    unaffected)."""
+    from types import SimpleNamespace
+    cfg = SimpleNamespace(model_type="gemma3n_text", hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          vocab_size=96)
+    with pytest.raises(ValueError, match="gemma3n"):
+        Mapper.from_hf_config(cfg)

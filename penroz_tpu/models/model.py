@@ -192,6 +192,48 @@ def _decode_chunk_size(remaining: int, cap: int) -> int:
     return chunk
 
 
+def _max_generate_batch() -> int:
+    """Server-side /generate_batch/ row cap (PENROZ_MAX_GENERATE_BATCH)."""
+    try:
+        return max(1, int(os.environ.get("PENROZ_MAX_GENERATE_BATCH", "64")))
+    except ValueError:
+        log.warning("Unparseable PENROZ_MAX_GENERATE_BATCH=%r; "
+                    "using default 64",
+                    os.environ.get("PENROZ_MAX_GENERATE_BATCH"))
+        return 64
+
+
+def validate_batch_generation(prompts: list[list[int]], block_size: int,
+                              max_new_tokens: int) -> None:
+    """Reject batched-generation requests the ragged path cannot serve
+    losslessly: the batched decode has no overflow crop/re-prefill, so any
+    row with ``prompt_len + max_new_tokens > block_size`` would be silently
+    truncated — name the offending rows in a ValueError (HTTP 400) instead.
+    Shared by ``generate_tokens_batched`` and the continuous-batching route
+    so both surfaces enforce identical contracts."""
+    if not prompts or any(not p for p in prompts):
+        raise ValueError("each batched prompt needs at least one token")
+    max_batch = _max_generate_batch()
+    if len(prompts) > max_batch:
+        raise ValueError(
+            f"batched generation accepts at most {max_batch} prompts "
+            f"(got {len(prompts)}; raise PENROZ_MAX_GENERATE_BATCH to "
+            f"override) — each row allocates a block_size KV cache per "
+            f"layer")
+    over = [(i, len(p)) for i, p in enumerate(prompts)
+            if len(p) + max_new_tokens > block_size]
+    if over:
+        detail = ", ".join(f"row {i} (prompt {n} tokens)"
+                           for i, n in over[:8])
+        more = f" and {len(over) - 8} more" if len(over) > 8 else ""
+        raise ValueError(
+            f"batched generation needs prompt_len + max_new_tokens "
+            f"({max_new_tokens}) <= block_size ({block_size}) for every "
+            f"row; overflowing: {detail}{more} — the batched path has no "
+            f"overflow crop/re-prefill, so these rows would be silently "
+            f"truncated; crop prompts first")
+
+
 def _resolve_device(device: Optional[str]):
     """Map an API device string to a jax.Device (None = leave placement).
 
@@ -2182,30 +2224,10 @@ class NeuralNetworkModel:
         """
         prompts = [[int(t) for t in (row if isinstance(row, (list, tuple))
                                      else [row])] for row in inputs]
-        if not prompts or any(not p for p in prompts):
-            raise ValueError("each batched prompt needs at least one token")
-        try:
-            max_batch = max(1, int(
-                os.environ.get("PENROZ_MAX_GENERATE_BATCH", "64")))
-        except ValueError:
-            log.warning("Unparseable PENROZ_MAX_GENERATE_BATCH=%r; "
-                        "using default 64",
-                        os.environ.get("PENROZ_MAX_GENERATE_BATCH"))
-            max_batch = 64
-        if len(prompts) > max_batch:
-            raise ValueError(
-                f"batched generation accepts at most {max_batch} prompts "
-                f"(got {len(prompts)}; raise PENROZ_MAX_GENERATE_BATCH to "
-                f"override) — each row allocates a block_size KV cache per "
-                f"layer")
+        validate_batch_generation(prompts, block_size, max_new_tokens)
         B = len(prompts)
         lens = [len(p) for p in prompts]
         max_p = max(lens)
-        if max_p + max_new_tokens > block_size:
-            raise ValueError(
-                f"batched generation needs max prompt ({max_p}) + "
-                f"max_new_tokens ({max_new_tokens}) <= block_size "
-                f"({block_size}); crop prompts first")
         greedy, temp, call_rng = self._sampling_setup(temperature)
         # Same compute dtype as the single-sequence decode path (its
         # decode_fn default) — anything else would break the documented
@@ -2289,6 +2311,84 @@ class NeuralNetworkModel:
                 last = toks[:, -1:]
                 dispatched += count
         return outs
+
+    # -- step-wise decode API (continuous-batching scheduler) ---------------
+
+    @staticmethod
+    def _norm_temperature(temperature):
+        """(greedy, temp scalar) with the same None/0.0 → greedy rule as
+        ``_sampling_setup`` (no rng split — the scheduler owns its rng)."""
+        greedy = temperature is None or float(temperature) == 0.0
+        temp = jnp.asarray(float(temperature) if temperature else 1.0,
+                           jnp.float32)
+        return greedy, temp
+
+    def decode_prefill_single(self, prompt: list[int], block_size: int,
+                              rng, temperature=1.0, top_k=None):
+        """Prefill one prompt into a fresh batch-1 KV state and sample its
+        first token — the exact program the single-sequence generate loop
+        dispatches (``_generate_iter``'s prefill), so the first token of a
+        scheduler-admitted request is identical to the standalone path.
+        Returns ``(first_token:int, kv_single, fed_len:int)``."""
+        greedy, temp = self._norm_temperature(temperature)
+        decode = self.arch.decode_fn()
+        kv = KV.create_kv_state(self.arch.kv_specs, 1, block_size,
+                                self._kv_dtype())
+        feed = prompt[-block_size:]
+        x = jnp.asarray(np.asarray(feed, np.int64)[None, :], jnp.int32)
+        tok_arr, kv = decode(self.params, self.buffers, kv, x, rng, temp,
+                             greedy=greedy, top_k=top_k,
+                             platform=self._platform)
+        return int(np.asarray(tok_arr)[0, 0]), kv, len(feed)
+
+    def decode_insert_row(self, kv_batch, row: int, kv_single):
+        """Jitted per-row admission: drop a prefilled batch-1 state into
+        row ``row`` of the persistent multi-row decode cache
+        (``ops.kv_cache.KVState.insert_row``).  One compiled program covers
+        every slot — ``row`` is traced.  Donates ``kv_batch``."""
+        key = ("insert_row", type(kv_batch).__name__, self._platform)
+        fn = self.arch._jit_cache.get(key)
+        if fn is None:
+            def ins(kvb, kvs, r):
+                return kvb.insert_row(r, kvs)
+            fn = self.arch._jit_cache[key] = jax.jit(ins, donate_argnums=(0,))
+        return fn(kv_batch, kv_single, jnp.asarray(row, jnp.int32))
+
+    def decode_step_batched(self, kv, last_tokens, lengths, rng,
+                            temperature=1.0, top_k=None):
+        """One shared decode+sample step across every row of a persistent
+        multi-row KV state — the continuous-batching hot loop: K in-flight
+        requests cost one batch-K forward per token instead of K batch-1
+        forwards.
+
+        ``lengths`` (B,) is the host's authoritative per-row valid length
+        (0 parks a free slot: its write lands at position 0 of its own row
+        and is never attended); it is installed via ``with_lengths`` inside
+        the jitted step, so recycled/idle rows never drift on-device.
+        Returns ``((B,) int32 next tokens, advanced kv)``; greedy outputs
+        per row are identical to the single-sequence path (same ragged
+        decode program as ``generate_tokens_batched``).  Donates ``kv`` —
+        always thread the returned state.
+        """
+        greedy, temp = self._norm_temperature(temperature)
+        arch = self.arch
+        key = ("sched_step", bool(greedy), top_k, self._platform)
+        fn = arch._jit_cache.get(key)
+        if fn is None:
+            platform = self._platform
+
+            def step(p, b, kv0, tok, lens, r, tmp):
+                kv1 = kv0.with_lengths(lens)
+                t, kv2 = arch._decode_step(p, b, kv1, tok, r, tmp,
+                                           greedy=greedy, top_k=top_k,
+                                           compute_dtype=None,
+                                           platform=platform)
+                return t[:, 0], kv2
+
+            fn = arch._jit_cache[key] = jax.jit(step, donate_argnums=(2,))
+        return fn(self.params, self.buffers, kv,
+                  jnp.asarray(last_tokens, jnp.int32),
+                  jnp.asarray(lengths, jnp.int32), rng, temp)
 
     def _sampling_setup(self, temperature):
         """Shared generation preamble: (greedy, temp scalar, call rng).

@@ -21,17 +21,59 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 log = logging.getLogger(__name__)
 
 TURBO_QUANT_ENV = "TURBO_QUANT_KV_CACHE"
 PAGED_ENV = "PAGED_KV_CACHE"
 PAGE_SIZE_ENV = "PENROZ_KV_PAGE_SIZE"
+
+# -- pool-capacity drop accounting ------------------------------------------
+# ``PagedKVState._allocate`` clamps page assignment at pool capacity and the
+# row lookups clip, so an overflowing append silently overwrites the final
+# page instead of raising (append_rows docstring).  The clamp itself runs
+# inside jit where it cannot be observed; the host-side callers that CAN see
+# an overflow coming (the eager oracle paths here, the continuous-batching
+# scheduler's capacity retirements) record it through this process-wide
+# counter so /serving_stats/ can surface silent truncation.
+_POOL_DROP_LOCK = threading.Lock()
+_POOL_DROPS = 0
+_POOL_DROP_WARNED = False
+
+
+def record_pool_drop(tokens: int = 1, context: str = ""):
+    """Count ``tokens`` KV writes dropped/overwritten at pool capacity.
+    Logs a warning on the first occurrence (per process)."""
+    global _POOL_DROPS, _POOL_DROP_WARNED
+    with _POOL_DROP_LOCK:
+        _POOL_DROPS += int(tokens)
+        first = not _POOL_DROP_WARNED
+        _POOL_DROP_WARNED = True
+    if first:
+        log.warning(
+            "KV pool capacity exceeded for the first time (%d token(s) "
+            "dropped%s) — sequences hitting this are truncated; grow the "
+            "pool (block_size / pool_pages) or admit fewer rows",
+            tokens, f"; {context}" if context else "")
+
+
+def pool_drop_count() -> int:
+    return _POOL_DROPS
+
+
+def reset_pool_drop_count():
+    """Test hook: zero the counter and re-arm the first-occurrence warning."""
+    global _POOL_DROPS, _POOL_DROP_WARNED
+    with _POOL_DROP_LOCK:
+        _POOL_DROPS = 0
+        _POOL_DROP_WARNED = False
 
 
 def turbo_quant_enabled() -> bool:
@@ -174,6 +216,65 @@ class KVState:
                            ragged_lengths=jnp.asarray(length, jnp.int32))
         return KVState(list(self.k), list(self.v), length)
 
+    # -- per-row slot management (continuous-batching scheduler) ------------
+
+    def _row_lengths(self):
+        """(B,) per-row valid lengths, broadcasting the scalar if needed."""
+        if self.ragged_lengths is not None:
+            return self.ragged_lengths
+        batch = self.k[0].shape[0] if self.k else 1
+        return jnp.broadcast_to(jnp.asarray(self._length, jnp.int32),
+                                (batch,))
+
+    @staticmethod
+    def _scalar_length(length):
+        """Collapse a source state's length (scalar or (1,) ragged) to a
+        scalar for the destination row."""
+        arr = jnp.asarray(length, jnp.int32)
+        return arr.reshape(-1)[0] if arr.ndim else arr
+
+    def insert_row(self, row, src):
+        """Copy a freshly prefilled batch-1 state ``src`` into row ``row``.
+
+        The continuous-batching scheduler's admission path: a newcomer is
+        prefilled into its own batch-1 cache (the exact single-sequence
+        prefill program), then dropped into a free row of the persistent
+        multi-row decode cache.  ``row`` may be a traced scalar, so one
+        compiled program serves every slot.  The result carries RAGGED
+        per-row lengths with row ``row`` set to ``src.length``.
+        """
+        if type(src) is not type(self):
+            raise ValueError(f"insert_row source must be a {type(self).__name__}"
+                             f" (got {type(src).__name__})")
+        if src.max_len != self.max_len:
+            raise ValueError(f"insert_row source max_len {src.max_len} != "
+                             f"destination max_len {self.max_len}")
+        row = jnp.asarray(row, jnp.int32)
+        out = self._with_length(
+            self._row_lengths().at[row].set(self._scalar_length(src.length)))
+        out.k = [jax.lax.dynamic_update_slice(d, s.astype(d.dtype),
+                                              (row, 0, 0, 0))
+                 for d, s in zip(self.k, src.k)]
+        out.v = [jax.lax.dynamic_update_slice(d, s.astype(d.dtype),
+                                              (row, 0, 0, 0))
+                 for d, s in zip(self.v, src.v)]
+        return out
+
+    def reset_row(self, row):
+        """Zero row ``row``'s valid length, recycling the slot for the next
+        sequence (ragged states only).  The stale K/V rows stay in place as
+        dead weight the per-row masks never attend."""
+        if self.ragged_lengths is None:
+            raise ValueError("reset_row requires ragged per-row lengths "
+                             "(call with_lengths first)")
+        return self._with_length(
+            self.ragged_lengths.at[jnp.asarray(row, jnp.int32)].set(0))
+
+    def with_static_table(self):
+        """No-op for contiguous layouts (rows already own fixed buffers);
+        the paged variants override this with a fixed page partition."""
+        return self
+
     # Observability: bytes resident in HBM for this cache.
     def memory_bytes(self) -> int:
         return sum(int(a.size) * a.dtype.itemsize for a in (*self.k, *self.v))
@@ -265,6 +366,15 @@ class QuantKVState(KVState):
         return QuantKVState(list(self.k), list(self.v), length,
                             list(self.k_scale), list(self.v_scale),
                             out_dtype=self.out_dtype)
+
+    def insert_row(self, row, src):
+        out = super().insert_row(row, src)
+        row = jnp.asarray(row, jnp.int32)
+        out.k_scale = [jax.lax.dynamic_update_slice(d, s, (row, 0, 0, 0))
+                       for d, s in zip(self.k_scale, src.k_scale)]
+        out.v_scale = [jax.lax.dynamic_update_slice(d, s, (row, 0, 0, 0))
+                       for d, s in zip(self.v_scale, src.v_scale)]
+        return out
 
     def logical_bytes(self) -> int:
         itemsize = jnp.dtype(self.out_dtype).itemsize
@@ -392,6 +502,11 @@ class PagedKVState(KVState):
             new_length = jnp.max(new_length)
         assigned = self.assigned_pages
         needed = jnp.minimum((new_length + P - 1) // P, S)
+        # Monotone: a recycled row shrinking max(lengths) below the pages
+        # already handed out (continuous-batching slot reuse), or a
+        # statically partitioned table (with_static_table), must not walk
+        # the counters backwards — that would re-assign live pages.
+        needed = jnp.maximum(needed, assigned)
         delta = needed - assigned
         slots = jnp.arange(S, dtype=jnp.int32)
         fresh = (slots >= assigned) & (slots < needed)
@@ -437,6 +552,22 @@ class PagedKVState(KVState):
         B, H, T, d = t.shape
         return t.transpose(1, 0, 2, 3).reshape(H, B * T, d)
 
+    def _note_overflow(self, T: int):
+        """Host-visible half of the silent-truncation contract: when this
+        append runs EAGERLY (oracle/test paths) and the write provably
+        lands past ``max_len``, count the dropped tokens.  Inside jit the
+        lengths are tracers and the clamp stays silent — the scheduler
+        covers that case from its host-side bookkeeping."""
+        lengths = self.length
+        if isinstance(lengths, jax.core.Tracer):
+            return
+        try:
+            over = int(np.max(np.asarray(lengths))) + int(T) - self.max_len
+        except Exception:  # noqa: BLE001 — accounting must never break appends
+            return
+        if over > 0:
+            record_pool_drop(over, context=f"paged pool max_len={self.max_len}")
+
     def append_rows(self, layer_idx: int, k_new, v_new):
         """Scatter new K/V into the page pools; returns the *flat* pools
         (no dense gather — the paged Pallas kernel walks the block table
@@ -446,8 +577,10 @@ class PagedKVState(KVState):
         page count and ``_rows`` clamps the logical-page lookup, so an
         overflowing append silently overwrites the final page's rows
         instead of raising — callers must reset/re-prefill at capacity the
-        way the generate loop does (models/model.py overflow path).
+        way the generate loop does (models/model.py overflow path).  Eager
+        overflows are counted via :func:`record_pool_drop`.
         """
+        self._note_overflow(k_new.shape[2])
         rows, new_length = self._allocate_rows(k_new.shape[2])
         self.k[layer_idx] = self.k[layer_idx].at[:, rows].set(
             self._to_rows(k_new).astype(self.k[layer_idx].dtype))
@@ -490,6 +623,68 @@ class PagedKVState(KVState):
         return PagedKVState(list(self.k), list(self.v),
                             jnp.zeros((3,), jnp.int32), table,
                             self.page_size, self.pages_per_seq)
+
+    # -- per-row slot management (continuous-batching scheduler) ------------
+
+    def _row_lengths(self):
+        if self.ragged_lengths is not None:
+            return self.ragged_lengths
+        batch = self.block_table.shape[0]
+        return jnp.broadcast_to(jnp.asarray(self.counters[0], jnp.int32),
+                                (batch,))
+
+    def with_static_table(self):
+        """Partition the pool statically: row ``i`` owns physical pages
+        ``[i*S, (i+1)*S)``.  The bump allocator never frees, so per-row
+        recycling cannot go through it; with the full table pre-assigned
+        (``assigned_pages = S``) and the monotone ``_allocate`` clamp,
+        appends become pure scatters into each row's own page range and a
+        recycled row simply overwrites its own stale pages.  Requires the
+        pool to back every row (the ``create`` default)."""
+        B, S = self.block_table.shape
+        if self.num_pool_pages < B * S:
+            raise ValueError(
+                f"static page table needs pool_pages >= batch*pages_per_seq "
+                f"({B}*{S}); pool has {self.num_pool_pages}")
+        out = self._with_length(self.length)
+        out.block_table = (jnp.arange(B, dtype=jnp.int32)[:, None] * S
+                           + jnp.arange(S, dtype=jnp.int32)[None, :])
+        out.counters = out.counters.at[1].set(B * S).at[2].set(S)
+        return out
+
+    def insert_row(self, row, src):
+        """Copy a prefilled batch-1 paged state into row ``row``.
+
+        A batch-1 pool's bump allocator assigns physical pages in logical
+        order (page j ↦ pool page j), so the source pool rows are already
+        position-ordered: the copy is one dynamic-slice write into the
+        destination row's own page range.  Installs the static per-row
+        table (see :meth:`with_static_table`) as a side effect — per-row
+        admission and the dynamic bump allocator cannot coexist.
+        """
+        if type(src) is not type(self):
+            raise ValueError(f"insert_row source must be a {type(self).__name__}"
+                             f" (got {type(src).__name__})")
+        if (src.page_size != self.page_size
+                or src.pages_per_seq != self.pages_per_seq):
+            raise ValueError(
+                f"insert_row source page layout ({src.page_size}, "
+                f"{src.pages_per_seq}) != destination ({self.page_size}, "
+                f"{self.pages_per_seq})")
+        base = self.with_static_table()
+        S, P = self.pages_per_seq, self.page_size
+        span = S * P
+        row = jnp.asarray(row, jnp.int32)
+        out = base._with_length(
+            base._row_lengths().at[row].set(self._scalar_length(src.length)))
+        start = row * span
+        out.k = [jax.lax.dynamic_update_slice(
+                     d, s[:, :span].astype(d.dtype), (0, start, 0))
+                 for d, s in zip(base.k, src.k)]
+        out.v = [jax.lax.dynamic_update_slice(
+                     d, s[:, :span].astype(d.dtype), (0, start, 0))
+                 for d, s in zip(base.v, src.v)]
+        return out
 
     def _row_bytes(self) -> int:
         """Bytes per token row summed over every layer's K and V pool."""
@@ -566,6 +761,7 @@ class QuantPagedKVState(PagedKVState):
     def append_rows(self, layer_idx: int, k_new, v_new):
         """Quantize then scatter values *and* scales into the pools (same
         allocator/scatter path and overflow precondition as the parent)."""
+        self._note_overflow(k_new.shape[2])
         qk, sk = _quantize_int8(k_new)
         qv, sv = _quantize_int8(v_new)
         rows, new_length = self._allocate_rows(k_new.shape[2])
@@ -612,6 +808,19 @@ class QuantPagedKVState(PagedKVState):
                                  self.page_size, self.pages_per_seq,
                                  list(self.k_scale), list(self.v_scale),
                                  out_dtype=self.out_dtype)
+
+    def insert_row(self, row, src):
+        out = super().insert_row(row, src)
+        span = self.pages_per_seq * self.page_size
+        row = jnp.asarray(row, jnp.int32)
+        start = row * span
+        out.k_scale = [jax.lax.dynamic_update_slice(d, s[:, :span],
+                                                    (0, start, 0))
+                       for d, s in zip(self.k_scale, src.k_scale)]
+        out.v_scale = [jax.lax.dynamic_update_slice(d, s[:, :span],
+                                                    (0, start, 0))
+                       for d, s in zip(self.v_scale, src.v_scale)]
+        return out
 
     def _row_bytes(self) -> int:
         """int8 value rows + fp32 scale rows per token, over every layer."""
@@ -669,6 +878,9 @@ class KVCacheMetrics:
     compressed_memory_bytes: int = 0
     compression_ratio: float = 1.0
     last_append_latency_ms: float = 0.0
+    # Process-wide KV writes dropped at pool capacity, snapshotted per step
+    # (see record_pool_drop) — surfaces silent paged-pool truncation.
+    pool_capacity_drops: int = 0
 
 
 class KVCache:
@@ -734,14 +946,15 @@ class KVCache:
         m.compression_ratio = (m.memory_bytes / m.compressed_memory_bytes
                                if m.compressed_memory_bytes else 1.0)
         m.last_append_latency_ms = latency_ms
+        m.pool_capacity_drops = pool_drop_count()
 
     def log_metrics(self):
         m = self._metrics
         log.info(
             "KVCache metrics: entries=%d, memory=%.1fKB, "
-            "compression_ratio=%.2f, last_append=%.3fms",
+            "compression_ratio=%.2f, last_append=%.3fms, pool_drops=%d",
             m.total_entries, m.memory_bytes / 1024, m.compression_ratio,
-            m.last_append_latency_ms)
+            m.last_append_latency_ms, m.pool_capacity_drops)
 
 
 class TurboQuantKVCache(KVCache):

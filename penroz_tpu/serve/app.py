@@ -209,9 +209,48 @@ async def evaluate_model(request: web.Request):
     return _json({"cost": cost})
 
 
+async def _try_scheduler_generate(request: web.Request, body):
+    """Serve /generate/ through the continuous-batching scheduler when
+    enabled and eligible; returns a Response or None (→ legacy path).
+    The whole point: K concurrent requests share one batch-K decode step
+    per token instead of K batch-1 programs (serve/decode_scheduler.py)."""
+    from penroz_tpu.serve import decode_scheduler
+    if not decode_scheduler.enabled():
+        return None
+    prompt = NeuralNetworkModel._prompt_tokens(body.input)
+    if not decode_scheduler.eligible(prompt, body.block_size,
+                                     body.max_new_tokens):
+        return None
+    engine = await decode_scheduler.acquire_engine(
+        body.model_id, body.block_size, body.temperature, body.top_k)
+    if engine is None:  # registry at capacity with nothing evictable
+        return None
+    if body.stream:
+        log.info("Streaming token generation for model %s via the "
+                 "continuous-batching scheduler", body.model_id)
+        response = web.StreamResponse(
+            headers={"Content-Type": "text/plain; charset=utf-8"})
+        await response.prepare(request)
+        try:
+            async for token in decode_scheduler.stream_request(
+                    engine, prompt, body.max_new_tokens, body.stop_token):
+                await response.write(f"{token}\n".encode())
+        except Exception:  # noqa: BLE001 — headers already out; end + log
+            log.exception("Scheduler streaming failed for model %s",
+                          body.model_id)
+        await response.write_eof()
+        return response
+    tokens = await decode_scheduler.run_request(
+        engine, prompt, body.max_new_tokens, body.stop_token)
+    return _json({"tokens": tokens})
+
+
 async def model_generate(request: web.Request):
     body = await _parse(request, schemas.GenerateRequest)
     log.info("Generating tokens using model %s", body.model_id)
+    response = await _try_scheduler_generate(request, body)
+    if response is not None:
+        return response
     model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
     if body.stream:
         log.info("Streaming token generation for model %s", body.model_id)
@@ -257,10 +296,30 @@ async def model_generate(request: web.Request):
 
 async def model_generate_batch(request: web.Request):
     """Ragged batched generation — N prompts share one forward per step
-    (beyond the reference surface; its /generate/ is single-sequence)."""
+    (beyond the reference surface; its /generate/ is single-sequence).
+    With PENROZ_CONTINUOUS_BATCHING=1 the rows join the shared in-flight
+    batch instead, so they coalesce with concurrent /generate/ traffic
+    and recycle KV slots as individual rows finish."""
     body = await _parse(request, schemas.GenerateBatchRequest)
     log.info("Batch-generating %d sequence(s) using model %s",
              len(body.inputs), body.model_id)
+    from penroz_tpu.serve import decode_scheduler
+    if decode_scheduler.enabled() and body.max_new_tokens >= 1:
+        prompts = [[int(t) for t in row] for row in body.inputs]
+        engine = await decode_scheduler.acquire_engine(
+            body.model_id, body.block_size, body.temperature, body.top_k)
+        if engine is not None:
+            # Same contract as the legacy path: reject (400) any row that
+            # would silently truncate — raised BEFORE submitting so the
+            # batch is all-or-nothing.
+            from penroz_tpu.models.model import validate_batch_generation
+            validate_batch_generation(prompts, body.block_size,
+                                      body.max_new_tokens)
+            sequences = await asyncio.gather(*[
+                decode_scheduler.run_request(engine, p, body.max_new_tokens,
+                                             body.stop_token)
+                for p in prompts])
+            return _json({"sequences": sequences})
     model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
     sequences = await _run_blocking(
         lambda: model.generate_tokens_batched(
@@ -365,6 +424,18 @@ async def model_stats(request: web.Request):
     return _json(stats)
 
 
+async def serving_stats(request: web.Request):
+    """Continuous-batching scheduler observability: queue depth, batch
+    occupancy, decode tokens/sec, admission latency, and the KV
+    pool-capacity drop counter (serve/decode_scheduler.py)."""
+    from penroz_tpu.serve import decode_scheduler
+    stats = decode_scheduler.serving_stats()
+    # Validate against the documented schema so /serving_stats/ and the
+    # OpenAPI surface cannot drift apart silently.
+    return _json(schemas.ServingStatsResponse.model_validate(
+        stats).model_dump())
+
+
 async def delete_model(request: web.Request):
     model_id = _query_param(request, "model_id")
     log.info("Requesting deletion of model %s", model_id)
@@ -446,6 +517,7 @@ def create_app() -> web.Application:
     app.router.add_post("/profile/", profile)
     app.router.add_get("/progress/", model_progress)
     app.router.add_get("/stats/", model_stats)
+    app.router.add_get("/serving_stats/", serving_stats)
     app.router.add_delete("/model/", delete_model)
     if os.path.isdir(STATIC_DIR):
         app.router.add_static("/static/", STATIC_DIR)

@@ -1,0 +1,556 @@
+"""Continuous-batching decode scheduler: coalesce concurrent /generate/
+requests into one shared in-flight batch.
+
+Without it, K concurrent clients cost K independent batch-1 decode programs
+per token; the TPU runs the same weights K times.  This module owns, per
+(model, block_size, sampling config), a fixed-capacity decode batch whose
+rows are KV-cache slots (paged pool pages when ``PAGED_KV_CACHE=1``):
+
+- a dedicated worker thread runs ONE shared jitted decode step per tick
+  across all active rows (``NeuralNetworkModel.decode_step_batched``);
+- newcomers are admitted at step boundaries: the prompt is prefilled into a
+  fresh batch-1 cache with the exact single-sequence prefill program and
+  dropped into a free row (``decode_insert_row`` → ``KVState.insert_row``),
+  so the first token is identical to the standalone path;
+- rows retire on stop-token / max_new_tokens and their slot is recycled
+  immediately for the next queued request (``KVState.reset_row``);
+- greedy outputs are token-identical to the single-sequence path (tested —
+  the ragged batched decode step is the same program family as
+  ``generate_tokens_batched``, whose greedy parity is bit-exact).
+
+Enabled by routing: serve/app.py sends eligible ``/generate/`` and
+``/generate_batch/`` traffic here when ``PENROZ_CONTINUOUS_BATCHING=1``.
+Knobs: ``PENROZ_SCHED_MAX_ROWS`` (decode batch capacity, default 8),
+``PENROZ_SCHED_ADMIT_MS`` (idle-burst coalescing window, default 0),
+``PENROZ_SCHED_MAX_ENGINES`` (engine registry cap, default 4).
+Observability: ``serving_stats()`` backs ``GET /serving_stats/`` — queue
+depth, batch occupancy, decode tokens/sec, admission latency, and the KV
+pool-capacity drop counter (ops/kv_cache.py).
+
+This is the serving shape the ragged paged-attention kernel line of work
+exists for (PAPERS.md "Ragged Paged Attention"): per-row ragged KV lengths
++ right-padded ragged prefill were the prerequisites, both already in tree.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import os
+import statistics
+import threading
+import time
+
+import jax
+import numpy as np
+
+from penroz_tpu.models import model as model_mod
+from penroz_tpu.models.model import NeuralNetworkModel
+from penroz_tpu.ops import kv_cache as KV
+from penroz_tpu.utils import checkpoint, profiling
+
+log = logging.getLogger(__name__)
+
+ENABLE_ENV = "PENROZ_CONTINUOUS_BATCHING"
+MAX_ROWS_ENV = "PENROZ_SCHED_MAX_ROWS"
+ADMIT_MS_ENV = "PENROZ_SCHED_ADMIT_MS"
+MAX_ENGINES_ENV = "PENROZ_SCHED_MAX_ENGINES"
+
+# Sliding window for the tokens/sec stat (seconds).
+_TPS_WINDOW_S = 30.0
+
+
+def enabled() -> bool:
+    return os.environ.get(ENABLE_ENV, "0") == "1"
+
+
+def _env_int(name: str, default: int, lo: int = 1) -> int:
+    try:
+        return max(lo, int(os.environ.get(name, str(default))))
+    except ValueError:
+        log.warning("Unparseable %s=%r; using default %d", name,
+                    os.environ.get(name), default)
+        return default
+
+
+def _max_rows() -> int:
+    return _env_int(MAX_ROWS_ENV, 8)
+
+
+def _max_engines() -> int:
+    return _env_int(MAX_ENGINES_ENV, 4)
+
+
+def _admit_ms() -> float:
+    try:
+        return max(0.0, float(os.environ.get(ADMIT_MS_ENV, "0")))
+    except ValueError:
+        log.warning("Unparseable %s=%r; using 0", ADMIT_MS_ENV,
+                    os.environ.get(ADMIT_MS_ENV))
+        return 0.0
+
+
+class Request:
+    """One generation request in flight through an engine.
+
+    ``on_event(kind, value)`` is invoked FROM THE SCHEDULER THREAD with
+    ``("token", int)`` per generated token (stop token included, matching
+    ``generate_tokens``), then ``("done", None)`` — or ``("error", exc)``.
+    Consumers bridge to their own concurrency world (asyncio queue, thread
+    queue); setting ``cancelled`` retires the row at the next boundary.
+    """
+
+    __slots__ = ("prompt", "max_new_tokens", "stop_token", "on_event",
+                 "enqueue_t", "cancelled")
+
+    def __init__(self, prompt, max_new_tokens, stop_token, on_event):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.stop_token = stop_token
+        self.on_event = on_event
+        self.enqueue_t = time.monotonic()
+        self.cancelled = False
+
+
+class _Row:
+    __slots__ = ("req", "produced", "finished")
+
+    def __init__(self, req):
+        self.req = req
+        self.produced = 0
+        self.finished = False
+
+
+class DecodeEngine:
+    """Per-(model, block_size, sampling) continuous-batching decode engine.
+
+    The worker thread owns the persistent multi-row KV state, the host-side
+    per-row lengths (authoritative — free slots are parked at length 0 so
+    the shared step's writes for them land in their own row and are never
+    attended), and the admission queue.  All device work runs under
+    ``decode_priority`` so a co-resident trainer yields between epochs.
+    """
+
+    def __init__(self, model_id: str, block_size: int, temperature,
+                 top_k, capacity: int | None = None):
+        self.model_id = model_id
+        self.block_size = int(block_size)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.capacity = capacity or _max_rows()
+        self.greedy = temperature is None or float(temperature) == 0.0
+
+        self._model = NeuralNetworkModel.deserialize(model_id)
+        self._ckpt_stamp_v = self._ckpt_stamp()
+        self._kv = (KV.create_kv_state(self._model.arch.kv_specs,
+                                       self.capacity, self.block_size,
+                                       self._model._kv_dtype())
+                    .with_static_table()
+                    .with_lengths(np.zeros(self.capacity, np.int32)))
+        self._lengths = np.zeros(self.capacity, np.int32)
+        self._last_tok = np.zeros(self.capacity, np.int32)
+        self._rows: list = [None] * self.capacity
+
+        self._pending: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._shutdown = False
+
+        self._rng = jax.random.key(0)
+        self._dispatch = 0
+
+        # metrics (ints/floats written only by the worker thread; readers
+        # tolerate torn-but-valid snapshots)
+        self._admissions = 0
+        self._completed = 0
+        self._decode_steps = 0
+        self._decode_tokens = 0
+        self._decode_time_s = 0.0
+        self._occupancy_sum = 0.0
+        self._admit_lat_ms: collections.deque = collections.deque(maxlen=256)
+        self._token_window: collections.deque = collections.deque()
+
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"penroz-sched-{model_id}-{self.block_size}")
+        self._thread.start()
+
+    # -- public surface -----------------------------------------------------
+
+    def submit(self, req: Request):
+        with self._cond:
+            if self._shutdown:
+                raise RuntimeError("decode engine is shut down")
+            self._pending.append(req)
+            self._cond.notify_all()
+
+    def shutdown(self, timeout: float = 10.0):
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+    @property
+    def active_rows(self) -> int:
+        return sum(1 for r in self._rows if r is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    def idle(self) -> bool:
+        return self.active_rows == 0 and not self._pending
+
+    def stats(self) -> dict:
+        now = time.monotonic()
+        window = [(t, n) for t, n in self._token_window
+                  if now - t <= _TPS_WINDOW_S]
+        span = (now - window[0][0]) if window else 0.0
+        recent = sum(n for _, n in window)
+        tps = recent / span if span > 0.2 else (
+            self._decode_tokens / self._decode_time_s
+            if self._decode_time_s > 0 else 0.0)
+        lat = sorted(self._admit_lat_ms)
+        active = self.active_rows
+        return {
+            "model_id": self.model_id,
+            "block_size": self.block_size,
+            "temperature": 0.0 if self.greedy else float(self.temperature),
+            "top_k": self.top_k,
+            "capacity": self.capacity,
+            "active_rows": active,
+            "queue_depth": self.queue_depth,
+            "occupancy": active / self.capacity,
+            "occupancy_avg": (self._occupancy_sum / self._decode_steps
+                              if self._decode_steps else 0.0),
+            "decode_steps": self._decode_steps,
+            "decode_tokens": self._decode_tokens,
+            "decode_tokens_per_sec": round(tps, 2),
+            "admissions": self._admissions,
+            "completed": self._completed,
+            "admission_latency_ms_p50": (round(statistics.median(lat), 3)
+                                         if lat else None),
+        }
+
+    # -- worker loop --------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while (not self._shutdown and not self._pending
+                       and self.active_rows == 0):
+                    self._cond.wait(timeout=1.0)
+                if self._shutdown:
+                    break
+            try:
+                self._coalesce_burst()
+                self._admit()
+                if self.active_rows:
+                    self._step()
+            except Exception as exc:  # noqa: BLE001 — fail requests, not thread
+                log.exception("Decode engine %s failed a tick", self.model_id)
+                self._fail_all(exc)
+        self._fail_all(RuntimeError("decode engine shut down"))
+
+    def _coalesce_burst(self):
+        """Optional idle-burst coalescing: when the batch is empty, wait up
+        to PENROZ_SCHED_ADMIT_MS after the first arrival so a concurrent
+        burst shares its very first decode step instead of trickling in."""
+        admit_ms = _admit_ms()
+        if admit_ms <= 0 or self.active_rows:
+            return
+        with self._cond:
+            if not self._pending:
+                return
+            deadline = self._pending[0].enqueue_t + admit_ms / 1000.0
+            while (len(self._pending) < self.capacity
+                   and not self._shutdown
+                   and time.monotonic() < deadline):
+                self._cond.wait(timeout=max(deadline - time.monotonic(),
+                                            0.001))
+
+    def _free_row(self):
+        for i, r in enumerate(self._rows):
+            if r is None:
+                return i
+        return None
+
+    def _admit(self):
+        while True:
+            row = self._free_row()
+            if row is None:
+                return
+            with self._cond:
+                if not self._pending:
+                    return
+                req = self._pending.popleft()
+            if req.cancelled:
+                continue
+            if self.active_rows == 0:
+                self._maybe_reload()
+            self._prefill_into(row, req)
+
+    def _prefill_into(self, row: int, req: Request):
+        model = self._model
+        rng = jax.random.fold_in(self._rng, self._dispatch)
+        self._dispatch += 1
+        with model_mod.decode_priority(), profiling.span("penroz/sched_prefill"):
+            first, kv_single, fed = model.decode_prefill_single(
+                req.prompt, self.block_size, rng, self.temperature,
+                self.top_k)
+            self._kv = model.decode_insert_row(self._kv, row, kv_single)
+        self._lengths[row] = fed
+        self._last_tok[row] = first
+        state = _Row(req)
+        self._rows[row] = state
+        self._admissions += 1
+        self._admit_lat_ms.append(
+            (time.monotonic() - req.enqueue_t) * 1000.0)
+        self._emit_token(row, state, first)
+
+    def _step(self):
+        t0 = time.monotonic()
+        rng = jax.random.fold_in(self._rng, self._dispatch)
+        self._dispatch += 1
+        with model_mod.decode_priority(), profiling.span("penroz/sched_step"):
+            toks, self._kv = self._model.decode_step_batched(
+                self._kv, self._last_tok[:, None], self._lengths, rng,
+                self.temperature, self.top_k)
+            arr = np.asarray(toks)
+        active = [i for i, r in enumerate(self._rows) if r is not None]
+        emitted = 0
+        for i in active:
+            state = self._rows[i]
+            self._lengths[i] += 1
+            tok = int(arr[i])
+            self._last_tok[i] = tok
+            emitted += 1
+            self._emit_token(i, state, tok)
+        now = time.monotonic()
+        self._decode_steps += 1
+        self._decode_tokens += emitted
+        self._decode_time_s += now - t0
+        self._occupancy_sum += len(active) / self.capacity
+        self._token_window.append((now, emitted))
+        while (self._token_window
+               and now - self._token_window[0][0] > _TPS_WINDOW_S):
+            self._token_window.popleft()
+
+    def _emit_token(self, row: int, state: _Row, tok: int):
+        state.produced += 1
+        self._deliver(state.req, "token", tok)
+        req = state.req
+        if req.cancelled:
+            self._retire(row, notify=False)
+            return
+        if req.stop_token is not None and tok == req.stop_token:
+            self._retire(row)
+            return
+        if state.produced >= req.max_new_tokens:
+            self._retire(row)
+            return
+        if self._lengths[row] >= self.block_size:
+            # Defensive: eligibility admits only prompt+max_new <= block,
+            # so this is a real pool-capacity truncation — count it.
+            KV.record_pool_drop(
+                req.max_new_tokens - state.produced,
+                context=f"scheduler row hit block_size={self.block_size}")
+            self._retire(row)
+
+    def _retire(self, row: int, notify: bool = True):
+        state = self._rows[row]
+        self._rows[row] = None
+        self._lengths[row] = 0
+        self._last_tok[row] = 0
+        self._kv = self._kv.reset_row(row)
+        self._completed += 1
+        if notify and state is not None:
+            self._deliver(state.req, "done", None)
+
+    def _deliver(self, req: Request, kind: str, value):
+        try:
+            req.on_event(kind, value)
+        except Exception:  # noqa: BLE001 — a dead consumer must not kill the batch
+            log.exception("Decode scheduler consumer callback failed")
+            req.cancelled = True
+
+    def _fail_all(self, exc: Exception):
+        for i, state in enumerate(self._rows):
+            if state is not None:
+                self._rows[i] = None
+                self._lengths[i] = 0
+                self._last_tok[i] = 0
+                self._deliver(state.req, "error", exc)
+        with self._cond:
+            pending, self._pending = list(self._pending), collections.deque()
+        for req in pending:
+            self._deliver(req, "error", exc)
+
+    # -- model staleness ----------------------------------------------------
+
+    def _ckpt_stamp(self):
+        try:
+            return os.path.getmtime(checkpoint._source_path(self.model_id))
+        except OSError:
+            return None
+
+    def _maybe_reload(self):
+        """With zero rows in flight, pick up a newer checkpoint (a /train/
+        that finished since the engine loaded) — serving stays at most one
+        idle gap behind training, matching the legacy per-request
+        deserialize semantics closely enough for a cached engine."""
+        stamp = self._ckpt_stamp()
+        if stamp == self._ckpt_stamp_v:
+            return
+        try:
+            self._model = NeuralNetworkModel.deserialize(self.model_id)
+            self._ckpt_stamp_v = stamp
+            log.info("Decode engine reloaded model %s (checkpoint changed)",
+                     self.model_id)
+        except KeyError:
+            # model deleted mid-flight: keep serving the cached weights;
+            # the registry entry dies with the next reset/eviction.
+            log.warning("Decode engine %s: checkpoint vanished; serving "
+                        "cached weights", self.model_id)
+
+
+# ---------------------------------------------------------------------------
+# Engine registry
+# ---------------------------------------------------------------------------
+
+_ENGINES: dict = {}
+_REG_LOCK = threading.Lock()
+
+
+def _engine_key(model_id, block_size, temperature, top_k):
+    greedy = temperature is None or float(temperature) == 0.0
+    return (model_id, int(block_size), 0.0 if greedy else float(temperature),
+            int(top_k) if top_k is not None else None)
+
+
+def get_engine(model_id, block_size, temperature, top_k):
+    """Blocking engine lookup/creation (deserializes the model on a miss —
+    call off the event loop).  Returns None when the registry is at
+    capacity and nothing is evictable; callers fall back to the legacy
+    per-request path.  Raises KeyError for an unknown model (HTTP 404)."""
+    key = _engine_key(model_id, block_size, temperature, top_k)
+    with _REG_LOCK:
+        engine = _ENGINES.get(key)
+        if engine is not None and not engine._shutdown:
+            return engine
+        if engine is not None:
+            del _ENGINES[key]
+        if len(_ENGINES) >= _max_engines():
+            victim = next((k for k, e in _ENGINES.items() if e.idle()), None)
+            if victim is None:
+                log.warning("Decode engine registry full (%d) with no idle "
+                            "engine; request falls back to the per-request "
+                            "path", len(_ENGINES))
+                return None
+            _ENGINES.pop(victim).shutdown(timeout=5.0)
+        engine = DecodeEngine(model_id, block_size, temperature, top_k)
+        _ENGINES[key] = engine
+        return engine
+
+
+def reset():
+    """Shut every engine down and clear the registry (tests, reloads)."""
+    with _REG_LOCK:
+        engines = list(_ENGINES.values())
+        _ENGINES.clear()
+    for engine in engines:
+        engine.shutdown(timeout=5.0)
+
+
+def serving_stats() -> dict:
+    """Aggregate scheduler observability — the /serving_stats/ payload."""
+    with _REG_LOCK:
+        engines = [e for e in _ENGINES.values() if not e._shutdown]
+    per = [e.stats() for e in engines]
+    capacity = sum(p["capacity"] for p in per)
+    active = sum(p["active_rows"] for p in per)
+    lat = sorted(x for e in engines for x in e._admit_lat_ms)
+    return {
+        "continuous_batching_enabled": enabled(),
+        "engines": per,
+        "capacity": capacity,
+        "active_rows": active,
+        "queue_depth": sum(p["queue_depth"] for p in per),
+        "batch_occupancy": (active / capacity) if capacity else 0.0,
+        "decode_tokens_per_sec": round(
+            sum(p["decode_tokens_per_sec"] for p in per), 2),
+        "admission_latency_ms_p50": (round(statistics.median(lat), 3)
+                                     if lat else None),
+        "kv_pool_capacity_drops": KV.pool_drop_count(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Async request surface (serve/app.py)
+# ---------------------------------------------------------------------------
+
+def eligible(prompt: list[int], block_size: int, max_new_tokens: int) -> bool:
+    """A request the scheduler can serve losslessly: non-empty prompt that
+    fits the fixed-capacity row with all its new tokens (the scheduler has
+    no overflow crop/re-prefill; oversized requests keep the legacy
+    single-sequence path and its re-prefill loop)."""
+    return (len(prompt) >= 1 and max_new_tokens >= 1
+            and len(prompt) + max_new_tokens <= block_size)
+
+
+async def acquire_engine(model_id, block_size, temperature, top_k):
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, get_engine, model_id,
+                                      block_size, temperature, top_k)
+
+
+def _async_request(prompt, max_new_tokens, stop_token):
+    loop = asyncio.get_running_loop()
+    queue: asyncio.Queue = asyncio.Queue()
+
+    def on_event(kind, value):
+        loop.call_soon_threadsafe(queue.put_nowait, (kind, value))
+
+    return Request(prompt, max_new_tokens, stop_token, on_event), queue
+
+
+async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
+                      stop_token) -> list[int]:
+    """Submit one request and await the full sequence (prompt + generated,
+    the ``generate_tokens`` contract)."""
+    req, queue = _async_request(prompt, max_new_tokens, stop_token)
+    engine.submit(req)
+    tokens = list(req.prompt)
+    try:
+        while True:
+            kind, value = await queue.get()
+            if kind == "token":
+                tokens.append(value)
+            elif kind == "done":
+                return tokens
+            else:
+                raise value
+    except asyncio.CancelledError:
+        req.cancelled = True
+        raise
+
+
+async def stream_request(engine: DecodeEngine, prompt, max_new_tokens,
+                         stop_token):
+    """Async generator yielding each generated token as its shared decode
+    step completes (the ``generate_tokens_stream`` contract: stop token
+    included, then the stream ends)."""
+    req, queue = _async_request(prompt, max_new_tokens, stop_token)
+    engine.submit(req)
+    try:
+        while True:
+            kind, value = await queue.get()
+            if kind == "token":
+                yield value
+            elif kind == "done":
+                return
+            else:
+                raise value
+    except asyncio.CancelledError:
+        req.cancelled = True
+        raise

@@ -146,6 +146,15 @@ def _routes() -> list[dict]:
              summary="Activation/gradient/weight histograms",
              params=_query_params("model_id"),
              responses=dict([ok, _resp(404, "Unknown model")])),
+        dict(method="get", path="/serving_stats/",
+             summary="Continuous-batching scheduler stats: queue depth, "
+                     "batch occupancy, decode tokens/sec, admission "
+                     "latency, KV pool-drop counter",
+             responses={"200": {
+                 "description": "Serving statistics",
+                 "content": {"application/json": {"schema": {
+                     "$ref": "#/components/schemas/ServingStatsResponse"}}},
+             }}),
         dict(method="delete", path="/model/", summary="Delete a model",
              params=_query_params("model_id"),
              responses=dict([_resp(204, "Deleted")])),
@@ -160,6 +169,7 @@ def build_spec() -> dict:
         schemas.GenerateRequest, schemas.GenerateBatchRequest,
         schemas.DecodeTokensRequest,
         schemas.TrainingRequest, schemas.ProfileRequest,
+        schemas.ServingStatsResponse,
     ]
     _, defs = models_json_schema(
         [(m, "validation") for m in models],

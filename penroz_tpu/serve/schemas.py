@@ -97,6 +97,46 @@ class ImportModelRequest(BaseModel):
     device: str = Field("cpu", description="Device to load the model on")
 
 
+class EngineStats(BaseModel):
+    """Per-engine snapshot inside ServingStatsResponse (one continuous-
+    batching engine per (model, block_size, sampling config))."""
+    model_id: str
+    block_size: int
+    temperature: float
+    top_k: Optional[int] = None
+    capacity: int = Field(..., description="Decode batch rows "
+                          "(PENROZ_SCHED_MAX_ROWS)")
+    active_rows: int
+    queue_depth: int
+    occupancy: float = Field(..., description="active_rows / capacity now")
+    occupancy_avg: float = Field(..., description="Mean occupancy over all "
+                                 "decode steps")
+    decode_steps: int
+    decode_tokens: int
+    decode_tokens_per_sec: float = Field(..., description="Over a 30s "
+                                         "sliding window")
+    admissions: int
+    completed: int
+    admission_latency_ms_p50: Optional[float] = Field(
+        None, description="Enqueue → prefill-complete latency median")
+
+
+class ServingStatsResponse(BaseModel):
+    """GET /serving_stats/ — continuous-batching scheduler observability
+    (serve/decode_scheduler.py)."""
+    continuous_batching_enabled: bool
+    engines: list[EngineStats]
+    capacity: int
+    active_rows: int
+    queue_depth: int
+    batch_occupancy: float
+    decode_tokens_per_sec: float
+    admission_latency_ms_p50: Optional[float] = None
+    kv_pool_capacity_drops: int = Field(..., description="KV writes dropped "
+                                        "at pool capacity (process-wide; "
+                                        "ops/kv_cache.py record_pool_drop)")
+
+
 class ProfileRequest(BaseModel):
     action: str = Field(..., description="'start' or 'stop' a jax.profiler "
                         "trace capture.")

@@ -203,10 +203,55 @@ function renderStats(stats, filter) {
   });
 }
 
+/* ---- serving tile (continuous batching, /serving_stats/) --------------- */
+
+/* Rolling client-side history so the tile shows a trajectory, not just the
+ * latest sample (the endpoint reports instantaneous aggregates). */
+const servingHistory = [];
+
+function renderServing(data) {
+  const meta = $("serving-meta");
+  const canvas = $("serving-chart");
+  if (!meta || !canvas) return;
+  if (!data) {
+    meta.textContent = "serving stats unavailable";
+    return;
+  }
+  const drops = data.kv_pool_capacity_drops || 0;
+  if (!data.continuous_batching_enabled && !(data.engines || []).length) {
+    meta.textContent =
+      `continuous batching off (PENROZ_CONTINUOUS_BATCHING=1 to enable)` +
+      ` · KV pool drops ${drops}`;
+    lineChart(canvas, []);
+    return;
+  }
+  const occ = data.batch_occupancy || 0;
+  const tps = data.decode_tokens_per_sec || 0;
+  meta.textContent =
+    `rows ${data.active_rows}/${data.capacity} (occupancy ` +
+    `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +
+    `${tps.toFixed(1)} tok/s · adm p50 ` +
+    `${data.admission_latency_ms_p50 == null ? "—"
+       : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +
+    `KV pool drops ${drops}`;
+  servingHistory.push({ occ: occ * 100, tps });
+  if (servingHistory.length > 200) servingHistory.shift();
+  const xs = servingHistory.map((_, i) => i);
+  lineChart(canvas, [
+    { name: "tokens/sec", xs, ys: servingHistory.map(h => h.tps) },
+    { name: "occupancy %", xs, ys: servingHistory.map(h => h.occ) },
+  ], { legend: true });
+}
+
 async function refresh() {
   const modelId = $("model-id").value.trim();
   const filter = $("layer-filter").value.trim();
   setQueryState(modelId, filter);
+  try {
+    renderServing(await fetchJson("/serving_stats/"));
+  } catch (e) {
+    renderServing(null);
+  }
   if (!modelId) return;
   try {
     const progress = await fetchJson(`/progress/?model_id=${encodeURIComponent(modelId)}`);

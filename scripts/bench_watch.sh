@@ -15,6 +15,30 @@ SLEEP_S="${PENROZ_WATCH_SLEEP_S:-60}"
 RESLEEP_S="${PENROZ_WATCH_RESLEEP_S:-1800}"   # between successful re-runs
 ROUND="${PENROZ_ROUND:-05}"
 SNAP="BENCH_MIDROUND_r${ROUND}.json"
+
+# Soak-run serving observability: with PENROZ_WATCH_SERVING_URL pointing at
+# a live server (e.g. http://127.0.0.1:8000), poll /serving_stats/ in the
+# background and append timestamped JSON lines to logs/serving_stats.jsonl —
+# continuous-batching occupancy/throughput regressions become visible in
+# the same artifact stream as the bench captures.
+SERVING_URL="${PENROZ_WATCH_SERVING_URL:-}"
+SERVING_POLL_S="${PENROZ_WATCH_SERVING_POLL_S:-60}"
+if [ -n "$SERVING_URL" ]; then
+  (
+    while true; do
+      if out=$(curl -fsS --max-time 10 "${SERVING_URL%/}/serving_stats/" \
+                 2>>logs/bench_watch.log); then
+        printf '{"t":"%s","serving":%s}\n' "$(date -u +%FT%TZ)" "$out" \
+          >> logs/serving_stats.jsonl
+      fi
+      sleep "$SERVING_POLL_S"
+    done
+  ) &
+  SERVING_POLL_PID=$!
+  trap '[ -n "${SERVING_POLL_PID:-}" ] && kill "$SERVING_POLL_PID" 2>/dev/null' EXIT
+  echo "$(date -u +%FT%TZ) polling ${SERVING_URL%/}/serving_stats/ every ${SERVING_POLL_S}s (pid $SERVING_POLL_PID)" >> logs/bench_watch.log
+fi
+
 attempt=0
 while true; do
   if timeout "$PROBE_S" python -c \

@@ -31,11 +31,20 @@ jax.config.update("jax_platforms", "cpu")
 # AOT executables, and loading another machine's spams feature-mismatch
 # errors (then recompiles anyway).  One fingerprint implementation serves
 # the test and dryrun caches alike.
-from __graft_entry__ import _machine_cache_tag  # noqa: E402
+#
+# OPT-IN (PENROZ_TEST_COMPILE_CACHE=1): on some sandbox images, re-LOADING
+# this suite's own cached XLA:CPU executables corrupts the heap
+# (`malloc_consolidate(): invalid chunk size` / `invalid fastbin entry
+# (free)` aborts inside the threaded /train/ tests) — a cold-cache run
+# passes, the very next warm run dies, reproducibly.  CI runners are fresh
+# per run and never benefited from the cache, so correctness wins by
+# default; set the env var locally if your image's cache reload is sound.
+if os.environ.get("PENROZ_TEST_COMPILE_CACHE") == "1":
+    from __graft_entry__ import _machine_cache_tag  # noqa: E402
 
-jax.config.update("jax_compilation_cache_dir",
-                  f"/tmp/jax_test_cache_{_machine_cache_tag()}")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    jax.config.update("jax_compilation_cache_dir",
+                      f"/tmp/jax_test_cache_{_machine_cache_tag()}")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 # Pin computation to the (virtual 8-device) CPU backend even when an
 # accelerator plugin is present and default: tests must behave like CI.

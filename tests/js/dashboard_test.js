@@ -23,6 +23,8 @@ const fixtures = {
     fs.readFileSync(path.join(HERE, "fixtures/stats_moe.json"))),
   statsPlain: JSON.parse(
     fs.readFileSync(path.join(HERE, "fixtures/stats_plain.json"))),
+  serving: JSON.parse(
+    fs.readFileSync(path.join(HERE, "fixtures/serving.json"))),
 };
 
 runDashboardTests(src, fixtures)

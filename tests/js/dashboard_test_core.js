@@ -57,7 +57,8 @@ function makeElement(tag) {
 
 const PANEL_IDS = ["model-id", "layer-filter", "refresh-btn", "auto-refresh",
                    "status-badge", "cost-chart", "avg-cost-chart",
-                   "speed-chart", "ratio-chart", "hist-grid"];
+                   "speed-chart", "ratio-chart", "hist-grid",
+                   "serving-meta", "serving-chart"];
 
 function makeDocument() {
   const byId = {};
@@ -79,7 +80,8 @@ function gridCells(grid) {
   }));
 }
 
-async function runDashboard(src, { progress, stats, progressStatus = 200 }) {
+async function runDashboard(src, { progress, stats, serving = null,
+                                   progressStatus = 200 }) {
   const document = makeDocument();
   const fetched = [];
   const fetchStub = async (url) => {
@@ -91,6 +93,10 @@ async function runDashboard(src, { progress, stats, progressStatus = 200 }) {
     if (url.startsWith("/stats/")) {
       return { ok: stats !== null, status: stats === null ? 404 : 200,
                json: async () => stats };
+    }
+    if (url.startsWith("/serving_stats/")) {
+      return { ok: serving !== null, status: serving === null ? 500 : 200,
+               json: async () => serving };
     }
     throw new Error(`unexpected fetch ${url}`);
   };
@@ -120,8 +126,19 @@ async function runDashboardTests(src, fixtures) {
   // 1. full render: panels draw, badge reflects the recorded status
   {
     const { document, fetched } = await runDashboard(src, {
-      progress: fixtures.progress, stats: fixtures.statsMoe });
-    assertEq(fetched.length, 2, "fetches /progress/ then /stats/");
+      progress: fixtures.progress, stats: fixtures.statsMoe,
+      serving: fixtures.serving });
+    assertEq(fetched.length, 3,
+             "fetches /serving_stats/, /progress/, /stats/");
+    const servingMeta = document.byId["serving-meta"].textContent;
+    assertOk(servingMeta.includes("tok/s"),
+             "serving tile shows decode throughput");
+    assertOk(servingMeta.includes(
+               `rows ${fixtures.serving.active_rows}/` +
+               `${fixtures.serving.capacity}`),
+             "serving tile shows batch occupancy rows");
+    const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
+    assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];
     assertEq(badge.textContent, fixtures.progress.status.code,
              "badge shows status code");
@@ -141,7 +158,8 @@ async function runDashboardTests(src, fixtures) {
              "one MoE routing panel per router_fraction entry");
   }
 
-  // 2. MoE panel appears IFF moe_router_fractions is present
+  // 2. MoE panel appears IFF moe_router_fractions is present; the serving
+  //    tile degrades gracefully when /serving_stats/ is unavailable
   {
     const { document } = await runDashboard(src, {
       progress: fixtures.progress, stats: fixtures.statsPlain });
@@ -150,12 +168,15 @@ async function runDashboardTests(src, fixtures) {
     assertOk(!cells.some((c) => c.title &&
                          c.title.includes("router_fraction")),
              "no MoE panel without moe_router_fractions");
+    assertOk(document.byId["serving-meta"].textContent.includes("unavailable"),
+             "serving tile reports unavailable endpoint without crashing");
   }
 
   // 3. unknown model: 404 progress renders the error badge, no crash
   {
     const { document } = await runDashboard(src, {
-      progress: { detail: "not found" }, stats: null, progressStatus: 404 });
+      progress: { detail: "not found" }, stats: null, progressStatus: 404,
+      serving: fixtures.serving });
     const badge = document.byId["status-badge"];
     assertEq(badge.textContent, "not found", "badge shows not found");
     assertEq(badge.className, "badge err", "badge styled err");

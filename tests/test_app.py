@@ -380,8 +380,8 @@ def test_openapi_spec(client):
     assert spec["openapi"].startswith("3.")
     for path in ["/model/", "/import/", "/dataset/", "/tokenize/",
                  "/output/", "/evaluate/", "/generate/", "/decode/",
-                 "/train/", "/progress/", "/stats/", "/profile/",
-                 "/dashboard"]:
+                 "/train/", "/progress/", "/stats/", "/serving_stats/",
+                 "/profile/", "/dashboard"]:
         assert path in spec["paths"], path
     assert set(spec["paths"]["/dataset/"]) == {"get", "post", "delete"}
     assert "CreateModelRequest" in spec["components"]["schemas"]

@@ -302,6 +302,111 @@ def test_quant_paged_memory_accounting():
     assert ratio > 2.0
 
 
+# -- per-row slot management (continuous-batching scheduler) ----------------
+
+def _prefilled_single(cls, specs, max_len, tokens, seed=0, **kw):
+    """Batch-1 state with ``tokens`` appended (a prefill stand-in)."""
+    state = cls.create(specs, batch=1, max_len=max_len, **kw)
+    rng = np.random.default_rng(seed)
+    k = rng.normal(size=(1, specs[0][0], tokens, specs[0][1])).astype(np.float32)
+    v = rng.normal(size=k.shape).astype(np.float32)
+    for layer in range(len(specs)):
+        if isinstance(state, KV.PagedKVState):
+            state.append_rows(layer, jnp.asarray(k), jnp.asarray(v))
+        else:
+            state.append(layer, k, v)
+    return state.advanced(tokens), k
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (KV.KVState, {}),
+    (KV.QuantKVState, {}),
+    (KV.PagedKVState, {"page_size": 4}),
+    (KV.QuantPagedKVState, {"page_size": 4}),
+])
+def test_insert_row_installs_sequence_and_length(cls, kw):
+    """insert_row drops a prefilled batch-1 state into one row of a batch
+    state: that row reads back the source K/V and carries its length; the
+    other rows stay empty.  Works jitted with a traced row index (one
+    program per engine, not per slot)."""
+    import jax
+    specs = [(2, 4), (2, 4)]
+    src, k = _prefilled_single(cls, specs, 8, 3, **kw)
+    batch = cls.create(specs, batch=2, max_len=8, **kw) \
+        .with_static_table().with_lengths([0, 0])
+    ins = jax.jit(lambda b, s, r: b.insert_row(r, s), donate_argnums=(0,))
+    out = ins(batch, src, jnp.asarray(1, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out.length), [0, 3])
+    read = (out._gather(out.k[0]) if isinstance(out, KV.PagedKVState)
+            else out.k[0])
+    if out.quantized:
+        # int8 storage: compare the dequantized view against the source's
+        got = np.asarray(read[1:2, :, :3], np.float32)
+        src_read = (src._gather(src.k[0]) if isinstance(src, KV.PagedKVState)
+                    else src.k[0])
+        np.testing.assert_array_equal(got, np.asarray(src_read[0:1, :, :3],
+                                                      np.float32))
+    else:
+        np.testing.assert_allclose(np.asarray(read)[1, :, :3], k[0],
+                                   rtol=1e-6)
+    # recycling: reset_row frees the slot's length for the next sequence
+    out = out.reset_row(1)
+    np.testing.assert_array_equal(np.asarray(out.length), [0, 0])
+    assert isinstance(out, cls)
+
+
+def test_insert_row_rejects_mismatched_layouts():
+    specs = [(1, 4)]
+    batch = KV.KVState.create(specs, batch=2, max_len=8).with_lengths([0, 0])
+    with pytest.raises(ValueError, match="max_len"):
+        batch.insert_row(0, KV.KVState.create(specs, batch=1, max_len=4))
+    with pytest.raises(ValueError, match="KVState"):
+        batch.insert_row(0, KV.QuantKVState.create(specs, 1, 8))
+    paged = KV.PagedKVState.create(specs, batch=2, max_len=8, page_size=4)
+    with pytest.raises(ValueError, match="page layout"):
+        paged.insert_row(0, KV.PagedKVState.create(specs, 1, 8, page_size=2))
+
+
+def test_reset_row_requires_ragged():
+    state = KV.KVState.create([(1, 4)], batch=2, max_len=8)
+    with pytest.raises(ValueError, match="ragged"):
+        state.reset_row(0)
+
+
+def test_static_table_pins_pages_and_allocator():
+    """with_static_table assigns each row its own page range; ragged appends
+    afterwards keep the table and counters frozen (the monotone _allocate
+    clamp) — per-row recycling never routes through the bump allocator."""
+    paged = KV.PagedKVState.create([(1, 4)], batch=2, max_len=8, page_size=4)
+    paged = paged.with_static_table().with_lengths([5, 0])
+    table0 = np.asarray(paged.block_table).copy()
+    np.testing.assert_array_equal(table0, [[0, 1], [2, 3]])
+    k = jnp.ones((2, 1, 1, 4))
+    paged.append_rows(0, k, k)
+    np.testing.assert_array_equal(np.asarray(paged.block_table), table0)
+    assert int(paged.next_free) == 4
+    assert int(paged.assigned_pages) == 2
+
+
+def test_pool_drop_counter_counts_eager_overflow():
+    """Satellite: the silent stop-at-capacity is now counted — an eager
+    append past max_len bumps the process-wide drop counter (and the
+    KVCache metrics snapshot picks it up)."""
+    KV.reset_pool_drop_count()
+    paged = KV.PagedKVState.create([(1, 4)], batch=1, max_len=4,
+                                   page_size=4).advanced(4)
+    k = jnp.ones((1, 1, 1, 4))
+    paged.append_rows(0, k, k)
+    assert KV.pool_drop_count() == 1
+    paged.append_rows(0, k, k)  # length still 4: one more overflowing write
+    assert KV.pool_drop_count() == 2
+    cache = KV.KVCache(num_layers=1)
+    cache.record_step(num_tokens=1, logical_bytes=10, stored_bytes=10)
+    assert cache.metrics.pool_capacity_drops == 2
+    KV.reset_pool_drop_count()
+    assert KV.pool_drop_count() == 0
+
+
 def test_quant_paged_reset_and_advance_preserve_type():
     state = KV.QuantPagedKVState.create([(1, 4)], batch=1, max_len=8,
                                         page_size=4)

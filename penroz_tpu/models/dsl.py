@@ -469,7 +469,16 @@ def _map_bloom_state_dict(sd: dict, n_layer: int, config=None) -> dict:
     layout variant exists."""
     pfx = "transformer"
     cfg = _llama_text_config(config)
-    heads = int(getattr(cfg, "n_head"))
+    if cfg is None or getattr(cfg, "n_head", None) is None:
+        # Mirror the GPT-2 Conv1D-sniff refusal: the key sniff
+        # (word_embeddings_layernorm) dispatches here even config-less, but
+        # the per-head QKV de-interleave needs n_head — dying later with a
+        # bare AttributeError would hide what is actually missing.
+        raise ValueError(
+            "BLOOM import requires the HF config (n_head drives the "
+            "per-head query_key_value de-interleave); pass the "
+            "checkpoint's config to map_hf_state_dict_to_custom")
+    heads = int(cfg.n_head)
 
     def deinterleave(arr):
         return _deinterleave_per_head(arr, heads)
@@ -599,6 +608,21 @@ def _map_mpt_state_dict(sd: dict, n_layer: int, config=None) -> dict:
            else lambda k, dflt=None: getattr(attn_cfg, k, dflt))
     has_clip = attn_cfg is not None and get("clip_qkv") is not None
     i_out = 4 if has_clip else 3  # [ln, qkv, (clamp,) attention, out]
+    # Refuse-loudly contract: every HF MptConfig ships weight-only norms
+    # (verified against transformers — even no_bias=False leaves them
+    # bias-free), and the DSL hardcodes bias:False accordingly.  A future
+    # variant shipping norm biases must fail here, not import silently
+    # without them.
+    norm_bias_keys = sorted(
+        k for k in sd
+        if k.endswith((".norm_1.bias", ".norm_2.bias"))
+        or k == "transformer.norm_f.bias")
+    if norm_bias_keys:
+        raise ValueError(
+            "MPT checkpoint carries LayerNorm biases "
+            f"({norm_bias_keys[:3]}...); this importer maps MPT norms as "
+            "weight-only (every released MptConfig) and refuses rather "
+            "than dropping the biases")
     out = {"layers.0.weight": sd["transformer.wte.weight"]}
     for i in range(n_layer):
         src = f"transformer.blocks.{i}"

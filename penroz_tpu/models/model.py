@@ -2341,6 +2341,52 @@ class NeuralNetworkModel:
                              platform=self._platform)
         return int(np.asarray(tok_arr)[0, 0]), kv, len(feed)
 
+    def decode_prefill_chunk(self, kv_batch, row: int, tokens, row_len: int,
+                             rng, temperature=1.0, top_k=None):
+        """Feed one prompt chunk for row ``row`` directly into the multi-row
+        decode state — the chunked-prefill dispatch the scheduler interleaves
+        between shared decode steps so a long prompt never stalls the batch
+        for more than one chunk.
+
+        ``tokens`` (T,) extends the row's KV from valid length ``row_len``
+        (positions ``row_len + [0, T)``): the chunk attends the row's
+        existing cache (including any radix-aliased prefix pages on the
+        paged variants) through the same ``cached_attention`` program family
+        as one-shot prefill, and its K/V appends land in the row's own
+        pages/buffers via ``KVState.row_view``/``merge_row``.  Returns
+        ``(sampled_token:int, kv_batch')`` — the token is the greedy/sampled
+        continuation at the chunk's last position, i.e. the request's first
+        generated token when this was the final chunk (identical to the
+        one-shot path: same logits position, same program family).  Jits per
+        (T, cache type, sampling); keep chunk sizes power-of-two-bucketed so
+        the program set stays bounded.  Donates ``kv_batch`` — always thread
+        the returned state.
+        """
+        greedy, temp = self._norm_temperature(temperature)
+        arch = self.arch
+        T = len(tokens)
+        key = ("prefill_chunk", T, type(kv_batch).__name__, bool(greedy),
+               top_k, self._platform)
+        fn = arch._jit_cache.get(key)
+        if fn is None:
+            platform = self._platform
+
+            def chunk_step(p, b, kvb, toks, r_idx, r_len, r, tmp):
+                view = kvb.row_view(r_idx, r_len)
+                tok, view2 = arch._decode_step(p, b, view, toks, r, tmp,
+                                               greedy=greedy, top_k=top_k,
+                                               compute_dtype=None,
+                                               platform=platform)
+                return tok[0, 0], kvb.merge_row(r_idx, view2)
+
+            fn = arch._jit_cache[key] = jax.jit(chunk_step,
+                                                donate_argnums=(2,))
+        x = jnp.asarray(np.asarray(tokens, np.int64)[None, :], jnp.int32)
+        tok, kv_out = fn(self.params, self.buffers, kv_batch, x,
+                         jnp.asarray(row, jnp.int32),
+                         jnp.asarray(row_len, jnp.int32), rng, temp)
+        return int(np.asarray(tok)), kv_out
+
     def decode_insert_row(self, kv_batch, row: int, kv_single):
         """Jitted per-row admission: drop a prefilled batch-1 state into
         row ``row`` of the persistent multi-row decode cache

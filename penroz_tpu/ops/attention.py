@@ -13,13 +13,28 @@ also the correctness oracle for the kernel tests.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+log = logging.getLogger(__name__)
+
 _NEG_INF = -1e30
+
+# One-shot trace-time fallback signals (the alltoall-SP fallbacks in
+# modules.py warn per occurrence; these run on every decode trace, so they
+# warn once per process).  Tests re-arm by clearing the set.
+_WARNED_ONCE: set = set()
+
+
+def _warn_once(key: str, msg: str, *args):
+    if key in _WARNED_ONCE:
+        return
+    _WARNED_ONCE.add(key)
+    log.warning(msg, *args)
 
 
 def _llama3_scale_inv_freq(inv_freq, scaling: dict):
@@ -224,6 +239,13 @@ def causal_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
     the decode kernels apply the cap in-tile, so serving stays fused.
     """
     if softcap is not None:
+        # Trace-time, one-shot (matching the SP fallback-signal
+        # convention): Gemma-2-class training/prefill silently losing the
+        # fused path is a perf cliff the operator should see.
+        _warn_once("softcap_reference",
+                   "logit softcap: flash kernel unavailable for the "
+                   "training/prefill path (no capped-gradient backward); "
+                   "using the O(T^2) jnp reference")
         return causal_attention_reference(q, k, v, dropout_rate,
                                           dropout_rng, window=window,
                                           alibi=alibi, scale=scale,
@@ -272,7 +294,26 @@ def cached_attention(q, k_full, v_full, offset, length,
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be passed together "
                          "(int8 caches carry scales for both streams)")
-    if dropout_rate == 0.0 and _use_flash_decode(q, k_full, platform):
+    use_kernel = dropout_rate == 0.0 and _use_flash_decode(q, k_full,
+                                                           platform)
+    if not use_kernel and dropout_rate == 0.0:
+        _, Hq, T, D = q.shape
+        Hkv, S = k_full.shape[1], k_full.shape[2]
+        if (T > 1 and S >= 128 and S % 128 == 0 and D in (64, 128, 256)
+                and Hq % Hkv == 0 and (Hq // Hkv) * T > 512
+                and not _flash_disabled()
+                and _tpu_platform(q, platform)):
+            # A multi-token chunk (chunked prefill) whose ONLY disqualifier
+            # is the decode kernel's (Hq/Hkv)·T ≤ 512 tile budget runs the
+            # dense jnp path over S_max — correct but a perf cliff; static
+            # shapes, so this is trace-time like the softcap signal above.
+            _warn_once("chunk_off_kernel",
+                       "cached attention chunk (T=%d, Hq=%d, Hkv=%d) "
+                       "exceeds the decode kernel's tile budget; using "
+                       "the jnp reference — a smaller PENROZ_PREFILL_CHUNK "
+                       "keeps chunked prefill on the fused path", T, Hq,
+                       Hkv)
+    if use_kernel:
         from penroz_tpu.ops.pallas import decode_attention as da
         return da.decode_attention(q, k_full, v_full, offset, length,
                                    k_scale=k_scale, v_scale=v_scale,

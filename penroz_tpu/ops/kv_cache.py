@@ -34,6 +34,8 @@ log = logging.getLogger(__name__)
 TURBO_QUANT_ENV = "TURBO_QUANT_KV_CACHE"
 PAGED_ENV = "PAGED_KV_CACHE"
 PAGE_SIZE_ENV = "PENROZ_KV_PAGE_SIZE"
+PREFIX_CACHE_ENV = "PENROZ_PREFIX_CACHE"
+PREFIX_CACHE_PAGES_ENV = "PENROZ_PREFIX_CACHE_PAGES"
 
 # -- pool-capacity drop accounting ------------------------------------------
 # ``PagedKVState._allocate`` clamps page assignment at pool capacity and the
@@ -82,6 +84,28 @@ def turbo_quant_enabled() -> bool:
 
 def paged_enabled() -> bool:
     return os.environ.get(PAGED_ENV, "0") == "1"
+
+
+def prefix_cache_enabled() -> bool:
+    """``PENROZ_PREFIX_CACHE=1`` opts into radix prefix-KV sharing over the
+    paged pool (requires ``PAGED_KV_CACHE=1`` — page granularity is the
+    sharing unit; the continuous-batching scheduler checks both)."""
+    return os.environ.get(PREFIX_CACHE_ENV, "0") == "1"
+
+
+def prefix_cache_pages() -> int:
+    """Pool pages reserved for the radix prefix cache
+    (``PENROZ_PREFIX_CACHE_PAGES``, default 64)."""
+    raw = os.environ.get(PREFIX_CACHE_PAGES_ENV, "64")
+    try:
+        pages = int(raw)
+        if pages < 0:
+            raise ValueError
+    except ValueError:
+        log.warning("Ignoring invalid %s=%r; using 64",
+                    PREFIX_CACHE_PAGES_ENV, raw)
+        return 64
+    return pages
 
 
 def default_page_size() -> int:
@@ -270,6 +294,32 @@ class KVState:
         return self._with_length(
             self.ragged_lengths.at[jnp.asarray(row, jnp.int32)].set(0))
 
+    def row_view(self, row, length):
+        """Batch-1 view of row ``row`` with scalar valid ``length`` — the
+        chunked-prefill substrate: the scheduler feeds prompt chunks through
+        the model against this view (appending at ``length``), then writes
+        the result back with :meth:`merge_row`.  ``row`` and ``length`` may
+        be traced scalars, so one compiled chunk program serves every slot.
+        """
+        row = jnp.asarray(row, jnp.int32)
+        slc = lambda a: jax.lax.dynamic_slice(
+            a, (row,) + (0,) * (a.ndim - 1), (1,) + a.shape[1:])
+        return KVState([slc(a) for a in self.k], [slc(a) for a in self.v],
+                       jnp.asarray(length, jnp.int32))
+
+    def merge_row(self, row, view):
+        """Multi-row state with row ``row``'s buffers replaced by ``view``'s
+        (a :meth:`row_view` after chunk appends).  Lengths are untouched —
+        the scheduler's host-side array stays authoritative, so a decode
+        step never attends a row whose prefill is still in flight."""
+        row = jnp.asarray(row, jnp.int32)
+        upd = lambda d, s: jax.lax.dynamic_update_slice(
+            d, s.astype(d.dtype), (row,) + (0,) * (d.ndim - 1))
+        out = self._with_length(self.length)
+        out.k = [upd(d, s) for d, s in zip(self.k, view.k)]
+        out.v = [upd(d, s) for d, s in zip(self.v, view.v)]
+        return out
+
     def with_static_table(self):
         """No-op for contiguous layouts (rows already own fixed buffers);
         the paged variants override this with a fixed page partition."""
@@ -374,6 +424,26 @@ class QuantKVState(KVState):
                        for d, s in zip(self.k_scale, src.k_scale)]
         out.v_scale = [jax.lax.dynamic_update_slice(d, s, (row, 0, 0, 0))
                        for d, s in zip(self.v_scale, src.v_scale)]
+        return out
+
+    def row_view(self, row, length):
+        row = jnp.asarray(row, jnp.int32)
+        slc = lambda a: jax.lax.dynamic_slice(
+            a, (row,) + (0,) * (a.ndim - 1), (1,) + a.shape[1:])
+        return QuantKVState([slc(a) for a in self.k],
+                            [slc(a) for a in self.v],
+                            jnp.asarray(length, jnp.int32),
+                            [slc(a) for a in self.k_scale],
+                            [slc(a) for a in self.v_scale],
+                            out_dtype=self.out_dtype)
+
+    def merge_row(self, row, view):
+        out = super().merge_row(row, view)
+        row = jnp.asarray(row, jnp.int32)
+        upd = lambda d, s: jax.lax.dynamic_update_slice(
+            d, s, (row,) + (0,) * (d.ndim - 1))
+        out.k_scale = [upd(d, s) for d, s in zip(self.k_scale, view.k_scale)]
+        out.v_scale = [upd(d, s) for d, s in zip(self.v_scale, view.v_scale)]
         return out
 
     def logical_bytes(self) -> int:
@@ -686,6 +756,79 @@ class PagedKVState(KVState):
                  for d, s in zip(base.v, src.v)]
         return out
 
+    def row_view(self, row, length):
+        """Batch-1 view of row ``row`` sharing this state's flat pools —
+        appends through the view scatter straight into the parent pool's
+        pages via the sliced block-table row, so :meth:`merge_row` is just
+        a pool swap (no data copy).  This is what makes chunked prefill
+        write a row's suffix in place while its leading table entries may
+        alias prefix-cache pages owned by other sequences.
+
+        Precondition: the row's table is fully assigned (the scheduler's
+        static partition / :meth:`with_static_table`); the view parks the
+        bump allocator (``assigned_pages = pages_per_seq``) so appends are
+        pure scatters and never walk the shared counters."""
+        row = jnp.asarray(row, jnp.int32)
+        table = jax.lax.dynamic_slice(self.block_table, (row, 0),
+                                      (1, self.pages_per_seq))
+        counters = jnp.stack([jnp.asarray(length, jnp.int32),
+                              self.counters[1],
+                              jnp.asarray(self.pages_per_seq, jnp.int32)])
+        return PagedKVState(list(self.k), list(self.v), counters, table,
+                            self.page_size, self.pages_per_seq)
+
+    def merge_row(self, row, view):
+        """Adopt the view's (already scattered-into) pools; table, counters
+        and per-row lengths are untouched — the scheduler's host array
+        stays authoritative."""
+        out = self._with_length(self.length)
+        out.k = list(view.k)
+        out.v = list(view.v)
+        return out
+
+    def with_row_prefix(self, row, prefix_pages):
+        """Row ``row``'s block-table entries rebuilt as ``prefix_pages``
+        aliased over the leading logical pages, the row's own
+        static-partition pages for the rest (radix prefix-KV sharing).
+        Suffix appends land at positions ≥ ``len(prefix_pages) *
+        page_size``, so the shared pages are only ever read.  Eager
+        admission-path op; ``row`` is a host int.  Requires the static
+        partition (:meth:`with_static_table`)."""
+        S = self.pages_per_seq
+        n = len(prefix_pages)
+        if n > S:
+            raise ValueError(f"prefix of {n} pages exceeds pages_per_seq={S}")
+        entries = np.arange(int(row) * S, int(row) * S + S, dtype=np.int32)
+        entries[:n] = np.asarray(list(prefix_pages), np.int32)
+        out = self._with_length(self.length)
+        out.block_table = self.block_table.at[int(row)].set(
+            jnp.asarray(entries))
+        return out
+
+    def restore_row_table(self, row):
+        """Drop row ``row``'s prefix aliases, restoring its own static
+        partition (retirement path — the next occupant must not write
+        through stale shared entries)."""
+        return self.with_row_prefix(row, ())
+
+    def copy_pages(self, src_pages, dst_pages):
+        """Copy whole physical pages ``src_pages[i] → dst_pages[i]`` in
+        every layer's K and V pool — prefix-cache registration: a finished
+        prompt's row-private pages are copied into the reserved cache
+        region so slot recycling cannot clobber them.  Eager op."""
+        if len(src_pages) != len(dst_pages):
+            raise ValueError("copy_pages needs equal-length page lists")
+        if not len(src_pages):
+            return self
+        rows = lambda pages: (
+            np.asarray(list(pages), np.int64)[:, None] * self.page_size
+            + np.arange(self.page_size)).reshape(-1)
+        src_rows, dst_rows = rows(src_pages), rows(dst_pages)
+        out = self._with_length(self.length)
+        out.k = [a.at[:, dst_rows].set(a[:, src_rows]) for a in self.k]
+        out.v = [a.at[:, dst_rows].set(a[:, src_rows]) for a in self.v]
+        return out
+
     def _row_bytes(self) -> int:
         """Bytes per token row summed over every layer's K and V pool."""
         return sum(a.shape[0] * a.shape[2] * a.dtype.itemsize
@@ -822,6 +965,34 @@ class QuantPagedKVState(PagedKVState):
                        for d, s in zip(self.v_scale, src.v_scale)]
         return out
 
+    def row_view(self, row, length):
+        base = super().row_view(row, length)
+        return QuantPagedKVState(base.k, base.v, base.counters,
+                                 base.block_table, base.page_size,
+                                 base.pages_per_seq, list(self.k_scale),
+                                 list(self.v_scale),
+                                 out_dtype=self.out_dtype)
+
+    def merge_row(self, row, view):
+        out = super().merge_row(row, view)
+        out.k_scale = list(view.k_scale)
+        out.v_scale = list(view.v_scale)
+        return out
+
+    def copy_pages(self, src_pages, dst_pages):
+        out = super().copy_pages(src_pages, dst_pages)
+        if not len(src_pages):
+            return out
+        rows = lambda pages: (
+            np.asarray(list(pages), np.int64)[:, None] * self.page_size
+            + np.arange(self.page_size)).reshape(-1)
+        src_rows, dst_rows = rows(src_pages), rows(dst_pages)
+        out.k_scale = [a.at[:, dst_rows].set(a[:, src_rows])
+                       for a in self.k_scale]
+        out.v_scale = [a.at[:, dst_rows].set(a[:, src_rows])
+                       for a in self.v_scale]
+        return out
+
     def _row_bytes(self) -> int:
         """int8 value rows + fp32 scale rows per token, over every layer."""
         values = super()._row_bytes()
@@ -844,25 +1015,233 @@ class QuantPagedKVState(PagedKVState):
 
 def create_kv_state(specs, batch: int, max_len: int, dtype=jnp.float32,
                     quantized: bool | None = None,
-                    paged: bool | None = None) -> KVState:
+                    paged: bool | None = None,
+                    extra_pool_pages: int = 0) -> KVState:
     """Factory honoring ``TURBO_QUANT_KV_CACHE=1`` and ``PAGED_KV_CACHE=1``
-    (both together → the int8 paged pool)."""
+    (both together → the int8 paged pool).  ``extra_pool_pages`` grows the
+    paged pool beyond the per-row partition — the reserved prefix-cache
+    region (ignored by contiguous layouts, which have no shared pool)."""
     if quantized is None:
         quantized = turbo_quant_enabled()
     if paged is None:
         paged = paged_enabled()
+    page = default_page_size()
+    pool_pages = None
+    if paged and extra_pool_pages:
+        pool_pages = batch * (-(-max_len // page)) + int(extra_pool_pages)
     if quantized and paged:
         log.info("Int8 paged KV cache enabled (%s=1 + %s=1, page_size=%d)",
-                 TURBO_QUANT_ENV, PAGED_ENV, default_page_size())
-        return QuantPagedKVState.create(specs, batch, max_len, dtype)
+                 TURBO_QUANT_ENV, PAGED_ENV, page)
+        return QuantPagedKVState.create(specs, batch, max_len, dtype,
+                                        pool_pages=pool_pages)
     if quantized:
         log.info("TurboQuant KV cache enabled (%s=1)", TURBO_QUANT_ENV)
         return QuantKVState.create(specs, batch, max_len, dtype)
     if paged:
         log.info("Paged KV cache enabled (%s=1, page_size=%d)", PAGED_ENV,
-                 default_page_size())
-        return PagedKVState.create(specs, batch, max_len, dtype)
+                 page)
+        return PagedKVState.create(specs, batch, max_len, dtype,
+                                   pool_pages=pool_pages)
     return KVState.create(specs, batch, max_len, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Radix prefix-KV cache (host-side bookkeeping over the paged pool)
+# ---------------------------------------------------------------------------
+
+class _RadixNode:
+    __slots__ = ("key", "page", "children", "parent", "refs", "last_use")
+
+    def __init__(self, key, page, parent, last_use):
+        self.key = key          # page_size-token tuple (edge label)
+        self.page = page        # physical pool page holding this block's KV
+        self.children = {}
+        self.parent = parent
+        self.refs = 0           # live rows aliasing this page
+        self.last_use = last_use
+
+
+class RadixPrefixCache:
+    """Radix tree over page-granularity prompt blocks → pages of a reserved
+    region of the paged KV pool (the SGLang/RadixAttention shape adapted to
+    this pool: PAPERS.md "Ragged Paged Attention" line of work).
+
+    Pure host-side bookkeeping: the device-side work — aliasing matched
+    pages into a row's block table, copying a finished prompt's pages into
+    the cache region — is the caller's job via
+    :meth:`PagedKVState.with_row_prefix` / :meth:`PagedKVState.copy_pages`.
+    This class decides WHICH pages, with:
+
+    - whole-page sharing only (a partially filled page is never cached —
+      suffix appends into it would corrupt other readers);
+    - refcounted pinning: a page aliased into a live row's table cannot be
+      evicted (eviction recycles the page for the next insert, which would
+      overwrite KV another row still attends);
+    - LRU eviction of unpinned *leaves* only (an interior page is a prefix
+      of its children's chains — evicting it would orphan them).
+
+    Greedy outputs with a cache hit are token-identical to a miss: the
+    aliased pages hold exactly the K/V the suffix prefill would recompute,
+    written at the same absolute positions (RoPE/ALiBi are position-
+    absolute, so a shared prefix's KV is request-invariant).
+    """
+
+    def __init__(self, pages, page_size: int):
+        self.page_size = int(page_size)
+        self._pages = list(pages)
+        self._free = list(reversed(self._pages))
+        self._root = _RadixNode(None, -1, None, 0)
+        self._clock = 0
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def capacity_pages(self) -> int:
+        return len(self._pages)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._pages) - len(self._free)
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "capacity_pages": self.capacity_pages,
+            "cached_pages": self.cached_pages,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": (self.hits / total) if total else None,
+            "hit_tokens": self.hit_tokens,
+            "inserted_pages": self.inserted_pages,
+            "evicted_pages": self.evicted_pages,
+        }
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _blocks(self, tokens, limit=None):
+        P = self.page_size
+        n = len(tokens) if limit is None else min(int(limit), len(tokens))
+        for b in range(n // P):
+            yield tuple(int(t) for t in tokens[b * P:(b + 1) * P])
+
+    # -- lookup / registration ----------------------------------------------
+
+    def match(self, tokens, limit=None) -> list:
+        """Longest cached prefix of ``tokens`` in whole pages; returns the
+        matched node chain (``[n.page for n in nodes]`` are the pages to
+        alias, in logical order).  ``limit`` caps the usable token count —
+        admission passes ``len(prompt) - 1`` so at least one real token is
+        always left to produce the first-sample logits.  Counts a hit iff
+        at least one page matched."""
+        nodes = []
+        node = self._root
+        for key in self._blocks(tokens, limit):
+            child = node.children.get(key)
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+        t = self._tick()
+        for nd in nodes:
+            nd.last_use = t
+        if nodes:
+            self.hits += 1
+            self.hit_tokens += len(nodes) * self.page_size
+        else:
+            self.misses += 1
+        return nodes
+
+    def pin(self, nodes):
+        """Hold ``nodes``' pages against eviction while a live row aliases
+        them (admission → :meth:`unpin` at retirement)."""
+        for nd in nodes:
+            nd.refs += 1
+
+    def unpin(self, nodes):
+        for nd in nodes:
+            nd.refs -= 1
+            if nd.refs < 0:  # defensive: never let an unpaired unpin
+                nd.refs = 0  # turn into a negative permanent pin
+
+    def insert(self, tokens, limit=None) -> list[tuple[int, int]]:
+        """Ensure nodes exist for every full page block of ``tokens``;
+        returns ``(block_index, page)`` pairs NEWLY allocated — the caller
+        must ``copy_pages`` the corresponding KV into them.  Allocation
+        evicts unpinned LRU leaves on demand and stops early (no error)
+        when everything left is pinned; partial chains are valid prefixes.
+        """
+        created = []
+        chain = []
+        node = self._root
+        t = self._tick()
+        try:
+            for b, key in enumerate(self._blocks(tokens, limit)):
+                child = node.children.get(key)
+                if child is None:
+                    page = self._alloc()
+                    if page is None:
+                        break
+                    child = _RadixNode(key, page, node, t)
+                    node.children[key] = child
+                    created.append((b, page))
+                    self.inserted_pages += 1
+                child.last_use = t
+                # pin the chain while building it: a tiny pool must not
+                # evict a node we created two blocks ago (its page would be
+                # recycled for a later block of this very chain, and the
+                # caller's copy would clobber it).
+                child.refs += 1
+                chain.append(child)
+                node = child
+        finally:
+            for nd in chain:
+                nd.refs -= 1
+        return created
+
+    def _alloc(self):
+        if self._free:
+            return self._free.pop()
+        victim = self._lru_leaf()
+        if victim is None:
+            return None
+        self._evict(victim)
+        return self._free.pop()
+
+    def _lru_leaf(self):
+        best = None
+        stack = list(self._root.children.values())
+        while stack:
+            nd = stack.pop()
+            if nd.children:
+                stack.extend(nd.children.values())
+            elif nd.refs == 0 and (best is None
+                                   or nd.last_use < best.last_use):
+                best = nd
+        return best
+
+    def _evict(self, node):
+        del node.parent.children[node.key]
+        self._free.append(node.page)
+        self.evicted_pages += 1
+
+    def clear(self):
+        """Drop every cached prefix and reclaim all pages (model reload:
+        cached K/V from the old weights must never serve the new ones).
+        Callers only reload with zero rows in flight, so nothing is pinned.
+        Counters survive — they are lifetime observability."""
+        self._root = _RadixNode(None, -1, None, 0)
+        self._free = list(reversed(self._pages))
 
 
 # ---------------------------------------------------------------------------

@@ -8,23 +8,37 @@ rows are KV-cache slots (paged pool pages when ``PAGED_KV_CACHE=1``):
 
 - a dedicated worker thread runs ONE shared jitted decode step per tick
   across all active rows (``NeuralNetworkModel.decode_step_batched``);
-- newcomers are admitted at step boundaries: the prompt is prefilled into a
-  fresh batch-1 cache with the exact single-sequence prefill program and
-  dropped into a free row (``decode_insert_row`` → ``KVState.insert_row``),
-  so the first token is identical to the standalone path;
+- newcomers are admitted at step boundaries into a PREFILLING row: the
+  prompt is fed in fixed-size, power-of-two-bucketed CHUNKS
+  (``PENROZ_PREFILL_CHUNK``, default 256) straight into the row's slice of
+  the shared KV state (``decode_prefill_chunk`` → ``KVState.row_view`` /
+  ``merge_row``), at most one chunk between decode steps — a long prompt
+  can never stall the in-flight batch for more than one chunk's latency
+  (``PENROZ_SCHED_MAX_STALL_MS`` budgets >1 chunk per boundary; with no
+  decode rows in flight, chunks run back-to-back);
+- with ``PENROZ_PREFIX_CACHE=1`` (+ ``PAGED_KV_CACHE=1``) admission first
+  matches the prompt against a radix tree of page-granularity blocks over
+  a reserved region of the paged pool (``PENROZ_PREFIX_CACHE_PAGES``),
+  aliases the matched pages into the row's block table (ref-count pinned,
+  LRU-evicted — ops/kv_cache.py ``RadixPrefixCache``) and chunk-prefills
+  only the suffix: repeated system prompts pay prefill once;
 - rows retire on stop-token / max_new_tokens and their slot is recycled
   immediately for the next queued request (``KVState.reset_row``);
-- greedy outputs are token-identical to the single-sequence path (tested —
-  the ragged batched decode step is the same program family as
-  ``generate_tokens_batched``, whose greedy parity is bit-exact).
+- greedy outputs are token-identical to the single-sequence path with the
+  prefix cache hitting, missing, or off, and with chunked or one-shot
+  prefill (tested — the chunked program family is the same
+  cached-attention path, reading the same absolute positions).
 
 Enabled by routing: serve/app.py sends eligible ``/generate/`` and
 ``/generate_batch/`` traffic here when ``PENROZ_CONTINUOUS_BATCHING=1``.
 Knobs: ``PENROZ_SCHED_MAX_ROWS`` (decode batch capacity, default 8),
 ``PENROZ_SCHED_ADMIT_MS`` (idle-burst coalescing window, default 0),
-``PENROZ_SCHED_MAX_ENGINES`` (engine registry cap, default 4).
+``PENROZ_SCHED_MAX_ENGINES`` (engine registry cap, default 4),
+``PENROZ_PREFILL_CHUNK`` / ``PENROZ_SCHED_MAX_STALL_MS`` /
+``PENROZ_PREFIX_CACHE`` / ``PENROZ_PREFIX_CACHE_PAGES`` (above).
 Observability: ``serving_stats()`` backs ``GET /serving_stats/`` — queue
-depth, batch occupancy, decode tokens/sec, admission latency, and the KV
+depth, batch occupancy, decode tokens/sec, admission latency, prefill
+chunk-stall p99, prefix-cache hit rate/evictions, and the KV
 pool-capacity drop counter (ops/kv_cache.py).
 
 This is the serving shape the ragged paged-attention kernel line of work
@@ -56,6 +70,8 @@ ENABLE_ENV = "PENROZ_CONTINUOUS_BATCHING"
 MAX_ROWS_ENV = "PENROZ_SCHED_MAX_ROWS"
 ADMIT_MS_ENV = "PENROZ_SCHED_ADMIT_MS"
 MAX_ENGINES_ENV = "PENROZ_SCHED_MAX_ENGINES"
+PREFILL_CHUNK_ENV = "PENROZ_PREFILL_CHUNK"
+MAX_STALL_MS_ENV = "PENROZ_SCHED_MAX_STALL_MS"
 
 # Sliding window for the tokens/sec stat (seconds).
 _TPS_WINDOW_S = 30.0
@@ -74,6 +90,15 @@ def _env_int(name: str, default: int, lo: int = 1) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return max(0.0, float(os.environ.get(name, str(default))))
+    except ValueError:
+        log.warning("Unparseable %s=%r; using %s", name,
+                    os.environ.get(name), default)
+        return default
+
+
 def _max_rows() -> int:
     return _env_int(MAX_ROWS_ENV, 8)
 
@@ -83,12 +108,35 @@ def _max_engines() -> int:
 
 
 def _admit_ms() -> float:
-    try:
-        return max(0.0, float(os.environ.get(ADMIT_MS_ENV, "0")))
-    except ValueError:
-        log.warning("Unparseable %s=%r; using 0", ADMIT_MS_ENV,
-                    os.environ.get(ADMIT_MS_ENV))
-        return 0.0
+    return _env_float(ADMIT_MS_ENV, 0.0)
+
+
+def _prefill_chunk() -> int:
+    return _env_int(PREFILL_CHUNK_ENV, 256)
+
+
+def _max_stall_ms() -> float:
+    return _env_float(MAX_STALL_MS_ENV, 0.0)
+
+
+def _chunk_plan(n: int, chunk: int) -> list[int]:
+    """Chunk sizes covering ``n`` prefill tokens: fixed ``chunk``-size
+    pieces, then a descending power-of-two decomposition of the remainder —
+    the compiled chunk-program set stays bounded by {chunk} ∪ {2^k < chunk}
+    instead of retracing per prompt length."""
+    plan = [chunk] * (n // chunk)
+    rem = n % chunk
+    for b in range(rem.bit_length() - 1, -1, -1):
+        if rem & (1 << b):
+            plan.append(1 << b)
+    return plan
+
+
+def _p99(values) -> float | None:
+    vals = sorted(values)
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
 
 
 class Request:
@@ -114,12 +162,23 @@ class Request:
 
 
 class _Row:
-    __slots__ = ("req", "produced", "finished")
+    __slots__ = ("req", "produced", "finished", "prefilling", "prefilled",
+                 "chunks", "chunk_idx", "prefix_nodes")
 
     def __init__(self, req):
         self.req = req
         self.produced = 0
         self.finished = False
+        # PREFILLING phase state: ``prefilled`` is the row's KV valid length
+        # so far (starts at the radix-matched prefix length); ``chunks`` is
+        # the pow-2-bucketed plan covering the remaining suffix;
+        # ``prefix_nodes`` are the pinned radix nodes whose pages the row's
+        # block table aliases (unpinned at retirement).
+        self.prefilling = True
+        self.prefilled = 0
+        self.chunks: list = []
+        self.chunk_idx = 0
+        self.prefix_nodes: list = []
 
 
 class DecodeEngine:
@@ -143,11 +202,30 @@ class DecodeEngine:
 
         self._model = NeuralNetworkModel.deserialize(model_id)
         self._ckpt_stamp_v = self._ckpt_stamp()
+        extra_pages = 0
+        if KV.prefix_cache_enabled():
+            if KV.paged_enabled():
+                extra_pages = KV.prefix_cache_pages()
+            else:
+                log.warning(
+                    "%s=1 ignored: prefix-KV sharing is page-granular and "
+                    "needs PAGED_KV_CACHE=1", KV.PREFIX_CACHE_ENV)
         self._kv = (KV.create_kv_state(self._model.arch.kv_specs,
                                        self.capacity, self.block_size,
-                                       self._model._kv_dtype())
+                                       self._model._kv_dtype(),
+                                       extra_pool_pages=extra_pages)
                     .with_static_table()
                     .with_lengths(np.zeros(self.capacity, np.int32)))
+        # Radix prefix cache over the reserved pool tail: pages
+        # [capacity * pages_per_seq, num_pool_pages) are never touched by
+        # the static per-row partition, so they are exclusively the radix
+        # tree's to hand out.
+        self._prefix_cache = None
+        if extra_pages > 0 and isinstance(self._kv, KV.PagedKVState):
+            base = self.capacity * self._kv.pages_per_seq
+            self._prefix_cache = KV.RadixPrefixCache(
+                list(range(base, self._kv.num_pool_pages)),
+                self._kv.page_size)
         self._lengths = np.zeros(self.capacity, np.int32)
         self._last_tok = np.zeros(self.capacity, np.int32)
         self._rows: list = [None] * self.capacity
@@ -169,6 +247,14 @@ class DecodeEngine:
         self._occupancy_sum = 0.0
         self._admit_lat_ms: collections.deque = collections.deque(maxlen=256)
         self._token_window: collections.deque = collections.deque()
+        self._prefill_chunks = 0
+        # decode-batch stall injected per step boundary by interleaved
+        # prefill chunks (only sampled while decode rows are in flight —
+        # idle-engine prefill stalls nobody)
+        self._chunk_stall_ms: collections.deque = collections.deque(
+            maxlen=512)
+        self._chunks_between_steps = 0
+        self._max_chunks_between_steps = 0
 
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -212,6 +298,7 @@ class DecodeEngine:
             if self._decode_time_s > 0 else 0.0)
         lat = sorted(self._admit_lat_ms)
         active = self.active_rows
+        stall_p99 = _p99(self._chunk_stall_ms)
         return {
             "model_id": self.model_id,
             "block_size": self.block_size,
@@ -230,6 +317,14 @@ class DecodeEngine:
             "completed": self._completed,
             "admission_latency_ms_p50": (round(statistics.median(lat), 3)
                                          if lat else None),
+            "prefill_chunks": self._prefill_chunks,
+            "prefill_chunk_stall_ms_p99": (round(stall_p99, 3)
+                                           if stall_p99 is not None
+                                           else None),
+            "prefill_max_chunks_between_steps":
+                self._max_chunks_between_steps,
+            "prefix_cache": (self._prefix_cache.stats()
+                             if self._prefix_cache is not None else None),
         }
 
     # -- worker loop --------------------------------------------------------
@@ -245,7 +340,8 @@ class DecodeEngine:
             try:
                 self._coalesce_burst()
                 self._admit()
-                if self.active_rows:
+                self._prefill_tick()
+                if self._decoding_rows():
                     self._step()
             except Exception as exc:  # noqa: BLE001 — fail requests, not thread
                 log.exception("Decode engine %s failed a tick", self.model_id)
@@ -275,6 +371,12 @@ class DecodeEngine:
                 return i
         return None
 
+    def _decoding_rows(self) -> list[int]:
+        """Rows with prefill complete — the shared decode step's real
+        participants (prefilling/free rows ride along parked)."""
+        return [i for i, r in enumerate(self._rows)
+                if r is not None and not r.prefilling]
+
     def _admit(self):
         while True:
             row = self._free_row()
@@ -288,25 +390,128 @@ class DecodeEngine:
                 continue
             if self.active_rows == 0:
                 self._maybe_reload()
-            self._prefill_into(row, req)
+            self._begin_prefill(row, req)
 
-    def _prefill_into(self, row: int, req: Request):
-        model = self._model
+    # -- chunked prefill (admission state machine) ---------------------------
+
+    def _begin_prefill(self, row: int, req: Request):
+        """Claim ``row`` for ``req`` in the PREFILLING phase: match the
+        radix prefix cache (paged + ``PENROZ_PREFIX_CACHE=1``), alias the
+        matched pages into the row's block table, and plan pow-2-bucketed
+        chunks over the remaining suffix.  No device prefill work happens
+        here — ``_prefill_tick`` interleaves it with decode steps."""
+        state = _Row(req)
+        if self._prefix_cache is not None:
+            # Cap the usable match at len(prompt) - 1: the final chunk must
+            # feed at least one real token to produce the first-sample
+            # logits (a full-prompt hit would leave nothing to run).
+            nodes = self._prefix_cache.match(req.prompt,
+                                             limit=len(req.prompt) - 1)
+            if nodes:
+                self._prefix_cache.pin(nodes)
+                state.prefix_nodes = nodes
+                state.prefilled = len(nodes) * self._prefix_cache.page_size
+            # Rebuild the row's table on miss too: re-basing to the static
+            # partition is one tiny host write, and it guarantees no stale
+            # alias survives an abnormal retirement path.
+            self._kv = self._kv.with_row_prefix(
+                row, [n.page for n in nodes])
+        state.chunks = _chunk_plan(len(req.prompt) - state.prefilled,
+                                   _prefill_chunk())
+        self._rows[row] = state
+        # Park the row's decode-step write position at the next prefill
+        # position: the interleaved shared step's (discarded) K/V write for
+        # this row lands exactly where the next chunk writes real data, so
+        # it can never clobber prefilled content — nor an aliased shared
+        # page, which only covers positions below ``prefilled``.
+        self._lengths[row] = state.prefilled
+        self._last_tok[row] = 0
+        self._admissions += 1
+
+    def _next_prefill_row(self):
+        """FIFO over prefilling rows (earliest enqueue first) so chunk
+        interleaving cannot starve an early long prompt behind later
+        arrivals."""
+        best = None
+        for i, r in enumerate(self._rows):
+            if r is None or not r.prefilling:
+                continue
+            if best is None or r.req.enqueue_t \
+                    < self._rows[best].req.enqueue_t:
+                best = i
+        return best
+
+    def _prefill_tick(self):
+        """Run prefill chunks for this step boundary: exactly one when
+        decode rows are in flight (the stall bound), more while under the
+        ``PENROZ_SCHED_MAX_STALL_MS`` budget; with an idle decode batch one
+        chunk per loop iteration keeps admission responsive while chunks
+        effectively run back-to-back."""
+        if self._next_prefill_row() is None:
+            return
+        budget_ms = _max_stall_ms()
+        stalling = bool(self._decoding_rows())
+        t0 = time.monotonic()
+        while True:
+            row = self._next_prefill_row()
+            if row is None:
+                break
+            self._run_prefill_chunk(row)
+            if not stalling:
+                break
+            self._chunks_between_steps += 1
+            if (time.monotonic() - t0) * 1000.0 >= budget_ms:
+                break
+        if stalling:
+            self._chunk_stall_ms.append((time.monotonic() - t0) * 1000.0)
+
+    def _run_prefill_chunk(self, row: int):
+        state = self._rows[row]
+        req = state.req
+        if req.cancelled:
+            self._retire(row, notify=False)
+            return
+        size = state.chunks[state.chunk_idx]
+        start = state.prefilled
         rng = jax.random.fold_in(self._rng, self._dispatch)
         self._dispatch += 1
-        with model_mod.decode_priority(), profiling.span("penroz/sched_prefill"):
-            first, kv_single, fed = model.decode_prefill_single(
-                req.prompt, self.block_size, rng, self.temperature,
-                self.top_k)
-            self._kv = model.decode_insert_row(self._kv, row, kv_single)
-        self._lengths[row] = fed
+        with model_mod.decode_priority(), \
+                profiling.span("penroz/sched_prefill_chunk"):
+            tok, self._kv = self._model.decode_prefill_chunk(
+                self._kv, row, req.prompt[start:start + size], start, rng,
+                self.temperature, self.top_k)
+        state.prefilled += size
+        state.chunk_idx += 1
+        self._prefill_chunks += 1
+        self._lengths[row] = state.prefilled  # re-park (see _begin_prefill)
+        if state.chunk_idx >= len(state.chunks):
+            self._finish_prefill(row, state, tok)
+
+    def _finish_prefill(self, row: int, state: _Row, first: int):
+        """Final chunk done: its sampled token IS the request's first token
+        (same logits position and program family as one-shot prefill)."""
+        state.prefilling = False
+        self._lengths[row] = state.prefilled  # == len(prompt)
         self._last_tok[row] = first
-        state = _Row(req)
-        self._rows[row] = state
-        self._admissions += 1
         self._admit_lat_ms.append(
-            (time.monotonic() - req.enqueue_t) * 1000.0)
+            (time.monotonic() - state.req.enqueue_t) * 1000.0)
+        self._register_prefix(row, state)
         self._emit_token(row, state, first)
+
+    def _register_prefix(self, row: int, state: _Row):
+        """Copy the finished prompt's full pages into the reserved cache
+        region and hang them on the radix tree — the next request sharing
+        this prefix aliases them instead of recomputing.  Aliased blocks
+        already live in the cache region (their nodes exist), so only the
+        freshly prefilled suffix pages are copied."""
+        if self._prefix_cache is None:
+            return
+        created = self._prefix_cache.insert(state.req.prompt)
+        if created:
+            S = self._kv.pages_per_seq
+            self._kv = self._kv.copy_pages(
+                [row * S + b for b, _ in created],
+                [page for _, page in created])
 
     def _step(self):
         t0 = time.monotonic()
@@ -317,7 +522,10 @@ class DecodeEngine:
                 self._kv, self._last_tok[:, None], self._lengths, rng,
                 self.temperature, self.top_k)
             arr = np.asarray(toks)
-        active = [i for i, r in enumerate(self._rows) if r is not None]
+        self._max_chunks_between_steps = max(
+            self._max_chunks_between_steps, self._chunks_between_steps)
+        self._chunks_between_steps = 0
+        active = self._decoding_rows()
         emitted = 0
         for i in active:
             state = self._rows[i]
@@ -362,10 +570,21 @@ class DecodeEngine:
         self._rows[row] = None
         self._lengths[row] = 0
         self._last_tok[row] = 0
+        self._release_prefix(row, state)
         self._kv = self._kv.reset_row(row)
         self._completed += 1
         if notify and state is not None:
             self._deliver(state.req, "done", None)
+
+    def _release_prefix(self, row: int, state):
+        """Unpin the row's aliased radix pages and restore its static block
+        table — the slot's next occupant must not write through the shared
+        entries (its parked position-0 write would corrupt every reader)."""
+        if state is None or not state.prefix_nodes:
+            return
+        self._prefix_cache.unpin(state.prefix_nodes)
+        state.prefix_nodes = []
+        self._kv = self._kv.restore_row_table(row)
 
     def _deliver(self, req: Request, kind: str, value):
         try:
@@ -380,6 +599,12 @@ class DecodeEngine:
                 self._rows[i] = None
                 self._lengths[i] = 0
                 self._last_tok[i] = 0
+                try:
+                    self._release_prefix(i, state)
+                except Exception:  # noqa: BLE001 — the device state may be
+                    # the failing thing; admission re-bases the row's table
+                    # anyway (_begin_prefill), so only log.
+                    log.exception("Failed to restore row %d block table", i)
                 self._deliver(state.req, "error", exc)
         with self._cond:
             pending, self._pending = list(self._pending), collections.deque()
@@ -405,6 +630,11 @@ class DecodeEngine:
         try:
             self._model = NeuralNetworkModel.deserialize(self.model_id)
             self._ckpt_stamp_v = stamp
+            if self._prefix_cache is not None:
+                # Cached prefix K/V was computed with the OLD weights; a hit
+                # against the new ones would silently mix models.  Zero rows
+                # are in flight here, so nothing is pinned.
+                self._prefix_cache.clear()
             log.info("Decode engine reloaded model %s (checkpoint changed)",
                      self.model_id)
         except KeyError:
@@ -470,6 +700,9 @@ def serving_stats() -> dict:
     capacity = sum(p["capacity"] for p in per)
     active = sum(p["active_rows"] for p in per)
     lat = sorted(x for e in engines for x in e._admit_lat_ms)
+    stall_p99 = _p99([x for e in engines for x in e._chunk_stall_ms])
+    pc = [p["prefix_cache"] for p in per if p["prefix_cache"] is not None]
+    pc_lookups = sum(c["hits"] + c["misses"] for c in pc)
     return {
         "continuous_batching_enabled": enabled(),
         "engines": per,
@@ -481,6 +714,11 @@ def serving_stats() -> dict:
             sum(p["decode_tokens_per_sec"] for p in per), 2),
         "admission_latency_ms_p50": (round(statistics.median(lat), 3)
                                      if lat else None),
+        "prefill_chunk_stall_ms_p99": (round(stall_p99, 3)
+                                       if stall_p99 is not None else None),
+        "prefix_cache_hit_rate": (
+            sum(c["hits"] for c in pc) / pc_lookups if pc_lookups else None),
+        "prefix_cache_evicted_pages": sum(c["evicted_pages"] for c in pc),
         "kv_pool_capacity_drops": KV.pool_drop_count(),
     }
 

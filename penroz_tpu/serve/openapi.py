@@ -149,7 +149,8 @@ def _routes() -> list[dict]:
         dict(method="get", path="/serving_stats/",
              summary="Continuous-batching scheduler stats: queue depth, "
                      "batch occupancy, decode tokens/sec, admission "
-                     "latency, KV pool-drop counter",
+                     "latency, prefill chunk-stall p99, prefix-cache hit "
+                     "rate/evictions, KV pool-drop counter",
              responses={"200": {
                  "description": "Serving statistics",
                  "content": {"application/json": {"schema": {

@@ -97,6 +97,23 @@ class ImportModelRequest(BaseModel):
     device: str = Field("cpu", description="Device to load the model on")
 
 
+class PrefixCacheStats(BaseModel):
+    """Radix prefix-KV cache snapshot (PENROZ_PREFIX_CACHE=1 over the paged
+    pool; ops/kv_cache.py RadixPrefixCache)."""
+    capacity_pages: int = Field(..., description="Reserved pool pages "
+                                "(PENROZ_PREFIX_CACHE_PAGES)")
+    cached_pages: int
+    hits: int = Field(..., description="Admissions matching ≥1 cached page")
+    misses: int
+    hit_rate: Optional[float] = Field(None, description="hits / lookups "
+                                      "(null before any lookup)")
+    hit_tokens: int = Field(..., description="Prompt tokens whose prefill "
+                            "was skipped via aliased pages")
+    inserted_pages: int
+    evicted_pages: int = Field(..., description="LRU-evicted pages "
+                               "(unpinned leaves only)")
+
+
 class EngineStats(BaseModel):
     """Per-engine snapshot inside ServingStatsResponse (one continuous-
     batching engine per (model, block_size, sampling config))."""
@@ -119,6 +136,17 @@ class EngineStats(BaseModel):
     completed: int
     admission_latency_ms_p50: Optional[float] = Field(
         None, description="Enqueue → prefill-complete latency median")
+    prefill_chunks: int = Field(0, description="Chunked-prefill dispatches "
+                                "(PENROZ_PREFILL_CHUNK-sized + pow-2 tail)")
+    prefill_chunk_stall_ms_p99: Optional[float] = Field(
+        None, description="p99 decode-batch stall injected per step "
+        "boundary by interleaved prefill chunks")
+    prefill_max_chunks_between_steps: int = Field(
+        0, description="Max chunks ever run between two decode steps "
+        "(1 unless PENROZ_SCHED_MAX_STALL_MS budgets more)")
+    prefix_cache: Optional[PrefixCacheStats] = Field(
+        None, description="null unless PENROZ_PREFIX_CACHE=1 with the "
+        "paged pool")
 
 
 class ServingStatsResponse(BaseModel):
@@ -132,6 +160,13 @@ class ServingStatsResponse(BaseModel):
     batch_occupancy: float
     decode_tokens_per_sec: float
     admission_latency_ms_p50: Optional[float] = None
+    prefill_chunk_stall_ms_p99: Optional[float] = Field(
+        None, description="p99 prefill-chunk stall across engines")
+    prefix_cache_hit_rate: Optional[float] = Field(
+        None, description="Aggregate radix prefix-cache hit rate (null "
+        "when no engine runs a prefix cache)")
+    prefix_cache_evicted_pages: int = Field(
+        0, description="Aggregate LRU-evicted prefix-cache pages")
     kv_pool_capacity_drops: int = Field(..., description="KV writes dropped "
                                         "at pool capacity (process-wide; "
                                         "ops/kv_cache.py record_pool_drop)")

@@ -227,13 +227,21 @@ function renderServing(data) {
   }
   const occ = data.batch_occupancy || 0;
   const tps = data.decode_tokens_per_sec || 0;
+  /* Prefix-cache + chunked-prefill observability (null-safe: the fields
+   * only carry values when PENROZ_PREFIX_CACHE / chunked admission ran). */
+  const hitRate = data.prefix_cache_hit_rate;
+  const prefixTxt = hitRate == null ? "prefix cache off"
+    : `prefix hits ${(hitRate * 100).toFixed(0)}% · evicted ` +
+      `${data.prefix_cache_evicted_pages || 0} pages`;
+  const stall = data.prefill_chunk_stall_ms_p99;
   meta.textContent =
     `rows ${data.active_rows}/${data.capacity} (occupancy ` +
     `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +
     `${tps.toFixed(1)} tok/s · adm p50 ` +
     `${data.admission_latency_ms_p50 == null ? "—"
        : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +
-    `KV pool drops ${drops}`;
+    `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
+    `${prefixTxt} · KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();
   const xs = servingHistory.map((_, i) => i);

@@ -19,6 +19,18 @@ Prints ONE JSON line, e.g.::
 
 CPU by default (``PENROZ_BENCH_SERVING_PLATFORM`` overrides); run from the
 repo root: ``python scripts/bench_serving.py [concurrency] [max_new]``.
+
+``--shared-prefix`` switches to the chunked-prefill + radix prefix-cache
+workload: N sequential streaming requests sharing one long prompt prefix
+(distinct short suffixes), measured with the prefix cache OFF then ON
+(``PENROZ_PREFIX_CACHE``), reporting TTFT p50/p99 and ITL p99 per phase,
+the cache hit rate, and the TTFT speedup.  Greedy parity is asserted
+between phases.  JSON goes to stdout and (``PENROZ_BENCH_JSON_OUT``) to a
+file for ``bench_watch.sh``-style artifact capture.  Scale knobs (env):
+``PENROZ_BENCH_SERVING_BLOCK/_D/_DEPTH``, ``PENROZ_BENCH_PREFIX_LEN``,
+``PENROZ_BENCH_SUFFIX_LEN``, ``PENROZ_BENCH_REQUESTS``,
+``PENROZ_BENCH_PREFIX_PAGE`` (KV page size), ``PENROZ_BENCH_CHUNK``
+(prefill chunk).
 """
 
 from __future__ import annotations
@@ -140,15 +152,173 @@ async def _bench(concurrency: int, max_new: int, block: int) -> dict:
         os.environ.pop(decode_scheduler.ENABLE_ENV, None)
 
 
+# ---------------------------------------------------------------------------
+# --shared-prefix: chunked prefill + radix prefix-KV cache TTFT workload
+# ---------------------------------------------------------------------------
+
+def _pct(vals, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]) of a non-empty list."""
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def _env_i(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+async def _stream_one(client, payload) -> tuple[list[int], float, list[float]]:
+    """POST a streaming /generate/; returns (generated tokens, ttft_ms,
+    inter-token gaps ms).  TTFT is request-send → first token line — with
+    chunked prefill it reflects admission interleaving, not a full-prompt
+    stall behind someone else's long prompt."""
+    import time as _t
+    t0 = _t.perf_counter()
+    resp = await client.post("/generate/", json=dict(payload, stream=True))
+    assert resp.status == 200, await resp.text()
+    toks, stamps = [], []
+    while True:
+        line = await resp.content.readline()
+        if not line:
+            break
+        toks.append(int(line))
+        stamps.append(_t.perf_counter())
+    assert toks, "stream produced no tokens"
+    ttft_ms = (stamps[0] - t0) * 1000.0
+    gaps = [(b - a) * 1000.0 for a, b in zip(stamps, stamps[1:])]
+    return toks, ttft_ms, gaps
+
+
+async def _bench_shared_prefix() -> dict:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 512)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 256)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 4)
+    prefix_len = _env_i("PENROZ_BENCH_PREFIX_LEN", 384)
+    suffix_len = _env_i("PENROZ_BENCH_SUFFIX_LEN", 4)
+    requests = _env_i("PENROZ_BENCH_REQUESTS", 6)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 8)
+    page = _env_i("PENROZ_BENCH_PREFIX_PAGE", 16)
+    chunk = _env_i("PENROZ_BENCH_CHUNK", 64)
+    vocab = 512
+    assert prefix_len + suffix_len + max_new <= block
+
+    # Serving-stack env for both phases; PENROZ_PREFIX_CACHE flips per phase.
+    cache_pages = 2 * (-(-block // page))  # room for two full prefixes
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        "PAGED_KV_CACHE": "1",
+        "PENROZ_KV_PAGE_SIZE": str(page),
+        decode_scheduler.PREFILL_CHUNK_ENV: str(chunk),
+        "PENROZ_PREFIX_CACHE_PAGES": str(cache_pages),
+    }
+    saved = {k: os.environ.get(k) for k in (*env, "PENROZ_PREFIX_CACHE")}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(0)
+    shared = [int(t) for t in rng.integers(1, vocab - 1, prefix_len)]
+    warm = [int(t) for t in rng.integers(1, vocab - 1, prefix_len)]
+    suffixes = [[int(t) for t in rng.integers(1, vocab - 1, suffix_len)]
+                for _ in range(requests)]
+
+    def payload(prompt):
+        return {"model_id": "bench-prefix", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-prefix",
+            "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+
+        results: dict = {
+            "mode": "shared_prefix", "block_size": block,
+            "prefix_len": prefix_len, "suffix_len": suffix_len,
+            "requests": requests, "max_new_tokens": max_new,
+            "page_size": page, "prefill_chunk": chunk, "model_d": d,
+            "model_depth": depth,
+        }
+        sequences = {}
+        for phase in ("off", "on"):
+            os.environ["PENROZ_PREFIX_CACHE"] = "1" if phase == "on" else "0"
+            decode_scheduler.reset()  # fresh engine (+ cache) per phase
+            # Warm with a DISTINCT prefix: compiles every chunk/decode
+            # program so the timed phase measures serving, not XLA; in the
+            # 'on' phase it also exercises (and does not pollute) the radix
+            # tree — the measured prefix still misses once then hits.
+            await _stream_one(client, payload(warm + suffixes[0]))
+            ttfts, itls, seqs = [], [], []
+            for suffix in suffixes:
+                toks, ttft_ms, gaps = await _stream_one(
+                    client, payload(shared + suffix))
+                ttfts.append(ttft_ms)
+                itls.extend(gaps)
+                seqs.append(toks)
+            sequences[phase] = seqs
+            phase_stats = {
+                "ttft_ms_p50": round(_pct(ttfts, 0.5), 3),
+                "ttft_ms_p99": round(_pct(ttfts, 0.99), 3),
+                "ttft_ms_all": [round(t, 3) for t in ttfts],
+                "itl_ms_p99": (round(_pct(itls, 0.99), 3) if itls else None),
+            }
+            resp = await client.get("/serving_stats/")
+            stats = await resp.json()
+            if phase == "on":
+                phase_stats["hit_rate"] = stats["prefix_cache_hit_rate"]
+                phase_stats["evicted_pages"] = \
+                    stats["prefix_cache_evicted_pages"]
+            phase_stats["prefill_chunk_stall_ms_p99"] = \
+                stats["prefill_chunk_stall_ms_p99"]
+            results[f"prefix_cache_{phase}"] = phase_stats
+        results["parity_ok"] = sequences["off"] == sequences["on"]
+        results["ttft_p50_speedup_on_vs_off"] = round(
+            results["prefix_cache_off"]["ttft_ms_p50"]
+            / results["prefix_cache_on"]["ttft_ms_p50"], 3)
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _emit(results: dict):
+    line = json.dumps(results)
+    print(line)
+    out = os.environ.get("PENROZ_BENCH_JSON_OUT")
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+
+
 def main():
-    concurrency = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    max_new = int(sys.argv[2]) if len(sys.argv) > 2 else 48
-    block = int(os.environ.get("PENROZ_BENCH_SERVING_BLOCK", "256"))
+    args = [a for a in sys.argv[1:] if a != "--shared-prefix"]
+    shared_prefix = len(args) != len(sys.argv) - 1
+    if os.environ.get("PENROZ_BENCH_JSON_OUT"):
+        # resolve before the chdir below so a relative path lands where the
+        # caller (bench_watch.sh) expects it
+        os.environ["PENROZ_BENCH_JSON_OUT"] = os.path.abspath(
+            os.environ["PENROZ_BENCH_JSON_OUT"])
     # Isolated checkpoint dirs: the benchmark must not touch repo models.
     workdir = tempfile.mkdtemp(prefix="penroz_bench_serving_")
     os.chdir(workdir)
-    results = asyncio.run(_bench(concurrency, max_new, block))
-    print(json.dumps(results))
+    if shared_prefix:
+        _emit(asyncio.run(_bench_shared_prefix()))
+        return
+    concurrency = int(args[0]) if len(args) > 0 else 8
+    max_new = int(args[1]) if len(args) > 1 else 48
+    block = int(os.environ.get("PENROZ_BENCH_SERVING_BLOCK", "256"))
+    _emit(asyncio.run(_bench(concurrency, max_new, block)))
 
 
 if __name__ == "__main__":

@@ -59,6 +59,20 @@ while true; do
           -- BENCH_PARTIAL.json >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) partial committed (rc=$rc)" >> logs/bench_watch.log
     fi
+    # Serving-stack capture alongside the training bench: the shared-prefix
+    # workload (chunked prefill + radix prefix cache) emits its own JSON
+    # artifact via PENROZ_BENCH_JSON_OUT.  Opt-in (adds minutes per pass);
+    # failures must not block the main capture.
+    if [ "${PENROZ_WATCH_SHARED_PREFIX:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_SHARED_PREFIX_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --shared-prefix \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_SHARED_PREFIX_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: shared-prefix serving capture" \
+          -- "BENCH_SHARED_PREFIX_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) shared-prefix capture committed" >> logs/bench_watch.log
+    fi
     if [ "$rc" -eq 0 ]; then
       python - "$SNAP" "$attempt" <<'EOF' 2>> logs/bench_watch.log
 import json, sys, time

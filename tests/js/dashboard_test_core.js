@@ -137,6 +137,18 @@ async function runDashboardTests(src, fixtures) {
                `rows ${fixtures.serving.active_rows}/` +
                `${fixtures.serving.capacity}`),
              "serving tile shows batch occupancy rows");
+    assertOk(servingMeta.includes("prefix hits " +
+               (fixtures.serving.prefix_cache_hit_rate * 100).toFixed(0) +
+               "%"),
+             "serving tile shows prefix-cache hit rate");
+    assertOk(servingMeta.includes(
+               `evicted ${fixtures.serving.prefix_cache_evicted_pages} ` +
+               "pages"),
+             "serving tile shows prefix-cache evictions");
+    assertOk(servingMeta.includes("chunk stall p99 " +
+               fixtures.serving.prefill_chunk_stall_ms_p99.toFixed(1) +
+               "ms"),
+             "serving tile shows prefill chunk-stall p99");
     const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
     assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];
@@ -170,6 +182,21 @@ async function runDashboardTests(src, fixtures) {
              "no MoE panel without moe_router_fractions");
     assertOk(document.byId["serving-meta"].textContent.includes("unavailable"),
              "serving tile reports unavailable endpoint without crashing");
+  }
+
+  // 2b. serving stats without prefix-cache fields (cache off / older
+  //     server): tile renders the off state instead of crashing on nulls
+  {
+    const servingOff = Object.assign({}, fixtures.serving, {
+      prefix_cache_hit_rate: null, prefill_chunk_stall_ms_p99: null });
+    const { document } = await runDashboard(src, {
+      progress: fixtures.progress, stats: fixtures.statsPlain,
+      serving: servingOff });
+    const servingMeta = document.byId["serving-meta"].textContent;
+    assertOk(servingMeta.includes("prefix cache off"),
+             "serving tile degrades to 'prefix cache off' on null hit rate");
+    assertOk(servingMeta.includes("chunk stall p99 —"),
+             "serving tile dashes a null chunk-stall p99");
   }
 
   // 3. unknown model: 404 progress renders the error badge, no crash

@@ -948,3 +948,25 @@ def test_flash_kernel_scale_override_value_and_grads():
         err = float(jnp.abs(a - b).max())
         scale = max(float(jnp.abs(b).max()), 1.0)
         assert err <= 2e-4 * scale, f"d{name}: {err}"
+
+
+def test_softcap_reference_fallback_warns_once(monkeypatch):
+    """causal_attention with a logit softcap (Gemma-2 training/prefill)
+    reroutes to the O(T^2) jnp reference — satellite: that fallback must
+    emit the one-time trace-time warning the other fallbacks already emit.
+    Asserted via a logger-method spy, not caplog — other suite tests
+    reconfigure logging handlers, which silently empties caplog (same
+    hazard the parallel-suite tests document)."""
+    import logging
+    monkeypatch.setattr(A, "_WARNED_ONCE", set())
+    warnings = []
+    logger = logging.getLogger("penroz_tpu.ops.attention")
+    monkeypatch.setattr(logger, "warning",
+                        lambda msg, *a: warnings.append(msg % a))
+    q, k, v = _qkv()
+    got = A.causal_attention(q, k, v, softcap=2.0)
+    want = A.causal_attention_reference(q, k, v, softcap=2.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    assert len(warnings) == 1 and "softcap" in warnings[0], warnings
+    A.causal_attention(q, k, v, softcap=2.0)  # one-time: no repeat spam
+    assert len(warnings) == 1

@@ -319,3 +319,164 @@ def test_oversized_request_falls_back_to_legacy_path(client, gpt_model,
     assert len(body["tokens"]) == 19
     status, stats = _json(client, "GET", "/serving_stats/")
     assert stats["engines"] == []  # never touched the scheduler
+
+
+# -- chunked prefill + radix prefix-KV cache (PR 2) --------------------------
+
+@pytest.fixture
+def prefix_env(monkeypatch):
+    """Paged pool + radix prefix cache + small chunks, sized to BLOCK=16
+    toy prompts (page = 4 tokens, cache region = 8 pages)."""
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "4")
+    return monkeypatch
+
+
+def test_chunked_prefill_parity_and_stall_bound(gpt_model, make_engine,
+                                                monkeypatch):
+    """A long prompt admitted mid-flight is prefilled in chunks interleaved
+    with the shared decode steps: both requests keep their standalone
+    greedy streams, and the decode batch is never stalled by more than ONE
+    chunk between consecutive steps (the acceptance bound; the admission
+    latency p50 reflects that interleaving instead of a full-prompt
+    stall)."""
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "2")
+    pa, pb = [5], [9, 10, 11, 12, 13, 14, 15]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 8, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 6, temperature=0.0)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    ca = _submit(engine, pa, 8)
+    deadline = time.monotonic() + 120
+    while ca.received < 2:  # A provably mid-decode before B arrives
+        assert time.monotonic() < deadline, "A never started decoding"
+        try:
+            kind, value = ca.q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        assert kind == "token", kind
+        ca.tokens.append(value)
+        ca.received += 1
+    cb = _submit(engine, pb, 6)
+    assert cb.result() == base_b
+    assert ca.result() == base_a
+    stats = engine.stats()
+    # chunk plans: A = [1], B = [2, 2, 2, 1] (pow-2-bucketed tail)
+    assert stats["prefill_chunks"] == 5
+    # the acceptance bound: at most one chunk ever ran between two decode
+    # steps (PENROZ_SCHED_MAX_STALL_MS defaults to 0)
+    assert stats["prefill_max_chunks_between_steps"] == 1
+    assert stats["prefill_chunk_stall_ms_p99"] is not None
+    assert stats["admission_latency_ms_p50"] is not None
+    assert stats["admission_latency_ms_p50"] > 0
+
+
+def test_chunked_vs_oneshot_prefill_identical(gpt_model, make_engine,
+                                              monkeypatch):
+    """Greedy parity between one-dispatch prefill (chunk >= prompt, pow-2
+    prompt length) and many-chunk prefill of the same prompt."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]  # 8 = one chunk at PENROZ_PREFILL_CHUNK=8
+    base = gpt_model.generate_tokens([prompt], BLOCK, 6, temperature=0.0)
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "8")
+    one_shot = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(one_shot, prompt, 6).result() == base
+    assert one_shot.stats()["prefill_chunks"] == 1
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "2")
+    chunked = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(chunked, prompt, 6).result() == base
+    assert chunked.stats()["prefill_chunks"] == 4
+
+
+def test_prefix_cache_hit_miss_parity(gpt_model, make_engine, prefix_env):
+    """The greedy parity matrix over the radix cache: (miss), (hit on a
+    different suffix), (repeat hit) — every stream token-identical to the
+    standalone path, with the hits aliasing the shared prefix's pages
+    (hit_tokens counts the skipped prefill)."""
+    prefix = [1, 2, 3, 4, 5, 6, 7, 8]          # 2 full pages
+    px, py = prefix + [9, 10], prefix + [11]
+    base_x = gpt_model.generate_tokens([px], BLOCK, 4, temperature=0.0)
+    base_y = gpt_model.generate_tokens([py], BLOCK, 4, temperature=0.0)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, px, 4).result() == base_x   # miss
+    assert _submit(engine, py, 4).result() == base_y   # hit (shared prefix)
+    assert _submit(engine, px, 4).result() == base_x   # repeat hit
+    pc = engine.stats()["prefix_cache"]
+    assert pc["misses"] == 1 and pc["hits"] == 2, pc
+    assert pc["hit_tokens"] == 16  # 2 pages x 4 tokens x 2 hits
+    assert pc["hit_rate"] == pytest.approx(2 / 3)
+
+
+def test_prefix_cache_eviction_then_rematch_parity(gpt_model, make_engine,
+                                                   prefix_env):
+    """Eviction correctness: churn distinct prefixes through a 4-page cache
+    region until the first prefix is LRU-evicted, then resubmit it — the
+    re-prefilled (and re-registered) stream is token-identical."""
+    prefix_env.setenv("PENROZ_PREFIX_CACHE_PAGES", "4")
+    pa = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 4, temperature=0.0)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, pa, 4).result() == base_a
+    for j in range(3):  # 3 distinct 2-page prefixes overflow 4 pages
+        p = [20 + j] * 8 + [j]
+        base = gpt_model.generate_tokens([p], BLOCK, 3, temperature=0.0)
+        assert _submit(engine, p, 3).result() == base
+    pc = engine.stats()["prefix_cache"]
+    assert pc["evicted_pages"] > 0, pc
+    assert _submit(engine, pa, 4).result() == base_a  # evicted → recompute
+    pc = engine.stats()["prefix_cache"]
+    assert pc["capacity_pages"] == 4
+
+
+def test_serving_stats_reports_prefix_and_chunk_fields(client, gpt_model,
+                                                       prefix_env):
+    """/serving_stats/ carries the new observability: prefix-cache hit
+    rate + evictions and the prefill chunk-stall p99, per engine and
+    aggregated (dashboard tile inputs), validated against the schema."""
+    prefix_env.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    payload = _gen_payload(input=[[1, 2, 3, 4, 5, 6, 7, 8, 9]])
+    status, first = _json(client, "POST", "/generate/", json=payload)
+    assert status == 200
+    status, second = _json(client, "POST", "/generate/", json=payload)
+    assert status == 200
+    assert second["tokens"] == first["tokens"]
+    status, stats = _json(client, "GET", "/serving_stats/")
+    assert status == 200
+    assert stats["prefix_cache_hit_rate"] == pytest.approx(0.5)
+    assert stats["prefix_cache_evicted_pages"] == 0
+    assert "prefill_chunk_stall_ms_p99" in stats
+    engine = stats["engines"][0]
+    assert engine["prefill_chunks"] >= 2
+    assert engine["prefix_cache"]["hits"] == 1
+    assert engine["prefix_cache"]["misses"] == 1
+    assert engine["prefix_cache"]["hit_tokens"] == 8
+    assert engine["prefill_max_chunks_between_steps"] <= 1
+
+
+def test_max_stall_budget_runs_multiple_chunks(gpt_model, make_engine,
+                                               monkeypatch):
+    """PENROZ_SCHED_MAX_STALL_MS > 0 trades inter-token latency for
+    admission speed: with a generous budget, several chunks run between
+    decode steps (the default budget of 0 pins that at one)."""
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "1")
+    monkeypatch.setenv("PENROZ_SCHED_MAX_STALL_MS", "60000")
+    pa, pb = [5], [9, 10, 11, 12, 13, 14]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 8, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 4, temperature=0.0)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    ca = _submit(engine, pa, 8)
+    deadline = time.monotonic() + 120
+    while ca.received < 2:
+        assert time.monotonic() < deadline, "A never started decoding"
+        try:
+            kind, value = ca.q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        ca.tokens.append(value)
+        ca.received += 1
+    cb = _submit(engine, pb, 4)
+    assert cb.result() == base_b
+    assert ca.result() == base_a
+    # all 6 of B's 1-token chunks fit one boundary under the huge budget
+    assert engine.stats()["prefill_max_chunks_between_steps"] == 6

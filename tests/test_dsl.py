@@ -354,3 +354,34 @@ def test_gemma3n_refused_loudly():
                           vocab_size=96)
     with pytest.raises(ValueError, match="gemma3n"):
         Mapper.from_hf_config(cfg)
+
+
+def test_configless_bloom_import_refused():
+    """Satellite (ADVICE round 5): the BLOOM key sniff dispatches even
+    without a config, and the mapper then needs cfg.n_head for the
+    per-head QKV de-interleave — config-less it must raise the same kind
+    of descriptive ValueError as the GPT-2 Conv1D sniff, not a bare
+    AttributeError on NoneType."""
+    d = 8
+    sd = {"transformer.word_embeddings.weight": np.zeros((20, d), np.float32),
+          "transformer.word_embeddings_layernorm.weight":
+              np.ones(d, np.float32),
+          "transformer.word_embeddings_layernorm.bias":
+              np.zeros(d, np.float32)}
+    with pytest.raises(ValueError, match="n_head"):
+        Mapper.map_hf_state_dict_to_custom(sd, 1)
+
+
+def test_mpt_norm_bias_checkpoint_refused():
+    """Satellite (ADVICE round 5): every released MptConfig ships
+    weight-only norms and the importer hardcodes bias:False — a variant
+    carrying norm biases must refuse loudly instead of importing silently
+    without them (the family's refuse-loudly contract)."""
+    d = 8
+    sd = {"transformer.wte.weight": np.zeros((20, d), np.float32),
+          "transformer.blocks.0.attn.Wqkv.weight":
+              np.zeros((3 * d, d), np.float32),
+          "transformer.blocks.0.norm_1.weight": np.ones(d, np.float32),
+          "transformer.blocks.0.norm_1.bias": np.zeros(d, np.float32)}
+    with pytest.raises(ValueError, match="bias"):
+        Mapper.map_hf_state_dict_to_custom(sd, 1)

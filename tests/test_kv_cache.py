@@ -419,3 +419,142 @@ def test_quant_paged_reset_and_advance_preserve_type():
     assert isinstance(state, KV.QuantPagedKVState)
     assert int(state.length) == 0
     assert np.all(np.asarray(state.block_table) == -1)
+
+
+# -- chunked prefill row views + radix prefix cache (PR 2) -------------------
+
+@pytest.mark.parametrize("cls,kw", [
+    (KV.KVState, {}),
+    (KV.QuantKVState, {}),
+    (KV.PagedKVState, {"page_size": 4}),
+    (KV.QuantPagedKVState, {"page_size": 4}),
+])
+def test_row_view_merge_row_appends_in_place(cls, kw):
+    """row_view/merge_row — the chunked-prefill substrate: appending a
+    chunk through a batch-1 view of row r and merging back reads exactly
+    like a direct batch-1 prefill of the same tokens, other rows untouched,
+    host lengths untouched.  Works jitted with traced row/length."""
+    import jax
+    specs = [(2, 4), (2, 4)]
+    src, k = _prefilled_single(cls, specs, 8, 3, **kw)
+    batch = cls.create(specs, batch=2, max_len=8, **kw) \
+        .with_static_table().with_lengths([0, 0])
+
+    def chunk_in(b, r, length, k_new, v_new):
+        view = b.row_view(r, length)
+        for layer in range(len(specs)):
+            if isinstance(view, KV.PagedKVState):
+                view.append_rows(layer, k_new, v_new)
+            elif view.quantized:
+                view.append_raw(layer, k_new, v_new)
+            else:
+                view.append(layer, k_new, v_new)
+        return b.merge_row(r, view.advanced(k_new.shape[2]))
+
+    fn = jax.jit(chunk_in, donate_argnums=(0,))
+    # two chunks: tokens [0:2) then [2:3) — same data the one-shot wrote
+    out = fn(batch, jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
+             jnp.asarray(k[:, :, :2]), jnp.asarray(k[:, :, :2]))
+    out = fn(out, jnp.asarray(1, jnp.int32), jnp.asarray(2, jnp.int32),
+             jnp.asarray(k[:, :, 2:3]), jnp.asarray(k[:, :, 2:3]))
+    assert isinstance(out, cls)
+    np.testing.assert_array_equal(np.asarray(out.length), [0, 0])  # host-owned
+    read = (out._gather(out.k[0]) if isinstance(out, KV.PagedKVState)
+            else out.k[0])
+    src_read = (src._gather(src.k[0]) if isinstance(src, KV.PagedKVState)
+                else src.k[0])
+    np.testing.assert_array_equal(np.asarray(read[1:2, :, :3], np.float32),
+                                  np.asarray(src_read[0:1, :, :3],
+                                             np.float32))
+    # row 0 stayed empty (zeros from create)
+    assert float(np.abs(np.asarray(read[0:1, :, :3],
+                                   np.float32)).max()) == 0.0
+
+
+@pytest.mark.parametrize("cls", [KV.PagedKVState, KV.QuantPagedKVState])
+def test_with_row_prefix_aliases_and_restores(cls):
+    """with_row_prefix points a row's leading logical pages at shared
+    (cache-region) physical pages — the row reads the shared KV without a
+    copy; restore_row_table re-bases the row on its static partition."""
+    specs = [(1, 4)]
+    kv = cls.create(specs, batch=2, max_len=8, page_size=4, pool_pages=6) \
+        .with_static_table().with_lengths([0, 0])
+    # write a distinctive page through row 0, then copy it into the cache
+    # region (pages 4..5 are beyond the static partition of 2 rows x 2)
+    view = kv.row_view(0, 0)
+    seven = 7 * jnp.ones((1, 1, 4, 4))
+    view.append_rows(0, seven, seven)
+    kv = kv.merge_row(0, view.advanced(4))
+    kv = kv.copy_pages([0], [4])
+    kv = kv.with_row_prefix(1, [4])
+    np.testing.assert_array_equal(np.asarray(kv.block_table),
+                                  [[0, 1], [4, 3]])
+    read = np.asarray(kv._gather(kv.k[0]), np.float32)
+    src = np.asarray(kv._gather(kv.k[0]), np.float32)[0, :, :4]
+    np.testing.assert_array_equal(read[1, :, :4], src)  # aliased == source
+    kv = kv.restore_row_table(1)
+    np.testing.assert_array_equal(np.asarray(kv.block_table),
+                                  [[0, 1], [2, 3]])
+    with pytest.raises(ValueError, match="pages_per_seq"):
+        kv.with_row_prefix(0, [4, 5, 4])
+
+
+def test_radix_prefix_cache_match_insert_lru_pin():
+    """RadixPrefixCache: page-granular longest-prefix match, whole-page
+    inserts, LRU leaf eviction, and refcount pinning (a pinned page — one a
+    live row aliases — survives allocation pressure)."""
+    c = KV.RadixPrefixCache(pages=[10, 11, 12], page_size=4)
+    a = list(range(12))           # 3 full pages
+    assert c.match(a) == [] and c.misses == 1
+    assert [b for b, _ in c.insert(a)] == [0, 1, 2]
+    # limit caps the usable match (admission passes len(prompt)-1)
+    nodes = c.match(a, limit=len(a) - 1)
+    assert [n.page for n in nodes] == [10, 11]
+    assert c.hits == 1 and c.hit_tokens == 8
+    c.pin(nodes)
+    # allocation pressure: a distinct 3-page chain can only take the one
+    # unpinned page; the pinned chain survives
+    b = list(range(100, 112))
+    created = c.insert(b)
+    assert len(created) == 1 and created[0][0] == 0
+    assert c.evicted_pages == 1
+    assert [n.page for n in c.match(a, limit=len(a) - 1)] == [10, 11]
+    c.unpin(nodes)
+    created = c.insert(b)          # now the old chain's pages are fair game
+    assert [bi for bi, _ in created] == [1, 2]
+    assert c.evicted_pages == 3
+    assert c.match(a) == []        # evicted → full recompute on next admit
+    stats = c.stats()
+    assert stats["capacity_pages"] == 3 and stats["cached_pages"] == 3
+    assert 0.0 <= stats["hit_rate"] <= 1.0
+    c.clear()
+    assert c.cached_pages == 0 and c.match(b) == []
+
+
+def test_radix_insert_never_evicts_its_own_chain():
+    """A pool smaller than one prompt's page count must not recycle a page
+    it handed out two blocks earlier in the SAME insert (the caller would
+    copy two different blocks into one page): the chain is pinned while it
+    is built, so insert stops early instead."""
+    c = KV.RadixPrefixCache(pages=[5, 6], page_size=2)
+    created = c.insert(list(range(10)))  # 5 blocks, 2 pages
+    assert [b for b, _ in created] == [0, 1]
+    pages = [p for _, p in created]
+    assert len(set(pages)) == len(pages)
+    assert c.evicted_pages == 0
+
+
+def test_create_kv_state_extra_pool_pages(monkeypatch):
+    """The factory reserves extra_pool_pages beyond the per-row partition
+    (the prefix-cache region) on paged variants and ignores it for
+    contiguous layouts."""
+    monkeypatch.setenv(KV.PAGED_ENV, "1")
+    monkeypatch.setenv(KV.PAGE_SIZE_ENV, "4")
+    state = KV.create_kv_state([(1, 4)], batch=2, max_len=8,
+                               extra_pool_pages=3)
+    assert isinstance(state, KV.PagedKVState)
+    assert state.num_pool_pages == 2 * 2 + 3
+    monkeypatch.setenv(KV.PAGED_ENV, "0")
+    state = KV.create_kv_state([(1, 4)], batch=2, max_len=8,
+                               extra_pool_pages=3)
+    assert type(state) is KV.KVState

@@ -182,7 +182,11 @@ class Downloader:
     def download(self, path: str, name: str | None = None,
                  split: str = "train"):
         """Download + tokenize + write fixed-size uint16 shards (the final
-        partial shard is also flushed)."""
+        partial shard is also flushed).  One attempt — bounded retry with
+        backoff lives in the API layer (serve/app.py download task), which
+        also surfaces terminal failure to clients."""
+        from penroz_tpu.utils import faults
+        faults.check("data.download")
         import datasets
         ds = datasets.load_dataset(path, name, split=split)
         os.makedirs(DATA_FOLDER, exist_ok=True)

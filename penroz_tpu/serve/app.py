@@ -149,7 +149,17 @@ async def import_from_huggingface(request: web.Request):
 async def list_dataset(request: web.Request):
     dataset_id = _query_param(request, "dataset_id")
     log.info("Requesting list of files for dataset %s", dataset_id)
-    return _json({"files": Loader(dataset_id).list()})
+    # "download" is additive (None when no download ran this process):
+    # clients polling after a 202 can see "downloading" / "complete" /
+    # terminal "failed" + error instead of tailing server logs.
+    return _json({"files": Loader(dataset_id).list(),
+                  "download": download_status.get(dataset_id)})
+
+
+# Terminal download outcomes per dataset id, surfaced through GET /dataset/
+# — the background task must not swallow failures into the log where no
+# client can see them (PR 3 satellite).
+download_status: Dict[str, dict] = {}
 
 
 async def download_dataset(request: web.Request):
@@ -161,14 +171,31 @@ async def download_dataset(request: web.Request):
         return _json({"detail": f"Downloading dataset {dataset_id} already in progress."},
                      status=409)
     downloader = Downloader(dataset_id, body.shard_size, body.encoding)
+    attempts = max(1, int(os.environ.get("PENROZ_DOWNLOAD_RETRIES", "3")))
+    backoff_s = float(os.environ.get("PENROZ_DOWNLOAD_BACKOFF_S", "1.0"))
 
     async def download():
         async with lock:
-            try:
-                await _run_blocking(downloader.download, body.path, body.name,
-                                    body.split)
-            except Exception:  # noqa: BLE001
-                log.exception("Dataset %s download failed", dataset_id)
+            status = download_status[dataset_id] = {
+                "state": "downloading", "attempts": 0, "error": None}
+            for attempt in range(1, attempts + 1):
+                status["attempts"] = attempt
+                try:
+                    await _run_blocking(downloader.download, body.path,
+                                        body.name, body.split)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("Dataset %s download attempt %d/%d failed",
+                                  dataset_id, attempt, attempts)
+                    status["error"] = f"{type(e).__name__}: {e}"
+                    if attempt < attempts:
+                        await asyncio.sleep(backoff_s * 2 ** (attempt - 1))
+                else:
+                    status["state"] = "complete"
+                    status["error"] = None
+                    return
+            status["state"] = "failed"
+            log.error("Dataset %s download failed terminally after %d "
+                      "attempt(s)", dataset_id, attempts)
 
     asyncio.get_running_loop().create_task(download())
     return _json({"message": f"Downloading Dataset {dataset_id} asynchronously."},
@@ -209,11 +236,33 @@ async def evaluate_model(request: web.Request):
     return _json({"cost": cost})
 
 
+def _shed_response(exc) -> web.Response:
+    """Map scheduler shed exceptions to their HTTP statuses: queue full →
+    429 + Retry-After, deadline exceeded → 504, circuit open → 503 +
+    Retry-After (fault-tolerance contract, serve/decode_scheduler.py)."""
+    from penroz_tpu.serve import decode_scheduler
+    if isinstance(exc, decode_scheduler.QueueFullError):
+        return web.json_response({"detail": f"Server overloaded: {exc}"},
+                                 status=429, headers={"Retry-After": "1"})
+    if isinstance(exc, decode_scheduler.DeadlineExceeded):
+        return _json({"detail": f"Deadline exceeded: {exc}"}, status=504)
+    assert isinstance(exc, decode_scheduler.CircuitOpenError), exc
+    return web.json_response({"detail": f"Service unavailable: {exc}"},
+                             status=503, headers={"Retry-After": "1"})
+
+
 async def _try_scheduler_generate(request: web.Request, body):
     """Serve /generate/ through the continuous-batching scheduler when
     enabled and eligible; returns a Response or None (→ legacy path).
     The whole point: K concurrent requests share one batch-K decode step
-    per token instead of K batch-1 programs (serve/decode_scheduler.py)."""
+    per token instead of K batch-1 programs (serve/decode_scheduler.py).
+
+    Overload/failure mapping: queue-full → 429, deadline → 504, open
+    circuit breaker → 503 (or the legacy path when
+    PENROZ_SCHED_FALLBACK=1 — degraded service beats none).  A client
+    disconnect cancels this handler (non-streaming) or fails the stream
+    write; both set ``req.cancelled`` so the abandoned row frees its KV
+    slot and prefix pins at the next step boundary."""
     from penroz_tpu.serve import decode_scheduler
     if not decode_scheduler.enabled():
         return None
@@ -225,24 +274,55 @@ async def _try_scheduler_generate(request: web.Request, body):
         body.model_id, body.block_size, body.temperature, body.top_k)
     if engine is None:  # registry at capacity with nothing evictable
         return None
-    if body.stream:
+    try:
+        if not body.stream:
+            tokens = await decode_scheduler.run_request(
+                engine, prompt, body.max_new_tokens, body.stop_token,
+                body.timeout_ms)
+            return _json({"tokens": tokens})
         log.info("Streaming token generation for model %s via the "
                  "continuous-batching scheduler", body.model_id)
-        response = web.StreamResponse(
-            headers={"Content-Type": "text/plain; charset=utf-8"})
-        await response.prepare(request)
-        try:
-            async for token in decode_scheduler.stream_request(
-                    engine, prompt, body.max_new_tokens, body.stop_token):
-                await response.write(f"{token}\n".encode())
-        except Exception:  # noqa: BLE001 — headers already out; end + log
-            log.exception("Scheduler streaming failed for model %s",
-                          body.model_id)
-        await response.write_eof()
-        return response
-    tokens = await decode_scheduler.run_request(
-        engine, prompt, body.max_new_tokens, body.stop_token)
-    return _json({"tokens": tokens})
+        # submit BEFORE prepare: shed paths (429/503/504-queued) still get
+        # their real status line instead of a broken 200 stream
+        req, queue = decode_scheduler.start_stream(
+            engine, prompt, body.max_new_tokens, body.stop_token,
+            body.timeout_ms)
+    except decode_scheduler.CircuitOpenError as exc:
+        if decode_scheduler.fallback_enabled():
+            log.warning("Scheduler circuit open for model %s; falling back "
+                        "to the single-sequence path", body.model_id)
+            return None
+        return _shed_response(exc)
+    except (decode_scheduler.QueueFullError,
+            decode_scheduler.DeadlineExceeded) as exc:
+        return _shed_response(exc)
+    response = web.StreamResponse(
+        headers={"Content-Type": "text/plain; charset=utf-8"})
+    await response.prepare(request)
+    try:
+        while True:
+            kind, value = await queue.get()
+            if kind == "token":
+                await response.write(f"{value}\n".encode())
+            elif kind == "done":
+                break
+            elif kind == "timeout":
+                # deadline hit mid-stream: tokens so far were delivered;
+                # a final non-numeric event line ends the stream honestly
+                await response.write(b"timeout\n")
+                break
+            else:
+                raise value
+    except asyncio.CancelledError:
+        # aiohttp cancels the handler on client disconnect — free the row
+        req.cancelled = True
+        raise
+    except Exception:  # noqa: BLE001 — headers already out; end + log
+        req.cancelled = True
+        log.exception("Scheduler streaming failed for model %s",
+                      body.model_id)
+    await response.write_eof()
+    return response
 
 
 async def model_generate(request: web.Request):
@@ -315,11 +395,30 @@ async def model_generate_batch(request: web.Request):
             from penroz_tpu.models.model import validate_batch_generation
             validate_batch_generation(prompts, body.block_size,
                                       body.max_new_tokens)
-            sequences = await asyncio.gather(*[
-                decode_scheduler.run_request(engine, p, body.max_new_tokens,
-                                             body.stop_token)
-                for p in prompts])
-            return _json({"sequences": sequences})
+            # return_exceptions: a shed row (429/504/503) must not leave
+            # its siblings decoding into a dropped response — every row
+            # settles, then the batch answers as one.
+            results = await asyncio.gather(*[
+                decode_scheduler.run_request(
+                    engine, p, body.max_new_tokens, body.stop_token,
+                    body.timeout_ms)
+                for p in prompts], return_exceptions=True)
+            errors = [r for r in results if isinstance(r, BaseException)]
+            if not errors:
+                return _json({"sequences": results})
+            shed = next((e for e in errors if isinstance(
+                e, (decode_scheduler.QueueFullError,
+                    decode_scheduler.DeadlineExceeded,
+                    decode_scheduler.CircuitOpenError))), None)
+            if shed is None:
+                raise errors[0]
+            if (isinstance(shed, decode_scheduler.CircuitOpenError)
+                    and decode_scheduler.fallback_enabled()):
+                log.warning("Scheduler circuit open for model %s; batch "
+                            "falls back to the legacy path", body.model_id)
+                # falls through to the legacy batched path below
+            else:
+                return _shed_response(shed)
     model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
     sequences = await _run_blocking(
         lambda: model.generate_tokens_batched(
@@ -436,6 +535,35 @@ async def serving_stats(request: web.Request):
         stats).model_dump())
 
 
+async def healthz(request: web.Request):
+    """Liveness: the event loop is alive and answering.  Always 200 — an
+    open circuit breaker is a readiness problem, not a liveness one
+    (restarting the process would not fix a crashing model)."""
+    return _json({"status": "ok"})
+
+
+async def readyz(request: web.Request):
+    """Readiness: 503 while any engine's circuit breaker is open or the
+    server is draining for shutdown — load balancers stop routing here
+    while the scheduler path cannot serve."""
+    from penroz_tpu.serve import decode_scheduler
+    breaker_open = decode_scheduler.breaker_open_engines()
+    draining = decode_scheduler.draining()
+    ready = not breaker_open and not draining
+    return _json({"ready": ready, "draining": draining,
+                  "breaker_open_engines": breaker_open},
+                 status=200 if ready else 503)
+
+
+async def _drain_on_shutdown(app: web.Application):
+    """Graceful shutdown: stop admission, let in-flight decode rows finish
+    within PENROZ_DRAIN_S, then join every engine worker thread (leaks are
+    reported, not ignored — DecodeEngine.shutdown returns False)."""
+    from penroz_tpu.serve import decode_scheduler
+    await asyncio.get_running_loop().run_in_executor(
+        None, decode_scheduler.drain_and_shutdown)
+
+
 async def delete_model(request: web.Request):
     model_id = _query_param(request, "model_id")
     log.info("Requesting deletion of model %s", model_id)
@@ -498,7 +626,10 @@ def create_app() -> web.Application:
     _sweep_orphaned_training()
     app = web.Application(middlewares=[error_middleware, gzip_middleware],
                           client_max_size=1024 ** 3)
+    app.on_shutdown.append(_drain_on_shutdown)
     app.router.add_get("/", redirect_to_dashboard)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", readyz)
     app.router.add_get("/dashboard", dashboard)
     app.router.add_get("/openapi.json", openapi_json)
     app.router.add_get("/docs", docs)

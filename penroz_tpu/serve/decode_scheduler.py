@@ -29,6 +29,41 @@ rows are KV-cache slots (paged pool pages when ``PAGED_KV_CACHE=1``):
   prefill (tested — the chunked program family is the same
   cached-attention path, reading the same absolute positions).
 
+Fault tolerance (PR 3) — overload and failure are scheduler features, not
+error-handler afterthoughts:
+
+- **Deadlines**: per-request ``timeout_ms`` (server-capped by
+  ``PENROZ_REQ_TIMEOUT_MS``; 0/unset = off) is enforced while queued (the
+  request is shed with a ``timeout`` event before prefill starts → HTTP
+  504) and in flight (the row retires at the next step boundary and the
+  stream ends with a ``timeout`` event).
+- **Backpressure**: ``PENROZ_SCHED_MAX_QUEUE`` bounds the admission queue;
+  a full queue rejects ``submit`` with :class:`QueueFullError` (→ HTTP 429
+  + ``Retry-After``) instead of queueing forever.
+- **Crash recovery**: a failed tick fails every waiting request with a
+  clean error AND fully resets the engine — fresh KV allocation, fresh
+  prefix cache, clean block tables — so the next request decodes from
+  provably uncorrupted state (greedy-identical to the no-crash path,
+  tested under injected ``decode.step`` / ``decode.prefill_chunk``
+  faults).
+- **Circuit breaker**: ``PENROZ_ENGINE_MAX_CRASHES`` consecutive crashes
+  (no successfully completed request in between) open a per-engine
+  breaker: ``submit`` raises :class:`CircuitOpenError` (→ HTTP 503, or the
+  legacy single-sequence path when ``PENROZ_SCHED_FALLBACK=1``) until
+  ``PENROZ_BREAKER_COOLDOWN_MS`` elapses, then ONE probe request is
+  admitted; its success closes the breaker, its failure re-arms the
+  cooldown.  ``/readyz`` reports not-ready while any breaker is open.
+- **Cancellation**: ``req.cancelled`` (client disconnect) frees the row
+  and its prefix pins at the next boundary; queued cancelled requests are
+  purged without ever prefilling.
+- **Graceful shutdown**: ``drain_and_shutdown`` stops admission, lets
+  in-flight rows finish within ``PENROZ_DRAIN_S``, then joins the worker
+  thread — ``shutdown`` returns False (and logs) if the thread leaks.
+
+All of the above is deterministically testable through
+``penroz_tpu/utils/faults.py`` (``PENROZ_FAULT_INJECT`` —
+``decode.step:raise@N`` / ``decode.step:sleep@MS`` sites inside the tick).
+
 Enabled by routing: serve/app.py sends eligible ``/generate/`` and
 ``/generate_batch/`` traffic here when ``PENROZ_CONTINUOUS_BATCHING=1``.
 Knobs: ``PENROZ_SCHED_MAX_ROWS`` (decode batch capacity, default 8),
@@ -62,7 +97,7 @@ import numpy as np
 from penroz_tpu.models import model as model_mod
 from penroz_tpu.models.model import NeuralNetworkModel
 from penroz_tpu.ops import kv_cache as KV
-from penroz_tpu.utils import checkpoint, profiling
+from penroz_tpu.utils import checkpoint, faults, profiling
 
 log = logging.getLogger(__name__)
 
@@ -72,13 +107,43 @@ ADMIT_MS_ENV = "PENROZ_SCHED_ADMIT_MS"
 MAX_ENGINES_ENV = "PENROZ_SCHED_MAX_ENGINES"
 PREFILL_CHUNK_ENV = "PENROZ_PREFILL_CHUNK"
 MAX_STALL_MS_ENV = "PENROZ_SCHED_MAX_STALL_MS"
+REQ_TIMEOUT_ENV = "PENROZ_REQ_TIMEOUT_MS"
+MAX_QUEUE_ENV = "PENROZ_SCHED_MAX_QUEUE"
+MAX_CRASHES_ENV = "PENROZ_ENGINE_MAX_CRASHES"
+FALLBACK_ENV = "PENROZ_SCHED_FALLBACK"
+BREAKER_COOLDOWN_ENV = "PENROZ_BREAKER_COOLDOWN_MS"
+DRAIN_S_ENV = "PENROZ_DRAIN_S"
 
 # Sliding window for the tokens/sec stat (seconds).
 _TPS_WINDOW_S = 30.0
 
 
+class QueueFullError(RuntimeError):
+    """Admission queue at PENROZ_SCHED_MAX_QUEUE — shed the request (429)."""
+
+
+class CircuitOpenError(RuntimeError):
+    """Engine circuit breaker open after repeated crashes (503, or the
+    legacy path with PENROZ_SCHED_FALLBACK=1)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request deadline (timeout_ms / PENROZ_REQ_TIMEOUT_MS) expired (504).
+
+    ``phase`` is ``"queued"`` (shed before prefill started) or
+    ``"inflight"`` (row retired at a step boundary mid-generation)."""
+
+    def __init__(self, phase: str, detail: str):
+        super().__init__(detail)
+        self.phase = phase
+
+
 def enabled() -> bool:
     return os.environ.get(ENABLE_ENV, "0") == "1"
+
+
+def fallback_enabled() -> bool:
+    return os.environ.get(FALLBACK_ENV, "0") == "1"
 
 
 def _env_int(name: str, default: int, lo: int = 1) -> int:
@@ -119,6 +184,35 @@ def _max_stall_ms() -> float:
     return _env_float(MAX_STALL_MS_ENV, 0.0)
 
 
+def _max_queue() -> int:
+    """Admission queue bound (0 = unbounded, the pre-PR-3 behavior)."""
+    return _env_int(MAX_QUEUE_ENV, 0, lo=0)
+
+
+def _max_crashes() -> int:
+    return _env_int(MAX_CRASHES_ENV, 3)
+
+
+def _breaker_cooldown_ms() -> float:
+    return _env_float(BREAKER_COOLDOWN_ENV, 1000.0)
+
+
+def _drain_s() -> float:
+    return _env_float(DRAIN_S_ENV, 5.0)
+
+
+def _effective_timeout_ms(timeout_ms) -> float | None:
+    """Deadline budget for one request: the client's ``timeout_ms`` capped
+    by the server-wide ``PENROZ_REQ_TIMEOUT_MS`` (which also applies to
+    requests that asked for no deadline).  None = no deadline (both off,
+    the default)."""
+    cap = _env_float(REQ_TIMEOUT_ENV, 0.0)
+    t = float(timeout_ms) if timeout_ms else 0.0
+    if cap > 0:
+        t = min(t, cap) if t > 0 else cap
+    return t if t > 0 else None
+
+
 def _chunk_plan(n: int, chunk: int) -> list[int]:
     """Chunk sizes covering ``n`` prefill tokens: fixed ``chunk``-size
     pieces, then a descending power-of-two decomposition of the remainder —
@@ -144,21 +238,31 @@ class Request:
 
     ``on_event(kind, value)`` is invoked FROM THE SCHEDULER THREAD with
     ``("token", int)`` per generated token (stop token included, matching
-    ``generate_tokens``), then ``("done", None)`` — or ``("error", exc)``.
-    Consumers bridge to their own concurrency world (asyncio queue, thread
-    queue); setting ``cancelled`` retires the row at the next boundary.
+    ``generate_tokens``), then ``("done", None)`` — or ``("error", exc)``,
+    or ``("timeout", DeadlineExceeded)`` when the request's deadline
+    expires (queued or in flight).  Consumers bridge to their own
+    concurrency world (asyncio queue, thread queue); setting ``cancelled``
+    retires the row at the next boundary.
     """
 
     __slots__ = ("prompt", "max_new_tokens", "stop_token", "on_event",
-                 "enqueue_t", "cancelled")
+                 "enqueue_t", "cancelled", "deadline")
 
-    def __init__(self, prompt, max_new_tokens, stop_token, on_event):
+    def __init__(self, prompt, max_new_tokens, stop_token, on_event,
+                 timeout_ms=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.stop_token = stop_token
         self.on_event = on_event
         self.enqueue_t = time.monotonic()
         self.cancelled = False
+        budget = _effective_timeout_ms(timeout_ms)
+        self.deadline = (self.enqueue_t + budget / 1000.0
+                         if budget is not None else None)
+
+    def expired(self, now: float | None = None) -> bool:
+        return (self.deadline is not None
+                and (now or time.monotonic()) >= self.deadline)
 
 
 class _Row:
@@ -202,37 +306,31 @@ class DecodeEngine:
 
         self._model = NeuralNetworkModel.deserialize(model_id)
         self._ckpt_stamp_v = self._ckpt_stamp()
-        extra_pages = 0
+        self._extra_pages = 0
         if KV.prefix_cache_enabled():
             if KV.paged_enabled():
-                extra_pages = KV.prefix_cache_pages()
+                self._extra_pages = KV.prefix_cache_pages()
             else:
                 log.warning(
                     "%s=1 ignored: prefix-KV sharing is page-granular and "
                     "needs PAGED_KV_CACHE=1", KV.PREFIX_CACHE_ENV)
-        self._kv = (KV.create_kv_state(self._model.arch.kv_specs,
-                                       self.capacity, self.block_size,
-                                       self._model._kv_dtype(),
-                                       extra_pool_pages=extra_pages)
-                    .with_static_table()
-                    .with_lengths(np.zeros(self.capacity, np.int32)))
-        # Radix prefix cache over the reserved pool tail: pages
-        # [capacity * pages_per_seq, num_pool_pages) are never touched by
-        # the static per-row partition, so they are exclusively the radix
-        # tree's to hand out.
-        self._prefix_cache = None
-        if extra_pages > 0 and isinstance(self._kv, KV.PagedKVState):
-            base = self.capacity * self._kv.pages_per_seq
-            self._prefix_cache = KV.RadixPrefixCache(
-                list(range(base, self._kv.num_pool_pages)),
-                self._kv.page_size)
         self._lengths = np.zeros(self.capacity, np.int32)
         self._last_tok = np.zeros(self.capacity, np.int32)
         self._rows: list = [None] * self.capacity
+        self._alloc_state()
 
         self._pending: collections.deque = collections.deque()
         self._cond = threading.Condition()
         self._shutdown = False
+        self._draining = False
+
+        # circuit breaker (written under _cond by submit / the worker)
+        self._breaker_open = False
+        self._breaker_open_t = 0.0
+        self._probe_inflight = False
+        self._crashes = 0          # consecutive, since last completed req
+        self._crashes_total = 0
+        self._engine_resets = 0
 
         self._rng = jax.random.key(0)
         self._dispatch = 0
@@ -246,7 +344,11 @@ class DecodeEngine:
         self._decode_time_s = 0.0
         self._occupancy_sum = 0.0
         self._admit_lat_ms: collections.deque = collections.deque(maxlen=256)
+        self._queue_wait_ms: collections.deque = collections.deque(maxlen=512)
         self._token_window: collections.deque = collections.deque()
+        self._queue_rejections = 0
+        self._breaker_rejections = 0
+        self._deadline_timeouts = 0
         self._prefill_chunks = 0
         # decode-batch stall injected per step boundary by interleaved
         # prefill chunks (only sampled while decode rows are in flight —
@@ -261,20 +363,90 @@ class DecodeEngine:
             name=f"penroz-sched-{model_id}-{self.block_size}")
         self._thread.start()
 
+    def _alloc_state(self):
+        """(Re)allocate the engine's device-facing state from scratch:
+        the multi-row KV buffers, the static block-table partition, and
+        the radix prefix cache over the reserved pool tail (pages
+        [capacity * pages_per_seq, num_pool_pages) are never touched by
+        the static per-row partition, so they are exclusively the radix
+        tree's to hand out).  Used at construction AND by crash recovery —
+        after a failed tick the old KV/prefix state is presumed corrupt
+        and nothing from it survives."""
+        self._kv = (KV.create_kv_state(self._model.arch.kv_specs,
+                                       self.capacity, self.block_size,
+                                       self._model._kv_dtype(),
+                                       extra_pool_pages=self._extra_pages)
+                    .with_static_table()
+                    .with_lengths(np.zeros(self.capacity, np.int32)))
+        self._prefix_cache = None
+        if self._extra_pages > 0 and isinstance(self._kv, KV.PagedKVState):
+            base = self.capacity * self._kv.pages_per_seq
+            self._prefix_cache = KV.RadixPrefixCache(
+                list(range(base, self._kv.num_pool_pages)),
+                self._kv.page_size)
+        self._lengths[:] = 0
+        self._last_tok[:] = 0
+        self._rows = [None] * self.capacity
+
     # -- public surface -----------------------------------------------------
 
     def submit(self, req: Request):
+        """Enqueue ``req`` or refuse it NOW: shedding happens at the door
+        (bounded queue, open breaker, draining engine) so clients get an
+        immediate, typed answer instead of a stalled connection."""
         with self._cond:
-            if self._shutdown:
+            if self._shutdown or self._draining:
                 raise RuntimeError("decode engine is shut down")
+            if self._breaker_open:
+                cooldown_done = (time.monotonic() >= self._breaker_open_t
+                                 + _breaker_cooldown_ms() / 1000.0)
+                if self._probe_inflight or not cooldown_done:
+                    self._breaker_rejections += 1
+                    raise CircuitOpenError(
+                        f"engine {self.model_id}: circuit breaker open "
+                        f"after {self._crashes} consecutive crashes")
+                # Half-open: exactly one probe request goes through; its
+                # completion closes the breaker (_retire), its failure
+                # re-arms the cooldown (_fail_all).
+                self._probe_inflight = True
+            max_queue = _max_queue()
+            if max_queue and len(self._pending) >= max_queue:
+                self._queue_rejections += 1
+                raise QueueFullError(
+                    f"engine {self.model_id}: admission queue full "
+                    f"({max_queue} waiting)")
             self._pending.append(req)
             self._cond.notify_all()
 
-    def shutdown(self, timeout: float = 10.0):
+    def shutdown(self, timeout: float = 10.0, drain_s: float = 0.0) -> bool:
+        """Stop the engine; returns True iff the worker thread joined.
+
+        ``drain_s > 0`` first stops admission (``_draining``) and gives
+        in-flight rows that long to finish before the hard stop — the
+        graceful path ``drain_and_shutdown`` uses at server shutdown.
+        A thread that fails to join within ``timeout`` is reported
+        (False + log) instead of silently leaked."""
+        if drain_s > 0:
+            with self._cond:
+                self._draining = True
+                self._cond.notify_all()
+            deadline = time.monotonic() + drain_s
+            while self.active_rows and time.monotonic() < deadline:
+                time.sleep(0.01)
+            if self.active_rows:
+                log.warning(
+                    "Decode engine %s: %d row(s) still in flight after "
+                    "%.1fs drain; failing them", self.model_id,
+                    self.active_rows, drain_s)
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            log.error("Decode engine %s: worker thread failed to join "
+                      "within %.1fs (leaked)", self.model_id, timeout)
+            return False
+        return True
 
     @property
     def active_rows(self) -> int:
@@ -299,7 +471,17 @@ class DecodeEngine:
         lat = sorted(self._admit_lat_ms)
         active = self.active_rows
         stall_p99 = _p99(self._chunk_stall_ms)
+        queue_wait_p99 = _p99(self._queue_wait_ms)
         return {
+            "queue_rejections": self._queue_rejections,
+            "deadline_timeouts": self._deadline_timeouts,
+            "breaker_rejections": self._breaker_rejections,
+            "queue_wait_ms_p99": (round(queue_wait_p99, 3)
+                                  if queue_wait_p99 is not None else None),
+            "breaker_open": self._breaker_open,
+            "consecutive_crashes": self._crashes,
+            "crashes_total": self._crashes_total,
+            "engine_resets": self._engine_resets,
             "model_id": self.model_id,
             "block_size": self.block_size,
             "temperature": 0.0 if self.greedy else float(self.temperature),
@@ -338,6 +520,7 @@ class DecodeEngine:
                 if self._shutdown:
                     break
             try:
+                self._purge_expired()
                 self._coalesce_burst()
                 self._admit()
                 self._prefill_tick()
@@ -345,8 +528,60 @@ class DecodeEngine:
                     self._step()
             except Exception as exc:  # noqa: BLE001 — fail requests, not thread
                 log.exception("Decode engine %s failed a tick", self.model_id)
+                self._record_crash()
                 self._fail_all(exc)
+                try:
+                    # Full reset: the exception left KV/prefix state in an
+                    # unknown shape — reallocate so the NEXT request runs
+                    # against provably clean buffers and block tables.
+                    self._engine_resets += 1
+                    self._alloc_state()
+                    log.warning("Decode engine %s reset after crash %d "
+                                "(consecutive %d)", self.model_id,
+                                self._crashes_total, self._crashes)
+                except Exception:  # noqa: BLE001 — can't trust the engine
+                    log.exception("Decode engine %s reset FAILED; opening "
+                                  "circuit breaker", self.model_id)
+                    with self._cond:
+                        self._breaker_open = True
+                        self._breaker_open_t = time.monotonic()
         self._fail_all(RuntimeError("decode engine shut down"))
+
+    def _record_crash(self):
+        with self._cond:
+            self._crashes += 1
+            self._crashes_total += 1
+            if self._crashes >= _max_crashes() and not self._breaker_open:
+                self._breaker_open = True
+                self._breaker_open_t = time.monotonic()
+                log.error(
+                    "Decode engine %s: circuit breaker OPEN after %d "
+                    "consecutive crashes (next probe in %.0fms)",
+                    self.model_id, self._crashes, _breaker_cooldown_ms())
+
+    def _purge_expired(self):
+        """Shed queued requests whose deadline passed (504 before prefill
+        ever starts) and silently drop cancelled ones (disconnected
+        clients must not spend a prefill)."""
+        now = time.monotonic()
+        expired = []
+        with self._cond:
+            if not self._pending:
+                return
+            keep: collections.deque = collections.deque()
+            for req in self._pending:
+                if req.cancelled:
+                    continue
+                if req.expired(now):
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            self._pending = keep
+        for req in expired:
+            self._deadline_timeouts += 1
+            self._deliver(req, "timeout", DeadlineExceeded(
+                "queued", "request deadline expired while queued "
+                "(before prefill started)"))
 
     def _coalesce_burst(self):
         """Optional idle-burst coalescing: when the batch is empty, wait up
@@ -383,10 +618,16 @@ class DecodeEngine:
             if row is None:
                 return
             with self._cond:
-                if not self._pending:
+                if self._draining or not self._pending:
                     return
                 req = self._pending.popleft()
             if req.cancelled:
+                continue
+            if req.expired():
+                self._deadline_timeouts += 1
+                self._deliver(req, "timeout", DeadlineExceeded(
+                    "queued", "request deadline expired while queued "
+                    "(before prefill started)"))
                 continue
             if self.active_rows == 0:
                 self._maybe_reload()
@@ -427,6 +668,8 @@ class DecodeEngine:
         self._lengths[row] = state.prefilled
         self._last_tok[row] = 0
         self._admissions += 1
+        self._queue_wait_ms.append(
+            (time.monotonic() - req.enqueue_t) * 1000.0)
 
     def _next_prefill_row(self):
         """FIFO over prefilling rows (earliest enqueue first) so chunk
@@ -471,6 +714,13 @@ class DecodeEngine:
         if req.cancelled:
             self._retire(row, notify=False)
             return
+        if req.expired():
+            self._deadline_timeouts += 1
+            self._retire(row, notify=False)
+            self._deliver(req, "timeout", DeadlineExceeded(
+                "inflight", "request deadline expired during prefill"))
+            return
+        faults.check("decode.prefill_chunk")
         size = state.chunks[state.chunk_idx]
         start = state.prefilled
         rng = jax.random.fold_in(self._rng, self._dispatch)
@@ -514,6 +764,7 @@ class DecodeEngine:
                 [page for _, page in created])
 
     def _step(self):
+        faults.check("decode.step")
         t0 = time.monotonic()
         rng = jax.random.fold_in(self._rng, self._dispatch)
         self._dispatch += 1
@@ -557,6 +808,16 @@ class DecodeEngine:
         if state.produced >= req.max_new_tokens:
             self._retire(row)
             return
+        if req.expired():
+            # Deadline passed mid-generation: retire at this step boundary
+            # and end the stream with a timeout event (tokens so far were
+            # already delivered).
+            self._deadline_timeouts += 1
+            self._retire(row, notify=False)
+            self._deliver(req, "timeout", DeadlineExceeded(
+                "inflight", f"request deadline expired after "
+                f"{state.produced} generated token(s)"))
+            return
         if self._lengths[row] >= self.block_size:
             # Defensive: eligibility admits only prompt+max_new <= block,
             # so this is a real pool-capacity truncation — count it.
@@ -574,6 +835,17 @@ class DecodeEngine:
         self._kv = self._kv.reset_row(row)
         self._completed += 1
         if notify and state is not None:
+            # A successfully completed request is the engine-health signal:
+            # it zeroes the consecutive-crash count and closes an open
+            # breaker (this is exactly the probe request succeeding — while
+            # open, nothing else is admitted).
+            with self._cond:
+                self._crashes = 0
+                self._probe_inflight = False
+                if self._breaker_open:
+                    self._breaker_open = False
+                    log.info("Decode engine %s: circuit breaker closed "
+                             "(probe request completed)", self.model_id)
             self._deliver(state.req, "done", None)
 
     def _release_prefix(self, row: int, state):
@@ -608,6 +880,11 @@ class DecodeEngine:
                 self._deliver(state.req, "error", exc)
         with self._cond:
             pending, self._pending = list(self._pending), collections.deque()
+            if self._probe_inflight:
+                # The probe died with everything else: stay open and re-arm
+                # the cooldown so the next probe waits its turn.
+                self._probe_inflight = False
+                self._breaker_open_t = time.monotonic()
         for req in pending:
             self._deliver(req, "error", exc)
 
@@ -650,6 +927,7 @@ class DecodeEngine:
 
 _ENGINES: dict = {}
 _REG_LOCK = threading.Lock()
+_DRAINING = False
 
 
 def _engine_key(model_id, block_size, temperature, top_k):
@@ -661,8 +939,12 @@ def _engine_key(model_id, block_size, temperature, top_k):
 def get_engine(model_id, block_size, temperature, top_k):
     """Blocking engine lookup/creation (deserializes the model on a miss —
     call off the event loop).  Returns None when the registry is at
-    capacity and nothing is evictable; callers fall back to the legacy
-    per-request path.  Raises KeyError for an unknown model (HTTP 404)."""
+    capacity and nothing is evictable, or while the server is draining
+    (shutdown must not spawn fresh engines); callers fall back to the
+    legacy per-request path.  Raises KeyError for an unknown model
+    (HTTP 404)."""
+    if _DRAINING:
+        return None
     key = _engine_key(model_id, block_size, temperature, top_k)
     with _REG_LOCK:
         engine = _ENGINES.get(key)
@@ -685,11 +967,49 @@ def get_engine(model_id, block_size, temperature, top_k):
 
 def reset():
     """Shut every engine down and clear the registry (tests, reloads)."""
+    global _DRAINING
     with _REG_LOCK:
         engines = list(_ENGINES.values())
         _ENGINES.clear()
+    _DRAINING = False
     for engine in engines:
         engine.shutdown(timeout=5.0)
+
+
+def draining() -> bool:
+    return _DRAINING
+
+
+def breaker_open_engines() -> list[str]:
+    """model_ids of engines whose circuit breaker is currently open
+    (the /readyz not-ready signal)."""
+    with _REG_LOCK:
+        return sorted({e.model_id for e in _ENGINES.values()
+                       if not e._shutdown and e._breaker_open})
+
+
+def drain_and_shutdown(drain_s: float | None = None) -> bool:
+    """Graceful server shutdown: mark the registry draining (readyz flips
+    not-ready, engines stop admitting), give in-flight rows up to
+    ``drain_s`` (default PENROZ_DRAIN_S) to finish, then join every worker
+    thread.  Returns True iff every thread joined."""
+    global _DRAINING
+    _DRAINING = True
+    if drain_s is None:
+        drain_s = _drain_s()
+    with _REG_LOCK:
+        engines = list(_ENGINES.values())
+        _ENGINES.clear()
+    ok = True
+    try:
+        for engine in engines:
+            ok = engine.shutdown(timeout=10.0, drain_s=drain_s) and ok
+    finally:
+        # Drain complete: the registry is empty and this app instance is
+        # gone.  Clearing the flag keeps a later create_app() in the same
+        # process (tests, embedded servers) serviceable.
+        _DRAINING = False
+    return ok
 
 
 def serving_stats() -> dict:
@@ -703,12 +1023,21 @@ def serving_stats() -> dict:
     stall_p99 = _p99([x for e in engines for x in e._chunk_stall_ms])
     pc = [p["prefix_cache"] for p in per if p["prefix_cache"] is not None]
     pc_lookups = sum(c["hits"] + c["misses"] for c in pc)
+    queue_wait_p99 = _p99([x for e in engines for x in e._queue_wait_ms])
     return {
         "continuous_batching_enabled": enabled(),
         "engines": per,
         "capacity": capacity,
         "active_rows": active,
         "queue_depth": sum(p["queue_depth"] for p in per),
+        "queue_rejections": sum(p["queue_rejections"] for p in per),
+        "deadline_timeouts": sum(p["deadline_timeouts"] for p in per),
+        "queue_wait_ms_p99": (round(queue_wait_p99, 3)
+                              if queue_wait_p99 is not None else None),
+        "breaker_open": any(p["breaker_open"] for p in per),
+        "crashes_total": sum(p["crashes_total"] for p in per),
+        "engine_resets": sum(p["engine_resets"] for p in per),
+        "draining": _DRAINING,
         "batch_occupancy": (active / capacity) if capacity else 0.0,
         "decode_tokens_per_sec": round(
             sum(p["decode_tokens_per_sec"] for p in per), 2),
@@ -742,21 +1071,27 @@ async def acquire_engine(model_id, block_size, temperature, top_k):
                                       block_size, temperature, top_k)
 
 
-def _async_request(prompt, max_new_tokens, stop_token):
+def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None):
     loop = asyncio.get_running_loop()
     queue: asyncio.Queue = asyncio.Queue()
 
     def on_event(kind, value):
         loop.call_soon_threadsafe(queue.put_nowait, (kind, value))
 
-    return Request(prompt, max_new_tokens, stop_token, on_event), queue
+    return (Request(prompt, max_new_tokens, stop_token, on_event,
+                    timeout_ms=timeout_ms), queue)
 
 
 async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
-                      stop_token) -> list[int]:
+                      stop_token, timeout_ms=None) -> list[int]:
     """Submit one request and await the full sequence (prompt + generated,
-    the ``generate_tokens`` contract)."""
-    req, queue = _async_request(prompt, max_new_tokens, stop_token)
+    the ``generate_tokens`` contract).  Raises DeadlineExceeded /
+    QueueFullError / CircuitOpenError on the shed paths; an aiohttp client
+    disconnect cancels the awaiting handler task, which propagates to
+    ``req.cancelled`` so the row and its prefix pins free at the next
+    boundary."""
+    req, queue = _async_request(prompt, max_new_tokens, stop_token,
+                                timeout_ms)
     engine.submit(req)
     tokens = list(req.prompt)
     try:
@@ -766,29 +1101,21 @@ async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
                 tokens.append(value)
             elif kind == "done":
                 return tokens
-            else:
+            else:  # "error" or "timeout": value is the exception
                 raise value
     except asyncio.CancelledError:
         req.cancelled = True
         raise
 
 
-async def stream_request(engine: DecodeEngine, prompt, max_new_tokens,
-                         stop_token):
-    """Async generator yielding each generated token as its shared decode
-    step completes (the ``generate_tokens_stream`` contract: stop token
-    included, then the stream ends)."""
-    req, queue = _async_request(prompt, max_new_tokens, stop_token)
+def start_stream(engine: DecodeEngine, prompt, max_new_tokens, stop_token,
+                 timeout_ms=None):
+    """Submit a streaming request; returns ``(req, queue)`` so the HTTP
+    layer can consume events AND flip ``req.cancelled`` itself when the
+    client goes away mid-stream (a write failure is invisible to an async
+    generator until its GC-time close — the explicit handle is the
+    disconnect wiring)."""
+    req, queue = _async_request(prompt, max_new_tokens, stop_token,
+                                timeout_ms)
     engine.submit(req)
-    try:
-        while True:
-            kind, value = await queue.get()
-            if kind == "token":
-                yield value
-            elif kind == "done":
-                return
-            else:
-                raise value
-    except asyncio.CancelledError:
-        req.cancelled = True
-        raise
+    return req, queue

@@ -86,6 +86,14 @@ def _routes() -> list[dict]:
     return [
         dict(method="get", path="/dashboard", summary="Training dashboard",
              responses=dict([_resp(200, "HTML dashboard")])),
+        dict(method="get", path="/healthz",
+             summary="Liveness probe (always 200 while the loop answers)",
+             responses=dict([_resp(200, "Alive")])),
+        dict(method="get", path="/readyz",
+             summary="Readiness probe: 503 while any engine circuit "
+                     "breaker is open or shutdown is draining",
+             responses=dict([_resp(200, "Ready to serve"),
+                             _resp(503, "Breaker open or draining")])),
         dict(method="post", path="/model/",
              summary="Create a model from the layer/optimizer DSL",
              body=_body("CreateModelRequest", gpt2_124m_example()),
@@ -118,14 +126,27 @@ def _routes() -> list[dict]:
         dict(method="post", path="/generate/",
              summary="Generate tokens (set stream:true for one per line)",
              body=_body("GenerateRequest"),
-             responses=dict([ok, _resp(404, "Unknown model")])),
+             responses=dict([ok, _resp(404, "Unknown model"),
+                             _resp(429, "Admission queue full "
+                                        "(PENROZ_SCHED_MAX_QUEUE) — retry "
+                                        "after Retry-After seconds"),
+                             _resp(503, "Engine circuit breaker open "
+                                        "(PENROZ_ENGINE_MAX_CRASHES "
+                                        "consecutive crashes)"),
+                             _resp(504, "Request deadline exceeded "
+                                        "(timeout_ms / "
+                                        "PENROZ_REQ_TIMEOUT_MS)")])),
         dict(method="post", path="/generate_batch/",
              summary="Ragged batched generation: N prompts of different "
                      "lengths share one forward per step",
              body=_body("GenerateBatchRequest"),
              responses=dict([ok, _resp(404, "Unknown model"),
                              _resp(400, "Prompt + max_new_tokens exceeds "
-                                        "block_size, or an empty prompt")])),
+                                        "block_size, or an empty prompt"),
+                             _resp(429, "Admission queue full (any shed "
+                                        "row sheds the batch)"),
+                             _resp(503, "Engine circuit breaker open"),
+                             _resp(504, "Row deadline exceeded")])),
         dict(method="post", path="/decode/", summary="Decode token ids",
              body=_body("DecodeTokensRequest"), responses=dict([ok])),
         dict(method="put", path="/train/",

@@ -68,6 +68,11 @@ class GenerateRequest(ModelRequest):
     top_k: Optional[int] = Field(None, description="Top-K sampling")
     stop_token: Optional[int] = Field(None, description="Early-stop token id")
     stream: bool = Field(False, description="Stream tokens as produced")
+    timeout_ms: Optional[int] = Field(
+        None, description="Request deadline in ms (scheduler path), capped "
+        "by PENROZ_REQ_TIMEOUT_MS server-side: 504 while queued, retired "
+        "at the next step boundary (stream ends with a 'timeout' line) in "
+        "flight")
 
 
 class GenerateBatchRequest(ModelRequest):
@@ -83,6 +88,10 @@ class GenerateBatchRequest(ModelRequest):
     top_k: Optional[int] = Field(None, description="Top-K sampling")
     stop_token: Optional[int] = Field(None, description="Per-row early-stop "
                                       "token id")
+    timeout_ms: Optional[int] = Field(
+        None, description="Per-row deadline in ms (scheduler path), capped "
+        "by PENROZ_REQ_TIMEOUT_MS; any shed row sheds the whole batch "
+        "(all-or-nothing contract)")
 
 
 class DecodeTokensRequest(TokenizerRequest):
@@ -147,6 +156,27 @@ class EngineStats(BaseModel):
     prefix_cache: Optional[PrefixCacheStats] = Field(
         None, description="null unless PENROZ_PREFIX_CACHE=1 with the "
         "paged pool")
+    queue_rejections: int = Field(0, description="Requests shed 429 at a "
+                                  "full admission queue "
+                                  "(PENROZ_SCHED_MAX_QUEUE)")
+    deadline_timeouts: int = Field(0, description="Requests shed 504 "
+                                   "(queued) or retired mid-flight on an "
+                                   "expired deadline")
+    breaker_rejections: int = Field(0, description="Submits refused 503 "
+                                    "while the circuit breaker was open")
+    queue_wait_ms_p99: Optional[float] = Field(
+        None, description="p99 enqueue → admission (prefill start) wait")
+    breaker_open: bool = Field(False, description="Circuit breaker state "
+                               "(PENROZ_ENGINE_MAX_CRASHES consecutive "
+                               "crashes open it; a successful probe "
+                               "closes it)")
+    consecutive_crashes: int = Field(0, description="Tick crashes since "
+                                     "the last successfully completed "
+                                     "request")
+    crashes_total: int = Field(0, description="Tick crashes over the "
+                               "engine lifetime")
+    engine_resets: int = Field(0, description="Full KV/prefix-state "
+                               "reallocations after crashes")
 
 
 class ServingStatsResponse(BaseModel):
@@ -157,6 +187,21 @@ class ServingStatsResponse(BaseModel):
     capacity: int
     active_rows: int
     queue_depth: int
+    queue_rejections: int = Field(0, description="Aggregate 429 queue-full "
+                                  "sheds")
+    deadline_timeouts: int = Field(0, description="Aggregate deadline "
+                                   "expiries (queued + in flight)")
+    queue_wait_ms_p99: Optional[float] = Field(
+        None, description="p99 enqueue → admission wait across engines")
+    breaker_open: bool = Field(False, description="True if ANY engine's "
+                               "circuit breaker is open (/readyz mirrors "
+                               "this)")
+    crashes_total: int = Field(0, description="Aggregate engine tick "
+                               "crashes")
+    engine_resets: int = Field(0, description="Aggregate post-crash engine "
+                               "resets")
+    draining: bool = Field(False, description="Graceful shutdown in "
+                           "progress (admission stopped)")
     batch_occupancy: float
     decode_tokens_per_sec: float
     admission_latency_ms_p50: Optional[float] = None

@@ -234,9 +234,20 @@ function renderServing(data) {
     : `prefix hits ${(hitRate * 100).toFixed(0)}% · evicted ` +
       `${data.prefix_cache_evicted_pages || 0} pages`;
   const stall = data.prefill_chunk_stall_ms_p99;
+  /* Fault-tolerance readouts (PR 3): shed/timeout counters and the engine
+   * circuit breaker — an open breaker is the "stop paging the dashboard,
+   * the engine is crash-looping" signal. */
+  const crashes = data.crashes_total || 0;
+  const breakerTxt = data.breaker_open
+    ? `breaker OPEN (${crashes} crashes, ${data.engine_resets || 0} resets)`
+    : `breaker ok (${crashes} crashes)`;
+  const shedTxt = `shed ${data.queue_rejections || 0} · ` +
+    `timeouts ${data.deadline_timeouts || 0}`;
   meta.textContent =
     `rows ${data.active_rows}/${data.capacity} (occupancy ` +
     `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +
+    `${shedTxt} · ${breakerTxt}` +
+    `${data.draining ? " · DRAINING" : ""} · ` +
     `${tps.toFixed(1)} tok/s · adm p50 ` +
     `${data.admission_latency_ms_p50 == null ? "—"
        : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +

@@ -35,6 +35,7 @@ import struct
 import tempfile
 import threading
 import uuid
+import zlib
 
 import numpy as np
 
@@ -81,8 +82,13 @@ def _encode_parts(data):
     offset = 0
     for a in arrays:
         offset = -(-offset // _ALIGN) * _ALIGN
+        # Per-stream CRC32: bit rot / torn copies surface as a descriptive
+        # error at load instead of a garbage decode into live weights.
+        # (tobytes() runs again in _write_stream — CPU for the checksum,
+        # but peak memory stays max(array), never sum.)
         meta.append({"dtype": str(a.dtype), "shape": list(a.shape),
-                     "offset": offset, "nbytes": a.nbytes})
+                     "offset": offset, "nbytes": a.nbytes,
+                     "crc32": zlib.crc32(a.tobytes()) & 0xFFFFFFFF})
         offset += a.nbytes
     header = json.dumps({"tree": tree, "arrays": meta},
                         separators=(",", ":")).encode("utf-8")
@@ -236,25 +242,65 @@ def _read(path: str):
                 "checkpoints are not loaded — re-create or re-import the "
                 "model")
         try:
-            return _decode(mm)
+            return _decode(mm, source=path)
         finally:
             mm.close()
 
 
-def _decode(buf: bytes):
-    """Decode container bytes back into the tree (inverse of ``_encode``)."""
+def _decode(buf: bytes, source: str = "<bytes>"):
+    """Decode container bytes back into the tree (inverse of ``_encode``).
+
+    Corruption is detected, not propagated: a payload shorter than the
+    header promises (truncation) or an array segment whose CRC32 disagrees
+    with the header raises a ValueError naming the file and the stream —
+    never a garbage decode into live weights or a bare struct error.
+    """
     if buf[:8] != MAGIC:
         raise ValueError(
             "not a penroz checkpoint (bad magic); legacy pickle checkpoints "
             "are not loaded — re-create or re-import the model")
     (header_len,) = struct.unpack("<Q", buf[8:16])
+    if len(buf) < 16 + header_len:
+        raise ValueError(
+            f"checkpoint corrupt (truncated header) in {source}: "
+            f"header claims {header_len} bytes, file holds "
+            f"{len(buf) - 16}")
     header = json.loads(buf[16:16 + header_len].decode("utf-8"))
     payload = memoryview(buf)[16 + header_len:]
     arrays = []
-    for m in header["arrays"]:
-        raw = payload[m["offset"]:m["offset"] + m["nbytes"]]
-        arrays.append(np.frombuffer(raw, dtype=np_dtype(m["dtype"]))
-                      .reshape(m["shape"]).copy())
+    error = None
+    for i, m in enumerate(header["arrays"]):
+        end = m["offset"] + m["nbytes"]
+        if end > len(payload):
+            error = (
+                f"checkpoint corrupt (truncated payload) in {source}: "
+                f"array stream {i} (dtype {m['dtype']}, shape "
+                f"{tuple(m['shape'])}) needs payload bytes "
+                f"[{m['offset']}, {end}) but only {len(payload)} exist")
+            break
+        raw = payload[m["offset"]:end]
+        # "crc32" absent = pre-CRC checkpoint: still loadable, unverified.
+        expect = m.get("crc32")
+        got = (zlib.crc32(raw) & 0xFFFFFFFF) if expect is not None else None
+        if got is None or got == expect:
+            arrays.append(np.frombuffer(raw, dtype=np_dtype(m["dtype"]))
+                          .reshape(m["shape"]).copy())
+        else:
+            error = (
+                f"checkpoint corrupt (CRC32 mismatch) in {source}: "
+                f"array stream {i} (dtype {m['dtype']}, shape "
+                f"{tuple(m['shape'])}) expected {expect:#010x}, got "
+                f"{got:#010x} — the file was truncated, bit-flipped, "
+                "or torn by a non-atomic copy")
+        # .copy() above detached the numpy view, so the slice can release
+        # now — raising with live exports would wedge the caller's
+        # mmap.close() (the traceback keeps frame locals alive).
+        raw.release()
+        if error:
+            break
+    if error:
+        payload.release()
+        raise ValueError(error)
     return _decode_tree(header["tree"], arrays.__getitem__)
 
 
@@ -408,6 +454,8 @@ def _mkstemp_for(path: str):
 
 
 def _atomic_write(path: str, data: dict):
+    from penroz_tpu.utils import faults
+    faults.check("ckpt.write")
     fd, tmp_path = _mkstemp_for(path)
     try:
         with os.fdopen(fd, "wb") as f:

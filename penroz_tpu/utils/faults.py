@@ -1,0 +1,133 @@
+"""Deterministic fault injection for recovery-path testing.
+
+Production failure handling (engine crash recovery, download retry,
+checkpoint-write rollback, deadline enforcement) is only trustworthy if the
+failures themselves can be produced on demand — a recovery path that has
+never executed is a recovery path that does not work.  This module is the
+single switchboard: hot paths carry a one-line ``faults.check("site")``
+hook that is a no-op (one env read + string compare) unless
+``PENROZ_FAULT_INJECT`` arms it.
+
+Spec grammar (comma-separated rules)::
+
+    PENROZ_FAULT_INJECT="decode.step:raise@3,ckpt.write:raise@1"
+    PENROZ_FAULT_INJECT="decode.step:sleep@200"
+    PENROZ_FAULT_INJECT="decode.step:raise@2+"
+
+- ``site:raise@N``  — raise :class:`InjectedFault` on exactly the Nth call
+  to ``check(site)`` (1-based; several rules for one site compose, so
+  ``s:raise@1,s:raise@2`` fails the first two calls).
+- ``site:raise@N+`` — raise on the Nth call and every call after it
+  (driving *consecutive*-failure paths like the engine circuit breaker).
+- ``site:sleep@MS`` — sleep MS milliseconds on every call (deadline /
+  stall / overload-window paths).
+
+Registered production sites: ``decode.step`` (shared decode step),
+``decode.prefill_chunk`` (admission prefill chunk), ``ckpt.write``
+(checkpoint container write), ``data.download`` (dataset download
+attempt).  Call counters are per-site and process-wide; tests reset them
+(and the parsed-spec cache) with :func:`reset`.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+ENV = "PENROZ_FAULT_INJECT"
+
+
+class InjectedFault(RuntimeError):
+    """The failure raised by an armed ``raise@N`` rule — a distinct type so
+    tests can assert the crash they asked for is the crash they got."""
+
+
+class _Rule:
+    __slots__ = ("mode", "n", "open_ended")
+
+    def __init__(self, mode: str, n: int, open_ended: bool):
+        self.mode = mode
+        self.n = n
+        self.open_ended = open_ended
+
+
+_LOCK = threading.Lock()
+_COUNTS: collections.Counter = collections.Counter()
+_CACHED_SPEC: str | None = None
+_CACHED_RULES: dict[str, list[_Rule]] = {}
+
+
+def _parse(spec: str) -> dict[str, list[_Rule]]:
+    rules: dict[str, list[_Rule]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            site, action = part.split(":", 1)
+            mode, arg = action.split("@", 1)
+            open_ended = mode == "raise" and arg.endswith("+")
+            n = int(arg[:-1] if open_ended else arg)
+            if mode not in ("raise", "sleep") or n < 0:
+                raise ValueError(part)
+        except ValueError:
+            log.warning("Ignoring unparseable %s rule %r "
+                        "(want site:raise@N[+] or site:sleep@MS)", ENV, part)
+            continue
+        rules.setdefault(site, []).append(_Rule(mode, n, open_ended))
+    return rules
+
+
+def _rules_for(site: str) -> list[_Rule]:
+    global _CACHED_SPEC, _CACHED_RULES
+    spec = os.environ.get(ENV, "")
+    if spec != _CACHED_SPEC:
+        _CACHED_RULES = _parse(spec)
+        _CACHED_SPEC = spec
+    return _CACHED_RULES.get(site, ())
+
+
+def check(site: str):
+    """Production hook: no-op unless ``PENROZ_FAULT_INJECT`` arms ``site``.
+
+    Sleeps first (all matching ``sleep`` rules), then raises if any
+    ``raise`` rule matches this call's ordinal — so a ``sleep`` + ``raise``
+    combination models a slow failure, not a fast one.
+    """
+    if not os.environ.get(ENV):
+        return
+    rules = _rules_for(site)
+    if not rules:
+        return
+    with _LOCK:
+        _COUNTS[site] += 1
+        count = _COUNTS[site]
+    for rule in rules:
+        if rule.mode == "sleep":
+            time.sleep(rule.n / 1000.0)
+    for rule in rules:
+        if rule.mode == "raise" and (
+                count == rule.n or (rule.open_ended and count >= rule.n)):
+            raise InjectedFault(
+                f"injected fault at {site} (call {count})")
+
+
+def call_count(site: str) -> int:
+    """How many armed ``check(site)`` calls have run (0 while disarmed —
+    the fast path never counts)."""
+    with _LOCK:
+        return _COUNTS[site]
+
+
+def reset():
+    """Clear call counters and the parsed-spec cache (tests)."""
+    global _CACHED_SPEC, _CACHED_RULES
+    with _LOCK:
+        _COUNTS.clear()
+    _CACHED_SPEC = None
+    _CACHED_RULES = {}

@@ -20,6 +20,15 @@ Prints ONE JSON line, e.g.::
 CPU by default (``PENROZ_BENCH_SERVING_PLATFORM`` overrides); run from the
 repo root: ``python scripts/bench_serving.py [concurrency] [max_new]``.
 
+``--overload`` switches to the fault-tolerance workload: offered load >
+capacity against a deliberately small engine (``PENROZ_BENCH_OVER_ROWS``
+rows, ``PENROZ_BENCH_OVER_QUEUE`` queue slots, ``PENROZ_BENCH_OVER_N``
+concurrent requests fired in waves), reporting the shed rate (429s),
+goodput (completed requests/sec), goodput latency p50/p99, and greedy
+parity of every completed response against its solo baseline — load
+shedding must never corrupt an admitted request (zero non-(200|429)
+statuses asserted by tests/test_bench_serving.py).
+
 ``--shared-prefix`` switches to the chunked-prefill + radix prefix-cache
 workload: N sequential streaming requests sharing one long prompt prefix
 (distinct short suffixes), measured with the prefix cache OFF then ON
@@ -150,6 +159,110 @@ async def _bench(concurrency: int, max_new: int, block: int) -> dict:
         decode_scheduler.reset()
         await client.close()
         os.environ.pop(decode_scheduler.ENABLE_ENV, None)
+
+
+# ---------------------------------------------------------------------------
+# --overload: offered load > capacity (shed rate + goodput, PR 3)
+# ---------------------------------------------------------------------------
+
+async def _bench_overload() -> dict:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = int(os.environ.get("PENROZ_BENCH_SERVING_BLOCK", "128"))
+    rows = int(os.environ.get("PENROZ_BENCH_OVER_ROWS", "2"))
+    queue = int(os.environ.get("PENROZ_BENCH_OVER_QUEUE", "2"))
+    offered = int(os.environ.get("PENROZ_BENCH_OVER_N", "16"))
+    waves = int(os.environ.get("PENROZ_BENCH_OVER_WAVES", "3"))
+    max_new = int(os.environ.get("PENROZ_BENCH_MAX_NEW", "16"))
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        decode_scheduler.MAX_ROWS_ENV: str(rows),
+        decode_scheduler.MAX_QUEUE_ENV: str(queue),
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, 255, 4 + (i % 4))]
+               for i in range(offered)]
+
+    def payload(prompt):
+        return {"model_id": "bench-overload", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+
+    async def one(prompt):
+        t0 = time.perf_counter()
+        resp = await client.post("/generate/", json=payload(prompt))
+        body = await resp.json() if resp.status != 204 else None
+        return resp.status, (time.perf_counter() - t0) * 1000.0, body
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-overload", "layers": _toy_gpt(
+                d=128, depth=2, block=block),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+
+        # Solo greedy baselines (scheduler on, no contention) — parity
+        # reference for every admitted response under overload.  Also
+        # warms every prompt-shape's prefill program.
+        baselines = {}
+        for p in prompts:
+            status, _, body = await one(p)
+            assert status == 200, body
+            baselines[tuple(p)] = body["tokens"]
+
+        statuses: dict = {}
+        latencies = []
+        parity_ok = True
+        t0 = time.perf_counter()
+        completed = 0
+        for _ in range(waves):
+            results = await asyncio.gather(*[one(p) for p in prompts])
+            for p, (status, ms, body) in zip(prompts, results):
+                statuses[status] = statuses.get(status, 0) + 1
+                if status == 200:
+                    completed += 1
+                    latencies.append(ms)
+                    parity_ok = parity_ok \
+                        and body["tokens"] == baselines[tuple(p)]
+        wall_s = time.perf_counter() - t0
+        shed = statuses.get(429, 0)
+        total = sum(statuses.values())
+        failures = total - completed - shed
+
+        resp = await client.get("/serving_stats/")
+        stats = await resp.json()
+        stats.pop("engines", None)
+        return {
+            "mode": "overload", "block_size": block, "capacity_rows": rows,
+            "max_queue": queue, "offered_concurrency": offered,
+            "waves": waves, "max_new_tokens": max_new,
+            "offered_requests": total, "completed": completed,
+            "shed_429": shed, "failed_other": failures,
+            "shed_rate": round(shed / total, 3) if total else None,
+            "goodput_req_per_sec": round(completed / wall_s, 2),
+            "goodput_ms_p50": (round(_pct(latencies, 0.5), 3)
+                               if latencies else None),
+            "goodput_ms_p99": (round(_pct(latencies, 0.99), 3)
+                               if latencies else None),
+            "parity_ok": parity_ok,
+            "serving_stats": stats,
+        }
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 # ---------------------------------------------------------------------------
@@ -302,8 +415,10 @@ def _emit(results: dict):
 
 
 def main():
-    args = [a for a in sys.argv[1:] if a != "--shared-prefix"]
-    shared_prefix = len(args) != len(sys.argv) - 1
+    args = [a for a in sys.argv[1:]
+            if a not in ("--shared-prefix", "--overload")]
+    shared_prefix = "--shared-prefix" in sys.argv[1:]
+    overload = "--overload" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
@@ -312,6 +427,9 @@ def main():
     # Isolated checkpoint dirs: the benchmark must not touch repo models.
     workdir = tempfile.mkdtemp(prefix="penroz_bench_serving_")
     os.chdir(workdir)
+    if overload:
+        _emit(asyncio.run(_bench_overload()))
+        return
     if shared_prefix:
         _emit(asyncio.run(_bench_shared_prefix()))
         return

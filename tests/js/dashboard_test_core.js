@@ -149,6 +149,15 @@ async function runDashboardTests(src, fixtures) {
                fixtures.serving.prefill_chunk_stall_ms_p99.toFixed(1) +
                "ms"),
              "serving tile shows prefill chunk-stall p99");
+    assertOk(servingMeta.includes(
+               `shed ${fixtures.serving.queue_rejections}`),
+             "serving tile shows queue-full shed count");
+    assertOk(servingMeta.includes(
+               `timeouts ${fixtures.serving.deadline_timeouts}`),
+             "serving tile shows deadline timeout count");
+    assertOk(servingMeta.includes(
+               `breaker ok (${fixtures.serving.crashes_total} crashes)`),
+             "serving tile shows closed breaker + crash counter");
     const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
     assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];
@@ -197,6 +206,22 @@ async function runDashboardTests(src, fixtures) {
              "serving tile degrades to 'prefix cache off' on null hit rate");
     assertOk(servingMeta.includes("chunk stall p99 —"),
              "serving tile dashes a null chunk-stall p99");
+  }
+
+  // 2c. open circuit breaker + draining flag: the tile surfaces the
+  //     fault-tolerance state loudly instead of hiding it in counters
+  {
+    const servingBroken = Object.assign({}, fixtures.serving, {
+      breaker_open: true, crashes_total: 4, engine_resets: 3,
+      draining: true });
+    const { document } = await runDashboard(src, {
+      progress: fixtures.progress, stats: fixtures.statsPlain,
+      serving: servingBroken });
+    const servingMeta = document.byId["serving-meta"].textContent;
+    assertOk(servingMeta.includes("breaker OPEN (4 crashes, 3 resets)"),
+             "serving tile shows an open breaker with crash/reset counts");
+    assertOk(servingMeta.includes("DRAINING"),
+             "serving tile flags a draining server");
   }
 
   // 3. unknown model: 404 progress renders the error badge, no crash

@@ -381,7 +381,7 @@ def test_openapi_spec(client):
     for path in ["/model/", "/import/", "/dataset/", "/tokenize/",
                  "/output/", "/evaluate/", "/generate/", "/decode/",
                  "/train/", "/progress/", "/stats/", "/serving_stats/",
-                 "/profile/", "/dashboard"]:
+                 "/profile/", "/dashboard", "/healthz", "/readyz"]:
         assert path in spec["paths"], path
     assert set(spec["paths"]["/dataset/"]) == {"get", "post", "delete"}
     assert "CreateModelRequest" in spec["components"]["schemas"]
@@ -435,6 +435,102 @@ def test_orphaned_training_swept_at_startup(workdir):
     # weights survive the metadata rewrite
     restored = NeuralNetworkModel.deserialize("orph")
     assert restored.params
+
+
+def test_sweep_runs_at_create_app_and_tolerates_corrupt_checkpoints(workdir):
+    """create_app() itself runs the orphan sweep synchronously (a client
+    retrying /train/ right after restart must not race it), a healthy
+    checkpoint is left alone, and an unreadable/corrupt checkpoint file in
+    the models dir must not block startup."""
+    import os
+    from penroz_tpu.models.dsl import Mapper
+    from penroz_tpu.models.model import NeuralNetworkModel
+    from penroz_tpu.utils import checkpoint
+
+    for mid, code in (("stale", "Training"), ("healthy", "Trained")):
+        m = NeuralNetworkModel(mid, Mapper(TOY_LAYERS, SGD))
+        m.status = {"code": code, "message": None}
+        m.serialize(sync_flush=True)
+    # garbage that list_model_ids will pick up but peek_tree cannot parse
+    os.makedirs("models", exist_ok=True)
+    with open("models/model_corrupt.ckpt", "wb") as f:
+        f.write(b"\x00garbage, not a container")
+
+    app_mod.create_app()  # must not raise despite the corrupt file
+
+    assert checkpoint.peek_tree("stale")["status"]["code"] == "Error"
+    assert "restart" in checkpoint.peek_tree("stale")["status"]["message"]
+    assert checkpoint.peek_tree("healthy")["status"]["code"] == "Trained"
+
+
+@pytest.fixture
+def fake_datasets(monkeypatch):
+    """A stub HuggingFace `datasets` module: download exercises the REAL
+    tokenize/shard pipeline, only the network fetch is faked."""
+    import sys
+    import types
+    mod = types.SimpleNamespace(
+        load_dataset=lambda path, name, split: {"text": ["hello world"] * 4})
+    monkeypatch.setitem(sys.modules, "datasets", mod)
+    return mod
+
+
+def _poll_download(client, dataset_id, timeout_s=30):
+    import time
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        status, body = client.json("GET", f"/dataset/?dataset_id={dataset_id}")
+        assert status == 200
+        dl = body.get("download")
+        if dl and dl["state"] in ("complete", "failed"):
+            return body
+        time.sleep(0.05)
+    raise AssertionError(f"download for {dataset_id} never settled")
+
+
+def test_download_retries_through_injected_fault(client, workdir,
+                                                 fake_datasets, monkeypatch):
+    """A transient download failure (injected at the data.download site) is
+    retried with backoff and succeeds on attempt 2 — shards exist and the
+    dataset status reports the attempt count."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv(faults.ENV, "data.download:raise@1")
+    monkeypatch.setenv("PENROZ_DOWNLOAD_RETRIES", "3")
+    monkeypatch.setenv("PENROZ_DOWNLOAD_BACKOFF_S", "0.01")
+    faults.reset()
+    status, _ = client.json("POST", "/dataset/", json={
+        "dataset_id": "retryds", "encoding": "byte", "path": "p",
+        "name": None, "split": "train", "shard_size": 64})
+    assert status == 202
+    body = _poll_download(client, "retryds")
+    assert body["download"]["state"] == "complete"
+    assert body["download"]["attempts"] == 2
+    assert body["download"]["error"] is None
+    assert body["files"], body
+    faults.reset()
+
+
+def test_download_terminal_failure_surfaced_to_clients(client, workdir,
+                                                       fake_datasets,
+                                                       monkeypatch):
+    """Exhausted retries surface as state=failed with the error text in the
+    dataset listing — clients see the terminal failure instead of a
+    silently-logged fire-and-forget task."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv(faults.ENV, "data.download:raise@1+")
+    monkeypatch.setenv("PENROZ_DOWNLOAD_RETRIES", "2")
+    monkeypatch.setenv("PENROZ_DOWNLOAD_BACKOFF_S", "0.01")
+    faults.reset()
+    status, _ = client.json("POST", "/dataset/", json={
+        "dataset_id": "deadds2", "encoding": "byte", "path": "p",
+        "name": None, "split": "train", "shard_size": 64})
+    assert status == 202
+    body = _poll_download(client, "deadds2")
+    assert body["download"]["state"] == "failed"
+    assert body["download"]["attempts"] == 2
+    assert "InjectedFault" in body["download"]["error"]
+    assert body["files"] == []
+    faults.reset()
 
 
 def test_stats_exposes_moe_router_fractions(client, workdir):

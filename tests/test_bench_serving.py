@@ -58,3 +58,37 @@ def test_shared_prefix_bench_smoke(tmp_path):
     # warm request misses, first measured request misses, the rest hit
     assert on["hit_rate"] is not None and on["hit_rate"] >= 0.5
     assert results["ttft_p50_speedup_on_vs_off"] >= 2.0, results
+
+
+def test_overload_bench_smoke(tmp_path):
+    """--overload (PR 3): offered load > capacity must shed with 429s and
+    complete the admitted requests with exact greedy parity — ZERO
+    non-(200|429) statuses while shedding is the acceptance bar (a 500
+    under overload would mean shedding corrupted an in-flight request)."""
+    out_path = tmp_path / "overload.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PENROZ_BENCH_SERVING_BLOCK="64",
+        PENROZ_BENCH_OVER_ROWS="2",
+        PENROZ_BENCH_OVER_QUEUE="2",
+        PENROZ_BENCH_OVER_N="10",
+        PENROZ_BENCH_OVER_WAVES="2",
+        PENROZ_BENCH_MAX_NEW="8",
+        PENROZ_BENCH_JSON_OUT=str(out_path),
+    )
+    proc = subprocess.run([sys.executable, SCRIPT, "--overload"],
+                          capture_output=True, text=True, timeout=900,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.loads(out_path.read_text()) == results
+
+    assert results["mode"] == "overload"
+    assert results["failed_other"] == 0, results       # the hard invariant
+    assert results["shed_429"] > 0, results            # overload really shed
+    assert results["completed"] > 0, results           # and goodput survived
+    assert results["parity_ok"] is True, results       # with exact tokens
+    assert results["goodput_ms_p99"] is not None
+    assert results["serving_stats"]["queue_rejections"] == \
+        results["shed_429"]

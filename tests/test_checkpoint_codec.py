@@ -119,6 +119,65 @@ def test_patch_meta_header_only_rewrite(tmp_path, monkeypatch):
         checkpoint.patch_meta("nope", {"status": {}})
 
 
+def _save_corruptible(tmp_path, monkeypatch, model_id="crc"):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(checkpoint, "SHM_PATH", str(tmp_path / "shm"))
+    arr = np.arange(256, dtype=np.float32).reshape(16, 16)
+    checkpoint.save(model_id, {"status": {"code": "Trained"},
+                               "params": {"w": arr}}, sync_flush=True)
+    return checkpoint.shm_model_path(model_id), arr
+
+
+def test_corrupt_checkpoint_bit_flip_named_in_error(tmp_path, monkeypatch):
+    """A single flipped payload byte must fail the per-stream CRC32 with
+    the file path and the offending stream named — never a silent garbage
+    decode into live weights."""
+    path, arr = _save_corruptible(tmp_path, monkeypatch)
+    blob = bytearray(open(path, "rb").read())
+    blob[-3] ^= 0x40  # one bit, deep in the array payload
+    with open(path, "wb") as f:
+        f.write(blob)
+    with pytest.raises(ValueError) as exc:
+        checkpoint.load("crc")
+    msg = str(exc.value)
+    assert "CRC32 mismatch" in msg
+    assert path in msg                 # which file
+    assert "array stream 0" in msg     # which stream
+    assert "float32" in msg
+
+
+def test_truncated_checkpoint_named_in_error(tmp_path, monkeypatch):
+    """A truncated container (killed copy, full disk) raises a descriptive
+    truncation error instead of a bare struct/frombuffer error."""
+    path, arr = _save_corruptible(tmp_path, monkeypatch, "trunc")
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) - arr.nbytes // 2])
+    with pytest.raises(ValueError) as exc:
+        checkpoint.load("trunc")
+    msg = str(exc.value)
+    assert "truncated" in msg
+    assert path in msg
+    assert "array stream 0" in msg
+
+
+def test_pre_crc_checkpoints_still_load(tmp_path, monkeypatch):
+    """Checkpoints written before the CRC field existed (no "crc32" in the
+    array meta) must keep loading — verification is opportunistic."""
+    import json as _json
+    import struct as _struct
+    buf = checkpoint._encode({"w": np.arange(8, dtype=np.int32)})
+    (hlen,) = _struct.unpack("<Q", buf[8:16])
+    header = _json.loads(buf[16:16 + hlen])
+    for m in header["arrays"]:
+        del m["crc32"]
+    new_header = _json.dumps(header, separators=(",", ":")).encode()
+    legacy = (buf[:8] + _struct.pack("<Q", len(new_header)) + new_header
+              + buf[16 + hlen:])
+    out = checkpoint._decode(legacy)
+    np.testing.assert_array_equal(out["w"], np.arange(8, dtype=np.int32))
+
+
 def test_list_model_ids_shard_suffix_only(tmp_path, monkeypatch):
     """Only the exact '.shard<idx>' suffix marks a shard file; a model id
     that merely contains '.shard' must stay visible."""

@@ -26,11 +26,15 @@ SGD = {"sgd": {"lr": 0.1}}
 
 @pytest.fixture(autouse=True)
 def _scheduler_registry(workdir):
-    """Fresh engine registry per test: engines cache model snapshots by id,
-    and every test gets its own checkpoint dir (workdir)."""
+    """Fresh engine registry + fault-injection counters per test: engines
+    cache model snapshots by id, and every test gets its own checkpoint
+    dir (workdir)."""
     from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    faults.reset()
     yield
     decode_scheduler.reset()
+    faults.reset()
 
 
 @pytest.fixture
@@ -84,11 +88,12 @@ class _Collector:
                 raise value
 
 
-def _submit(engine, prompt, max_new, stop_token=None):
+def _submit(engine, prompt, max_new, stop_token=None, timeout_ms=None):
     from penroz_tpu.serve import decode_scheduler
     collector = _Collector(prompt)
     engine.submit(decode_scheduler.Request(prompt, max_new, stop_token,
-                                           collector.on_event))
+                                           collector.on_event,
+                                           timeout_ms=timeout_ms))
     return collector
 
 
@@ -302,9 +307,19 @@ def test_serving_stats_disabled_and_openapi(client, workdir):
     assert stats["continuous_batching_enabled"] is False
     assert stats["engines"] == []
     assert stats["kv_pool_capacity_drops"] >= 0
+    # fault-tolerance aggregates are present from day zero
+    assert stats["queue_rejections"] == 0
+    assert stats["deadline_timeouts"] == 0
+    assert stats["breaker_open"] is False
+    assert stats["crashes_total"] == 0
+    assert stats["draining"] is False
     status, spec = _json(client, "GET", "/openapi.json")
     assert "/serving_stats/" in spec["paths"]
+    assert "/healthz" in spec["paths"]
+    assert "/readyz" in spec["paths"]
     assert "ServingStatsResponse" in spec["components"]["schemas"]
+    gen = spec["paths"]["/generate/"]["post"]["responses"]
+    assert {"429", "503", "504"} <= set(gen)
 
 
 def test_oversized_request_falls_back_to_legacy_path(client, gpt_model,
@@ -454,6 +469,224 @@ def test_serving_stats_reports_prefix_and_chunk_fields(client, gpt_model,
     assert engine["prefill_max_chunks_between_steps"] <= 1
 
 
+# -- fault tolerance: deadlines, backpressure, crash recovery (PR 3) --------
+
+def _wait_tokens(collector, n, timeout=120):
+    """Drain collector events until ``n`` tokens arrived (so the request is
+    provably mid-decode)."""
+    deadline = time.monotonic() + timeout
+    while collector.received < n:
+        assert time.monotonic() < deadline, "request never started decoding"
+        try:
+            kind, value = collector.q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        assert kind == "token", kind
+        collector.tokens.append(value)
+        collector.received += 1
+
+
+def test_step_crash_fails_all_cleanly_then_recovers_with_parity(
+        gpt_model, make_engine, monkeypatch):
+    """THE acceptance path: an injected decode.step crash fails every
+    waiting request with a clean (typed) error, the engine fully resets
+    its KV/prefix state, and the very next request completes with greedy
+    output identical to the no-crash path."""
+    from penroz_tpu.utils import faults
+    pa, pb = [1, 2, 3], [5]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 6, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "decode.step:raise@1")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    c1 = _submit(engine, pa, 6)
+    c2 = _submit(engine, pb, 6)
+    with pytest.raises(faults.InjectedFault):
+        c1.result()
+    with pytest.raises(faults.InjectedFault):
+        c2.result()
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+    # next request: same engine object, post-reset state, token-identical
+    assert _submit(engine, pa, 6).result() == base_a
+    stats = engine.stats()
+    assert stats["crashes_total"] == 1
+    assert stats["engine_resets"] == 1
+    assert stats["consecutive_crashes"] == 0  # success zeroed it
+    assert stats["breaker_open"] is False
+    assert engine.active_rows == 0
+
+
+def test_prefill_chunk_crash_recovers_with_parity(gpt_model, make_engine,
+                                                  monkeypatch):
+    """Same recovery contract for the second tick site: a crash inside an
+    admission prefill chunk."""
+    from penroz_tpu.utils import faults
+    prompt = [9, 10, 11, 12]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "decode.prefill_chunk:raise@1")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, prompt, 5).result()
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+    assert _submit(engine, prompt, 5).result() == base
+    assert engine.stats()["engine_resets"] == 1
+
+
+def test_queue_full_sheds_while_inflight_keeps_parity(gpt_model,
+                                                      make_engine,
+                                                      monkeypatch):
+    """PENROZ_SCHED_MAX_QUEUE bounds admission: with the row busy and the
+    queue full, submit raises QueueFullError immediately — and neither the
+    in-flight nor the queued request's tokens change (no cross-request
+    corruption under shedding)."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    pa, pb, pc = [1, 2, 3], [5], [7, 8]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 6, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 4, temperature=0.0)
+    monkeypatch.setenv(decode_scheduler.MAX_QUEUE_ENV, "1")
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@80")  # slow decode
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    ca = _submit(engine, pa, 6)
+    _wait_tokens(ca, 1)          # A admitted: pending queue is empty
+    cb = _submit(engine, pb, 4)  # queued (row busy) — fills the queue
+    with pytest.raises(decode_scheduler.QueueFullError):
+        _submit(engine, pc, 4)
+    assert ca.result() == base_a
+    assert cb.result() == base_b
+    stats = engine.stats()
+    assert stats["queue_rejections"] == 1
+    assert stats["queue_wait_ms_p99"] is not None
+
+
+def test_deadline_expires_while_queued(gpt_model, make_engine, monkeypatch):
+    """A queued request whose deadline passes before a row frees is shed
+    with a 'queued'-phase DeadlineExceeded — before any prefill — while
+    the in-flight request keeps its exact stream."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    pa, pb = [1, 2, 3], [5]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 8, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@80")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    ca = _submit(engine, pa, 8)
+    _wait_tokens(ca, 1)
+    cb = _submit(engine, pb, 4, timeout_ms=150)
+    with pytest.raises(decode_scheduler.DeadlineExceeded) as exc:
+        cb.result()
+    assert exc.value.phase == "queued"
+    assert cb.received == 0      # shed before prefill ever ran
+    assert ca.result() == base_a
+    assert engine.stats()["deadline_timeouts"] == 1
+
+
+def test_deadline_expires_in_flight_retires_at_boundary(gpt_model,
+                                                        make_engine,
+                                                        monkeypatch):
+    """An in-flight deadline retires the row at the next step boundary:
+    the tokens produced so far were delivered, then the stream ends with a
+    timeout event — and the engine immediately serves the next request."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    prompt = [1, 2, 3]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 4, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@100")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    c = _submit(engine, prompt, 50, timeout_ms=350)
+    with pytest.raises(decode_scheduler.DeadlineExceeded) as exc:
+        c.result()
+    assert exc.value.phase == "inflight"
+    assert 1 <= c.received < 50
+    assert engine.active_rows == 0
+    assert _submit(engine, prompt, 4).result() == base
+    assert engine.stats()["deadline_timeouts"] == 1
+
+
+def test_circuit_breaker_opens_after_consecutive_crashes_then_probe_closes(
+        gpt_model, make_engine, monkeypatch):
+    """PENROZ_ENGINE_MAX_CRASHES consecutive crashes open the breaker:
+    submits are refused with CircuitOpenError during the cooldown, then
+    ONE probe request is admitted and its success closes the breaker."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    prompt = [1, 2, 3]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    monkeypatch.setenv(decode_scheduler.MAX_CRASHES_ENV, "2")
+    monkeypatch.setenv(decode_scheduler.BREAKER_COOLDOWN_ENV, "400")
+    monkeypatch.setenv(faults.ENV,
+                       "decode.step:raise@1,decode.step:raise@2")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, prompt, 5).result()          # crash 1
+    assert engine.stats()["breaker_open"] is False
+    assert engine.stats()["consecutive_crashes"] == 1
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, prompt, 5).result()          # crash 2 → breaker
+    assert engine.stats()["breaker_open"] is True
+    with pytest.raises(decode_scheduler.CircuitOpenError):
+        _submit(engine, prompt, 5)                   # cooldown: refused
+    time.sleep(0.5)                                  # cooldown elapses
+    assert _submit(engine, prompt, 5).result() == base  # probe succeeds
+    stats = engine.stats()
+    assert stats["breaker_open"] is False            # probe closed it
+    assert stats["consecutive_crashes"] == 0
+    assert stats["crashes_total"] == 2
+    assert stats["breaker_rejections"] == 1
+
+
+def test_cancellation_frees_row_mid_flight(gpt_model, make_engine,
+                                           monkeypatch):
+    """req.cancelled (the client-disconnect signal) retires the row at the
+    next boundary instead of decoding to max_new_tokens, and the slot
+    serves the next request with exact parity."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    pa, pb = [1, 2, 3], [5]
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 5, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@60")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    collector = _Collector(pa)
+    req = decode_scheduler.Request(pa, 50, None, collector.on_event)
+    engine.submit(req)
+    _wait_tokens(collector, 2)
+    req.cancelled = True
+    deadline = time.monotonic() + 30
+    while engine.active_rows and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert engine.active_rows == 0
+    assert collector.received < 50   # provably did not run to completion
+    assert _submit(engine, pb, 5).result() == base_b
+
+
+def test_graceful_shutdown_drains_inflight_rows(gpt_model, make_engine,
+                                                monkeypatch):
+    """shutdown(drain_s=...) lets the in-flight request finish (every
+    token delivered, done event sent) before the worker joins, and
+    reports the successful join (returns True) — the satellite contract."""
+    from penroz_tpu.utils import faults
+    prompt = [1, 2, 3]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 6, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@40")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    c = _submit(engine, prompt, 6)
+    _wait_tokens(c, 1)
+    assert engine.shutdown(timeout=30.0, drain_s=30.0) is True
+    assert c.result(timeout=5) == base   # drained, not killed
+
+
+def test_shutdown_reports_failed_join(gpt_model, make_engine, monkeypatch):
+    """A worker thread that cannot join within the timeout is REPORTED
+    (False + log) instead of silently leaked — satellite fix for the old
+    fire-and-forget join."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@1500")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    c = _submit(engine, [1, 2], 2)
+    _wait_tokens(c, 1)               # worker is now inside the slow step
+    assert engine.shutdown(timeout=0.2) is False
+    # the fixture's teardown shutdown() joins for real once the step ends
+
+
 def test_max_stall_budget_runs_multiple_chunks(gpt_model, make_engine,
                                                monkeypatch):
     """PENROZ_SCHED_MAX_STALL_MS > 0 trades inter-token latency for
@@ -480,3 +713,209 @@ def test_max_stall_budget_runs_multiple_chunks(gpt_model, make_engine,
     assert ca.result() == base_a
     # all 6 of B's 1-token chunks fit one boundary under the huge budget
     assert engine.stats()["prefill_max_chunks_between_steps"] == 6
+
+
+# -- fault tolerance over HTTP (429/504/503, lifecycle endpoints) ------------
+
+def test_http_queue_full_429_with_retry_after(client, gpt_model,
+                                              monkeypatch):
+    """Queue-full sheds 429 + Retry-After while the in-flight and queued
+    requests keep token-identical greedy outputs (the acceptance's
+    no-corruption-under-shedding clause, end to end)."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    pa, pb = [1, 2, 3], [5]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 8, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 4, temperature=0.0)
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(decode_scheduler.MAX_ROWS_ENV, "1")
+    monkeypatch.setenv(decode_scheduler.MAX_QUEUE_ENV, "1")
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@80")
+    test_client, loop = client
+
+    async def go():
+        task_a = asyncio.ensure_future(test_client.post(
+            "/generate/", json=_gen_payload(input=[pa], max_new_tokens=8)))
+        # wait until A occupies the row (pending queue empty again)
+        for _ in range(200):
+            stats = await (await test_client.get("/serving_stats/")).json()
+            if stats["active_rows"] >= 1 and stats["queue_depth"] == 0:
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise AssertionError("A never admitted")
+        task_b = asyncio.ensure_future(test_client.post(
+            "/generate/", json=_gen_payload(input=[pb], max_new_tokens=4)))
+        for _ in range(200):
+            stats = await (await test_client.get("/serving_stats/")).json()
+            if stats["queue_depth"] >= 1:
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise AssertionError("B never queued")
+        resp_c = await test_client.post(
+            "/generate/", json=_gen_payload(input=[[7, 8]],
+                                            max_new_tokens=4))
+        resp_a, resp_b = await task_a, await task_b
+        return (resp_a.status, await resp_a.json(),
+                resp_b.status, await resp_b.json(),
+                resp_c.status, await resp_c.json(),
+                resp_c.headers.get("Retry-After"))
+
+    a_status, a_body, b_status, b_body, c_status, c_body, retry = \
+        loop.run_until_complete(go())
+    assert a_status == 200 and a_body["tokens"] == base_a
+    assert b_status == 200 and b_body["tokens"] == base_b
+    assert c_status == 429, c_body
+    assert "overloaded" in c_body["detail"]
+    assert retry is not None
+    _, stats = _json(client, "GET", "/serving_stats/")
+    assert stats["queue_rejections"] == 1
+
+
+def test_http_deadline_504_queued_and_inflight(client, gpt_model,
+                                               monkeypatch):
+    """timeout_ms maps to 504 in both phases: shed from the queue while a
+    slow request holds the row, and expired mid-flight afterwards — the
+    concurrent in-flight request's tokens stay exact."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    pa = [1, 2, 3]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 8, temperature=0.0)
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(decode_scheduler.MAX_ROWS_ENV, "1")
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@80")
+    test_client, loop = client
+
+    async def go():
+        task_a = asyncio.ensure_future(test_client.post(
+            "/generate/", json=_gen_payload(input=[pa], max_new_tokens=8)))
+        for _ in range(200):
+            stats = await (await test_client.get("/serving_stats/")).json()
+            if stats["active_rows"] >= 1:
+                break
+            await asyncio.sleep(0.02)
+        # queued-phase 504: B can't get the row within its 100ms budget
+        resp_q = await test_client.post(
+            "/generate/", json=_gen_payload(input=[[5]], max_new_tokens=4,
+                                            timeout_ms=100))
+        resp_a = await task_a
+        # inflight-phase 504: row is free now; the deadline expires
+        # mid-generation (slow steps, many tokens)
+        resp_i = await test_client.post(
+            "/generate/", json=_gen_payload(input=[[7]], max_new_tokens=14,
+                                            timeout_ms=300))
+        return (resp_q.status, await resp_q.json(), resp_a.status,
+                await resp_a.json(), resp_i.status, await resp_i.json())
+
+    q_status, q_body, a_status, a_body, i_status, i_body = \
+        loop.run_until_complete(go())
+    assert q_status == 504 and "queued" in q_body["detail"]
+    assert a_status == 200 and a_body["tokens"] == base_a
+    assert i_status == 504, i_body
+    _, stats = _json(client, "GET", "/serving_stats/")
+    assert stats["deadline_timeouts"] == 2
+
+
+def test_http_stream_deadline_emits_timeout_line(client, gpt_model,
+                                                 monkeypatch):
+    """A streaming request whose deadline expires mid-flight delivers the
+    tokens produced so far, then a literal 'timeout' line, then ends."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@100")
+    test_client, loop = client
+
+    async def go():
+        resp = await test_client.post("/generate/", json=_gen_payload(
+            input=[[1, 2]], max_new_tokens=13, stream=True, timeout_ms=350))
+        assert resp.status == 200
+        return (await resp.read()).decode()
+
+    lines = loop.run_until_complete(go()).strip().split("\n")
+    assert lines[-1] == "timeout"
+    assert 1 <= len(lines) - 1 < 13
+    assert all(line.isdigit() for line in lines[:-1])
+
+
+def test_http_breaker_503_readyz_and_probe_recovery(client, gpt_model,
+                                                    monkeypatch):
+    """The breaker acceptance, end to end: N injected crashes → 503 from
+    the scheduler path + /readyz not ready; after the cooldown one probe
+    request succeeds with exact greedy parity and /readyz recovers."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    prompt = [1, 2, 3]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 4, temperature=0.0)
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(decode_scheduler.MAX_CRASHES_ENV, "1")
+    monkeypatch.setenv(decode_scheduler.BREAKER_COOLDOWN_ENV, "100000")
+    monkeypatch.setenv(faults.ENV, "decode.step:raise@1")
+
+    status, body = _json(client, "POST", "/generate/", json=_gen_payload())
+    assert status == 500                     # the injected crash itself
+
+    status, body = _json(client, "GET", "/readyz")
+    assert status == 503
+    assert body["ready"] is False
+    assert body["breaker_open_engines"] == ["schedgpt"]
+    status, _ = _json(client, "GET", "/healthz")
+    assert status == 200                     # liveness unaffected
+
+    status, body = _json(client, "POST", "/generate/", json=_gen_payload())
+    assert status == 503                     # breaker sheds during cooldown
+    assert "circuit breaker" in body["detail"]
+
+    _, stats = _json(client, "GET", "/serving_stats/")
+    assert stats["breaker_open"] is True
+    assert stats["crashes_total"] == 1
+    assert stats["engine_resets"] == 1
+
+    # cooldown over (0ms), fault disarmed: the next request is the probe
+    monkeypatch.setenv(decode_scheduler.BREAKER_COOLDOWN_ENV, "0")
+    monkeypatch.delenv(faults.ENV)
+    from penroz_tpu.utils import faults as _f
+    _f.reset()
+    status, body = _json(client, "POST", "/generate/", json=_gen_payload())
+    assert status == 200
+    assert body["tokens"] == base            # post-reset greedy parity
+    status, body = _json(client, "GET", "/readyz")
+    assert status == 200 and body["ready"] is True
+    _, stats = _json(client, "GET", "/serving_stats/")
+    assert stats["breaker_open"] is False
+
+
+def test_http_breaker_fallback_to_legacy_path(client, gpt_model,
+                                              monkeypatch):
+    """PENROZ_SCHED_FALLBACK=1 degrades an open-breaker request to the
+    pre-PR-1 single-sequence path (200 + exact tokens) instead of 503."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    base = gpt_model.generate_tokens([[1, 2, 3]], BLOCK, 4, temperature=0.0)
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(decode_scheduler.MAX_CRASHES_ENV, "1")
+    monkeypatch.setenv(decode_scheduler.BREAKER_COOLDOWN_ENV, "100000")
+    monkeypatch.setenv(faults.ENV, "decode.step:raise@1")
+    status, _ = _json(client, "POST", "/generate/", json=_gen_payload())
+    assert status == 500                     # crash opens the breaker
+    monkeypatch.setenv(decode_scheduler.FALLBACK_ENV, "1")
+    status, body = _json(client, "POST", "/generate/", json=_gen_payload())
+    assert status == 200                     # degraded, not refused
+    assert body["tokens"] == base
+    _, stats = _json(client, "GET", "/serving_stats/")
+    assert stats["breaker_open"] is True     # breaker itself stays open
+
+
+def test_healthz_readyz_and_draining(client, workdir, monkeypatch):
+    """Lifecycle endpoints: /healthz always 200; /readyz 200 when clean,
+    503 while the scheduler registry is draining for shutdown."""
+    from penroz_tpu.serve import decode_scheduler
+    status, body = _json(client, "GET", "/healthz")
+    assert status == 200 and body["status"] == "ok"
+    status, body = _json(client, "GET", "/readyz")
+    assert status == 200 and body["ready"] is True
+    monkeypatch.setattr(decode_scheduler, "_DRAINING", True)
+    status, body = _json(client, "GET", "/readyz")
+    assert status == 503 and body["draining"] is True
+    _, stats = _json(client, "GET", "/serving_stats/")
+    assert stats["draining"] is True

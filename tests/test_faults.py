@@ -1,0 +1,104 @@
+"""Deterministic fault-injection registry (utils/faults.py).
+
+The registry is the substrate every PR-3 recovery test stands on, so its
+own semantics are pinned first: exact-Nth and open-ended raise rules,
+sleep rules, rule composition, the disabled fast path, and the ckpt.write
+production hook (a failed checkpoint write must roll back cleanly, never
+leave a torn file).
+"""
+
+import glob
+import os
+import time
+
+import numpy as np
+import pytest
+
+from penroz_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv(faults.ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def test_disabled_is_noop_and_counts_nothing():
+    for _ in range(3):
+        faults.check("decode.step")
+    assert faults.call_count("decode.step") == 0
+
+
+def test_raise_on_exact_nth_call(monkeypatch):
+    monkeypatch.setenv(faults.ENV, "decode.step:raise@2")
+    faults.check("decode.step")                      # call 1: fine
+    with pytest.raises(faults.InjectedFault, match="decode.step"):
+        faults.check("decode.step")                  # call 2: armed
+    faults.check("decode.step")                      # call 3: fine again
+    assert faults.call_count("decode.step") == 3
+
+
+def test_open_ended_raise_from_nth_call(monkeypatch):
+    monkeypatch.setenv(faults.ENV, "s:raise@2+")
+    faults.check("s")
+    for _ in range(3):
+        with pytest.raises(faults.InjectedFault):
+            faults.check("s")
+
+
+def test_rules_compose_and_sites_are_independent(monkeypatch):
+    monkeypatch.setenv(faults.ENV, "a:raise@1,a:raise@2,b:raise@1")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("a")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("a")
+    faults.check("a")                                # a survives call 3
+    with pytest.raises(faults.InjectedFault):
+        faults.check("b")                            # b has its own counter
+    faults.check("unarmed.site")                     # never armed: no-op
+
+
+def test_sleep_rule_sleeps_roughly_the_requested_ms(monkeypatch):
+    monkeypatch.setenv(faults.ENV, "slow:sleep@50")
+    t0 = time.monotonic()
+    faults.check("slow")
+    assert time.monotonic() - t0 >= 0.045
+
+
+def test_unparseable_rules_are_ignored_not_fatal(monkeypatch):
+    monkeypatch.setenv(faults.ENV, "garbage,a:explode@1,a:raise@nan,"
+                                   "a:raise@1")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("a")
+
+
+def test_reset_clears_counters_and_respec(monkeypatch):
+    monkeypatch.setenv(faults.ENV, "s:raise@1")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("s")
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):        # counter back to 0
+        faults.check("s")
+
+
+def test_ckpt_write_fault_rolls_back_cleanly(workdir, monkeypatch):
+    """The ckpt.write production hook: an injected write failure surfaces
+    to the caller and leaves NO file behind — neither the target nor a
+    temp sibling (the atomic-write contract under failure)."""
+    from penroz_tpu.utils import checkpoint
+    monkeypatch.setenv(faults.ENV, "ckpt.write:raise@1")
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):
+        checkpoint.save("faulty", {"status": {"code": "Created"},
+                                   "params": {"w": np.ones(4, np.float32)}},
+                        sync_flush=True)
+    leftovers = (glob.glob(os.path.join(checkpoint.SHM_PATH, "models", "*"))
+                 + glob.glob("models/*"))
+    assert leftovers == [], leftovers
+    # the next write (call 2, unarmed) succeeds
+    checkpoint.save("faulty", {"status": {"code": "Created"},
+                               "params": {"w": np.ones(4, np.float32)}},
+                    sync_flush=True)
+    assert checkpoint.load("faulty")["status"]["code"] == "Created"

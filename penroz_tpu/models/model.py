@@ -2387,6 +2387,52 @@ class NeuralNetworkModel:
                          jnp.asarray(row_len, jnp.int32), rng, temp)
         return int(np.asarray(tok)), kv_out
 
+    def decode_verify_row(self, kv_batch, row: int, tokens, row_len: int,
+                          rng, temperature=1.0, top_k=None):
+        """Speculative-decoding verify step for one row: one forward over
+        the row's T candidate tokens (``tokens[0]`` is the last sampled
+        token, the rest a drafter's proposals), sampling at EVERY position.
+
+        Same program family and write path as :meth:`decode_prefill_chunk`
+        (``row_view``/``merge_row`` over all four cache variants, appends
+        at ``row_len + [0, T)``) — the only difference is that all T
+        sampled tokens come back instead of the last one, so the scheduler
+        can accept the longest greedy-matching prefix and roll the row's
+        KV back past the rejected positions (``KVState.rollback_row``;
+        lengths here stay host-authoritative exactly as in the chunk
+        path).  Returns ``(list[int] of T sampled tokens, kv_batch')``.
+        Jits per (T, cache type, sampling) — keep draft lengths
+        power-of-two-bucketed so the program set stays bounded.  Donates
+        ``kv_batch`` — always thread the returned state.
+        """
+        greedy, temp = self._norm_temperature(temperature)
+        arch = self.arch
+        T = len(tokens)
+        key = ("verify_row", T, type(kv_batch).__name__, bool(greedy),
+               top_k, self._platform)
+        fn = arch._jit_cache.get(key)
+        if fn is None:
+            platform = self._platform
+
+            def verify_step(p, b, kvb, toks, r_idx, r_len, r, tmp):
+                view = kvb.row_view(r_idx, r_len)
+                acts, _, _, view2 = arch.forward(
+                    p, b, toks, None, kv=view, pos_offset=view.length,
+                    skip_softmax=True, compute_dtype=None,
+                    platform=platform)
+                logits = acts[-1]          # (1, T, V)
+                out = arch._sample(logits[0], r, tmp, greedy=greedy,
+                                   top_k=top_k)          # (T,)
+                return out, kvb.merge_row(r_idx, view2)
+
+            fn = arch._jit_cache[key] = jax.jit(verify_step,
+                                                donate_argnums=(2,))
+        x = jnp.asarray(np.asarray(tokens, np.int64)[None, :], jnp.int32)
+        out, kv_out = fn(self.params, self.buffers, kv_batch, x,
+                         jnp.asarray(row, jnp.int32),
+                         jnp.asarray(row_len, jnp.int32), rng, temp)
+        return [int(t) for t in np.asarray(out)], kv_out
+
     def decode_insert_row(self, kv_batch, row: int, kv_single):
         """Jitted per-row admission: drop a prefilled batch-1 state into
         row ``row`` of the persistent multi-row decode cache

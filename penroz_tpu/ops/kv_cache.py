@@ -189,24 +189,20 @@ class KVState:
         Does NOT advance ``length`` — the model runtime advances it once per
         step via ``advanced(T)`` after all layers have appended.
 
-        With RAGGED (B,) lengths (``with_lengths``) each sequence's row is
-        written at its own position; restricted to single-token appends
-        (T = 1) — the batched decode hot loop.
+        With RAGGED (B,) lengths (``with_lengths``) each sequence's T new
+        rows are written at its own positions ``length[b] + [0, T)`` —
+        T = 1 is the batched decode hot loop; T > 1 is the multi-token
+        speculative verify step (every row advances by the same candidate
+        count; ragged *acceptance* is a post-step length rewind, see
+        :meth:`rollback_row`).
         """
         ragged = jnp.ndim(self.length) >= 1
         if ragged:
-            T = k_new.shape[2]
-            if T != 1:
-                raise ValueError(
-                    f"ragged KVState appends require T=1 (per-sequence "
-                    f"write positions); got T={T}")
-            b_idx = jnp.arange(k_new.shape[0])
-            self.k[layer_idx] = self.k[layer_idx].at[
-                b_idx, :, self.length].set(
-                k_new[:, :, 0].astype(self.k[layer_idx].dtype))
-            self.v[layer_idx] = self.v[layer_idx].at[
-                b_idx, :, self.length].set(
-                v_new[:, :, 0].astype(self.v[layer_idx].dtype))
+            pos, b_idx = self._ragged_positions(k_new.shape)
+            self.k[layer_idx] = self.k[layer_idx].at[b_idx, :, pos].set(
+                k_new.transpose(0, 2, 1, 3).astype(self.k[layer_idx].dtype))
+            self.v[layer_idx] = self.v[layer_idx].at[b_idx, :, pos].set(
+                v_new.transpose(0, 2, 1, 3).astype(self.v[layer_idx].dtype))
         else:
             start = (0, 0, self.length, 0)
             self.k[layer_idx] = jax.lax.dynamic_update_slice(
@@ -217,6 +213,17 @@ class KVState:
                 start)
         new_length = self.length + k_new.shape[2]
         return self.k[layer_idx], self.v[layer_idx], new_length
+
+    def _ragged_positions(self, new_shape):
+        """(B, T) per-row write positions + (B, 1) batch indices for a
+        ragged append of ``new_shape`` = (B, H, T, D) rows.  The advanced-
+        index pair ``buf.at[b_idx, :, pos]`` addresses a (B, T, H, D) view,
+        so callers scatter ``new.transpose(0, 2, 1, 3)``."""
+        B, _, T, _ = new_shape
+        b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+        pos = (jnp.asarray(self.length, jnp.int32)[:, None]
+               + jnp.arange(T, dtype=jnp.int32)[None, :])
+        return pos, b_idx
 
     def advanced(self, num_tokens: int):
         """State with length advanced by ``num_tokens`` (post-step)."""
@@ -293,6 +300,30 @@ class KVState:
                              "(call with_lengths first)")
         return self._with_length(
             self.ragged_lengths.at[jnp.asarray(row, jnp.int32)].set(0))
+
+    def rollback_row(self, row, new_length):
+        """Rewind row ``row``'s valid length to ``new_length`` — the
+        speculative-decoding rejection path: a verify step appends K+1
+        candidate positions, then the rejected suffix is rolled back so
+        the next append overwrites it.
+
+        Purely a per-row length rewind (ragged states only): the rejected
+        K/V stays in place as garbage the per-row masks never attend, and
+        nothing is freed or zeroed.  On the paged variants this means a
+        rewind across a page boundary simply moves the next write position
+        back into an earlier (still-assigned) page of the row's table, and
+        pages the table merely *aliases* — refcount-pinned prefix-cache
+        pages — are never freed or written by the rollback itself.
+        Callers must not rewind below a row's aliased-prefix length: the
+        shared pages are read-only, and a subsequent append would write
+        into them.
+        """
+        if self.ragged_lengths is None:
+            raise ValueError("rollback_row requires ragged per-row lengths "
+                             "(call with_lengths first)")
+        return self._with_length(
+            self.ragged_lengths.at[jnp.asarray(row, jnp.int32)].set(
+                jnp.asarray(new_length, jnp.int32)))
 
     def row_view(self, row, length):
         """Batch-1 view of row ``row`` with scalar valid ``length`` — the
@@ -379,15 +410,15 @@ class QuantKVState(KVState):
         qk, sk = _quantize_int8(k_new)
         qv, sv = _quantize_int8(v_new)
         if jnp.ndim(self.length) >= 1:  # ragged: per-sequence positions
-            if k_new.shape[2] != 1:
-                raise ValueError(
-                    f"ragged int8 appends require T=1 (per-sequence write "
-                    f"positions); got T={k_new.shape[2]}")
-            b_idx = jnp.arange(k_new.shape[0])
+            # Quantize-and-store multi-token writes: T = 1 is the batched
+            # decode hot loop, T > 1 the speculative verify step (and any
+            # future ragged chunked prefill) — scales scatter to the same
+            # (B, T) positions as the int8 values.
+            pos, b_idx = self._ragged_positions(k_new.shape)
             for buf, new in ((self.k, qk), (self.v, qv),
                              (self.k_scale, sk), (self.v_scale, sv)):
-                buf[layer_idx] = buf[layer_idx].at[
-                    b_idx, :, self.length].set(new[:, :, 0])
+                buf[layer_idx] = buf[layer_idx].at[b_idx, :, pos].set(
+                    new.transpose(0, 2, 1, 3))
         else:
             start = (0, 0, self.length, 0)
             for buf, new in ((self.k, qk), (self.v, qv),
@@ -597,22 +628,22 @@ class PagedKVState(KVState):
         """Bump-allocate pages for ``T`` new tokens; returns the flat pool
         row index per (batch, token) plus the new valid length.
 
-        RAGGED (B,) lengths (``with_lengths``): each sequence's row lands
-        at its own position — T must be 1, mirroring the contiguous
-        ragged-append contract (the batched decode hot loop)."""
+        RAGGED (B,) lengths (``with_lengths``): each sequence's T rows
+        land at its own positions ``length[b] + [0, T)``, walking the
+        block table per position so a write may span a page boundary —
+        T = 1 is the batched decode hot loop, T > 1 the multi-token
+        speculative verify step (same contract as the contiguous ragged
+        append)."""
         new_length = self.length + T
         self._allocate(new_length)
         if jnp.ndim(self.length) >= 1:
-            if T != 1:
-                raise ValueError(
-                    f"ragged paged appends require T=1 (per-sequence "
-                    f"write positions); got T={T}")
             P = self.page_size
-            page = jnp.clip(self.length // P, 0, self.pages_per_seq - 1)
-            phys = jnp.take_along_axis(self.block_table, page[:, None],
-                                       axis=1)[:, 0]         # (B,)
-            rows = phys * P + self.length % P
-            return rows, new_length                          # rows: (B,)
+            pos = (jnp.asarray(self.length, jnp.int32)[:, None]
+                   + jnp.arange(T, dtype=jnp.int32)[None, :])   # (B, T)
+            page = jnp.clip(pos // P, 0, self.pages_per_seq - 1)
+            phys = jnp.take_along_axis(self.block_table, page, axis=1)
+            rows = phys * P + pos % P
+            return rows.reshape(-1), new_length     # rows: (B*T,), b-major
         pos = self.length + jnp.arange(T, dtype=jnp.int32)
         return self._rows(pos).reshape(-1), new_length  # rows: (B*T,)
 

@@ -525,8 +525,9 @@ async def model_stats(request: web.Request):
 
 async def serving_stats(request: web.Request):
     """Continuous-batching scheduler observability: queue depth, batch
-    occupancy, decode tokens/sec, admission latency, and the KV
-    pool-capacity drop counter (serve/decode_scheduler.py)."""
+    occupancy, decode tokens/sec, admission latency, speculative-decoding
+    accept rate / tokens per decode step, and the KV pool-capacity drop
+    counter (serve/decode_scheduler.py)."""
     from penroz_tpu.serve import decode_scheduler
     stats = decode_scheduler.serving_stats()
     # Validate against the documented schema so /serving_stats/ and the

@@ -24,6 +24,14 @@ rows are KV-cache slots (paged pool pages when ``PAGED_KV_CACHE=1``):
   only the suffix: repeated system prompts pay prefill once;
 - rows retire on stop-token / max_new_tokens and their slot is recycled
   immediately for the next queued request (``KVState.reset_row``);
+- with ``PENROZ_SPEC_DECODE=1`` (greedy engines only), each tick first
+  runs a multi-token **verify step** for every row whose prompt-lookup
+  drafter proposed candidates (``serve/spec_decode.py`` — the row's own
+  history is the draft model), accepting the longest greedy-matching
+  prefix + bonus token and rolling the row's KV back past rejections
+  (``KVState.rollback_row``); rows with no draft share one plain batched
+  step as before, so acceptance is ragged per row and a predictable row
+  can emit up to ``PENROZ_SPEC_K + 1`` tokens per decode step;
 - greedy outputs are token-identical to the single-sequence path with the
   prefix cache hitting, missing, or off, and with chunked or one-shot
   prefill (tested — the chunked program family is the same
@@ -70,11 +78,14 @@ Knobs: ``PENROZ_SCHED_MAX_ROWS`` (decode batch capacity, default 8),
 ``PENROZ_SCHED_ADMIT_MS`` (idle-burst coalescing window, default 0),
 ``PENROZ_SCHED_MAX_ENGINES`` (engine registry cap, default 4),
 ``PENROZ_PREFILL_CHUNK`` / ``PENROZ_SCHED_MAX_STALL_MS`` /
-``PENROZ_PREFIX_CACHE`` / ``PENROZ_PREFIX_CACHE_PAGES`` (above).
+``PENROZ_PREFIX_CACHE`` / ``PENROZ_PREFIX_CACHE_PAGES`` (above),
+``PENROZ_SPEC_DECODE`` / ``PENROZ_SPEC_K`` / ``PENROZ_SPEC_NGRAM``
+(serve/spec_decode.py).
 Observability: ``serving_stats()`` backs ``GET /serving_stats/`` — queue
 depth, batch occupancy, decode tokens/sec, admission latency, prefill
-chunk-stall p99, prefix-cache hit rate/evictions, and the KV
-pool-capacity drop counter (ops/kv_cache.py).
+chunk-stall p99, prefix-cache hit rate/evictions, speculative-decoding
+accept rate + tokens per decode step, and the KV pool-capacity drop
+counter (ops/kv_cache.py).
 
 This is the serving shape the ragged paged-attention kernel line of work
 exists for (PAPERS.md "Ragged Paged Attention"): per-row ragged KV lengths
@@ -97,7 +108,9 @@ import numpy as np
 from penroz_tpu.models import model as model_mod
 from penroz_tpu.models.model import NeuralNetworkModel
 from penroz_tpu.ops import kv_cache as KV
+from penroz_tpu.serve import spec_decode
 from penroz_tpu.utils import checkpoint, faults, profiling
+from penroz_tpu.utils import stats as stats_util
 
 log = logging.getLogger(__name__)
 
@@ -267,12 +280,15 @@ class Request:
 
 class _Row:
     __slots__ = ("req", "produced", "finished", "prefilling", "prefilled",
-                 "chunks", "chunk_idx", "prefix_nodes")
+                 "chunks", "chunk_idx", "prefix_nodes", "history")
 
     def __init__(self, req):
         self.req = req
         self.produced = 0
         self.finished = False
+        # prompt + every emitted token, in order — the prompt-lookup
+        # drafter's corpus (spec decode); bounded by block_size.
+        self.history = list(req.prompt)
         # PREFILLING phase state: ``prefilled`` is the row's KV valid length
         # so far (starts at the radix-matched prefix length); ``chunks`` is
         # the pow-2-bucketed plan covering the remaining suffix;
@@ -357,6 +373,10 @@ class DecodeEngine:
             maxlen=512)
         self._chunks_between_steps = 0
         self._max_chunks_between_steps = 0
+        # speculative decoding (PENROZ_SPEC_DECODE=1, greedy engines)
+        self._spec_verify_steps = 0
+        self._spec_drafted_tokens = 0
+        self._spec_accepted_tokens = 0
 
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -507,6 +527,15 @@ class DecodeEngine:
                 self._max_chunks_between_steps,
             "prefix_cache": (self._prefix_cache.stats()
                              if self._prefix_cache is not None else None),
+            "spec_decode": self._spec_on(),
+            "spec_verify_steps": self._spec_verify_steps,
+            "spec_drafted_tokens": self._spec_drafted_tokens,
+            "spec_accepted_tokens": self._spec_accepted_tokens,
+            "spec_accept_rate": stats_util.rate(self._spec_accepted_tokens,
+                                                self._spec_drafted_tokens),
+            "tokens_per_decode_step": round(
+                stats_util.rate(self._decode_tokens, self._decode_steps)
+                or 0.0, 3),
         }
 
     # -- worker loop --------------------------------------------------------
@@ -764,27 +793,27 @@ class DecodeEngine:
                 [page for _, page in created])
 
     def _step(self):
+        """One decode tick: a multi-token verify step for every row whose
+        drafter proposed candidates (spec decode), then ONE shared batched
+        step for the rest.  Counts as a single decode step either way —
+        ``tokens_per_decode_step`` is the speculation win."""
         faults.check("decode.step")
         t0 = time.monotonic()
-        rng = jax.random.fold_in(self._rng, self._dispatch)
-        self._dispatch += 1
-        with model_mod.decode_priority(), profiling.span("penroz/sched_step"):
-            toks, self._kv = self._model.decode_step_batched(
-                self._kv, self._last_tok[:, None], self._lengths, rng,
-                self.temperature, self.top_k)
-            arr = np.asarray(toks)
         self._max_chunks_between_steps = max(
             self._max_chunks_between_steps, self._chunks_between_steps)
         self._chunks_between_steps = 0
         active = self._decoding_rows()
         emitted = 0
-        for i in active:
-            state = self._rows[i]
-            self._lengths[i] += 1
-            tok = int(arr[i])
-            self._last_tok[i] = tok
-            emitted += 1
-            self._emit_token(i, state, tok)
+        plan = self._plan_drafts(active)
+        for row, draft in plan:
+            emitted += self._verify_row(row, draft)
+        drafted = {row for row, _ in plan}
+        # Rows without a draft (or with spec off) run the plain shared
+        # step; verified rows ride along parked — their discarded write
+        # lands at their next write position and is always overwritten.
+        normal = [i for i in self._decoding_rows() if i not in drafted]
+        if normal:
+            emitted += self._shared_step(normal)
         now = time.monotonic()
         self._decode_steps += 1
         self._decode_tokens += emitted
@@ -795,8 +824,99 @@ class DecodeEngine:
                and now - self._token_window[0][0] > _TPS_WINDOW_S):
             self._token_window.popleft()
 
+    def _shared_step(self, rows: list[int]) -> int:
+        """The pre-speculation hot loop: one batched decode+sample step
+        across every row, emitting for ``rows``.  Returns tokens emitted."""
+        rng = jax.random.fold_in(self._rng, self._dispatch)
+        self._dispatch += 1
+        with model_mod.decode_priority(), profiling.span("penroz/sched_step"):
+            toks, self._kv = self._model.decode_step_batched(
+                self._kv, self._last_tok[:, None], self._lengths, rng,
+                self.temperature, self.top_k)
+            arr = np.asarray(toks)
+        emitted = 0
+        for i in rows:
+            state = self._rows[i]
+            self._lengths[i] += 1
+            tok = int(arr[i])
+            self._last_tok[i] = tok
+            emitted += 1
+            self._emit_token(i, state, tok)
+        return emitted
+
+    # -- speculative decoding (PENROZ_SPEC_DECODE=1) -------------------------
+
+    def _spec_on(self) -> bool:
+        """Greedy engines only: accepting a drafted token under sampling
+        would need rejection-resampling to keep the output distribution —
+        non-greedy engines cleanly bypass drafting."""
+        return self.greedy and spec_decode.enabled()
+
+    def _plan_drafts(self, rows: list[int]) -> list[tuple[int, list[int]]]:
+        """(row, draft) pairs for this tick's verify steps.  The per-row
+        draft is capped so the verify step can neither write KV past
+        block_size nor draft beyond the request's remaining budget (a
+        draft longer than remaining-1 buys nothing: the bonus token
+        already covers the last position)."""
+        if not rows or not self._spec_on():
+            return []
+        k, n = spec_decode.draft_k(), spec_decode.ngram()
+        plan = []
+        for i in rows:
+            state = self._rows[i]
+            cap = min(k,
+                      state.req.max_new_tokens - state.produced - 1,
+                      self.block_size - 1 - int(self._lengths[i]))
+            if cap < 1:
+                continue
+            draft = spec_decode.propose(state.history, cap, n)
+            if draft:
+                plan.append((i, draft))
+        return plan
+
+    def _verify_row(self, row: int, draft: list[int]) -> int:
+        """Multi-token verify step for one row: one forward over the K+1
+        candidate positions (last token + K drafted), emit the longest
+        greedy-matching prefix plus the model's bonus token, and roll the
+        row's KV back past the rejected positions.  Returns tokens
+        emitted (1..K+1; a fully rejected draft still yields the bonus
+        token, so a verify step never emits less than a plain step)."""
+        faults.check("decode.verify")
+        state = self._rows[row]
+        start = int(self._lengths[row])
+        tokens = [int(self._last_tok[row])] + [int(t) for t in draft]
+        rng = jax.random.fold_in(self._rng, self._dispatch)
+        self._dispatch += 1
+        with model_mod.decode_priority(), \
+                profiling.span("penroz/sched_verify"):
+            out, self._kv = self._model.decode_verify_row(
+                self._kv, row, tokens, start, rng, self.temperature,
+                self.top_k)
+        accepted = spec_decode.accept_length(draft, out)
+        self._spec_verify_steps += 1
+        self._spec_drafted_tokens += len(draft)
+        self._spec_accepted_tokens += accepted
+        # The verify wrote K+1 fresh KV positions, but only the first
+        # accepted+1 were fed the tokens greedy decoding would feed —
+        # rewind past the rest (the bonus token's own KV is written by
+        # the NEXT step that feeds it, exactly like the plain path).
+        new_len = start + accepted + 1
+        self._kv = self._kv.rollback_row(row, new_len)
+        self._lengths[row] = new_len
+        emitted = 0
+        for tok in out[:accepted + 1]:
+            self._last_tok[row] = tok
+            emitted += 1
+            self._emit_token(row, state, tok)
+            if self._rows[row] is not state:
+                break   # retired mid-acceptance (stop token / budget /
+                # deadline / cancel): the remaining accepted tokens are
+                # discarded, matching the plain path's stop exactly.
+        return emitted
+
     def _emit_token(self, row: int, state: _Row, tok: int):
         state.produced += 1
+        state.history.append(tok)
         self._deliver(state.req, "token", tok)
         req = state.req
         if req.cancelled:
@@ -1024,6 +1144,10 @@ def serving_stats() -> dict:
     pc = [p["prefix_cache"] for p in per if p["prefix_cache"] is not None]
     pc_lookups = sum(c["hits"] + c["misses"] for c in pc)
     queue_wait_p99 = _p99([x for e in engines for x in e._queue_wait_ms])
+    spec_drafted = sum(p["spec_drafted_tokens"] for p in per)
+    spec_accepted = sum(p["spec_accepted_tokens"] for p in per)
+    decode_steps = sum(p["decode_steps"] for p in per)
+    decode_tokens = sum(p["decode_tokens"] for p in per)
     return {
         "continuous_batching_enabled": enabled(),
         "engines": per,
@@ -1048,6 +1172,12 @@ def serving_stats() -> dict:
         "prefix_cache_hit_rate": (
             sum(c["hits"] for c in pc) / pc_lookups if pc_lookups else None),
         "prefix_cache_evicted_pages": sum(c["evicted_pages"] for c in pc),
+        "spec_decode_enabled": spec_decode.enabled(),
+        "spec_drafted_tokens": spec_drafted,
+        "spec_accepted_tokens": spec_accepted,
+        "spec_accept_rate": stats_util.rate(spec_accepted, spec_drafted),
+        "tokens_per_decode_step": round(
+            stats_util.rate(decode_tokens, decode_steps) or 0.0, 3),
         "kv_pool_capacity_drops": KV.pool_drop_count(),
     }
 

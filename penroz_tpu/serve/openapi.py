@@ -171,7 +171,8 @@ def _routes() -> list[dict]:
              summary="Continuous-batching scheduler stats: queue depth, "
                      "batch occupancy, decode tokens/sec, admission "
                      "latency, prefill chunk-stall p99, prefix-cache hit "
-                     "rate/evictions, KV pool-drop counter",
+                     "rate/evictions, speculative-decoding accept rate + "
+                     "tokens per decode step, KV pool-drop counter",
              responses={"200": {
                  "description": "Serving statistics",
                  "content": {"application/json": {"schema": {

@@ -177,6 +177,25 @@ class EngineStats(BaseModel):
                                "engine lifetime")
     engine_resets: int = Field(0, description="Full KV/prefix-state "
                                "reallocations after crashes")
+    spec_decode: bool = Field(False, description="Speculative decoding "
+                              "active on this engine (PENROZ_SPEC_DECODE=1 "
+                              "and greedy sampling; non-greedy engines "
+                              "bypass drafting)")
+    spec_verify_steps: int = Field(0, description="Multi-token verify "
+                                   "dispatches (one per drafted row per "
+                                   "decode tick)")
+    spec_drafted_tokens: int = Field(0, description="Prompt-lookup draft "
+                                     "tokens proposed (PENROZ_SPEC_K cap)")
+    spec_accepted_tokens: int = Field(0, description="Draft tokens the "
+                                      "verify step accepted (greedy-"
+                                      "matching prefix)")
+    spec_accept_rate: Optional[float] = Field(
+        None, description="spec_accepted_tokens / spec_drafted_tokens "
+        "(null before any draft)")
+    tokens_per_decode_step: float = Field(
+        0.0, description="decode_tokens / decode_steps — >1 per active "
+        "row means speculation is paying (a plain step emits exactly one "
+        "token per decoding row)")
 
 
 class ServingStatsResponse(BaseModel):
@@ -212,6 +231,19 @@ class ServingStatsResponse(BaseModel):
         "when no engine runs a prefix cache)")
     prefix_cache_evicted_pages: int = Field(
         0, description="Aggregate LRU-evicted prefix-cache pages")
+    spec_decode_enabled: bool = Field(False, description="PENROZ_SPEC_DECODE"
+                                      "=1 (greedy engines draft via prompt "
+                                      "lookup + multi-token verify steps)")
+    spec_drafted_tokens: int = Field(0, description="Aggregate draft "
+                                     "tokens proposed")
+    spec_accepted_tokens: int = Field(0, description="Aggregate draft "
+                                      "tokens accepted")
+    spec_accept_rate: Optional[float] = Field(
+        None, description="Aggregate accepted/drafted (null before any "
+        "draft)")
+    tokens_per_decode_step: float = Field(
+        0.0, description="Aggregate decode_tokens / decode_steps across "
+        "engines")
     kv_pool_capacity_drops: int = Field(..., description="KV writes dropped "
                                         "at pool capacity (process-wide; "
                                         "ops/kv_cache.py record_pool_drop)")

@@ -1,0 +1,105 @@
+"""Draft-free speculative decoding: prompt-lookup drafts + acceptance.
+
+Every decode step without speculation advances a row by exactly one token
+— inter-token latency is pinned to one full forward dispatch per token no
+matter how predictable the text is.  Prompt lookup (the "n-gram copy"
+drafter: arXiv:2304.04487-adjacent, no second model) attacks the highly
+predictable case directly: if the trailing ``n``-gram of a row's context
+(prompt + generated tokens) occurred earlier in that same context, the
+tokens that followed it are proposed as a draft, and the scheduler's
+**verify step** runs ONE forward over the K+1 candidate positions
+(``NeuralNetworkModel.decode_verify_row``), accepting the longest
+greedy-matching prefix plus the model's bonus token.  Rejections roll the
+row's KV length back (``KVState.rollback_row``), so a wrong draft costs
+one multi-token forward instead of wrong output — greedy results are
+token-identical to speculation off by construction.
+
+Greedy-only: under sampling, accepting a draft token would need the full
+rejection-resampling scheme to keep the output distribution; non-greedy
+engines bypass drafting entirely (the scheduler checks ``engine.greedy``).
+
+Knobs::
+
+    PENROZ_SPEC_DECODE=1   enable (scheduler path, greedy engines)
+    PENROZ_SPEC_K          max draft tokens per verify step (default 4)
+    PENROZ_SPEC_NGRAM      trailing-match length (default 3)
+
+This module is pure host-side policy (which tokens to propose, how many
+matched); the device work lives in models/model.py (verify dispatch) and
+ops/kv_cache.py (multi-token appends + rollback).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+ENABLE_ENV = "PENROZ_SPEC_DECODE"
+K_ENV = "PENROZ_SPEC_K"
+NGRAM_ENV = "PENROZ_SPEC_NGRAM"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENABLE_ENV, "0") == "1"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, str(default))))
+    except ValueError:
+        log.warning("Unparseable %s=%r; using default %d", name,
+                    os.environ.get(name), default)
+        return default
+
+
+def draft_k() -> int:
+    """Max draft tokens proposed per verify step (``PENROZ_SPEC_K``)."""
+    return _env_int(K_ENV, 4)
+
+
+def ngram() -> int:
+    """Trailing-n-gram match length (``PENROZ_SPEC_NGRAM``)."""
+    return _env_int(NGRAM_ENV, 3)
+
+
+def propose(history, k: int, n: int) -> list[int]:
+    """Up to ``k`` draft tokens for the next positions of ``history``
+    (prompt + generated so far) by prompt lookup: find the most recent
+    *earlier* occurrence of the trailing ``n``-gram and propose the
+    tokens that followed it.  Returns ``[]`` when nothing matched.
+
+    The draft is truncated to a power-of-two length so the jitted
+    verify-program set stays bounded (T = len+1 ∈ {2, 3, 5, 9, …} per
+    cache type), mirroring the prefill chunk-plan bucketing.  The scan is
+    O(len(history) · n) per call — host-side, off the device hot path,
+    and bounded by block_size at serving scale.
+    """
+    L = len(history)
+    if k < 1 or L <= n:
+        return []
+    pattern = list(history[-n:])
+    for i in range(L - n - 1, -1, -1):
+        if list(history[i:i + n]) == pattern:
+            cont = history[i + n:i + n + k]
+            if not cont:
+                return []
+            keep = 1 << (len(cont).bit_length() - 1)
+            return [int(t) for t in cont[:keep]]
+    return []
+
+
+def accept_length(draft, out) -> int:
+    """Number of draft tokens accepted: ``draft[j]`` is accepted iff it
+    equals ``out[j]`` — the model's (greedy) token after consuming
+    positions ≤ j — and every earlier draft token was accepted.  The
+    scheduler then emits ``out[:accepted + 1]``: the accepted tokens plus
+    the model's bonus token at the first divergent position, exactly the
+    sequence ``accepted + 1`` plain decode steps would have produced."""
+    a = 0
+    for d, o in zip(draft, out):
+        if int(d) != int(o):
+            break
+        a += 1
+    return a

@@ -234,6 +234,15 @@ function renderServing(data) {
     : `prefix hits ${(hitRate * 100).toFixed(0)}% · evicted ` +
       `${data.prefix_cache_evicted_pages || 0} pages`;
   const stall = data.prefill_chunk_stall_ms_p99;
+  /* Speculative decoding (PENROZ_SPEC_DECODE=1): accept rate of the
+   * prompt-lookup drafts and tokens emitted per decode step — the >1
+   * tokens/step headroom speculation buys (null-safe: accept rate is
+   * null until the first draft). */
+  const acceptRate = data.spec_accept_rate;
+  const specTxt = !data.spec_decode_enabled ? "spec off"
+    : `spec accept ${acceptRate == null ? "—"
+         : (acceptRate * 100).toFixed(0) + "%"} · ` +
+      `${(data.tokens_per_decode_step || 0).toFixed(2)} tok/step`;
   /* Fault-tolerance readouts (PR 3): shed/timeout counters and the engine
    * circuit breaker — an open breaker is the "stop paging the dashboard,
    * the engine is crash-looping" signal. */
@@ -252,7 +261,7 @@ function renderServing(data) {
     `${data.admission_latency_ms_p50 == null ? "—"
        : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
-    `${prefixTxt} · KV pool drops ${drops}`;
+    `${specTxt} · ${prefixTxt} · KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();
   const xs = servingHistory.map((_, i) => i);

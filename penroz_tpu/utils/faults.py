@@ -23,7 +23,8 @@ Spec grammar (comma-separated rules)::
   stall / overload-window paths).
 
 Registered production sites: ``decode.step`` (shared decode step),
-``decode.prefill_chunk`` (admission prefill chunk), ``ckpt.write``
+``decode.prefill_chunk`` (admission prefill chunk), ``decode.verify``
+(speculative-decoding multi-token verify step), ``ckpt.write``
 (checkpoint container write), ``data.download`` (dataset download
 attempt).  Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.

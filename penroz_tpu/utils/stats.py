@@ -15,6 +15,14 @@ import numpy as np
 HIST_BINS = 100  # torch.histogram's default bin count
 
 
+def rate(numerator, denominator):
+    """``numerator / denominator`` or None when the denominator is zero —
+    the serving-stats ratio convention (spec-decode accept rate, tokens
+    per decode step, prefix-cache hit rate): None keeps "never ran"
+    distinct from "ran and measured 0"."""
+    return (numerator / denominator) if denominator else None
+
+
 def histogram(a: np.ndarray):
     """(bin_left_edges, density) matching torch.histogram(density=True)."""
     a = np.asarray(a, np.float32).ravel()

@@ -40,6 +40,21 @@ file for ``bench_watch.sh``-style artifact capture.  Scale knobs (env):
 ``PENROZ_BENCH_SUFFIX_LEN``, ``PENROZ_BENCH_REQUESTS``,
 ``PENROZ_BENCH_PREFIX_PAGE`` (KV page size), ``PENROZ_BENCH_CHUNK``
 (prefill chunk).
+
+``--speculative`` switches to the speculative-decoding workload:
+sequential streaming requests over repetitive-text prompts (short token
+motifs repeated — the shape prompt lookup exists for), measured with
+``PENROZ_SPEC_DECODE`` OFF then ON, reporting ITL p50/p99 and — the
+headline — **tokens per decode step** per phase plus the draft accept
+rate.  Sequential single-row traffic pins the off-phase at exactly 1.0
+token/step, so the on/off ratio isolates what speculation buys.  Greedy
+parity is asserted between phases (the verify step must never trade
+correctness for speed).  Every mode's JSON capture now carries the
+aggregate ``tokens_per_decode_step`` + ``spec_accept_rate`` fields via
+``serving_stats``.  Scale knobs: ``PENROZ_BENCH_SPEC_K``,
+``PENROZ_BENCH_SPEC_NGRAM``, ``PENROZ_BENCH_SPEC_PROMPT``,
+``PENROZ_BENCH_SPEC_VOCAB``, plus the shared ``PENROZ_BENCH_SERVING_*`` /
+``PENROZ_BENCH_REQUESTS`` / ``PENROZ_BENCH_MAX_NEW`` set.
 """
 
 from __future__ import annotations
@@ -405,6 +420,113 @@ async def _bench_shared_prefix() -> dict:
                 os.environ[k] = v
 
 
+# ---------------------------------------------------------------------------
+# --speculative: prompt-lookup draft + multi-token verify (tokens/step)
+# ---------------------------------------------------------------------------
+
+async def _bench_speculative() -> dict:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler, spec_decode
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 256)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 256)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 4)
+    requests = _env_i("PENROZ_BENCH_REQUESTS", 4)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 48)
+    k = _env_i("PENROZ_BENCH_SPEC_K", 4)
+    n = _env_i("PENROZ_BENCH_SPEC_NGRAM", 2)
+    prompt_len = _env_i("PENROZ_BENCH_SPEC_PROMPT", 32)
+    vocab = _env_i("PENROZ_BENCH_SPEC_VOCAB", 128)
+    assert prompt_len + max_new <= block
+
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        spec_decode.K_ENV: str(k),
+        spec_decode.NGRAM_ENV: str(n),
+    }
+    saved = {key: os.environ.get(key)
+             for key in (*env, spec_decode.ENABLE_ENV)}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(0)
+
+    def motif_prompt(seed):
+        """Repetitive text: a 4-token motif tiled to prompt_len — the
+        trailing n-gram always has earlier occurrences, and greedy toy
+        models lock into short cycles the drafter then predicts."""
+        motif = [int(t) for t in np.random.default_rng(seed).integers(
+            1, vocab - 1, 4)]
+        return (motif * (prompt_len // 4 + 1))[:prompt_len]
+
+    prompts = [motif_prompt(100 + i) for i in range(requests)]
+    warm = motif_prompt(7)
+
+    def payload(prompt):
+        return {"model_id": "bench-spec", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-spec",
+            "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+
+        results: dict = {
+            "mode": "speculative", "block_size": block,
+            "prompt_len": prompt_len, "requests": requests,
+            "max_new_tokens": max_new, "spec_k": k, "spec_ngram": n,
+            "vocab": vocab, "model_d": d, "model_depth": depth,
+        }
+        sequences = {}
+        for phase in ("off", "on"):
+            os.environ[spec_decode.ENABLE_ENV] = \
+                "1" if phase == "on" else "0"
+            decode_scheduler.reset()  # fresh engine (+ counters) per phase
+            # Warm with a DISTINCT motif: compiles the decode/chunk
+            # programs and (on) the verify-program family, so the timed
+            # ITLs measure serving, not XLA.
+            await _stream_one(client, payload(warm))
+            itls, seqs = [], []
+            for prompt in prompts:
+                toks, _, gaps = await _stream_one(client, payload(prompt))
+                itls.extend(gaps)
+                seqs.append(toks)
+            sequences[phase] = seqs
+            resp = await client.get("/serving_stats/")
+            stats = await resp.json()
+            results[f"spec_{phase}"] = {
+                "itl_ms_p50": (round(_pct(itls, 0.5), 3) if itls else None),
+                "itl_ms_p99": (round(_pct(itls, 0.99), 3) if itls else None),
+                "tokens_per_decode_step": stats["tokens_per_decode_step"],
+                "spec_accept_rate": stats["spec_accept_rate"],
+                "spec_drafted_tokens": stats["spec_drafted_tokens"],
+                "spec_accepted_tokens": stats["spec_accepted_tokens"],
+            }
+        results["parity_ok"] = sequences["off"] == sequences["on"]
+        off_tps = results["spec_off"]["tokens_per_decode_step"]
+        on_tps = results["spec_on"]["tokens_per_decode_step"]
+        results["tokens_per_step_speedup_on_vs_off"] = (
+            round(on_tps / off_tps, 3) if off_tps else None)
+        results["itl_p50_speedup_on_vs_off"] = round(
+            results["spec_off"]["itl_ms_p50"]
+            / results["spec_on"]["itl_ms_p50"], 3)
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for key, v in saved.items():
+            if v is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = v
+
+
 def _emit(results: dict):
     line = json.dumps(results)
     print(line)
@@ -416,9 +538,10 @@ def _emit(results: dict):
 
 def main():
     args = [a for a in sys.argv[1:]
-            if a not in ("--shared-prefix", "--overload")]
+            if a not in ("--shared-prefix", "--overload", "--speculative")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
+    speculative = "--speculative" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
@@ -432,6 +555,9 @@ def main():
         return
     if shared_prefix:
         _emit(asyncio.run(_bench_shared_prefix()))
+        return
+    if speculative:
+        _emit(asyncio.run(_bench_speculative()))
         return
     concurrency = int(args[0]) if len(args) > 0 else 8
     max_new = int(args[1]) if len(args) > 1 else 48

@@ -73,6 +73,19 @@ while true; do
           -- "BENCH_SHARED_PREFIX_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) shared-prefix capture committed" >> logs/bench_watch.log
     fi
+    # Speculative-decoding capture (same shape as the shared-prefix hook):
+    # tokens/decode-step + accept rate with spec on vs off.  Opt-in;
+    # failures must not block the main capture.
+    if [ "${PENROZ_WATCH_SPEC:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_SPEC_DECODE_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --speculative \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_SPEC_DECODE_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: speculative-decoding capture" \
+          -- "BENCH_SPEC_DECODE_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) speculative capture committed" >> logs/bench_watch.log
+    fi
     if [ "$rc" -eq 0 ]; then
       python - "$SNAP" "$attempt" <<'EOF' 2>> logs/bench_watch.log
 import json, sys, time

@@ -158,6 +158,13 @@ async function runDashboardTests(src, fixtures) {
     assertOk(servingMeta.includes(
                `breaker ok (${fixtures.serving.crashes_total} crashes)`),
              "serving tile shows closed breaker + crash counter");
+    assertOk(servingMeta.includes("spec accept " +
+               (fixtures.serving.spec_accept_rate * 100).toFixed(0) + "%"),
+             "serving tile shows speculative-decoding accept rate");
+    assertOk(servingMeta.includes(
+               fixtures.serving.tokens_per_decode_step.toFixed(2) +
+               " tok/step"),
+             "serving tile shows tokens per decode step");
     const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
     assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];
@@ -193,11 +200,13 @@ async function runDashboardTests(src, fixtures) {
              "serving tile reports unavailable endpoint without crashing");
   }
 
-  // 2b. serving stats without prefix-cache fields (cache off / older
-  //     server): tile renders the off state instead of crashing on nulls
+  // 2b. serving stats without prefix-cache / spec-decode fields (features
+  //     off / older server): tile renders the off states instead of
+  //     crashing on nulls
   {
     const servingOff = Object.assign({}, fixtures.serving, {
-      prefix_cache_hit_rate: null, prefill_chunk_stall_ms_p99: null });
+      prefix_cache_hit_rate: null, prefill_chunk_stall_ms_p99: null,
+      spec_decode_enabled: false, spec_accept_rate: null });
     const { document } = await runDashboard(src, {
       progress: fixtures.progress, stats: fixtures.statsPlain,
       serving: servingOff });
@@ -206,6 +215,23 @@ async function runDashboardTests(src, fixtures) {
              "serving tile degrades to 'prefix cache off' on null hit rate");
     assertOk(servingMeta.includes("chunk stall p99 —"),
              "serving tile dashes a null chunk-stall p99");
+    assertOk(servingMeta.includes("spec off"),
+             "serving tile shows 'spec off' when speculation is disabled");
+    assertOk(!servingMeta.includes("tok/step"),
+             "no tokens-per-step readout while speculation is off");
+  }
+
+  // 2d. spec decode enabled but no draft yet: accept rate dashes instead
+  //     of pretending a measurement exists
+  {
+    const servingIdle = Object.assign({}, fixtures.serving, {
+      spec_accept_rate: null });
+    const { document } = await runDashboard(src, {
+      progress: fixtures.progress, stats: fixtures.statsPlain,
+      serving: servingIdle });
+    assertOk(document.byId["serving-meta"].textContent.includes(
+               "spec accept —"),
+             "serving tile dashes the accept rate before any draft");
   }
 
   // 2c. open circuit breaker + draining flag: the tile surfaces the

@@ -313,6 +313,10 @@ def test_serving_stats_disabled_and_openapi(client, workdir):
     assert stats["breaker_open"] is False
     assert stats["crashes_total"] == 0
     assert stats["draining"] is False
+    # speculative-decoding aggregates present from day zero
+    assert stats["spec_decode_enabled"] is False
+    assert stats["spec_accept_rate"] is None
+    assert stats["tokens_per_decode_step"] == 0.0
     status, spec = _json(client, "GET", "/openapi.json")
     assert "/serving_stats/" in spec["paths"]
     assert "/healthz" in spec["paths"]
@@ -919,3 +923,232 @@ def test_healthz_readyz_and_draining(client, workdir, monkeypatch):
     assert status == 503 and body["draining"] is True
     _, stats = _json(client, "GET", "/serving_stats/")
     assert stats["draining"] is True
+
+
+# -- speculative decoding: prompt-lookup drafts + verify steps (PR 4) --------
+
+REP_PROMPT = [1, 2, 3, 1, 2, 3, 1, 2]   # repetitive text: 2 pages of 4
+
+
+@pytest.fixture
+def spec_env(monkeypatch):
+    """Spec decode on, with the aggressive 1-gram matcher so toy streams
+    (which lock into short cycles) draft early."""
+    monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+    monkeypatch.setenv("PENROZ_SPEC_NGRAM", "1")
+    return monkeypatch
+
+
+def _oracle_drafter(bases):
+    """Draft the exact greedy continuation (from the precomputed standalone
+    sequences) — deterministic full acceptance, so the verify/rollback
+    path provably runs and multi-token emission is exercised."""
+    def propose(history, k, n):
+        for base in bases:
+            if len(history) < len(base) and history == base[:len(history)]:
+                return [int(t) for t in base[len(history):len(history) + k]]
+        return []
+    return propose
+
+
+@pytest.mark.parametrize("paged_prefix,int8,chunk", [
+    (paged, int8, chunk)
+    for paged in (0, 1) for int8 in (0, 1) for chunk in ("16", "2")])
+def test_spec_parity_matrix(gpt_model, make_engine, monkeypatch,
+                            paged_prefix, int8, chunk):
+    """THE acceptance matrix: greedy outputs with PENROZ_SPEC_DECODE=1 are
+    token-identical to spec-off across prefix cache on/off, int8 KV
+    on/off (all four cache variants) and chunked/one-shot prefill — with
+    the verify path provably engaged (oracle drafts, full acceptance)."""
+    from penroz_tpu.serve import spec_decode
+    if paged_prefix:
+        monkeypatch.setenv("PAGED_KV_CACHE", "1")
+        monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    if int8:
+        monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", chunk)
+    monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+    # spec-off baseline: the legacy path under the same KV env flags
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                     temperature=0.0)
+    monkeypatch.setattr(spec_decode, "propose", _oracle_drafter([base]))
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    # second request: a prefix-cache HIT when the cache is on
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["spec_decode"] is True
+    assert stats["spec_verify_steps"] > 0
+    assert stats["spec_drafted_tokens"] > 0
+    assert stats["spec_accept_rate"] == 1.0          # oracle drafts
+    assert stats["tokens_per_decode_step"] > 1.0
+    if paged_prefix:
+        assert stats["prefix_cache"]["hits"] >= 1
+
+
+def test_spec_real_drafter_parity(gpt_model, make_engine, spec_env):
+    """The real prompt-lookup drafter (no oracle): repetitive prompt +
+    1-gram matching — parity is exact whatever the accept rate lands at,
+    and drafting provably engaged on the toy stream's cycles."""
+    prompt = [1, 2, 3, 1, 2]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 11, temperature=0.0)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, prompt, 11).result() == base
+    stats = engine.stats()
+    assert stats["spec_drafted_tokens"] > 0
+    assert 0.0 <= stats["spec_accept_rate"] <= 1.0
+
+
+def test_spec_adversarial_drafter_zero_accept_keeps_parity(
+        gpt_model, make_engine, spec_env):
+    """An always-wrong drafter costs accept rate, never correctness: every
+    draft token is rejected (accept_rate == 0), each verify step's bonus
+    token still advances the row, and the stream is token-identical."""
+    from penroz_tpu.serve import spec_decode
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                     temperature=0.0)
+
+    def wrong(history, k, n):
+        nxt = base[len(history)] if len(history) < len(base) else 0
+        return [(int(nxt) + 1) % 64] * min(k, 2)   # first token always wrong
+
+    spec_env.setattr(spec_decode, "propose", wrong)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["spec_drafted_tokens"] > 0
+    assert stats["spec_accepted_tokens"] == 0
+    assert stats["spec_accept_rate"] == 0.0
+    assert stats["tokens_per_decode_step"] == pytest.approx(1.0)
+
+
+def test_spec_stop_token_inside_accepted_draft(gpt_model, make_engine,
+                                               spec_env):
+    """A stop token accepted mid-draft retires the row exactly where the
+    plain path would: the tokens after the stop are discarded even though
+    the verify step accepted them."""
+    from penroz_tpu.serve import spec_decode
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 8,
+                                     temperature=0.0)
+    stop = base[len(REP_PROMPT) + 2]               # third generated token
+    base_stop = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 8,
+                                          temperature=0.0, stop_token=stop)
+    spec_env.setattr(spec_decode, "propose", _oracle_drafter([base]))
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, REP_PROMPT, 8, stop_token=stop).result() \
+        == base_stop
+    assert engine.stats()["spec_verify_steps"] > 0
+    assert engine.active_rows == 0
+
+
+def test_spec_mid_flight_admission_during_verify(gpt_model, make_engine,
+                                                 spec_env):
+    """A new row admitted while another row advances through verify steps:
+    both keep their standalone streams (the newcomer prefills between
+    ticks; the verifying row's rollbacks never touch other rows)."""
+    from penroz_tpu.serve import spec_decode
+    pa, pb = REP_PROMPT, [5, 6, 5, 6]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 7, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 5, temperature=0.0)
+    spec_env.setattr(spec_decode, "propose",
+                     _oracle_drafter([base_a, base_b]))
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    ca = _submit(engine, pa, 7)
+    _wait_tokens(ca, 2)            # A provably mid-generation
+    cb = _submit(engine, pb, 5)
+    assert cb.result() == base_b
+    assert ca.result() == base_a
+    stats = engine.stats()
+    assert stats["spec_verify_steps"] > 0
+    assert stats["completed"] == 2
+
+
+def test_spec_non_greedy_engine_bypasses_drafting(gpt_model, make_engine,
+                                                  spec_env):
+    """Non-greedy engines cleanly bypass drafting (acceptance under
+    sampling would need rejection-resampling): the request completes and
+    no draft is ever proposed."""
+    engine = make_engine("schedgpt", BLOCK, 0.8, 4, capacity=2)
+    result = _submit(engine, [1, 2, 3], 4).result()
+    assert len(result) == 7
+    stats = engine.stats()
+    assert stats["spec_decode"] is False
+    assert stats["spec_drafted_tokens"] == 0
+    assert stats["spec_verify_steps"] == 0
+
+
+def _radix_nodes(cache):
+    nodes, stack = [], list(cache._root.children.values())
+    while stack:
+        nd = stack.pop()
+        nodes.append(nd)
+        stack.extend(nd.children.values())
+    return nodes
+
+
+def test_spec_verify_crash_recovers_with_parity(gpt_model, make_engine,
+                                                spec_env, prefix_env):
+    """Fault site decode.verify: a crash during a verify step fails the
+    request cleanly, the engine reallocates its KV + prefix state
+    (_alloc_state), and the next identical request is greedy-identical
+    with no leaked paged blocks or pinned prefix pages."""
+    from penroz_tpu.serve import spec_decode
+    from penroz_tpu.utils import faults
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                     temperature=0.0)
+    spec_env.setattr(spec_decode, "propose", _oracle_drafter([base]))
+    spec_env.setenv(faults.ENV, "decode.verify:raise@1")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, REP_PROMPT, 6).result()
+    spec_env.delenv(faults.ENV)
+    faults.reset()
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["crashes_total"] == 1
+    assert stats["engine_resets"] == 1
+    assert stats["breaker_open"] is False
+    assert engine.active_rows == 0
+    # no leaked pool state: every radix page accounted for, nothing pinned
+    cache = engine._prefix_cache
+    assert cache.free_pages + cache.cached_pages == cache.capacity_pages
+    assert all(nd.refs == 0 for nd in _radix_nodes(cache))
+
+
+def test_spec_http_serving_stats_and_streaming(client, gpt_model,
+                                               monkeypatch):
+    """End to end over HTTP: spec decode on the scheduler path keeps
+    /generate/ token-identical (buffered + streaming), and
+    /serving_stats/ carries the new spec fields through the schema."""
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    payload = _gen_payload(input=[[1, 2, 3, 1, 2]], max_new_tokens=9)
+    status, legacy = _json(client, "POST", "/generate/", json=payload)
+    assert status == 200
+    monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+    monkeypatch.setenv("PENROZ_SPEC_NGRAM", "1")
+    status, routed = _json(client, "POST", "/generate/", json=payload)
+    assert status == 200
+    assert routed["tokens"] == legacy["tokens"]
+
+    test_client, loop = client
+
+    async def go():
+        resp = await test_client.post("/generate/",
+                                      json=dict(payload, stream=True))
+        assert resp.status == 200
+        return (await resp.read()).decode()
+
+    body = loop.run_until_complete(go())
+    streamed = [int(line) for line in body.strip().split("\n")]
+    assert streamed == legacy["tokens"][5:]
+
+    status, stats = _json(client, "GET", "/serving_stats/")
+    assert status == 200
+    assert stats["spec_decode_enabled"] is True
+    assert stats["spec_drafted_tokens"] >= 0
+    assert stats["tokens_per_decode_step"] >= 1.0
+    engine = stats["engines"][0]
+    assert engine["spec_decode"] is True
+    assert "spec_accept_rate" in engine

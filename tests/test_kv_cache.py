@@ -558,3 +558,166 @@ def test_create_kv_state_extra_pool_pages(monkeypatch):
     state = KV.create_kv_state([(1, 4)], batch=2, max_len=8,
                                extra_pool_pages=3)
     assert type(state) is KV.KVState
+
+
+# -- ragged multi-token appends + per-row rollback (speculative decoding) ----
+
+ALL_VARIANTS = [
+    (KV.KVState, {}),
+    (KV.QuantKVState, {}),
+    (KV.PagedKVState, {"page_size": 4}),
+    (KV.QuantPagedKVState, {"page_size": 4}),
+]
+
+
+def _ragged_append(state, layer, k, v):
+    """Variant-dispatching raw append (the decode/verify write path)."""
+    if isinstance(state, KV.PagedKVState):
+        return state.append_rows(layer, jnp.asarray(k), jnp.asarray(v))
+    if state.quantized:
+        return state.append_raw(layer, jnp.asarray(k), jnp.asarray(v))
+    return state.append(layer, jnp.asarray(k), jnp.asarray(v))
+
+
+def _read_k(state, layer=0):
+    """(B, H, S, D) raw storage view of layer ``layer``'s keys."""
+    if isinstance(state, KV.PagedKVState):
+        return np.asarray(state._gather(state.k[layer]))
+    return np.asarray(state.k[layer])
+
+
+@pytest.mark.parametrize("cls,kw", ALL_VARIANTS)
+@pytest.mark.parametrize("T", [1, 2, 4])
+def test_ragged_multi_token_append_matches_sequential(cls, kw, T):
+    """Satellite: the T=1 restriction on ragged appends is lifted — a
+    single T-token ragged append (the multi-token verify step's write)
+    stores bit-identical K/V (and int8 scales) to T sequential one-token
+    appends at the same per-row positions, page boundaries included
+    (page_size=4, row starts straddle a boundary at start+T)."""
+    specs = [(2, 4), (2, 4)]
+    rng = np.random.default_rng(3)
+    B = 2
+    k = rng.normal(size=(B, 2, T, 4)).astype(np.float32)
+    v = rng.normal(size=k.shape).astype(np.float32)
+    start = [3, 1]  # row 0 crosses the page_size=4 boundary for T >= 2
+
+    def fresh():
+        st = cls.create(specs, B, 16, **kw)
+        if isinstance(st, KV.PagedKVState):
+            st = st.with_static_table()
+        return st.with_lengths(start)
+
+    multi = fresh()
+    for layer in range(len(specs)):
+        _ragged_append(multi, layer, k, v)
+
+    seq = fresh()
+    for t in range(T):
+        for layer in range(len(specs)):
+            _ragged_append(seq, layer, k[:, :, t:t + 1], v[:, :, t:t + 1])
+        seq = seq.advanced(1)
+
+    for layer in range(len(specs)):
+        np.testing.assert_array_equal(np.asarray(multi.k[layer]),
+                                      np.asarray(seq.k[layer]))
+        np.testing.assert_array_equal(np.asarray(multi.v[layer]),
+                                      np.asarray(seq.v[layer]))
+        if multi.quantized:
+            np.testing.assert_array_equal(np.asarray(multi.k_scale[layer]),
+                                          np.asarray(seq.k_scale[layer]))
+            np.testing.assert_array_equal(np.asarray(multi.v_scale[layer]),
+                                          np.asarray(seq.v_scale[layer]))
+
+
+@pytest.mark.parametrize("T", [1, 2, 4])
+def test_ragged_int8_append_tracks_fp_path(T):
+    """Satellite: the int8 ragged multi-token write stores what the fp
+    path stores, up to per-token quantization error — the verify step on
+    TurboQuant caches reads the same values chunked prefill would."""
+    specs = [(2, 4)]
+    rng = np.random.default_rng(5)
+    k = rng.normal(size=(2, 2, T, 4)).astype(np.float32) * 2.0
+    v = rng.normal(size=k.shape).astype(np.float32) * 2.0
+    start = [2, 5]
+    fp = KV.KVState.create(specs, 2, 16).with_lengths(start)
+    q8 = KV.QuantKVState.create(specs, 2, 16).with_lengths(start)
+    fp.append(0, jnp.asarray(k), jnp.asarray(v))
+    q8.append_raw(0, jnp.asarray(k), jnp.asarray(v))
+    deq = np.asarray(q8.k[0], np.float32) * np.asarray(q8.k_scale[0])
+    np.testing.assert_allclose(deq, np.asarray(fp.k[0]), atol=0.05)
+    # written exactly at the per-row ragged positions, nothing else
+    written = np.zeros_like(deq, bool)
+    for b, s in enumerate(start):
+        written[b, :, s:s + T] = True
+    assert np.all(deq[~written] == 0.0)
+
+
+@pytest.mark.parametrize("cls,kw", ALL_VARIANTS)
+def test_rollback_row_rewinds_and_next_append_overwrites(cls, kw):
+    """rollback_row — the verify step's rejection path: the row's length
+    rewinds (across a page boundary on the paged variants: 6 -> 2 with
+    page_size=4), other rows are untouched, and the next append lands at
+    the rewound position, overwriting the rejected garbage."""
+    specs = [(1, 4)]
+    state = cls.create(specs, batch=2, max_len=8, **kw)
+    if isinstance(state, KV.PagedKVState):
+        state = state.with_static_table()
+    state = state.with_lengths([0, 3])
+    ones = jnp.ones((2, 1, 6, 4), jnp.float32)
+    _ragged_append(state, 0, ones, ones)      # row 0: positions 0..5
+    state = state.advanced(0)._with_length(jnp.asarray([6, 3], jnp.int32))
+    state = state.rollback_row(0, 2)
+    assert isinstance(state, cls)
+    np.testing.assert_array_equal(np.asarray(state.length), [2, 3])
+    if isinstance(state, KV.PagedKVState):
+        # nothing freed: the row keeps its static page range
+        np.testing.assert_array_equal(np.asarray(state.block_table),
+                                      [[0, 1], [2, 3]])
+    nines = 9.0 * jnp.ones((2, 1, 1, 4), jnp.float32)
+    _ragged_append(state, 0, nines, nines)    # row 0 writes at position 2
+    read = _read_k(state)
+    got = read[0, 0, 2]
+    if state.quantized:
+        got = got.astype(np.float32) * (
+            np.asarray(state.k_scale[0] if not isinstance(
+                state, KV.PagedKVState)
+                else state._gather(state.k_scale[0]))[0, 0, 2])
+    np.testing.assert_allclose(got, 9.0 * np.ones(4), rtol=1e-6)
+    # row 1's content at its own position is untouched by the rollback
+    assert float(np.abs(read[1, 0, 3]).max()) > 0.0
+
+
+def test_rollback_row_requires_ragged():
+    state = KV.KVState.create([(1, 4)], batch=2, max_len=8)
+    with pytest.raises(ValueError, match="ragged"):
+        state.rollback_row(0, 1)
+
+
+@pytest.mark.parametrize("cls", [KV.PagedKVState, KV.QuantPagedKVState])
+def test_rollback_row_never_frees_pinned_prefix_pages(cls):
+    """The paged contract: a rollback past (or onto) an aliased prefix
+    boundary must neither drop the row's prefix aliases from its block
+    table nor touch the shared page's KV — the refcount-pinned cache
+    pages another row may be attending stay bit-identical."""
+    specs = [(1, 4)]
+    kv = cls.create(specs, batch=2, max_len=8, page_size=4, pool_pages=6) \
+        .with_static_table().with_lengths([0, 0])
+    # write a distinctive page through row 0, register it as cache page 4
+    view = kv.row_view(0, 0)
+    seven = 7 * jnp.ones((1, 1, 4, 4))
+    view.append_rows(0, seven, seven)
+    kv = kv.merge_row(0, view.advanced(4))
+    kv = kv.copy_pages([0], [4])
+    kv = kv.with_row_prefix(1, [4])           # row 1 aliases the cache page
+    kv = kv._with_length(jnp.asarray([4, 6], jnp.int32))
+    shared_before = _read_k(kv)[1, :, :4].copy()
+    kv = kv.rollback_row(1, 4)                # reject row 1's suffix writes
+    np.testing.assert_array_equal(np.asarray(kv.length), [4, 4])
+    # the alias survives and the shared KV is untouched
+    np.testing.assert_array_equal(np.asarray(kv.block_table),
+                                  [[0, 1], [4, 3]])
+    np.testing.assert_array_equal(_read_k(kv)[1, :, :4], shared_before)
+    # a subsequent suffix append writes the row's OWN page, not the alias
+    nines = 9.0 * jnp.ones((2, 1, 1, 4))
+    kv.append_rows(0, nines, nines)
+    np.testing.assert_array_equal(_read_k(kv)[1, :, :4], shared_before)

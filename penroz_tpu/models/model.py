@@ -334,11 +334,12 @@ class CompiledArch:
 
     def _apply(self, params, buffers, x, *, training=False, rng=None, kv=None,
                pos_offset=None, skip_softmax=False, compute_dtype=None,
-               sp_mesh=None, platform=None, sp_mode="ring", ep_mesh=None):
+               sp_mesh=None, platform=None, sp_mode="ring", ep_mesh=None,
+               lora=None, lora_idx=None):
         ctx = M.Ctx(params, buffers, training=training, rng=rng, kv=kv,
                     pos_offset=pos_offset, compute_dtype=compute_dtype,
                     sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode,
-                    ep_mesh=ep_mesh)
+                    ep_mesh=ep_mesh, lora=lora, lora_idx=lora_idx)
         acts = []
         h = x
         logits = None
@@ -370,17 +371,21 @@ class CompiledArch:
     def forward(self, params, buffers, tokens, targets=None, *,
                 training=False, rng=None, kv=None, pos_offset=None,
                 skip_softmax=False, compute_dtype=None, sp_mesh=None,
-                platform=None, sp_mode="ring", ep_mesh=None):
+                platform=None, sp_mode="ring", ep_mesh=None, lora=None,
+                lora_idx=None):
         """Full forward collecting every top-level activation.
 
         Returns ``(activations, cost, buffer_updates, new_kv)``; ``cost`` is
         None without targets, ``new_kv`` is the advanced KV state (or None).
+        ``lora``/``lora_idx`` carry the stacked mixed-adapter pack + per-row
+        slot indices (models/lora.py) into the module Ctx; single-adapter
+        application instead binds ``lora_A/B/scale`` keys into ``params``.
         """
         acts, logits, ctx = self._apply(
             params, buffers, tokens, training=training, rng=rng, kv=kv,
             pos_offset=pos_offset, skip_softmax=skip_softmax,
             compute_dtype=compute_dtype, sp_mesh=sp_mesh, platform=platform,
-            sp_mode=sp_mode, ep_mesh=ep_mesh)
+            sp_mode=sp_mode, ep_mesh=ep_mesh, lora=lora, lora_idx=lora_idx)
         cost = (self._cost_from_logits(logits, targets, platform=platform)
                 if targets is not None else None)
         if cost is not None and ctx.aux_losses:
@@ -783,13 +788,14 @@ class CompiledArch:
     # -- decode -------------------------------------------------------------
 
     def _decode_step(self, params, buffers, kv, tokens, rng, temp, *,
-                     greedy, top_k, compute_dtype, platform=None):
+                     greedy, top_k, compute_dtype, platform=None,
+                     lora=None, lora_idx=None):
         """Feed tokens through the stack with the KV cache, sample the next
         token on-device (reference samples on host: :393-405)."""
         acts, _, _, new_kv = self.forward(
             params, buffers, tokens, None, kv=kv, pos_offset=kv.length,
             skip_softmax=True, compute_dtype=compute_dtype,
-            platform=platform)
+            platform=platform, lora=lora, lora_idx=lora_idx)
         logits = acts[-1]
         if logits.ndim == 3:
             logits = logits[:, -1, :]
@@ -1845,7 +1851,8 @@ class NeuralNetworkModel:
 
     @classmethod
     def train_model_on_device(cls, model_id, device, dataset_id, shard,
-                              epochs, batch_size, block_size, step_size):
+                              epochs, batch_size, block_size, step_size,
+                              adapter=None):
         """Worker entry: deserialize → place → train (reference DDP worker:
         neural_net_model.py:516-550, minus the process tree — one process
         owns the TPU runtime and the mesh handles per-chip parallelism).
@@ -1867,9 +1874,19 @@ class NeuralNetworkModel:
                 and dist.process_count() == 1):
             return cls._train_in_worker_process(
                 model_id, device, dataset_id, shard, epochs, batch_size,
-                block_size, step_size)
+                block_size, step_size, adapter=adapter)
         model = cls.deserialize(model_id)
         model.to_device(device)
+        if adapter is not None:
+            # LoRA fine-tune: the base stays frozen, only the adapter tree
+            # trains, and the checkpoint written is adapter-only
+            # (models/lora.py) — registry-loadable the moment it lands.
+            from penroz_tpu.models import lora
+            lora.train_adapter(model, adapter["adapter_id"], adapter,
+                               dataset_id, shard=shard, epochs=epochs,
+                               batch_size=batch_size, block_size=block_size,
+                               step_size=step_size)
+            return model
         model.train_model(dataset_id, shard=shard, epochs=epochs,
                           batch_size=batch_size, block_size=block_size,
                           step_size=step_size)
@@ -1877,7 +1894,8 @@ class NeuralNetworkModel:
 
     @classmethod
     def _train_in_worker_process(cls, model_id, device, dataset_id, shard,
-                                 epochs, batch_size, block_size, step_size):
+                                 epochs, batch_size, block_size, step_size,
+                                 adapter=None):
         """Run the training job in a subprocess and contain its crashes.
 
         The parent blocks (callers already run this on an executor
@@ -1892,7 +1910,7 @@ class NeuralNetworkModel:
         args = {"model_id": model_id, "device": device,
                 "dataset_id": dataset_id, "shard": shard, "epochs": epochs,
                 "batch_size": batch_size, "block_size": block_size,
-                "step_size": step_size}
+                "step_size": step_size, "adapter": adapter}
         env = dict(os.environ)
         env.pop("PENROZ_TRAIN_WORKER", None)  # the child trains in-process
         from penroz_tpu.utils import checkpoint
@@ -1912,6 +1930,9 @@ class NeuralNetworkModel:
             rc = proc.wait()
         finally:
             _TRAIN_WORKERS.pop(model_id, None)
+        if adapter is not None:
+            cls._post_mortem_adapter_worker(adapter["adapter_id"], rc)
+            return cls.deserialize(model_id)
         model = cls.deserialize(model_id)
         if rc != 0 and model.status.get("code") == "Training":
             log.error("Training worker for model %s died (rc=%s); marking "
@@ -1921,7 +1942,39 @@ class NeuralNetworkModel:
                 "message": f"Training worker died (rc={rc}); last "
                            f"checkpoint retained"}
             model.serialize(sync_flush=True)
+        elif rc != 0:
+            # Clean Python-level failure: the child already recorded status
+            # Error and exited 1 — the parent still logs the death so a
+            # fleet operator sees it without polling /progress/.
+            log.error("Training worker for model %s exited rc=%s "
+                      "(status %s)", model_id, rc,
+                      model.status.get("code"))
         return model
+
+    @staticmethod
+    def _post_mortem_adapter_worker(adapter_id: str, rc: int):
+        """Adapter-run analog of the base post-mortem: a worker that died
+        mid-run leaves the ADAPTER blob saying 'Training' — rewrite it to
+        Error; a clean failure (status already Error, rc=1) is logged."""
+        try:
+            blob = checkpoint.load_adapter(adapter_id)
+        except KeyError:
+            if rc != 0:
+                log.error("Adapter-training worker for %s died (rc=%s) "
+                          "before writing any checkpoint", adapter_id, rc)
+            return
+        code = (blob.get("status") or {}).get("code")
+        if rc != 0 and code == "Training":
+            log.error("Adapter-training worker for %s died (rc=%s); "
+                      "marking Error", adapter_id, rc)
+            blob["status"] = {
+                "code": "Error",
+                "message": f"Training worker died (rc={rc}); last "
+                           f"checkpoint retained"}
+            checkpoint.save_adapter(adapter_id, blob, sync_flush=True)
+        elif rc != 0:
+            log.error("Adapter-training worker for %s exited rc=%s "
+                      "(status %s)", adapter_id, rc, code)
 
     def _compute_stats(self, x, y) -> dict:
         """/stats/ histograms from one host-local micro-batch.
@@ -2342,7 +2395,8 @@ class NeuralNetworkModel:
         return int(np.asarray(tok_arr)[0, 0]), kv, len(feed)
 
     def decode_prefill_chunk(self, kv_batch, row: int, tokens, row_len: int,
-                             rng, temperature=1.0, top_k=None):
+                             rng, temperature=1.0, top_k=None, lora=None,
+                             adapter_slot: int = 0):
         """Feed one prompt chunk for row ``row`` directly into the multi-row
         decode state — the chunked-prefill dispatch the scheduler interleaves
         between shared decode steps so a long prompt never stalls the batch
@@ -2371,24 +2425,29 @@ class NeuralNetworkModel:
         if fn is None:
             platform = self._platform
 
-            def chunk_step(p, b, kvb, toks, r_idx, r_len, r, tmp):
+            def chunk_step(p, b, kvb, toks, r_idx, r_len, r, tmp, lo, ai):
                 view = kvb.row_view(r_idx, r_len)
                 tok, view2 = arch._decode_step(p, b, view, toks, r, tmp,
                                                greedy=greedy, top_k=top_k,
                                                compute_dtype=None,
-                                               platform=platform)
+                                               platform=platform,
+                                               lora=lo, lora_idx=ai)
                 return tok[0, 0], kvb.merge_row(r_idx, view2)
 
             fn = arch._jit_cache[key] = jax.jit(chunk_step,
                                                 donate_argnums=(2,))
         x = jnp.asarray(np.asarray(tokens, np.int64)[None, :], jnp.int32)
+        aidx = (jnp.asarray([adapter_slot], jnp.int32)
+                if lora is not None else None)
         tok, kv_out = fn(self.params, self.buffers, kv_batch, x,
                          jnp.asarray(row, jnp.int32),
-                         jnp.asarray(row_len, jnp.int32), rng, temp)
+                         jnp.asarray(row_len, jnp.int32), rng, temp,
+                         lora, aidx)
         return int(np.asarray(tok)), kv_out
 
     def decode_verify_row(self, kv_batch, row: int, tokens, row_len: int,
-                          rng, temperature=1.0, top_k=None):
+                          rng, temperature=1.0, top_k=None, lora=None,
+                          adapter_slot: int = 0):
         """Speculative-decoding verify step for one row: one forward over
         the row's T candidate tokens (``tokens[0]`` is the last sampled
         token, the rest a drafter's proposals), sampling at EVERY position.
@@ -2414,12 +2473,12 @@ class NeuralNetworkModel:
         if fn is None:
             platform = self._platform
 
-            def verify_step(p, b, kvb, toks, r_idx, r_len, r, tmp):
+            def verify_step(p, b, kvb, toks, r_idx, r_len, r, tmp, lo, ai):
                 view = kvb.row_view(r_idx, r_len)
                 acts, _, _, view2 = arch.forward(
                     p, b, toks, None, kv=view, pos_offset=view.length,
                     skip_softmax=True, compute_dtype=None,
-                    platform=platform)
+                    platform=platform, lora=lo, lora_idx=ai)
                 logits = acts[-1]          # (1, T, V)
                 out = arch._sample(logits[0], r, tmp, greedy=greedy,
                                    top_k=top_k)          # (T,)
@@ -2428,9 +2487,12 @@ class NeuralNetworkModel:
             fn = arch._jit_cache[key] = jax.jit(verify_step,
                                                 donate_argnums=(2,))
         x = jnp.asarray(np.asarray(tokens, np.int64)[None, :], jnp.int32)
+        aidx = (jnp.asarray([adapter_slot], jnp.int32)
+                if lora is not None else None)
         out, kv_out = fn(self.params, self.buffers, kv_batch, x,
                          jnp.asarray(row, jnp.int32),
-                         jnp.asarray(row_len, jnp.int32), rng, temp)
+                         jnp.asarray(row_len, jnp.int32), rng, temp,
+                         lora, aidx)
         return [int(t) for t in np.asarray(out)], kv_out
 
     def decode_insert_row(self, kv_batch, row: int, kv_single):
@@ -2447,7 +2509,8 @@ class NeuralNetworkModel:
         return fn(kv_batch, kv_single, jnp.asarray(row, jnp.int32))
 
     def decode_step_batched(self, kv, last_tokens, lengths, rng,
-                            temperature=1.0, top_k=None):
+                            temperature=1.0, top_k=None, lora=None,
+                            row_adapter=None):
         """One shared decode+sample step across every row of a persistent
         multi-row KV state — the continuous-batching hot loop: K in-flight
         requests cost one batch-K forward per token instead of K batch-1
@@ -2469,18 +2532,21 @@ class NeuralNetworkModel:
         if fn is None:
             platform = self._platform
 
-            def step(p, b, kv0, tok, lens, r, tmp):
+            def step(p, b, kv0, tok, lens, r, tmp, lo, ai):
                 kv1 = kv0.with_lengths(lens)
                 t, kv2 = arch._decode_step(p, b, kv1, tok, r, tmp,
                                            greedy=greedy, top_k=top_k,
                                            compute_dtype=None,
-                                           platform=platform)
+                                           platform=platform,
+                                           lora=lo, lora_idx=ai)
                 return t[:, 0], kv2
 
             fn = arch._jit_cache[key] = jax.jit(step, donate_argnums=(2,))
+        aidx = (jnp.asarray(row_adapter, jnp.int32)
+                if lora is not None else None)
         return fn(self.params, self.buffers, kv,
                   jnp.asarray(last_tokens, jnp.int32),
-                  jnp.asarray(lengths, jnp.int32), rng, temp)
+                  jnp.asarray(lengths, jnp.int32), rng, temp, lora, aidx)
 
     def _sampling_setup(self, temperature):
         """Shared generation preamble: (greedy, temp scalar, call rng).

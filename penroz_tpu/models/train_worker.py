@@ -39,13 +39,21 @@ def main(argv: list[str]) -> int:
     threading.Thread(target=_watch_parent, args=(os.getppid(),),
                      daemon=True).start()
     from penroz_tpu.models.model import NeuralNetworkModel
+    adapter = args.get("adapter")
     model = NeuralNetworkModel.train_model_on_device(
         args["model_id"], args["device"], args["dataset_id"], args["shard"],
         args["epochs"], args["batch_size"], args["block_size"],
-        args["step_size"])
+        args["step_size"], adapter=adapter)
     # In-process training records failures as status Error and returns;
     # propagate that as a nonzero exit so the parent logs the death even
-    # when it was a clean Python-level failure.
+    # when it was a clean Python-level failure.  Adapter runs key the exit
+    # code off the ADAPTER blob's status — the base model's status is
+    # untouched by a LoRA fine-tune.
+    if adapter is not None:
+        from penroz_tpu.utils import checkpoint
+        status = (checkpoint.peek_adapter_tree(adapter["adapter_id"])
+                  .get("status") or {})
+        return 0 if status.get("code") == "Trained" else 1
     return 0 if model.status.get("code") == "Trained" else 1
 
 

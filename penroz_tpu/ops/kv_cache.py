@@ -1121,7 +1121,13 @@ class RadixPrefixCache:
         self.page_size = int(page_size)
         self._pages = list(pages)
         self._free = list(reversed(self._pages))
-        self._root = _RadixNode(None, -1, None, 0)
+        # Namespaced roots: cached prefix KV depends on the WEIGHTS that
+        # produced it, so rows bound to different LoRA adapters must never
+        # alias each other's pages (a base-model prefix hit on an adapter
+        # row would silently serve the wrong model).  Each namespace (None
+        # = base, an adapter load-generation uid otherwise) gets its own
+        # radix root; the page pool and LRU eviction stay shared.
+        self._roots: dict = {None: _RadixNode(None, -1, None, 0)}
         self._clock = 0
         self.hits = 0
         self.misses = 0
@@ -1168,15 +1174,23 @@ class RadixPrefixCache:
 
     # -- lookup / registration ----------------------------------------------
 
-    def match(self, tokens, limit=None) -> list:
+    def _ns_root(self, namespace):
+        root = self._roots.get(namespace)
+        if root is None:
+            root = self._roots[namespace] = _RadixNode(None, -1, None, 0)
+        return root
+
+    def match(self, tokens, limit=None, namespace=None) -> list:
         """Longest cached prefix of ``tokens`` in whole pages; returns the
         matched node chain (``[n.page for n in nodes]`` are the pages to
         alias, in logical order).  ``limit`` caps the usable token count —
         admission passes ``len(prompt) - 1`` so at least one real token is
         always left to produce the first-sample logits.  Counts a hit iff
-        at least one page matched."""
+        at least one page matched.  ``namespace`` isolates adapter-bound
+        rows: a lookup only ever matches pages inserted under the SAME
+        namespace."""
         nodes = []
-        node = self._root
+        node = self._ns_root(namespace)
         for key in self._blocks(tokens, limit):
             child = node.children.get(key)
             if child is None:
@@ -1205,16 +1219,19 @@ class RadixPrefixCache:
             if nd.refs < 0:  # defensive: never let an unpaired unpin
                 nd.refs = 0  # turn into a negative permanent pin
 
-    def insert(self, tokens, limit=None) -> list[tuple[int, int]]:
+    def insert(self, tokens, limit=None,
+               namespace=None) -> list[tuple[int, int]]:
         """Ensure nodes exist for every full page block of ``tokens``;
         returns ``(block_index, page)`` pairs NEWLY allocated — the caller
         must ``copy_pages`` the corresponding KV into them.  Allocation
         evicts unpinned LRU leaves on demand and stops early (no error)
         when everything left is pinned; partial chains are valid prefixes.
+        ``namespace`` must match the weights (base / adapter generation)
+        that computed the pages being registered.
         """
         created = []
         chain = []
-        node = self._root
+        node = self._ns_root(namespace)
         t = self._tick()
         try:
             for b, key in enumerate(self._blocks(tokens, limit)):
@@ -1251,7 +1268,8 @@ class RadixPrefixCache:
 
     def _lru_leaf(self):
         best = None
-        stack = list(self._root.children.values())
+        stack = [nd for root in self._roots.values()
+                 for nd in root.children.values()]
         while stack:
             nd = stack.pop()
             if nd.children:
@@ -1271,7 +1289,7 @@ class RadixPrefixCache:
         cached K/V from the old weights must never serve the new ones).
         Callers only reload with zero rows in flight, so nothing is pinned.
         Counters survive — they are lifetime observability."""
-        self._root = _RadixNode(None, -1, None, 0)
+        self._roots = {None: _RadixNode(None, -1, None, 0)}
         self._free = list(reversed(self._pages))
 
 

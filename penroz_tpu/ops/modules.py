@@ -49,7 +49,7 @@ class Ctx:
     def __init__(self, params, buffers=None, *, training=False, rng=None,
                  kv=None, pos_offset=None, compute_dtype=None, sp_mesh=None,
                  platform=None, sp_mode="ring", sp_manual_axis=None,
-                 ep_mesh=None):
+                 ep_mesh=None, lora=None, lora_idx=None):
         self.params = params
         self.buffers = buffers or {}
         self.training = training
@@ -72,6 +72,14 @@ class Ctx:
         # MoE under pipe keeps the dense-combine inside each stage).
         self.ep_mesh = ep_mesh
         self.platform = platform  # execution platform hint for kernel gates
+        # Mixed-adapter LoRA (models/lora.py): ``lora`` maps a Linear's
+        # prefix to stacked low-rank factors {a: (L, r, in), b: (L, out, r),
+        # scale: (L,)} and ``lora_idx`` (B,) selects each batch row's slot
+        # (the last, all-zero slot is the base-model row).  Single-adapter
+        # application instead BINDS ``<prefix>.lora_A/B/scale`` keys into
+        # ``params`` — Linear.apply picks either up.
+        self.lora = lora
+        self.lora_idx = lora_idx
         self.buffer_updates = {}
         self.aux_losses = []  # auxiliary training losses (e.g. MoE balance)
         self._rng_counter = 0
@@ -276,7 +284,39 @@ class Linear(Module):
         out = jnp.matmul(x, w.T)
         if self.use_bias:
             out = out + self._p(ctx, "bias")
-        return out
+        return self._maybe_lora(out, x, ctx)
+
+    def _maybe_lora(self, out, x, ctx):
+        """Low-rank adapter delta ``out += scale · (x Aᵀ) Bᵀ`` when adapter
+        factors are bound for this projection (models/lora.py).
+
+        Two bindings: flat ``<prefix>.lora_A/B/scale`` keys inside
+        ``ctx.params`` apply ONE adapter to the whole batch (training, the
+        legacy generate paths); ``ctx.lora[prefix]`` holds per-slot stacked
+        factors and ``ctx.lora_idx`` routes each batch row to its slot —
+        the BGMV-style gathered einsum that lets rows with different
+        adapters (or none: the trailing all-zero slot) share one forward.
+        """
+        a = ctx.params.get(self.key("lora_A"))
+        if a is not None:
+            b = ctx.params[self.key("lora_B")]
+            s = ctx.params[self.key("lora_scale")]
+            t = jnp.matmul(x, a.astype(x.dtype).T)
+            return out + jnp.matmul(t, b.astype(x.dtype).T) \
+                * s.astype(out.dtype)
+        ent = ctx.lora.get(self.prefix) if ctx.lora else None
+        if ent is None:
+            return out
+        idx = ctx.lora_idx
+        asel = jnp.take(ent["a"], idx, axis=0).astype(x.dtype)  # (B, r, in)
+        bsel = jnp.take(ent["b"], idx, axis=0).astype(x.dtype)  # (B, out, r)
+        ssel = jnp.take(ent["scale"], idx, axis=0).astype(out.dtype)  # (B,)
+        if x.ndim == 2:  # (B, d) stacks (MLP-style models)
+            t = jnp.einsum("bd,brd->br", x, asel)
+            return out + jnp.einsum("br,bor->bo", t, bsel) * ssel[:, None]
+        t = jnp.einsum("btd,brd->btr", x, asel)
+        return out + jnp.einsum("btr,bor->bto", t, bsel) \
+            * ssel[:, None, None]
 
 
 class Flatten(Module):

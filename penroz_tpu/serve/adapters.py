@@ -1,0 +1,267 @@
+"""Serving-side LoRA adapter registry (the ``/adapters/`` surface).
+
+The scheduler serves MANY tenants' adapters against one resident base
+model (models/lora.py); this module owns the host-side lifecycle between
+the adapter checkpoints on disk and the engines' stacked live slots:
+
+- **LRU host cache** of decoded adapter param trees (``PENROZ_LORA_HOST_
+  CACHE`` entries): a popular adapter's factors decode from the CRC32-
+  verified checkpoint container once, not per request.
+- **Refcount pinning**: every in-flight request holds a reference on its
+  entry from admission until its terminal event — a pinned entry is never
+  LRU-evicted, so the engine's slot rebuild always has the params at hand.
+- **Load states**: the FIRST request for an uncached adapter loads it
+  inline (off the event loop); concurrent requests arriving mid-load get
+  :class:`AdapterLoadingError` (→ HTTP 409 naming the adapter) instead of
+  piling onto the disk read; an unknown adapter is a ValueError (→ 400
+  naming the adapter) — never a KeyError 500.
+- **Generation uids**: each successful load gets a fresh ``uid``.  Engines
+  key slot reuse AND the radix prefix-cache namespace on the uid, so a
+  retrained/recreated adapter under the same id can never serve stale
+  factors or alias prefix KV computed by its previous generation.
+- ``lora.load`` fault site (utils/faults.py): deterministic load-failure
+  injection drives the error-path tests.
+
+Invalidations: ``DELETE /adapters/`` and adapter retraining drop the
+cached entry; ``DELETE /model/`` and engine model-reload flush every
+adapter of that model (the PR-2 prefix-cache-flush contract extended to
+adapters).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+
+import numpy as np
+
+from penroz_tpu.utils import checkpoint, faults
+
+log = logging.getLogger(__name__)
+
+HOST_CACHE_ENV = "PENROZ_LORA_HOST_CACHE"
+
+
+class AdapterLoadingError(RuntimeError):
+    """Another request is currently loading this adapter (HTTP 409)."""
+
+
+def _host_cache() -> int:
+    try:
+        return max(1, int(os.environ.get(HOST_CACHE_ENV, "16")))
+    except ValueError:
+        log.warning("Unparseable %s=%r; using default 16", HOST_CACHE_ENV,
+                    os.environ.get(HOST_CACHE_ENV))
+        return 16
+
+
+class AdapterEntry:
+    """One cached adapter generation: immutable after load completes."""
+
+    __slots__ = ("adapter_id", "model_id", "config", "params", "uid",
+                 "state", "refs", "last_use")
+
+    def __init__(self, adapter_id: str, uid: int):
+        self.adapter_id = adapter_id
+        self.model_id = None
+        self.config = None
+        self.params = None
+        self.uid = uid
+        self.state = "loading"
+        self.refs = 0
+        self.last_use = 0
+
+
+class AdapterRegistry:
+    def __init__(self):
+        self._entries: dict[str, AdapterEntry] = {}
+        self._lock = threading.Lock()
+        self._uid = itertools.count(1)
+        self._clock = itertools.count(1)
+
+    # -- request path -------------------------------------------------------
+
+    def acquire(self, adapter_id: str,
+                model_id: str | None = None) -> AdapterEntry:
+        """Pin + return the adapter's cached entry, loading it from its
+        checkpoint on a miss.  Call off the event loop (disk IO on a
+        miss).  Raises ValueError for an unknown/mismatched/corrupt
+        adapter (→ 400 naming it) and :class:`AdapterLoadingError` while
+        another caller's load is in flight (→ 409)."""
+        with self._lock:
+            entry = self._entries.get(adapter_id)
+            if entry is not None and entry.state == "ready":
+                self._check_model(entry, model_id)
+                entry.refs += 1
+                entry.last_use = next(self._clock)
+                return entry
+            if entry is not None:
+                raise AdapterLoadingError(
+                    f"adapter {adapter_id!r} is still loading; retry "
+                    f"shortly")
+            entry = self._entries[adapter_id] = AdapterEntry(
+                adapter_id, next(self._uid))
+        try:
+            faults.check("lora.load")
+            blob = checkpoint.load_adapter(adapter_id)
+            entry.model_id = blob.get("model_id")
+            entry.config = blob.get("config") or {}
+            entry.params = {k: np.asarray(v)
+                            for k, v in (blob.get("params") or {}).items()}
+            if not entry.params:
+                raise ValueError("checkpoint holds no adapter params")
+            from penroz_tpu.models import lora
+            rank = int(entry.config.get("rank") or 0)
+            if rank > lora.max_rank():
+                # Refuse HERE (typed 400), not inside the engine tick: the
+                # stacked pack pads ranks to PENROZ_LORA_MAX_RANK, and an
+                # over-rank adapter would crash the shared step instead.
+                raise ValueError(
+                    f"rank {rank} exceeds {lora.MAX_RANK_ENV}="
+                    f"{lora.max_rank()}; raise the knob or recreate the "
+                    f"adapter at a smaller rank")
+        except KeyError:
+            with self._lock:
+                self._entries.pop(adapter_id, None)
+            raise ValueError(
+                f"unknown adapter {adapter_id!r} — POST /adapters/ or "
+                f"train it first")
+        except Exception as e:  # noqa: BLE001 — typed, descriptive 400
+            with self._lock:
+                self._entries.pop(adapter_id, None)
+            raise ValueError(
+                f"adapter {adapter_id!r} failed to load: "
+                f"{type(e).__name__}: {e}")
+        with self._lock:
+            self._check_model(entry, model_id, drop_on_mismatch=True)
+            entry.state = "ready"
+            entry.refs += 1
+            entry.last_use = next(self._clock)
+            self._evict_over_capacity()
+        return entry
+
+    def _check_model(self, entry: AdapterEntry, model_id,
+                     drop_on_mismatch: bool = False):
+        if model_id is not None and entry.model_id != model_id:
+            if drop_on_mismatch:
+                self._entries.pop(entry.adapter_id, None)
+            raise ValueError(
+                f"adapter {entry.adapter_id!r} belongs to model "
+                f"{entry.model_id!r}, not {model_id!r}")
+
+    def release(self, entry: AdapterEntry):
+        with self._lock:
+            entry.refs = max(0, entry.refs - 1)
+
+    def _evict_over_capacity(self):
+        """Drop least-recently-used UNPINNED entries over the cache cap
+        (caller holds the lock).  All-pinned overflow is allowed — live
+        rows outrank the cap — and logged once per overflow."""
+        cap = _host_cache()
+        while len(self._entries) > cap:
+            victims = [e for e in self._entries.values()
+                       if e.refs == 0 and e.state == "ready"]
+            if not victims:
+                log.warning("Adapter host cache over capacity (%d > %d) "
+                            "with every entry pinned", len(self._entries),
+                            cap)
+                return
+            victim = min(victims, key=lambda e: e.last_use)
+            del self._entries[victim.adapter_id]
+
+    # -- invalidation -------------------------------------------------------
+
+    def invalidate(self, adapter_id: str):
+        """Drop the cached entry (delete/retrain): the next acquire reloads
+        from the checkpoint under a fresh uid.  In-flight rows keep their
+        already-copied slot factors."""
+        with self._lock:
+            self._entries.pop(adapter_id, None)
+
+    def invalidate_model(self, model_id: str):
+        """Flush every cached adapter of ``model_id`` (DELETE /model/ and
+        engine model-reload — the prefix-cache-flush mirror)."""
+        with self._lock:
+            for aid in [aid for aid, e in self._entries.items()
+                        if e.model_id == model_id]:
+                del self._entries[aid]
+
+    def reset(self):
+        with self._lock:
+            self._entries.clear()
+
+    # -- introspection ------------------------------------------------------
+
+    def cached_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def entry_state(self, adapter_id: str) -> dict | None:
+        with self._lock:
+            e = self._entries.get(adapter_id)
+            if e is None:
+                return None
+            return {"state": e.state, "refs": e.refs, "uid": e.uid}
+
+
+REGISTRY = AdapterRegistry()
+
+
+def list_adapters() -> list[dict]:
+    """GET /adapters/ listing: every adapter checkpoint on disk, with
+    header-only metadata (cheap peek) plus the host-cache state."""
+    out = []
+    for aid in checkpoint.list_adapter_ids():
+        try:
+            tree = checkpoint.peek_adapter_tree(aid)
+        except (KeyError, ValueError):
+            continue
+        cfg = tree.get("config") or {}
+        out.append({
+            "adapter_id": aid,
+            "model_id": tree.get("model_id"),
+            "rank": cfg.get("rank"),
+            "alpha": cfg.get("alpha"),
+            "targets": cfg.get("targets"),
+            "status": tree.get("status"),
+            "cache": REGISTRY.entry_state(aid),
+        })
+    return out
+
+
+def adapter_detail(adapter_id: str) -> dict:
+    """Single-adapter detail incl. training progress.  :raises KeyError:
+    unknown adapter (→ 404 on the GET surface)."""
+    tree = checkpoint.peek_adapter_tree(adapter_id)
+    cfg = tree.get("config") or {}
+    return {
+        "adapter_id": adapter_id,
+        "model_id": tree.get("model_id"),
+        "rank": cfg.get("rank"),
+        "alpha": cfg.get("alpha"),
+        "targets": cfg.get("targets"),
+        "status": tree.get("status"),
+        "progress": tree.get("progress") or [],
+        "cache": REGISTRY.entry_state(adapter_id),
+    }
+
+
+def delete_model_adapters(model_id: str) -> list[str]:
+    """DELETE /model/ rider: flush the model's cached adapters AND remove
+    their checkpoints — an adapter without its base model can never serve
+    again, and leaving the blobs behind would resurrect them under a
+    recreated model id with different weights."""
+    REGISTRY.invalidate_model(model_id)
+    deleted = []
+    for aid in checkpoint.list_adapter_ids():
+        try:
+            if checkpoint.peek_adapter_tree(aid).get("model_id") != model_id:
+                continue
+        except (KeyError, ValueError):
+            continue
+        REGISTRY.invalidate(aid)
+        checkpoint.delete_adapter(aid)
+        deleted.append(aid)
+    return deleted

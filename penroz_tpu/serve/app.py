@@ -251,7 +251,21 @@ def _shed_response(exc) -> web.Response:
                              status=503, headers={"Retry-After": "1"})
 
 
-async def _try_scheduler_generate(request: web.Request, body):
+async def _resolve_adapter(adapter_id: str, model_id: str):
+    """Pin the adapter's registry entry (loading it off the event loop on
+    a miss).  Returns the entry, or a ready 409 Response while another
+    request's load is in flight.  Unknown/corrupt adapters raise
+    ValueError (→ 400 naming the adapter via the error middleware) — never
+    a KeyError 500."""
+    from penroz_tpu.serve import adapters
+    try:
+        return await _run_blocking(adapters.REGISTRY.acquire, adapter_id,
+                                   model_id)
+    except adapters.AdapterLoadingError as exc:
+        return _json({"detail": f"Conflict: {exc}"}, status=409)
+
+
+async def _try_scheduler_generate(request: web.Request, body, adapter=None):
     """Serve /generate/ through the continuous-batching scheduler when
     enabled and eligible; returns a Response or None (→ legacy path).
     The whole point: K concurrent requests share one batch-K decode step
@@ -278,7 +292,7 @@ async def _try_scheduler_generate(request: web.Request, body):
         if not body.stream:
             tokens = await decode_scheduler.run_request(
                 engine, prompt, body.max_new_tokens, body.stop_token,
-                body.timeout_ms)
+                body.timeout_ms, adapter=adapter)
             return _json({"tokens": tokens})
         log.info("Streaming token generation for model %s via the "
                  "continuous-batching scheduler", body.model_id)
@@ -286,7 +300,7 @@ async def _try_scheduler_generate(request: web.Request, body):
         # their real status line instead of a broken 200 stream
         req, queue = decode_scheduler.start_stream(
             engine, prompt, body.max_new_tokens, body.stop_token,
-            body.timeout_ms)
+            body.timeout_ms, adapter=adapter)
     except decode_scheduler.CircuitOpenError as exc:
         if decode_scheduler.fallback_enabled():
             log.warning("Scheduler circuit open for model %s; falling back "
@@ -327,11 +341,32 @@ async def _try_scheduler_generate(request: web.Request, body):
 
 async def model_generate(request: web.Request):
     body = await _parse(request, schemas.GenerateRequest)
-    log.info("Generating tokens using model %s", body.model_id)
-    response = await _try_scheduler_generate(request, body)
+    log.info("Generating tokens using model %s%s", body.model_id,
+             f" (adapter {body.adapter_id})" if body.adapter_id else "")
+    entry = None
+    if body.adapter_id:
+        entry = await _resolve_adapter(body.adapter_id, body.model_id)
+        if isinstance(entry, web.Response):
+            return entry
+    try:
+        return await _model_generate_inner(request, body, entry)
+    finally:
+        if entry is not None:
+            from penroz_tpu.serve import adapters
+            adapters.REGISTRY.release(entry)
+
+
+async def _model_generate_inner(request: web.Request, body, entry):
+    response = await _try_scheduler_generate(request, body, adapter=entry)
     if response is not None:
         return response
     model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
+    if entry is not None:
+        # Legacy single-sequence path: bind the adapter factors into the
+        # flat param dict — every compiled program picks the delta up
+        # through Ctx.params (models/lora.py bind_model).
+        from penroz_tpu.models import lora
+        model = lora.bind_model(model, entry.params, entry.config)
     if body.stream:
         log.info("Streaming token generation for model %s", body.model_id)
         response = web.StreamResponse(
@@ -374,15 +409,84 @@ async def model_generate(request: web.Request):
     return _json({"tokens": tokens})
 
 
+async def _resolve_batch_adapters(body):
+    """Per-row adapter entries for /generate_batch/: ``adapter_ids`` (one
+    per row, null = base) overrides the batch-wide ``adapter_id``.
+
+    All-or-nothing like the PR-1 overflow 400: every bad row is named in
+    ONE descriptive error — unknown/invalid adapters raise ValueError
+    (400), still-loading adapters return a 409 Response — and on any
+    failure every already-pinned entry is released.  Returns
+    ``(row_entries, unique_entries)`` on success."""
+    from penroz_tpu.serve import adapters
+    n = len(body.inputs)
+    if body.adapter_ids is not None:
+        if len(body.adapter_ids) != n:
+            raise ValueError(
+                f"adapter_ids has {len(body.adapter_ids)} entries for "
+                f"{n} input row(s); pass one per row (null = base model)")
+        row_ids = list(body.adapter_ids)
+    else:
+        row_ids = [body.adapter_id] * n
+    entries: Dict[str, object] = {}
+    unknown: list = []
+    loading: list = []
+    for aid in row_ids:
+        if aid is None or aid in entries:
+            continue
+        try:
+            entries[aid] = await _run_blocking(
+                adapters.REGISTRY.acquire, aid, body.model_id)
+        except adapters.AdapterLoadingError:
+            loading.append(aid)
+        except ValueError as exc:
+            unknown.append((aid, str(exc)))
+
+    def _rows_for(aid):
+        rows = [i for i, r in enumerate(row_ids) if r == aid]
+        return ", ".join(f"row {i}" for i in rows[:8]) + (
+            f" and {len(rows) - 8} more" if len(rows) > 8 else "")
+
+    if unknown:
+        for entry in entries.values():
+            adapters.REGISTRY.release(entry)
+        detail = "; ".join(f"adapter {aid!r} ({_rows_for(aid)}): {msg}"
+                           for aid, msg in unknown)
+        raise ValueError(f"batched generation rejected: {detail}")
+    if loading:
+        for entry in entries.values():
+            adapters.REGISTRY.release(entry)
+        detail = "; ".join(f"adapter {aid!r} ({_rows_for(aid)}) is still "
+                           f"loading" for aid in loading)
+        return _json({"detail": f"Conflict: {detail}; retry shortly"},
+                     status=409)
+    return [entries.get(aid) for aid in row_ids], entries
+
+
 async def model_generate_batch(request: web.Request):
     """Ragged batched generation — N prompts share one forward per step
     (beyond the reference surface; its /generate/ is single-sequence).
     With PENROZ_CONTINUOUS_BATCHING=1 the rows join the shared in-flight
     batch instead, so they coalesce with concurrent /generate/ traffic
-    and recycle KV slots as individual rows finish."""
+    and recycle KV slots as individual rows finish.  Rows may carry
+    DIFFERENT LoRA adapters (``adapter_ids``) — the scheduler serves the
+    mix in one shared step via the stacked adapter pack."""
     body = await _parse(request, schemas.GenerateBatchRequest)
     log.info("Batch-generating %d sequence(s) using model %s",
              len(body.inputs), body.model_id)
+    resolved = await _resolve_batch_adapters(body)
+    if isinstance(resolved, web.Response):
+        return resolved
+    row_entries, unique_entries = resolved
+    try:
+        return await _model_generate_batch_inner(body, row_entries)
+    finally:
+        from penroz_tpu.serve import adapters
+        for entry in unique_entries.values():
+            adapters.REGISTRY.release(entry)
+
+
+async def _model_generate_batch_inner(body, row_entries):
     from penroz_tpu.serve import decode_scheduler
     if decode_scheduler.enabled() and body.max_new_tokens >= 1:
         prompts = [[int(t) for t in row] for row in body.inputs]
@@ -401,8 +505,9 @@ async def model_generate_batch(request: web.Request):
             results = await asyncio.gather(*[
                 decode_scheduler.run_request(
                     engine, p, body.max_new_tokens, body.stop_token,
-                    body.timeout_ms)
-                for p in prompts], return_exceptions=True)
+                    body.timeout_ms, adapter=entry)
+                for p, entry in zip(prompts, row_entries)],
+                return_exceptions=True)
             errors = [r for r in results if isinstance(r, BaseException)]
             if not errors:
                 return _json({"sequences": results})
@@ -420,10 +525,37 @@ async def model_generate_batch(request: web.Request):
             else:
                 return _shed_response(shed)
     model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
-    sequences = await _run_blocking(
-        lambda: model.generate_tokens_batched(
-            body.inputs, body.block_size, body.max_new_tokens,
-            body.temperature, body.top_k, body.stop_token))
+    if not any(e is not None for e in row_entries):
+        sequences = await _run_blocking(
+            lambda: model.generate_tokens_batched(
+                body.inputs, body.block_size, body.max_new_tokens,
+                body.temperature, body.top_k, body.stop_token))
+        return _json({"sequences": sequences})
+    # Legacy path with adapters: group rows per adapter, run each group
+    # through a bound model (one adapter per forward), reassemble in row
+    # order.  The all-or-nothing 400 contract still holds — validate the
+    # WHOLE batch before any group runs.
+    from penroz_tpu.models import lora
+    from penroz_tpu.models.model import validate_batch_generation
+    prompts = [[int(t) for t in row] for row in body.inputs]
+    validate_batch_generation(prompts, body.block_size, body.max_new_tokens)
+    groups: Dict[object, list] = {}
+    for i, entry in enumerate(row_entries):
+        groups.setdefault(entry, []).append(i)
+    sequences: list = [None] * len(prompts)
+
+    def run_groups():
+        for entry, rows in groups.items():
+            bound = (model if entry is None
+                     else lora.bind_model(model, entry.params, entry.config))
+            outs = bound.generate_tokens_batched(
+                [prompts[i] for i in rows], body.block_size,
+                body.max_new_tokens, body.temperature, body.top_k,
+                body.stop_token)
+            for i, seq in zip(rows, outs):
+                sequences[i] = seq
+
+    await _run_blocking(run_groups)
     return _json({"sequences": sequences})
 
 
@@ -439,13 +571,24 @@ async def train_model(request: web.Request):
     model_id = body.model_id
     log.info("Requesting training for model %s on device %s",
              model_id, body.device)
-    # Validate early so a bad model id 404s and a bad device string 400s
-    # instead of silently failing in the fire-and-forget background task
-    # (the checkpoint read is cheap via shm).
+    # Validate early so a bad model id 404s, a bad device string 400s, and
+    # a bad adapter config 400s instead of silently failing in the
+    # fire-and-forget background task (the checkpoint read is cheap via
+    # shm).
     from penroz_tpu.models.model import _resolve_device
     _resolve_device(body.device)
     await _run_blocking(NeuralNetworkModel.deserialize, model_id)
+    adapter_cfg = None
+    if body.adapter is not None:
+        from penroz_tpu.models import lora
+        adapter_cfg = lora.validate_config({
+            "rank": body.adapter.rank, "alpha": body.adapter.alpha,
+            "targets": body.adapter.targets})
+        adapter_cfg["adapter_id"] = body.adapter.adapter_id
 
+    # One lock per base model covers base AND adapter runs: an adapter
+    # fine-tune reads the base weights, so it must never race a base
+    # /train/ rewriting them mid-run.
     lock = model_locks.setdefault(model_id, asyncio.Lock())
     if lock.locked():
         return _json({"detail": f"Training already in progress for model {model_id}."},
@@ -458,14 +601,26 @@ async def train_model(request: web.Request):
                 await _run_blocking(
                     NeuralNetworkModel.train_model_on_device, model_id,
                     body.device, body.dataset_id, body.shard, body.epochs,
-                    body.batch_size, body.block_size, body.step_size)
+                    body.batch_size, body.block_size, body.step_size,
+                    adapter_cfg)
             except Exception:  # noqa: BLE001
                 log.exception("Training failed for model %s", model_id)
             else:
                 log.info("Training completed for model %s", model_id)
+            finally:
+                if adapter_cfg is not None:
+                    # Serving must pick up the fresh factors: the cached
+                    # registry entry (if any) still holds the pre-train
+                    # generation — drop it so the next request reloads
+                    # under a new uid (which also retires its prefix-cache
+                    # namespace).
+                    from penroz_tpu.serve import adapters
+                    adapters.REGISTRY.invalidate(adapter_cfg["adapter_id"])
 
     asyncio.get_running_loop().create_task(_launch())
-    return _json({"message": f"Training for model {model_id} started asynchronously."},
+    what = (f"adapter {adapter_cfg['adapter_id']} on model {model_id}"
+            if adapter_cfg is not None else f"model {model_id}")
+    return _json({"message": f"Training for {what} started asynchronously."},
                  status=202)
 
 
@@ -568,7 +723,69 @@ async def _drain_on_shutdown(app: web.Application):
 async def delete_model(request: web.Request):
     model_id = _query_param(request, "model_id")
     log.info("Requesting deletion of model %s", model_id)
+    # Flush + delete the model's LoRA adapters first (registry cache AND
+    # checkpoints): an adapter without its base can never serve again, and
+    # a stale blob would resurrect under a recreated model id with
+    # different weights (mirror of the PR-2 prefix-cache flush).
+    from penroz_tpu.serve import adapters
+    deleted = await _run_blocking(adapters.delete_model_adapters, model_id)
+    if deleted:
+        log.info("Deleted %d adapter(s) of model %s: %s", len(deleted),
+                 model_id, ", ".join(deleted))
     NeuralNetworkModel.delete(model_id)
+    return web.Response(status=204)
+
+
+# ---------------------------------------------------------------------------
+# LoRA adapter lifecycle (/adapters/ — serve/adapters.py, models/lora.py)
+# ---------------------------------------------------------------------------
+
+async def create_adapter(request: web.Request):
+    body = await _parse(request, schemas.CreateAdapterRequest)
+    log.info("Requesting creation of adapter %s for model %s",
+             body.adapter_id, body.model_id)
+    from penroz_tpu.models import lora
+    from penroz_tpu.utils import checkpoint
+    if body.init not in ("zeros", "random"):
+        raise ValueError(f"init must be 'zeros' or 'random', "
+                         f"got {body.init!r}")
+    try:
+        checkpoint.peek_adapter_tree(body.adapter_id)
+        return _json({"detail": f"Adapter {body.adapter_id} already "
+                                f"exists."}, status=409)
+    except KeyError:
+        pass
+    model = await _run_blocking(NeuralNetworkModel.deserialize,
+                                body.model_id)
+    cfg = {"rank": body.rank, "alpha": body.alpha, "targets": body.targets}
+    blob = await _run_blocking(
+        lambda: lora.create_adapter(body.adapter_id, model, cfg,
+                                    seed=body.seed, init=body.init))
+    return _json({"adapter_id": body.adapter_id, "model_id": body.model_id,
+                  "config": blob["config"],
+                  "message": f"Adapter {body.adapter_id} created for model "
+                             f"{body.model_id}"})
+
+
+async def list_adapters(request: web.Request):
+    from penroz_tpu.serve import adapters
+    adapter_id = request.query.get("adapter_id")
+    if adapter_id is not None:
+        log.info("Requesting detail for adapter %s", adapter_id)
+        return _json(await _run_blocking(adapters.adapter_detail,
+                                         adapter_id))
+    log.info("Requesting adapter listing")
+    return _json({"adapters": await _run_blocking(adapters.list_adapters)})
+
+
+async def delete_adapter(request: web.Request):
+    adapter_id = _query_param(request, "adapter_id")
+    log.info("Requesting deletion of adapter %s", adapter_id)
+    from penroz_tpu.serve import adapters
+    from penroz_tpu.utils import checkpoint
+    checkpoint.peek_adapter_tree(adapter_id)  # KeyError → 404
+    adapters.REGISTRY.invalidate(adapter_id)
+    checkpoint.delete_adapter(adapter_id)
     return web.Response(status=204)
 
 
@@ -650,6 +867,9 @@ def create_app() -> web.Application:
     app.router.add_get("/progress/", model_progress)
     app.router.add_get("/stats/", model_stats)
     app.router.add_get("/serving_stats/", serving_stats)
+    app.router.add_post("/adapters/", create_adapter)
+    app.router.add_get("/adapters/", list_adapters)
+    app.router.add_delete("/adapters/", delete_adapter)
     app.router.add_delete("/model/", delete_model)
     if os.path.isdir(STATIC_DIR):
         app.router.add_static("/static/", STATIC_DIR)

@@ -35,7 +35,17 @@ rows are KV-cache slots (paged pool pages when ``PAGED_KV_CACHE=1``):
 - greedy outputs are token-identical to the single-sequence path with the
   prefix cache hitting, missing, or off, and with chunked or one-shot
   prefill (tested — the chunked program family is the same
-  cached-attention path, reading the same absolute positions).
+  cached-attention path, reading the same absolute positions);
+- with LoRA adapters registered (``serve/adapters.py``), requests carrying
+  an ``adapter_id`` bind to one of ``PENROZ_LORA_MAX_LIVE`` live slots per
+  engine: the slots' low-rank factors stack into static ``[L+1, R, ·]``
+  tensors and a per-row slot-index vector gathers each row's adapter
+  inside the SAME shared step (models/lora.py ``build_pack`` — rows with
+  different adapters, or none, decode together); chunked prefill and
+  spec-decode verify apply the row's adapter through the same pack, the
+  radix prefix cache namespaces pages per adapter generation (a base
+  prefix never aliases an adapter's KV), and crash recovery rebuilds the
+  adapter row tables with the rest of the engine state.
 
 Fault tolerance (PR 3) — overload and failure are scheduler features, not
 error-handler afterthoughts:
@@ -105,9 +115,11 @@ import time
 import jax
 import numpy as np
 
+from penroz_tpu.models import lora as lora_mod
 from penroz_tpu.models import model as model_mod
 from penroz_tpu.models.model import NeuralNetworkModel
 from penroz_tpu.ops import kv_cache as KV
+from penroz_tpu.serve import adapters as adapters_mod
 from penroz_tpu.serve import spec_decode
 from penroz_tpu.utils import checkpoint, faults, profiling
 from penroz_tpu.utils import stats as stats_util
@@ -259,16 +271,19 @@ class Request:
     """
 
     __slots__ = ("prompt", "max_new_tokens", "stop_token", "on_event",
-                 "enqueue_t", "cancelled", "deadline")
+                 "enqueue_t", "cancelled", "deadline", "adapter")
 
     def __init__(self, prompt, max_new_tokens, stop_token, on_event,
-                 timeout_ms=None):
+                 timeout_ms=None, adapter=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.stop_token = stop_token
         self.on_event = on_event
         self.enqueue_t = time.monotonic()
         self.cancelled = False
+        # serve.adapters.AdapterEntry (refcount-pinned by the HTTP layer
+        # for the request's lifetime) or None for base-model rows.
+        self.adapter = adapter
         budget = _effective_timeout_ms(timeout_ms)
         self.deadline = (self.enqueue_t + budget / 1000.0
                          if budget is not None else None)
@@ -333,6 +348,12 @@ class DecodeEngine:
         self._lengths = np.zeros(self.capacity, np.int32)
         self._last_tok = np.zeros(self.capacity, np.int32)
         self._rows: list = [None] * self.capacity
+        # Mixed-adapter serving (models/lora.py): up to PENROZ_LORA_MAX_LIVE
+        # adapters occupy live slots whose factors stack into one static
+        # [L+1, R, ·] pack; _row_adapter maps each batch row to its slot
+        # (slot _max_live = the always-zero base slot).
+        self._max_live = lora_mod.max_live()
+        self._adapter_tokens: dict = {}
         self._alloc_state()
 
         self._pending: collections.deque = collections.deque()
@@ -407,6 +428,13 @@ class DecodeEngine:
         self._lengths[:] = 0
         self._last_tok[:] = 0
         self._rows = [None] * self.capacity
+        # Adapter row tables rebuild with the rest of the engine state:
+        # after a crash nothing about the old slot assignment is trusted —
+        # every row re-parks on the base slot and the stacked pack drops
+        # (admission re-binds live adapters from their pinned entries).
+        self._slot_entries: list = [None] * self._max_live
+        self._row_adapter = np.full(self.capacity, self._max_live, np.int32)
+        self._lora_pack = None
 
     # -- public surface -----------------------------------------------------
 
@@ -527,6 +555,13 @@ class DecodeEngine:
                 self._max_chunks_between_steps,
             "prefix_cache": (self._prefix_cache.stats()
                              if self._prefix_cache is not None else None),
+            "lora_active_adapters": sum(
+                1 for e in self._slot_entries if e is not None),
+            "lora_rows": sum(
+                1 for i, r in enumerate(self._rows)
+                if r is not None
+                and int(self._row_adapter[i]) != self._max_live),
+            "lora_adapter_tokens": dict(self._adapter_tokens),
             "spec_decode": self._spec_on(),
             "spec_verify_steps": self._spec_verify_steps,
             "spec_drafted_tokens": self._spec_drafted_tokens,
@@ -660,23 +695,76 @@ class DecodeEngine:
                 continue
             if self.active_rows == 0:
                 self._maybe_reload()
-            self._begin_prefill(row, req)
+            slot = self._adapter_slot(req)
+            if slot is None:
+                # Every live slot belongs to a DIFFERENT in-flight adapter
+                # (PENROZ_LORA_MAX_LIVE of them) — requeue at the head
+                # (FIFO order preserved) and stop admitting this tick;
+                # a slot frees as soon as its last row retires.  This can
+                # only happen with rows in flight, so the worker loop
+                # keeps stepping and re-tries every boundary.
+                with self._cond:
+                    self._pending.appendleft(req)
+                return
+            self._begin_prefill(row, req, slot)
+
+    # -- adapter slots (mixed-adapter batches, models/lora.py) ---------------
+
+    def _adapter_slot(self, req: Request):
+        """Slot index for ``req``'s adapter: the base slot for plain rows,
+        a live slot holding the SAME adapter generation (uid) when one
+        exists, else a free/reclaimable slot (stacked pack rebuilt).
+        None when all slots hold other adapters with rows in flight."""
+        if req.adapter is None:
+            return self._max_live
+        for s, e in enumerate(self._slot_entries):
+            if e is not None and e.uid == req.adapter.uid:
+                return s
+        in_flight = {int(self._row_adapter[i])
+                     for i, r in enumerate(self._rows) if r is not None}
+        for s in range(self._max_live):
+            if self._slot_entries[s] is None or s not in in_flight:
+                self._slot_entries[s] = req.adapter
+                self._rebuild_pack()
+                return s
+        return None
+
+    def _rebuild_pack(self):
+        self._lora_pack = lora_mod.build_pack(
+            [e.params if e is not None else None
+             for e in self._slot_entries],
+            [e.config if e is not None else None
+             for e in self._slot_entries],
+            self._max_live)
+
+    def _prefix_ns(self, req: Request):
+        """Radix prefix-cache namespace for the row: adapter rows key on
+        the adapter LOAD GENERATION (entry.uid), so a retrained or
+        recreated adapter can never alias KV its previous weights wrote;
+        base rows share the None namespace."""
+        return req.adapter.uid if req.adapter is not None else None
 
     # -- chunked prefill (admission state machine) ---------------------------
 
-    def _begin_prefill(self, row: int, req: Request):
+    def _begin_prefill(self, row: int, req: Request, slot: int | None = None):
         """Claim ``row`` for ``req`` in the PREFILLING phase: match the
         radix prefix cache (paged + ``PENROZ_PREFIX_CACHE=1``), alias the
         matched pages into the row's block table, and plan pow-2-bucketed
         chunks over the remaining suffix.  No device prefill work happens
         here — ``_prefill_tick`` interleaves it with decode steps."""
         state = _Row(req)
+        self._row_adapter[row] = (slot if slot is not None
+                                  else self._max_live)
         if self._prefix_cache is not None:
             # Cap the usable match at len(prompt) - 1: the final chunk must
             # feed at least one real token to produce the first-sample
             # logits (a full-prompt hit would leave nothing to run).
+            # Namespaced per adapter generation: a base prefix must never
+            # alias an adapter's KV (or vice versa) — the pages hold
+            # weight-dependent K/V.
             nodes = self._prefix_cache.match(req.prompt,
-                                             limit=len(req.prompt) - 1)
+                                             limit=len(req.prompt) - 1,
+                                             namespace=self._prefix_ns(req))
             if nodes:
                 self._prefix_cache.pin(nodes)
                 state.prefix_nodes = nodes
@@ -758,7 +846,8 @@ class DecodeEngine:
                 profiling.span("penroz/sched_prefill_chunk"):
             tok, self._kv = self._model.decode_prefill_chunk(
                 self._kv, row, req.prompt[start:start + size], start, rng,
-                self.temperature, self.top_k)
+                self.temperature, self.top_k, lora=self._lora_pack,
+                adapter_slot=int(self._row_adapter[row]))
         state.prefilled += size
         state.chunk_idx += 1
         self._prefill_chunks += 1
@@ -785,7 +874,8 @@ class DecodeEngine:
         freshly prefilled suffix pages are copied."""
         if self._prefix_cache is None:
             return
-        created = self._prefix_cache.insert(state.req.prompt)
+        created = self._prefix_cache.insert(
+            state.req.prompt, namespace=self._prefix_ns(state.req))
         if created:
             S = self._kv.pages_per_seq
             self._kv = self._kv.copy_pages(
@@ -832,7 +922,8 @@ class DecodeEngine:
         with model_mod.decode_priority(), profiling.span("penroz/sched_step"):
             toks, self._kv = self._model.decode_step_batched(
                 self._kv, self._last_tok[:, None], self._lengths, rng,
-                self.temperature, self.top_k)
+                self.temperature, self.top_k, lora=self._lora_pack,
+                row_adapter=self._row_adapter)
             arr = np.asarray(toks)
         emitted = 0
         for i in rows:
@@ -891,7 +982,8 @@ class DecodeEngine:
                 profiling.span("penroz/sched_verify"):
             out, self._kv = self._model.decode_verify_row(
                 self._kv, row, tokens, start, rng, self.temperature,
-                self.top_k)
+                self.top_k, lora=self._lora_pack,
+                adapter_slot=int(self._row_adapter[row]))
         accepted = spec_decode.accept_length(draft, out)
         self._spec_verify_steps += 1
         self._spec_drafted_tokens += len(draft)
@@ -917,6 +1009,9 @@ class DecodeEngine:
     def _emit_token(self, row: int, state: _Row, tok: int):
         state.produced += 1
         state.history.append(tok)
+        if state.req.adapter is not None:
+            aid = state.req.adapter.adapter_id
+            self._adapter_tokens[aid] = self._adapter_tokens.get(aid, 0) + 1
         self._deliver(state.req, "token", tok)
         req = state.req
         if req.cancelled:
@@ -951,6 +1046,7 @@ class DecodeEngine:
         self._rows[row] = None
         self._lengths[row] = 0
         self._last_tok[row] = 0
+        self._row_adapter[row] = self._max_live
         self._release_prefix(row, state)
         self._kv = self._kv.reset_row(row)
         self._completed += 1
@@ -991,6 +1087,7 @@ class DecodeEngine:
                 self._rows[i] = None
                 self._lengths[i] = 0
                 self._last_tok[i] = 0
+                self._row_adapter[i] = self._max_live
                 try:
                     self._release_prefix(i, state)
                 except Exception:  # noqa: BLE001 — the device state may be
@@ -1032,8 +1129,17 @@ class DecodeEngine:
                 # against the new ones would silently mix models.  Zero rows
                 # are in flight here, so nothing is pinned.
                 self._prefix_cache.clear()
-            log.info("Decode engine reloaded model %s (checkpoint changed)",
-                     self.model_id)
+            # Same contract for adapters (the prefix-cache-flush mirror):
+            # the live slots and the host registry cache hold factors
+            # whose base just changed under them — drop both so the next
+            # adapter request re-resolves against fresh state (a reloaded
+            # entry gets a new uid, which also retires its old prefix
+            # namespace).
+            self._slot_entries = [None] * self._max_live
+            self._lora_pack = None
+            adapters_mod.REGISTRY.invalidate_model(self.model_id)
+            log.info("Decode engine reloaded model %s (checkpoint changed; "
+                     "prefix cache + adapter slots flushed)", self.model_id)
         except KeyError:
             # model deleted mid-flight: keep serving the cached weights;
             # the registry entry dies with the next reset/eviction.
@@ -1148,6 +1254,10 @@ def serving_stats() -> dict:
     spec_accepted = sum(p["spec_accepted_tokens"] for p in per)
     decode_steps = sum(p["decode_steps"] for p in per)
     decode_tokens = sum(p["decode_tokens"] for p in per)
+    adapter_tokens: dict = {}
+    for p in per:
+        for aid, n in p["lora_adapter_tokens"].items():
+            adapter_tokens[aid] = adapter_tokens.get(aid, 0) + n
     return {
         "continuous_batching_enabled": enabled(),
         "engines": per,
@@ -1172,6 +1282,9 @@ def serving_stats() -> dict:
         "prefix_cache_hit_rate": (
             sum(c["hits"] for c in pc) / pc_lookups if pc_lookups else None),
         "prefix_cache_evicted_pages": sum(c["evicted_pages"] for c in pc),
+        "lora_active_adapters": sum(p["lora_active_adapters"] for p in per),
+        "lora_rows": sum(p["lora_rows"] for p in per),
+        "lora_adapter_tokens": adapter_tokens,
         "spec_decode_enabled": spec_decode.enabled(),
         "spec_drafted_tokens": spec_drafted,
         "spec_accepted_tokens": spec_accepted,
@@ -1201,7 +1314,8 @@ async def acquire_engine(model_id, block_size, temperature, top_k):
                                       block_size, temperature, top_k)
 
 
-def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None):
+def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None,
+                   adapter=None):
     loop = asyncio.get_running_loop()
     queue: asyncio.Queue = asyncio.Queue()
 
@@ -1209,19 +1323,21 @@ def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None):
         loop.call_soon_threadsafe(queue.put_nowait, (kind, value))
 
     return (Request(prompt, max_new_tokens, stop_token, on_event,
-                    timeout_ms=timeout_ms), queue)
+                    timeout_ms=timeout_ms, adapter=adapter), queue)
 
 
 async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
-                      stop_token, timeout_ms=None) -> list[int]:
+                      stop_token, timeout_ms=None,
+                      adapter=None) -> list[int]:
     """Submit one request and await the full sequence (prompt + generated,
     the ``generate_tokens`` contract).  Raises DeadlineExceeded /
     QueueFullError / CircuitOpenError on the shed paths; an aiohttp client
     disconnect cancels the awaiting handler task, which propagates to
     ``req.cancelled`` so the row and its prefix pins free at the next
-    boundary."""
+    boundary.  ``adapter`` (serve.adapters.AdapterEntry) routes the row
+    through that adapter's live slot; the CALLER holds the registry pin."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
-                                timeout_ms)
+                                timeout_ms, adapter)
     engine.submit(req)
     tokens = list(req.prompt)
     try:
@@ -1239,13 +1355,13 @@ async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
 
 
 def start_stream(engine: DecodeEngine, prompt, max_new_tokens, stop_token,
-                 timeout_ms=None):
+                 timeout_ms=None, adapter=None):
     """Submit a streaming request; returns ``(req, queue)`` so the HTTP
     layer can consume events AND flip ``req.cancelled`` itself when the
     client goes away mid-stream (a write failure is invisible to an async
     generator until its GC-time close — the explicit handle is the
     disconnect wiring)."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
-                                timeout_ms)
+                                timeout_ms, adapter)
     engine.submit(req)
     return req, queue

@@ -150,11 +150,32 @@ def _routes() -> list[dict]:
         dict(method="post", path="/decode/", summary="Decode token ids",
              body=_body("DecodeTokensRequest"), responses=dict([ok])),
         dict(method="put", path="/train/",
-             summary="Train asynchronously (poll /progress/)",
+             summary="Train asynchronously (poll /progress/; with an "
+                     "'adapter' config, fine-tune a LoRA adapter against "
+                     "the frozen base and poll GET /adapters/)",
              body=_body("TrainingRequest"),
              responses=dict([_resp(202, "Training started"),
                              _resp(404, "Unknown model"),
+                             _resp(400, "Invalid device or adapter config"),
                              _resp(409, "Training already in progress")])),
+        dict(method="post", path="/adapters/",
+             summary="Register a LoRA adapter for a model (zero-init B: "
+                     "serves as the base model until trained)",
+             body=_body("CreateAdapterRequest"),
+             responses=dict([ok, _resp(404, "Unknown model"),
+                             _resp(400, "Invalid rank/targets "
+                                        "(PENROZ_LORA_MAX_RANK caps rank)"),
+                             _resp(409, "Adapter already exists")])),
+        dict(method="get", path="/adapters/",
+             summary="List adapters (or one adapter's detail + training "
+                     "progress with ?adapter_id=)",
+             responses=dict([ok, _resp(404, "Unknown adapter")])),
+        dict(method="delete", path="/adapters/",
+             summary="Delete an adapter (checkpoint + registry cache; "
+                     "in-flight rows finish on their copied factors)",
+             params=_query_params("adapter_id"),
+             responses=dict([_resp(204, "Deleted"),
+                             _resp(404, "Unknown adapter")])),
         dict(method="post", path="/profile/",
              summary="Start/stop a jax.profiler trace capture",
              body=_body("ProfileRequest"),
@@ -172,7 +193,8 @@ def _routes() -> list[dict]:
                      "batch occupancy, decode tokens/sec, admission "
                      "latency, prefill chunk-stall p99, prefix-cache hit "
                      "rate/evictions, speculative-decoding accept rate + "
-                     "tokens per decode step, KV pool-drop counter",
+                     "tokens per decode step, LoRA live adapters/rows + "
+                     "per-adapter token counts, KV pool-drop counter",
              responses={"200": {
                  "description": "Serving statistics",
                  "content": {"application/json": {"schema": {
@@ -192,6 +214,7 @@ def build_spec() -> dict:
         schemas.GenerateRequest, schemas.GenerateBatchRequest,
         schemas.DecodeTokensRequest,
         schemas.TrainingRequest, schemas.ProfileRequest,
+        schemas.CreateAdapterRequest,
         schemas.ServingStatsResponse,
     ]
     _, defs = models_json_schema(

@@ -38,12 +38,30 @@ class DownloadDatasetRequest(DatasetRequest, TokenizerRequest):
     shard_size: int = Field(..., description="Number of tokens per shard")
 
 
+class AdapterTrainConfig(BaseModel):
+    """LoRA fine-tune selector on PUT /train/: the base model is frozen,
+    only the adapter's low-rank factors train, and the checkpoint written
+    is adapter-only (models/lora.py, servable via /adapters/)."""
+    adapter_id: str = Field(..., description="Adapter to train (created on "
+                            "first train if absent)")
+    rank: int = Field(8, description="Low-rank dimension r; capped by "
+                      "PENROZ_LORA_MAX_RANK")
+    alpha: Optional[float] = Field(None, description="Scale numerator "
+                                   "(delta = alpha/r · B·A·x); default 2r")
+    targets: Optional[list[str]] = Field(
+        None, description="Substring matchers over Linear param prefixes "
+        "(e.g. ['layers.2']); null targets every Linear projection")
+
+
 class TrainingRequest(ModelOnDeviceRequest, DatasetRequest):
     shard: int = Field(..., description="Dataset shard to begin training from")
     epochs: int = Field(..., description="Number of training epochs")
     batch_size: int = Field(..., description="Batch size sampled each epoch")
     block_size: int = Field(..., description="Sequence length per sample")
     step_size: int = Field(..., description="Blocks per accumulation step")
+    adapter: Optional[AdapterTrainConfig] = Field(
+        None, description="Train a LoRA adapter instead of the base "
+        "weights (base frozen; adapter-only checkpoint)")
 
 
 class EvaluateRequest(TrainingRequest):
@@ -73,6 +91,11 @@ class GenerateRequest(ModelRequest):
         "by PENROZ_REQ_TIMEOUT_MS server-side: 504 while queued, retired "
         "at the next step boundary (stream ends with a 'timeout' line) in "
         "flight")
+    adapter_id: Optional[str] = Field(
+        None, description="Serve through this LoRA adapter (POST "
+        "/adapters/ or a /train/ adapter run creates one). Unknown "
+        "adapter → 400 naming it; still loading → 409. Mixed adapters "
+        "share one decode batch under PENROZ_CONTINUOUS_BATCHING=1")
 
 
 class GenerateBatchRequest(ModelRequest):
@@ -92,6 +115,32 @@ class GenerateBatchRequest(ModelRequest):
         None, description="Per-row deadline in ms (scheduler path), capped "
         "by PENROZ_REQ_TIMEOUT_MS; any shed row sheds the whole batch "
         "(all-or-nothing contract)")
+    adapter_id: Optional[str] = Field(
+        None, description="LoRA adapter applied to EVERY row (overridden "
+        "per-row by adapter_ids)")
+    adapter_ids: Optional[list[Optional[str]]] = Field(
+        None, description="Per-row LoRA adapter ids (null entries = base "
+        "model); length must equal inputs. Rows with different adapters "
+        "share one decode batch; unknown adapters 400 naming the rows, "
+        "still-loading adapters 409")
+
+
+class CreateAdapterRequest(ModelRequest):
+    """POST /adapters/ — register a fresh LoRA adapter for a model.
+    B is zero-initialized, so an untrained adapter serves exactly the
+    base model; ``init='random'`` randomizes B too (benchmarks/tests)."""
+    adapter_id: str = Field(..., description="Unique adapter id")
+    rank: int = Field(8, description="Low-rank dimension r (1..PENROZ_"
+                      "LORA_MAX_RANK)")
+    alpha: Optional[float] = Field(None, description="Scale numerator; "
+                                   "default 2r")
+    targets: Optional[list[str]] = Field(
+        None, description="Substring matchers over Linear param prefixes; "
+        "null targets every Linear projection")
+    seed: int = Field(0, description="Init seed")
+    init: str = Field("zeros", description="'zeros' (identity until "
+                      "trained) or 'random' (non-trivial delta without "
+                      "training)")
 
 
 class DecodeTokensRequest(TokenizerRequest):
@@ -177,6 +226,15 @@ class EngineStats(BaseModel):
                                "engine lifetime")
     engine_resets: int = Field(0, description="Full KV/prefix-state "
                                "reallocations after crashes")
+    lora_active_adapters: int = Field(0, description="LoRA adapters "
+                                      "occupying live slots of this "
+                                      "engine's stacked pack "
+                                      "(PENROZ_LORA_MAX_LIVE cap)")
+    lora_rows: int = Field(0, description="In-flight rows bound to an "
+                           "adapter (the rest decode the base model)")
+    lora_adapter_tokens: dict[str, int] = Field(
+        default_factory=dict, description="Tokens emitted per adapter id "
+        "over the engine lifetime (multi-tenant accounting)")
     spec_decode: bool = Field(False, description="Speculative decoding "
                               "active on this engine (PENROZ_SPEC_DECODE=1 "
                               "and greedy sampling; non-greedy engines "
@@ -231,6 +289,13 @@ class ServingStatsResponse(BaseModel):
         "when no engine runs a prefix cache)")
     prefix_cache_evicted_pages: int = Field(
         0, description="Aggregate LRU-evicted prefix-cache pages")
+    lora_active_adapters: int = Field(0, description="Aggregate live "
+                                      "adapter slots across engines")
+    lora_rows: int = Field(0, description="Aggregate in-flight adapter-"
+                           "bound rows")
+    lora_adapter_tokens: dict[str, int] = Field(
+        default_factory=dict, description="Aggregate tokens emitted per "
+        "adapter id")
     spec_decode_enabled: bool = Field(False, description="PENROZ_SPEC_DECODE"
                                       "=1 (greedy engines draft via prompt "
                                       "lookup + multi-token verify steps)")

@@ -246,6 +246,12 @@ function renderServing(data) {
   /* Fault-tolerance readouts (PR 3): shed/timeout counters and the engine
    * circuit breaker — an open breaker is the "stop paging the dashboard,
    * the engine is crash-looping" signal. */
+  /* Multi-tenant LoRA (PENROZ_LORA_MAX_LIVE slots per engine): live
+   * adapters sharing the decode batch and the rows currently bound to
+   * one — "lora off" until any adapter occupies a slot. */
+  const loraAdapters = data.lora_active_adapters || 0;
+  const loraTxt = loraAdapters === 0 ? "lora off"
+    : `lora ${loraAdapters} adapters · ${data.lora_rows || 0} rows`;
   const crashes = data.crashes_total || 0;
   const breakerTxt = data.breaker_open
     ? `breaker OPEN (${crashes} crashes, ${data.engine_resets || 0} resets)`
@@ -261,7 +267,7 @@ function renderServing(data) {
     `${data.admission_latency_ms_p50 == null ? "—"
        : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
-    `${specTxt} · ${prefixTxt} · KV pool drops ${drops}`;
+    `${specTxt} · ${loraTxt} · ${prefixTxt} · KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();
   const xs = servingHistory.map((_, i) => i);

@@ -407,6 +407,85 @@ def load_shards(model_id: str) -> list[dict]:
     return shards
 
 
+# ---------------------------------------------------------------------------
+# LoRA adapter checkpoints (models/lora.py, serve/adapters.py)
+# ---------------------------------------------------------------------------
+#
+# Adapters persist through the SAME container format (CRC32 per array
+# stream, shm write-through + background durable flush) under their own
+# filename family — ``adapter_<id>.ckpt`` never collides with the
+# ``model_*`` glob, so list_model_ids / the orphan sweep stay model-only.
+
+def adapter_path(adapter_id: str) -> str:
+    return os.path.join(MODELS_FOLDER, f"adapter_{adapter_id}.ckpt")
+
+
+def shm_adapter_path(adapter_id: str) -> str:
+    return os.path.join(SHM_PATH, adapter_path(adapter_id))
+
+
+def list_adapter_ids() -> list[str]:
+    """Adapter ids with a checkpoint blob (durable or shm copy)."""
+    import glob
+    import re
+    ids = set()
+    for base in (MODELS_FOLDER, os.path.join(SHM_PATH, MODELS_FOLDER)):
+        for path in glob.glob(os.path.join(base, "adapter_*.ckpt")):
+            m = re.match(r"adapter_(.+?)\.ckpt$", os.path.basename(path))
+            if m:
+                ids.add(m.group(1))
+    return sorted(ids)
+
+
+def save_adapter(adapter_id: str, data: dict, sync_flush: bool = False):
+    """Persist one adapter blob (shm write-through + background flush —
+    the model-checkpoint write path applied to the adapter family)."""
+    os.makedirs(MODELS_FOLDER, exist_ok=True)
+    os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
+    shm_path = shm_adapter_path(adapter_id)
+    _atomic_write(shm_path, data)
+    if sync_flush:
+        _flush(shm_path, adapter_path(adapter_id))
+    else:
+        _spawn_flush(shm_path, adapter_path(adapter_id))
+
+
+def load_adapter(adapter_id: str) -> dict:
+    """Read an adapter checkpoint (CRC-verified), repopulating the shm
+    cache on a miss.  :raises KeyError: if the adapter was never created
+    (API maps this to a descriptive 400/404)."""
+    shm_path = shm_adapter_path(adapter_id)
+    durable_path = adapter_path(adapter_id)
+    try:
+        if not os.path.exists(shm_path):
+            os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
+            shutil.copyfile(durable_path, shm_path)
+        return _read(shm_path)
+    except FileNotFoundError:
+        raise KeyError(f"Adapter {adapter_id} not created yet.")
+
+
+def peek_adapter_tree(adapter_id: str) -> dict:
+    """Header-only adapter metadata (status/config/model_id) — array leaves
+    come back None.  :raises KeyError: unknown adapter."""
+    path = shm_adapter_path(adapter_id)
+    if not os.path.exists(path):
+        path = adapter_path(adapter_id)
+    try:
+        with open(path, "rb") as f:
+            header, _ = _read_header(f)
+    except FileNotFoundError:
+        raise KeyError(f"Adapter {adapter_id} not created yet.")
+    return _decode_tree(header["tree"], lambda i: None)
+
+
+def delete_adapter(adapter_id: str):
+    """Remove both adapter copies (shm + durable) independently, like
+    :func:`delete` does for models."""
+    _remove_quietly(shm_adapter_path(adapter_id))
+    _remove_quietly(adapter_path(adapter_id))
+
+
 def save(model_id: str, data: dict, sync_flush: bool = False):
     """Write checkpoint to shm and flush to disk in the background.
 

@@ -26,7 +26,8 @@ Registered production sites: ``decode.step`` (shared decode step),
 ``decode.prefill_chunk`` (admission prefill chunk), ``decode.verify``
 (speculative-decoding multi-token verify step), ``ckpt.write``
 (checkpoint container write), ``data.download`` (dataset download
-attempt).  Call counters are per-site and process-wide; tests reset them
+attempt), ``lora.load`` (adapter-checkpoint load into the serving
+registry, serve/adapters.py).  Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.
 """
 

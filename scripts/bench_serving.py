@@ -41,6 +41,18 @@ file for ``bench_watch.sh``-style artifact capture.  Scale knobs (env):
 ``PENROZ_BENCH_PREFIX_PAGE`` (KV page size), ``PENROZ_BENCH_CHUNK``
 (prefill chunk).
 
+``--multi-adapter`` switches to the multi-tenant LoRA workload: N tenants
+(distinct random adapters + the base model) stream requests; phase
+``serial_per_adapter`` runs one tenant's batched group at a time (the
+best a per-adapter-engine deployment can do) and phase ``mixed`` fires
+every tenant concurrently so rows with DIFFERENT adapters share one
+decode step via the stacked adapter pack (models/lora.py).  Reports wall
+time + ITL p50/p99 per phase, the mixed-vs-serial wall speedup, greedy
+per-request parity between phases, and the ``lora_*`` serving stats.
+Scale knobs: ``PENROZ_BENCH_LORA_ADAPTERS``, ``PENROZ_BENCH_LORA_RANK``,
+``PENROZ_BENCH_LORA_PROMPT``, plus the shared ``PENROZ_BENCH_SERVING_*``
+/ ``PENROZ_BENCH_REQUESTS`` / ``PENROZ_BENCH_MAX_NEW`` set.
+
 ``--speculative`` switches to the speculative-decoding workload:
 sequential streaming requests over repetitive-text prompts (short token
 motifs repeated — the shape prompt lookup exists for), measured with
@@ -421,6 +433,126 @@ async def _bench_shared_prefix() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --multi-adapter: mixed LoRA tenants in one shared decode batch
+# ---------------------------------------------------------------------------
+
+async def _bench_multi_adapter() -> dict:
+    """Multi-tenant LoRA workload: N tenants (distinct random adapters +
+    the base model) each stream requests; phase 'serial_per_adapter' runs
+    one tenant's group at a time (each group still batched — the best a
+    per-adapter-engine deployment can do), phase 'mixed' fires every
+    tenant concurrently so rows with different adapters share ONE decode
+    step via the stacked adapter pack.  Reports wall time + ITL p50/p99
+    per phase and asserts greedy parity per request between phases —
+    mixing tenants must not change anyone's tokens."""
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import adapters, decode_scheduler
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 256)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 256)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 4)
+    n_adapters = _env_i("PENROZ_BENCH_LORA_ADAPTERS", 2)
+    rank = _env_i("PENROZ_BENCH_LORA_RANK", 8)
+    per_tenant = _env_i("PENROZ_BENCH_REQUESTS", 2)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 32)
+    prompt_len = _env_i("PENROZ_BENCH_LORA_PROMPT", 8)
+    vocab = 512
+    assert prompt_len + max_new <= block
+
+    env = {decode_scheduler.ENABLE_ENV: "1",
+           decode_scheduler.MAX_ROWS_ENV: str((n_adapters + 1) * per_tenant)}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(0)
+    tenants = [f"tenant-{i}" for i in range(n_adapters)] + [None]
+    prompts = {t: [[int(x) for x in rng.integers(1, vocab - 1, prompt_len)]
+                   for _ in range(per_tenant)] for t in tenants}
+
+    def payload(prompt, tenant):
+        p = {"model_id": "bench-lora", "input": [prompt],
+             "block_size": block, "max_new_tokens": max_new,
+             "temperature": 0.0}
+        if tenant is not None:
+            p["adapter_id"] = tenant
+        return p
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-lora",
+            "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+        for i in range(n_adapters):
+            resp = await client.post("/adapters/", json={
+                "model_id": "bench-lora", "adapter_id": f"tenant-{i}",
+                "rank": rank, "init": "random", "seed": 100 + i})
+            assert resp.status == 200, await resp.text()
+
+        results: dict = {
+            "mode": "multi_adapter", "block_size": block,
+            "adapters": n_adapters, "rank": rank,
+            "requests_per_tenant": per_tenant, "max_new_tokens": max_new,
+            "model_d": d, "model_depth": depth,
+        }
+        # Warm every (tenant, prompt-shape) program family so the timed
+        # phases measure serving, not XLA compiles.
+        for t in tenants:
+            await _stream_one(client, payload(prompts[t][0], t))
+
+        sequences = {}
+        for phase in ("serial_per_adapter", "mixed"):
+            decode_scheduler.reset()  # fresh engine + counters per phase
+            itls, seqs = [], {}
+            t0 = time.perf_counter()
+            if phase == "serial_per_adapter":
+                for t in tenants:
+                    outs = await asyncio.gather(*[
+                        _stream_one(client, payload(p, t))
+                        for p in prompts[t]])
+                    for p, (toks, _, gaps) in zip(prompts[t], outs):
+                        itls.extend(gaps)
+                        seqs[(t, tuple(p))] = toks
+            else:
+                jobs = [(t, p) for t in tenants for p in prompts[t]]
+                outs = await asyncio.gather(*[
+                    _stream_one(client, payload(p, t)) for t, p in jobs])
+                for (t, p), (toks, _, gaps) in zip(jobs, outs):
+                    itls.extend(gaps)
+                    seqs[(t, tuple(p))] = toks
+            wall_s = time.perf_counter() - t0
+            sequences[phase] = seqs
+            results[phase] = {
+                "wall_s": round(wall_s, 3),
+                "itl_ms_p50": (round(_pct(itls, 0.5), 3) if itls else None),
+                "itl_ms_p99": (round(_pct(itls, 0.99), 3) if itls else None),
+            }
+        results["parity_ok"] = (sequences["serial_per_adapter"]
+                                == sequences["mixed"])
+        results["wall_speedup_mixed_vs_serial"] = round(
+            results["serial_per_adapter"]["wall_s"]
+            / results["mixed"]["wall_s"], 3)
+        resp = await client.get("/serving_stats/")
+        stats = await resp.json()
+        stats.pop("engines", None)
+        results["serving_stats"] = stats
+        return results
+    finally:
+        decode_scheduler.reset()
+        adapters.REGISTRY.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
 # --speculative: prompt-lookup draft + multi-token verify (tokens/step)
 # ---------------------------------------------------------------------------
 
@@ -538,17 +670,24 @@ def _emit(results: dict):
 
 def main():
     args = [a for a in sys.argv[1:]
-            if a not in ("--shared-prefix", "--overload", "--speculative")]
+            if a not in ("--shared-prefix", "--overload", "--speculative",
+                         "--multi-adapter")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     speculative = "--speculative" in sys.argv[1:]
+    multi_adapter = "--multi-adapter" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
         os.environ["PENROZ_BENCH_JSON_OUT"] = os.path.abspath(
             os.environ["PENROZ_BENCH_JSON_OUT"])
     # Isolated checkpoint dirs: the benchmark must not touch repo models.
+    # PENROZ_SHM_PATH is pinned too (before any penroz import reads it) —
+    # the shm write-through copy otherwise leaks blobs across bench runs
+    # (an adapter_* blob in the real /dev/shm would 409 the next run's
+    # POST /adapters/).
     workdir = tempfile.mkdtemp(prefix="penroz_bench_serving_")
+    os.environ.setdefault("PENROZ_SHM_PATH", workdir)
     os.chdir(workdir)
     if overload:
         _emit(asyncio.run(_bench_overload()))
@@ -558,6 +697,9 @@ def main():
         return
     if speculative:
         _emit(asyncio.run(_bench_speculative()))
+        return
+    if multi_adapter:
+        _emit(asyncio.run(_bench_multi_adapter()))
         return
     concurrency = int(args[0]) if len(args) > 0 else 8
     max_new = int(args[1]) if len(args) > 1 else 48

@@ -86,6 +86,19 @@ while true; do
           -- "BENCH_SPEC_DECODE_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) speculative capture committed" >> logs/bench_watch.log
     fi
+    # Multi-tenant LoRA capture (same shape as the shared-prefix hook):
+    # mixed-adapter ITL/wall vs per-adapter serial groups + parity.
+    # Opt-in; failures must not block the main capture.
+    if [ "${PENROZ_WATCH_LORA:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_LORA_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --multi-adapter \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_LORA_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: multi-adapter LoRA capture" \
+          -- "BENCH_LORA_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) multi-adapter capture committed" >> logs/bench_watch.log
+    fi
     if [ "$rc" -eq 0 ]; then
       python - "$SNAP" "$attempt" <<'EOF' 2>> logs/bench_watch.log
 import json, sys, time

@@ -165,6 +165,10 @@ async function runDashboardTests(src, fixtures) {
                fixtures.serving.tokens_per_decode_step.toFixed(2) +
                " tok/step"),
              "serving tile shows tokens per decode step");
+    assertOk(servingMeta.includes(
+               `lora ${fixtures.serving.lora_active_adapters} adapters · ` +
+               `${fixtures.serving.lora_rows} rows`),
+             "serving tile shows live LoRA adapters and bound rows");
     const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
     assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];
@@ -206,7 +210,8 @@ async function runDashboardTests(src, fixtures) {
   {
     const servingOff = Object.assign({}, fixtures.serving, {
       prefix_cache_hit_rate: null, prefill_chunk_stall_ms_p99: null,
-      spec_decode_enabled: false, spec_accept_rate: null });
+      spec_decode_enabled: false, spec_accept_rate: null,
+      lora_active_adapters: 0, lora_rows: 0, lora_adapter_tokens: {} });
     const { document } = await runDashboard(src, {
       progress: fixtures.progress, stats: fixtures.statsPlain,
       serving: servingOff });
@@ -219,6 +224,8 @@ async function runDashboardTests(src, fixtures) {
              "serving tile shows 'spec off' when speculation is disabled");
     assertOk(!servingMeta.includes("tok/step"),
              "no tokens-per-step readout while speculation is off");
+    assertOk(servingMeta.includes("lora off"),
+             "serving tile shows 'lora off' with zero live adapters");
   }
 
   // 2d. spec decode enabled but no draft yet: accept rate dashes instead

@@ -104,6 +104,43 @@ def test_speculative_bench_smoke(tmp_path):
         assert phase["itl_ms_p99"] >= phase["itl_ms_p50"]
 
 
+def test_multi_adapter_bench_smoke(tmp_path):
+    """--multi-adapter: mixed LoRA tenants in one shared decode batch must
+    return exactly the tokens each tenant gets from its own serial group
+    (greedy parity — mixing tenants never changes anyone's output), with
+    the lora_* serving stats populated and per-tenant token accounting."""
+    out_path = tmp_path / "multi_adapter.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PENROZ_BENCH_SERVING_BLOCK="128",
+        PENROZ_BENCH_SERVING_D="64",
+        PENROZ_BENCH_SERVING_DEPTH="2",
+        PENROZ_BENCH_LORA_ADAPTERS="2",
+        PENROZ_BENCH_LORA_RANK="4",
+        PENROZ_BENCH_REQUESTS="2",
+        PENROZ_BENCH_MAX_NEW="16",
+        PENROZ_BENCH_JSON_OUT=str(out_path),
+    )
+    proc = subprocess.run([sys.executable, SCRIPT, "--multi-adapter"],
+                          capture_output=True, text=True, timeout=900,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.loads(out_path.read_text()) == results
+
+    assert results["mode"] == "multi_adapter"
+    assert results["parity_ok"] is True, results       # never wrong tokens
+    for phase in ("serial_per_adapter", "mixed"):
+        assert results[phase]["wall_s"] > 0
+        assert results[phase]["itl_ms_p50"] > 0
+    stats = results["serving_stats"]
+    assert stats["lora_active_adapters"] == 2
+    # every tenant's tokens are accounted: 2 requests x 16 new tokens
+    assert stats["lora_adapter_tokens"] == {"tenant-0": 32, "tenant-1": 32}
+    assert results["wall_speedup_mixed_vs_serial"] > 0
+
+
 def test_overload_bench_smoke(tmp_path):
     """--overload (PR 3): offered load > capacity must shed with 429s and
     complete the admitted requests with exact greedy parity — ZERO

@@ -1080,7 +1080,9 @@ def test_spec_non_greedy_engine_bypasses_drafting(gpt_model, make_engine,
 
 
 def _radix_nodes(cache):
-    nodes, stack = [], list(cache._root.children.values())
+    # walk every namespace root (adapter namespaces included)
+    nodes, stack = [], [nd for root in cache._roots.values()
+                        for nd in root.children.values()]
     while stack:
         nd = stack.pop()
         nodes.append(nd)

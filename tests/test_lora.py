@@ -1,0 +1,332 @@
+"""LoRA adapter unit tests: the low-rank math (models/lora.py +
+ops/modules.Linear), adapter checkpoints, the serving registry
+(serve/adapters.py), the namespaced radix prefix cache, and API-driven
+adapter training.
+
+The load-bearing contracts: a zero-B adapter is EXACTLY the base model; a
+bound adapter matches the offline weight-merge oracle greedy-token-wise;
+prefix pages never cross adapter namespaces; the registry turns unknown /
+mid-load / corrupt adapters into typed, descriptive errors.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from penroz_tpu.models import lora
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+from penroz_tpu.utils import checkpoint, faults
+
+pytestmark = pytest.mark.runtime
+
+BLOCK = 16
+SGD = {"sgd": {"lr": 0.1}}
+
+
+@pytest.fixture(autouse=True)
+def _registry_reset():
+    from penroz_tpu.serve import adapters
+    adapters.REGISTRY.reset()
+    faults.reset()
+    yield
+    adapters.REGISTRY.reset()
+    faults.reset()
+
+
+@pytest.fixture
+def gpt_model(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("loragpt", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    return model
+
+
+def _random_adapter(model, rank=4, seed=1):
+    cfg = lora.validate_config({"rank": rank, "alpha": 2.0 * rank})
+    return cfg, lora.init_params(model.arch, cfg, seed=seed, init="random")
+
+
+# ---------------------------------------------------------------------------
+# Low-rank math
+# ---------------------------------------------------------------------------
+
+def test_zero_adapter_is_exact_identity(gpt_model):
+    """Fresh (B=0) adapters must serve the base model token-identically —
+    a new tenant's first request before any training is the base model."""
+    cfg = lora.validate_config({"rank": 4})
+    params = lora.init_params(gpt_model.arch, cfg)
+    base = gpt_model.generate_tokens([[1, 2, 3]], BLOCK, 6, temperature=0.0)
+    bound = lora.bind_model(gpt_model, params, cfg)
+    assert bound.generate_tokens([[1, 2, 3]], BLOCK, 6,
+                                 temperature=0.0) == base
+
+
+def test_bound_adapter_matches_merged_weight_oracle(gpt_model):
+    """base + (alpha/r)·B·A·x through the Linear hook must match folding
+    ΔW = (alpha/r)·B·A into the weights offline (greedy tokens)."""
+    import copy
+    cfg, params = _random_adapter(gpt_model)
+    bound = lora.bind_model(gpt_model, params, cfg)
+    merged_model = copy.copy(gpt_model)
+    merged_model.params = lora.merge_weights(gpt_model.params, params, cfg)
+    out_bound = bound.generate_tokens([[1, 2, 3]], BLOCK, 8,
+                                      temperature=0.0)
+    out_merged = merged_model.generate_tokens([[1, 2, 3]], BLOCK, 8,
+                                              temperature=0.0)
+    assert out_bound == out_merged
+    # and a random adapter actually changes the output vs base
+    base = gpt_model.generate_tokens([[1, 2, 3]], BLOCK, 8, temperature=0.0)
+    assert out_bound != base
+
+
+def test_validate_config_rank_cap(monkeypatch):
+    monkeypatch.setenv(lora.MAX_RANK_ENV, "8")
+    with pytest.raises(ValueError, match="rank 9 outside"):
+        lora.validate_config({"rank": 9})
+    assert lora.validate_config({"rank": 8})["rank"] == 8
+    assert lora.validate_config({"rank": 4})["alpha"] == 8.0  # default 2r
+
+
+def test_target_linears_filtering(gpt_model):
+    all_targets = lora.target_linears(gpt_model.arch, None)
+    assert len(all_targets) == 9  # 4 per block x 2 blocks + lm head
+    some = lora.target_linears(gpt_model.arch, ["layers.2"])
+    assert 0 < len(some) < len(all_targets)
+    assert all(p.startswith("layers.2") for p, _, _ in some)
+    with pytest.raises(ValueError, match="match no Linear"):
+        lora.target_linears(gpt_model.arch, ["nomatch"])
+
+
+def test_build_pack_shapes_and_zero_slot(gpt_model, monkeypatch):
+    monkeypatch.setenv(lora.MAX_RANK_ENV, "8")
+    cfgA, apA = _random_adapter(gpt_model, rank=4, seed=1)
+    cfgB, apB = _random_adapter(gpt_model, rank=2, seed=2)
+    pack = lora.build_pack([apA, apB, None], [cfgA, cfgB, None], 3)
+    prefix = next(iter(pack))
+    ent = pack[prefix]
+    assert ent["a"].shape[0] == 4 and ent["a"].shape[1] == 8  # L+1, R
+    # rank padding beyond each adapter's r is zero
+    assert not np.asarray(ent["a"][0, 4:]).any()
+    assert not np.asarray(ent["a"][1, 2:]).any()
+    # empty slot 2 and the trailing base slot 3 are all-zero
+    assert not np.asarray(ent["a"][2]).any()
+    assert not np.asarray(ent["a"][3]).any()
+    assert not np.asarray(ent["b"][3]).any()
+    assert float(ent["scale"][3]) == 0.0
+    assert lora.build_pack([None, None], [None, None], 2) is None
+
+
+# ---------------------------------------------------------------------------
+# Adapter checkpoints
+# ---------------------------------------------------------------------------
+
+def test_adapter_checkpoint_roundtrip(gpt_model):
+    cfg, params = _random_adapter(gpt_model)
+    lora.save_adapter("rt", "loragpt", cfg, params,
+                      {"code": "Created", "message": "x"}, sync_flush=True)
+    assert "rt" in checkpoint.list_adapter_ids()
+    blob = checkpoint.load_adapter("rt")
+    assert blob["model_id"] == "loragpt"
+    assert blob["config"]["rank"] == cfg["rank"]
+    for k, v in params.items():
+        np.testing.assert_array_equal(blob["params"][k], np.asarray(v))
+    # header-only peek sees metadata without arrays
+    tree = checkpoint.peek_adapter_tree("rt")
+    assert tree["status"]["code"] == "Created"
+    checkpoint.delete_adapter("rt")
+    assert "rt" not in checkpoint.list_adapter_ids()
+    with pytest.raises(KeyError):
+        checkpoint.load_adapter("rt")
+
+
+# ---------------------------------------------------------------------------
+# Serving registry
+# ---------------------------------------------------------------------------
+
+def test_registry_acquire_release_and_lru(gpt_model, monkeypatch):
+    from penroz_tpu.serve import adapters
+    monkeypatch.setenv(adapters.HOST_CACHE_ENV, "2")
+    for i in range(3):
+        cfg, params = _random_adapter(gpt_model, seed=i)
+        lora.save_adapter(f"a{i}", "loragpt", cfg, params,
+                          {"code": "Created"}, sync_flush=True)
+    e0 = adapters.REGISTRY.acquire("a0", "loragpt")
+    assert e0.state == "ready" and e0.refs == 1
+    e1 = adapters.REGISTRY.acquire("a1", "loragpt")
+    adapters.REGISTRY.release(e1)
+    # a0 stays pinned; loading a2 over the 2-entry cap evicts unpinned a1
+    adapters.REGISTRY.acquire("a2", "loragpt")
+    assert set(adapters.REGISTRY.cached_ids()) == {"a0", "a2"}
+    # re-acquire of the same id reuses the entry (same uid)
+    again = adapters.REGISTRY.acquire("a0", "loragpt")
+    assert again.uid == e0.uid
+
+
+def test_registry_unknown_adapter_is_descriptive_value_error(gpt_model):
+    from penroz_tpu.serve import adapters
+    with pytest.raises(ValueError, match="unknown adapter 'ghost'"):
+        adapters.REGISTRY.acquire("ghost", "loragpt")
+
+
+def test_registry_rejects_over_rank_checkpoint(gpt_model, monkeypatch):
+    """A checkpoint whose rank exceeds the CURRENT PENROZ_LORA_MAX_RANK
+    (the knob shrank after creation) fails at acquire with a typed 400 —
+    the stacked pack pads to max_rank, so letting it through would crash
+    the engine tick instead."""
+    from penroz_tpu.serve import adapters
+    cfg, params = _random_adapter(gpt_model, rank=4)
+    lora.save_adapter("bigr", "loragpt", cfg, params, {"code": "Created"},
+                      sync_flush=True)
+    monkeypatch.setenv(lora.MAX_RANK_ENV, "2")
+    with pytest.raises(ValueError, match="rank 4 exceeds"):
+        adapters.REGISTRY.acquire("bigr", "loragpt")
+
+
+def test_registry_model_mismatch(gpt_model):
+    from penroz_tpu.serve import adapters
+    cfg, params = _random_adapter(gpt_model)
+    lora.save_adapter("mm", "loragpt", cfg, params, {"code": "Created"},
+                      sync_flush=True)
+    with pytest.raises(ValueError, match="belongs to model 'loragpt'"):
+        adapters.REGISTRY.acquire("mm", "othermodel")
+
+
+def test_registry_load_failure_fault_site(gpt_model, monkeypatch):
+    """lora.load raise@1: the first acquire fails descriptively (naming
+    the adapter, no KeyError 500 shape) and the NEXT acquire retries the
+    load and succeeds — a transient read error must not poison the id."""
+    from penroz_tpu.serve import adapters
+    cfg, params = _random_adapter(gpt_model)
+    lora.save_adapter("flaky", "loragpt", cfg, params, {"code": "Created"},
+                      sync_flush=True)
+    monkeypatch.setenv(faults.ENV, "lora.load:raise@1")
+    with pytest.raises(ValueError, match="'flaky' failed to load"):
+        adapters.REGISTRY.acquire("flaky", "loragpt")
+    entry = adapters.REGISTRY.acquire("flaky", "loragpt")
+    assert entry.state == "ready"
+
+
+def test_registry_concurrent_load_second_caller_409_shape(gpt_model,
+                                                          monkeypatch):
+    """While one thread loads an adapter, a concurrent acquire gets
+    AdapterLoadingError (the HTTP 409) instead of a duplicate disk read."""
+    from penroz_tpu.serve import adapters
+    cfg, params = _random_adapter(gpt_model)
+    lora.save_adapter("slow", "loragpt", cfg, params, {"code": "Created"},
+                      sync_flush=True)
+    monkeypatch.setenv(faults.ENV, "lora.load:sleep@300")
+    results = {}
+
+    def first():
+        results["first"] = adapters.REGISTRY.acquire("slow", "loragpt")
+
+    t = threading.Thread(target=first)
+    t.start()
+    time.sleep(0.1)  # first() is inside the injected 300ms load sleep
+    with pytest.raises(adapters.AdapterLoadingError, match="still loading"):
+        adapters.REGISTRY.acquire("slow", "loragpt")
+    t.join(timeout=10)
+    assert results["first"].state == "ready"
+
+
+def test_registry_invalidate_model_drops_entries(gpt_model):
+    from penroz_tpu.serve import adapters
+    cfg, params = _random_adapter(gpt_model)
+    lora.save_adapter("inv", "loragpt", cfg, params, {"code": "Created"},
+                      sync_flush=True)
+    old = adapters.REGISTRY.acquire("inv", "loragpt")
+    adapters.REGISTRY.invalidate_model("loragpt")
+    assert adapters.REGISTRY.cached_ids() == []
+    # next acquire reloads under a NEW generation uid (prefix-cache
+    # namespaces key on it, so stale KV can never alias)
+    fresh = adapters.REGISTRY.acquire("inv", "loragpt")
+    assert fresh.uid != old.uid
+
+
+# ---------------------------------------------------------------------------
+# Namespaced radix prefix cache
+# ---------------------------------------------------------------------------
+
+def test_radix_namespaces_isolate_adapters():
+    from penroz_tpu.ops.kv_cache import RadixPrefixCache
+    cache = RadixPrefixCache(list(range(10)), page_size=2)
+    prompt = [1, 2, 3, 4, 5, 6]
+    created = cache.insert(prompt, namespace=None)
+    assert len(created) == 3
+    # same tokens under an adapter namespace: NO cross-namespace match
+    assert cache.match(prompt, namespace=7) == []
+    assert cache.match(prompt, namespace=None)  # own namespace hits
+    # adapter namespace builds its own chain on distinct pages
+    created_a = cache.insert(prompt, namespace=7)
+    assert len(created_a) == 3
+    base_pages = {n.page for n in cache.match(prompt, namespace=None)}
+    a_pages = {n.page for n in cache.match(prompt, namespace=7)}
+    assert base_pages.isdisjoint(a_pages)
+
+
+def test_radix_namespace_lru_eviction_shares_pool():
+    from penroz_tpu.ops.kv_cache import RadixPrefixCache
+    cache = RadixPrefixCache([0, 1], page_size=2)
+    cache.insert([1, 2], namespace=None)
+    cache.insert([3, 4], namespace=5)
+    assert cache.free_pages == 0
+    # a third insert (new namespace) evicts the LRU leaf across namespaces
+    cache.insert([7, 8], namespace=9)
+    assert cache.evicted_pages == 1
+    assert cache.match([7, 8], namespace=9)
+    # clear drops every namespace
+    cache.clear()
+    assert cache.match([7, 8], namespace=9) == []
+    assert cache.free_pages == 2
+
+
+# ---------------------------------------------------------------------------
+# Adapter training (frozen base, adapter-only checkpoint)
+# ---------------------------------------------------------------------------
+
+def test_train_adapter_freezes_base_and_writes_adapter_checkpoint(
+        gpt_model, toy_shards):
+    base_before = {k: np.asarray(v) for k, v in gpt_model.params.items()}
+    cfg = lora.validate_config({"rank": 2})
+    trained = lora.train_adapter(gpt_model, "ft", cfg, toy_shards,
+                                 epochs=2, batch_size=2, block_size=8,
+                                 step_size=1)
+    # base params untouched (frozen)
+    for k, v in gpt_model.params.items():
+        np.testing.assert_array_equal(np.asarray(v), base_before[k])
+    # B moved off zero → the adapter learned something
+    assert any(np.asarray(v).any() for k, v in trained.items()
+               if k.endswith(".lora_B"))
+    blob = checkpoint.load_adapter("ft")
+    assert blob["status"]["code"] == "Trained"
+    assert len(blob["progress"]) == 2
+    assert blob["progress"][0]["cost"] > 0
+    # the checkpoint round-trips into the registry and serves
+    from penroz_tpu.serve import adapters
+    entry = adapters.REGISTRY.acquire("ft", "loragpt")
+    bound = lora.bind_model(gpt_model, entry.params, entry.config)
+    out = bound.generate_tokens([[1, 2, 3]], BLOCK, 4, temperature=0.0)
+    assert len(out) == 7
+
+
+def test_train_adapter_config_mismatch_rejected(gpt_model, toy_shards):
+    cfg = lora.validate_config({"rank": 2})
+    lora.train_adapter(gpt_model, "shape", cfg, toy_shards, epochs=1,
+                       batch_size=1, block_size=8, step_size=1)
+    with pytest.raises(ValueError, match="exists with rank=2"):
+        lora.train_adapter(gpt_model, "shape",
+                           lora.validate_config({"rank": 4}), toy_shards,
+                           epochs=1, batch_size=1, block_size=8,
+                           step_size=1)
+
+
+def test_train_adapter_failure_records_error_status(gpt_model):
+    cfg = lora.validate_config({"rank": 2})
+    with pytest.raises(ValueError):
+        lora.train_adapter(gpt_model, "bad", cfg, "no-such-dataset",
+                           epochs=1, batch_size=1, block_size=8,
+                           step_size=1)
+    blob = checkpoint.peek_adapter_tree("bad")
+    assert blob["status"]["code"] == "Error"

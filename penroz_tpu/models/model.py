@@ -2439,10 +2439,11 @@ class NeuralNetworkModel:
         x = jnp.asarray(np.asarray(tokens, np.int64)[None, :], jnp.int32)
         aidx = (jnp.asarray([adapter_slot], jnp.int32)
                 if lora is not None else None)
-        tok, kv_out = fn(self.params, self.buffers, kv_batch, x,
-                         jnp.asarray(row, jnp.int32),
-                         jnp.asarray(row_len, jnp.int32), rng, temp,
-                         lora, aidx)
+        with profiling.span("penroz/decode_prefill_chunk"):
+            tok, kv_out = fn(self.params, self.buffers, kv_batch, x,
+                             jnp.asarray(row, jnp.int32),
+                             jnp.asarray(row_len, jnp.int32), rng, temp,
+                             lora, aidx)
         return int(np.asarray(tok)), kv_out
 
     def decode_verify_row(self, kv_batch, row: int, tokens, row_len: int,
@@ -2489,10 +2490,11 @@ class NeuralNetworkModel:
         x = jnp.asarray(np.asarray(tokens, np.int64)[None, :], jnp.int32)
         aidx = (jnp.asarray([adapter_slot], jnp.int32)
                 if lora is not None else None)
-        out, kv_out = fn(self.params, self.buffers, kv_batch, x,
-                         jnp.asarray(row, jnp.int32),
-                         jnp.asarray(row_len, jnp.int32), rng, temp,
-                         lora, aidx)
+        with profiling.span("penroz/decode_verify_row"):
+            out, kv_out = fn(self.params, self.buffers, kv_batch, x,
+                             jnp.asarray(row, jnp.int32),
+                             jnp.asarray(row_len, jnp.int32), rng, temp,
+                             lora, aidx)
         return [int(t) for t in np.asarray(out)], kv_out
 
     def decode_insert_row(self, kv_batch, row: int, kv_single):
@@ -2544,9 +2546,10 @@ class NeuralNetworkModel:
             fn = arch._jit_cache[key] = jax.jit(step, donate_argnums=(2,))
         aidx = (jnp.asarray(row_adapter, jnp.int32)
                 if lora is not None else None)
-        return fn(self.params, self.buffers, kv,
-                  jnp.asarray(last_tokens, jnp.int32),
-                  jnp.asarray(lengths, jnp.int32), rng, temp, lora, aidx)
+        with profiling.span("penroz/decode_step_batched"):
+            return fn(self.params, self.buffers, kv,
+                      jnp.asarray(last_tokens, jnp.int32),
+                      jnp.asarray(lengths, jnp.int32), rng, temp, lora, aidx)
 
     def _sampling_setup(self, temperature):
         """Shared generation preamble: (greedy, temp scalar, call rng).

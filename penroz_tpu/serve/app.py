@@ -34,6 +34,7 @@ from penroz_tpu.data.tokenizers import Tokenizer
 from penroz_tpu.models.dsl import Mapper
 from penroz_tpu.models.model import NeuralNetworkModel
 from penroz_tpu.serve import schemas
+from penroz_tpu.utils import tracing
 
 log = logging.getLogger(__name__)
 
@@ -53,6 +54,28 @@ def _json(content, status: int = 200) -> web.Response:
 
 
 @web.middleware
+async def request_id_middleware(request: web.Request, handler):
+    """Every request gets an id (the client's sane ``X-Request-Id`` is
+    honored for cross-system correlation): echoed in the response header,
+    carried in error bodies (error_middleware), bound into log records
+    via the tracing contextvar, and — for generation requests — the key
+    of the ``GET /trace/{request_id}`` lifecycle span tree."""
+    rid = tracing.new_request_id(request.headers.get("X-Request-Id"))
+    request["request_id"] = rid
+    token = tracing.bind(rid)
+    try:
+        response = await handler(request)
+    except web.HTTPException as exc:
+        exc.headers.setdefault("X-Request-Id", rid)
+        raise
+    finally:
+        tracing.unbind(token)
+    if not response.prepared:
+        response.headers.setdefault("X-Request-Id", rid)
+    return response
+
+
+@web.middleware
 async def gzip_middleware(request: web.Request, handler):
     # aiohttp inflates gzip request bodies itself; only decompress when the
     # payload still carries the gzip magic (e.g. proxies that skip inflation).
@@ -67,19 +90,26 @@ async def gzip_middleware(request: web.Request, handler):
 
 @web.middleware
 async def error_middleware(request: web.Request, handler):
+    # Error bodies name the request id so a client-side failure report can
+    # be joined against server logs and GET /trace/{request_id}.
+    rid = request.get("request_id")
     try:
         return await handler(request)
     except web.HTTPException:
         raise
     except pydantic.ValidationError as e:
-        return _json({"detail": json.loads(e.json())}, status=422)
+        return _json({"detail": json.loads(e.json()), "request_id": rid},
+                     status=422)
     except KeyError as e:
-        return _json({"detail": f"Not found error occurred: {e}"}, status=404)
+        return _json({"detail": f"Not found error occurred: {e}",
+                      "request_id": rid}, status=404)
     except ValueError as e:
-        return _json({"detail": f"Value error occurred: {e}"}, status=400)
+        return _json({"detail": f"Value error occurred: {e}",
+                      "request_id": rid}, status=400)
     except Exception as e:  # noqa: BLE001
         log.error("An error occurred: %s", e)
-        return _json({"detail": "Please refer to server logs"}, status=500)
+        return _json({"detail": "Please refer to server logs",
+                      "request_id": rid}, status=500)
 
 
 async def _parse(request: web.Request, model_cls):
@@ -288,11 +318,20 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
         body.model_id, body.block_size, body.temperature, body.top_k)
     if engine is None:  # registry at capacity with nothing evictable
         return None
+    rid = request.get("request_id") or tracing.new_request_id()
+    # Per-request lifecycle trace (utils/tracing.py): the scheduler
+    # records queue/prefill/decode/recovery spans against it and finishes
+    # it at retirement; the shed paths below finish it here so no trace
+    # leaks in the live table.
+    trace = tracing.maybe_trace(rid, route="/generate/",
+                                model_id=body.model_id,
+                                stream=bool(body.stream))
     try:
         if not body.stream:
             tokens = await decode_scheduler.run_request(
                 engine, prompt, body.max_new_tokens, body.stop_token,
-                body.timeout_ms, adapter=adapter)
+                body.timeout_ms, adapter=adapter, request_id=rid,
+                trace=trace)
             return _json({"tokens": tokens})
         log.info("Streaming token generation for model %s via the "
                  "continuous-batching scheduler", body.model_id)
@@ -300,18 +339,33 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
         # their real status line instead of a broken 200 stream
         req, queue = decode_scheduler.start_stream(
             engine, prompt, body.max_new_tokens, body.stop_token,
-            body.timeout_ms, adapter=adapter)
+            body.timeout_ms, adapter=adapter, request_id=rid, trace=trace)
     except decode_scheduler.CircuitOpenError as exc:
+        if trace is not None:
+            trace.finish("breaker_open")
         if decode_scheduler.fallback_enabled():
             log.warning("Scheduler circuit open for model %s; falling back "
                         "to the single-sequence path", body.model_id)
             return None
         return _shed_response(exc)
-    except (decode_scheduler.QueueFullError,
-            decode_scheduler.DeadlineExceeded) as exc:
+    except decode_scheduler.QueueFullError as exc:
+        if trace is not None:
+            trace.finish("queue_full")
         return _shed_response(exc)
+    except decode_scheduler.DeadlineExceeded as exc:
+        if trace is not None:
+            trace.finish("timeout")
+        return _shed_response(exc)
+    except Exception:
+        # engine-owned traces are finished by the engine's crash-recovery
+        # path (which still has recovery spans to record); only close
+        # traces the scheduler never accepted
+        if trace is not None and not trace.owned:
+            trace.finish("error")
+        raise
     response = web.StreamResponse(
-        headers={"Content-Type": "text/plain; charset=utf-8"})
+        headers={"Content-Type": "text/plain; charset=utf-8",
+                 "X-Request-Id": rid})
     await response.prepare(request)
     try:
         while True:
@@ -360,6 +414,27 @@ async def _model_generate_inner(request: web.Request, body, entry):
     response = await _try_scheduler_generate(request, body, adapter=entry)
     if response is not None:
         return response
+    # Legacy single-sequence path: a one-span trace so /trace/ still
+    # answers for requests the scheduler did not serve.
+    rid = request.get("request_id") or tracing.new_request_id()
+    trace = tracing.maybe_trace(rid, route="/generate/",
+                                model_id=body.model_id, engine="legacy",
+                                stream=bool(body.stream))
+    sp = trace.span("legacy_generate") if trace is not None else None
+    try:
+        response = await _model_generate_legacy(request, body, entry, rid)
+    except Exception:
+        if trace is not None:
+            trace.end(sp)
+            trace.finish("error")
+        raise
+    if trace is not None:
+        trace.end(sp)
+        trace.finish("completed")
+    return response
+
+
+async def _model_generate_legacy(request: web.Request, body, entry, rid):
     model = await _run_blocking(NeuralNetworkModel.deserialize, body.model_id)
     if entry is not None:
         # Legacy single-sequence path: bind the adapter factors into the
@@ -370,7 +445,8 @@ async def _model_generate_inner(request: web.Request, body, entry):
     if body.stream:
         log.info("Streaming token generation for model %s", body.model_id)
         response = web.StreamResponse(
-            headers={"Content-Type": "text/plain; charset=utf-8"})
+            headers={"Content-Type": "text/plain; charset=utf-8",
+                     "X-Request-Id": rid})
         await response.prepare(request)
         queue: asyncio.Queue = asyncio.Queue()
         loop = asyncio.get_running_loop()
@@ -479,14 +555,14 @@ async def model_generate_batch(request: web.Request):
         return resolved
     row_entries, unique_entries = resolved
     try:
-        return await _model_generate_batch_inner(body, row_entries)
+        return await _model_generate_batch_inner(request, body, row_entries)
     finally:
         from penroz_tpu.serve import adapters
         for entry in unique_entries.values():
             adapters.REGISTRY.release(entry)
 
 
-async def _model_generate_batch_inner(body, row_entries):
+async def _model_generate_batch_inner(request, body, row_entries):
     from penroz_tpu.serve import decode_scheduler
     if decode_scheduler.enabled() and body.max_new_tokens >= 1:
         prompts = [[int(t) for t in row] for row in body.inputs]
@@ -502,12 +578,33 @@ async def _model_generate_batch_inner(body, row_entries):
             # return_exceptions: a shed row (429/504/503) must not leave
             # its siblings decoding into a dropped response — every row
             # settles, then the batch answers as one.
+            rid = request.get("request_id") or tracing.new_request_id()
+            # Per-row traces under suffixed ids (rid-r0, rid-r1, ...): each
+            # row has its own scheduler lifecycle, so each gets its own
+            # span tree; shed rows are finished in the error sweep below.
+            rows = [(f"{rid}-r{i}",
+                     tracing.maybe_trace(f"{rid}-r{i}",
+                                         route="/generate_batch/",
+                                         model_id=body.model_id, row=i))
+                    for i in range(len(prompts))]
             results = await asyncio.gather(*[
                 decode_scheduler.run_request(
                     engine, p, body.max_new_tokens, body.stop_token,
-                    body.timeout_ms, adapter=entry)
-                for p, entry in zip(prompts, row_entries)],
+                    body.timeout_ms, adapter=entry, request_id=row_rid,
+                    trace=row_trace)
+                for (p, entry, (row_rid, row_trace))
+                in zip(prompts, row_entries, rows)],
                 return_exceptions=True)
+            reason_of = {
+                decode_scheduler.QueueFullError: "queue_full",
+                decode_scheduler.DeadlineExceeded: "timeout",
+                decode_scheduler.CircuitOpenError: "breaker_open"}
+            for (_, row_trace), res in zip(rows, results):
+                if (row_trace is not None and not row_trace.finished
+                        and not row_trace.owned):
+                    row_trace.finish(
+                        reason_of.get(type(res), "error")
+                        if isinstance(res, BaseException) else "completed")
             errors = [r for r in results if isinstance(r, BaseException)]
             if not errors:
                 return _json({"sequences": results})
@@ -691,6 +788,47 @@ async def serving_stats(request: web.Request):
         stats).model_dump())
 
 
+async def metrics_exposition(request: web.Request):
+    """Prometheus text exposition (GET /metrics): process-wide counters +
+    fixed-bucket latency histograms written by the scheduler at event
+    time, gauges read from the live engine registry at scrape time
+    (serve/metrics.py — dependency-free, format 0.0.4)."""
+    from penroz_tpu.serve import metrics as serve_metrics
+    body = await _run_blocking(serve_metrics.render)
+    return web.Response(body=body.encode("utf-8"),
+                        headers={"Content-Type": serve_metrics.CONTENT_TYPE})
+
+
+async def trace_list(request: web.Request):
+    """Recent request traces (GET /trace/): summaries of the completed
+    ring (most recent first, PENROZ_TRACE_BUFFER entries) plus the
+    currently in-flight traces — pick a request_id, then GET
+    /trace/{request_id} for its span tree."""
+    try:
+        limit = max(1, min(1000, int(request.query.get("limit", "50"))))
+    except ValueError:
+        raise web.HTTPUnprocessableEntity(
+            text=json.dumps({"detail": "limit must be an integer"}),
+            content_type="application/json")
+    return _json({
+        "traces": [t.summary() for t in tracing.completed(limit)],
+        "live": [t.summary() for t in tracing.live()],
+    })
+
+
+async def trace_detail(request: web.Request):
+    """One request's lifecycle span tree (GET /trace/{request_id}):
+    queue wait, prefix-cache match, prefill chunks, decode/verify steps,
+    crash-recovery events, and the retirement reason — in-flight
+    requests resolve too (their root span is still open)."""
+    rid = request.match_info["request_id"]
+    trace = tracing.get(rid)
+    if trace is None:
+        raise KeyError(f"no trace for request id {rid!r} (ring holds "
+                       f"PENROZ_TRACE_BUFFER most recent)")
+    return _json(trace.to_dict())
+
+
 async def healthz(request: web.Request):
     """Liveness: the event loop is alive and answering.  Always 200 — an
     open circuit breaker is a readiness problem, not a liveness one
@@ -709,6 +847,14 @@ async def readyz(request: web.Request):
     return _json({"ready": ready, "draining": draining,
                   "breaker_open_engines": breaker_open},
                  status=200 if ready else 503)
+
+
+async def _startup_observability(app: web.Application):
+    """App startup: bring up the live-profiling gRPC endpoint when
+    PENROZ_PROFILER_PORT is set — embedded servers (tests, benches) get
+    it too, not just the __main__ path."""
+    from penroz_tpu.utils import profiling
+    profiling.maybe_start_server()
 
 
 async def _drain_on_shutdown(app: web.Application):
@@ -842,12 +988,17 @@ def create_app() -> web.Application:
     # stale pre-restart payload).  patch_meta keeps this cheap — O(file
     # copy) per orphan, no array decode.
     _sweep_orphaned_training()
-    app = web.Application(middlewares=[error_middleware, gzip_middleware],
+    app = web.Application(middlewares=[request_id_middleware,
+                                       error_middleware, gzip_middleware],
                           client_max_size=1024 ** 3)
+    app.on_startup.append(_startup_observability)
     app.on_shutdown.append(_drain_on_shutdown)
     app.router.add_get("/", redirect_to_dashboard)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", readyz)
+    app.router.add_get("/metrics", metrics_exposition)
+    app.router.add_get("/trace/", trace_list)
+    app.router.add_get("/trace/{request_id}", trace_detail)
     app.router.add_get("/dashboard", dashboard)
     app.router.add_get("/openapi.json", openapi_json)
     app.router.add_get("/docs", docs)
@@ -864,6 +1015,10 @@ def create_app() -> web.Application:
     app.router.add_post("/decode/", decode_tokens)
     app.router.add_put("/train/", train_model)
     app.router.add_post("/profile/", profile)
+    # Alias: profiler trace capture under the /profiler/ namespace (same
+    # handler/semantics as /profile/ — start/stop a jax.profiler capture
+    # whose timeline carries the penroz/sched_* span annotations).
+    app.router.add_post("/profiler/trace/", profile)
     app.router.add_get("/progress/", model_progress)
     app.router.add_get("/stats/", model_stats)
     app.router.add_get("/serving_stats/", serving_stats)

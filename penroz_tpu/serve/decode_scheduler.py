@@ -108,7 +108,6 @@ import asyncio
 import collections
 import logging
 import os
-import statistics
 import threading
 import time
 
@@ -120,8 +119,10 @@ from penroz_tpu.models import model as model_mod
 from penroz_tpu.models.model import NeuralNetworkModel
 from penroz_tpu.ops import kv_cache as KV
 from penroz_tpu.serve import adapters as adapters_mod
+from penroz_tpu.serve import metrics as serve_metrics
 from penroz_tpu.serve import spec_decode
 from penroz_tpu.utils import checkpoint, faults, profiling
+from penroz_tpu.utils import metrics as metrics_util
 from penroz_tpu.utils import stats as stats_util
 
 log = logging.getLogger(__name__)
@@ -138,6 +139,11 @@ MAX_CRASHES_ENV = "PENROZ_ENGINE_MAX_CRASHES"
 FALLBACK_ENV = "PENROZ_SCHED_FALLBACK"
 BREAKER_COOLDOWN_ENV = "PENROZ_BREAKER_COOLDOWN_MS"
 DRAIN_S_ENV = "PENROZ_DRAIN_S"
+TICK_TIMELINE_ENV = "PENROZ_TICK_TIMELINE"
+
+# Max tick-timeline entries served per /serving_stats/ payload (the ring
+# itself holds PENROZ_TICK_TIMELINE entries).
+_TIMELINE_SERVE = 120
 
 # Sliding window for the tokens/sec stat (seconds).
 _TPS_WINDOW_S = 30.0
@@ -226,6 +232,10 @@ def _drain_s() -> float:
     return _env_float(DRAIN_S_ENV, 5.0)
 
 
+def _tick_timeline_len() -> int:
+    return _env_int(TICK_TIMELINE_ENV, 256)
+
+
 def _effective_timeout_ms(timeout_ms) -> float | None:
     """Deadline budget for one request: the client's ``timeout_ms`` capped
     by the server-wide ``PENROZ_REQ_TIMEOUT_MS`` (which also applies to
@@ -251,13 +261,6 @@ def _chunk_plan(n: int, chunk: int) -> list[int]:
     return plan
 
 
-def _p99(values) -> float | None:
-    vals = sorted(values)
-    if not vals:
-        return None
-    return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
-
-
 class Request:
     """One generation request in flight through an engine.
 
@@ -271,10 +274,12 @@ class Request:
     """
 
     __slots__ = ("prompt", "max_new_tokens", "stop_token", "on_event",
-                 "enqueue_t", "cancelled", "deadline", "adapter")
+                 "enqueue_t", "cancelled", "deadline", "adapter",
+                 "request_id", "trace")
 
     def __init__(self, prompt, max_new_tokens, stop_token, on_event,
-                 timeout_ms=None, adapter=None):
+                 timeout_ms=None, adapter=None, request_id=None,
+                 trace=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.stop_token = stop_token
@@ -284,6 +289,12 @@ class Request:
         # serve.adapters.AdapterEntry (refcount-pinned by the HTTP layer
         # for the request's lifetime) or None for base-model rows.
         self.adapter = adapter
+        # utils/tracing.py: request_id is the X-Request-Id correlation
+        # key; trace (None when sampled out / tracing off) records the
+        # lifecycle span tree — every recording site below is None-guarded
+        # so the disabled path costs one comparison.
+        self.request_id = request_id
+        self.trace = trace
         budget = _effective_timeout_ms(timeout_ms)
         self.deadline = (self.enqueue_t + budget / 1000.0
                          if budget is not None else None)
@@ -295,12 +306,18 @@ class Request:
 
 class _Row:
     __slots__ = ("req", "produced", "finished", "prefilling", "prefilled",
-                 "chunks", "chunk_idx", "prefix_nodes", "history")
+                 "chunks", "chunk_idx", "prefix_nodes", "history",
+                 "last_emit_t", "sp_prefill", "sp_decode")
 
     def __init__(self, req):
         self.req = req
         self.produced = 0
         self.finished = False
+        # inter-token-latency anchor (monotonic s of the last emitted
+        # token) + the row's open trace spans (utils/tracing.py)
+        self.last_emit_t = None
+        self.sp_prefill = None
+        self.sp_decode = None
         # prompt + every emitted token, in order — the prompt-lookup
         # drafter's corpus (spec decode); bounded by block_size.
         self.history = list(req.prompt)
@@ -380,18 +397,31 @@ class DecodeEngine:
         self._decode_tokens = 0
         self._decode_time_s = 0.0
         self._occupancy_sum = 0.0
-        self._admit_lat_ms: collections.deque = collections.deque(maxlen=256)
-        self._queue_wait_ms: collections.deque = collections.deque(maxlen=512)
         self._token_window: collections.deque = collections.deque()
         self._queue_rejections = 0
         self._breaker_rejections = 0
         self._deadline_timeouts = 0
         self._prefill_chunks = 0
-        # decode-batch stall injected per step boundary by interleaved
-        # prefill chunks (only sampled while decode rows are in flight —
-        # idle-engine prefill stalls nobody)
-        self._chunk_stall_ms: collections.deque = collections.deque(
-            maxlen=512)
+        # Latency distributions: true fixed-bucket histograms
+        # (utils/metrics.py Hist), not truncated sample deques — the p99s
+        # /serving_stats/ reports derive from these, and /metrics exposes
+        # the process-wide mirrors the engine observes alongside.
+        # _h_ttft: enqueue → first token (admission latency);
+        # _h_queue_wait: enqueue → admission (prefill start);
+        # _h_chunk_stall: decode-batch stall per step boundary from
+        # interleaved prefill chunks (only sampled while decode rows are
+        # in flight — idle-engine prefill stalls nobody);
+        # _h_itl: per-row inter-token gap; _h_tick: tick dispatch wall.
+        self._h_ttft = metrics_util.Hist()
+        self._h_queue_wait = metrics_util.Hist()
+        self._h_chunk_stall = metrics_util.Hist()
+        self._h_itl = metrics_util.Hist()
+        self._h_tick = metrics_util.Hist()
+        # Tick-level telemetry ring: per-tick phase composition (prefill
+        # chunks / verify rows / shared-step rows), batch occupancy, and
+        # dispatch wall time — the dashboard occupancy/latency strip.
+        self._tick_timeline: collections.deque = collections.deque(
+            maxlen=_tick_timeline_len())
         self._chunks_between_steps = 0
         self._max_chunks_between_steps = 0
         # speculative decoding (PENROZ_SPEC_DECODE=1, greedy engines)
@@ -450,6 +480,10 @@ class DecodeEngine:
                                  + _breaker_cooldown_ms() / 1000.0)
                 if self._probe_inflight or not cooldown_done:
                     self._breaker_rejections += 1
+                    serve_metrics.BREAKER_REJECTIONS.inc()
+                    serve_metrics.REQUESTS.inc(outcome="breaker_open")
+                    if req.trace is not None:
+                        req.trace.event("shed", reason="breaker_open")
                     raise CircuitOpenError(
                         f"engine {self.model_id}: circuit breaker open "
                         f"after {self._crashes} consecutive crashes")
@@ -460,10 +494,20 @@ class DecodeEngine:
             max_queue = _max_queue()
             if max_queue and len(self._pending) >= max_queue:
                 self._queue_rejections += 1
+                serve_metrics.QUEUE_REJECTIONS.inc()
+                serve_metrics.REQUESTS.inc(outcome="queue_full")
+                if req.trace is not None:
+                    req.trace.event("shed", reason="queue_full")
                 raise QueueFullError(
                     f"engine {self.model_id}: admission queue full "
                     f"({max_queue} waiting)")
             self._pending.append(req)
+            if req.trace is not None:
+                # From here on every terminal path (retire, purge, crash
+                # recovery, shutdown) runs through this engine — it owns
+                # the trace's finish so the recovery span can be recorded
+                # after the error event already reached the client.
+                req.trace.owned = True
             self._cond.notify_all()
 
     def shutdown(self, timeout: float = 10.0, drain_s: float = 0.0) -> bool:
@@ -507,7 +551,23 @@ class DecodeEngine:
     def idle(self) -> bool:
         return self.active_rows == 0 and not self._pending
 
+    @property
+    def live_adapters(self) -> int:
+        return sum(1 for e in self._slot_entries if e is not None)
+
+    def _round_q(self, hist: metrics_util.Hist, q: float):
+        v = hist.quantile(q)
+        return round(v, 3) if v is not None else None
+
     def stats(self) -> dict:
+        """THE engine observability accessor: every cross-engine aggregate
+        (``serving_stats()``) and every scrape reads through here — no
+        caller reaches into private engine state, so the worker thread's
+        writes race only with the lock-guarded histogram snapshots below
+        (the scalar counters are single-writer ints; readers tolerate
+        torn-but-valid snapshots).  The ``histograms`` key carries the raw
+        bucket snapshots the aggregation layer merges; pydantic drops it
+        from the HTTP payload (not a declared schema field)."""
         now = time.monotonic()
         window = [(t, n) for t, n in self._token_window
                   if now - t <= _TPS_WINDOW_S]
@@ -516,11 +576,28 @@ class DecodeEngine:
         tps = recent / span if span > 0.2 else (
             self._decode_tokens / self._decode_time_s
             if self._decode_time_s > 0 else 0.0)
-        lat = sorted(self._admit_lat_ms)
         active = self.active_rows
-        stall_p99 = _p99(self._chunk_stall_ms)
-        queue_wait_p99 = _p99(self._queue_wait_ms)
+        stall_p99 = self._h_chunk_stall.quantile(0.99)
+        queue_wait_p99 = self._h_queue_wait.quantile(0.99)
+        # newest-first tail of the ring (age_s ≈ 0 leads)
+        timeline = list(self._tick_timeline)[-_TIMELINE_SERVE:][::-1]
         return {
+            "histograms": {
+                "ttft_ms": self._h_ttft.snapshot(),
+                "itl_ms": self._h_itl.snapshot(),
+                "queue_wait_ms": self._h_queue_wait.snapshot(),
+                "chunk_stall_ms": self._h_chunk_stall.snapshot(),
+                "tick_ms": self._h_tick.snapshot(),
+            },
+            "ttft_ms_p99": self._round_q(self._h_ttft, 0.99),
+            "itl_ms_p50": self._round_q(self._h_itl, 0.5),
+            "itl_ms_p99": self._round_q(self._h_itl, 0.99),
+            "tick_ms_p50": self._round_q(self._h_tick, 0.5),
+            "tick_ms_p99": self._round_q(self._h_tick, 0.99),
+            "tick_timeline": [
+                {"age_s": round(now - e["t"], 3),
+                 **{k: v for k, v in e.items() if k != "t"}}
+                for e in timeline],
             "queue_rejections": self._queue_rejections,
             "deadline_timeouts": self._deadline_timeouts,
             "breaker_rejections": self._breaker_rejections,
@@ -545,8 +622,7 @@ class DecodeEngine:
             "decode_tokens_per_sec": round(tps, 2),
             "admissions": self._admissions,
             "completed": self._completed,
-            "admission_latency_ms_p50": (round(statistics.median(lat), 3)
-                                         if lat else None),
+            "admission_latency_ms_p50": self._round_q(self._h_ttft, 0.5),
             "prefill_chunks": self._prefill_chunks,
             "prefill_chunk_stall_ms_p99": (round(stall_p99, 3)
                                            if stall_p99 is not None
@@ -555,8 +631,7 @@ class DecodeEngine:
                 self._max_chunks_between_steps,
             "prefix_cache": (self._prefix_cache.stats()
                              if self._prefix_cache is not None else None),
-            "lora_active_adapters": sum(
-                1 for e in self._slot_entries if e is not None),
+            "lora_active_adapters": self.live_adapters,
             "lora_rows": sum(
                 1 for i, r in enumerate(self._rows)
                 if r is not None
@@ -587,31 +662,73 @@ class DecodeEngine:
                 self._purge_expired()
                 self._coalesce_burst()
                 self._admit()
-                self._prefill_tick()
-                if self._decoding_rows():
-                    self._step()
+                self._tick()
             except Exception as exc:  # noqa: BLE001 — fail requests, not thread
                 log.exception("Decode engine %s failed a tick", self.model_id)
                 self._record_crash()
-                self._fail_all(exc)
+                crashed_traces = self._fail_all(exc, crashed=True)
                 try:
                     # Full reset: the exception left KV/prefix state in an
                     # unknown shape — reallocate so the NEXT request runs
                     # against provably clean buffers and block tables.
                     self._engine_resets += 1
+                    serve_metrics.ENGINE_RESETS.inc()
+                    t_crash = time.monotonic()
                     self._alloc_state()
+                    for tr in crashed_traces:
+                        # The failed request's trace carries the recovery it
+                        # triggered: crash site → clean engine, so "where
+                        # did this 504/500 come from" reads off one tree.
+                        sp = tr.span("recovery", t0=t_crash,
+                                     resets=self._engine_resets)
+                        tr.end(sp)
+                        tr.finish("error")
                     log.warning("Decode engine %s reset after crash %d "
                                 "(consecutive %d)", self.model_id,
                                 self._crashes_total, self._crashes)
                 except Exception:  # noqa: BLE001 — can't trust the engine
                     log.exception("Decode engine %s reset FAILED; opening "
                                   "circuit breaker", self.model_id)
+                    for tr in crashed_traces:
+                        tr.finish("error")
                     with self._cond:
                         self._breaker_open = True
                         self._breaker_open_t = time.monotonic()
         self._fail_all(RuntimeError("decode engine shut down"))
 
+    def _tick(self):
+        """One scheduler tick: interleaved prefill chunks, then the shared
+        decode step — instrumented as a unit (dispatch wall time, phase
+        composition, occupancy) into the tick timeline, the tick-duration
+        histogram, and a profiler span, so both a Perfetto capture and the
+        dashboard strip show what the loop actually did between dispatches.
+        """
+        prefilling = self._next_prefill_row() is not None
+        decoding = bool(self._decoding_rows())
+        if not prefilling and not decoding:
+            return
+        chunks0 = self._prefill_chunks
+        verify_rows = shared_rows = emitted = 0
+        t0 = time.monotonic()
+        with profiling.span("penroz/sched_tick"):
+            self._prefill_tick()
+            if self._decoding_rows():
+                verify_rows, shared_rows, emitted = self._step()
+        dur_ms = (time.monotonic() - t0) * 1000.0
+        self._h_tick.observe(dur_ms)
+        serve_metrics.TICK_MS.observe(dur_ms)
+        self._tick_timeline.append({
+            "t": t0,
+            "dispatch_ms": round(dur_ms, 3),
+            "occupancy": round(self.active_rows / self.capacity, 4),
+            "prefill_chunks": self._prefill_chunks - chunks0,
+            "verify_rows": verify_rows,
+            "shared_rows": shared_rows,
+            "emitted": emitted,
+        })
+
     def _record_crash(self):
+        serve_metrics.ENGINE_CRASHES.inc()
         with self._cond:
             self._crashes += 1
             self._crashes_total += 1
@@ -629,23 +746,43 @@ class DecodeEngine:
         clients must not spend a prefill)."""
         now = time.monotonic()
         expired = []
+        dropped = []
         with self._cond:
             if not self._pending:
                 return
             keep: collections.deque = collections.deque()
             for req in self._pending:
                 if req.cancelled:
+                    dropped.append(req)
                     continue
                 if req.expired(now):
                     expired.append(req)
                 else:
                     keep.append(req)
             self._pending = keep
+        for req in dropped:
+            self._finish_trace(req, "cancelled")
+            serve_metrics.REQUESTS.inc(outcome="cancelled")
         for req in expired:
-            self._deadline_timeouts += 1
-            self._deliver(req, "timeout", DeadlineExceeded(
-                "queued", "request deadline expired while queued "
-                "(before prefill started)"))
+            self._timeout_queued(req)
+
+    def _timeout_queued(self, req: Request):
+        """Shed one queued request on an expired deadline (504 before
+        prefill ever starts) — counter, metrics, trace, event delivery."""
+        self._deadline_timeouts += 1
+        serve_metrics.DEADLINE_TIMEOUTS.inc()
+        serve_metrics.REQUESTS.inc(outcome="timeout")
+        if req.trace is not None:
+            sp = req.trace.span("queue", t0=req.enqueue_t)
+            req.trace.end(sp)
+        self._finish_trace(req, "timeout")
+        self._deliver(req, "timeout", DeadlineExceeded(
+            "queued", "request deadline expired while queued "
+            "(before prefill started)"))
+
+    def _finish_trace(self, req: Request, reason: str):
+        if req.trace is not None:
+            req.trace.finish(reason)
 
     def _coalesce_burst(self):
         """Optional idle-burst coalescing: when the batch is empty, wait up
@@ -686,12 +823,11 @@ class DecodeEngine:
                     return
                 req = self._pending.popleft()
             if req.cancelled:
+                self._finish_trace(req, "cancelled")
+                serve_metrics.REQUESTS.inc(outcome="cancelled")
                 continue
             if req.expired():
-                self._deadline_timeouts += 1
-                self._deliver(req, "timeout", DeadlineExceeded(
-                    "queued", "request deadline expired while queued "
-                    "(before prefill started)"))
+                self._timeout_queued(req)
                 continue
             if self.active_rows == 0:
                 self._maybe_reload()
@@ -755,6 +891,15 @@ class DecodeEngine:
         state = _Row(req)
         self._row_adapter[row] = (slot if slot is not None
                                   else self._max_live)
+        trace = req.trace
+        if trace is not None:
+            # Retroactive queue span (enqueue → now): its duration IS the
+            # queue wait the histogram records below.
+            sp = trace.span("queue", t0=req.enqueue_t)
+            trace.end(sp)
+            if req.adapter is not None:
+                trace.event("adapter_slot", adapter_id=req.adapter.adapter_id,
+                            slot=int(self._row_adapter[row]))
         if self._prefix_cache is not None:
             # Cap the usable match at len(prompt) - 1: the final chunk must
             # feed at least one real token to produce the first-sample
@@ -769,6 +914,12 @@ class DecodeEngine:
                 self._prefix_cache.pin(nodes)
                 state.prefix_nodes = nodes
                 state.prefilled = len(nodes) * self._prefix_cache.page_size
+                serve_metrics.PREFIX_HITS.inc()
+            else:
+                serve_metrics.PREFIX_MISSES.inc()
+            if trace is not None:
+                trace.event("prefix_match", matched_tokens=state.prefilled,
+                            pages=len(nodes))
             # Rebuild the row's table on miss too: re-basing to the static
             # partition is one tiny host write, and it guarantees no stale
             # alias survives an abnormal retirement path.
@@ -785,8 +936,13 @@ class DecodeEngine:
         self._lengths[row] = state.prefilled
         self._last_tok[row] = 0
         self._admissions += 1
-        self._queue_wait_ms.append(
-            (time.monotonic() - req.enqueue_t) * 1000.0)
+        wait_ms = (time.monotonic() - req.enqueue_t) * 1000.0
+        self._h_queue_wait.observe(wait_ms)
+        serve_metrics.QUEUE_WAIT_MS.observe(wait_ms)
+        if trace is not None:
+            state.sp_prefill = trace.span(
+                "prefill", prompt_tokens=len(req.prompt),
+                cached_tokens=state.prefilled, chunks=len(state.chunks))
 
     def _next_prefill_row(self):
         """FIFO over prefilling rows (earliest enqueue first) so chunk
@@ -823,17 +979,20 @@ class DecodeEngine:
             if (time.monotonic() - t0) * 1000.0 >= budget_ms:
                 break
         if stalling:
-            self._chunk_stall_ms.append((time.monotonic() - t0) * 1000.0)
+            stall_ms = (time.monotonic() - t0) * 1000.0
+            self._h_chunk_stall.observe(stall_ms)
+            serve_metrics.CHUNK_STALL_MS.observe(stall_ms)
 
     def _run_prefill_chunk(self, row: int):
         state = self._rows[row]
         req = state.req
         if req.cancelled:
-            self._retire(row, notify=False)
+            self._retire(row, notify=False, reason="cancelled")
             return
         if req.expired():
             self._deadline_timeouts += 1
-            self._retire(row, notify=False)
+            serve_metrics.DEADLINE_TIMEOUTS.inc()
+            self._retire(row, notify=False, reason="timeout")
             self._deliver(req, "timeout", DeadlineExceeded(
                 "inflight", "request deadline expired during prefill"))
             return
@@ -842,15 +1001,21 @@ class DecodeEngine:
         start = state.prefilled
         rng = jax.random.fold_in(self._rng, self._dispatch)
         self._dispatch += 1
+        sp = (req.trace.span("prefill_chunk", parent=state.sp_prefill,
+                             size=size, start=start)
+              if req.trace is not None else None)
         with model_mod.decode_priority(), \
                 profiling.span("penroz/sched_prefill_chunk"):
             tok, self._kv = self._model.decode_prefill_chunk(
                 self._kv, row, req.prompt[start:start + size], start, rng,
                 self.temperature, self.top_k, lora=self._lora_pack,
                 adapter_slot=int(self._row_adapter[row]))
+        if req.trace is not None:
+            req.trace.end(sp)
         state.prefilled += size
         state.chunk_idx += 1
         self._prefill_chunks += 1
+        serve_metrics.PREFILL_CHUNKS.inc()
         self._lengths[row] = state.prefilled  # re-park (see _begin_prefill)
         if state.chunk_idx >= len(state.chunks):
             self._finish_prefill(row, state, tok)
@@ -861,8 +1026,14 @@ class DecodeEngine:
         state.prefilling = False
         self._lengths[row] = state.prefilled  # == len(prompt)
         self._last_tok[row] = first
-        self._admit_lat_ms.append(
-            (time.monotonic() - state.req.enqueue_t) * 1000.0)
+        ttft_ms = (time.monotonic() - state.req.enqueue_t) * 1000.0
+        self._h_ttft.observe(ttft_ms)
+        serve_metrics.TTFT_MS.observe(ttft_ms)
+        trace = state.req.trace
+        if trace is not None:
+            trace.end(state.sp_prefill)
+            state.sp_prefill = None
+            state.sp_decode = trace.span("decode", ttft_ms=round(ttft_ms, 3))
         self._register_prefix(row, state)
         self._emit_token(row, state, first)
 
@@ -886,7 +1057,9 @@ class DecodeEngine:
         """One decode tick: a multi-token verify step for every row whose
         drafter proposed candidates (spec decode), then ONE shared batched
         step for the rest.  Counts as a single decode step either way —
-        ``tokens_per_decode_step`` is the speculation win."""
+        ``tokens_per_decode_step`` is the speculation win.  Returns the
+        tick composition ``(verify_rows, shared_rows, emitted)`` for the
+        tick timeline."""
         faults.check("decode.step")
         t0 = time.monotonic()
         self._max_chunks_between_steps = max(
@@ -907,27 +1080,35 @@ class DecodeEngine:
         now = time.monotonic()
         self._decode_steps += 1
         self._decode_tokens += emitted
+        serve_metrics.DECODE_TOKENS.inc(emitted)
         self._decode_time_s += now - t0
         self._occupancy_sum += len(active) / self.capacity
         self._token_window.append((now, emitted))
         while (self._token_window
                and now - self._token_window[0][0] > _TPS_WINDOW_S):
             self._token_window.popleft()
+        return len(plan), len(normal), emitted
 
     def _shared_step(self, rows: list[int]) -> int:
         """The pre-speculation hot loop: one batched decode+sample step
         across every row, emitting for ``rows``.  Returns tokens emitted."""
         rng = jax.random.fold_in(self._rng, self._dispatch)
         self._dispatch += 1
+        t0 = time.monotonic()
         with model_mod.decode_priority(), profiling.span("penroz/sched_step"):
             toks, self._kv = self._model.decode_step_batched(
                 self._kv, self._last_tok[:, None], self._lengths, rng,
                 self.temperature, self.top_k, lora=self._lora_pack,
                 row_adapter=self._row_adapter)
             arr = np.asarray(toks)
+        t1 = time.monotonic()
         emitted = 0
         for i in rows:
             state = self._rows[i]
+            if state.req.trace is not None:
+                sp = state.req.trace.span("decode_step",
+                                          t0=t0, parent=state.sp_decode)
+                state.req.trace.end(sp, t1=t1)
             self._lengths[i] += 1
             tok = int(arr[i])
             self._last_tok[i] = tok
@@ -978,6 +1159,9 @@ class DecodeEngine:
         tokens = [int(self._last_tok[row])] + [int(t) for t in draft]
         rng = jax.random.fold_in(self._rng, self._dispatch)
         self._dispatch += 1
+        sp = (state.req.trace.span("verify", parent=state.sp_decode,
+                                   drafted=len(draft))
+              if state.req.trace is not None else None)
         with model_mod.decode_priority(), \
                 profiling.span("penroz/sched_verify"):
             out, self._kv = self._model.decode_verify_row(
@@ -985,9 +1169,14 @@ class DecodeEngine:
                 self.top_k, lora=self._lora_pack,
                 adapter_slot=int(self._row_adapter[row]))
         accepted = spec_decode.accept_length(draft, out)
+        if state.req.trace is not None:
+            state.req.trace.end(sp, accepted=accepted,
+                                rollback_to=start + accepted + 1)
         self._spec_verify_steps += 1
         self._spec_drafted_tokens += len(draft)
         self._spec_accepted_tokens += accepted
+        serve_metrics.SPEC_DRAFTED.inc(len(draft))
+        serve_metrics.SPEC_ACCEPTED.inc(accepted)
         # The verify wrote K+1 fresh KV positions, but only the first
         # accepted+1 were fed the tokens greedy decoding would feed —
         # rewind past the rest (the bonus token's own KV is written by
@@ -1009,26 +1198,34 @@ class DecodeEngine:
     def _emit_token(self, row: int, state: _Row, tok: int):
         state.produced += 1
         state.history.append(tok)
+        now = time.monotonic()
+        if state.last_emit_t is not None:
+            itl_ms = (now - state.last_emit_t) * 1000.0
+            self._h_itl.observe(itl_ms)
+            serve_metrics.ITL_MS.observe(itl_ms)
+        state.last_emit_t = now
         if state.req.adapter is not None:
             aid = state.req.adapter.adapter_id
             self._adapter_tokens[aid] = self._adapter_tokens.get(aid, 0) + 1
+            serve_metrics.LORA_TOKENS.inc(adapter_id=aid)
         self._deliver(state.req, "token", tok)
         req = state.req
         if req.cancelled:
-            self._retire(row, notify=False)
+            self._retire(row, notify=False, reason="cancelled")
             return
         if req.stop_token is not None and tok == req.stop_token:
-            self._retire(row)
+            self._retire(row, reason="stop_token")
             return
         if state.produced >= req.max_new_tokens:
-            self._retire(row)
+            self._retire(row, reason="max_new_tokens")
             return
         if req.expired():
             # Deadline passed mid-generation: retire at this step boundary
             # and end the stream with a timeout event (tokens so far were
             # already delivered).
             self._deadline_timeouts += 1
-            self._retire(row, notify=False)
+            serve_metrics.DEADLINE_TIMEOUTS.inc()
+            self._retire(row, notify=False, reason="timeout")
             self._deliver(req, "timeout", DeadlineExceeded(
                 "inflight", f"request deadline expired after "
                 f"{state.produced} generated token(s)"))
@@ -1039,9 +1236,10 @@ class DecodeEngine:
             KV.record_pool_drop(
                 req.max_new_tokens - state.produced,
                 context=f"scheduler row hit block_size={self.block_size}")
-            self._retire(row)
+            self._retire(row, reason="pool_capacity")
 
-    def _retire(self, row: int, notify: bool = True):
+    def _retire(self, row: int, notify: bool = True,
+                reason: str = "completed"):
         state = self._rows[row]
         self._rows[row] = None
         self._lengths[row] = 0
@@ -1050,6 +1248,16 @@ class DecodeEngine:
         self._release_prefix(row, state)
         self._kv = self._kv.reset_row(row)
         self._completed += 1
+        if state is not None and state.req.trace is not None:
+            trace = state.req.trace
+            trace.end(state.sp_prefill)
+            trace.end(state.sp_decode, produced=state.produced)
+            trace.finish(reason)
+        serve_metrics.REQUESTS.inc(
+            outcome=("completed" if reason in ("stop_token",
+                                               "max_new_tokens",
+                                               "pool_capacity")
+                     else reason))
         if notify and state is not None:
             # A successfully completed request is the engine-health signal:
             # it zeroes the consecutive-crash count and closes an open
@@ -1081,7 +1289,12 @@ class DecodeEngine:
             log.exception("Decode scheduler consumer callback failed")
             req.cancelled = True
 
-    def _fail_all(self, exc: Exception):
+    def _fail_all(self, exc: Exception, crashed: bool = False):
+        """Fail every in-flight and queued request.  Returns the affected
+        rows' traces; with ``crashed=True`` they carry an ``engine_crash``
+        event and are left UNFINISHED so the caller can attach the
+        recovery span before closing them (otherwise finished here)."""
+        open_traces: list = []
         for i, state in enumerate(self._rows):
             if state is not None:
                 self._rows[i] = None
@@ -1094,6 +1307,16 @@ class DecodeEngine:
                     # the failing thing; admission re-bases the row's table
                     # anyway (_begin_prefill), so only log.
                     log.exception("Failed to restore row %d block table", i)
+                serve_metrics.REQUESTS.inc(outcome="error")
+                trace = state.req.trace
+                if trace is not None:
+                    trace.end(state.sp_prefill)
+                    trace.end(state.sp_decode, produced=state.produced)
+                    if crashed:
+                        trace.event("engine_crash", error=str(exc))
+                        open_traces.append(trace)
+                    else:
+                        trace.finish("error")
                 self._deliver(state.req, "error", exc)
         with self._cond:
             pending, self._pending = list(self._pending), collections.deque()
@@ -1103,7 +1326,10 @@ class DecodeEngine:
                 self._probe_inflight = False
                 self._breaker_open_t = time.monotonic()
         for req in pending:
+            serve_metrics.REQUESTS.inc(outcome="error")
+            self._finish_trace(req, "error")
             self._deliver(req, "error", exc)
+        return open_traces
 
     # -- model staleness ----------------------------------------------------
 
@@ -1238,18 +1464,33 @@ def drain_and_shutdown(drain_s: float | None = None) -> bool:
     return ok
 
 
+def _merged_q(per: list[dict], name: str, q: float):
+    """Quantile over the merged per-engine histogram snapshots — the
+    cross-engine aggregation path (all reads went through
+    ``DecodeEngine.stats()``; nothing here touches engine internals)."""
+    v = metrics_util.quantile_of(metrics_util.merge_snapshots(
+        [p["histograms"][name] for p in per]), q)
+    return round(v, 3) if v is not None else None
+
+
 def serving_stats() -> dict:
-    """Aggregate scheduler observability — the /serving_stats/ payload."""
+    """Aggregate scheduler observability — the /serving_stats/ payload.
+
+    Every per-engine read goes through the one locked accessor
+    ``DecodeEngine.stats()``; percentiles aggregate by merging the
+    engines' histogram bucket snapshots (identical layouts), never by
+    re-reading raw samples."""
     with _REG_LOCK:
         engines = [e for e in _ENGINES.values() if not e._shutdown]
     per = [e.stats() for e in engines]
     capacity = sum(p["capacity"] for p in per)
     active = sum(p["active_rows"] for p in per)
-    lat = sorted(x for e in engines for x in e._admit_lat_ms)
-    stall_p99 = _p99([x for e in engines for x in e._chunk_stall_ms])
+    stall_p99 = _merged_q(per, "chunk_stall_ms", 0.99)
     pc = [p["prefix_cache"] for p in per if p["prefix_cache"] is not None]
     pc_lookups = sum(c["hits"] + c["misses"] for c in pc)
-    queue_wait_p99 = _p99([x for e in engines for x in e._queue_wait_ms])
+    queue_wait_p99 = _merged_q(per, "queue_wait_ms", 0.99)
+    timeline = sorted((t for p in per for t in p["tick_timeline"]),
+                      key=lambda e: e["age_s"])[:_TIMELINE_SERVE]
     spec_drafted = sum(p["spec_drafted_tokens"] for p in per)
     spec_accepted = sum(p["spec_accepted_tokens"] for p in per)
     decode_steps = sum(p["decode_steps"] for p in per)
@@ -1266,8 +1507,7 @@ def serving_stats() -> dict:
         "queue_depth": sum(p["queue_depth"] for p in per),
         "queue_rejections": sum(p["queue_rejections"] for p in per),
         "deadline_timeouts": sum(p["deadline_timeouts"] for p in per),
-        "queue_wait_ms_p99": (round(queue_wait_p99, 3)
-                              if queue_wait_p99 is not None else None),
+        "queue_wait_ms_p99": queue_wait_p99,
         "breaker_open": any(p["breaker_open"] for p in per),
         "crashes_total": sum(p["crashes_total"] for p in per),
         "engine_resets": sum(p["engine_resets"] for p in per),
@@ -1275,10 +1515,14 @@ def serving_stats() -> dict:
         "batch_occupancy": (active / capacity) if capacity else 0.0,
         "decode_tokens_per_sec": round(
             sum(p["decode_tokens_per_sec"] for p in per), 2),
-        "admission_latency_ms_p50": (round(statistics.median(lat), 3)
-                                     if lat else None),
-        "prefill_chunk_stall_ms_p99": (round(stall_p99, 3)
-                                       if stall_p99 is not None else None),
+        "admission_latency_ms_p50": _merged_q(per, "ttft_ms", 0.5),
+        "ttft_ms_p99": _merged_q(per, "ttft_ms", 0.99),
+        "itl_ms_p50": _merged_q(per, "itl_ms", 0.5),
+        "itl_ms_p99": _merged_q(per, "itl_ms", 0.99),
+        "tick_ms_p50": _merged_q(per, "tick_ms", 0.5),
+        "tick_ms_p99": _merged_q(per, "tick_ms", 0.99),
+        "tick_timeline": timeline,
+        "prefill_chunk_stall_ms_p99": stall_p99,
         "prefix_cache_hit_rate": (
             sum(c["hits"] for c in pc) / pc_lookups if pc_lookups else None),
         "prefix_cache_evicted_pages": sum(c["evicted_pages"] for c in pc),
@@ -1315,7 +1559,7 @@ async def acquire_engine(model_id, block_size, temperature, top_k):
 
 
 def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None,
-                   adapter=None):
+                   adapter=None, request_id=None, trace=None):
     loop = asyncio.get_running_loop()
     queue: asyncio.Queue = asyncio.Queue()
 
@@ -1323,21 +1567,25 @@ def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None,
         loop.call_soon_threadsafe(queue.put_nowait, (kind, value))
 
     return (Request(prompt, max_new_tokens, stop_token, on_event,
-                    timeout_ms=timeout_ms, adapter=adapter), queue)
+                    timeout_ms=timeout_ms, adapter=adapter,
+                    request_id=request_id, trace=trace), queue)
 
 
 async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
-                      stop_token, timeout_ms=None,
-                      adapter=None) -> list[int]:
+                      stop_token, timeout_ms=None, adapter=None,
+                      request_id=None, trace=None) -> list[int]:
     """Submit one request and await the full sequence (prompt + generated,
     the ``generate_tokens`` contract).  Raises DeadlineExceeded /
     QueueFullError / CircuitOpenError on the shed paths; an aiohttp client
     disconnect cancels the awaiting handler task, which propagates to
     ``req.cancelled`` so the row and its prefix pins free at the next
     boundary.  ``adapter`` (serve.adapters.AdapterEntry) routes the row
-    through that adapter's live slot; the CALLER holds the registry pin."""
+    through that adapter's live slot; the CALLER holds the registry pin.
+    ``request_id``/``trace`` thread per-request observability through the
+    scheduler (utils/tracing.py); the scheduler finishes the trace at
+    retirement, the caller finishes it on shed paths."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
-                                timeout_ms, adapter)
+                                timeout_ms, adapter, request_id, trace)
     engine.submit(req)
     tokens = list(req.prompt)
     try:
@@ -1355,13 +1603,14 @@ async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
 
 
 def start_stream(engine: DecodeEngine, prompt, max_new_tokens, stop_token,
-                 timeout_ms=None, adapter=None):
+                 timeout_ms=None, adapter=None, request_id=None,
+                 trace=None):
     """Submit a streaming request; returns ``(req, queue)`` so the HTTP
     layer can consume events AND flip ``req.cancelled`` itself when the
     client goes away mid-stream (a write failure is invisible to an async
     generator until its GC-time close — the explicit handle is the
     disconnect wiring)."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
-                                timeout_ms, adapter)
+                                timeout_ms, adapter, request_id, trace)
     engine.submit(req)
     return req, queue

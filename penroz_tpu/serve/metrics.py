@@ -1,0 +1,162 @@
+"""Serving metrics: the ``GET /metrics`` Prometheus exposition.
+
+Process-wide, monotonic counters + histograms that the decode scheduler
+writes at event time (engines come and go with the registry — reset an
+engine and its lifetime counters would march backwards, so cumulative
+totals live HERE, not on the engine), and scrape-time gauges that read
+the live engine registry.  ``/serving_stats/`` keeps its JSON shape for
+humans and the dashboard; ``/metrics`` is the machine-scrape surface
+over the same events.
+
+Everything renders through utils/metrics.py — no prometheus_client
+dependency.  Series (all prefixed ``penroz_``):
+
+counters   requests_total{outcome}, decode_tokens_total,
+           prefill_chunks_total, queue_rejections_total,
+           deadline_timeouts_total, breaker_rejections_total,
+           engine_crashes_total, engine_resets_total,
+           spec_drafted_tokens_total, spec_accepted_tokens_total,
+           prefix_cache_hits_total, prefix_cache_misses_total,
+           lora_adapter_tokens_total{adapter_id}, traces_completed_total
+gauges     engines, active_rows, queue_depth, batch_occupancy,
+           breaker_open, draining, lora_live_adapters,
+           kv_pool_capacity_drops (monotonic in practice, exposed as a
+           gauge because the source counter lives in ops/kv_cache.py)
+histograms ttft_ms, itl_ms, queue_wait_ms, chunk_stall_ms, tick_ms
+           (fixed LATENCY_BUCKETS_MS buckets; cumulative ``_bucket``
+           series sum to ``_count`` — asserted by the strict-format
+           parser test)
+"""
+
+from __future__ import annotations
+
+from penroz_tpu.utils import metrics as m
+
+REGISTRY = m.Registry()
+
+# -- counters (event-time writes from the scheduler) ------------------------
+
+REQUESTS = REGISTRY.register(m.Counter(
+    "penroz_requests_total",
+    "Scheduler requests by terminal outcome (completed|error|timeout|"
+    "cancelled|queue_full|breaker_open|pool_capacity)", ("outcome",)))
+DECODE_TOKENS = REGISTRY.register(m.Counter(
+    "penroz_decode_tokens_total",
+    "Tokens emitted by the shared decode batch"))
+PREFILL_CHUNKS = REGISTRY.register(m.Counter(
+    "penroz_prefill_chunks_total", "Chunked-prefill dispatches"))
+QUEUE_REJECTIONS = REGISTRY.register(m.Counter(
+    "penroz_queue_rejections_total",
+    "Requests shed 429 at a full admission queue"))
+DEADLINE_TIMEOUTS = REGISTRY.register(m.Counter(
+    "penroz_deadline_timeouts_total",
+    "Requests expired on their deadline (queued or in flight)"))
+BREAKER_REJECTIONS = REGISTRY.register(m.Counter(
+    "penroz_breaker_rejections_total",
+    "Submits refused while an engine circuit breaker was open"))
+ENGINE_CRASHES = REGISTRY.register(m.Counter(
+    "penroz_engine_crashes_total", "Scheduler tick crashes"))
+ENGINE_RESETS = REGISTRY.register(m.Counter(
+    "penroz_engine_resets_total",
+    "Full engine state reallocations after crashes"))
+SPEC_DRAFTED = REGISTRY.register(m.Counter(
+    "penroz_spec_drafted_tokens_total",
+    "Speculative-decoding draft tokens proposed"))
+SPEC_ACCEPTED = REGISTRY.register(m.Counter(
+    "penroz_spec_accepted_tokens_total",
+    "Speculative-decoding draft tokens accepted"))
+PREFIX_HITS = REGISTRY.register(m.Counter(
+    "penroz_prefix_cache_hits_total",
+    "Admissions matching at least one cached prefix page"))
+PREFIX_MISSES = REGISTRY.register(m.Counter(
+    "penroz_prefix_cache_misses_total",
+    "Admissions matching no cached prefix page"))
+LORA_TOKENS = REGISTRY.register(m.Counter(
+    "penroz_lora_adapter_tokens_total",
+    "Tokens emitted per LoRA adapter", ("adapter_id",)))
+TRACES_COMPLETED = REGISTRY.register(m.Counter(
+    "penroz_traces_completed_total",
+    "Request traces finished into the /trace/ ring"))
+
+# -- histograms (engine observes the global mirror alongside its own) -------
+
+TTFT_MS = REGISTRY.register(m.Histogram(
+    "penroz_ttft_ms", "Enqueue to first token (admission latency), ms"))
+ITL_MS = REGISTRY.register(m.Histogram(
+    "penroz_itl_ms", "Inter-token latency per decoding row, ms"))
+QUEUE_WAIT_MS = REGISTRY.register(m.Histogram(
+    "penroz_queue_wait_ms", "Enqueue to admission (prefill start), ms"))
+CHUNK_STALL_MS = REGISTRY.register(m.Histogram(
+    "penroz_chunk_stall_ms",
+    "Decode-batch stall injected per step boundary by prefill chunks, ms"))
+TICK_MS = REGISTRY.register(m.Histogram(
+    "penroz_tick_ms", "Scheduler tick dispatch wall time, ms"))
+
+# -- gauges (scrape-time reads of live state) -------------------------------
+
+ENGINES_GAUGE = REGISTRY.register(m.Gauge(
+    "penroz_engines", "Live decode engines in the registry"))
+ACTIVE_ROWS = REGISTRY.register(m.Gauge(
+    "penroz_active_rows", "In-flight decode rows across engines"))
+QUEUE_DEPTH = REGISTRY.register(m.Gauge(
+    "penroz_queue_depth", "Requests waiting for admission"))
+OCCUPANCY = REGISTRY.register(m.Gauge(
+    "penroz_batch_occupancy", "active_rows / capacity across engines"))
+BREAKER_OPEN = REGISTRY.register(m.Gauge(
+    "penroz_breaker_open", "1 if any engine circuit breaker is open"))
+DRAINING = REGISTRY.register(m.Gauge(
+    "penroz_draining", "1 while graceful shutdown drains admission"))
+LORA_LIVE = REGISTRY.register(m.Gauge(
+    "penroz_lora_live_adapters", "Adapters occupying live engine slots"))
+POOL_DROPS = REGISTRY.register(m.Gauge(
+    "penroz_kv_pool_capacity_drops",
+    "KV writes dropped at pool capacity (process-wide counter in "
+    "ops/kv_cache.py, exposed at scrape)"))
+
+
+def _wire_gauges():
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import decode_scheduler as ds
+
+    def engines():
+        with ds._REG_LOCK:
+            return [e for e in ds._ENGINES.values() if not e._shutdown]
+
+    ENGINES_GAUGE.set_function(lambda: len(engines()))
+    ACTIVE_ROWS.set_function(
+        lambda: sum(e.active_rows for e in engines()))
+    QUEUE_DEPTH.set_function(
+        lambda: sum(e.queue_depth for e in engines()))
+
+    def occupancy():
+        es = engines()
+        cap = sum(e.capacity for e in es)
+        return (sum(e.active_rows for e in es) / cap) if cap else 0.0
+
+    OCCUPANCY.set_function(occupancy)
+    BREAKER_OPEN.set_function(
+        lambda: 1 if ds.breaker_open_engines() else 0)
+    DRAINING.set_function(lambda: 1 if ds.draining() else 0)
+    LORA_LIVE.set_function(lambda: sum(
+        e.live_adapters for e in engines()))
+    POOL_DROPS.set_function(KV.pool_drop_count)
+
+
+_WIRED = False
+
+
+def render() -> str:
+    """The /metrics response body (text exposition format 0.0.4)."""
+    global _WIRED
+    if not _WIRED:
+        _wire_gauges()
+        _WIRED = True
+    return REGISTRY.render()
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def reset() -> None:
+    """Zero counters/histograms (tests and bench phase isolation)."""
+    REGISTRY.reset()

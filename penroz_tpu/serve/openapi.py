@@ -94,6 +94,26 @@ def _routes() -> list[dict]:
                      "breaker is open or shutdown is draining",
              responses=dict([_resp(200, "Ready to serve"),
                              _resp(503, "Breaker open or draining")])),
+        dict(method="get", path="/metrics",
+             summary="Prometheus text exposition (format 0.0.4, "
+                     "dependency-free): request/token/shed/crash "
+                     "counters, engine gauges, and fixed-bucket TTFT / "
+                     "ITL / queue-wait / chunk-stall / tick-duration "
+                     "histograms",
+             responses=dict([_resp(200, "text/plain exposition")])),
+        dict(method="get", path="/trace/",
+             summary="Recent per-request trace summaries (completed ring "
+                     "of PENROZ_TRACE_BUFFER + in-flight), sampled via "
+                     "PENROZ_TRACE_SAMPLE",
+             responses=dict([_resp(200, "Trace summaries")])),
+        dict(method="get", path="/trace/{request_id}",
+             summary="One request's lifecycle span tree: queue wait, "
+                     "prefix-cache match, prefill chunks, decode/verify "
+                     "steps, crash-recovery events, retirement reason "
+                     "(request ids come from the X-Request-Id response "
+                     "header)",
+             responses=dict([_resp(200, "Span tree"),
+                             _resp(404, "Unknown/evicted request id")])),
         dict(method="post", path="/model/",
              summary="Create a model from the layer/optimizer DSL",
              body=_body("CreateModelRequest", gpt2_124m_example()),
@@ -180,6 +200,12 @@ def _routes() -> list[dict]:
              summary="Start/stop a jax.profiler trace capture",
              body=_body("ProfileRequest"),
              responses=dict([ok, _resp(409, "Capture state conflict")])),
+        dict(method="post", path="/profiler/trace/",
+             summary="Alias of /profile/: start/stop a jax.profiler "
+                     "capture whose timeline carries the framework's "
+                     "penroz/sched_* span annotations",
+             body=_body("ProfileRequest"),
+             responses=dict([ok, _resp(409, "Capture state conflict")])),
         dict(method="get", path="/progress/",
              summary="Training progress, average cost history, status",
              params=_query_params("model_id"),
@@ -190,11 +216,13 @@ def _routes() -> list[dict]:
              responses=dict([ok, _resp(404, "Unknown model")])),
         dict(method="get", path="/serving_stats/",
              summary="Continuous-batching scheduler stats: queue depth, "
-                     "batch occupancy, decode tokens/sec, admission "
-                     "latency, prefill chunk-stall p99, prefix-cache hit "
-                     "rate/evictions, speculative-decoding accept rate + "
-                     "tokens per decode step, LoRA live adapters/rows + "
-                     "per-adapter token counts, KV pool-drop counter",
+                     "batch occupancy, decode tokens/sec, "
+                     "histogram-derived TTFT/ITL/queue-wait/chunk-stall/"
+                     "tick percentiles, the tick telemetry timeline, "
+                     "prefix-cache hit rate/evictions, "
+                     "speculative-decoding accept rate + tokens per "
+                     "decode step, LoRA live adapters/rows + per-adapter "
+                     "token counts, KV pool-drop counter",
              responses={"200": {
                  "description": "Serving statistics",
                  "content": {"application/json": {"schema": {

@@ -172,6 +172,27 @@ class PrefixCacheStats(BaseModel):
                                "(unpinned leaves only)")
 
 
+class TickRecord(BaseModel):
+    """One scheduler tick in the telemetry timeline: what the loop did
+    between dispatches — phase composition (prefill chunks / spec-decode
+    verify rows / shared-step rows), batch occupancy, and dispatch wall
+    time.  Ring-buffered per engine (PENROZ_TICK_TIMELINE entries); the
+    dashboard renders the tail as the occupancy/latency strip."""
+    age_s: float = Field(..., description="Seconds before the stats "
+                         "snapshot this tick ran (newest ≈ 0)")
+    dispatch_ms: float = Field(..., description="Tick dispatch wall time "
+                               "(prefill chunks + decode step)")
+    occupancy: float = Field(..., description="active_rows / capacity "
+                             "after the tick")
+    prefill_chunks: int = Field(0, description="Prefill chunks run at "
+                                "this step boundary")
+    verify_rows: int = Field(0, description="Rows that ran a spec-decode "
+                             "multi-token verify step")
+    shared_rows: int = Field(0, description="Rows in the plain shared "
+                             "batched step")
+    emitted: int = Field(0, description="Tokens emitted this tick")
+
+
 class EngineStats(BaseModel):
     """Per-engine snapshot inside ServingStatsResponse (one continuous-
     batching engine per (model, block_size, sampling config))."""
@@ -254,6 +275,21 @@ class EngineStats(BaseModel):
         0.0, description="decode_tokens / decode_steps — >1 per active "
         "row means speculation is paying (a plain step emits exactly one "
         "token per decoding row)")
+    ttft_ms_p99: Optional[float] = Field(
+        None, description="p99 enqueue → first token (histogram-derived, "
+        "like every percentile here — never a truncated-sample p99)")
+    itl_ms_p50: Optional[float] = Field(
+        None, description="Median inter-token latency per decoding row")
+    itl_ms_p99: Optional[float] = Field(
+        None, description="p99 inter-token latency per decoding row")
+    tick_ms_p50: Optional[float] = Field(
+        None, description="Median scheduler-tick dispatch wall time")
+    tick_ms_p99: Optional[float] = Field(
+        None, description="p99 scheduler-tick dispatch wall time")
+    tick_timeline: list[TickRecord] = Field(
+        default_factory=list, description="Recent ticks (newest-first cap "
+        "120 of the PENROZ_TICK_TIMELINE ring): phase composition, "
+        "occupancy, dispatch wall time")
 
 
 class ServingStatsResponse(BaseModel):
@@ -282,6 +318,23 @@ class ServingStatsResponse(BaseModel):
     batch_occupancy: float
     decode_tokens_per_sec: float
     admission_latency_ms_p50: Optional[float] = None
+    ttft_ms_p99: Optional[float] = Field(
+        None, description="p99 enqueue → first token across engines "
+        "(merged histogram buckets, not truncated samples)")
+    itl_ms_p50: Optional[float] = Field(
+        None, description="Median inter-token latency across engines")
+    itl_ms_p99: Optional[float] = Field(
+        None, description="p99 inter-token latency across engines")
+    tick_ms_p50: Optional[float] = Field(
+        None, description="Median scheduler-tick dispatch wall time "
+        "across engines")
+    tick_ms_p99: Optional[float] = Field(
+        None, description="p99 scheduler-tick dispatch wall time across "
+        "engines")
+    tick_timeline: list[TickRecord] = Field(
+        default_factory=list, description="Merged recent ticks across "
+        "engines (newest-first, cap 120) — the dashboard "
+        "occupancy/latency strip")
     prefill_chunk_stall_ms_p99: Optional[float] = Field(
         None, description="p99 prefill-chunk stall across engines")
     prefix_cache_hit_rate: Optional[float] = Field(

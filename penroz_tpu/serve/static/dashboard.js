@@ -277,15 +277,134 @@ function renderServing(data) {
   ], { legend: true });
 }
 
+/* ---- tick telemetry strip (/serving_stats/ tick_timeline) -------------- */
+
+/* Bars: per-tick dispatch wall time, colored by phase composition
+ * (prefill chunk > verify > plain shared step); line: batch occupancy.
+ * This is the "what is the tick loop actually doing between dispatches"
+ * panel — a tall amber bar is a chunk stall, a purple run is spec-decode
+ * verify traffic, the teal line sagging is an underfed batch. */
+function renderTickStrip(data) {
+  const canvas = $("tick-strip");
+  const meta = $("tick-meta");
+  if (!canvas || !meta) return;
+  const timeline = (data && data.tick_timeline) || [];
+  if (!timeline.length) {
+    meta.textContent = "no ticks yet";
+    prepCanvas(canvas);
+    return;
+  }
+  const fmt = (v) => (v == null ? "—" : v.toFixed(1) + "ms");
+  meta.textContent =
+    `${timeline.length} recent ticks · dispatch p50 ${fmt(data.tick_ms_p50)}` +
+    ` p99 ${fmt(data.tick_ms_p99)} · itl p50 ${fmt(data.itl_ms_p50)}` +
+    ` p99 ${fmt(data.itl_ms_p99)} · ttft p99 ${fmt(data.ttft_ms_p99)}`;
+  const ticks = timeline.slice().reverse();  // chronological left → right
+  const ctx = prepCanvas(canvas);
+  const w = canvas.width, h = canvas.height, pad = 8;
+  const hi = Math.max(...ticks.map(t => t.dispatch_ms), 1e-9);
+  const bw = (w - 2 * pad) / ticks.length;
+  ticks.forEach((t, i) => {
+    const bh = Math.max(1, t.dispatch_ms / hi * (h - 2 * pad));
+    ctx.fillStyle = t.prefill_chunks > 0 ? "#e0b35c"
+      : t.verify_rows > 0 ? "#b58cd9" : "#7aa2f7";
+    ctx.fillRect(pad + i * bw, h - pad - bh, Math.max(1, bw - 1), bh);
+  });
+  ctx.strokeStyle = "#7fd1b9";
+  ctx.lineWidth = 1.5;
+  ctx.beginPath();
+  ticks.forEach((t, i) => {
+    const x = pad + i * bw + bw / 2;
+    const y = h - pad - t.occupancy * (h - 2 * pad);
+    if (i === 0) ctx.moveTo(x, y); else ctx.lineTo(x, y);
+  });
+  ctx.stroke();
+  drawLabel(ctx, `${hi.toFixed(1)}ms`, 4, 12);
+  drawLabel(ctx, "chunk", w - 200, 12, "#e0b35c");
+  drawLabel(ctx, "verify", w - 150, 12, "#b58cd9");
+  drawLabel(ctx, "step", w - 100, 12, "#7aa2f7");
+  drawLabel(ctx, "occupancy", w - 68, 12, "#7fd1b9");
+}
+
+/* ---- per-request trace waterfall (/trace/, /trace/{id}) ---------------- */
+
+const SPAN_COLORS = {
+  queue: "#5d7285", prefill: "#e0b35c", prefill_chunk: "#c77d0a",
+  decode: "#7aa2f7", decode_step: "#56b6c2", verify: "#b58cd9",
+  recovery: "#e06c75", legacy_generate: "#98c379",
+};
+
+function flattenSpans(span, depth, out) {
+  out.push({ span, depth });
+  (span.children || []).forEach((c) => flattenSpans(c, depth + 1, out));
+  return out;
+}
+
+function renderWaterfall(tree) {
+  const canvas = $("trace-waterfall");
+  const meta = $("trace-meta");
+  if (!canvas || !meta) return;
+  if (!tree || !tree.root) {
+    meta.textContent =
+      "no traces yet (serve a /generate/ request, or paste a request id)";
+    prepCanvas(canvas);
+    return;
+  }
+  const total = tree.root.duration_ms != null ? tree.root.duration_ms
+    : Math.max(1, ...flattenSpans(tree.root, 0, [])
+        .map(r => r.span.t1_ms == null ? r.span.t0_ms : r.span.t1_ms));
+  const reason = (tree.meta && tree.meta.retire_reason) ||
+    (tree.finished ? "finished" : "in flight");
+  meta.textContent = `request ${tree.request_id} · ` +
+    `${total.toFixed(1)}ms · ${reason}` +
+    (tree.dropped_spans ? ` · ${tree.dropped_spans} spans dropped` : "");
+  const rows = flattenSpans(tree.root, 0, []).slice(0, 24);
+  const ctx = prepCanvas(canvas);
+  const w = canvas.width, pad = 6, rowH = 15;
+  const sx = (ms) => pad + 170 + (ms / Math.max(total, 1e-9))
+    * (w - pad * 2 - 170);
+  rows.forEach(({ span, depth }, i) => {
+    const y = pad + i * rowH;
+    const t0 = span.t0_ms || 0;
+    const t1 = span.t1_ms == null ? total : span.t1_ms;
+    ctx.fillStyle = SPAN_COLORS[span.name] || "#3f7f6b";
+    ctx.fillRect(sx(t0), y + 3, Math.max(2, sx(t1) - sx(t0)), rowH - 5);
+    const dur = span.duration_ms == null ? "…"
+      : span.duration_ms.toFixed(1) + "ms";
+    drawLabel(ctx, `${"  ".repeat(depth)}${span.name} ${dur}`,
+              pad, y + rowH - 3);
+  });
+}
+
+async function refreshTrace() {
+  const input = $("trace-id");
+  let id = input ? input.value.trim() : "";
+  try {
+    if (!id) {
+      const list = await fetchJson("/trace/");
+      if (list.traces && list.traces.length) id = list.traces[0].request_id;
+      else if (list.live && list.live.length) id = list.live[0].request_id;
+    }
+    renderWaterfall(id
+      ? await fetchJson(`/trace/${encodeURIComponent(id)}`) : null);
+  } catch (e) {
+    renderWaterfall(null);
+  }
+}
+
 async function refresh() {
   const modelId = $("model-id").value.trim();
   const filter = $("layer-filter").value.trim();
   setQueryState(modelId, filter);
   try {
-    renderServing(await fetchJson("/serving_stats/"));
+    const serving = await fetchJson("/serving_stats/");
+    renderServing(serving);
+    renderTickStrip(serving);
   } catch (e) {
     renderServing(null);
+    renderTickStrip(null);
   }
+  await refreshTrace();
   if (!modelId) return;
   try {
     const progress = await fetchJson(`/progress/?model_id=${encodeURIComponent(modelId)}`);
@@ -315,7 +434,9 @@ window.addEventListener("DOMContentLoaded", () => {
   $("layer-filter").value = state.filter;
   $("refresh-btn").addEventListener("click", refresh);
   $("auto-refresh").addEventListener("change", setupAuto);
-  [$("model-id"), $("layer-filter")].forEach(el =>
-    el.addEventListener("keydown", (e) => { if (e.key === "Enter") refresh(); }));
+  [$("model-id"), $("layer-filter"), $("trace-id")].forEach(el => {
+    if (el) el.addEventListener("keydown",
+      (e) => { if (e.key === "Enter") refresh(); });
+  });
   if (state.modelId) refresh();
 });

@@ -67,6 +67,14 @@ aggregate ``tokens_per_decode_step`` + ``spec_accept_rate`` fields via
 ``PENROZ_BENCH_SPEC_NGRAM``, ``PENROZ_BENCH_SPEC_PROMPT``,
 ``PENROZ_BENCH_SPEC_VOCAB``, plus the shared ``PENROZ_BENCH_SERVING_*`` /
 ``PENROZ_BENCH_REQUESTS`` / ``PENROZ_BENCH_MAX_NEW`` set.
+
+Observability (PR 6): every scenario scrapes ``GET /metrics`` before and
+after its run and embeds the counter/histogram deltas as
+``metrics_delta`` in the JSON capture — committed bench captures double
+as a metrics regression record.  The default mode also runs a
+``trace_overhead`` phase: sequential streaming ITLs with per-request
+tracing sampled out (``PENROZ_TRACE_SAMPLE=0``) vs full (``=1``), greedy
+parity asserted, delta recorded.
 """
 
 from __future__ import annotations
@@ -83,6 +91,48 @@ import tempfile  # noqa: E402
 import time  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_metrics(text: str) -> dict:
+    """Flat ``{series: value}`` map of a Prometheus text exposition —
+    ``penroz_requests_total{outcome="completed"} 12`` becomes one entry."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+async def _scrape_metrics(client) -> dict:
+    resp = await client.get("/metrics")
+    assert resp.status == 200, await resp.text()
+    return _parse_metrics(await resp.text())
+
+
+def _metrics_delta(before: dict, after: dict) -> dict:
+    """What this scenario did to the monotonic series (counters and
+    histogram sums/counts; gauges are instantaneous and excluded):
+    embedded in every bench JSON capture so the bench history doubles as
+    a metrics regression record — a scenario that stops moving
+    ``penroz_spec_accepted_tokens_total`` shows up in the diff of its
+    committed captures, not just in a live Prometheus."""
+    delta = {}
+    for key, value in after.items():
+        base = key.split("{", 1)[0]
+        if base.endswith("_bucket") or not (
+                base.endswith("_total") or base.endswith("_sum")
+                or base.endswith("_count")):
+            continue
+        d = value - before.get(key, 0.0)
+        if d:
+            delta[key] = round(d, 3)
+    return delta
 
 
 def _toy_gpt(d=256, heads=8, vocab=512, block=256, depth=4):
@@ -143,6 +193,7 @@ async def _bench(concurrency: int, max_new: int, block: int) -> dict:
 
         results: dict = {"concurrency": concurrency,
                          "max_new_tokens": max_new, "block_size": block}
+        metrics_before = await _scrape_metrics(client)
         baselines = None
         parity_ok = True
         for mode in ("off", "on"):
@@ -177,15 +228,52 @@ async def _bench(concurrency: int, max_new: int, block: int) -> dict:
         results["concurrent_on_vs_serial_off"] = round(
             off["serial_s"] / on["concurrent_s"], 3)
         results["parity_ok"] = parity_ok
+        results["trace_overhead"] = await _bench_trace_overhead(
+            client, prompts, max_new, block)
         resp = await client.get("/serving_stats/")
         stats = await resp.json()
         stats.pop("engines", None)
+        stats.pop("tick_timeline", None)  # per-tick samples, not a summary
         results["serving_stats"] = stats
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
         return results
     finally:
         decode_scheduler.reset()
         await client.close()
         os.environ.pop(decode_scheduler.ENABLE_ENV, None)
+        os.environ.pop("PENROZ_TRACE_SAMPLE", None)
+
+
+async def _bench_trace_overhead(client, prompts, max_new, block) -> dict:
+    """Per-request tracing is host-side span bookkeeping; this phase pins
+    that it stays invisible next to a decode dispatch: sequential
+    streaming ITLs through the scheduler with PENROZ_TRACE_SAMPLE=0 vs 1,
+    greedy parity asserted, the delta recorded in the JSON capture (the
+    acceptance bar is 'within noise', so the capture records the evidence,
+    not a hard threshold that would flake on shared CI boxes)."""
+    from penroz_tpu.serve import decode_scheduler
+    os.environ[decode_scheduler.ENABLE_ENV] = "1"
+    out: dict = {}
+    seqs = {}
+    sample = prompts[:4]
+    for phase in ("off", "on"):
+        os.environ["PENROZ_TRACE_SAMPLE"] = "0" if phase == "off" else "1"
+        itls, toks_all = [], []
+        for p in sample:
+            toks, _, gaps = await _stream_one(client, {
+                "model_id": "bench-serving", "input": [p],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0})
+            itls.extend(gaps)
+            toks_all.append(toks)
+        seqs[phase] = toks_all
+        out[f"itl_ms_p50_trace_{phase}"] = round(_pct(itls, 0.5), 3)
+        out[f"itl_ms_p99_trace_{phase}"] = round(_pct(itls, 0.99), 3)
+    out["itl_p50_delta_ms"] = round(
+        out["itl_ms_p50_trace_on"] - out["itl_ms_p50_trace_off"], 3)
+    out["parity_ok"] = seqs["off"] == seqs["on"]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -235,6 +323,7 @@ async def _bench_overload() -> dict:
                 d=128, depth=2, block=block),
             "optimizer": {"sgd": {"lr": 0.1}}})
         assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
 
         # Solo greedy baselines (scheduler on, no contention) — parity
         # reference for every admitted response under overload.  Also
@@ -267,6 +356,7 @@ async def _bench_overload() -> dict:
         resp = await client.get("/serving_stats/")
         stats = await resp.json()
         stats.pop("engines", None)
+        stats.pop("tick_timeline", None)
         return {
             "mode": "overload", "block_size": block, "capacity_rows": rows,
             "max_queue": queue, "offered_concurrency": offered,
@@ -281,6 +371,8 @@ async def _bench_overload() -> dict:
                                if latencies else None),
             "parity_ok": parity_ok,
             "serving_stats": stats,
+            "metrics_delta": _metrics_delta(
+                metrics_before, await _scrape_metrics(client)),
         }
     finally:
         decode_scheduler.reset()
@@ -377,6 +469,7 @@ async def _bench_shared_prefix() -> dict:
             "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
             "optimizer": {"sgd": {"lr": 0.1}}})
         assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
 
         results: dict = {
             "mode": "shared_prefix", "block_size": block,
@@ -421,6 +514,8 @@ async def _bench_shared_prefix() -> dict:
         results["ttft_p50_speedup_on_vs_off"] = round(
             results["prefix_cache_off"]["ttft_ms_p50"]
             / results["prefix_cache_on"]["ttft_ms_p50"], 3)
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
         return results
     finally:
         decode_scheduler.reset()
@@ -492,6 +587,7 @@ async def _bench_multi_adapter() -> dict:
                 "model_id": "bench-lora", "adapter_id": f"tenant-{i}",
                 "rank": rank, "init": "random", "seed": 100 + i})
             assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
 
         results: dict = {
             "mode": "multi_adapter", "block_size": block,
@@ -539,7 +635,10 @@ async def _bench_multi_adapter() -> dict:
         resp = await client.get("/serving_stats/")
         stats = await resp.json()
         stats.pop("engines", None)
+        stats.pop("tick_timeline", None)
         results["serving_stats"] = stats
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
         return results
     finally:
         decode_scheduler.reset()
@@ -608,6 +707,7 @@ async def _bench_speculative() -> dict:
             "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
             "optimizer": {"sgd": {"lr": 0.1}}})
         assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
 
         results: dict = {
             "mode": "speculative", "block_size": block,
@@ -648,6 +748,8 @@ async def _bench_speculative() -> dict:
         results["itl_p50_speedup_on_vs_off"] = round(
             results["spec_off"]["itl_ms_p50"]
             / results["spec_on"]["itl_ms_p50"], 3)
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
         return results
     finally:
         decode_scheduler.reset()

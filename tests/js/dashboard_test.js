@@ -25,6 +25,10 @@ const fixtures = {
     fs.readFileSync(path.join(HERE, "fixtures/stats_plain.json"))),
   serving: JSON.parse(
     fs.readFileSync(path.join(HERE, "fixtures/serving.json"))),
+  traceList: JSON.parse(
+    fs.readFileSync(path.join(HERE, "fixtures/trace_list.json"))),
+  traceDetail: JSON.parse(
+    fs.readFileSync(path.join(HERE, "fixtures/trace_detail.json"))),
 };
 
 runDashboardTests(src, fixtures)

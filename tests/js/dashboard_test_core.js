@@ -58,12 +58,16 @@ function makeElement(tag) {
 const PANEL_IDS = ["model-id", "layer-filter", "refresh-btn", "auto-refresh",
                    "status-badge", "cost-chart", "avg-cost-chart",
                    "speed-chart", "ratio-chart", "hist-grid",
-                   "serving-meta", "serving-chart"];
+                   "serving-meta", "serving-chart",
+                   "tick-meta", "tick-strip",
+                   "trace-id", "trace-meta", "trace-waterfall"];
 
 function makeDocument() {
   const byId = {};
   for (const id of PANEL_IDS) {
-    byId[id] = makeElement(id.includes("chart") ? "canvas" : "div");
+    byId[id] = makeElement(
+      id.includes("chart") || id === "tick-strip" ||
+      id === "trace-waterfall" ? "canvas" : "div");
   }
   return {
     byId,
@@ -81,6 +85,7 @@ function gridCells(grid) {
 }
 
 async function runDashboard(src, { progress, stats, serving = null,
+                                   traceList = null, traceDetail = null,
                                    progressStatus = 200 }) {
   const document = makeDocument();
   const fetched = [];
@@ -97,6 +102,16 @@ async function runDashboard(src, { progress, stats, serving = null,
     if (url.startsWith("/serving_stats/")) {
       return { ok: serving !== null, status: serving === null ? 500 : 200,
                json: async () => serving };
+    }
+    if (url === "/trace/") {
+      return { ok: traceList !== null,
+               status: traceList === null ? 500 : 200,
+               json: async () => traceList };
+    }
+    if (url.startsWith("/trace/")) {
+      return { ok: traceDetail !== null,
+               status: traceDetail === null ? 404 : 200,
+               json: async () => traceDetail };
     }
     throw new Error(`unexpected fetch ${url}`);
   };
@@ -127,9 +142,10 @@ async function runDashboardTests(src, fixtures) {
   {
     const { document, fetched } = await runDashboard(src, {
       progress: fixtures.progress, stats: fixtures.statsMoe,
-      serving: fixtures.serving });
-    assertEq(fetched.length, 3,
-             "fetches /serving_stats/, /progress/, /stats/");
+      serving: fixtures.serving, traceList: fixtures.traceList,
+      traceDetail: fixtures.traceDetail });
+    assertEq(fetched.length, 5,
+             "fetches /serving_stats/, /trace/ (x2), /progress/, /stats/");
     const servingMeta = document.byId["serving-meta"].textContent;
     assertOk(servingMeta.includes("tok/s"),
              "serving tile shows decode throughput");
@@ -188,6 +204,36 @@ async function runDashboardTests(src, fixtures) {
     assertEq(moeCells.length,
              Object.keys(fixtures.statsMoe.moe_router_fractions).length,
              "one MoE routing panel per router_fraction entry");
+    // tick telemetry strip: phase-colored dispatch bars + occupancy line
+    const tickMeta = document.byId["tick-meta"].textContent;
+    assertOk(tickMeta.includes(
+               `${fixtures.serving.tick_timeline.length} recent ticks`),
+             "tick strip meta counts timeline entries");
+    assertOk(tickMeta.includes("dispatch p50 " +
+               fixtures.serving.tick_ms_p50.toFixed(1) + "ms"),
+             "tick strip meta shows histogram-derived dispatch p50");
+    assertOk(tickMeta.includes("ttft p99 " +
+               fixtures.serving.ttft_ms_p99.toFixed(1) + "ms"),
+             "tick strip meta shows ttft p99");
+    const tickOps = document.byId["tick-strip"]._ops.map((o) => o[0]);
+    assertOk(tickOps.includes("fillRect"), "tick strip drew dispatch bars");
+    assertOk(tickOps.includes("stroke"),
+             "tick strip drew the occupancy line");
+    // per-request waterfall: newest completed trace, span labels visible
+    const traceMeta = document.byId["trace-meta"].textContent;
+    assertOk(traceMeta.includes(fixtures.traceDetail.request_id),
+             "waterfall meta names the rendered request id");
+    assertOk(traceMeta.includes(fixtures.traceDetail.meta.retire_reason),
+             "waterfall meta shows the retirement reason");
+    const wfOps = document.byId["trace-waterfall"]._ops;
+    assertOk(wfOps.filter((o) => o[0] === "fillRect").length >= 8,
+             "waterfall drew one bar per span");
+    const wfLabels = wfOps.filter((o) => o[0] === "fillText")
+      .map((o) => String(o[1]));
+    for (const name of ["queue", "prefill", "decode", "verify", "recovery"]) {
+      assertOk(wfLabels.some((l) => l.includes(name)),
+               `waterfall labels the ${name} span`);
+    }
   }
 
   // 2. MoE panel appears IFF moe_router_fractions is present; the serving
@@ -202,6 +248,10 @@ async function runDashboardTests(src, fixtures) {
              "no MoE panel without moe_router_fractions");
     assertOk(document.byId["serving-meta"].textContent.includes("unavailable"),
              "serving tile reports unavailable endpoint without crashing");
+    assertOk(document.byId["tick-meta"].textContent.includes("no ticks"),
+             "tick strip degrades without serving stats");
+    assertOk(document.byId["trace-meta"].textContent.includes("no traces"),
+             "waterfall degrades without any trace");
   }
 
   // 2b. serving stats without prefix-cache / spec-decode fields (features

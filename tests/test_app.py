@@ -381,7 +381,9 @@ def test_openapi_spec(client):
     for path in ["/model/", "/import/", "/dataset/", "/tokenize/",
                  "/output/", "/evaluate/", "/generate/", "/decode/",
                  "/train/", "/progress/", "/stats/", "/serving_stats/",
-                 "/profile/", "/dashboard", "/healthz", "/readyz"]:
+                 "/profile/", "/profiler/trace/", "/metrics", "/trace/",
+                 "/trace/{request_id}", "/dashboard", "/healthz",
+                 "/readyz"]:
         assert path in spec["paths"], path
     assert set(spec["paths"]["/dataset/"]) == {"get", "post", "delete"}
     assert "CreateModelRequest" in spec["components"]["schemas"]

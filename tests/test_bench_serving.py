@@ -58,6 +58,13 @@ def test_shared_prefix_bench_smoke(tmp_path):
     # warm request misses, first measured request misses, the rest hit
     assert on["hit_rate"] is not None and on["hit_rate"] >= 0.5
     assert results["ttft_p50_speedup_on_vs_off"] >= 2.0, results
+    # /metrics scrape deltas embedded: the scenario's traffic moved the
+    # prometheus counters it should (bench history doubles as a metrics
+    # regression record)
+    delta = results["metrics_delta"]
+    assert delta["penroz_prefix_cache_hits_total"] >= 3, delta
+    assert delta['penroz_requests_total{outcome="completed"}'] > 0, delta
+    assert delta["penroz_ttft_ms_count"] > 0, delta
 
 
 def test_speculative_bench_smoke(tmp_path):
@@ -102,6 +109,10 @@ def test_speculative_bench_smoke(tmp_path):
     for phase in (on, off):
         assert phase["itl_ms_p50"] > 0
         assert phase["itl_ms_p99"] >= phase["itl_ms_p50"]
+    delta = results["metrics_delta"]
+    assert delta["penroz_spec_accepted_tokens_total"] > 0, delta
+    assert delta["penroz_spec_drafted_tokens_total"] >= \
+        delta["penroz_spec_accepted_tokens_total"], delta
 
 
 def test_multi_adapter_bench_smoke(tmp_path):
@@ -172,4 +183,6 @@ def test_overload_bench_smoke(tmp_path):
     assert results["parity_ok"] is True, results       # with exact tokens
     assert results["goodput_ms_p99"] is not None
     assert results["serving_stats"]["queue_rejections"] == \
+        results["shed_429"]
+    assert results["metrics_delta"]["penroz_queue_rejections_total"] == \
         results["shed_429"]

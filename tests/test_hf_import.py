@@ -1109,6 +1109,21 @@ def test_bloom_import_logit_parity_and_generate(workdir):
     assert toks == _greedy_rollout(model, [1, 2, 3], 6)
 
 
+def test_bloom_configless_import_refused_with_named_field():
+    """Config-less BLOOM state-dict mapping needs n_head for the per-head
+    fused-QKV de-interleave: the key sniff (word_embeddings_layernorm)
+    dispatches fine without a config, so the refusal must be a descriptive
+    ValueError naming the missing field — not the bare AttributeError a
+    ``getattr(None, 'n_head')`` would die with later (mirrors the GPT-2
+    Conv1D-sniff refusal convention)."""
+    import numpy as np
+    from penroz_tpu.models.dsl import Mapper
+    sd = {"transformer.word_embeddings_layernorm.weight": np.ones(8),
+          "transformer.word_embeddings.weight": np.ones((16, 8))}
+    with pytest.raises(ValueError, match="n_head"):
+        Mapper.map_hf_state_dict_to_custom(sd, 1, config=None)
+
+
 def test_bloom_post_layernorm_residual_refused():
     from penroz_tpu.models.dsl import Mapper
     from types import SimpleNamespace

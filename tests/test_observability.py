@@ -1,0 +1,486 @@
+"""Observability-layer tests (PR 6): per-request trace span trees,
+the ``GET /metrics`` Prometheus exposition, tick-level engine telemetry,
+and the schema-sync contracts that keep ``/serving_stats/``, the OpenAPI
+spec, and the JS dashboard fixtures from drifting apart.
+
+The two load-bearing invariants:
+
+- **Strict exposition format** — every ``/metrics`` line parses under
+  the Prometheus text-format grammar, every sample belongs to a declared
+  ``# TYPE`` family, histogram bucket series are cumulative and their
+  ``+Inf`` bucket equals ``_count``.
+- **Tracing changes nothing** — greedy outputs are token-identical with
+  per-request tracing on, sampled out, or off (host-side bookkeeping
+  only), and a crash-injected request's trace shows the full
+  queue → prefill → decode → recovery lifecycle with the retirement
+  reason.
+"""
+
+import asyncio
+import json
+import os
+import re
+import time
+
+import pytest
+
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+
+pytestmark = pytest.mark.runtime
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BLOCK = 16
+SGD = {"sgd": {"lr": 0.1}}
+
+
+@pytest.fixture(autouse=True)
+def _observability_state(workdir):
+    """Fresh engine registry, fault counters, trace ring, and metric
+    registry per test — counters are process-wide by design, so tests
+    must zero them to assert deltas."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.serve import metrics as serve_metrics
+    from penroz_tpu.utils import faults, tracing
+    faults.reset()
+    tracing.reset()
+    serve_metrics.reset()
+    yield
+    decode_scheduler.reset()
+    faults.reset()
+    tracing.reset()
+    serve_metrics.reset()
+
+
+@pytest.fixture
+def gpt_model(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("obsgpt", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    return model
+
+
+@pytest.fixture
+def client(workdir):
+    from penroz_tpu.serve import app as app_mod
+    app_mod.model_locks.clear()
+    app_mod.dataset_locks.clear()
+    from aiohttp.test_utils import TestClient, TestServer
+    loop = asyncio.new_event_loop()
+    client = TestClient(TestServer(app_mod.create_app()), loop=loop)
+    loop.run_until_complete(client.start_server())
+    yield client, loop
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+def _request(client_loop, method, path, **kw):
+    client, loop = client_loop
+
+    async def go():
+        resp = await client.request(method, path, **kw)
+        body = await resp.read()
+        return resp, body
+
+    return loop.run_until_complete(go())
+
+
+def _json(client_loop, method, path, **kw):
+    resp, body = _request(client_loop, method, path, **kw)
+    return resp.status, (json.loads(body) if body else None)
+
+
+def _gen_payload(**overrides):
+    payload = {"model_id": "obsgpt", "input": [[1, 2, 3]],
+               "block_size": BLOCK, "max_new_tokens": 4, "temperature": 0.0}
+    payload.update(overrides)
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# GET /metrics — strict exposition-format parser
+# ---------------------------------------------------------------------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABELS = r'\{%s="(?:[^"\\\n])*"(?:,%s="(?:[^"\\\n])*")*\}' % (_NAME, _NAME)
+_VALUE = r"(?:[-+]?(?:\d+(?:\.\d+)?|\.\d+)(?:[eE][-+]?\d+)?|\+Inf|-Inf|NaN)"
+_SAMPLE_RE = re.compile(
+    r"^(%s)(%s)? (%s)$" % (_NAME, _LABELS, _VALUE))
+
+
+def parse_exposition(text: str):
+    """Strict parse of the Prometheus text format: returns
+    ``(types, samples)`` where samples preserve file order per series.
+    Asserts the grammar line by line — any malformed line fails here."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    types: dict = {}
+    samples: list = []
+    for line in text.split("\n")[:-1]:
+        assert line == line.rstrip(), f"trailing whitespace: {line!r}"
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert re.fullmatch(_NAME, name), line
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), line
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        samples.append((m.group(1), m.group(2), float(m.group(3))
+                        if m.group(3) not in ("+Inf", "-Inf", "NaN")
+                        else m.group(3)))
+    return types, samples
+
+
+def _family_of(sample_name: str, types: dict) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        base = sample_name[:-len(suffix)] if sample_name.endswith(suffix) \
+            else None
+        if base in types and types[base] == "histogram":
+            return base
+    return sample_name
+
+
+def test_metrics_exposition_strict_format(client, gpt_model, monkeypatch):
+    """Every /metrics line parses under the exposition grammar, every
+    sample belongs to a declared family, and histogram buckets are
+    cumulative with le=+Inf == _count and a consistent _sum."""
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    for i in range(3):
+        status, body = _json(client, "POST", "/generate/",
+                             json=_gen_payload(input=[[1 + i, 2]]))
+        assert status == 200, body
+    resp, body = _request(client, "GET", "/metrics")
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    types, samples = parse_exposition(body.decode())
+
+    by_series: dict = {}
+    for name, labels, value in samples:
+        family = _family_of(name, types)
+        assert family in types, f"sample {name} has no # TYPE declaration"
+        by_series.setdefault(family, []).append((name, labels, value))
+
+    # the serving metric families all exist
+    for family in ("penroz_requests_total", "penroz_decode_tokens_total",
+                   "penroz_ttft_ms", "penroz_itl_ms", "penroz_queue_wait_ms",
+                   "penroz_chunk_stall_ms", "penroz_tick_ms",
+                   "penroz_active_rows", "penroz_breaker_open"):
+        assert family in types, f"missing family {family}"
+
+    # histogram invariants: cumulative buckets, +Inf == _count,
+    # counts/sums consistent
+    histograms = [n for n, k in types.items() if k == "histogram"]
+    assert histograms
+    for family in histograms:
+        rows = by_series.get(family, [])
+        buckets = [(labels, v) for n, labels, v in rows
+                   if n == family + "_bucket"]
+        counts = [v for n, _, v in rows if n == family + "_count"]
+        sums = [v for n, _, v in rows if n == family + "_sum"]
+        assert len(counts) == 1 and len(sums) == 1, family
+        assert buckets, family
+        assert buckets[-1][0] == '{le="+Inf"}', family
+        cum = [v for _, v in buckets]
+        assert cum == sorted(cum), f"{family} buckets not cumulative: {cum}"
+        assert cum[-1] == counts[0], f"{family} +Inf != _count"
+        edges = [labels[5:-2] for labels, _ in buckets[:-1]]
+        assert edges == sorted(edges, key=float), f"{family} edges unsorted"
+        if counts[0] == 0:
+            assert sums[0] == 0
+        else:
+            assert sums[0] > 0
+
+    # traffic moved the counters the traffic should move
+    flat = {name + (labels or ""): v for name, labels, v in samples}
+    assert flat['penroz_requests_total{outcome="completed"}'] == 3
+    assert flat["penroz_decode_tokens_total"] >= 9  # 3 req x (4 - first)
+    assert flat["penroz_ttft_ms_count"] == 3
+    assert flat["penroz_traces_completed_total"] >= 3
+
+
+def test_serving_stats_p99s_histogram_derived(client, gpt_model,
+                                              monkeypatch):
+    """/serving_stats/ keeps its field names but the percentiles now come
+    from the engines' histogram snapshots — asserted by recomputing the
+    aggregate from the engine accessor and matching the HTTP payload."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import metrics as metrics_util
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    for _ in range(2):
+        status, _ = _json(client, "POST", "/generate/", json=_gen_payload())
+        assert status == 200
+    status, stats = _json(client, "GET", "/serving_stats/")
+    assert status == 200
+    engine = stats["engines"][0]
+    # field names unchanged; values present after traffic
+    for field in ("queue_wait_ms_p99", "admission_latency_ms_p50",
+                  "itl_ms_p99", "tick_ms_p99"):
+        assert engine[field] is not None, field
+        assert stats[field] is not None, field
+    # recompute from the one locked accessor: identical derivation
+    with decode_scheduler._REG_LOCK:
+        engines = [e for e in decode_scheduler._ENGINES.values()
+                   if not e._shutdown]
+    assert len(engines) == 1
+    snap = engines[0].stats()["histograms"]["queue_wait_ms"]
+    expect = metrics_util.quantile_of(snap, 0.99)
+    assert engine["queue_wait_ms_p99"] == pytest.approx(round(expect, 3))
+    # the raw snapshots never leak into the HTTP payload
+    assert "histograms" not in engine
+
+
+def test_tick_timeline_surfaced(client, gpt_model, monkeypatch):
+    """Each tick logs phase composition + dispatch wall time; the
+    timeline reaches /serving_stats/ (newest-first) with the TickRecord
+    shape the dashboard strip renders."""
+    from penroz_tpu.serve import schemas
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    status, _ = _json(client, "POST", "/generate/",
+                      json=_gen_payload(max_new_tokens=5))
+    assert status == 200
+    status, stats = _json(client, "GET", "/serving_stats/")
+    timeline = stats["tick_timeline"]
+    assert timeline, "no tick telemetry after a served request"
+    tick_fields = set(schemas.TickRecord.model_fields)
+    for entry in timeline:
+        assert set(entry) == tick_fields
+        assert entry["dispatch_ms"] > 0
+    ages = [t["age_s"] for t in timeline]
+    assert ages == sorted(ages), "timeline must be newest-first"
+    # first token comes from the final prefill chunk (not step-emitted),
+    # and the retiring tick's record may land just after the "done" event
+    # reaches the client — so of 5 tokens, at least 3 step emissions are
+    # guaranteed visible here
+    assert sum(t["emitted"] for t in timeline) >= 3
+    assert any(t["prefill_chunks"] > 0 for t in timeline)
+    assert stats["tick_ms_p99"] is not None
+
+
+# ---------------------------------------------------------------------------
+# request ids + traces
+# ---------------------------------------------------------------------------
+
+def test_request_id_header_and_error_body(client, workdir):
+    resp, _ = _request(client, "GET", "/healthz")
+    assert resp.headers.get("X-Request-Id")
+    # a sane client-supplied id is honored (proxy correlation)
+    resp, body = _request(client, "GET", "/progress/?model_id=ghost",
+                          headers={"X-Request-Id": "my-corr-id_1"})
+    assert resp.status == 404
+    assert resp.headers["X-Request-Id"] == "my-corr-id_1"
+    assert json.loads(body)["request_id"] == "my-corr-id_1"
+    # a hostile one is replaced
+    resp, _ = _request(client, "GET", "/healthz",
+                       headers={"X-Request-Id": "x" * 200})
+    assert resp.headers["X-Request-Id"] != "x" * 200
+
+
+def _trace_for(client, rid, timeout=10.0, require_finished=True):
+    deadline = time.monotonic() + timeout
+    while True:
+        status, tree = _json(client, "GET", f"/trace/{rid}")
+        if status == 200 and (tree["finished"] or not require_finished):
+            return tree
+        assert time.monotonic() < deadline, (status, tree)
+        time.sleep(0.05)
+
+
+def _span_names(span):
+    return [c["name"] for c in span.get("children", [])]
+
+
+def test_trace_span_tree_happy_path(client, gpt_model, monkeypatch):
+    """A served scheduler request yields a span tree with queue →
+    prefill (chunks) → decode (steps) nesting and a completed
+    retirement."""
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    resp, body = _request(client, "POST", "/generate/",
+                          json=_gen_payload())
+    assert resp.status == 200
+    rid = resp.headers["X-Request-Id"]
+    tree = _trace_for(client, rid)
+    assert tree["request_id"] == rid
+    # the precise retirement reason, not just "completed"
+    assert tree["meta"]["retire_reason"] == "max_new_tokens"
+    root = tree["root"]
+    assert root["name"] == "request"
+    names = _span_names(root)
+    assert names.index("queue") < names.index("prefill") \
+        < names.index("decode")
+    prefill = root["children"][names.index("prefill")]
+    assert all(c["name"] == "prefill_chunk"
+               for c in prefill.get("children", []))
+    assert prefill["children"], "prefill must record its chunks"
+    decode = root["children"][names.index("decode")]
+    assert any(c["name"] == "decode_step"
+               for c in decode.get("children", []))
+    assert decode["meta"]["produced"] == 4
+    # every closed span is well-formed
+    def check(span):
+        assert span["t1_ms"] is None or span["t1_ms"] >= span["t0_ms"]
+        for c in span.get("children", []):
+            assert c["t0_ms"] >= span["t0_ms"] - 1e-6
+            check(c)
+    check(root)
+    # /trace/ lists it, newest first
+    status, listing = _json(client, "GET", "/trace/")
+    assert status == 200
+    assert listing["traces"][0]["request_id"] == rid
+
+
+def test_trace_crash_recovery_span_tree(client, gpt_model, monkeypatch):
+    """THE acceptance path: a crash-injected request's trace contains the
+    full queue → prefill → decode → recovery lifecycle with an
+    engine_crash event and an 'error' retirement — and after the fault
+    clears, greedy output is token-identical to the tracing-off path."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    # two decode steps succeed, the third tick crashes mid-generation
+    monkeypatch.setenv(faults.ENV, "decode.step:raise@3")
+    resp, body = _request(client, "POST", "/generate/",
+                          json=_gen_payload(max_new_tokens=8))
+    assert resp.status == 500
+    rid = resp.headers["X-Request-Id"]
+    assert json.loads(body)["request_id"] == rid
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+
+    tree = _trace_for(client, rid)
+    assert tree["meta"]["retire_reason"] == "error"
+    root = tree["root"]
+    names = _span_names(root)
+    # the ordered lifecycle: queue → prefill → decode → crash → recovery
+    assert names.index("queue") < names.index("prefill") \
+        < names.index("decode") < names.index("engine_crash") \
+        < names.index("recovery")
+    decode = root["children"][names.index("decode")]
+    assert any(c["name"] == "decode_step"
+               for c in decode.get("children", []))
+    recovery = root["children"][names.index("recovery")]
+    assert recovery["meta"]["resets"] >= 1
+
+    # recovered engine + tracing off: same greedy tokens as tracing on
+    status, traced = _json(client, "POST", "/generate/",
+                           json=_gen_payload(max_new_tokens=8))
+    assert status == 200
+    monkeypatch.setenv("PENROZ_TRACE_SAMPLE", "0")
+    status, untraced = _json(client, "POST", "/generate/",
+                             json=_gen_payload(max_new_tokens=8))
+    assert status == 200
+    assert traced["tokens"] == untraced["tokens"]
+    monkeypatch.delenv("PENROZ_TRACE_SAMPLE")
+    monkeypatch.delenv("PENROZ_CONTINUOUS_BATCHING")
+    status, legacy = _json(client, "POST", "/generate/",
+                           json=_gen_payload(max_new_tokens=8))
+    assert legacy["tokens"] == traced["tokens"]
+
+
+def test_trace_deadline_event(client, gpt_model, monkeypatch):
+    """An in-flight deadline expiry retires the row with a 'timeout'
+    reason visible in the trace (satellite: deadline events appear with
+    the right span nesting)."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@120")
+    resp, body = _request(client, "POST", "/generate/",
+                          json=_gen_payload(max_new_tokens=8,
+                                            timeout_ms=250))
+    rid = resp.headers["X-Request-Id"]
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+    assert resp.status in (200, 504)  # stream-off deadline -> 504 midflight
+    tree = _trace_for(client, rid)
+    assert tree["meta"]["retire_reason"] == "timeout"
+    names = _span_names(tree["root"])
+    assert "queue" in names and "prefill" in names
+
+
+def test_trace_sampling_and_ring_bound(client, gpt_model, monkeypatch):
+    from penroz_tpu.utils import tracing
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(tracing.TRACE_BUFFER_ENV, "2")
+    rids = []
+    for i in range(3):
+        resp, _ = _request(client, "POST", "/generate/",
+                           json=_gen_payload(input=[[1 + i, 2]]))
+        assert resp.status == 200
+        rids.append(resp.headers["X-Request-Id"])
+    # poll until the newest trace lands in the ring
+    _trace_for(client, rids[-1])
+    status, listing = _json(client, "GET", "/trace/")
+    assert len(listing["traces"]) <= 2
+    listed = {t["request_id"] for t in listing["traces"]}
+    assert rids[-1] in listed and rids[0] not in listed
+    # evicted trace 404s with a descriptive detail
+    status, body = _json(client, "GET", f"/trace/{rids[0]}")
+    assert status == 404
+    assert "PENROZ_TRACE_BUFFER" in body["detail"]
+    # sampled out: no trace is ever recorded
+    monkeypatch.setenv(tracing.TRACE_SAMPLE_ENV, "0")
+    resp, _ = _request(client, "POST", "/generate/", json=_gen_payload())
+    assert resp.status == 200
+    status, _ = _json(client, "GET",
+                      f"/trace/{resp.headers['X-Request-Id']}")
+    assert status == 404
+
+
+def test_profiler_trace_alias_roundtrip(client, tmp_path):
+    """POST /profiler/trace/ start → stop aliases /profile/ and writes a
+    capture directory."""
+    log_dir = str(tmp_path / "prof")
+    status, _ = _json(client, "POST", "/profiler/trace/",
+                      json={"action": "start", "log_dir": log_dir})
+    assert status == 200
+    status, _ = _json(client, "POST", "/profiler/trace/",
+                      json={"action": "start", "log_dir": log_dir})
+    assert status == 409  # already capturing
+    status, _ = _json(client, "POST", "/profiler/trace/",
+                      json={"action": "stop"})
+    assert status == 200
+    assert os.path.isdir(log_dir)
+    status, _ = _json(client, "POST", "/profiler/trace/",
+                      json={"action": "stop"})
+    assert status == 409
+
+
+# ---------------------------------------------------------------------------
+# schema sync: /serving_stats/ == pydantic schema == openapi == JS fixtures
+# ---------------------------------------------------------------------------
+
+def test_serving_stats_schema_sync(client, gpt_model, monkeypatch):
+    """The three copies of the serving-stats shape (live payload, OpenAPI
+    component schema, JS dashboard fixture) can no longer drift: all key
+    sets must be identical (satellite)."""
+    from penroz_tpu.serve import openapi, schemas
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    status, _ = _json(client, "POST", "/generate/", json=_gen_payload())
+    assert status == 200
+    status, stats = _json(client, "GET", "/serving_stats/")
+    assert status == 200
+
+    agg_fields = set(schemas.ServingStatsResponse.model_fields)
+    eng_fields = set(schemas.EngineStats.model_fields)
+    assert set(stats) == agg_fields
+    assert stats["engines"] and set(stats["engines"][0]) == eng_fields
+
+    spec = openapi.build_spec()
+    assert set(spec["components"]["schemas"]["ServingStatsResponse"]
+               ["properties"]) == agg_fields
+    assert set(spec["components"]["schemas"]["EngineStats"]
+               ["properties"]) == eng_fields
+
+    fixture = json.load(open(os.path.join(HERE, "js", "fixtures",
+                                          "serving.json")))
+    assert set(fixture) == agg_fields, (
+        "tests/js/fixtures/serving.json drifted from "
+        "ServingStatsResponse — update the fixture with the schema")
+    assert set(fixture["engines"][0]) == eng_fields
+
+    tick_fields = set(schemas.TickRecord.model_fields)
+    for entry in fixture["tick_timeline"]:
+        assert set(entry) == tick_fields

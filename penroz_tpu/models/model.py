@@ -2512,7 +2512,7 @@ class NeuralNetworkModel:
 
     def decode_step_batched(self, kv, last_tokens, lengths, rng,
                             temperature=1.0, top_k=None, lora=None,
-                            row_adapter=None):
+                            row_adapter=None, dispatch=None):
         """One shared decode+sample step across every row of a persistent
         multi-row KV state — the continuous-batching hot loop: K in-flight
         requests cost one batch-K forward per token instead of K batch-1
@@ -2522,6 +2522,12 @@ class NeuralNetworkModel:
         (0 parks a free slot: its write lands at position 0 of its own row
         and is never attended); it is installed via ``with_lengths`` inside
         the jitted step, so recycled/idle rows never drift on-device.
+        With ``dispatch`` set, ``rng`` is the caller's BASE key and the
+        per-step key advance ``fold_in(rng, dispatch)`` happens inside the
+        jitted program — the caller passes the same base key every step
+        plus an integer, instead of launching a host-side fold dispatch
+        per token (``fold_in`` is bit-identical either side of the jit
+        boundary, so seeded non-greedy output is unchanged — tested).
         Returns ``((B,) int32 next tokens, advanced kv)``; greedy outputs
         per row are identical to the single-sequence path (same ragged
         decode program as ``generate_tokens_batched``).  Donates ``kv`` —
@@ -2529,12 +2535,15 @@ class NeuralNetworkModel:
         """
         greedy, temp = self._norm_temperature(temperature)
         arch = self.arch
-        key = ("sched_step", bool(greedy), top_k, self._platform)
+        fold = dispatch is not None
+        key = ("sched_step", bool(greedy), top_k, self._platform, fold)
         fn = arch._jit_cache.get(key)
         if fn is None:
             platform = self._platform
 
-            def step(p, b, kv0, tok, lens, r, tmp, lo, ai):
+            def step(p, b, kv0, tok, lens, r, d, tmp, lo, ai):
+                if fold:
+                    r = jax.random.fold_in(r, d)
                 kv1 = kv0.with_lengths(lens)
                 t, kv2 = arch._decode_step(p, b, kv1, tok, r, tmp,
                                            greedy=greedy, top_k=top_k,
@@ -2549,7 +2558,100 @@ class NeuralNetworkModel:
         with profiling.span("penroz/decode_step_batched"):
             return fn(self.params, self.buffers, kv,
                       jnp.asarray(last_tokens, jnp.int32),
-                      jnp.asarray(lengths, jnp.int32), rng, temp, lora, aidx)
+                      jnp.asarray(lengths, jnp.int32), rng,
+                      jnp.asarray(dispatch if fold else 0, jnp.int32),
+                      temp, lora, aidx)
+
+    def decode_superstep(self, kv, last_tokens, lengths, active,
+                         stop_tokens, remaining, rng, dispatch, n,
+                         temperature=1.0, top_k=None, lora=None,
+                         row_adapter=None):
+        """Run up to ``n`` shared decode+sample steps in ONE jitted
+        dispatch — a ``lax.scan`` over the exact per-step program of
+        :meth:`decode_step_batched`, so the host dispatch floor (sync
+        lengths, check stop tokens, launch again — 73–107 ms/dispatch in
+        the bench captures) is paid once per ``n`` tokens instead of once
+        per token.
+
+        The scan carry is ``(kv, last_tok, lengths, active, emitted)``:
+
+        - ``kv`` threads through the scan donated-in, so the cache
+          advances on device without host copies on all four variants
+          (fp/int8 × contiguous/paged — the paged variants walk their
+          static block-table partition with trace-static shapes exactly
+          as in the single-step program);
+        - ``lengths`` (B,) stays carry-authoritative and is re-installed
+          via ``with_lengths`` each iteration, advancing by 1 only for
+          ``active`` rows — parked/finished rows keep writing their
+          compute-but-discard K/V at the same parked position, exactly
+          like padded rows in the single-step path;
+        - ``active`` (B, bool) is the on-device stop detector: a row
+          leaves the mask when it samples its stop token, exhausts its
+          ``remaining`` token budget, or fills the cache
+          (``length == max_len``).  Finished rows keep computing and
+          discard (``where``) — the program stays trace-static;
+        - the sampling key for scan step ``i`` is
+          ``fold_in(rng, dispatch + i)`` — the identical key sequence
+          the host-folded single-step path would produce over the same
+          ``n`` dispatch ordinals, so seeded non-greedy output is
+          unchanged by fusing (tested; greedy ignores the key entirely).
+
+        ``stop_tokens`` (B,) carries -1 for rows with no stop token;
+        ``remaining`` (B,) is the per-row token budget left.  Returns
+        ``(toks (n, B) int32 with -1 at masked slots, emitted (n, B)
+        bool, final_lengths (B,), kv')`` — ONE host sync for the whole
+        block; the scheduler replays ``toks[s, i]`` where ``emitted[s,
+        i]`` through its normal per-token retirement path at the
+        superstep boundary.  Jits per (n, sampling, cache type); keep
+        ``n`` power-of-two-bucketed so the program set stays bounded.
+        Donates ``kv`` — always thread the returned state.
+        """
+        greedy, temp = self._norm_temperature(temperature)
+        arch = self.arch
+        key = ("superstep", int(n), bool(greedy), top_k, self._platform)
+        fn = arch._jit_cache.get(key)
+        if fn is None:
+            platform = self._platform
+
+            def run(p, b, kv0, tok0, len0, act0, stopt, rem, r, d0, tmp,
+                    lo, ai):
+                max_len = kv0.max_len  # static
+
+                def step(carry, i):
+                    kvc, tok, lens, act, done = carry
+                    kv1 = kvc.with_lengths(lens)
+                    r_i = jax.random.fold_in(r, d0 + i)
+                    t, kv2 = arch._decode_step(p, b, kv1, tok, r_i, tmp,
+                                               greedy=greedy, top_k=top_k,
+                                               compute_dtype=None,
+                                               platform=platform,
+                                               lora=lo, lora_idx=ai)
+                    t = t[:, 0]
+                    new_tok = jnp.where(act, t, tok[:, 0])[:, None]
+                    new_lens = lens + act.astype(lens.dtype)
+                    new_done = done + act.astype(jnp.int32)
+                    still = (act & (t != stopt) & (new_done < rem)
+                             & (new_lens < max_len))
+                    out = (jnp.where(act, t, -1), act)
+                    return (kv2, new_tok, new_lens, still, new_done), out
+
+                init = (kv0, tok0, len0, act0,
+                        jnp.zeros_like(len0))
+                (kvf, _, lensf, _, _), (toks, emitted) = jax.lax.scan(
+                    step, init, jnp.arange(n, dtype=jnp.int32))
+                return toks, emitted, lensf, kvf
+
+            fn = arch._jit_cache[key] = jax.jit(run, donate_argnums=(2,))
+        aidx = (jnp.asarray(row_adapter, jnp.int32)
+                if lora is not None else None)
+        with profiling.span("penroz/decode_superstep"):
+            return fn(self.params, self.buffers, kv,
+                      jnp.asarray(last_tokens, jnp.int32),
+                      jnp.asarray(lengths, jnp.int32),
+                      jnp.asarray(active, bool),
+                      jnp.asarray(stop_tokens, jnp.int32),
+                      jnp.asarray(remaining, jnp.int32), rng,
+                      jnp.asarray(dispatch, jnp.int32), temp, lora, aidx)
 
     def _sampling_setup(self, temperature):
         """Shared generation preamble: (greedy, temp scalar, call rng).

@@ -147,6 +147,22 @@ class KVState:
     buffer has a matching output to alias (otherwise every batched prefill
     emits "donated buffers were not usable: int32[]").  The stale scalar is
     poisoned to -1 so a direct read fails loudly; ``length`` masks it.
+
+    **Scan-carry contract** (compiled multi-step decode,
+    ``NeuralNetworkModel.decode_superstep``): every state variant is a
+    registered pytree whose children keep a fixed structure under
+    ``with_lengths`` → append → ``advanced`` cycles, so a ``lax.scan``
+    can thread the cache through N fused decode steps with the input
+    donated — each iteration re-installs the carry's (B,) lengths via
+    ``with_lengths`` (the in-scan analogue of the scheduler's
+    host-authoritative per-dispatch install), appends at trace-static
+    shapes, and the buffers alias in place across steps with zero host
+    copies.  Holds for all four variants: this class (fp contiguous),
+    :class:`QuantKVState` (int8 quantize-on-append), and the paged pair,
+    whose appends walk a STATIC block-table partition
+    (``with_static_table`` pins ``assigned_pages``, so the in-jit bump
+    allocator is a no-op inside the scan and the carried counters stay
+    constant).
     """
 
     quantized = False

@@ -32,10 +32,21 @@ rows are KV-cache slots (paged pool pages when ``PAGED_KV_CACHE=1``):
   (``KVState.rollback_row``); rows with no draft share one plain batched
   step as before, so acceptance is ragged per row and a predictable row
   can emit up to ``PENROZ_SPEC_K + 1`` tokens per decode step;
+- with ``PENROZ_SCHED_SUPERSTEP`` > 1 (default 8, **compiled multi-step
+  decode**), a tick with no pending prefill chunks, no queued admissions
+  and no spec-decode drafts fuses up to that many decode steps into ONE
+  jitted ``lax.scan`` dispatch (``NeuralNetworkModel.decode_superstep``):
+  sampling, RNG-key folding, length advance and stop-token/budget
+  detection all run on device behind a per-row active mask (finished
+  rows compute-but-discard, like padded rows), and the host surfaces
+  once per block to stream the emitted tokens, admit newcomers, and
+  check deadlines/cancellation — which are therefore observed up to N
+  tokens late (the documented granularity trade);
 - greedy outputs are token-identical to the single-sequence path with the
-  prefix cache hitting, missing, or off, and with chunked or one-shot
-  prefill (tested — the chunked program family is the same
-  cached-attention path, reading the same absolute positions);
+  prefix cache hitting, missing, or off, with chunked or one-shot
+  prefill, and under any superstep size (tested — the chunked program
+  family is the same cached-attention path, reading the same absolute
+  positions, and each fused step is the identical per-step program);
 - with LoRA adapters registered (``serve/adapters.py``), requests carrying
   an ``adapter_id`` bind to one of ``PENROZ_LORA_MAX_LIVE`` live slots per
   engine: the slots' low-rank factors stack into static ``[L+1, R, ·]``
@@ -90,7 +101,8 @@ Knobs: ``PENROZ_SCHED_MAX_ROWS`` (decode batch capacity, default 8),
 ``PENROZ_PREFILL_CHUNK`` / ``PENROZ_SCHED_MAX_STALL_MS`` /
 ``PENROZ_PREFIX_CACHE`` / ``PENROZ_PREFIX_CACHE_PAGES`` (above),
 ``PENROZ_SPEC_DECODE`` / ``PENROZ_SPEC_K`` / ``PENROZ_SPEC_NGRAM``
-(serve/spec_decode.py).
+(serve/spec_decode.py), ``PENROZ_SCHED_SUPERSTEP`` (fused decode steps
+per dispatch, above).
 Observability: ``serving_stats()`` backs ``GET /serving_stats/`` — queue
 depth, batch occupancy, decode tokens/sec, admission latency, prefill
 chunk-stall p99, prefix-cache hit rate/evictions, speculative-decoding
@@ -140,6 +152,7 @@ FALLBACK_ENV = "PENROZ_SCHED_FALLBACK"
 BREAKER_COOLDOWN_ENV = "PENROZ_BREAKER_COOLDOWN_MS"
 DRAIN_S_ENV = "PENROZ_DRAIN_S"
 TICK_TIMELINE_ENV = "PENROZ_TICK_TIMELINE"
+SUPERSTEP_ENV = "PENROZ_SCHED_SUPERSTEP"
 
 # Max tick-timeline entries served per /serving_stats/ payload (the ring
 # itself holds PENROZ_TICK_TIMELINE entries).
@@ -234,6 +247,12 @@ def _drain_s() -> float:
 
 def _tick_timeline_len() -> int:
     return _env_int(TICK_TIMELINE_ENV, 256)
+
+
+def _superstep_max() -> int:
+    """Decode steps fused per dispatch (compiled multi-step decode).
+    1 restores the legacy one-dispatch-per-token tick loop."""
+    return _env_int(SUPERSTEP_ENV, 8)
 
 
 def _effective_timeout_ms(timeout_ms) -> float | None:
@@ -388,6 +407,10 @@ class DecodeEngine:
 
         self._rng = jax.random.key(0)
         self._dispatch = 0
+        # Worker-loop iteration count: an idle engine's loop is parked on
+        # the condition variable, so this must not advance while idle
+        # (the idle-spin regression test reads it).
+        self._loops = 0
 
         # metrics (ints/floats written only by the worker thread; readers
         # tolerate torn-but-valid snapshots)
@@ -417,6 +440,15 @@ class DecodeEngine:
         self._h_chunk_stall = metrics_util.Hist()
         self._h_itl = metrics_util.Hist()
         self._h_tick = metrics_util.Hist()
+        # Compiled multi-step decode accounting: one "dispatch" is one
+        # device round trip of the decode path (shared step, verify step,
+        # or fused superstep) — tokens_per_dispatch ≈ PENROZ_SCHED_SUPERSTEP
+        # for unconstrained fused decode is the feature's acceptance shape
+        # (distinct from tokens_per_decode_step, which measures what
+        # SPECULATION buys per logical step).
+        self._dispatches = 0
+        self._h_tokens_per_dispatch = metrics_util.Hist(
+            metrics_util.TOKENS_PER_DISPATCH_BUCKETS)
         # Tick-level telemetry ring: per-tick phase composition (prefill
         # chunks / verify rows / shared-step rows), batch occupancy, and
         # dispatch wall time — the dashboard occupancy/latency strip.
@@ -579,6 +611,7 @@ class DecodeEngine:
         active = self.active_rows
         stall_p99 = self._h_chunk_stall.quantile(0.99)
         queue_wait_p99 = self._h_queue_wait.quantile(0.99)
+        tpd = self._h_tokens_per_dispatch.snapshot()
         # newest-first tail of the ring (age_s ≈ 0 leads)
         timeline = list(self._tick_timeline)[-_TIMELINE_SERVE:][::-1]
         return {
@@ -588,7 +621,14 @@ class DecodeEngine:
                 "queue_wait_ms": self._h_queue_wait.snapshot(),
                 "chunk_stall_ms": self._h_chunk_stall.snapshot(),
                 "tick_ms": self._h_tick.snapshot(),
+                "tokens_per_dispatch": tpd,
             },
+            "superstep": _superstep_max(),
+            "dispatches_total": self._dispatches,
+            "tokens_per_dispatch_avg": (round(tpd["sum"] / tpd["count"], 3)
+                                        if tpd["count"] else None),
+            "tokens_per_dispatch_p50": self._round_q(
+                self._h_tokens_per_dispatch, 0.5),
             "ttft_ms_p99": self._round_q(self._h_ttft, 0.99),
             "itl_ms_p50": self._round_q(self._h_itl, 0.5),
             "itl_ms_p99": self._round_q(self._h_itl, 0.99),
@@ -655,9 +695,14 @@ class DecodeEngine:
             with self._cond:
                 while (not self._shutdown and not self._pending
                        and self.active_rows == 0):
-                    self._cond.wait(timeout=1.0)
+                    # Untimed wait: every state change the predicate reads
+                    # notifies (submit, shutdown, drain), so an idle engine
+                    # parks on the condition variable and burns zero CPU —
+                    # no periodic wake, no empty ticks (tested).
+                    self._cond.wait()
                 if self._shutdown:
                     break
+            self._loops += 1
             try:
                 self._purge_expired()
                 self._coalesce_burst()
@@ -697,23 +742,32 @@ class DecodeEngine:
         self._fail_all(RuntimeError("decode engine shut down"))
 
     def _tick(self):
-        """One scheduler tick: interleaved prefill chunks, then the shared
-        decode step — instrumented as a unit (dispatch wall time, phase
-        composition, occupancy) into the tick timeline, the tick-duration
-        histogram, and a profiler span, so both a Perfetto capture and the
-        dashboard strip show what the loop actually did between dispatches.
+        """One scheduler tick: interleaved prefill chunks, then the decode
+        dispatch — either the legacy verify+shared single step or ONE fused
+        ``PENROZ_SCHED_SUPERSTEP``-step program (``_plan_superstep``
+        decides) — instrumented as a unit (dispatch wall time, phase
+        composition, occupancy, fused step count) into the tick timeline,
+        the tick-duration histogram, and a profiler span, so both a
+        Perfetto capture and the dashboard strip show what the loop
+        actually did between dispatches.
         """
         prefilling = self._next_prefill_row() is not None
         decoding = bool(self._decoding_rows())
         if not prefilling and not decoding:
             return
         chunks0 = self._prefill_chunks
-        verify_rows = shared_rows = emitted = 0
+        verify_rows = shared_rows = emitted = steps = 0
         t0 = time.monotonic()
         with profiling.span("penroz/sched_tick"):
             self._prefill_tick()
             if self._decoding_rows():
-                verify_rows, shared_rows, emitted = self._step()
+                n = self._plan_superstep()
+                if n > 1:
+                    shared_rows, emitted = self._superstep(n)
+                    steps = n
+                else:
+                    verify_rows, shared_rows, emitted = self._step()
+                    steps = 1
         dur_ms = (time.monotonic() - t0) * 1000.0
         self._h_tick.observe(dur_ms)
         serve_metrics.TICK_MS.observe(dur_ms)
@@ -725,6 +779,7 @@ class DecodeEngine:
             "verify_rows": verify_rows,
             "shared_rows": shared_rows,
             "emitted": emitted,
+            "superstep": steps,
         })
 
     def _record_crash(self):
@@ -1091,15 +1146,21 @@ class DecodeEngine:
 
     def _shared_step(self, rows: list[int]) -> int:
         """The pre-speculation hot loop: one batched decode+sample step
-        across every row, emitting for ``rows``.  Returns tokens emitted."""
-        rng = jax.random.fold_in(self._rng, self._dispatch)
+        across every row, emitting for ``rows``.  Returns tokens emitted.
+
+        The sampler key advance (``fold_in(rng, dispatch)``) happens
+        INSIDE the jitted step — the host passes the unchanged base key
+        plus the dispatch ordinal instead of launching a fold dispatch
+        per token (bit-identical key, so seeded non-greedy output is
+        unchanged — tested)."""
+        dispatch = self._dispatch
         self._dispatch += 1
         t0 = time.monotonic()
         with model_mod.decode_priority(), profiling.span("penroz/sched_step"):
             toks, self._kv = self._model.decode_step_batched(
-                self._kv, self._last_tok[:, None], self._lengths, rng,
+                self._kv, self._last_tok[:, None], self._lengths, self._rng,
                 self.temperature, self.top_k, lora=self._lora_pack,
-                row_adapter=self._row_adapter)
+                row_adapter=self._row_adapter, dispatch=dispatch)
             arr = np.asarray(toks)
         t1 = time.monotonic()
         emitted = 0
@@ -1114,7 +1175,149 @@ class DecodeEngine:
             self._last_tok[i] = tok
             emitted += 1
             self._emit_token(i, state, tok)
+        self._record_dispatch(emitted)
         return emitted
+
+    # -- compiled multi-step decode (PENROZ_SCHED_SUPERSTEP) -----------------
+
+    def _record_dispatch(self, emitted: int):
+        """One decode-path device round trip (shared step / verify step /
+        fused superstep) and the tokens it emitted."""
+        self._dispatches += 1
+        self._h_tokens_per_dispatch.observe(float(emitted))
+        serve_metrics.DISPATCHES.inc()
+        serve_metrics.TOKENS_PER_DISPATCH.observe(float(emitted))
+
+    def _plan_superstep(self) -> int:
+        """Fused decode steps for this tick's dispatch.
+
+        Superstep > 1 only when the host provably has nothing to do at the
+        intermediate step boundaries it would skip: no prefilling rows
+        (chunk interleaving is a per-boundary stall contract), no queued
+        admissions (a newcomer must not wait N tokens for a free slot it
+        could take now), and no spec-decode drafts (verify is a per-row
+        multi-token program with its own dispatch and rollback).  Any of
+        those fall back to the legacy n=1 tick, so PR 2/4 interleaving
+        semantics are preserved verbatim.  Deadlines/cancellation do NOT
+        force n=1 — they are observed at the superstep boundary, up to N
+        tokens late (the documented PENROZ_SCHED_SUPERSTEP granularity
+        trade).  The env value is clamped to the largest per-row token
+        need and bucketed down to a power of two, so the compiled program
+        set stays {2^k ≤ PENROZ_SCHED_SUPERSTEP}."""
+        n = _superstep_max()
+        if n <= 1:
+            return 1
+        if self._next_prefill_row() is not None:
+            return 1
+        with self._cond:
+            if self._pending:
+                return 1
+        rows = self._decoding_rows()
+        if self._spec_on() and self._plan_drafts(rows):
+            return 1
+        need = 1
+        for i in rows:
+            state = self._rows[i]
+            need = max(need,
+                       min(state.req.max_new_tokens - state.produced,
+                           self.block_size - int(self._lengths[i])))
+        n = max(min(n, need), 1)
+        return 1 << (n.bit_length() - 1)
+
+    def _superstep(self, n: int) -> tuple[int, int]:
+        """Dispatch ONE fused n-step decode program
+        (``NeuralNetworkModel.decode_superstep``) and replay its token
+        block through the normal per-token retirement path at the
+        boundary.
+
+        On-device, each fused step samples per row, folds the RNG key,
+        advances only active rows' lengths, and drops rows from the
+        active mask on stop-token / budget / cache-full — finished rows
+        compute-but-discard, exactly like parked padded rows.  The host
+        syncs ONCE per block: it replays ``(toks, emit)`` step-major
+        through ``_emit_token``, whose stop/max bookkeeping retires each
+        row on exactly the token the device mask stopped at (host and
+        device run the same update rule on the same inputs).  Host-only
+        terminal conditions — deadline expiry, client cancellation — are
+        observed here at the boundary, so a row can overshoot its
+        deadline by up to n tokens of device work (never by delivered
+        tokens: ``_emit_token`` retires on the first replayed token once
+        expired).  Counts as n decode steps (``tokens_per_decode_step``
+        keeps measuring speculation, not fusing) and ONE dispatch
+        (``tokens_per_dispatch`` ≈ n is this feature's win).  Returns
+        ``(rows_in_step, tokens_emitted)``.
+        """
+        faults.check("decode.step")
+        t0 = time.monotonic()
+        self._max_chunks_between_steps = max(
+            self._max_chunks_between_steps, self._chunks_between_steps)
+        self._chunks_between_steps = 0
+        rows = self._decoding_rows()
+        states = {i: self._rows[i] for i in rows}
+        active = np.zeros(self.capacity, bool)
+        stop = np.full(self.capacity, -1, np.int32)
+        remaining = np.zeros(self.capacity, np.int32)
+        for i in rows:
+            req = states[i].req
+            active[i] = True
+            stop[i] = -1 if req.stop_token is None else int(req.stop_token)
+            remaining[i] = req.max_new_tokens - states[i].produced
+        dispatch = self._dispatch
+        # n dispatch ordinals, one per fused step: the key sequence is
+        # identical to n single-step dispatches, so greedy AND seeded
+        # non-greedy outputs are invariant under the superstep size.
+        self._dispatch += n
+        with model_mod.decode_priority(), \
+                profiling.span("penroz/sched_superstep"):
+            toks, emit, lens, self._kv = self._model.decode_superstep(
+                self._kv, self._last_tok[:, None], self._lengths, active,
+                stop, remaining, self._rng, dispatch, n,
+                self.temperature, self.top_k, lora=self._lora_pack,
+                row_adapter=self._row_adapter)
+            toks = np.asarray(toks)
+            emit = np.asarray(emit)
+        t1 = time.monotonic()
+        for i in rows:
+            state = states[i]
+            if state.req.trace is not None:
+                sp = state.req.trace.span("decode_step", t0=t0,
+                                          parent=state.sp_decode,
+                                          superstep=n)
+                state.req.trace.end(sp, t1=t1)
+        emitted = 0
+        for s in range(n):
+            for i in rows:
+                # A row the host retired mid-replay (stop/max on an earlier
+                # token, deadline, cancel) is skipped for the rest of the
+                # block — `is not states[i]` covers retirement AND slot
+                # recycling.
+                if not emit[s, i] or self._rows[i] is not states[i]:
+                    continue
+                self._lengths[i] += 1
+                tok = int(toks[s, i])
+                self._last_tok[i] = tok
+                emitted += 1
+                self._emit_token(i, states[i], tok)
+        # Surviving rows' host lengths must agree with the device scan's —
+        # drift here means the emit mask and KV write positions diverged.
+        lens = np.asarray(lens)
+        for i in rows:
+            if self._rows[i] is states[i]:
+                assert int(self._lengths[i]) == int(lens[i]), (
+                    f"superstep length drift on row {i}: host "
+                    f"{int(self._lengths[i])} != device {int(lens[i])}")
+        now = time.monotonic()
+        self._decode_steps += n
+        self._decode_tokens += emitted
+        serve_metrics.DECODE_TOKENS.inc(emitted)
+        self._decode_time_s += now - t0
+        self._occupancy_sum += n * len(rows) / self.capacity
+        self._token_window.append((now, emitted))
+        while (self._token_window
+               and now - self._token_window[0][0] > _TPS_WINDOW_S):
+            self._token_window.popleft()
+        self._record_dispatch(emitted)
+        return len(rows), emitted
 
     # -- speculative decoding (PENROZ_SPEC_DECODE=1) -------------------------
 
@@ -1193,6 +1396,7 @@ class DecodeEngine:
                 break   # retired mid-acceptance (stop token / budget /
                 # deadline / cancel): the remaining accepted tokens are
                 # discarded, matching the plain path's stop exactly.
+        self._record_dispatch(emitted)
         return emitted
 
     def _emit_token(self, row: int, state: _Row, tok: int):
@@ -1495,6 +1699,8 @@ def serving_stats() -> dict:
     spec_accepted = sum(p["spec_accepted_tokens"] for p in per)
     decode_steps = sum(p["decode_steps"] for p in per)
     decode_tokens = sum(p["decode_tokens"] for p in per)
+    tpd = metrics_util.merge_snapshots(
+        [p["histograms"]["tokens_per_dispatch"] for p in per])
     adapter_tokens: dict = {}
     for p in per:
         for aid, n in p["lora_adapter_tokens"].items():
@@ -1535,6 +1741,11 @@ def serving_stats() -> dict:
         "spec_accept_rate": stats_util.rate(spec_accepted, spec_drafted),
         "tokens_per_decode_step": round(
             stats_util.rate(decode_tokens, decode_steps) or 0.0, 3),
+        "dispatches_total": sum(p["dispatches_total"] for p in per),
+        "tokens_per_dispatch_avg": (round(tpd["sum"] / tpd["count"], 3)
+                                    if tpd["count"] else None),
+        "tokens_per_dispatch_p50": _merged_q(per, "tokens_per_dispatch",
+                                             0.5),
         "kv_pool_capacity_drops": KV.pool_drop_count(),
     }
 

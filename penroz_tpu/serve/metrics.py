@@ -17,7 +17,8 @@ counters   requests_total{outcome}, decode_tokens_total,
            engine_crashes_total, engine_resets_total,
            spec_drafted_tokens_total, spec_accepted_tokens_total,
            prefix_cache_hits_total, prefix_cache_misses_total,
-           lora_adapter_tokens_total{adapter_id}, traces_completed_total
+           lora_adapter_tokens_total{adapter_id}, traces_completed_total,
+           dispatches_total
 gauges     engines, active_rows, queue_depth, batch_occupancy,
            breaker_open, draining, lora_live_adapters,
            kv_pool_capacity_drops (monotonic in practice, exposed as a
@@ -25,7 +26,8 @@ gauges     engines, active_rows, queue_depth, batch_occupancy,
 histograms ttft_ms, itl_ms, queue_wait_ms, chunk_stall_ms, tick_ms
            (fixed LATENCY_BUCKETS_MS buckets; cumulative ``_bucket``
            series sum to ``_count`` — asserted by the strict-format
-           parser test)
+           parser test) and tokens_per_dispatch (token-count buckets —
+           the compiled multi-step decode headline)
 """
 
 from __future__ import annotations
@@ -77,6 +79,11 @@ LORA_TOKENS = REGISTRY.register(m.Counter(
 TRACES_COMPLETED = REGISTRY.register(m.Counter(
     "penroz_traces_completed_total",
     "Request traces finished into the /trace/ ring"))
+DISPATCHES = REGISTRY.register(m.Counter(
+    "penroz_dispatches_total",
+    "Decode dispatches (shared steps, spec-decode verify steps, fused "
+    "supersteps) — the host round-trip count the multi-step decode path "
+    "exists to shrink"))
 
 # -- histograms (engine observes the global mirror alongside its own) -------
 
@@ -91,6 +98,12 @@ CHUNK_STALL_MS = REGISTRY.register(m.Histogram(
     "Decode-batch stall injected per step boundary by prefill chunks, ms"))
 TICK_MS = REGISTRY.register(m.Histogram(
     "penroz_tick_ms", "Scheduler tick dispatch wall time, ms"))
+TOKENS_PER_DISPATCH = REGISTRY.register(m.Histogram(
+    "penroz_tokens_per_dispatch",
+    "Tokens emitted per decode dispatch (≈ PENROZ_SCHED_SUPERSTEP for "
+    "unconstrained fused decode, 1 on the per-token path; distinct from "
+    "tokens_per_decode_step, which measures speculation not fusing)",
+    buckets=m.TOKENS_PER_DISPATCH_BUCKETS))
 
 # -- gauges (scrape-time reads of live state) -------------------------------
 

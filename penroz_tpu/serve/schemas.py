@@ -191,6 +191,10 @@ class TickRecord(BaseModel):
     shared_rows: int = Field(0, description="Rows in the plain shared "
                              "batched step")
     emitted: int = Field(0, description="Tokens emitted this tick")
+    superstep: int = Field(0, description="Decode steps fused into this "
+                           "tick's dispatch (PENROZ_SCHED_SUPERSTEP path; "
+                           "1 = legacy single step, 0 = no decode dispatch "
+                           "ran this tick)")
 
 
 class EngineStats(BaseModel):
@@ -274,7 +278,24 @@ class EngineStats(BaseModel):
     tokens_per_decode_step: float = Field(
         0.0, description="decode_tokens / decode_steps — >1 per active "
         "row means speculation is paying (a plain step emits exactly one "
-        "token per decoding row)")
+        "token per decoding row; a fused superstep counts as N steps, so "
+        "this stays a speculation metric)")
+    superstep: int = Field(1, description="Configured "
+                           "PENROZ_SCHED_SUPERSTEP — max decode steps "
+                           "fused per dispatch (1 = legacy per-token "
+                           "dispatch loop)")
+    dispatches_total: int = Field(0, description="Decode-path device "
+                                  "round trips (shared steps + verify "
+                                  "steps + fused supersteps) — what the "
+                                  "compiled multi-step decode path "
+                                  "shrinks per token")
+    tokens_per_dispatch_avg: Optional[float] = Field(
+        None, description="Mean tokens emitted per decode dispatch "
+        "(histogram-backed; ≈ superstep for unconstrained fused decode, "
+        "1.0 on the legacy path — distinct from tokens_per_decode_step, "
+        "which measures speculation not fusing)")
+    tokens_per_dispatch_p50: Optional[float] = Field(
+        None, description="Median tokens emitted per decode dispatch")
     ttft_ms_p99: Optional[float] = Field(
         None, description="p99 enqueue → first token (histogram-derived, "
         "like every percentile here — never a truncated-sample p99)")
@@ -361,6 +382,16 @@ class ServingStatsResponse(BaseModel):
         "draft)")
     tokens_per_decode_step: float = Field(
         0.0, description="Aggregate decode_tokens / decode_steps across "
+        "engines")
+    dispatches_total: int = Field(0, description="Aggregate decode-path "
+                                  "device round trips (shared + verify + "
+                                  "superstep dispatches)")
+    tokens_per_dispatch_avg: Optional[float] = Field(
+        None, description="Mean tokens per decode dispatch across engines "
+        "(merged histogram; ≈ PENROZ_SCHED_SUPERSTEP for unconstrained "
+        "fused decode)")
+    tokens_per_dispatch_p50: Optional[float] = Field(
+        None, description="Median tokens per decode dispatch across "
         "engines")
     kv_pool_capacity_drops: int = Field(..., description="KV writes dropped "
                                         "at pool capacity (process-wide; "

@@ -243,6 +243,15 @@ function renderServing(data) {
     : `spec accept ${acceptRate == null ? "—"
          : (acceptRate * 100).toFixed(0) + "%"} · ` +
       `${(data.tokens_per_decode_step || 0).toFixed(2)} tok/step`;
+  /* Compiled multi-step decode (PENROZ_SCHED_SUPERSTEP): tokens emitted
+   * per device dispatch — ≈ the superstep size when fused decode runs
+   * unconstrained, 1.0 on the legacy per-token dispatch loop (null-safe:
+   * no value until the first decode dispatch). */
+  const tpd = data.tokens_per_dispatch_avg;
+  const multistepTxt = tpd == null
+    ? `${data.dispatches_total || 0} dispatches`
+    : `${tpd.toFixed(2)} tok/dispatch (${data.dispatches_total || 0} ` +
+      `dispatches)`;
   /* Fault-tolerance readouts (PR 3): shed/timeout counters and the engine
    * circuit breaker — an open breaker is the "stop paging the dashboard,
    * the engine is crash-looping" signal. */
@@ -267,6 +276,7 @@ function renderServing(data) {
     `${data.admission_latency_ms_p50 == null ? "—"
        : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
+    `${multistepTxt} · ` +
     `${specTxt} · ${loraTxt} · ${prefixTxt} · KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();

@@ -35,6 +35,13 @@ LATENCY_BUCKETS_MS = (
     100.0, 150.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
     30000.0)
 
+# Buckets for token-count-per-dispatch distributions (compiled multi-step
+# decode): small integers, dense through the PENROZ_SCHED_SUPERSTEP range —
+# a 1-token bucket distinguishes the legacy per-token path from any fusing
+# at all, and the tail covers superstep × spec-decode composition headroom.
+TOKENS_PER_DISPATCH_BUCKETS = (
+    1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
+
 
 class Hist:
     """Fixed-bucket histogram data: cumulative-friendly counts, sum,

@@ -652,6 +652,119 @@ async def _bench_multi_adapter() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --multistep: compiled multi-step decode (tokens/dispatch, ITL)
+# ---------------------------------------------------------------------------
+
+async def _bench_multistep() -> dict:
+    """Compiled multi-step decode workload (PENROZ_SCHED_SUPERSTEP):
+    sequential single-row streaming requests — the regime where the
+    per-dispatch host floor is 100% of inter-token latency overhead —
+    measured with the superstep at 1 (legacy per-token dispatch loop)
+    then 4 and 8.  Reports per-phase **mean ITL** (first→last token wall
+    over tokens-1: with fused decode, tokens arrive in blocks of N, so
+    gap percentiles are bimodal by design — the mean is the honest
+    per-token cost), gap p50/p99 for visibility, and the headline
+    **tokens per dispatch** (≈ superstep for unconstrained decode) plus
+    ``dispatches_total`` from /serving_stats/.  Greedy parity is asserted
+    across every phase — fusing N steps into one program must never
+    change a token.  Scale knobs: ``PENROZ_BENCH_SERVING_BLOCK/_D/
+    _DEPTH``, ``PENROZ_BENCH_REQUESTS``, ``PENROZ_BENCH_MAX_NEW``,
+    ``PENROZ_BENCH_MULTISTEP_PROMPT``."""
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 256)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 128)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 2)
+    requests = _env_i("PENROZ_BENCH_REQUESTS", 4)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 64)
+    prompt_len = _env_i("PENROZ_BENCH_MULTISTEP_PROMPT", 16)
+    vocab = 256
+    assert prompt_len + max_new <= block
+
+    env = {decode_scheduler.ENABLE_ENV: "1"}
+    saved = {k: os.environ.get(k)
+             for k in (*env, decode_scheduler.SUPERSTEP_ENV)}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+               for _ in range(requests)]
+    warm = [int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+
+    def payload(prompt):
+        return {"model_id": "bench-multistep", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-multistep",
+            "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
+
+        results: dict = {
+            "mode": "multistep", "block_size": block,
+            "prompt_len": prompt_len, "requests": requests,
+            "max_new_tokens": max_new, "model_d": d, "model_depth": depth,
+        }
+        sequences = {}
+        for phase, superstep in (("off", 1), ("on4", 4), ("on8", 8)):
+            os.environ[decode_scheduler.SUPERSTEP_ENV] = str(superstep)
+            decode_scheduler.reset()  # fresh engine (+ counters) per phase
+            # Warm with a distinct prompt: compiles the chunk programs and
+            # this phase's superstep program so the timed requests measure
+            # serving, not XLA.
+            await _stream_one(client, payload(warm))
+            gaps_all, means, seqs = [], [], []
+            for prompt in prompts:
+                toks, _, gaps = await _stream_one(client, payload(prompt))
+                gaps_all.extend(gaps)
+                if gaps:
+                    means.append(sum(gaps) / len(gaps))
+                seqs.append(toks)
+            sequences[phase] = seqs
+            resp = await client.get("/serving_stats/")
+            stats = await resp.json()
+            results[f"superstep_{phase}"] = {
+                "superstep": superstep,
+                "itl_ms_mean": (round(sum(means) / len(means), 3)
+                                if means else None),
+                "itl_gap_ms_p50": (round(_pct(gaps_all, 0.5), 3)
+                                   if gaps_all else None),
+                "itl_gap_ms_p99": (round(_pct(gaps_all, 0.99), 3)
+                                   if gaps_all else None),
+                "dispatches_total": stats["dispatches_total"],
+                "tokens_per_dispatch_avg": stats["tokens_per_dispatch_avg"],
+                "tokens_per_decode_step": stats["tokens_per_decode_step"],
+            }
+        results["parity_ok"] = (sequences["off"] == sequences["on4"]
+                                == sequences["on8"])
+        off_itl = results["superstep_off"]["itl_ms_mean"]
+        for phase in ("on4", "on8"):
+            on_itl = results[f"superstep_{phase}"]["itl_ms_mean"]
+            results[f"itl_mean_speedup_{phase}_vs_off"] = (
+                round(off_itl / on_itl, 3) if off_itl and on_itl else None)
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
 # --speculative: prompt-lookup draft + multi-token verify (tokens/step)
 # ---------------------------------------------------------------------------
 
@@ -773,11 +886,12 @@ def _emit(results: dict):
 def main():
     args = [a for a in sys.argv[1:]
             if a not in ("--shared-prefix", "--overload", "--speculative",
-                         "--multi-adapter")]
+                         "--multi-adapter", "--multistep")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     speculative = "--speculative" in sys.argv[1:]
     multi_adapter = "--multi-adapter" in sys.argv[1:]
+    multistep = "--multistep" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
@@ -802,6 +916,9 @@ def main():
         return
     if multi_adapter:
         _emit(asyncio.run(_bench_multi_adapter()))
+        return
+    if multistep:
+        _emit(asyncio.run(_bench_multistep()))
         return
     concurrency = int(args[0]) if len(args) > 0 else 8
     max_new = int(args[1]) if len(args) > 1 else 48

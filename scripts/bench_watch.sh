@@ -86,6 +86,19 @@ while true; do
           -- "BENCH_SPEC_DECODE_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) speculative capture committed" >> logs/bench_watch.log
     fi
+    # Compiled multi-step decode capture (same shape as the shared-prefix
+    # hook): single-row mean ITL + tokens/dispatch at superstep 1 vs 4 vs 8
+    # with greedy parity.  Opt-in; failures must not block the main capture.
+    if [ "${PENROZ_WATCH_MULTISTEP:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_MULTISTEP_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --multistep \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_MULTISTEP_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: multi-step decode capture" \
+          -- "BENCH_MULTISTEP_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) multi-step capture committed" >> logs/bench_watch.log
+    fi
     # Multi-tenant LoRA capture (same shape as the shared-prefix hook):
     # mixed-adapter ITL/wall vs per-adapter serial groups + parity.
     # Opt-in; failures must not block the main capture.

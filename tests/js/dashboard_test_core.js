@@ -182,6 +182,11 @@ async function runDashboardTests(src, fixtures) {
                " tok/step"),
              "serving tile shows tokens per decode step");
     assertOk(servingMeta.includes(
+               fixtures.serving.tokens_per_dispatch_avg.toFixed(2) +
+               " tok/dispatch (" +
+               fixtures.serving.dispatches_total + " dispatches)"),
+             "serving tile shows tokens per dispatch (multi-step decode)");
+    assertOk(servingMeta.includes(
                `lora ${fixtures.serving.lora_active_adapters} adapters · ` +
                `${fixtures.serving.lora_rows} rows`),
              "serving tile shows live LoRA adapters and bound rows");

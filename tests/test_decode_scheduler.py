@@ -595,6 +595,11 @@ def test_deadline_expires_in_flight_retires_at_boundary(gpt_model,
     prompt = [1, 2, 3]
     base = gpt_model.generate_tokens([prompt], BLOCK, 4, temperature=0.0)
     monkeypatch.setenv(faults.ENV, "decode.step:sleep@100")
+    # Per-token deadline granularity is the n=1 contract: with supersteps
+    # the sleep fires once per fused dispatch and the deadline is only
+    # observed at block boundaries (covered by the dedicated superstep
+    # deadline test below).
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "1")
     engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
     c = _submit(engine, prompt, 50, timeout_ms=350)
     with pytest.raises(decode_scheduler.DeadlineExceeded) as exc:
@@ -789,6 +794,9 @@ def test_http_deadline_504_queued_and_inflight(client, gpt_model,
     monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
     monkeypatch.setenv(decode_scheduler.MAX_ROWS_ENV, "1")
     monkeypatch.setenv(faults.ENV, "decode.step:sleep@80")
+    # Per-token deadline granularity is the n=1 contract (see the
+    # superstep deadline test for the boundary-granularity behavior).
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "1")
     test_client, loop = client
 
     async def go():
@@ -825,9 +833,13 @@ def test_http_stream_deadline_emits_timeout_line(client, gpt_model,
                                                  monkeypatch):
     """A streaming request whose deadline expires mid-flight delivers the
     tokens produced so far, then a literal 'timeout' line, then ends."""
+    from penroz_tpu.serve import decode_scheduler
     from penroz_tpu.utils import faults
     monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
     monkeypatch.setenv(faults.ENV, "decode.step:sleep@100")
+    # Per-token deadline granularity is the n=1 contract (see the
+    # superstep deadline test for the boundary-granularity behavior).
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "1")
     test_client, loop = client
 
     async def go():
@@ -1154,3 +1166,298 @@ def test_spec_http_serving_stats_and_streaming(client, gpt_model,
     engine = stats["engines"][0]
     assert engine["spec_decode"] is True
     assert "spec_accept_rate" in engine
+
+
+# -- compiled multi-step decode: fused supersteps (PENROZ_SCHED_SUPERSTEP) ---
+
+
+def _settled_stats(engine, timeout=30):
+    """Engine stats once the worker loop has finished the tick that
+    retired the last request: the 'done' event is delivered from inside
+    the emit loop, BEFORE the tick's counter/timeline updates, so a
+    reader racing the worker can see the pre-tick totals."""
+    deadline = time.monotonic() + timeout
+    stats = engine.stats()
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        nxt = engine.stats()
+        if (engine.idle()
+                and nxt["decode_tokens"] == stats["decode_tokens"]
+                and nxt["dispatches_total"] == stats["dispatches_total"]
+                and len(nxt["tick_timeline"]) == len(stats["tick_timeline"])):
+            return nxt
+        stats = nxt
+    return stats
+
+
+@pytest.mark.parametrize("superstep", [1, 4, 8])
+@pytest.mark.parametrize("paged_prefix,int8,chunk", [
+    (0, 0, "16"), (1, 0, "2"), (0, 1, "16"), (1, 1, "2")],
+    ids=["fp-contig", "paged-prefix-chunked", "int8-contig",
+         "int8-paged-prefix-chunked"])
+def test_superstep_parity_matrix(gpt_model, make_engine, monkeypatch,
+                                 superstep, paged_prefix, int8, chunk):
+    """THE multi-step acceptance matrix: greedy outputs are
+    token-identical across superstep ∈ {1, 4, 8} × prefix-cache on/off ×
+    int8 KV on/off (all four cache variants) × chunked/one-shot prefill
+    — two overlapping rows with different budgets, so rows provably
+    finish (and keep compute-but-discarding) mid-block, plus a second
+    wave for real prefix-cache hits in the 'on' combos."""
+    from penroz_tpu.serve import decode_scheduler
+    if paged_prefix:
+        monkeypatch.setenv("PAGED_KV_CACHE", "1")
+        monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    if int8:
+        monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", chunk)
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, str(superstep))
+    pa, pb = [1, 2, 3, 4, 5, 6, 7, 8], [5]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 6, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 9, temperature=0.0)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    ca, cb = _submit(engine, pa, 6), _submit(engine, pb, 9)
+    assert ca.result() == base_a
+    assert cb.result() == base_b
+    # second wave: prefix-cache hit (when on) feeding straight into a
+    # fused block
+    assert _submit(engine, pa, 6).result() == base_a
+    stats = _settled_stats(engine)
+    assert stats["superstep"] == superstep
+    assert stats["dispatches_total"] > 0
+    if superstep > 1:
+        # at least one dispatch actually fused >1 steps
+        assert any(e["superstep"] > 1 for e in stats["tick_timeline"])
+        assert stats["tokens_per_dispatch_avg"] > 1.0
+    # fusing must not inflate the SPECULATION metric: a superstep counts
+    # as N decode steps, so tokens/step stays bounded by the row count
+    assert 1.0 <= stats["tokens_per_decode_step"] <= 2.0
+
+
+def test_superstep_stop_token_detected_on_device(gpt_model, make_engine,
+                                                 monkeypatch):
+    """A stop token sampled mid-block deactivates the row ON DEVICE: the
+    stream truncates exactly where the legacy per-token path stops
+    (stop token delivered, nothing after it), and the row's slot
+    recycles for the next request."""
+    from penroz_tpu.serve import decode_scheduler
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "8")
+    prompt = [1, 2, 3]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 12, temperature=0.0)
+    stop = base[len(prompt) + 4]          # sampled mid-superstep
+    base_stop = gpt_model.generate_tokens([prompt], BLOCK, 12,
+                                          temperature=0.0, stop_token=stop)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    assert _submit(engine, prompt, 12, stop_token=stop).result() \
+        == base_stop
+    # slot recycles cleanly after the on-device early stop
+    assert _submit(engine, prompt, 12).result() == base
+    stats = _settled_stats(engine)
+    assert stats["completed"] == 2
+    assert any(e["superstep"] > 1 for e in stats["tick_timeline"])
+
+
+def test_superstep_crash_mid_generation_recovers_with_parity(
+        gpt_model, make_engine, monkeypatch):
+    """decode.step:raise@2 with superstep 4 crashes the SECOND fused
+    dispatch — the request is several supersteps deep when the scan's
+    tick dies.  The waiting request fails cleanly, _alloc_state rebuilds
+    the engine, and the resubmitted request is greedy-identical."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "4")
+    prompt = [1, 2, 3]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 12, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "decode.step:raise@2")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    c = _submit(engine, prompt, 12)
+    with pytest.raises(faults.InjectedFault):
+        c.result()
+    # the crash landed mid-request: the first fused block (4 tokens) plus
+    # the prefill token were already delivered, the rest never arrived
+    assert 1 <= c.received < 12, c.received
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+    assert _submit(engine, prompt, 12).result() == base
+    stats = engine.stats()
+    assert stats["crashes_total"] == 1
+    assert stats["engine_resets"] == 1
+    assert engine.active_rows == 0
+
+
+def test_superstep_deadline_retires_at_boundary(gpt_model, make_engine,
+                                                monkeypatch):
+    """A deadline expiring MID-superstep is only observed at the block
+    boundary (the documented ≤N-token granularity trade): the row retires
+    there with a timeout event and a 'timeout' trace retirement reason,
+    and the engine serves the next request cleanly."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults, tracing
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "8")
+    prompt = [1, 2, 3]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 4, temperature=0.0)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    # warm: compiles the prefill + superstep programs so the deadline below
+    # measures the slow dispatch, not XLA
+    _submit(engine, prompt, 12).result()
+    # each fused dispatch now sleeps well past the deadline: the expiry
+    # lands mid-block and must surface at the boundary
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@400")
+    monkeypatch.setenv("PENROZ_TRACE_SAMPLE", "1")
+    trace = tracing.maybe_trace("req-superstep-deadline")
+    collector = _Collector(prompt)
+    req = decode_scheduler.Request(prompt, 12, None, collector.on_event,
+                                   timeout_ms=150,
+                                   request_id="req-superstep-deadline",
+                                   trace=trace)
+    engine.submit(req)
+    with pytest.raises(decode_scheduler.DeadlineExceeded) as exc:
+        collector.result()
+    assert exc.value.phase == "inflight"
+    # tokens delivered before the boundary noticed the expiry — the
+    # overshoot is bounded by one block, never the full budget
+    assert 1 <= collector.received < 12
+    assert trace.finished
+    assert trace.meta.get("retire_reason") == "timeout"
+    assert engine.stats()["deadline_timeouts"] == 1
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+    assert _submit(engine, prompt, 4).result() == base
+
+
+def test_superstep_cancellation_observed_at_boundary(gpt_model,
+                                                     make_engine,
+                                                     monkeypatch):
+    """req.cancelled flipped mid-superstep frees the row at the block
+    boundary; the slot then serves the next request with exact parity."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "4")
+    pa, pb = [1, 2, 3], [5]
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 5, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@60")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    collector = _Collector(pa)
+    req = decode_scheduler.Request(pa, 12, None, collector.on_event)
+    engine.submit(req)
+    _wait_tokens(collector, 1)
+    req.cancelled = True
+    deadline = time.monotonic() + 30
+    while engine.active_rows and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert engine.active_rows == 0
+    assert collector.received < 12
+    assert _submit(engine, pb, 5).result() == base_b
+
+
+def test_superstep_falls_back_while_admissions_pending(gpt_model,
+                                                       make_engine,
+                                                       monkeypatch):
+    """A queued request must not wait N tokens for its slot: with the
+    queue non-empty the planner falls back to n=1 ticks, so admission
+    happens at the very next boundary (and the fused path resumes once
+    the queue drains — both visible in the tick timeline)."""
+    from penroz_tpu.serve import decode_scheduler
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "8")
+    pa, pb = [1, 2, 3], [5]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 12, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 8, temperature=0.0)
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    ca = _submit(engine, pa, 12)
+    cb = _submit(engine, pb, 8)   # queued behind A (capacity 1)
+    assert ca.result() == base_a
+    assert cb.result() == base_b
+    timeline = _settled_stats(engine)["tick_timeline"]
+    assert any(e["superstep"] == 1 for e in timeline)   # fallback ticks
+    assert any(e["superstep"] > 1 for e in timeline)    # fused ticks
+
+
+def test_superstep_dispatch_accounting(gpt_model, make_engine,
+                                       monkeypatch):
+    """The new dispatch metrics, exactly: prompt [1] + 12 tokens at
+    superstep 8 is one prefill token + supersteps of 8, 2 and a single
+    step (pow-2-bucketed tail) — 3 decode dispatches for 11 decode
+    tokens, with the histogram-backed tokens_per_dispatch reflecting the
+    fused blocks and tokens_per_decode_step pinned at 1.0 (fusing is not
+    speculation)."""
+    from penroz_tpu.serve import decode_scheduler
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "8")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    _submit(engine, [1], 12).result()
+    stats = _settled_stats(engine)
+    assert stats["dispatches_total"] == 3
+    assert stats["decode_tokens"] == 11     # 12 minus the prefill token
+    assert stats["decode_steps"] == 11
+    assert stats["tokens_per_decode_step"] == pytest.approx(1.0)
+    assert stats["tokens_per_dispatch_avg"] == pytest.approx(11 / 3, abs=1e-3)
+    assert stats["tokens_per_dispatch_p50"] == pytest.approx(2.0)
+    supersteps = [e["superstep"] for e in stats["tick_timeline"]
+                  if e["superstep"] > 0]
+    assert sorted(supersteps) == [1, 2, 8]
+
+
+def test_idle_engine_parks_on_condvar_no_spin(gpt_model, make_engine):
+    """An idle engine burns no CPU: the worker loop parks on the
+    condition variable (untimed wait) after its last request, so neither
+    the loop counter nor the tick telemetry advances while idle — the
+    old 1s-timeout poll would have woken it repeatedly."""
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=1)
+    _submit(engine, [1, 2], 3).result()
+    time.sleep(0.1)                      # let the loop finish its pass
+    loops0 = engine._loops
+    ticks0 = len(engine._tick_timeline)
+    steps0 = engine.stats()["decode_steps"]
+    time.sleep(1.5)                      # > the old poll interval
+    assert engine._loops == loops0       # zero wakeups while idle
+    assert len(engine._tick_timeline) == ticks0
+    assert engine.stats()["decode_steps"] == steps0
+    # and the parked engine still wakes instantly for new work
+    assert engine.idle()
+    _submit(engine, [1, 2], 3).result(timeout=30)
+
+
+def test_step_rng_fold_in_jit_matches_host_fold(gpt_model):
+    """The hoisted sampler-key advance is bit-identical: folding the
+    dispatch ordinal into the base key INSIDE the jitted step (the new
+    path) samples exactly the tokens the old host-side fold produced —
+    seeded non-greedy output is unchanged by the hoist."""
+    import jax
+    from penroz_tpu.ops import kv_cache as KV
+    model = gpt_model
+
+    def fresh_kv():
+        return (KV.create_kv_state(model.arch.kv_specs, 2, BLOCK,
+                                   model._kv_dtype())
+                .with_static_table()
+                .with_lengths(np.zeros(2, np.int32)))
+
+    toks = np.array([[3], [5]], np.int32)
+    lengths = np.array([1, 1], np.int32)
+    rng = jax.random.key(7)
+    old, _ = model.decode_step_batched(fresh_kv(), toks, lengths,
+                                       jax.random.fold_in(rng, 5),
+                                       temperature=1.0)
+    new, _ = model.decode_step_batched(fresh_kv(), toks, lengths, rng,
+                                       temperature=1.0, dispatch=5)
+    assert np.array_equal(np.asarray(old), np.asarray(new))
+
+
+def test_non_greedy_seeded_output_invariant_under_superstep(
+        gpt_model, make_engine, monkeypatch):
+    """Sequential single-row NON-greedy traffic samples the identical
+    token sequence at superstep 1 and 8: each fused step consumes the
+    same dispatch ordinal (hence the same folded key) the single-step
+    loop would have, so fusing never perturbs seeded sampling."""
+    from penroz_tpu.serve import decode_scheduler
+    prompt = [1, 2, 3]
+    outs = {}
+    for superstep in (1, 8):
+        monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, str(superstep))
+        engine = make_engine("schedgpt", BLOCK, 1.0, None, capacity=2)
+        outs[superstep] = [
+            _submit(engine, prompt, 10).result(),
+            _submit(engine, [5], 6).result(),
+        ]
+        engine.shutdown()
+    assert outs[1] == outs[8]

@@ -503,3 +503,41 @@ def test_train_worker_clean_failure_exits_nonzero_and_parent_logs(
     assert model.status["code"] == "Error"
     assert any("Training worker for model mtgpt" in m and "rc=" in m
                for m in errors), errors
+
+
+@pytest.mark.parametrize("superstep", [1, 4, 8])
+def test_mixed_adapter_superstep_parity(gpt_model, tenants, make_engine,
+                                        monkeypatch, superstep):
+    """Compiled multi-step decode over a MIXED-adapter batch: rows bound
+    to adapter A, adapter B and the base model share one fused
+    PENROZ_SCHED_SUPERSTEP-step dispatch (the stacked pack and per-row
+    slot gather ride the scan carry unchanged), and every tenant's
+    stream is token-identical to its bound-model standalone run at every
+    superstep size."""
+    from penroz_tpu.serve import decode_scheduler
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, str(superstep))
+    jobs = [("tenA", [1, 2, 1, 2, 1, 2]),
+            (None, [5, 6, 5, 6]),
+            ("tenB", [7, 8, 7, 8, 7])]
+    max_new = 6
+    oracles = {}
+    for aid, prompt in jobs:
+        model = gpt_model
+        if aid is not None:
+            entry = tenants[aid]
+            model = lora.bind_model(gpt_model, entry.params, entry.config)
+        oracles[aid] = model.generate_tokens([prompt], BLOCK, max_new,
+                                             temperature=0.0)
+    engine = make_engine("mtgpt", BLOCK, 0.0, None, capacity=3)
+    for wave in range(2):
+        collectors = [(aid, _submit(engine, prompt, max_new,
+                                    adapter=tenants.get(aid)))
+                      for aid, prompt in jobs]
+        for aid, collector in collectors:
+            assert collector.result() == oracles[aid], \
+                f"wave {wave}: adapter {aid} diverged at superstep " \
+                f"{superstep}"
+    stats = engine.stats()
+    assert stats["lora_active_adapters"] == 2
+    if superstep > 1:
+        assert any(e["superstep"] > 1 for e in stats["tick_timeline"])

@@ -78,6 +78,44 @@ def reset_pool_drop_count():
         _POOL_DROP_WARNED = False
 
 
+# A RadixPrefixCache.unpin without a matching pin means refcount accounting
+# broke somewhere upstream — clamping silently (the old behaviour) hides the
+# bug until a pinned page gets evicted under a live row.  Same shape as the
+# pool-drop counter: process-wide count surfaced through /metrics, warn once
+# per node key so a hot retirement path cannot flood the log.
+_UNPIN_UNDERFLOW_LOCK = threading.Lock()
+_UNPIN_UNDERFLOWS = 0
+_UNPIN_UNDERFLOW_WARNED: set = set()
+
+
+def record_unpin_underflow(key):
+    """Count one negative-refcount unpin on the radix node labelled ``key``;
+    warn the first time each distinct key underflows."""
+    global _UNPIN_UNDERFLOWS
+    with _UNPIN_UNDERFLOW_LOCK:
+        _UNPIN_UNDERFLOWS += 1
+        first = key not in _UNPIN_UNDERFLOW_WARNED
+        _UNPIN_UNDERFLOW_WARNED.add(key)
+    if first:
+        log.warning(
+            "RadixPrefixCache.unpin underflow on node key %r: refcount went "
+            "negative (unpaired unpin) — clamped to 0; check pin/unpin "
+            "pairing on the retirement / preempt-resume paths "
+            "(prefix_cache_unpin_underflow counts every occurrence)", key)
+
+
+def unpin_underflow_count() -> int:
+    return _UNPIN_UNDERFLOWS
+
+
+def reset_unpin_underflow_count():
+    """Test hook: zero the counter and re-arm the per-key warnings."""
+    global _UNPIN_UNDERFLOWS
+    with _UNPIN_UNDERFLOW_LOCK:
+        _UNPIN_UNDERFLOWS = 0
+        _UNPIN_UNDERFLOW_WARNED.clear()
+
+
 def turbo_quant_enabled() -> bool:
     return os.environ.get(TURBO_QUANT_ENV, "0") == "1"
 
@@ -1196,15 +1234,12 @@ class RadixPrefixCache:
             root = self._roots[namespace] = _RadixNode(None, -1, None, 0)
         return root
 
-    def match(self, tokens, limit=None, namespace=None) -> list:
-        """Longest cached prefix of ``tokens`` in whole pages; returns the
-        matched node chain (``[n.page for n in nodes]`` are the pages to
-        alias, in logical order).  ``limit`` caps the usable token count —
-        admission passes ``len(prompt) - 1`` so at least one real token is
-        always left to produce the first-sample logits.  Counts a hit iff
-        at least one page matched.  ``namespace`` isolates adapter-bound
-        rows: a lookup only ever matches pages inserted under the SAME
-        namespace."""
+    def chain(self, tokens, limit=None, namespace=None) -> list:
+        """The cached node chain for ``tokens``' longest whole-page prefix,
+        WITHOUT hit/miss accounting — bookkeeping walks (the preemption path
+        re-pinning a chain it just inserted) must not skew the hit-rate
+        stats that describe admission lookups.  Touches LRU recency like
+        :meth:`match` (the chain is demonstrably live)."""
         nodes = []
         node = self._ns_root(namespace)
         for key in self._blocks(tokens, limit):
@@ -1216,6 +1251,18 @@ class RadixPrefixCache:
         t = self._tick()
         for nd in nodes:
             nd.last_use = t
+        return nodes
+
+    def match(self, tokens, limit=None, namespace=None) -> list:
+        """Longest cached prefix of ``tokens`` in whole pages; returns the
+        matched node chain (``[n.page for n in nodes]`` are the pages to
+        alias, in logical order).  ``limit`` caps the usable token count —
+        admission passes ``len(prompt) - 1`` so at least one real token is
+        always left to produce the first-sample logits.  Counts a hit iff
+        at least one page matched.  ``namespace`` isolates adapter-bound
+        rows: a lookup only ever matches pages inserted under the SAME
+        namespace."""
+        nodes = self.chain(tokens, limit, namespace)
         if nodes:
             self.hits += 1
             self.hit_tokens += len(nodes) * self.page_size
@@ -1234,6 +1281,7 @@ class RadixPrefixCache:
             nd.refs -= 1
             if nd.refs < 0:  # defensive: never let an unpaired unpin
                 nd.refs = 0  # turn into a negative permanent pin
+                record_unpin_underflow(nd.key)
 
     def insert(self, tokens, limit=None,
                namespace=None) -> list[tuple[int, int]]:

@@ -267,18 +267,25 @@ async def evaluate_model(request: web.Request):
 
 
 def _shed_response(exc) -> web.Response:
-    """Map scheduler shed exceptions to their HTTP statuses: queue full →
-    429 + Retry-After, deadline exceeded → 504, circuit open → 503 +
-    Retry-After (fault-tolerance contract, serve/decode_scheduler.py)."""
+    """Map scheduler shed exceptions to their HTTP statuses: queue full /
+    tenant quota exceeded → 429 + Retry-After, deadline exceeded → 504,
+    circuit open → 503 + Retry-After (fault-tolerance contract,
+    serve/decode_scheduler.py).  Retry-After is load-aware: queue depth ×
+    recent tick time for queue sheds, bucket refill time for quota sheds,
+    remaining cooldown for breaker sheds."""
     from penroz_tpu.serve import decode_scheduler
+    retry = str(int(getattr(exc, "retry_after", 1) or 1))
     if isinstance(exc, decode_scheduler.QueueFullError):
         return web.json_response({"detail": f"Server overloaded: {exc}"},
-                                 status=429, headers={"Retry-After": "1"})
+                                 status=429, headers={"Retry-After": retry})
+    if isinstance(exc, decode_scheduler.TenantQuotaExceeded):
+        return web.json_response({"detail": f"Tenant quota exceeded: {exc}"},
+                                 status=429, headers={"Retry-After": retry})
     if isinstance(exc, decode_scheduler.DeadlineExceeded):
         return _json({"detail": f"Deadline exceeded: {exc}"}, status=504)
     assert isinstance(exc, decode_scheduler.CircuitOpenError), exc
     return web.json_response({"detail": f"Service unavailable: {exc}"},
-                             status=503, headers={"Retry-After": "1"})
+                             status=503, headers={"Retry-After": retry})
 
 
 async def _resolve_adapter(adapter_id: str, model_id: str):
@@ -331,7 +338,7 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
             tokens = await decode_scheduler.run_request(
                 engine, prompt, body.max_new_tokens, body.stop_token,
                 body.timeout_ms, adapter=adapter, request_id=rid,
-                trace=trace)
+                trace=trace, priority=body.priority, tenant=body.tenant)
             return _json({"tokens": tokens})
         log.info("Streaming token generation for model %s via the "
                  "continuous-batching scheduler", body.model_id)
@@ -339,7 +346,8 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
         # their real status line instead of a broken 200 stream
         req, queue = decode_scheduler.start_stream(
             engine, prompt, body.max_new_tokens, body.stop_token,
-            body.timeout_ms, adapter=adapter, request_id=rid, trace=trace)
+            body.timeout_ms, adapter=adapter, request_id=rid, trace=trace,
+            priority=body.priority, tenant=body.tenant)
     except decode_scheduler.CircuitOpenError as exc:
         if trace is not None:
             trace.finish("breaker_open")
@@ -351,6 +359,10 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
     except decode_scheduler.QueueFullError as exc:
         if trace is not None:
             trace.finish("queue_full")
+        return _shed_response(exc)
+    except decode_scheduler.TenantQuotaExceeded as exc:
+        if trace is not None:
+            trace.finish("quota")
         return _shed_response(exc)
     except decode_scheduler.DeadlineExceeded as exc:
         if trace is not None:
@@ -591,14 +603,16 @@ async def _model_generate_batch_inner(request, body, row_entries):
                 decode_scheduler.run_request(
                     engine, p, body.max_new_tokens, body.stop_token,
                     body.timeout_ms, adapter=entry, request_id=row_rid,
-                    trace=row_trace)
+                    trace=row_trace, priority=body.priority,
+                    tenant=body.tenant)
                 for (p, entry, (row_rid, row_trace))
                 in zip(prompts, row_entries, rows)],
                 return_exceptions=True)
             reason_of = {
                 decode_scheduler.QueueFullError: "queue_full",
                 decode_scheduler.DeadlineExceeded: "timeout",
-                decode_scheduler.CircuitOpenError: "breaker_open"}
+                decode_scheduler.CircuitOpenError: "breaker_open",
+                decode_scheduler.TenantQuotaExceeded: "quota"}
             for (_, row_trace), res in zip(rows, results):
                 if (row_trace is not None and not row_trace.finished
                         and not row_trace.owned):
@@ -611,7 +625,8 @@ async def _model_generate_batch_inner(request, body, row_entries):
             shed = next((e for e in errors if isinstance(
                 e, (decode_scheduler.QueueFullError,
                     decode_scheduler.DeadlineExceeded,
-                    decode_scheduler.CircuitOpenError))), None)
+                    decode_scheduler.CircuitOpenError,
+                    decode_scheduler.TenantQuotaExceeded))), None)
             if shed is None:
                 raise errors[0]
             if (isinstance(shed, decode_scheduler.CircuitOpenError)
@@ -786,6 +801,37 @@ async def serving_stats(request: web.Request):
     # OpenAPI surface cannot drift apart silently.
     return _json(schemas.ServingStatsResponse.model_validate(
         stats).model_dump())
+
+
+async def put_tenant_quota(request: web.Request):
+    """Per-tenant token-rate override (PUT /tenants/{tenant_id}/quota):
+    sets the tenant's sustained tokens/sec budget over emitted + prefilled
+    tokens (serve/qos.py token bucket; env default
+    PENROZ_QOS_TENANT_TOKENS_PER_S).  ``tokens_per_s: null`` clears the
+    override; 0 blocks all new admissions for the tenant while in-flight
+    rows finish."""
+    from penroz_tpu.serve import qos
+    tenant_id = request.match_info["tenant_id"]
+    body = await _parse(request, schemas.TenantQuotaRequest)
+    if body.tokens_per_s is not None and body.tokens_per_s < 0:
+        raise ValueError("tokens_per_s must be >= 0 (or null to clear "
+                         "the override)")
+    qos.QUOTAS.set_rate(tenant_id, body.tokens_per_s)
+    log.info("Tenant %s quota %s", tenant_id,
+             "cleared (env default)" if body.tokens_per_s is None
+             else f"set to {body.tokens_per_s} tokens/s")
+    return _json({"tenant": tenant_id,
+                  "tokens_per_s": qos.QUOTAS.rate_for(tenant_id),
+                  "override": body.tokens_per_s is not None})
+
+
+async def list_tenants(request: web.Request):
+    """Tenant quota state (GET /tenants/): configured overrides plus live
+    bucket levels and rejection counts for every tenant the scheduler has
+    seen — the admin view behind the dashboard per-tenant tile."""
+    from penroz_tpu.serve import qos
+    return _json({"tenants": qos.QUOTAS.stats(),
+                  "default_tokens_per_s": qos.QUOTAS.rate_for(None)})
 
 
 async def metrics_exposition(request: web.Request):
@@ -1022,6 +1068,8 @@ def create_app() -> web.Application:
     app.router.add_get("/progress/", model_progress)
     app.router.add_get("/stats/", model_stats)
     app.router.add_get("/serving_stats/", serving_stats)
+    app.router.add_get("/tenants/", list_tenants)
+    app.router.add_put("/tenants/{tenant_id}/quota", put_tenant_quota)
     app.router.add_post("/adapters/", create_adapter)
     app.router.add_get("/adapters/", list_adapters)
     app.router.add_delete("/adapters/", delete_adapter)

@@ -66,9 +66,11 @@ error-handler afterthoughts:
   request is shed with a ``timeout`` event before prefill starts → HTTP
   504) and in flight (the row retires at the next step boundary and the
   stream ends with a ``timeout`` event).
-- **Backpressure**: ``PENROZ_SCHED_MAX_QUEUE`` bounds the admission queue;
-  a full queue rejects ``submit`` with :class:`QueueFullError` (→ HTTP 429
-  + ``Retry-After``) instead of queueing forever.
+- **Backpressure**: ``PENROZ_SCHED_MAX_QUEUE`` bounds the admission queue
+  (aggregate; per-class ``PENROZ_QOS_MAX_QUEUE_<CLASS>`` overrides it per
+  SLO class); a full queue rejects ``submit`` with :class:`QueueFullError`
+  (→ HTTP 429 + a load-aware ``Retry-After``: queue depth × recent tick
+  p50, clamped) instead of queueing forever.
 - **Crash recovery**: a failed tick fails every waiting request with a
   clean error AND fully resets the engine — fresh KV allocation, fresh
   prefix cache, clean block tables — so the next request decodes from
@@ -89,9 +91,37 @@ error-handler afterthoughts:
   in-flight rows finish within ``PENROZ_DRAIN_S``, then joins the worker
   thread — ``shutdown`` returns False (and logs) if the thread leaks.
 
+Multi-tenant QoS (serve/qos.py) — SLO isolation on top of the overload
+machinery:
+
+- **Priority classes + WFQ**: requests carry ``priority`` (``interactive``
+  | ``standard`` | ``batch``, default ``standard``); the admission queue is
+  per-(tenant, class) sub-queues drained by deficit-weighted round robin
+  (``PENROZ_QOS_WEIGHTS``, default ``interactive:8,standard:4,batch:1``) —
+  one tenant's burst can no longer starve another tenant's queue wait.
+- **Per-tenant token quotas**: a token bucket per tenant id (explicit
+  ``tenant`` field > adapter id > ``"default"``) over emitted + prefilled
+  tokens (``PENROZ_QOS_TENANT_TOKENS_PER_S``; per-tenant overrides via
+  ``PUT /tenants/{id}/quota``).  An exhausted bucket 429s that tenant's
+  NEW admissions with a refill-derived ``Retry-After`` while its in-flight
+  rows finish; other tenants are untouched.
+- **Preemption with zero-recompute resume**: an ``interactive`` arrival
+  facing a full batch evicts the lowest-priority longest-running decode
+  row — its history's KV pages are already pool-resident, so eviction is
+  "insert into the radix tree + copy the uncached pages + free the row"
+  (``PENROZ_QOS_PREEMPT=0`` disables).  The victim requeues at the head of
+  its sub-queue and resumes through the normal prefix-match path with zero
+  recompute of the cached prefix; greedy output is token-identical to the
+  unpreempted run (tested across prefix restore × int8 × superstep ×
+  LoRA).  Preemption is observed at step boundaries, so it can lag the
+  interactive arrival by up to one superstep (the same
+  ``PENROZ_SCHED_SUPERSTEP`` granularity trade as deadlines — and a
+  non-empty queue already collapses the superstep to 1).
+
 All of the above is deterministically testable through
 ``penroz_tpu/utils/faults.py`` (``PENROZ_FAULT_INJECT`` —
-``decode.step:raise@N`` / ``decode.step:sleep@MS`` sites inside the tick).
+``decode.step:raise@N`` / ``decode.step:sleep@MS`` sites inside the tick,
+plus ``qos.preempt`` at the top of the eviction path).
 
 Enabled by routing: serve/app.py sends eligible ``/generate/`` and
 ``/generate_batch/`` traffic here when ``PENROZ_CONTINUOUS_BATCHING=1``.
@@ -119,6 +149,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import math
 import os
 import threading
 import time
@@ -132,7 +163,9 @@ from penroz_tpu.models.model import NeuralNetworkModel
 from penroz_tpu.ops import kv_cache as KV
 from penroz_tpu.serve import adapters as adapters_mod
 from penroz_tpu.serve import metrics as serve_metrics
+from penroz_tpu.serve import qos
 from penroz_tpu.serve import spec_decode
+from penroz_tpu.serve.qos import TenantQuotaExceeded  # noqa: F401 — re-export
 from penroz_tpu.utils import checkpoint, faults, profiling
 from penroz_tpu.utils import metrics as metrics_util
 from penroz_tpu.utils import stats as stats_util
@@ -163,12 +196,26 @@ _TPS_WINDOW_S = 30.0
 
 
 class QueueFullError(RuntimeError):
-    """Admission queue at PENROZ_SCHED_MAX_QUEUE — shed the request (429)."""
+    """Admission queue at its bound (per-class PENROZ_QOS_MAX_QUEUE_* or
+    the aggregate PENROZ_SCHED_MAX_QUEUE) — shed the request (429).
+
+    ``retry_after`` is the load-aware hint (seconds): queue depth × recent
+    tick p50, clamped — a deep queue behind a slow model tells the client
+    to back off longer than a shallow one behind a fast model."""
+
+    def __init__(self, msg: str, retry_after: int = 1):
+        super().__init__(msg)
+        self.retry_after = int(retry_after)
 
 
 class CircuitOpenError(RuntimeError):
     """Engine circuit breaker open after repeated crashes (503, or the
-    legacy path with PENROZ_SCHED_FALLBACK=1)."""
+    legacy path with PENROZ_SCHED_FALLBACK=1).  ``retry_after`` is the
+    remaining cooldown, rounded up (seconds)."""
+
+    def __init__(self, msg: str, retry_after: int = 1):
+        super().__init__(msg)
+        self.retry_after = int(retry_after)
 
 
 class DeadlineExceeded(RuntimeError):
@@ -294,11 +341,13 @@ class Request:
 
     __slots__ = ("prompt", "max_new_tokens", "stop_token", "on_event",
                  "enqueue_t", "cancelled", "deadline", "adapter",
-                 "request_id", "trace")
+                 "request_id", "trace", "priority", "tenant",
+                 "resume_history", "resume_produced", "resume_nodes",
+                 "preempted")
 
     def __init__(self, prompt, max_new_tokens, stop_token, on_event,
                  timeout_ms=None, adapter=None, request_id=None,
-                 trace=None):
+                 trace=None, priority=None, tenant=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.stop_token = stop_token
@@ -308,6 +357,21 @@ class Request:
         # serve.adapters.AdapterEntry (refcount-pinned by the HTTP layer
         # for the request's lifetime) or None for base-model rows.
         self.adapter = adapter
+        # QoS identity: SLO class (WFQ sub-queue + preemption rank) and
+        # tenant id (quota bucket + per-tenant accounting) — explicit
+        # field > adapter id > shared "default".
+        self.priority = qos.validate_priority(priority)
+        self.tenant = qos.tenant_of(
+            tenant, adapter.adapter_id if adapter is not None else None)
+        # Preempt-to-prefix-cache resume state: the full history (prompt +
+        # emitted tokens) becomes the effective prompt of the resume
+        # admission; ``resume_nodes`` hold the radix pins that guarantee
+        # the cached pages survive until the resume prefix-match re-pins
+        # them (zero recompute).
+        self.resume_history = None
+        self.resume_produced = 0
+        self.resume_nodes: list = []
+        self.preempted = 0
         # utils/tracing.py: request_id is the X-Request-Id correlation
         # key; trace (None when sampled out / tracing off) records the
         # lifecycle span tree — every recording site below is None-guarded
@@ -326,12 +390,18 @@ class Request:
 class _Row:
     __slots__ = ("req", "produced", "finished", "prefilling", "prefilled",
                  "chunks", "chunk_idx", "prefix_nodes", "history",
-                 "last_emit_t", "sp_prefill", "sp_decode")
+                 "last_emit_t", "sp_prefill", "sp_decode", "admit_t",
+                 "resumed")
 
     def __init__(self, req):
         self.req = req
         self.produced = 0
         self.finished = False
+        # preemption bookkeeping: admission time ranks "longest-running"
+        # victims; a resumed row skips TTFT (its first token already
+        # shipped before the preempt).
+        self.admit_t = time.monotonic()
+        self.resumed = False
         # inter-token-latency anchor (monotonic s of the last emitted
         # token) + the row's open trace spans (utils/tracing.py)
         self.last_emit_t = None
@@ -392,7 +462,11 @@ class DecodeEngine:
         self._adapter_tokens: dict = {}
         self._alloc_state()
 
-        self._pending: collections.deque = collections.deque()
+        # Admission queue: per-(tenant, class) sub-queues drained by
+        # deficit-weighted round robin (serve/qos.py).  All mutations
+        # happen under _cond, exactly like the deque it replaced; with
+        # only default traffic it degrades to the same FIFO.
+        self._pending: qos.WFQueue = qos.WFQueue()
         self._cond = threading.Condition()
         self._shutdown = False
         self._draining = False
@@ -425,6 +499,14 @@ class DecodeEngine:
         self._breaker_rejections = 0
         self._deadline_timeouts = 0
         self._prefill_chunks = 0
+        # QoS accounting: preemptions, resume cached-token credit (the
+        # zero-recompute proof), quota sheds, per-class admissions, and
+        # per-tenant emitted+prefilled tokens.
+        self._preemptions = 0
+        self._resume_cached_tokens = 0
+        self._quota_rejections = 0
+        self._class_admissions = collections.Counter()
+        self._tenant_tokens: dict = {}
         # Latency distributions: true fixed-bucket histograms
         # (utils/metrics.py Hist), not truncated sample deques — the p99s
         # /serving_stats/ reports derive from these, and /metrics exposes
@@ -440,6 +522,11 @@ class DecodeEngine:
         self._h_chunk_stall = metrics_util.Hist()
         self._h_itl = metrics_util.Hist()
         self._h_tick = metrics_util.Hist()
+        # Per-class latency breakdown (SLO isolation is only verifiable if
+        # the interactive distribution is separable from the flood's).
+        self._h_ttft_cls = {c: metrics_util.Hist() for c in qos.PRIORITIES}
+        self._h_queue_wait_cls = {c: metrics_util.Hist()
+                                  for c in qos.PRIORITIES}
         # Compiled multi-step decode accounting: one "dispatch" is one
         # device round trip of the decode path (shared step, verify step,
         # or fused superstep) — tokens_per_dispatch ≈ PENROZ_SCHED_SUPERSTEP
@@ -500,40 +587,89 @@ class DecodeEngine:
 
     # -- public surface -----------------------------------------------------
 
+    def _queue_retry_after(self) -> int:
+        """Load-aware backoff hint for a queue shed: the queued work's
+        rough drain time (depth × recent tick p50), clamped to [1, 30]s —
+        callers hold _cond."""
+        tick_ms = self._h_tick.quantile(0.5) or 50.0
+        depth = len(self._pending)
+        return int(min(30, max(1, math.ceil(depth * tick_ms / 1000.0))))
+
+    def _shed_span(self, req: Request, reason: str):
+        """A shed request never reaches an engine row, but its trace must
+        still carry the queue wait (enqueue → shed) and the typed reason —
+        'why did my 429/504 take this long' reads off the one tree."""
+        if req.trace is not None:
+            sp = req.trace.span("queue", t0=req.enqueue_t)
+            req.trace.end(sp)
+            req.trace.event("shed", reason=reason)
+
     def submit(self, req: Request):
         """Enqueue ``req`` or refuse it NOW: shedding happens at the door
-        (bounded queue, open breaker, draining engine) so clients get an
-        immediate, typed answer instead of a stalled connection."""
+        (bounded queue, exhausted tenant quota, open breaker, draining
+        engine) so clients get an immediate, typed answer instead of a
+        stalled connection."""
         with self._cond:
             if self._shutdown or self._draining:
                 raise RuntimeError("decode engine is shut down")
             if self._breaker_open:
-                cooldown_done = (time.monotonic() >= self._breaker_open_t
-                                 + _breaker_cooldown_ms() / 1000.0)
+                cooldown_ms = _breaker_cooldown_ms()
+                now = time.monotonic()
+                cooldown_done = (now >= self._breaker_open_t
+                                 + cooldown_ms / 1000.0)
                 if self._probe_inflight or not cooldown_done:
                     self._breaker_rejections += 1
                     serve_metrics.BREAKER_REJECTIONS.inc()
                     serve_metrics.REQUESTS.inc(outcome="breaker_open")
                     if req.trace is not None:
                         req.trace.event("shed", reason="breaker_open")
+                    remaining_s = max(
+                        0.0, self._breaker_open_t + cooldown_ms / 1000.0
+                        - now)
                     raise CircuitOpenError(
                         f"engine {self.model_id}: circuit breaker open "
-                        f"after {self._crashes} consecutive crashes")
+                        f"after {self._crashes} consecutive crashes",
+                        retry_after=min(30, max(1,
+                                                math.ceil(remaining_s))))
                 # Half-open: exactly one probe request goes through; its
                 # completion closes the breaker (_retire), its failure
                 # re-arms the cooldown (_fail_all).
                 self._probe_inflight = True
-            max_queue = _max_queue()
-            if max_queue and len(self._pending) >= max_queue:
+            # Tenant token quota: an exhausted bucket sheds THIS tenant's
+            # new admissions (429 + refill-derived Retry-After); in-flight
+            # rows — anyone's — are never touched.
+            try:
+                qos.QUOTAS.admit(req.tenant)
+            except TenantQuotaExceeded:
+                self._quota_rejections += 1
+                serve_metrics.QUOTA_REJECTIONS.inc(tenant=req.tenant)
+                serve_metrics.REQUESTS.inc(outcome="quota")
+                self._shed_span(req, "quota")
+                raise
+            # Per-class bound when PENROZ_QOS_MAX_QUEUE_<CLASS> is set
+            # (0 = explicitly unbounded); otherwise the pre-QoS aggregate
+            # PENROZ_SCHED_MAX_QUEUE applies unchanged.
+            cls_bound = qos.class_queue_bound(req.priority)
+            if cls_bound is not None:
+                full = (cls_bound
+                        and self._pending.class_depth(req.priority)
+                        >= cls_bound)
+                bound_desc = (f"{cls_bound} {req.priority} waiting"
+                              if cls_bound else "")
+            else:
+                max_queue = _max_queue()
+                full = max_queue and len(self._pending) >= max_queue
+                bound_desc = f"{max_queue} waiting"
+            if full:
                 self._queue_rejections += 1
                 serve_metrics.QUEUE_REJECTIONS.inc()
                 serve_metrics.REQUESTS.inc(outcome="queue_full")
-                if req.trace is not None:
-                    req.trace.event("shed", reason="queue_full")
+                self._shed_span(req, "queue_full")
                 raise QueueFullError(
                     f"engine {self.model_id}: admission queue full "
-                    f"({max_queue} waiting)")
-            self._pending.append(req)
+                    f"({bound_desc})",
+                    retry_after=self._queue_retry_after())
+            self._pending.push(req)
             if req.trace is not None:
                 # From here on every terminal path (retire, purge, crash
                 # recovery, shutdown) runs through this engine — it owns
@@ -622,6 +758,11 @@ class DecodeEngine:
                 "chunk_stall_ms": self._h_chunk_stall.snapshot(),
                 "tick_ms": self._h_tick.snapshot(),
                 "tokens_per_dispatch": tpd,
+                "ttft_ms_by_class": {
+                    c: h.snapshot() for c, h in self._h_ttft_cls.items()},
+                "queue_wait_ms_by_class": {
+                    c: h.snapshot()
+                    for c, h in self._h_queue_wait_cls.items()},
             },
             "superstep": _superstep_max(),
             "dispatches_total": self._dispatches,
@@ -641,6 +782,19 @@ class DecodeEngine:
             "queue_rejections": self._queue_rejections,
             "deadline_timeouts": self._deadline_timeouts,
             "breaker_rejections": self._breaker_rejections,
+            "quota_rejections": self._quota_rejections,
+            "preemptions": self._preemptions,
+            "preempted_resume_cached_tokens": self._resume_cached_tokens,
+            "queue_depth_by_class": self._pending.class_depths(),
+            "admissions_by_class": {
+                c: self._class_admissions[c] for c in qos.PRIORITIES},
+            "tenant_tokens": dict(self._tenant_tokens),
+            "ttft_ms_p99_by_class": {
+                c: self._round_q(h, 0.99)
+                for c, h in self._h_ttft_cls.items()},
+            "queue_wait_ms_p99_by_class": {
+                c: self._round_q(h, 0.99)
+                for c, h in self._h_queue_wait_cls.items()},
             "queue_wait_ms_p99": (round(queue_wait_p99, 3)
                                   if queue_wait_p99 is not None else None),
             "breaker_open": self._breaker_open,
@@ -800,30 +954,23 @@ class DecodeEngine:
         ever starts) and silently drop cancelled ones (disconnected
         clients must not spend a prefill)."""
         now = time.monotonic()
-        expired = []
-        dropped = []
         with self._cond:
             if not self._pending:
                 return
-            keep: collections.deque = collections.deque()
-            for req in self._pending:
-                if req.cancelled:
-                    dropped.append(req)
-                    continue
-                if req.expired(now):
-                    expired.append(req)
-                else:
-                    keep.append(req)
-            self._pending = keep
-        for req in dropped:
-            self._finish_trace(req, "cancelled")
-            serve_metrics.REQUESTS.inc(outcome="cancelled")
-        for req in expired:
-            self._timeout_queued(req)
+            removed = self._pending.purge(
+                lambda r: r.cancelled or r.expired(now))
+        for req in removed:
+            if req.cancelled:
+                self._release_resume(req)
+                self._finish_trace(req, "cancelled")
+                serve_metrics.REQUESTS.inc(outcome="cancelled")
+            else:
+                self._timeout_queued(req)
 
     def _timeout_queued(self, req: Request):
         """Shed one queued request on an expired deadline (504 before
         prefill ever starts) — counter, metrics, trace, event delivery."""
+        self._release_resume(req)
         self._deadline_timeouts += 1
         serve_metrics.DEADLINE_TIMEOUTS.inc()
         serve_metrics.REQUESTS.inc(outcome="timeout")
@@ -847,9 +994,10 @@ class DecodeEngine:
         if admit_ms <= 0 or self.active_rows:
             return
         with self._cond:
-            if not self._pending:
+            first_t = self._pending.oldest_enqueue_t()
+            if first_t is None:
                 return
-            deadline = self._pending[0].enqueue_t + admit_ms / 1000.0
+            deadline = first_t + admit_ms / 1000.0
             while (len(self._pending) < self.capacity
                    and not self._shutdown
                    and time.monotonic() < deadline):
@@ -871,13 +1019,20 @@ class DecodeEngine:
     def _admit(self):
         while True:
             row = self._free_row()
+            req = None
             if row is None:
-                return
-            with self._cond:
-                if self._draining or not self._pending:
+                row, req = self._try_preempt()
+                if row is None:
                     return
-                req = self._pending.popleft()
+            if req is None:
+                with self._cond:
+                    if self._draining or not self._pending:
+                        return
+                    req = self._pending.pop()
+                if req is None:
+                    return
             if req.cancelled:
+                self._release_resume(req)
                 self._finish_trace(req, "cancelled")
                 serve_metrics.REQUESTS.inc(outcome="cancelled")
                 continue
@@ -895,9 +1050,125 @@ class DecodeEngine:
                 # only happen with rows in flight, so the worker loop
                 # keeps stepping and re-tries every boundary.
                 with self._cond:
-                    self._pending.appendleft(req)
+                    self._pending.push_front(req)
                 return
             self._begin_prefill(row, req, slot)
+
+    # -- preemption (preempt-to-prefix-cache, resume with zero recompute) ----
+
+    def _try_preempt(self):
+        """With the batch full and an ``interactive`` request queued, evict
+        the lowest-priority longest-running decode row into the radix
+        prefix cache and hand its slot to the interactive request
+        specifically (DRR order would happily give the freed row back to
+        the flood).  Returns ``(row, request)`` or ``(None, None)``."""
+        if not qos.preempt_enabled() or self._prefix_cache is None:
+            return None, None
+        with self._cond:
+            if (self._draining
+                    or self._pending.class_depth("interactive") == 0):
+                return None, None
+        victim = self._preempt_victim()
+        if victim is None:
+            return None, None
+        self._preempt_row(victim)
+        with self._cond:
+            req = self._pending.pop_class("interactive")
+        return victim, req
+
+    def _preempt_victim(self):
+        """Victim row: strictly lower class than ``interactive`` (an
+        interactive row is never preempted for another), decode phase only
+        (a prefilling row has produced nothing a client is waiting on —
+        and its partial KV is not yet a cacheable history), lowest class
+        first, then longest-running (earliest admission)."""
+        best = None
+        best_rank = None
+        for i, state in enumerate(self._rows):
+            if state is None or state.prefilling:
+                continue
+            pri = state.req.priority
+            if pri == "interactive":
+                continue
+            # batch outranks standard as a victim; earlier admit_t wins
+            # within a class.
+            rank = (0 if pri == "batch" else 1, state.admit_t)
+            if best_rank is None or rank < best_rank:
+                best, best_rank = i, rank
+        return best
+
+    def _preempt_row(self, row: int):
+        """Evict one decode row into the radix prefix cache: its pages are
+        already pool-resident, so eviction is "insert history into the
+        radix tree + copy the uncached pages + free the row".  The request
+        requeues at the head of its sub-queue carrying pinned resume nodes;
+        the resume admission's normal prefix-match path aliases them back
+        with zero recompute of the cached prefix.  Crash-safe: the
+        ``qos.preempt`` fault site fires before any mutation, and a crash
+        anywhere in here fails the tick → ``_alloc_state`` rebuilds KV and
+        a fresh prefix cache, so no pin can outlive the state it guards."""
+        faults.check("qos.preempt")
+        state = self._rows[row]
+        req = state.req
+        t0 = time.monotonic()
+        # KV valid length: a decode row has KV for len(history) - 1 tokens
+        # (the newest sampled token's KV is written by the step that feeds
+        # it) — insert exactly the full pages below it.
+        kv_len = int(self._lengths[row])
+        ns = self._prefix_ns(req)
+        created = self._prefix_cache.insert(state.history, limit=kv_len,
+                                            namespace=ns)
+        if created:
+            S = self._kv.pages_per_seq
+            self._kv = self._kv.copy_pages(
+                [row * S + b for b, _ in created],
+                [page for _, page in created])
+        # Pin the whole cached chain until the resume re-pins it — LRU
+        # eviction must not recycle these pages while the request waits.
+        nodes = self._prefix_cache.chain(state.history, limit=kv_len,
+                                         namespace=ns)
+        self._prefix_cache.pin(nodes)
+        cached = len(nodes) * self._prefix_cache.page_size
+        # Free the row (retire mechanics WITHOUT a terminal event — the
+        # stream stays open across the preemption).
+        self._rows[row] = None
+        self._lengths[row] = 0
+        self._last_tok[row] = 0
+        self._row_adapter[row] = self._max_live
+        self._release_prefix(row, state)
+        self._kv = self._kv.reset_row(row)
+        req.resume_history = list(state.history)
+        req.resume_produced = state.produced
+        req.resume_nodes = nodes
+        req.preempted += 1
+        # Queue wait restarts at the preempt: the resume admission's queue
+        # span/histogram measure the requeue wait, not the original one
+        # (the deadline stays anchored at the ORIGINAL enqueue).
+        req.enqueue_t = t0
+        self._preemptions += 1
+        serve_metrics.PREEMPTIONS.inc()
+        if req.trace is not None:
+            req.trace.end(state.sp_prefill)
+            req.trace.end(state.sp_decode, produced=state.produced)
+            sp = req.trace.span("preempt", t0=t0, cached_tokens=cached,
+                                produced=state.produced)
+            req.trace.end(sp)
+        with self._cond:
+            self._pending.push_front(req)
+        log.info("Decode engine %s: preempted row %d (%s/%s, %d produced, "
+                 "%d tokens cached) for a queued interactive request",
+                 self.model_id, row, req.tenant, req.priority,
+                 state.produced, cached)
+
+    def _release_resume(self, req: Request):
+        """Drop a preempted request's resume pins (resume admission,
+        deadline purge, cancellation, engine failure) — without this, a
+        preempted request that never comes back would pin its pages
+        forever."""
+        if req.resume_nodes:
+            if self._prefix_cache is not None:
+                self._prefix_cache.unpin(req.resume_nodes)
+            req.resume_nodes = []
 
     # -- adapter slots (mixed-adapter batches, models/lora.py) ---------------
 
@@ -942,8 +1213,21 @@ class DecodeEngine:
         radix prefix cache (paged + ``PENROZ_PREFIX_CACHE=1``), alias the
         matched pages into the row's block table, and plan pow-2-bucketed
         chunks over the remaining suffix.  No device prefill work happens
-        here — ``_prefill_tick`` interleaves it with decode steps."""
+        here — ``_prefill_tick`` interleaves it with decode steps.
+
+        A PREEMPTED request resumes through this very path: its effective
+        prompt is the full history (prompt + tokens already emitted), whose
+        KV the preempt pinned into the radix tree — the prefix match below
+        aliases those pages back, the final chunk reproduces the exact
+        sampling position of the unpreempted step, and greedy output is
+        token-identical with zero recompute of the cached prefix."""
         state = _Row(req)
+        resumed = req.resume_history is not None
+        if resumed:
+            state.resumed = True
+            state.history = list(req.resume_history)
+            state.produced = req.resume_produced
+        eff_prompt = state.history  # == req.prompt for fresh admissions
         self._row_adapter[row] = (slot if slot is not None
                                   else self._max_live)
         trace = req.trace
@@ -962,8 +1246,8 @@ class DecodeEngine:
             # Namespaced per adapter generation: a base prefix must never
             # alias an adapter's KV (or vice versa) — the pages hold
             # weight-dependent K/V.
-            nodes = self._prefix_cache.match(req.prompt,
-                                             limit=len(req.prompt) - 1,
+            nodes = self._prefix_cache.match(eff_prompt,
+                                             limit=len(eff_prompt) - 1,
                                              namespace=self._prefix_ns(req))
             if nodes:
                 self._prefix_cache.pin(nodes)
@@ -980,9 +1264,28 @@ class DecodeEngine:
             # alias survives an abnormal retirement path.
             self._kv = self._kv.with_row_prefix(
                 row, [n.page for n in nodes])
-        state.chunks = _chunk_plan(len(req.prompt) - state.prefilled,
+        if resumed:
+            # The row's own pins now hold the pages — drop the preempt-time
+            # hold and record the zero-recompute credit.
+            self._resume_cached_tokens += state.prefilled
+            serve_metrics.RESUME_CACHED_TOKENS.inc(state.prefilled)
+            self._release_resume(req)
+            req.resume_history = None
+            req.resume_produced = 0
+            if trace is not None:
+                sp = trace.span("resume", cached_tokens=state.prefilled,
+                                produced=state.produced)
+                trace.end(sp)
+        state.chunks = _chunk_plan(len(eff_prompt) - state.prefilled,
                                    _prefill_chunk())
         self._rows[row] = state
+        # Quota charges cover prefilled + emitted tokens: bill the compute
+        # this admission will actually run (the radix-matched prefix costs
+        # nothing, so a resume re-charges only its final chunk).
+        qos.QUOTAS.charge(req.tenant,
+                          len(eff_prompt) - state.prefilled)
+        self._class_admissions[req.priority] += 1
+        serve_metrics.CLASS_ADMISSIONS.inc(priority=req.priority)
         # Park the row's decode-step write position at the next prefill
         # position: the interleaved shared step's (discarded) K/V write for
         # this row lands exactly where the next chunk writes real data, so
@@ -993,10 +1296,13 @@ class DecodeEngine:
         self._admissions += 1
         wait_ms = (time.monotonic() - req.enqueue_t) * 1000.0
         self._h_queue_wait.observe(wait_ms)
+        self._h_queue_wait_cls[req.priority].observe(wait_ms)
         serve_metrics.QUEUE_WAIT_MS.observe(wait_ms)
+        serve_metrics.QUEUE_WAIT_BY_CLASS.observe(wait_ms,
+                                                  priority=req.priority)
         if trace is not None:
             state.sp_prefill = trace.span(
-                "prefill", prompt_tokens=len(req.prompt),
+                "prefill", prompt_tokens=len(eff_prompt),
                 cached_tokens=state.prefilled, chunks=len(state.chunks))
 
     def _next_prefill_row(self):
@@ -1059,10 +1365,13 @@ class DecodeEngine:
         sp = (req.trace.span("prefill_chunk", parent=state.sp_prefill,
                              size=size, start=start)
               if req.trace is not None else None)
+        # state.history is the effective prompt (the full pre-preemption
+        # history for a resumed row, req.prompt otherwise) and is static
+        # for the whole PREFILLING phase — tokens only append post-prefill.
         with model_mod.decode_priority(), \
                 profiling.span("penroz/sched_prefill_chunk"):
             tok, self._kv = self._model.decode_prefill_chunk(
-                self._kv, row, req.prompt[start:start + size], start, rng,
+                self._kv, row, state.history[start:start + size], start, rng,
                 self.temperature, self.top_k, lora=self._lora_pack,
                 adapter_slot=int(self._row_adapter[row]))
         if req.trace is not None:
@@ -1079,11 +1388,17 @@ class DecodeEngine:
         """Final chunk done: its sampled token IS the request's first token
         (same logits position and program family as one-shot prefill)."""
         state.prefilling = False
-        self._lengths[row] = state.prefilled  # == len(prompt)
+        self._lengths[row] = state.prefilled  # == len(effective prompt)
         self._last_tok[row] = first
         ttft_ms = (time.monotonic() - state.req.enqueue_t) * 1000.0
-        self._h_ttft.observe(ttft_ms)
-        serve_metrics.TTFT_MS.observe(ttft_ms)
+        if not state.resumed:
+            # A resumed row's first token shipped before the preempt —
+            # re-observing here would double-count its TTFT.
+            self._h_ttft.observe(ttft_ms)
+            self._h_ttft_cls[state.req.priority].observe(ttft_ms)
+            serve_metrics.TTFT_MS.observe(ttft_ms)
+            serve_metrics.TTFT_BY_CLASS.observe(
+                ttft_ms, priority=state.req.priority)
         trace = state.req.trace
         if trace is not None:
             trace.end(state.sp_prefill)
@@ -1412,6 +1727,10 @@ class DecodeEngine:
             aid = state.req.adapter.adapter_id
             self._adapter_tokens[aid] = self._adapter_tokens.get(aid, 0) + 1
             serve_metrics.LORA_TOKENS.inc(adapter_id=aid)
+        tenant = state.req.tenant
+        self._tenant_tokens[tenant] = self._tenant_tokens.get(tenant, 0) + 1
+        serve_metrics.TENANT_TOKENS.inc(tenant=tenant)
+        qos.QUOTAS.charge(tenant, 1)
         self._deliver(state.req, "token", tok)
         req = state.req
         if req.cancelled:
@@ -1523,13 +1842,14 @@ class DecodeEngine:
                         trace.finish("error")
                 self._deliver(state.req, "error", exc)
         with self._cond:
-            pending, self._pending = list(self._pending), collections.deque()
+            pending = self._pending.drain()
             if self._probe_inflight:
                 # The probe died with everything else: stay open and re-arm
                 # the cooldown so the next probe waits its turn.
                 self._probe_inflight = False
                 self._breaker_open_t = time.monotonic()
         for req in pending:
+            self._release_resume(req)
             serve_metrics.REQUESTS.inc(outcome="error")
             self._finish_trace(req, "error")
             self._deliver(req, "error", exc)
@@ -1705,6 +2025,18 @@ def serving_stats() -> dict:
     for p in per:
         for aid, n in p["lora_adapter_tokens"].items():
             adapter_tokens[aid] = adapter_tokens.get(aid, 0) + n
+    tenant_tokens: dict = {}
+    for p in per:
+        for tid, n in p["tenant_tokens"].items():
+            tenant_tokens[tid] = tenant_tokens.get(tid, 0) + n
+    qdepth_by_class = {c: sum(p["queue_depth_by_class"][c] for p in per)
+                       for c in qos.PRIORITIES}
+
+    def _cls_q(name: str, cls: str, q: float):
+        v = metrics_util.quantile_of(metrics_util.merge_snapshots(
+            [p["histograms"][name][cls] for p in per]), q)
+        return round(v, 3) if v is not None else None
+
     return {
         "continuous_batching_enabled": enabled(),
         "engines": per,
@@ -1713,6 +2045,17 @@ def serving_stats() -> dict:
         "queue_depth": sum(p["queue_depth"] for p in per),
         "queue_rejections": sum(p["queue_rejections"] for p in per),
         "deadline_timeouts": sum(p["deadline_timeouts"] for p in per),
+        "quota_rejections": sum(p["quota_rejections"] for p in per),
+        "preemptions_total": sum(p["preemptions"] for p in per),
+        "preempted_resume_cached_tokens": sum(
+            p["preempted_resume_cached_tokens"] for p in per),
+        "queue_depth_by_class": qdepth_by_class,
+        "tenant_tokens": tenant_tokens,
+        "ttft_ms_p99_by_class": {
+            c: _cls_q("ttft_ms_by_class", c, 0.99) for c in qos.PRIORITIES},
+        "queue_wait_ms_p99_by_class": {
+            c: _cls_q("queue_wait_ms_by_class", c, 0.99)
+            for c in qos.PRIORITIES},
         "queue_wait_ms_p99": queue_wait_p99,
         "breaker_open": any(p["breaker_open"] for p in per),
         "crashes_total": sum(p["crashes_total"] for p in per),
@@ -1770,7 +2113,8 @@ async def acquire_engine(model_id, block_size, temperature, top_k):
 
 
 def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None,
-                   adapter=None, request_id=None, trace=None):
+                   adapter=None, request_id=None, trace=None,
+                   priority=None, tenant=None):
     loop = asyncio.get_running_loop()
     queue: asyncio.Queue = asyncio.Queue()
 
@@ -1779,12 +2123,14 @@ def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None,
 
     return (Request(prompt, max_new_tokens, stop_token, on_event,
                     timeout_ms=timeout_ms, adapter=adapter,
-                    request_id=request_id, trace=trace), queue)
+                    request_id=request_id, trace=trace,
+                    priority=priority, tenant=tenant), queue)
 
 
 async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
                       stop_token, timeout_ms=None, adapter=None,
-                      request_id=None, trace=None) -> list[int]:
+                      request_id=None, trace=None, priority=None,
+                      tenant=None) -> list[int]:
     """Submit one request and await the full sequence (prompt + generated,
     the ``generate_tokens`` contract).  Raises DeadlineExceeded /
     QueueFullError / CircuitOpenError on the shed paths; an aiohttp client
@@ -1794,9 +2140,12 @@ async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
     through that adapter's live slot; the CALLER holds the registry pin.
     ``request_id``/``trace`` thread per-request observability through the
     scheduler (utils/tracing.py); the scheduler finishes the trace at
-    retirement, the caller finishes it on shed paths."""
+    retirement, the caller finishes it on shed paths.
+    ``priority``/``tenant`` are the QoS routing fields (WFQ class +
+    quota bucket)."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
-                                timeout_ms, adapter, request_id, trace)
+                                timeout_ms, adapter, request_id, trace,
+                                priority, tenant)
     engine.submit(req)
     tokens = list(req.prompt)
     try:
@@ -1815,13 +2164,14 @@ async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
 
 def start_stream(engine: DecodeEngine, prompt, max_new_tokens, stop_token,
                  timeout_ms=None, adapter=None, request_id=None,
-                 trace=None):
+                 trace=None, priority=None, tenant=None):
     """Submit a streaming request; returns ``(req, queue)`` so the HTTP
     layer can consume events AND flip ``req.cancelled`` itself when the
     client goes away mid-stream (a write failure is invisible to an async
     generator until its GC-time close — the explicit handle is the
     disconnect wiring)."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
-                                timeout_ms, adapter, request_id, trace)
+                                timeout_ms, adapter, request_id, trace,
+                                priority, tenant)
     engine.submit(req)
     return req, queue

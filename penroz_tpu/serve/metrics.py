@@ -18,16 +18,22 @@ counters   requests_total{outcome}, decode_tokens_total,
            spec_drafted_tokens_total, spec_accepted_tokens_total,
            prefix_cache_hits_total, prefix_cache_misses_total,
            lora_adapter_tokens_total{adapter_id}, traces_completed_total,
-           dispatches_total
+           dispatches_total, quota_rejections_total{tenant},
+           class_admissions_total{priority}, tenant_tokens_total{tenant},
+           preemptions_total, preempted_resume_cached_tokens_total
 gauges     engines, active_rows, queue_depth, batch_occupancy,
            breaker_open, draining, lora_live_adapters,
-           kv_pool_capacity_drops (monotonic in practice, exposed as a
-           gauge because the source counter lives in ops/kv_cache.py)
+           kv_pool_capacity_drops, prefix_cache_unpin_underflow
+           (both monotonic in practice, exposed as gauges because the
+           source counters live in ops/kv_cache.py)
 histograms ttft_ms, itl_ms, queue_wait_ms, chunk_stall_ms, tick_ms
            (fixed LATENCY_BUCKETS_MS buckets; cumulative ``_bucket``
            series sum to ``_count`` — asserted by the strict-format
-           parser test) and tokens_per_dispatch (token-count buckets —
-           the compiled multi-step decode headline)
+           parser test), tokens_per_dispatch (token-count buckets —
+           the compiled multi-step decode headline), and the labeled
+           QoS pair ttft_ms_by_class{priority} /
+           queue_wait_ms_by_class{priority} (one series family per
+           SLO class)
 """
 
 from __future__ import annotations
@@ -84,6 +90,22 @@ DISPATCHES = REGISTRY.register(m.Counter(
     "Decode dispatches (shared steps, spec-decode verify steps, fused "
     "supersteps) — the host round-trip count the multi-step decode path "
     "exists to shrink"))
+QUOTA_REJECTIONS = REGISTRY.register(m.Counter(
+    "penroz_quota_rejections_total",
+    "Admissions shed 429 by a tenant's exhausted token bucket", ("tenant",)))
+CLASS_ADMISSIONS = REGISTRY.register(m.Counter(
+    "penroz_class_admissions_total",
+    "Requests admitted to a decode row per SLO class", ("priority",)))
+TENANT_TOKENS = REGISTRY.register(m.Counter(
+    "penroz_tenant_tokens_total",
+    "Tokens emitted per tenant (quota accounting view)", ("tenant",)))
+PREEMPTIONS = REGISTRY.register(m.Counter(
+    "penroz_preemptions_total",
+    "Decode rows evicted mid-generation for a higher-priority admission"))
+RESUME_CACHED_TOKENS = REGISTRY.register(m.Counter(
+    "penroz_preempted_resume_cached_tokens_total",
+    "Prompt+generated tokens restored from the prefix cache (zero "
+    "recompute) when preempted requests resumed"))
 
 # -- histograms (engine observes the global mirror alongside its own) -------
 
@@ -104,6 +126,12 @@ TOKENS_PER_DISPATCH = REGISTRY.register(m.Histogram(
     "unconstrained fused decode, 1 on the per-token path; distinct from "
     "tokens_per_decode_step, which measures speculation not fusing)",
     buckets=m.TOKENS_PER_DISPATCH_BUCKETS))
+TTFT_BY_CLASS = REGISTRY.register(m.Histogram(
+    "penroz_ttft_ms_by_class",
+    "Enqueue to first token per SLO class, ms", labelnames=("priority",)))
+QUEUE_WAIT_BY_CLASS = REGISTRY.register(m.Histogram(
+    "penroz_queue_wait_ms_by_class",
+    "Enqueue to admission per SLO class, ms", labelnames=("priority",)))
 
 # -- gauges (scrape-time reads of live state) -------------------------------
 
@@ -124,6 +152,11 @@ LORA_LIVE = REGISTRY.register(m.Gauge(
 POOL_DROPS = REGISTRY.register(m.Gauge(
     "penroz_kv_pool_capacity_drops",
     "KV writes dropped at pool capacity (process-wide counter in "
+    "ops/kv_cache.py, exposed at scrape)"))
+UNPIN_UNDERFLOW = REGISTRY.register(m.Gauge(
+    "penroz_prefix_cache_unpin_underflow",
+    "RadixPrefixCache unpins that drove a refcount negative — any "
+    "nonzero value is a pin/unpin pairing bug (process-wide counter in "
     "ops/kv_cache.py, exposed at scrape)"))
 
 
@@ -153,6 +186,7 @@ def _wire_gauges():
     LORA_LIVE.set_function(lambda: sum(
         e.live_adapters for e in engines()))
     POOL_DROPS.set_function(KV.pool_drop_count)
+    UNPIN_UNDERFLOW.set_function(KV.unpin_underflow_count)
 
 
 _WIRED = False

@@ -148,8 +148,12 @@ def _routes() -> list[dict]:
              body=_body("GenerateRequest"),
              responses=dict([ok, _resp(404, "Unknown model"),
                              _resp(429, "Admission queue full "
-                                        "(PENROZ_SCHED_MAX_QUEUE) — retry "
-                                        "after Retry-After seconds"),
+                                        "(PENROZ_SCHED_MAX_QUEUE / "
+                                        "per-class PENROZ_QOS_MAX_QUEUE_*) "
+                                        "or tenant token quota exhausted "
+                                        "(PENROZ_QOS_TENANT_TOKENS_PER_S) "
+                                        "— retry after the load-aware "
+                                        "Retry-After seconds"),
                              _resp(503, "Engine circuit breaker open "
                                         "(PENROZ_ENGINE_MAX_CRASHES "
                                         "consecutive crashes)"),
@@ -163,8 +167,9 @@ def _routes() -> list[dict]:
              responses=dict([ok, _resp(404, "Unknown model"),
                              _resp(400, "Prompt + max_new_tokens exceeds "
                                         "block_size, or an empty prompt"),
-                             _resp(429, "Admission queue full (any shed "
-                                        "row sheds the batch)"),
+                             _resp(429, "Admission queue full or tenant "
+                                        "quota exhausted (any shed row "
+                                        "sheds the batch)"),
                              _resp(503, "Engine circuit breaker open"),
                              _resp(504, "Row deadline exceeded")])),
         dict(method="post", path="/decode/", summary="Decode token ids",
@@ -228,6 +233,20 @@ def _routes() -> list[dict]:
                  "content": {"application/json": {"schema": {
                      "$ref": "#/components/schemas/ServingStatsResponse"}}},
              }}),
+        dict(method="get", path="/tenants/",
+             summary="Tenant quota state: per-tenant rate overrides, "
+                     "tokens charged, and quota-shed counts "
+                     "(serve/qos.py token buckets)",
+             responses=dict([ok])),
+        dict(method="put", path="/tenants/{tenant_id}/quota",
+             summary="Set (or clear with null) a tenant's token-rate "
+                     "override of PENROZ_QOS_TENANT_TOKENS_PER_S; an "
+                     "exhausted bucket 429s that tenant's new admissions "
+                     "with a refill-derived Retry-After while in-flight "
+                     "rows finish",
+             body=_body("TenantQuotaRequest"),
+             responses=dict([ok, _resp(400, "Negative tokens_per_s"),
+                             _resp(422, "Validation error")])),
         dict(method="delete", path="/model/", summary="Delete a model",
              params=_query_params("model_id"),
              responses=dict([_resp(204, "Deleted")])),
@@ -242,7 +261,7 @@ def build_spec() -> dict:
         schemas.GenerateRequest, schemas.GenerateBatchRequest,
         schemas.DecodeTokensRequest,
         schemas.TrainingRequest, schemas.ProfileRequest,
-        schemas.CreateAdapterRequest,
+        schemas.CreateAdapterRequest, schemas.TenantQuotaRequest,
         schemas.ServingStatsResponse,
     ]
     _, defs = models_json_schema(

@@ -96,6 +96,17 @@ class GenerateRequest(ModelRequest):
         "/adapters/ or a /train/ adapter run creates one). Unknown "
         "adapter → 400 naming it; still loading → 409. Mixed adapters "
         "share one decode batch under PENROZ_CONTINUOUS_BATCHING=1")
+    priority: Optional[str] = Field(
+        None, description="SLO class: 'interactive' | 'standard' | "
+        "'batch' (default 'standard'). Classes drain by deficit-weighted "
+        "round robin (PENROZ_QOS_WEIGHTS); an interactive arrival may "
+        "preempt a lower-class decode row (PENROZ_QOS_PREEMPT)")
+    tenant: Optional[str] = Field(
+        None, description="Tenant id for fair queuing + token quotas "
+        "(default: adapter_id, else 'default'). An exhausted tenant "
+        "token bucket 429s new admissions with a refill-derived "
+        "Retry-After (PENROZ_QOS_TENANT_TOKENS_PER_S / PUT "
+        "/tenants/{id}/quota)")
 
 
 class GenerateBatchRequest(ModelRequest):
@@ -123,6 +134,23 @@ class GenerateBatchRequest(ModelRequest):
         "model); length must equal inputs. Rows with different adapters "
         "share one decode batch; unknown adapters 400 naming the rows, "
         "still-loading adapters 409")
+    priority: Optional[str] = Field(
+        None, description="SLO class applied to every row: 'interactive' "
+        "| 'standard' | 'batch' (default 'standard')")
+    tenant: Optional[str] = Field(
+        None, description="Tenant id applied to every row for fair "
+        "queuing + token quotas (default: the row's adapter id, else "
+        "'default')")
+
+
+class TenantQuotaRequest(BaseModel):
+    """PUT /tenants/{tenant_id}/quota — per-tenant token-rate override
+    of PENROZ_QOS_TENANT_TOKENS_PER_S (serve/qos.py token bucket over
+    emitted + prefilled tokens).  Null restores the env default."""
+    tokens_per_s: Optional[float] = Field(
+        ..., description="Sustained token budget per second (burst = 1s "
+        "of rate, min 1 token); 0 blocks all new admissions for the "
+        "tenant; null clears the override back to the env default")
 
 
 class CreateAdapterRequest(ModelRequest):
@@ -232,12 +260,39 @@ class EngineStats(BaseModel):
         "paged pool")
     queue_rejections: int = Field(0, description="Requests shed 429 at a "
                                   "full admission queue "
-                                  "(PENROZ_SCHED_MAX_QUEUE)")
+                                  "(PENROZ_SCHED_MAX_QUEUE / per-class "
+                                  "PENROZ_QOS_MAX_QUEUE_*)")
     deadline_timeouts: int = Field(0, description="Requests shed 504 "
                                    "(queued) or retired mid-flight on an "
                                    "expired deadline")
     breaker_rejections: int = Field(0, description="Submits refused 503 "
                                     "while the circuit breaker was open")
+    quota_rejections: int = Field(0, description="Admissions shed 429 by "
+                                  "an exhausted tenant token bucket "
+                                  "(PENROZ_QOS_TENANT_TOKENS_PER_S / PUT "
+                                  "/tenants/{id}/quota)")
+    preemptions: int = Field(0, description="Decode rows evicted mid-"
+                             "generation for a queued interactive "
+                             "admission (PENROZ_QOS_PREEMPT)")
+    preempted_resume_cached_tokens: int = Field(
+        0, description="Prompt+generated tokens restored from the prefix "
+        "cache — zero recompute — when preempted requests resumed")
+    queue_depth_by_class: dict[str, int] = Field(
+        default_factory=dict, description="Waiting requests per SLO class "
+        "(interactive/standard/batch)")
+    admissions_by_class: dict[str, int] = Field(
+        default_factory=dict, description="Rows admitted per SLO class "
+        "over the engine lifetime")
+    tenant_tokens: dict[str, int] = Field(
+        default_factory=dict, description="Tokens emitted per tenant id "
+        "(quota accounting view; tenant = explicit field > adapter id > "
+        "'default')")
+    ttft_ms_p99_by_class: dict[str, Optional[float]] = Field(
+        default_factory=dict, description="p99 enqueue → first token per "
+        "SLO class (null before any admission of that class)")
+    queue_wait_ms_p99_by_class: dict[str, Optional[float]] = Field(
+        default_factory=dict, description="p99 enqueue → admission wait "
+        "per SLO class")
     queue_wait_ms_p99: Optional[float] = Field(
         None, description="p99 enqueue → admission (prefill start) wait")
     breaker_open: bool = Field(False, description="Circuit breaker state "
@@ -325,6 +380,26 @@ class ServingStatsResponse(BaseModel):
                                   "sheds")
     deadline_timeouts: int = Field(0, description="Aggregate deadline "
                                    "expiries (queued + in flight)")
+    quota_rejections: int = Field(0, description="Aggregate 429 tenant-"
+                                  "quota sheds")
+    preemptions_total: int = Field(0, description="Aggregate mid-"
+                                   "generation row evictions for "
+                                   "interactive admissions")
+    preempted_resume_cached_tokens: int = Field(
+        0, description="Aggregate tokens restored from the prefix cache "
+        "(zero recompute) when preempted requests resumed")
+    queue_depth_by_class: dict[str, int] = Field(
+        default_factory=dict, description="Aggregate waiting requests per "
+        "SLO class")
+    tenant_tokens: dict[str, int] = Field(
+        default_factory=dict, description="Aggregate tokens emitted per "
+        "tenant id")
+    ttft_ms_p99_by_class: dict[str, Optional[float]] = Field(
+        default_factory=dict, description="p99 enqueue → first token per "
+        "SLO class across engines (merged histogram buckets)")
+    queue_wait_ms_p99_by_class: dict[str, Optional[float]] = Field(
+        default_factory=dict, description="p99 enqueue → admission wait "
+        "per SLO class across engines")
     queue_wait_ms_p99: Optional[float] = Field(
         None, description="p99 enqueue → admission wait across engines")
     breaker_open: bool = Field(False, description="True if ANY engine's "

@@ -266,7 +266,27 @@ function renderServing(data) {
     ? `breaker OPEN (${crashes} crashes, ${data.engine_resets || 0} resets)`
     : `breaker ok (${crashes} crashes)`;
   const shedTxt = `shed ${data.queue_rejections || 0} · ` +
+    `quota shed ${data.quota_rejections || 0} · ` +
     `timeouts ${data.deadline_timeouts || 0}`;
+  /* Multi-tenant QoS (serve/qos.py): per-class p99 TTFT breakdown, the
+   * preemption counter with its zero-recompute resume credit, and the
+   * per-tenant token totals — "qos idle" until any non-default class,
+   * tenant, or preemption shows up. */
+  const ttftCls = data.ttft_ms_p99_by_class || {};
+  const clsTxt = ["interactive", "standard", "batch"]
+    .filter((c) => ttftCls[c] != null)
+    .map((c) => `${c.slice(0, 5)} ${ttftCls[c].toFixed(0)}ms`)
+    .join(" / ");
+  const tenants = Object.entries(data.tenant_tokens || {});
+  const tenantTxt = tenants.length === 0 ? ""
+    : ` · tenants ${tenants.slice(0, 4)
+        .map(([t, n]) => `${t}:${n}`).join(" ")}` +
+      (tenants.length > 4 ? ` +${tenants.length - 4}` : "");
+  const preempts = data.preemptions_total || 0;
+  const qosTxt = (!clsTxt && !preempts && !tenants.length) ? "qos idle"
+    : `ttft p99 [${clsTxt || "—"}] · preempts ${preempts} ` +
+      `(${data.preempted_resume_cached_tokens || 0} tok resumed cached)` +
+      tenantTxt;
   meta.textContent =
     `rows ${data.active_rows}/${data.capacity} (occupancy ` +
     `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +
@@ -277,7 +297,8 @@ function renderServing(data) {
        : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
     `${multistepTxt} · ` +
-    `${specTxt} · ${loraTxt} · ${prefixTxt} · KV pool drops ${drops}`;
+    `${specTxt} · ${loraTxt} · ${prefixTxt} · ${qosTxt} · ` +
+    `KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();
   const xs = servingHistory.map((_, i) => i);
@@ -342,6 +363,7 @@ const SPAN_COLORS = {
   queue: "#5d7285", prefill: "#e0b35c", prefill_chunk: "#c77d0a",
   decode: "#7aa2f7", decode_step: "#56b6c2", verify: "#b58cd9",
   recovery: "#e06c75", legacy_generate: "#98c379",
+  preempt: "#d19a66", resume: "#7fd1b9",
 };
 
 function flattenSpans(span, depth, out) {

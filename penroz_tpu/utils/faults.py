@@ -27,7 +27,9 @@ Registered production sites: ``decode.step`` (shared decode step),
 (speculative-decoding multi-token verify step), ``ckpt.write``
 (checkpoint container write), ``data.download`` (dataset download
 attempt), ``lora.load`` (adapter-checkpoint load into the serving
-registry, serve/adapters.py).  Call counters are per-site and process-wide; tests reset them
+registry, serve/adapters.py), ``qos.preempt`` (top of the QoS row-eviction
+path, serve/decode_scheduler.py — crash-during-preemption recovery).
+Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.
 """
 

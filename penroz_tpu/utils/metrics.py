@@ -231,33 +231,66 @@ class Gauge(Metric):
 
 class Histogram(Metric):
     """Registered histogram wrapping :class:`Hist`; renders cumulative
-    ``_bucket`` series plus ``_sum`` / ``_count``."""
+    ``_bucket`` series plus ``_sum`` / ``_count``.  With ``labelnames``
+    each observed label set gets its own :class:`Hist` and renders as a
+    distinct series family (``_bucket{le=...,priority=...}`` etc.) — the
+    per-SLO-class latency breakdowns the QoS layer exports."""
 
     kind = "histogram"
 
     def __init__(self, name: str, help_text: str,
-                 buckets=LATENCY_BUCKETS_MS):
+                 buckets=LATENCY_BUCKETS_MS, labelnames=()):
         super().__init__(name, help_text)
-        self.hist = Hist(buckets)
+        self.labelnames = tuple(labelnames)
+        self._buckets = tuple(buckets)
+        self.hist = Hist(buckets)          # unlabeled fast path
+        self._hists: dict = {}             # label-key tuple -> Hist
+        self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
-        self.hist.observe(value)
+    def observe(self, value: float, **labels) -> None:
+        assert set(labels) == set(self.labelnames), (labels, self.labelnames)
+        if not self.labelnames:
+            self.hist.observe(value)
+            return
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Hist(self._buckets)
+        h.observe(value)
 
-    def sample_lines(self) -> list[str]:
-        snap = self.hist.snapshot()
+    def _series_lines(self, snap: dict, label_body: str) -> list[str]:
+        # label_body is "" or 'k="v",...' — le= is appended alongside so
+        # every series in the family carries the full label set.
+        sep = "," if label_body else ""
         lines = []
         cum = 0
         for edge, c in zip(snap["buckets"], snap["counts"]):
             cum += c
-            lines.append(f'{self.name}_bucket{{le="{_fmt_value(edge)}"}} '
-                         f"{cum}")
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {snap["count"]}')
-        lines.append(f"{self.name}_sum {_fmt_value(snap['sum'])}")
-        lines.append(f"{self.name}_count {snap['count']}")
+            lines.append(f'{self.name}_bucket{{{label_body}{sep}'
+                         f'le="{_fmt_value(edge)}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{{label_body}{sep}le="+Inf"}} '
+                     f'{snap["count"]}')
+        suffix = "{" + label_body + "}" if label_body else ""
+        lines.append(f"{self.name}_sum{suffix} {_fmt_value(snap['sum'])}")
+        lines.append(f"{self.name}_count{suffix} {snap['count']}")
+        return lines
+
+    def sample_lines(self) -> list[str]:
+        if not self.labelnames:
+            return self._series_lines(self.hist.snapshot(), "")
+        with self._lock:
+            items = sorted(self._hists.items())
+        lines = []
+        for key, h in items:
+            body = _fmt_labels(dict(zip(self.labelnames, key)))[1:-1]
+            lines.extend(self._series_lines(h.snapshot(), body))
         return lines
 
     def reset(self) -> None:
-        self.hist = Hist(self.hist.buckets)
+        self.hist = Hist(self._buckets)
+        with self._lock:
+            self._hists.clear()
 
 
 class Registry:

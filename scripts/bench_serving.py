@@ -68,6 +68,27 @@ aggregate ``tokens_per_decode_step`` + ``spec_accept_rate`` fields via
 ``PENROZ_BENCH_SPEC_VOCAB``, plus the shared ``PENROZ_BENCH_SERVING_*`` /
 ``PENROZ_BENCH_REQUESTS`` / ``PENROZ_BENCH_MAX_NEW`` set.
 
+``--mixed-slo`` switches to the SLO-tiered QoS workload (PR 8): a batch
+flood saturates a deliberately small engine while interactive probes
+stream through it, measured classless (``fifo`` — the pre-QoS single
+sub-queue) then with SLO classes + preemption (``qos``).  Headline
+fields: ``slo_ok_qos`` (interactive p99 TTFT under QoS within the
+``PENROZ_BENCH_QOS_SLO_MS`` budget, default 50 ms, floored at 2× the
+unloaded p99) and ``slo_exceeded_fifo`` (FIFO blows that budget).  A final
+``quota`` phase pins per-tenant shedding: only the over-budget tenant
+429s, the victim completes with greedy parity.  Scale knobs:
+``PENROZ_BENCH_QOS_ROWS/_FLOOD/_PROBES/_PROBE_NEW/_RATE`` plus the
+shared ``PENROZ_BENCH_SERVING_BLOCK`` / ``PENROZ_BENCH_MAX_NEW``.
+
+``--chaos`` arms ONE fault site (``PENROZ_BENCH_CHAOS_SITE``, default
+``qos.preempt``; Nth trigger via ``PENROZ_BENCH_CHAOS_AT``) and drives
+mixed-priority overload waves through it — the building block
+``scripts/chaos_matrix.sh`` sweeps across every registered site ×
+superstep {1, 8}.  Reports the status histogram (anything outside
+200/429/503/504 — plus the armed crash's own 500s — lands in
+``disallowed``), crash/preemption counts, and post-fault greedy parity
+(``parity_ok``); ``ok`` is the single gate the matrix script checks.
+
 Observability (PR 6): every scenario scrapes ``GET /metrics`` before and
 after its run and embeds the counter/histogram deltas as
 ``metrics_delta`` in the JSON capture — committed bench captures double
@@ -874,6 +895,359 @@ async def _bench_speculative() -> dict:
                 os.environ[key] = v
 
 
+# ---------------------------------------------------------------------------
+# --mixed-slo: SLO-tiered QoS (WFQ + preemption + tenant quotas, PR 8)
+# ---------------------------------------------------------------------------
+
+async def _bench_mixed_slo() -> dict:
+    """Interactive p99 TTFT under a batch flood, FIFO vs QoS.
+
+    Three phases against one small engine (rows/queue deliberately under
+    offered load):
+
+    - ``unloaded``: sequential interactive streams, no contention — the
+      TTFT yardstick.
+    - ``fifo``: flood + probes all submitted classless into the single
+      default sub-queue (the pre-QoS scheduler, byte-for-byte) — probes
+      queue behind the whole flood.
+    - ``qos``: the same offered load, flood tagged ``batch`` and probes
+      ``interactive`` — WFQ admission + preempt-to-prefix-cache-resume
+      must hold probe TTFT near unloaded while the flood saturates rows.
+
+    Headline fields: ``slo_ok_qos`` (interactive p99 TTFT under QoS
+    within the absolute ``PENROZ_BENCH_QOS_SLO_MS`` budget, default
+    50 ms, floored at 2× the unloaded p99 so a slow host can't make the
+    target unmeetable) and ``slo_exceeded_fifo`` (FIFO blows the budget —
+    i.e. the win is real, not slack).  A fourth ``quota`` phase sets a
+    tiny token rate for one tenant and fires offender + victim waves:
+    only the offender 429s, the victim completes with greedy parity.
+    """
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 128)
+    rows = _env_i("PENROZ_BENCH_QOS_ROWS", 2)
+    flood_n = _env_i("PENROZ_BENCH_QOS_FLOOD", 6)
+    # default probes == rows: every probe preempts straight into a row;
+    # more probes than rows measures probe-behind-probe wait, not QoS
+    probes_n = _env_i("PENROZ_BENCH_QOS_PROBES", rows)
+    flood_new = _env_i("PENROZ_BENCH_MAX_NEW", 24)
+    probe_new = _env_i("PENROZ_BENCH_QOS_PROBE_NEW", 8)
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        decode_scheduler.MAX_ROWS_ENV: str(rows),
+        decode_scheduler.MAX_QUEUE_ENV: "0",       # shedding is not the
+        "PAGED_KV_CACHE": "1",                     # phenomenon under test
+        "PENROZ_KV_PAGE_SIZE": "16",
+        "PENROZ_PREFIX_CACHE": "1",
+        "PENROZ_PREFIX_CACHE_PAGES": "64",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(9)
+    probe_len = _env_i("PENROZ_BENCH_QOS_PROBE_PROMPT", 24)
+    # flood prompts span at least one full KV page (16 tokens) so a victim
+    # preempted early still has a whole page to alias on resume
+    flood_prompts = [[int(t) for t in rng.integers(1, 255, 18 + (i % 3))]
+                     for i in range(flood_n)]
+    probe_prompts = [[int(t) for t in rng.integers(1, 255, probe_len)]
+                     for _ in range(probes_n)]
+
+    def payload(prompt, max_new, **qos_fields):
+        body = {"model_id": "bench-qos", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+        body.update(qos_fields)
+        return body
+
+    async def one(prompt, max_new, **qos_fields):
+        resp = await client.post(
+            "/generate/", json=payload(prompt, max_new, **qos_fields))
+        return resp.status, (await resp.json() if resp.status != 204
+                             else None)
+
+    async def probe(prompt, **qos_fields):
+        toks, ttft_ms, _ = await _stream_one(
+            client, payload(prompt, probe_new, **qos_fields))
+        return toks, ttft_ms
+
+    results: dict = {"mode": "mixed_slo", "block_size": block,
+                     "capacity_rows": rows, "flood": flood_n,
+                     "probes": probes_n, "flood_max_new": flood_new,
+                     "probe_max_new": probe_new}
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-qos", "layers": _toy_gpt(
+                d=128, depth=2, block=block),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
+
+        # greedy baselines (and program warm-up) for every prompt shape
+        flood_base = {}
+        for p in flood_prompts:
+            status, body = await one(p, flood_new)
+            assert status == 200, body
+            flood_base[tuple(p)] = body["tokens"]
+        probe_base = {}
+        for p in probe_prompts:
+            toks, _ = await probe(p)
+            probe_base[tuple(p)] = toks
+
+        async def saturate(min_queued=0):
+            for _ in range(300):
+                resp = await client.get("/serving_stats/")
+                stats = await resp.json()
+                if stats["active_rows"] >= rows \
+                        and stats["queue_depth"] >= min_queued:
+                    return
+                await asyncio.sleep(0.02)
+
+        # Warm the preempt/resume programs BEFORE any measured phase: the
+        # first eviction compiles the restored-prefix prefill shape, and
+        # that one-time cost must not land inside a probe's measured TTFT.
+        warm = [asyncio.ensure_future(one(p, flood_new, priority="batch"))
+                for p in flood_prompts[:rows]]
+        await saturate()
+        await probe(probe_prompts[0], priority="interactive")
+        for task in warm:
+            status, body = await task
+            assert status == 200, body
+
+        # phase 1 — unloaded interactive TTFT yardstick
+        ttfts = []
+        for p in probe_prompts:
+            toks, ttft_ms = await probe(p, priority="interactive")
+            assert toks == probe_base[tuple(p)]
+            ttfts.append(ttft_ms)
+        results["unloaded_ttft_ms_p50"] = round(_pct(ttfts, 0.5), 3)
+        results["unloaded_ttft_ms_p99"] = round(_pct(ttfts, 0.99), 3)
+
+        async def loaded_phase(name, flood_fields, probe_fields):
+            parity = True
+            flood_tasks = [asyncio.ensure_future(
+                one(p, flood_new, **flood_fields)) for p in flood_prompts]
+            # probes go out only once the flood holds every row AND has a
+            # queued backlog — the regime the two phases disagree about
+            await saturate(min_queued=1)
+            probed = await asyncio.gather(
+                *[probe(p, **probe_fields) for p in probe_prompts])
+            ttfts = []
+            for p, (toks, ttft_ms) in zip(probe_prompts, probed):
+                parity = parity and toks == probe_base[tuple(p)]
+                ttfts.append(ttft_ms)
+            for task, p in zip(flood_tasks, flood_prompts):
+                status, body = await task
+                assert status == 200, body
+                parity = parity and body["tokens"] == flood_base[tuple(p)]
+            results[f"{name}_ttft_ms_p50"] = round(_pct(ttfts, 0.5), 3)
+            results[f"{name}_ttft_ms_p99"] = round(_pct(ttfts, 0.99), 3)
+            results[f"{name}_parity_ok"] = parity
+
+        # phase 2 — FIFO: classless flood AND probes share one sub-queue
+        os.environ["PENROZ_QOS_PREEMPT"] = "0"
+        await loaded_phase("fifo", {}, {})
+        # phase 3 — QoS: same load, SLO classes + preemption armed
+        os.environ["PENROZ_QOS_PREEMPT"] = "1"
+        await loaded_phase("qos", {"priority": "batch"},
+                           {"priority": "interactive"})
+        os.environ.pop("PENROZ_QOS_PREEMPT", None)
+
+        # Absolute interactive-TTFT SLO, floored at 2x the unloaded p99 so
+        # a slow host never turns the budget into an unmeetable target.
+        slo_ms = float(os.environ.get("PENROZ_BENCH_QOS_SLO_MS", "50"))
+        budget = max(slo_ms, 2.0 * results["unloaded_ttft_ms_p99"])
+        results["ttft_budget_ms"] = round(budget, 3)
+        results["slo_ok_qos"] = results["qos_ttft_ms_p99"] < budget
+        results["slo_exceeded_fifo"] = results["fifo_ttft_ms_p99"] >= budget
+
+        # phase 4 — tenant quota: only the offender sheds
+        rate = _env_i("PENROZ_BENCH_QOS_RATE", 8)
+        resp = await client.put("/tenants/offender/quota",
+                                json={"tokens_per_s": rate})
+        assert resp.status == 200, await resp.text()
+        counts = {"offender": {}, "victim": {}}
+        parity = True
+        for _ in range(3):
+            jobs = [one(p, flood_new, tenant=t)
+                    for t in ("offender", "victim")
+                    for p in flood_prompts[:2]]
+            for i, (status, body) in enumerate(await asyncio.gather(*jobs)):
+                tenant = "offender" if i < 2 else "victim"
+                c = counts[tenant]
+                c[status] = c.get(status, 0) + 1
+                if status == 200 and tenant == "victim":
+                    parity = parity and body["tokens"] == flood_base[
+                        tuple(flood_prompts[i - 2])]
+        await client.put("/tenants/offender/quota",
+                         json={"tokens_per_s": None})
+        results["quota"] = {
+            "tokens_per_s": rate,
+            "offender_statuses": counts["offender"],
+            "victim_statuses": counts["victim"],
+            "offender_shed": counts["offender"].get(429, 0) > 0,
+            "victim_clean": set(counts["victim"]) == {200},
+            "victim_parity_ok": parity,
+        }
+
+        resp = await client.get("/serving_stats/")
+        stats = await resp.json()
+        stats.pop("engines", None)
+        stats.pop("tick_timeline", None)
+        results["preemptions"] = stats.get("preemptions_total", 0)
+        results["resume_cached_tokens"] = stats.get(
+            "preempted_resume_cached_tokens", 0)
+        results["serving_stats"] = stats
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        os.environ.pop("PENROZ_QOS_PREEMPT", None)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# --chaos: one armed fault site under overload (scripts/chaos_matrix.sh)
+# ---------------------------------------------------------------------------
+
+async def _bench_chaos() -> dict:
+    """Overload waves with ONE fault site armed (``PENROZ_BENCH_CHAOS_SITE``,
+    ``site:raise@N`` via utils/faults.py), mixed-priority so the QoS
+    preemption path runs too.  The contract chaos_matrix.sh enforces:
+
+    - while armed, every response is 200/429/503/504 — plus 500 for the
+      requests the injected crash itself fails (InjectedFault surfaces as
+      a 500 to the victims of that one tick; anything else is a bug);
+    - after the fault clears, a solo replay of every prompt is greedy
+      token-identical to its pre-chaos baseline (``parity_ok``) — crash
+      recovery must rebuild state, not corrupt it.
+
+    Sites that never execute during a serving workload (ckpt.write,
+    data.download) pass trivially: arming them must not disturb serving.
+    """
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+
+    site = os.environ.get("PENROZ_BENCH_CHAOS_SITE", "qos.preempt")
+    at = _env_i("PENROZ_BENCH_CHAOS_AT", 3)
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 128)
+    rows = _env_i("PENROZ_BENCH_OVER_ROWS", 2)
+    waves = _env_i("PENROZ_BENCH_OVER_WAVES", 2)
+    offered = _env_i("PENROZ_BENCH_OVER_N", 8)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 12)
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        decode_scheduler.MAX_ROWS_ENV: str(rows),
+        decode_scheduler.MAX_QUEUE_ENV: "4",
+        "PAGED_KV_CACHE": "1",
+        "PENROZ_KV_PAGE_SIZE": "16",
+        "PENROZ_PREFIX_CACHE": "1",
+        "PENROZ_PREFIX_CACHE_PAGES": "64",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    saved[faults.ENV] = os.environ.get(faults.ENV)
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(7)
+    prompts = [[int(t) for t in rng.integers(1, 255, 4 + (i % 4))]
+               for i in range(offered)]
+    # mixed-priority offered load: tail requests are interactive so the
+    # row-full + interactive-queued preemption path actually executes
+    klass = ["batch" if i < offered - 2 else "interactive"
+             for i in range(offered)]
+
+    async def one(prompt, priority=None):
+        body = {"model_id": "bench-chaos", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+        if priority:
+            body["priority"] = priority
+        resp = await client.post("/generate/", json=body)
+        return resp.status, (await resp.json() if resp.status != 204
+                             else None)
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-chaos", "layers": _toy_gpt(
+                d=128, depth=2, block=block),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+
+        baselines = {}
+        for p in prompts:
+            status, body = await one(p)
+            assert status == 200, body
+            baselines[tuple(p)] = body["tokens"]
+
+        os.environ[faults.ENV] = f"{site}:raise@{at}"
+        faults.reset()
+        statuses: dict = {}
+        for _ in range(waves):
+            results = await asyncio.gather(
+                *[one(p, k) for p, k in zip(prompts, klass)])
+            for status, _ in results:
+                statuses[status] = statuses.get(status, 0) + 1
+        os.environ.pop(faults.ENV, None)
+        faults.reset()
+
+        allowed = {200, 429, 500, 503, 504}
+        disallowed = {s: n for s, n in statuses.items() if s not in allowed}
+
+        # breaker may still be cooling down after the injected crash —
+        # wait it out before the parity replay (solo, fault cleared)
+        deadline = time.perf_counter() + 30.0
+        parity_ok = True
+        for p in prompts:
+            while True:
+                status, body = await one(p)
+                if status == 200:
+                    parity_ok = parity_ok \
+                        and body["tokens"] == baselines[tuple(p)]
+                    break
+                assert status == 503, (status, body)
+                assert time.perf_counter() < deadline, "breaker stuck open"
+                await asyncio.sleep(0.2)
+
+        resp = await client.get("/serving_stats/")
+        stats = await resp.json()
+        return {
+            "mode": "chaos", "site": site, "raise_at": at,
+            "superstep": _env_i(decode_scheduler.SUPERSTEP_ENV, 8),
+            "offered_requests": sum(statuses.values()),
+            "statuses": {str(s): n for s, n in sorted(statuses.items())},
+            "disallowed": {str(s): n for s, n in disallowed.items()},
+            "crashes_total": stats.get("crashes_total", 0),
+            "preemptions": stats.get("preemptions_total", 0),
+            "parity_ok": parity_ok,
+            "ok": not disallowed and parity_ok,
+        }
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        faults.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _emit(results: dict):
     line = json.dumps(results)
     print(line)
@@ -886,12 +1260,15 @@ def _emit(results: dict):
 def main():
     args = [a for a in sys.argv[1:]
             if a not in ("--shared-prefix", "--overload", "--speculative",
-                         "--multi-adapter", "--multistep")]
+                         "--multi-adapter", "--multistep", "--mixed-slo",
+                         "--chaos")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     speculative = "--speculative" in sys.argv[1:]
     multi_adapter = "--multi-adapter" in sys.argv[1:]
     multistep = "--multistep" in sys.argv[1:]
+    mixed_slo = "--mixed-slo" in sys.argv[1:]
+    chaos = "--chaos" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
@@ -919,6 +1296,12 @@ def main():
         return
     if multistep:
         _emit(asyncio.run(_bench_multistep()))
+        return
+    if mixed_slo:
+        _emit(asyncio.run(_bench_mixed_slo()))
+        return
+    if chaos:
+        _emit(asyncio.run(_bench_chaos()))
         return
     concurrency = int(args[0]) if len(args) > 0 else 8
     max_new = int(args[1]) if len(args) > 1 else 48

@@ -99,6 +99,20 @@ while true; do
           -- "BENCH_MULTISTEP_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) multi-step capture committed" >> logs/bench_watch.log
     fi
+    # SLO-tiered QoS capture (same shape as the shared-prefix hook):
+    # interactive p99 TTFT under a batch flood, FIFO vs WFQ+preemption,
+    # plus the tenant-quota offender/victim split.  Opt-in; failures must
+    # not block the main capture.
+    if [ "${PENROZ_WATCH_QOS:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_QOS_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --mixed-slo \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_QOS_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: mixed-SLO QoS capture" \
+          -- "BENCH_QOS_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) mixed-SLO QoS capture committed" >> logs/bench_watch.log
+    fi
     # Multi-tenant LoRA capture (same shape as the shared-prefix hook):
     # mixed-adapter ITL/wall vs per-adapter serial groups + parity.
     # Opt-in; failures must not block the main capture.

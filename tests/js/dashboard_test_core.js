@@ -190,6 +190,17 @@ async function runDashboardTests(src, fixtures) {
                `lora ${fixtures.serving.lora_active_adapters} adapters · ` +
                `${fixtures.serving.lora_rows} rows`),
              "serving tile shows live LoRA adapters and bound rows");
+    assertOk(servingMeta.includes(
+               `quota shed ${fixtures.serving.quota_rejections}`),
+             "serving tile shows tenant quota shed count");
+    assertOk(servingMeta.includes(
+               `preempts ${fixtures.serving.preemptions_total} ` +
+               `(${fixtures.serving.preempted_resume_cached_tokens} ` +
+               "tok resumed cached)"),
+             "serving tile shows QoS preemptions + cached resume credit");
+    assertOk(servingMeta.includes("tenant-a:" +
+               fixtures.serving.tenant_tokens["tenant-a"]),
+             "serving tile shows the per-tenant token breakdown");
     const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
     assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];
@@ -266,7 +277,9 @@ async function runDashboardTests(src, fixtures) {
     const servingOff = Object.assign({}, fixtures.serving, {
       prefix_cache_hit_rate: null, prefill_chunk_stall_ms_p99: null,
       spec_decode_enabled: false, spec_accept_rate: null,
-      lora_active_adapters: 0, lora_rows: 0, lora_adapter_tokens: {} });
+      lora_active_adapters: 0, lora_rows: 0, lora_adapter_tokens: {},
+      preemptions_total: 0, preempted_resume_cached_tokens: 0,
+      tenant_tokens: {}, ttft_ms_p99_by_class: {} });
     const { document } = await runDashboard(src, {
       progress: fixtures.progress, stats: fixtures.statsPlain,
       serving: servingOff });
@@ -281,6 +294,8 @@ async function runDashboardTests(src, fixtures) {
              "no tokens-per-step readout while speculation is off");
     assertOk(servingMeta.includes("lora off"),
              "serving tile shows 'lora off' with zero live adapters");
+    assertOk(servingMeta.includes("qos idle"),
+             "serving tile degrades to 'qos idle' with no QoS activity");
   }
 
   // 2d. spec decode enabled but no draft yet: accept rate dashes instead

@@ -233,3 +233,84 @@ def test_multistep_bench_smoke(tmp_path):
     delta = results["metrics_delta"]
     assert delta["penroz_dispatches_total"] > 0, delta
     assert delta["penroz_tokens_per_dispatch_count"] > 0, delta
+
+
+def test_mixed_slo_bench_smoke(tmp_path):
+    """--mixed-slo (PR 8): under an identical batch flood, WFQ admission +
+    preempt-to-prefix-cache-resume must hold interactive TTFT strictly
+    below the classless-FIFO phase (the committed full-scale capture
+    additionally demonstrates the absolute PENROZ_BENCH_QOS_SLO_MS
+    budget; at smoke scale only the FIFO-exceeds-budget half and the
+    ordering are timing-safe), with
+    greedy parity everywhere and quota shedding that hits ONLY the
+    offending tenant."""
+    out_path = tmp_path / "mixed_slo.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PENROZ_BENCH_SERVING_BLOCK="64",
+        PENROZ_BENCH_QOS_ROWS="2",
+        PENROZ_BENCH_QOS_FLOOD="4",
+        PENROZ_BENCH_QOS_PROBES="2",
+        PENROZ_BENCH_MAX_NEW="16",
+        PENROZ_BENCH_QOS_PROBE_NEW="4",
+        PENROZ_BENCH_QOS_RATE="4",
+        PENROZ_BENCH_JSON_OUT=str(out_path),
+    )
+    proc = subprocess.run([sys.executable, SCRIPT, "--mixed-slo"],
+                          capture_output=True, text=True, timeout=900,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.loads(out_path.read_text()) == results
+
+    assert results["mode"] == "mixed_slo"
+    assert results["unloaded_ttft_ms_p99"] > 0
+    # the headline ordering: QoS strictly beats FIFO for interactive TTFT,
+    # and FIFO really is pathological (probes queue behind the flood)
+    assert results["qos_ttft_ms_p99"] < results["fifo_ttft_ms_p99"], results
+    assert results["slo_exceeded_fifo"] is True, results
+    # priorities never buy latency with wrong tokens
+    assert results["fifo_parity_ok"] is True
+    assert results["qos_parity_ok"] is True
+    # the QoS phase actually exercised eviction + zero-recompute resume
+    assert results["preemptions"] >= 1, results
+    assert results["resume_cached_tokens"] >= 1, results
+    quota = results["quota"]
+    assert quota["offender_shed"] is True, quota
+    assert quota["victim_clean"] is True, quota
+    assert quota["victim_parity_ok"] is True, quota
+
+
+def test_chaos_matrix_fast_subset(tmp_path):
+    """scripts/chaos_matrix.sh CHAOS_FAST=1 (PR 8): the qos.preempt x
+    superstep-8 combo through the chaos overload bench — the injected
+    crash-at-preemption must surface only 200/429/503/504 (+ the crash's
+    own 500s), recover, and replay every prompt greedy-identical.  The
+    full site x superstep matrix is the same script without CHAOS_FAST."""
+    script = os.path.join(REPO, "scripts", "chaos_matrix.sh")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        CHAOS_FAST="1",
+        PENROZ_BENCH_SERVING_BLOCK="64",
+        PENROZ_BENCH_OVER_ROWS="2",
+        PENROZ_BENCH_OVER_N="6",
+        PENROZ_BENCH_OVER_WAVES="2",
+        PENROZ_BENCH_MAX_NEW="8",
+        PENROZ_BENCH_CHAOS_AT="1",   # crash the very first preemption
+    )
+    env.pop("PENROZ_BENCH_JSON_OUT", None)
+    proc = subprocess.run(["bash", script], capture_output=True, text=True,
+                          timeout=900, cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert results["mode"] == "chaos"
+    assert results["site"] == "qos.preempt"
+    assert results["superstep"] == 8
+    assert results["ok"] is True, results
+    assert results["disallowed"] == {}, results
+    # the fault really fired: the preemption path crashed and recovered
+    assert results["crashes_total"] >= 1, results
+    assert results["parity_ok"] is True
+    assert "chaos matrix: OK" in proc.stderr

@@ -26,15 +26,20 @@ SGD = {"sgd": {"lr": 0.1}}
 
 @pytest.fixture(autouse=True)
 def _scheduler_registry(workdir):
-    """Fresh engine registry + fault-injection counters per test: engines
-    cache model snapshots by id, and every test gets its own checkpoint
-    dir (workdir)."""
-    from penroz_tpu.serve import decode_scheduler
+    """Fresh engine registry + fault-injection counters + QoS quota state
+    per test: engines cache model snapshots by id, and every test gets its
+    own checkpoint dir (workdir)."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import decode_scheduler, qos
     from penroz_tpu.utils import faults
     faults.reset()
+    qos.reset()
+    KV.reset_unpin_underflow_count()
     yield
     decode_scheduler.reset()
     faults.reset()
+    qos.reset()
+    KV.reset_unpin_underflow_count()
 
 
 @pytest.fixture

@@ -39,17 +39,19 @@ def _observability_state(workdir):
     """Fresh engine registry, fault counters, trace ring, and metric
     registry per test — counters are process-wide by design, so tests
     must zero them to assert deltas."""
-    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.serve import decode_scheduler, qos
     from penroz_tpu.serve import metrics as serve_metrics
     from penroz_tpu.utils import faults, tracing
     faults.reset()
     tracing.reset()
     serve_metrics.reset()
+    qos.reset()
     yield
     decode_scheduler.reset()
     faults.reset()
     tracing.reset()
     serve_metrics.reset()
+    qos.reset()
 
 
 @pytest.fixture
@@ -183,27 +185,56 @@ def test_metrics_exposition_strict_format(client, gpt_model, monkeypatch):
         assert family in types, f"missing family {family}"
 
     # histogram invariants: cumulative buckets, +Inf == _count,
-    # counts/sums consistent
+    # counts/sums consistent — per label set, so the labeled QoS
+    # families (penroz_ttft_ms_by_class{priority=...}) are held to the
+    # same contract as the unlabeled ones
+    def _split_le(labels):
+        """('other-labels key', le-value) of a _bucket label blob."""
+        pairs = re.findall(r'(%s)="((?:[^"\\\n])*)"' % _NAME, labels or "")
+        le = [v for k, v in pairs if k == "le"]
+        assert len(le) == 1, f"bucket without exactly one le: {labels!r}"
+        rest = ",".join(f'{k}="{v}"' for k, v in pairs if k != "le")
+        return rest, le[0]
+
     histograms = [n for n, k in types.items() if k == "histogram"]
     assert histograms
     for family in histograms:
         rows = by_series.get(family, [])
-        buckets = [(labels, v) for n, labels, v in rows
-                   if n == family + "_bucket"]
-        counts = [v for n, _, v in rows if n == family + "_count"]
-        sums = [v for n, _, v in rows if n == family + "_sum"]
-        assert len(counts) == 1 and len(sums) == 1, family
-        assert buckets, family
-        assert buckets[-1][0] == '{le="+Inf"}', family
-        cum = [v for _, v in buckets]
-        assert cum == sorted(cum), f"{family} buckets not cumulative: {cum}"
-        assert cum[-1] == counts[0], f"{family} +Inf != _count"
-        edges = [labels[5:-2] for labels, _ in buckets[:-1]]
-        assert edges == sorted(edges, key=float), f"{family} edges unsorted"
-        if counts[0] == 0:
-            assert sums[0] == 0
-        else:
-            assert sums[0] > 0
+        if not rows:
+            # a labeled family with no observations yet renders only its
+            # HELP/TYPE header — nothing to check
+            continue
+        series: dict = {}
+        for n, labels, v in rows:
+            if n == family + "_bucket":
+                rest, le = _split_le(labels)
+                series.setdefault(rest, {"buckets": [], "counts": [],
+                                         "sums": []})["buckets"].append(
+                                             (le, v))
+            else:
+                rest, _ = _split_le((labels or "{}")[:-1] + ',le="x"}')
+                kind = "counts" if n == family + "_count" else "sums"
+                series.setdefault(rest, {"buckets": [], "counts": [],
+                                         "sums": []})[kind].append(v)
+        assert series, family
+        for rest, s in series.items():
+            ctx = f"{family}{{{rest}}}"
+            assert len(s["counts"]) == 1 and len(s["sums"]) == 1, ctx
+            assert s["buckets"], ctx
+            assert s["buckets"][-1][0] == "+Inf", ctx
+            cum = [v for _, v in s["buckets"]]
+            assert cum == sorted(cum), f"{ctx} buckets not cumulative: {cum}"
+            assert cum[-1] == s["counts"][0], f"{ctx} +Inf != _count"
+            edges = [le for le, _ in s["buckets"][:-1]]
+            assert edges == sorted(edges, key=float), f"{ctx} edges unsorted"
+            if s["counts"][0] == 0:
+                assert s["sums"][0] == 0
+            else:
+                assert s["sums"][0] > 0
+        if family in ("penroz_ttft_ms_by_class",
+                      "penroz_queue_wait_ms_by_class"):
+            # default traffic lands in exactly the standard class series
+            assert list(series) == ['priority="standard"'], family
 
     # traffic moved the counters the traffic should move
     flat = {name + (labels or ""): v for name, labels, v in samples}

@@ -330,16 +330,29 @@ class CompiledArch:
             specs.append((mod.num_kv_heads, mod.head_dim))
         return specs
 
+    def jit_program_counts(self) -> dict[str, int]:
+        """Live jitted-program count per function family — cache keys are
+        tuples whose first element names the family (``"sched_step"``,
+        ``"mixed_step"``, …).  The ``penroz_jit_programs`` gauge reads
+        this at scrape time: shape bucketing exists to keep these counts
+        bounded, and the gauge is where churn becomes visible."""
+        counts: dict[str, int] = {}
+        for key in self._jit_cache:
+            fam = key[0] if isinstance(key, tuple) and key else str(key)
+            counts[str(fam)] = counts.get(str(fam), 0) + 1
+        return counts
+
     # -- forward ------------------------------------------------------------
 
     def _apply(self, params, buffers, x, *, training=False, rng=None, kv=None,
                pos_offset=None, skip_softmax=False, compute_dtype=None,
                sp_mesh=None, platform=None, sp_mode="ring", ep_mesh=None,
-               lora=None, lora_idx=None):
+               lora=None, lora_idx=None, ragged_descs=None, ragged_rows=None):
         ctx = M.Ctx(params, buffers, training=training, rng=rng, kv=kv,
                     pos_offset=pos_offset, compute_dtype=compute_dtype,
                     sp_mesh=sp_mesh, platform=platform, sp_mode=sp_mode,
-                    ep_mesh=ep_mesh, lora=lora, lora_idx=lora_idx)
+                    ep_mesh=ep_mesh, lora=lora, lora_idx=lora_idx,
+                    ragged_descs=ragged_descs, ragged_rows=ragged_rows)
         acts = []
         h = x
         logits = None
@@ -372,7 +385,7 @@ class CompiledArch:
                 training=False, rng=None, kv=None, pos_offset=None,
                 skip_softmax=False, compute_dtype=None, sp_mesh=None,
                 platform=None, sp_mode="ring", ep_mesh=None, lora=None,
-                lora_idx=None):
+                lora_idx=None, ragged_descs=None, ragged_rows=None):
         """Full forward collecting every top-level activation.
 
         Returns ``(activations, cost, buffer_updates, new_kv)``; ``cost`` is
@@ -380,19 +393,30 @@ class CompiledArch:
         ``lora``/``lora_idx`` carry the stacked mixed-adapter pack + per-row
         slot indices (models/lora.py) into the module Ctx; single-adapter
         application instead binds ``lora_A/B/scale`` keys into ``params``.
+        ``ragged_descs``/``ragged_rows`` (paged caches only) switch
+        attention to the packed mixed-batch path: ``tokens`` is (1, Tp)
+        packed, ``pos_offset`` the (1, Tp) per-token positions, and
+        ``new_kv`` advances per-descriptor instead of by ``T``.
         """
         acts, logits, ctx = self._apply(
             params, buffers, tokens, training=training, rng=rng, kv=kv,
             pos_offset=pos_offset, skip_softmax=skip_softmax,
             compute_dtype=compute_dtype, sp_mesh=sp_mesh, platform=platform,
-            sp_mode=sp_mode, ep_mesh=ep_mesh, lora=lora, lora_idx=lora_idx)
+            sp_mode=sp_mode, ep_mesh=ep_mesh, lora=lora, lora_idx=lora_idx,
+            ragged_descs=ragged_descs, ragged_rows=ragged_rows)
         cost = (self._cost_from_logits(logits, targets, platform=platform)
                 if targets is not None else None)
         if cost is not None and ctx.aux_losses:
             # Auxiliary training losses (MoE load balancing) ride the same
             # scalar so value_and_grad backpropagates them with the task loss.
             cost = cost + sum(ctx.aux_losses)
-        new_kv = ctx.kv.advanced(tokens.shape[-1]) if ctx.kv is not None else None
+        if ctx.kv is None:
+            new_kv = None
+        elif ragged_descs is not None:
+            new_kv = ctx.kv.with_lengths(
+                ctx.kv.lengths_after_packed(ragged_descs))
+        else:
+            new_kv = ctx.kv.advanced(tokens.shape[-1])
         return acts, cost, ctx.buffer_updates, new_kv
 
     def jit_forward(self, params, buffers, tokens, targets=None, *,
@@ -2652,6 +2676,103 @@ class NeuralNetworkModel:
                       jnp.asarray(stop_tokens, jnp.int32),
                       jnp.asarray(remaining, jnp.int32), rng,
                       jnp.asarray(dispatch, jnp.int32), temp, lora, aidx)
+
+    def decode_mixed_step(self, kv, descs, tok_lit, tok_src, positions,
+                          sample_slot, last_tokens, rng, dispatch,
+                          temperature=1.0, top_k=None, lora=None,
+                          lora_slots=None):
+        """Run ``n`` unified RAGGED steps in one dispatch — the single
+        program that subsumes :meth:`decode_prefill_chunk`,
+        :meth:`decode_step_batched` and :meth:`decode_verify_row` for
+        paged caches: every step is one packed mixed batch where prefill
+        chunks, decode steps and spec-verify spans share one kernel
+        dispatch (ops/pallas/ragged_paged_attention.py), appends scatter
+        straight through the block table (no ``row_view``
+        materialization), and sampling happens at every packed position.
+
+        The host plans the whole block up front (it knows each row's
+        prompt, so a row can finish its prefill at step s and decode from
+        step s+1 *inside the same dispatch* — the ``tok_src`` indirection
+        feeds the carry's freshly sampled token forward), then replays
+        emissions from the returned ``(n, Tp)`` sample array:
+
+        - ``descs`` (n, NB, 4) int32 per-step descriptor arrays
+          (ops/kv_cache.py::build_descriptors; NB shape-bucketed —
+          utils/bucketing.py::bucket_count — so the program set stays
+          bounded);
+        - ``tok_lit``/``tok_src`` (n, Tp): packed input tokens — slot p
+          feeds ``last_tokens[tok_src]`` when ``tok_src ≥ 0`` (decode
+          continuation) else the literal (prompt/draft tokens);
+        - ``positions`` (n, Tp) int32 absolute position per packed slot
+          (per-token RoPE);
+        - ``sample_slot`` (n, B): the packed slot whose sample becomes
+          row b's carry ``last_token`` after that step (-1 keeps it —
+          parked rows, non-final prefill chunks);
+        - ``lora_slots`` (n, Tp) per-TOKEN adapter slots when ``lora``
+          is set (the per-row gather rides the same dispatch).
+
+        The sampling key for step ``i`` is ``fold_in(rng, dispatch+i)``,
+        the same sequence the phased path folds over its dispatch
+        ordinals.  Returns ``(sampled (n, Tp) int32, kv')``; the caller
+        replays per-row emissions (stop tokens, verify acceptance,
+        rollbacks) host-side — host lengths stay authoritative exactly
+        as on the phased path.  Jits per (n, NB, Tp, sampling, cache
+        type).  Donates ``kv`` — always thread the returned state.
+        """
+        greedy, temp = self._norm_temperature(temperature)
+        arch = self.arch
+        descs = np.asarray(descs, np.int32)
+        n, NB = descs.shape[0], descs.shape[1]
+        tok_lit = np.asarray(tok_lit, np.int32)
+        Tp = tok_lit.shape[1]
+        if Tp % NB != 0:
+            raise ValueError(f"packed length {Tp} must be a multiple of "
+                             f"the descriptor count {NB}")
+        block_q = Tp // NB
+        key = ("mixed_step", n, NB, Tp, type(kv).__name__, bool(greedy),
+               top_k, self._platform, lora is not None)
+        fn = arch._jit_cache.get(key)
+        if fn is None:
+            platform = self._platform
+
+            def run(p, b, kv0, dsc_s, tlit_s, tsrc_s, pos_s, sslot_s,
+                    li_s, last0, r, d0, tmp, lo):
+                def step(carry, x):
+                    kvc, last = carry
+                    dsc, tlit, tsrc, pos, sslot, li, i = x
+                    toks = jnp.where(tsrc >= 0,
+                                     last[jnp.clip(tsrc, 0)], tlit)
+                    rows = kvc.packed_rows(dsc, block_q)
+                    r_i = jax.random.fold_in(r, d0 + i)
+                    acts, _, _, kv2 = arch.forward(
+                        p, b, toks[None, :], None, kv=kvc,
+                        pos_offset=pos[None, :], skip_softmax=True,
+                        compute_dtype=None, platform=platform, lora=lo,
+                        lora_idx=(li[None, :] if lo is not None else None),
+                        ragged_descs=dsc, ragged_rows=rows)
+                    logits = acts[-1][0]                       # (Tp, V)
+                    out = arch._sample(logits, r_i, tmp, greedy=greedy,
+                                       top_k=top_k)            # (Tp,)
+                    new_last = jnp.where(sslot >= 0,
+                                         out[jnp.clip(sslot, 0)], last)
+                    return (kv2, new_last), out
+
+                xs = (dsc_s, tlit_s, tsrc_s, pos_s, sslot_s, li_s,
+                      jnp.arange(n, dtype=jnp.int32))
+                (kvf, _), sampled = jax.lax.scan(step, (kv0, last0), xs)
+                return sampled, kvf
+
+            fn = arch._jit_cache[key] = jax.jit(run, donate_argnums=(2,))
+        li = (np.asarray(lora_slots, np.int32) if lora_slots is not None
+              else np.zeros((n, Tp), np.int32))
+        with profiling.span("penroz/decode_mixed_step"):
+            return fn(self.params, self.buffers, kv,
+                      jnp.asarray(descs), jnp.asarray(tok_lit),
+                      jnp.asarray(tok_src, jnp.int32).reshape(n, Tp),
+                      jnp.asarray(positions, jnp.int32).reshape(n, Tp),
+                      jnp.asarray(sample_slot, jnp.int32),
+                      jnp.asarray(li), jnp.asarray(last_tokens, jnp.int32),
+                      rng, jnp.asarray(dispatch, jnp.int32), temp, lora)
 
     def _sampling_setup(self, temperature):
         """Shared generation preamble: (greedy, temp scalar, call rng).

@@ -57,7 +57,10 @@ def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype,
                  scaling: Optional[dict] = None):
     """cos/sin tables of shape (length, head_dim) starting at ``offset`` —
     or (B, length, head_dim) when ``offset`` is a (B,) vector (ragged
-    batches: each sequence rotates from its own position).
+    batches: each sequence rotates from its own position).  A (B, length)
+    ``offset`` gives every token its OWN absolute position (the ragged
+    packed batch, where adjacent packed slots belong to different
+    sequences at unrelated positions).
 
     ``scaling``: an HF ``rope_scaling`` dict with ``rope_type='llama3'``
     rescales the inverse frequencies (Llama 3.1+ long-context models)."""
@@ -74,7 +77,12 @@ def rope_cos_sin(head_dim: int, theta: float, offset, length: int, dtype,
             inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
     steps = jnp.arange(length, dtype=jnp.float32)
     offset = jnp.asarray(offset)
-    if offset.ndim >= 1:
+    if offset.ndim == 2:
+        if offset.shape[1] != length:
+            raise ValueError(f"per-token offset length {offset.shape[1]} "
+                             f"!= sequence length {length}")
+        t = offset.astype(jnp.float32)  # (B, length): explicit positions
+    elif offset.ndim >= 1:
         t = offset.astype(jnp.float32)[:, None] + steps  # (B, length)
     else:
         t = offset.astype(jnp.float32) + steps
@@ -404,6 +412,112 @@ def paged_cached_attention(q, flat_k, flat_v, block_table, page_size: int,
                             length, dropout_rate, dropout_rng,
                             platform=platform, window=window, alibi=alibi,
                             scale=scale, softcap=softcap)
+
+
+def ragged_paged_attention_reference(q, flat_k, flat_v, block_table,
+                                     page_size: int, descs,
+                                     k_scale=None, v_scale=None,
+                                     window: Optional[int] = None,
+                                     alibi: Optional[np.ndarray] = None,
+                                     scale: Optional[float] = None,
+                                     softcap: Optional[float] = None):
+    """Sequential-oracle attention for a PACKED mixed batch.
+
+    q: (1, Hq, Tp, D) packed queries in descriptor order (Tp = num_descs
+    · block_q); descs: (num_descs, 4) int32 ``(row, q_pos0, q_valid,
+    kv_len)`` — see ops/pallas/ragged_paged_attention.py.  Gathers each
+    descriptor's dense KV view through the block table and reuses
+    :func:`_attend` with the per-token causal mask, so the result equals
+    running each row's phase (prefill chunk / decode step / verify span)
+    through :func:`paged_cached_attention` one at a time.  Padding slots
+    (row = -1 or t ≥ q_valid) come back zero, matching the kernel.
+    """
+    _, Hq, Tp, D = q.shape
+    Hkv = flat_k.shape[0]
+    group = Hq // Hkv
+    NB = descs.shape[0]
+    BQ = Tp // NB
+    pages_per_seq = block_table.shape[1]
+    max_len = pages_per_seq * page_size
+    descs = jnp.asarray(descs, jnp.int32)
+    row = jnp.maximum(descs[:, 0], 0)
+    all_pos = jnp.arange(max_len, dtype=jnp.int32)
+    phys = jnp.maximum(block_table[row][:, all_pos // page_size], 0)
+    rows = phys * page_size + all_pos % page_size  # (NB, max_len)
+    gather = lambda flat: jnp.take(flat, rows, axis=1,
+                                   mode="clip").transpose(1, 0, 2, 3)
+    if k_scale is not None:
+        k_dense = (gather(flat_k).astype(jnp.float32)
+                   * gather(k_scale)).astype(q.dtype)
+        v_dense = (gather(flat_v).astype(jnp.float32)
+                   * gather(v_scale)).astype(q.dtype)
+    else:
+        k_dense, v_dense = gather(flat_k), gather(flat_v)
+    # (1, Hq, Tp, D) → (NB, Hkv, group, BQ, D): one "batch" entry per
+    # descriptor block (head order is kv-major, pure reshape + transpose).
+    qg = q[0].reshape(Hkv, group, NB, BQ, D).transpose(2, 0, 1, 3, 4)
+    t = jnp.arange(BQ, dtype=jnp.int32)
+    q_abs = descs[:, 1:2] + t[None, :]                    # (NB, BQ)
+    valid_q = (t[None, :] < descs[:, 2:3]) & (descs[:, 0:1] >= 0)
+    k_idx = jnp.arange(max_len, dtype=jnp.int32)
+    mask = valid_q[:, :, None] & (k_idx[None, None, :] <= q_abs[:, :, None])
+    if window is not None:
+        mask &= k_idx[None, None, :] > q_abs[:, :, None] - int(window)
+    bias = (None if alibi is None
+            else _alibi_bias(alibi, q_abs[:, :, None],
+                             k_idx[None, None, :], Hkv))
+    out = _attend(qg, k_dense, v_dense, mask[:, None, None], bias=bias,
+                  scale=scale, softcap=softcap)
+    # Fully-masked padding slots softmax to uniform in _attend; zero them
+    # like the kernel (l = 0 → output 0) so parity is exact slot-for-slot.
+    out = out * valid_q[:, None, None, :, None].astype(out.dtype)
+    return out.transpose(1, 2, 0, 3, 4).reshape(1, Hq, Tp, D)
+
+
+def ragged_paged_cached_attention(q, flat_k, flat_v, block_table,
+                                  page_size: int, descs, platform=None,
+                                  k_scale=None, v_scale=None,
+                                  window: Optional[int] = None,
+                                  alibi: Optional[np.ndarray] = None,
+                                  scale: Optional[float] = None,
+                                  softcap: Optional[float] = None):
+    """Unified mixed-batch attention over a paged pool (the ragged
+    serving fast path).
+
+    On TPU dispatches to the ragged Pallas kernel — one dispatch covers
+    prefill chunks, decode steps and spec-verify spans side by side,
+    reading KV through the block table (ops/pallas/
+    ragged_paged_attention.py).  The fallback (also the correctness
+    oracle) gathers per-descriptor dense views.
+    """
+    if _use_ragged_kernel(q, flat_k, block_table, page_size, descs,
+                          platform):
+        from penroz_tpu.ops.pallas import ragged_paged_attention as rpa
+        return rpa.ragged_paged_attention(q, flat_k, flat_v, block_table,
+                                          page_size, descs,
+                                          k_scale=k_scale, v_scale=v_scale,
+                                          window=window, alibi=alibi,
+                                          scale=scale, softcap=softcap)
+    return ragged_paged_attention_reference(q, flat_k, flat_v, block_table,
+                                            page_size, descs,
+                                            k_scale=k_scale,
+                                            v_scale=v_scale, window=window,
+                                            alibi=alibi, scale=scale,
+                                            softcap=softcap)
+
+
+def _use_ragged_kernel(q, flat_k, block_table, page_size: int, descs,
+                       platform=None) -> bool:
+    if _flash_disabled() or not _tpu_platform(q, platform):
+        return False
+    _, Hq, Tp, D = q.shape
+    Hkv = flat_k.shape[0]
+    NB = descs.shape[0]
+    if NB == 0 or Tp % NB != 0:
+        return False
+    block_q = Tp // NB
+    return (D in (64, 128, 256) and page_size % 8 == 0 and page_size >= 8
+            and Hq % Hkv == 0 and (Hq // Hkv) * block_q <= 512)
 
 
 def _use_paged_kernel(q, flat_k, block_table, page_size: int,

@@ -536,6 +536,48 @@ class QuantKVState(KVState):
         return sum(int(a.size) * itemsize for a in (*self.k, *self.v))
 
 
+def build_descriptors(spans, block_q: int, num_blocks: int):
+    """Host-side descriptor builder for the ragged unified dispatch.
+
+    ``spans``: an ordered list of ``(row, q_start, q_len)`` work items —
+    a decode step is ``q_len = 1``, a prefill chunk ``q_len = chunk``, a
+    spec-verify span ``q_len = K+1``.  Each span is cut into
+    ``ceil(q_len / block_q)`` consecutive ``block_q``-token descriptor
+    blocks ``(row, q_pos0, q_valid, kv_len)`` with ``kv_len = q_start +
+    q_len`` (the row's valid length after the append), padded with
+    ``(-1, 0, 0, 0)`` rows up to ``num_blocks`` (the shape bucket — see
+    utils/bucketing.py::bucket_count).  Returns ``(descs, offsets)``:
+    the ``(num_blocks, 4)`` int32 numpy array plus each span's first
+    block index, so callers can locate span token ``i`` at packed slot
+    ``(offsets[s] + i // block_q) * block_q + i % block_q``.
+    """
+    descs = np.zeros((num_blocks, 4), np.int32)
+    descs[:, 0] = -1
+    offsets = []
+    nb = 0
+    for row, q_start, q_len in spans:
+        offsets.append(nb)
+        done = 0
+        while done < q_len:
+            take = min(block_q, q_len - done)
+            if nb >= num_blocks:
+                raise ValueError(
+                    f"spans need more than num_blocks={num_blocks} "
+                    f"descriptor blocks of block_q={block_q}")
+            descs[nb] = (row, q_start + done, take, q_start + q_len)
+            nb += 1
+            done += take
+    return descs, offsets
+
+
+def packed_slots(offset: int, q_len: int, block_q: int) -> np.ndarray:
+    """Packed-array slot index of each of a span's ``q_len`` tokens,
+    given the span's first descriptor block ``offset``
+    (:func:`build_descriptors` returns those offsets)."""
+    i = np.arange(int(q_len))
+    return (int(offset) + i // int(block_q)) * int(block_q) + i % int(block_q)
+
+
 @jax.tree_util.register_pytree_node_class
 class PagedKVState(KVState):
     """Paged KV cache: fixed-size pages in a shared HBM pool + block table.
@@ -748,6 +790,55 @@ class PagedKVState(KVState):
         _, _, new_length = self.append_rows(layer_idx, k_new, v_new)
         return (self._gather(self.k[layer_idx]),
                 self._gather(self.v[layer_idx]), new_length)
+
+    # -- ragged packed-batch path (unified mixed dispatch) ------------------
+
+    def packed_rows(self, descs, block_q: int):
+        """Flat pool row per PACKED token for a ``(NB, 4)`` descriptor
+        array (``build_descriptors``) — the scatter targets of
+        :meth:`append_packed`.  Padding slots (row = -1 or t ≥ q_valid)
+        map past the pool so the scatter drops them.  Requires the row
+        tables to be fully assigned (the scheduler's static partition /
+        prefix aliases) — the bump allocator is never consulted, which is
+        what lets prefill chunks, decode steps and verify spans share one
+        scatter."""
+        P = self.page_size
+        descs = jnp.asarray(descs, jnp.int32)
+        t = jnp.arange(int(block_q), dtype=jnp.int32)[None, :]
+        row = descs[:, 0:1]
+        pos = descs[:, 1:2] + t                            # (NB, BQ)
+        valid = (t < descs[:, 2:3]) & (row >= 0) & (pos < self.max_len)
+        page = jnp.clip(pos // P, 0, self.pages_per_seq - 1)
+        phys = self.block_table[jnp.clip(row, 0), page]    # (NB, BQ)
+        rows = phys * P + pos % P
+        oob = self.k[0].shape[1] if self.k else 0
+        return jnp.where(valid & (phys >= 0), rows, oob).reshape(-1)
+
+    def append_packed(self, layer_idx: int, k_new, v_new, rows):
+        """Scatter a PACKED mixed batch into the pools.
+
+        ``k_new``/``v_new``: (1, Hkv, Tp, D) packed new tokens;
+        ``rows``: (Tp,) flat pool rows from :meth:`packed_rows` (shared
+        across layers — compute once per step).  Out-of-pool rows (the
+        padding slots) are dropped by the scatter.  Lengths are NOT
+        advanced here — descriptors carry the post-append lengths and
+        :meth:`lengths_after_packed` reconciles the state."""
+        self.k[layer_idx] = self.k[layer_idx].at[:, rows].set(
+            self._to_rows(k_new).astype(self.k[layer_idx].dtype),
+            mode="drop")
+        self.v[layer_idx] = self.v[layer_idx].at[:, rows].set(
+            self._to_rows(v_new).astype(self.v[layer_idx].dtype),
+            mode="drop")
+        return self.k[layer_idx], self.v[layer_idx]
+
+    def lengths_after_packed(self, descs):
+        """Per-row (B,) valid lengths after a packed append: each live
+        descriptor raises its row to its ``kv_len``; untouched rows keep
+        their current length."""
+        descs = jnp.asarray(descs, jnp.int32)
+        lens = self._row_lengths()
+        row = jnp.where(descs[:, 0] >= 0, descs[:, 0], lens.shape[0])
+        return lens.at[row].max(descs[:, 3], mode="drop")
 
     def _gather(self, flat):
         """Assemble the (B, Hkv, S_max, D) view the attention mask expects."""
@@ -1002,6 +1093,21 @@ class QuantPagedKVState(PagedKVState):
         self.v_scale[layer_idx] = self.v_scale[layer_idx].at[:, rows].set(
             self._to_rows(sv))
         return self.k[layer_idx], self.v[layer_idx], new_length
+
+    def append_packed(self, layer_idx: int, k_new, v_new, rows):
+        """Quantize then scatter a packed mixed batch — values and
+        per-token scales land at the same pool rows (padding dropped)."""
+        qk, sk = _quantize_int8(k_new)
+        qv, sv = _quantize_int8(v_new)
+        self.k[layer_idx] = self.k[layer_idx].at[:, rows].set(
+            self._to_rows(qk), mode="drop")
+        self.v[layer_idx] = self.v[layer_idx].at[:, rows].set(
+            self._to_rows(qv), mode="drop")
+        self.k_scale[layer_idx] = self.k_scale[layer_idx].at[:, rows].set(
+            self._to_rows(sk), mode="drop")
+        self.v_scale[layer_idx] = self.v_scale[layer_idx].at[:, rows].set(
+            self._to_rows(sv), mode="drop")
+        return self.k[layer_idx], self.v[layer_idx]
 
     def append(self, layer_idx: int, k_new, v_new):
         """Scatter + dense dequantized views (jnp fallback/oracle path)."""

@@ -49,7 +49,8 @@ class Ctx:
     def __init__(self, params, buffers=None, *, training=False, rng=None,
                  kv=None, pos_offset=None, compute_dtype=None, sp_mesh=None,
                  platform=None, sp_mode="ring", sp_manual_axis=None,
-                 ep_mesh=None, lora=None, lora_idx=None):
+                 ep_mesh=None, lora=None, lora_idx=None, ragged_descs=None,
+                 ragged_rows=None):
         self.params = params
         self.buffers = buffers or {}
         self.training = training
@@ -80,6 +81,15 @@ class Ctx:
         # ``params`` — Linear.apply picks either up.
         self.lora = lora
         self.lora_idx = lora_idx
+        # Ragged unified dispatch (paged caches only): ``ragged_descs`` is
+        # the (NB, 4) packed-batch descriptor array (ops/kv_cache.py::
+        # build_descriptors) and ``ragged_rows`` the per-packed-token pool
+        # scatter rows (PagedKVState.packed_rows — computed once, shared by
+        # every layer's append).  When set, attention appends/attends
+        # through the packed path and ``pos_offset`` holds the (1, Tp)
+        # per-token absolute positions.
+        self.ragged_descs = ragged_descs
+        self.ragged_rows = ragged_rows
         self.buffer_updates = {}
         self.aux_losses = []  # auxiliary training losses (e.g. MoE balance)
         self._rng_counter = 0
@@ -252,8 +262,14 @@ class PositionEmbedding(Embedding):
         # per-sequence position rows (B, T) → (B, T, d).
         offset = jnp.asarray(ctx.offset())
         steps = jnp.arange(num_positions, dtype=jnp.int32)
-        positions = (offset[:, None] + steps if offset.ndim >= 1
-                     else offset + steps)
+        if offset.ndim == 2:
+            # (B, T) explicit per-token absolute positions (ragged packed
+            # batches) — already fully resolved, nothing to add.
+            positions = offset
+        elif offset.ndim >= 1:
+            positions = offset[:, None] + steps
+        else:
+            positions = offset + steps
         return jnp.take(self._p(ctx, "weight"), positions, axis=0)
 
 
@@ -308,6 +324,17 @@ class Linear(Module):
         if ent is None:
             return out
         idx = ctx.lora_idx
+        if idx is not None and jnp.ndim(idx) == 2:
+            # (B, T) PER-TOKEN slots — the ragged packed batch, where
+            # adjacent tokens belong to different rows with different
+            # adapters.  Gathered factors grow a token axis; otherwise
+            # identical to the per-row einsum below.
+            asel = jnp.take(ent["a"], idx, axis=0).astype(x.dtype)
+            bsel = jnp.take(ent["b"], idx, axis=0).astype(x.dtype)
+            ssel = jnp.take(ent["scale"], idx, axis=0).astype(out.dtype)
+            t = jnp.einsum("btd,btrd->btr", x, asel)
+            return out + jnp.einsum("btr,btor->bto", t, bsel) \
+                * ssel[:, :, None]
         asel = jnp.take(ent["a"], idx, axis=0).astype(x.dtype)  # (B, r, in)
         bsel = jnp.take(ent["b"], idx, axis=0).astype(x.dtype)  # (B, out, r)
         ssel = jnp.take(ent["scale"], idx, axis=0).astype(out.dtype)  # (B,)
@@ -1163,7 +1190,12 @@ class CausalSelfAttention(Module):
         if ctx.kv is not None:
             from penroz_tpu.ops import kv_cache as KV
             paged = isinstance(ctx.kv, KV.PagedKVState)
-            if paged:
+            ragged = paged and ctx.ragged_descs is not None
+            if ragged:
+                store_k, store_v = ctx.kv.append_packed(
+                    self.layer_idx, k, v, ctx.ragged_rows)
+                length = None
+            elif paged:
                 store_k, store_v, length = ctx.kv.append_rows(self.layer_idx,
                                                               k, v)
             elif ctx.kv.quantized:
@@ -1180,7 +1212,14 @@ class CausalSelfAttention(Module):
             scales = ({"k_scale": ctx.kv.k_scale[self.layer_idx],
                        "v_scale": ctx.kv.v_scale[self.layer_idx]}
                       if ctx.kv.quantized else {})
-            if paged:
+            if ragged:
+                out = attn_ops.ragged_paged_cached_attention(
+                    q, store_k, store_v, ctx.kv.block_table,
+                    ctx.kv.page_size, ctx.ragged_descs,
+                    platform=ctx.platform, window=self.sliding_window,
+                    alibi=alibi, scale=self.attn_scale,
+                    softcap=self.logit_softcap, **scales)
+            elif paged:
                 out = attn_ops.paged_cached_attention(
                     q, store_k, store_v, ctx.kv.block_table, ctx.kv.page_size,
                     offset, length, dropout_rate=dropout_rate,

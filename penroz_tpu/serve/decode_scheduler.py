@@ -166,7 +166,7 @@ from penroz_tpu.serve import metrics as serve_metrics
 from penroz_tpu.serve import qos
 from penroz_tpu.serve import spec_decode
 from penroz_tpu.serve.qos import TenantQuotaExceeded  # noqa: F401 — re-export
-from penroz_tpu.utils import checkpoint, faults, profiling
+from penroz_tpu.utils import bucketing, checkpoint, faults, profiling
 from penroz_tpu.utils import metrics as metrics_util
 from penroz_tpu.utils import stats as stats_util
 
@@ -186,6 +186,7 @@ BREAKER_COOLDOWN_ENV = "PENROZ_BREAKER_COOLDOWN_MS"
 DRAIN_S_ENV = "PENROZ_DRAIN_S"
 TICK_TIMELINE_ENV = "PENROZ_TICK_TIMELINE"
 SUPERSTEP_ENV = "PENROZ_SCHED_SUPERSTEP"
+RAGGED_ENV = "PENROZ_RAGGED_ATTENTION"
 
 # Max tick-timeline entries served per /serving_stats/ payload (the ring
 # itself holds PENROZ_TICK_TIMELINE entries).
@@ -271,8 +272,35 @@ def _prefill_chunk() -> int:
     return _env_int(PREFILL_CHUNK_ENV, 256)
 
 
+_STALL_DEPRECATION_WARNED = False
+
+
 def _max_stall_ms() -> float:
     return _env_float(MAX_STALL_MS_ENV, 0.0)
+
+
+def ragged_enabled() -> bool:
+    """Unified ragged dispatch (paged caches): prefill chunks, decode
+    steps and spec-verify spans share ONE kernel dispatch per tick.
+    On by default wherever the cache is paged; ``PENROZ_RAGGED_ATTENTION=0``
+    is the one-release escape hatch back to phased scheduling."""
+    return os.environ.get(RAGGED_ENV, "1") != "0"
+
+
+def _warn_stall_deprecated():
+    """PENROZ_SCHED_MAX_STALL_MS is meaningless on the unified path (there
+    is no prefill/decode phase boundary left to budget) — warn once when a
+    deployment still sets it so the knob can be dropped next release."""
+    global _STALL_DEPRECATION_WARNED
+    if _STALL_DEPRECATION_WARNED or MAX_STALL_MS_ENV not in os.environ:
+        return
+    _STALL_DEPRECATION_WARNED = True
+    log.warning(
+        "%s is deprecated and ignored on the unified ragged path: prefill "
+        "chunks ride the same dispatch as decode steps, so there is no "
+        "inter-phase stall to budget.  It still applies to the legacy "
+        "phased path (%s=0 or contiguous KV) and will be removed next "
+        "release.", MAX_STALL_MS_ENV, RAGGED_ENV)
 
 
 def _max_queue() -> int:
@@ -318,13 +346,9 @@ def _chunk_plan(n: int, chunk: int) -> list[int]:
     """Chunk sizes covering ``n`` prefill tokens: fixed ``chunk``-size
     pieces, then a descending power-of-two decomposition of the remainder —
     the compiled chunk-program set stays bounded by {chunk} ∪ {2^k < chunk}
-    instead of retracing per prompt length."""
-    plan = [chunk] * (n // chunk)
-    rem = n % chunk
-    for b in range(rem.bit_length() - 1, -1, -1):
-        if rem & (1 << b):
-            plan.append(1 << b)
-    return plan
+    instead of retracing per prompt length (utils/bucketing.py, shared
+    with the superstep planner and the ragged descriptor bucketing)."""
+    return bucketing.chunk_plan(n, chunk)
 
 
 class Request:
@@ -723,6 +747,9 @@ class DecodeEngine:
     def live_adapters(self) -> int:
         return sum(1 for e in self._slot_entries if e is not None)
 
+    def jit_program_counts(self) -> dict[str, int]:
+        return self._model.arch.jit_program_counts()
+
     def _round_q(self, hist: metrics_util.Hist, q: float):
         v = hist.quantile(q)
         return round(v, 3) if v is not None else None
@@ -909,6 +936,11 @@ class DecodeEngine:
         decoding = bool(self._decoding_rows())
         if not prefilling and not decoding:
             return
+        if self._unified():
+            self._tick_unified()
+            return
+        prefill_rows = sum(1 for r in self._rows
+                           if r is not None and r.prefilling)
         chunks0 = self._prefill_chunks
         verify_rows = shared_rows = emitted = steps = 0
         t0 = time.monotonic()
@@ -934,7 +966,314 @@ class DecodeEngine:
             "shared_rows": shared_rows,
             "emitted": emitted,
             "superstep": steps,
+            "unified": False,
+            "prefill_rows": prefill_rows,
+            "decode_rows": shared_rows,
         })
+
+    def _unified(self) -> bool:
+        """Unified ragged dispatch is THE paged fast path: every tick is
+        one ``decode_mixed_step`` block in which prefill chunks, decode
+        steps and spec-verify spans share a single kernel dispatch — no
+        prefill/decode phase boundary, no stall budget, none of the PR 7
+        superstep fallbacks.  ``PENROZ_RAGGED_ATTENTION=0`` (one-release
+        escape hatch) or a contiguous cache keeps the legacy phased tick."""
+        return isinstance(self._kv, KV.PagedKVState) and ragged_enabled()
+
+    def _tick_unified(self):
+        """One unified tick: host-plan an n-step mixed block (prefill
+        chunks, decode steps and verify spans all in the SAME dispatches),
+        run it as ONE ``decode_mixed_step`` device round trip, replay the
+        sampled block through the normal per-token retirement path.
+
+        There is no phase distinction left: a prefill chunk does not stall
+        the decode batch (they share the dispatch), so the stall budget is
+        gone, and none of the phased superstep fallbacks apply — pending
+        prefill chunks and spec drafts fuse INTO the block instead of
+        collapsing it to n=1.  Host-only terminal conditions (deadline,
+        cancel) are observed at the block boundary, the same documented
+        ``PENROZ_SCHED_SUPERSTEP`` granularity trade as the phased path."""
+        _warn_stall_deprecated()
+        t0 = time.monotonic()
+        with profiling.span("penroz/sched_tick"):
+            plan = self._plan_mixed()
+            if plan is None:
+                return
+            comp = self._mixed_dispatch(plan)
+        dur_ms = (time.monotonic() - t0) * 1000.0
+        self._h_tick.observe(dur_ms)
+        serve_metrics.TICK_MS.observe(dur_ms)
+        self._tick_timeline.append({
+            "t": t0,
+            "dispatch_ms": round(dur_ms, 3),
+            "occupancy": round(self.active_rows / self.capacity, 4),
+            "prefill_chunks": comp["prefill_chunks"],
+            "verify_rows": comp["verify_rows"],
+            "shared_rows": comp["decode_rows"],
+            "emitted": comp["emitted"],
+            "superstep": plan["n"],
+            "unified": True,
+            "prefill_rows": comp["prefill_rows"],
+            "decode_rows": comp["decode_rows"],
+        })
+
+    def _plan_mixed(self):
+        """Host-side plan for one unified block: simulate every row's next
+        ``PENROZ_SCHED_SUPERSTEP`` steps of work — a prefilling row runs
+        one pow-2-bucketed chunk per step and flows STRAIGHT into decode
+        mid-block (its final chunk's sample feeds the next step through
+        the device carry), a drafted row runs its K+1 verify span at step
+        0 then parks (acceptance is a host decision), a decode row runs a
+        1-token span per step until its budget or the row capacity is
+        spent — and pack each step's spans into shape-bucketed descriptor
+        arrays (utils/bucketing.py: the step count takes the pow-2 floor,
+        the block count the pow-2 ceiling, so the compiled mixed-program
+        set stays O(log²) for any workload)."""
+        from penroz_tpu.ops.pallas.ragged_paged_attention import (
+            default_block_q)
+        rows = [(i, r) for i, r in enumerate(self._rows) if r is not None]
+        if not rows:
+            return None
+        block_q = default_block_q()
+        n_max = max(1, _superstep_max())
+        spec = self._spec_on()
+        drafts = dict(self._plan_drafts(self._decoding_rows()))
+        sim = {}
+        for i, state in rows:
+            sim[i] = {
+                "mode": ("prefill" if state.prefilling
+                         else "verify" if i in drafts else "decode"),
+                "len": int(self._lengths[i]),
+                "chunk": state.chunk_idx,
+                "produced": state.produced,
+            }
+        steps = []          # per step: list of replay ops
+        blocks_per_step = []
+        for s in range(n_max):
+            spans = []      # (row, q_start, q_len)
+            ops = []
+            for i, state in rows:
+                st = sim[i]
+                req = state.req
+                if st["mode"] == "prefill":
+                    size = state.chunks[st["chunk"]]
+                    final = st["chunk"] + 1 >= len(state.chunks)
+                    spans.append((i, st["len"], size))
+                    ops.append(("chunk", i, state, st["len"], size, final,
+                                len(spans) - 1))
+                    st["len"] += size
+                    st["chunk"] += 1
+                    if final:
+                        # Park at the final chunk: its sample is the
+                        # request's FIRST token and must ship at this
+                        # block's boundary, not after n-1 more in-block
+                        # decode steps (TTFT) — and with spec decode on,
+                        # the row's next step should be a drafted verify
+                        # span, which only the host can plan.
+                        st["mode"] = "parked"
+                        st["produced"] += 1     # the chunk's own sample
+                elif st["mode"] == "verify":
+                    if s == 0:
+                        draft = drafts[i]
+                        spans.append((i, st["len"], len(draft) + 1))
+                        ops.append(("verify", i, state, draft,
+                                    len(spans) - 1))
+                        st["mode"] = "parked"
+                elif st["mode"] == "decode":
+                    if (st["produced"] < req.max_new_tokens
+                            and st["len"] < self.block_size):
+                        spans.append((i, st["len"], 1))
+                        ops.append(("decode", i, state, len(spans) - 1))
+                        st["len"] += 1
+                        st["produced"] += 1
+            if not ops:
+                break
+            steps.append((spans, ops))
+            blocks_per_step.append(
+                sum(-(-q_len // block_q) for _, _, q_len in spans))
+        if not steps:
+            return None
+        n = bucketing.clamp_pow2_floor(len(steps), hi=n_max)
+        steps = steps[:n]
+        NB = bucketing.bucket_count(max(blocks_per_step[:n]))
+        Tp = NB * block_q
+        descs = np.zeros((n, NB, 4), np.int32)
+        tok_lit = np.zeros((n, Tp), np.int32)
+        tok_src = np.full((n, Tp), -1, np.int32)
+        positions = np.zeros((n, Tp), np.int32)
+        sample_slot = np.full((n, self.capacity), -1, np.int32)
+        lora_slots = np.full((n, Tp), self._max_live, np.int32)
+        replay = []
+        for s, (spans, ops) in enumerate(steps):
+            d, offsets = KV.build_descriptors(spans, block_q, NB)
+            descs[s] = d
+            step_ops = []
+            for op in ops:
+                kind, i, state = op[0], op[1], op[2]
+                span_idx = op[-1]
+                q_start, q_len = spans[span_idx][1], spans[span_idx][2]
+                slots = KV.packed_slots(offsets[span_idx], q_len, block_q)
+                positions[s, slots] = q_start + np.arange(q_len)
+                lora_slots[s, slots] = int(self._row_adapter[i])
+                if kind == "chunk":
+                    _, _, _, start, size, final, _ = op
+                    tok_lit[s, slots] = state.history[start:start + size]
+                    if final:
+                        sample_slot[s, i] = slots[-1]
+                        step_ops.append(("chunk", i, state, size,
+                                         int(slots[-1])))
+                    else:
+                        step_ops.append(("chunk", i, state, size, None))
+                elif kind == "verify":
+                    draft = op[3]
+                    tok_lit[s, slots] = ([int(self._last_tok[i])]
+                                         + [int(t) for t in draft])
+                    step_ops.append(("verify", i, state, draft,
+                                     [int(sl) for sl in slots]))
+                else:
+                    tok_src[s, slots[0]] = i
+                    sample_slot[s, i] = slots[0]
+                    step_ops.append(("decode", i, state, int(slots[0])))
+            replay.append(step_ops)
+        return {"n": n, "descs": descs, "tok_lit": tok_lit,
+                "tok_src": tok_src, "positions": positions,
+                "sample_slot": sample_slot, "lora_slots": lora_slots,
+                "replay": replay}
+
+    def _mixed_dispatch(self, plan) -> dict:
+        """Run the planned block as ONE ``decode_mixed_step`` dispatch and
+        replay its ``(n, Tp)`` sample array step-major through the normal
+        retirement path — the same replay contract as ``_superstep``
+        (``is not states[i]`` skips rows the host retired mid-block), plus
+        chunk bookkeeping (``_finish_prefill`` on a final chunk emits the
+        first token with its TTFT) and verify acceptance + KV rollback.
+        Host lengths stay authoritative throughout."""
+        faults.check("decode.step")
+        n, replay = plan["n"], plan["replay"]
+        has_chunks = any(op[0] == "chunk" for ops in replay for op in ops)
+        has_verify = any(op[0] == "verify" for ops in replay for op in ops)
+        if has_chunks:
+            faults.check("decode.prefill_chunk")
+        if has_verify:
+            faults.check("decode.verify")
+        dispatch = self._dispatch
+        self._dispatch += n
+        t0 = time.monotonic()
+        with model_mod.decode_priority(), \
+                profiling.span("penroz/sched_mixed"):
+            sampled, self._kv = self._model.decode_mixed_step(
+                self._kv, plan["descs"], plan["tok_lit"], plan["tok_src"],
+                plan["positions"], plan["sample_slot"], self._last_tok,
+                self._rng, dispatch, self.temperature, self.top_k,
+                lora=self._lora_pack, lora_slots=plan["lora_slots"])
+            arr = np.asarray(sampled)
+        t1 = time.monotonic()
+        prefill_rows = {op[1] for ops in replay for op in ops
+                        if op[0] == "chunk"}
+        decode_rows = {op[1] for ops in replay for op in ops
+                       if op[0] == "decode"}
+        verify_rows = {op[1] for ops in replay for op in ops
+                       if op[0] == "verify"}
+        for i in decode_rows | verify_rows:
+            state = self._rows[i]
+            if state is not None and state.req.trace is not None:
+                sp = state.req.trace.span("decode_step", t0=t0,
+                                          parent=state.sp_decode,
+                                          superstep=n)
+                state.req.trace.end(sp, t1=t1)
+        emitted = 0         # decode-path tokens (decode_tokens parity)
+        emitted_total = 0   # every token out of this dispatch
+        chunks_run = 0
+        steps_decode = 0
+        for s, ops in enumerate(replay):
+            if any(op[0] in ("decode", "verify") for op in ops):
+                steps_decode += 1
+            for op in ops:
+                kind, i, state = op[0], op[1], op[2]
+                if self._rows[i] is not state:
+                    continue    # retired mid-block (stop/budget/deadline)
+                if kind == "chunk":
+                    size, final_slot = op[3], op[4]
+                    req = state.req
+                    if req.cancelled:
+                        self._retire(i, notify=False, reason="cancelled")
+                        continue
+                    if req.expired():
+                        self._deadline_timeouts += 1
+                        serve_metrics.DEADLINE_TIMEOUTS.inc()
+                        self._retire(i, notify=False, reason="timeout")
+                        self._deliver(req, "timeout", DeadlineExceeded(
+                            "inflight",
+                            "request deadline expired during prefill"))
+                        continue
+                    if req.trace is not None:
+                        sp = req.trace.span(
+                            "prefill_chunk", t0=t0,
+                            parent=state.sp_prefill, size=size,
+                            start=state.prefilled)
+                        req.trace.end(sp, t1=t1)
+                    state.prefilled += size
+                    state.chunk_idx += 1
+                    self._prefill_chunks += 1
+                    serve_metrics.PREFILL_CHUNKS.inc()
+                    self._lengths[i] = state.prefilled
+                    chunks_run += 1
+                    if final_slot is not None:
+                        emitted_total += 1
+                        self._finish_prefill(i, state, int(arr[s, final_slot]))
+                elif kind == "decode":
+                    slot = op[3]
+                    self._lengths[i] += 1
+                    tok = int(arr[s, slot])
+                    self._last_tok[i] = tok
+                    emitted += 1
+                    emitted_total += 1
+                    self._emit_token(i, state, tok)
+                else:   # verify
+                    draft, slots = op[3], op[4]
+                    out = [int(arr[s, sl]) for sl in slots]
+                    accepted = spec_decode.accept_length(draft, out)
+                    self._spec_verify_steps += 1
+                    self._spec_drafted_tokens += len(draft)
+                    self._spec_accepted_tokens += accepted
+                    serve_metrics.SPEC_DRAFTED.inc(len(draft))
+                    serve_metrics.SPEC_ACCEPTED.inc(accepted)
+                    # The span wrote K+1 fresh positions; only accepted+1
+                    # were fed greedy-consistent tokens — rewind the rest.
+                    new_len = int(self._lengths[i]) + accepted + 1
+                    self._kv = self._kv.rollback_row(i, new_len)
+                    self._lengths[i] = new_len
+                    for tok in out[:accepted + 1]:
+                        self._last_tok[i] = tok
+                        emitted += 1
+                        emitted_total += 1
+                        self._emit_token(i, state, tok)
+                        if self._rows[i] is not state:
+                            break
+        now = time.monotonic()
+        self._decode_steps += steps_decode
+        self._decode_tokens += emitted
+        serve_metrics.DECODE_TOKENS.inc(emitted)
+        self._decode_time_s += now - t0
+        self._occupancy_sum += (steps_decode
+                                * len(decode_rows | verify_rows)
+                                / self.capacity)
+        self._token_window.append((now, emitted))
+        while (self._token_window
+               and now - self._token_window[0][0] > _TPS_WINDOW_S):
+            self._token_window.popleft()
+        if chunks_run and steps_decode:
+            # Chunks rode the decode dispatch: the decode batch stalled
+            # ZERO ms for prefill — record the win where the phased path
+            # recorded its stall.
+            self._h_chunk_stall.observe(0.0)
+            serve_metrics.CHUNK_STALL_MS.observe(0.0)
+        self._record_dispatch(emitted_total)
+        return {"prefill_chunks": chunks_run,
+                "prefill_rows": len(prefill_rows),
+                "decode_rows": len(decode_rows),
+                "verify_rows": len(verify_rows),
+                "emitted": emitted_total}
 
     def _record_crash(self):
         serve_metrics.ENGINE_CRASHES.inc()
@@ -1536,8 +1875,7 @@ class DecodeEngine:
             need = max(need,
                        min(state.req.max_new_tokens - state.produced,
                            self.block_size - int(self._lengths[i])))
-        n = max(min(n, need), 1)
-        return 1 << (n.bit_length() - 1)
+        return bucketing.clamp_pow2_floor(need, hi=n)
 
     def _superstep(self, n: int) -> tuple[int, int]:
         """Dispatch ONE fused n-step decode program

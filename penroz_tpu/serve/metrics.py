@@ -25,7 +25,9 @@ gauges     engines, active_rows, queue_depth, batch_occupancy,
            breaker_open, draining, lora_live_adapters,
            kv_pool_capacity_drops, prefix_cache_unpin_underflow
            (both monotonic in practice, exposed as gauges because the
-           source counters live in ops/kv_cache.py)
+           source counters live in ops/kv_cache.py),
+           jit_programs{function} (live compiled-program count per jit
+           family — the ragged descriptor compile-churn guard)
 histograms ttft_ms, itl_ms, queue_wait_ms, chunk_stall_ms, tick_ms
            (fixed LATENCY_BUCKETS_MS buckets; cumulative ``_bucket``
            series sum to ``_count`` — asserted by the strict-format
@@ -158,6 +160,12 @@ UNPIN_UNDERFLOW = REGISTRY.register(m.Gauge(
     "RadixPrefixCache unpins that drove a refcount negative — any "
     "nonzero value is a pin/unpin pairing bug (process-wide counter in "
     "ops/kv_cache.py, exposed at scrape)"))
+JIT_PROGRAMS = REGISTRY.register(m.Gauge(
+    "penroz_jit_programs",
+    "Live compiled XLA programs per model jit family summed across "
+    "engines — flat between scrapes means descriptor shape bucketing "
+    "is holding; unbounded growth under steady traffic is compile churn",
+    labelnames=("function",)))
 
 
 def _wire_gauges():
@@ -187,6 +195,15 @@ def _wire_gauges():
         e.live_adapters for e in engines()))
     POOL_DROPS.set_function(KV.pool_drop_count)
     UNPIN_UNDERFLOW.set_function(KV.unpin_underflow_count)
+
+    def jit_programs():
+        out: dict = {}
+        for e in engines():
+            for fam, n in e.jit_program_counts().items():
+                out[fam] = out.get(fam, 0) + n
+        return out
+
+    JIT_PROGRAMS.set_function(jit_programs)
 
 
 _WIRED = False

@@ -223,6 +223,16 @@ class TickRecord(BaseModel):
                            "tick's dispatch (PENROZ_SCHED_SUPERSTEP path; "
                            "1 = legacy single step, 0 = no decode dispatch "
                            "ran this tick)")
+    unified: bool = Field(False, description="True when the tick ran as "
+                          "ONE ragged mixed dispatch (paged KV + "
+                          "PENROZ_RAGGED_ATTENTION) carrying prefill "
+                          "chunks, decode steps, and verify rows in a "
+                          "single descriptor grid; False on the legacy "
+                          "phased path")
+    prefill_rows: int = Field(0, description="Rows still chunk-prefilling "
+                              "at tick start (mixed-composition view)")
+    decode_rows: int = Field(0, description="Rows in the decode/verify "
+                             "phase at tick start (mixed-composition view)")
 
 
 class EngineStats(BaseModel):

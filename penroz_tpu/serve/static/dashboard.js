@@ -311,10 +311,12 @@ function renderServing(data) {
 /* ---- tick telemetry strip (/serving_stats/ tick_timeline) -------------- */
 
 /* Bars: per-tick dispatch wall time, colored by phase composition
- * (prefill chunk > verify > plain shared step); line: batch occupancy.
- * This is the "what is the tick loop actually doing between dispatches"
- * panel — a tall amber bar is a chunk stall, a purple run is spec-decode
- * verify traffic, the teal line sagging is an underfed batch. */
+ * (unified mixed > prefill chunk > verify > plain shared step); line:
+ * batch occupancy.  This is the "what is the tick loop actually doing
+ * between dispatches" panel — a green bar is a ragged unified tick whose
+ * ONE dispatch carried prefill chunks alongside decode rows, a tall
+ * amber bar is a phased chunk stall, a purple run is spec-decode verify
+ * traffic, the teal line sagging is an underfed batch. */
 function renderTickStrip(data) {
   const canvas = $("tick-strip");
   const meta = $("tick-meta");
@@ -337,7 +339,9 @@ function renderTickStrip(data) {
   const bw = (w - 2 * pad) / ticks.length;
   ticks.forEach((t, i) => {
     const bh = Math.max(1, t.dispatch_ms / hi * (h - 2 * pad));
-    ctx.fillStyle = t.prefill_chunks > 0 ? "#e0b35c"
+    ctx.fillStyle =
+      t.unified && t.prefill_chunks > 0 && t.shared_rows > 0 ? "#98c379"
+      : t.prefill_chunks > 0 ? "#e0b35c"
       : t.verify_rows > 0 ? "#b58cd9" : "#7aa2f7";
     ctx.fillRect(pad + i * bw, h - pad - bh, Math.max(1, bw - 1), bh);
   });
@@ -351,6 +355,7 @@ function renderTickStrip(data) {
   });
   ctx.stroke();
   drawLabel(ctx, `${hi.toFixed(1)}ms`, 4, 12);
+  drawLabel(ctx, "mixed", w - 248, 12, "#98c379");
   drawLabel(ctx, "chunk", w - 200, 12, "#e0b35c");
   drawLabel(ctx, "verify", w - 150, 12, "#b58cd9");
   drawLabel(ctx, "step", w - 100, 12, "#7aa2f7");

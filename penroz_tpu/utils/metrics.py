@@ -204,12 +204,15 @@ class Counter(Metric):
 class Gauge(Metric):
     """Instantaneous value — set directly or computed at scrape time via
     ``set_function`` (engine-registry state is read fresh per scrape, so
-    the gauge can never go stale)."""
+    the gauge can never go stale).  With ``labelnames`` the callback
+    returns a mapping of label-value (single name) or label-value tuple
+    (several) to value, rendered as one series per key."""
 
     kind = "gauge"
 
-    def __init__(self, name: str, help_text: str, fn=None):
+    def __init__(self, name: str, help_text: str, fn=None, labelnames=()):
         super().__init__(name, help_text)
+        self.labelnames = tuple(labelnames)
         self._value = 0.0
         self._fn = fn
 
@@ -220,6 +223,19 @@ class Gauge(Metric):
         self._fn = fn
 
     def sample_lines(self) -> list[str]:
+        if self.labelnames:
+            try:
+                values = dict(self._fn()) if self._fn is not None else {}
+            except Exception:  # noqa: BLE001 — a scrape must never 500
+                values = {}
+            lines = []
+            for key, v in sorted(values.items()):
+                if not isinstance(key, tuple):
+                    key = (key,)
+                lines.append(self.name
+                             + _fmt_labels(dict(zip(self.labelnames, key)))
+                             + f" {_fmt_value(v)}")
+            return lines
         v = self._value
         if self._fn is not None:
             try:

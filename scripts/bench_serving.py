@@ -80,6 +80,18 @@ unloaded p99) and ``slo_exceeded_fifo`` (FIFO blows that budget).  A final
 ``PENROZ_BENCH_QOS_ROWS/_FLOOD/_PROBES/_PROBE_NEW/_RATE`` plus the
 shared ``PENROZ_BENCH_SERVING_BLOCK`` / ``PENROZ_BENCH_MAX_NEW``.
 
+``--ragged`` switches to the unified ragged-attention workload (PR 9):
+short decode streams run while long prompts chunk-prefill through the
+same engine, measured contiguous-legacy (``PAGED_KV_CACHE=0`` — the
+phased scheduler) then paged-unified (``=1`` — one dispatch over the
+mixed batch).  Headlines: mixed ITL p50/p99 of the decode streams,
+tokens per dispatch (the paged path must be ≥ contiguous on the same
+offered load — ``paged_ge_contiguous``), greedy parity, and the tick
+timeline's ``mixed_fused_superstep_max`` (a single dispatch carrying
+prefill chunks AND n>1 fused decode steps — the regime the PR 7
+fallbacks forbade).  Scale knobs: ``PENROZ_BENCH_RAGGED_STREAMS/
+_PREFILLS/_PROMPT/_LONG/_PREFILL_NEW`` plus the shared set.
+
 ``--chaos`` arms ONE fault site (``PENROZ_BENCH_CHAOS_SITE``, default
 ``qos.preempt``; Nth trigger via ``PENROZ_BENCH_CHAOS_AT``) and drives
 mixed-priority overload waves through it — the building block
@@ -1118,6 +1130,210 @@ async def _bench_mixed_slo() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --ragged: unified prefill+decode dispatch on mixed traffic (paged vs
+# contiguous)
+# ---------------------------------------------------------------------------
+
+async def _bench_ragged() -> dict:
+    """Mixed-traffic workload for the ragged unified attention path: short
+    streaming decodes run concurrently while long prompts arrive and
+    chunk-prefill through the SAME engine.  Measured twice:
+
+    - ``contiguous``: PAGED_KV_CACHE=0 — the legacy phased scheduler
+      (prefill ticks vs decode ticks, stall budget, superstep fallback
+      conditions), the PR 7 baseline behaviour on this traffic.
+    - ``paged``: PAGED_KV_CACHE=1 — the unified ragged path, where one
+      dispatch carries prefill chunks, decode steps, and (with spec on)
+      verify rows in a single descriptor grid.
+
+    Headlines: per-phase **mixed ITL p50/p99** of the decode streams (the
+    latency prefill chunks used to stall), **tokens per dispatch** and
+    ``dispatches_total`` (deterministic counters — the unified path must
+    emit more tokens per host round-trip than phased scheduling on the
+    same offered load), greedy parity between phases, and — from the tick
+    timeline — ``mixed_ticks`` / ``mixed_fused_superstep_max``: unified
+    ticks whose single dispatch carried BOTH prefill chunks and shared
+    decode rows at superstep > 1, the regime every PR 7 fallback
+    condition used to kick the engine back to one-step dispatches.
+    Scale knobs: ``PENROZ_BENCH_RAGGED_STREAMS/_PREFILLS/_PROMPT/_LONG/
+    _PREFILL_NEW`` plus the shared ``PENROZ_BENCH_SERVING_BLOCK/_D/
+    _DEPTH`` / ``PENROZ_BENCH_MAX_NEW`` / ``PENROZ_BENCH_CHUNK`` set."""
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 256)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 128)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 2)
+    streams = _env_i("PENROZ_BENCH_RAGGED_STREAMS", 3)
+    prefills = _env_i("PENROZ_BENCH_RAGGED_PREFILLS", 3)
+    prompt_len = _env_i("PENROZ_BENCH_RAGGED_PROMPT", 12)
+    long_len = _env_i("PENROZ_BENCH_RAGGED_LONG", 160)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 48)
+    prefill_new = _env_i("PENROZ_BENCH_RAGGED_PREFILL_NEW", 4)
+    chunk = _env_i("PENROZ_BENCH_CHUNK", 32)
+    vocab = 256
+    assert prompt_len + max_new <= block
+    assert long_len + prefill_new <= block
+
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        decode_scheduler.MAX_ROWS_ENV: str(streams + prefills),
+        decode_scheduler.PREFILL_CHUNK_ENV: str(chunk),
+        "PENROZ_KV_PAGE_SIZE": "16",
+    }
+    saved = {k: os.environ.get(k) for k in (*env, "PAGED_KV_CACHE")}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(11)
+    short_prompts = [[int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+                     for _ in range(streams)]
+    long_prompts = [[int(t) for t in rng.integers(1, vocab - 1, long_len)]
+                    for _ in range(prefills)]
+    warm_shorts = [[int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+                   for _ in range(streams)]
+    warm_longs = [[int(t) for t in rng.integers(1, vocab - 1, long_len)]
+                  for _ in range(prefills)]
+
+    def payload(prompt, new):
+        return {"model_id": "bench-ragged", "input": [prompt],
+                "block_size": block, "max_new_tokens": new,
+                "temperature": 0.0}
+
+    async def saturate(n):
+        for _ in range(300):
+            resp = await client.get("/serving_stats/")
+            stats = await resp.json()
+            if stats["active_rows"] >= n:
+                return
+            await asyncio.sleep(0.01)
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-ragged",
+            "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
+
+        results: dict = {
+            "mode": "ragged", "block_size": block, "streams": streams,
+            "prefills": prefills, "stream_prompt_len": prompt_len,
+            "long_prompt_len": long_len, "stream_max_new": max_new,
+            "prefill_max_new": prefill_new, "prefill_chunk": chunk,
+            "model_d": d, "model_depth": depth,
+        }
+        sequences = {}
+        for phase in ("contiguous", "paged"):
+            os.environ["PAGED_KV_CACHE"] = "1" if phase == "paged" else "0"
+            decode_scheduler.reset()  # fresh engine + KV layout per phase
+            # Warm with DISTINCT prompts at the MEASURED composition
+            # (streams short decodes + prefills long prompts concurrently):
+            # the mixed-program shape families (n steps x descriptor-block
+            # buckets) depend on the batch mix, so a single-request warm-up
+            # would leave the measured phase paying XLA compiles.  Which
+            # shapes a round exercises is timing-dependent, so repeat until
+            # the penroz_jit_programs gauge stops growing — steady state by
+            # the compile-churn guard's own definition.
+            programs = -1
+            for _ in range(5):
+                warm_stream = [asyncio.ensure_future(
+                    _stream_one(client, payload(p, max_new)))
+                    for p in warm_shorts]
+                await saturate(streams)
+                await asyncio.gather(
+                    *warm_stream,
+                    *[_stream_one(client, payload(p, prefill_new))
+                      for p in warm_longs])
+                scrape = await _scrape_metrics(client)
+                now_programs = sum(v for k, v in scrape.items()
+                                   if k.startswith("penroz_jit_programs"))
+                if now_programs == programs:
+                    break
+                programs = now_programs
+            # Measured: decode streams first, long prefills land mid-flight.
+            stream_tasks = [asyncio.ensure_future(
+                _stream_one(client, payload(p, max_new)))
+                for p in short_prompts]
+            await saturate(streams)
+            t0 = time.perf_counter()
+            long_tasks = [asyncio.ensure_future(
+                _stream_one(client, payload(p, prefill_new)))
+                for p in long_prompts]
+            stream_out = await asyncio.gather(*stream_tasks)
+            long_out = await asyncio.gather(*long_tasks)
+            wall_s = time.perf_counter() - t0
+            itls, seqs = [], []
+            for toks, _, gaps in stream_out:
+                itls.extend(gaps)
+                seqs.append(toks)
+            long_ttfts = []
+            for toks, ttft_ms, _ in long_out:
+                long_ttfts.append(ttft_ms)
+                seqs.append(toks)
+            sequences[phase] = seqs
+            resp = await client.get("/serving_stats/")
+            stats = await resp.json()
+            timeline = stats.get("tick_timeline") or []
+            mixed = [e for e in timeline
+                     if e.get("prefill_chunks", 0) > 0
+                     and e.get("shared_rows", 0) > 0]
+            results[phase] = {
+                # fused dispatches deliver tokens in bursts, so gap
+                # percentiles are bimodal by design — the mean is the
+                # honest per-token cost, percentiles shown for visibility
+                "mixed_itl_ms_mean": (round(sum(itls) / len(itls), 3)
+                                      if itls else None),
+                "mixed_itl_ms_p50": (round(_pct(itls, 0.5), 3)
+                                     if itls else None),
+                "mixed_itl_ms_p99": (round(_pct(itls, 0.99), 3)
+                                     if itls else None),
+                "long_ttft_ms_p50": round(_pct(long_ttfts, 0.5), 3),
+                "wall_s": round(wall_s, 3),
+                "dispatches_total": stats["dispatches_total"],
+                "tokens_per_dispatch_avg": stats["tokens_per_dispatch_avg"],
+                "prefill_chunk_stall_ms_p99":
+                    stats["prefill_chunk_stall_ms_p99"],
+                "unified_ticks": sum(1 for e in timeline
+                                     if e.get("unified")),
+                "mixed_ticks": len(mixed),
+                "mixed_fused_superstep_max": max(
+                    (e.get("superstep", 1) for e in mixed), default=0),
+            }
+        results["parity_ok"] = sequences["contiguous"] == sequences["paged"]
+        cont, paged = results["contiguous"], results["paged"]
+        results["tokens_per_dispatch_paged_vs_contiguous"] = (
+            round(paged["tokens_per_dispatch_avg"]
+                  / cont["tokens_per_dispatch_avg"], 3)
+            if cont["tokens_per_dispatch_avg"] else None)
+        results["mixed_itl_p99_contiguous_vs_paged"] = (
+            round(cont["mixed_itl_ms_p99"] / paged["mixed_itl_ms_p99"], 3)
+            if cont["mixed_itl_ms_p99"] and paged["mixed_itl_ms_p99"]
+            else None)
+        # the acceptance gate: paged is the fast path on mixed traffic —
+        # more tokens per host round-trip (deterministic counters), never
+        # bought with wrong tokens
+        results["paged_ge_contiguous"] = bool(
+            results["parity_ok"]
+            and paged["tokens_per_dispatch_avg"]
+            >= cont["tokens_per_dispatch_avg"])
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
 # --chaos: one armed fault site under overload (scripts/chaos_matrix.sh)
 # ---------------------------------------------------------------------------
 
@@ -1229,6 +1445,8 @@ async def _bench_chaos() -> dict:
         return {
             "mode": "chaos", "site": site, "raise_at": at,
             "superstep": _env_i(decode_scheduler.SUPERSTEP_ENV, 8),
+            "sched_mode": ("unified" if decode_scheduler.ragged_enabled()
+                           else "phased"),
             "offered_requests": sum(statuses.values()),
             "statuses": {str(s): n for s, n in sorted(statuses.items())},
             "disallowed": {str(s): n for s, n in disallowed.items()},
@@ -1261,7 +1479,7 @@ def main():
     args = [a for a in sys.argv[1:]
             if a not in ("--shared-prefix", "--overload", "--speculative",
                          "--multi-adapter", "--multistep", "--mixed-slo",
-                         "--chaos")]
+                         "--chaos", "--ragged")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     speculative = "--speculative" in sys.argv[1:]
@@ -1269,6 +1487,7 @@ def main():
     multistep = "--multistep" in sys.argv[1:]
     mixed_slo = "--mixed-slo" in sys.argv[1:]
     chaos = "--chaos" in sys.argv[1:]
+    ragged = "--ragged" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
@@ -1302,6 +1521,9 @@ def main():
         return
     if chaos:
         _emit(asyncio.run(_bench_chaos()))
+        return
+    if ragged:
+        _emit(asyncio.run(_bench_ragged()))
         return
     concurrency = int(args[0]) if len(args) > 0 else 8
     max_new = int(args[1]) if len(args) > 1 else 48

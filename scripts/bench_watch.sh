@@ -113,6 +113,20 @@ while true; do
           -- "BENCH_QOS_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) mixed-SLO QoS capture committed" >> logs/bench_watch.log
     fi
+    # Ragged unified-attention capture (same shape as the shared-prefix
+    # hook): mixed-traffic ITL + tokens/dispatch, paged-unified vs
+    # contiguous-phased, with greedy parity.  Opt-in; failures must not
+    # block the main capture.
+    if [ "${PENROZ_WATCH_RAGGED:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_RAGGED_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --ragged \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_RAGGED_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: ragged unified-attention capture" \
+          -- "BENCH_RAGGED_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) ragged capture committed" >> logs/bench_watch.log
+    fi
     # Multi-tenant LoRA capture (same shape as the shared-prefix hook):
     # mixed-adapter ITL/wall vs per-adapter serial groups + parity.
     # Opt-in; failures must not block the main capture.

@@ -1,48 +1,53 @@
 #!/bin/bash
-# Chaos matrix: every registered fault site (utils/faults.py) x compiled
-# superstep {1, 8}, each combo driven through the overload chaos bench
-# (scripts/bench_serving.py --chaos).  A combo passes iff the bench's `ok`
-# gate holds: no status outside 200/429/503/504 (the armed crash's own
-# 500s excepted) and the post-fault solo replay of every prompt is greedy
+# Chaos matrix: every registered fault site (utils/faults.py) x scheduler
+# mode {unified, phased}, each combo driven through the overload chaos
+# bench (scripts/bench_serving.py --chaos, paged KV).  `unified` is the
+# ragged one-dispatch mixed tick (PENROZ_RAGGED_ATTENTION=1, the default);
+# `phased` is the legacy prefill/decode-phase scheduler the =0 escape
+# hatch restores.  A combo passes iff the bench's `ok` gate holds: no
+# status outside 200/429/503/504 (the armed crash's own 500s excepted)
+# and the post-fault solo replay of every prompt is greedy
 # token-identical to its pre-chaos baseline.  Any failed combo fails the
 # script (exit 1) with the offending JSON line printed.
 #
-# CHAOS_FAST=1 runs a single representative combo (qos.preempt x
-# superstep 8 — the newest recovery path, on the fused-dispatch engine) so
-# a tier-1 test can afford the sweep; the full matrix is the pre-release /
+# CHAOS_FAST=1 runs a single representative combo (qos.preempt x unified
+# — the newest recovery path, on the ragged mixed-dispatch engine) so a
+# tier-1 test can afford the sweep; the full matrix is the pre-release /
 # soak entry point.
 #
 # Env passthrough: PENROZ_BENCH_SERVING_PLATFORM, PENROZ_BENCH_* scale
-# knobs.  CHAOS_SITES / CHAOS_SUPERSTEPS override the swept sets
+# knobs.  CHAOS_SITES / CHAOS_MODES override the swept sets
 # (space-separated).
 set -u
 cd "$(dirname "$0")/.."
 
 SITES="${CHAOS_SITES:-decode.step decode.prefill_chunk decode.verify ckpt.write data.download lora.load qos.preempt}"
-SUPERSTEPS="${CHAOS_SUPERSTEPS:-1 8}"
+MODES="${CHAOS_MODES:-unified phased}"
 if [ "${CHAOS_FAST:-0}" = "1" ]; then
   SITES="qos.preempt"
-  SUPERSTEPS="8"
+  MODES="unified"
 fi
 
 fail=0
 ran=0
 for site in $SITES; do
-  for ss in $SUPERSTEPS; do
+  for mode in $MODES; do
     ran=$((ran + 1))
-    echo "=== chaos: site=$site superstep=$ss ===" >&2
-    out=$(PENROZ_BENCH_CHAOS_SITE="$site" PENROZ_SCHED_SUPERSTEP="$ss" \
+    ragged=1
+    [ "$mode" = "phased" ] && ragged=0
+    echo "=== chaos: site=$site mode=$mode ===" >&2
+    out=$(PENROZ_BENCH_CHAOS_SITE="$site" PENROZ_RAGGED_ATTENTION="$ragged" \
             timeout 900 python scripts/bench_serving.py --chaos)
     rc=$?
     echo "$out"
     if [ "$rc" -ne 0 ]; then
-      echo "FAIL site=$site superstep=$ss rc=$rc" >&2
+      echo "FAIL site=$site mode=$mode rc=$rc" >&2
       fail=1
       continue
     fi
     if ! printf '%s' "$out" | python -c \
         'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") else 1)'; then
-      echo "FAIL site=$site superstep=$ss: disallowed statuses or parity break" >&2
+      echo "FAIL site=$site mode=$mode: disallowed statuses or parity break" >&2
       fail=1
     fi
   done

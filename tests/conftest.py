@@ -51,6 +51,25 @@ if os.environ.get("PENROZ_TEST_COMPILE_CACHE") == "1":
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _drop_jit_caches_per_module():
+    """Free compiled XLA:CPU executables at every module boundary.
+
+    Models (and their per-arch jit caches) are function-scoped, but jax's
+    GLOBAL C++ pjit cache keeps every traced jnp-op executable alive for the
+    whole session.  On the same sandbox images whose cache *reload* corrupts
+    the heap (see the PENROZ_TEST_COMPILE_CACHE note above), letting
+    thousands of live executables accumulate makes a late-suite
+    `backend_compile` segfault — the crash lands in whichever module
+    compiles next, not in the one that tipped it over.  Clearing per module
+    keeps peak allocator state flat; each module only recompiles its own
+    small working set.  (Measured: clearing every module is also the
+    FASTEST full-suite config — sparser clearing lets the bounded global
+    cache fill and eviction-thrash through the late heavy modules.)"""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def cpu_devices():
     return jax.devices("cpu")

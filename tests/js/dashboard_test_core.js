@@ -235,6 +235,10 @@ async function runDashboardTests(src, fixtures) {
     assertOk(tickOps.includes("fillRect"), "tick strip drew dispatch bars");
     assertOk(tickOps.includes("stroke"),
              "tick strip drew the occupancy line");
+    const tickLabels = document.byId["tick-strip"]._ops
+      .filter((o) => o[0] === "fillText").map((o) => String(o[1]));
+    assertOk(tickLabels.some((l) => l.includes("mixed")),
+             "tick strip legends the unified mixed phase");
     // per-request waterfall: newest completed trace, span labels visible
     const traceMeta = document.byId["trace-meta"].textContent;
     assertOk(traceMeta.includes(fixtures.traceDetail.request_id),

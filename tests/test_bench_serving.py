@@ -282,12 +282,65 @@ def test_mixed_slo_bench_smoke(tmp_path):
     assert quota["victim_parity_ok"] is True, quota
 
 
+def test_ragged_bench_smoke(tmp_path):
+    """--ragged (PR 9): on mixed traffic (short decode streams + long
+    prompts chunk-prefilling through the same engine), the paged-unified
+    path must be the fast path — at least as many tokens per host
+    round-trip as contiguous-phased scheduling (deterministic counters,
+    not wall timing), with exact greedy parity, and the tick timeline
+    must show unified ticks whose ONE dispatch carried prefill chunks
+    alongside n>1 fused decode steps — the composition every PR 7
+    fallback condition used to forbid."""
+    out_path = tmp_path / "ragged.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PENROZ_BENCH_SERVING_BLOCK="256",
+        PENROZ_BENCH_SERVING_D="64",
+        PENROZ_BENCH_SERVING_DEPTH="2",
+        PENROZ_BENCH_RAGGED_STREAMS="3",
+        PENROZ_BENCH_RAGGED_PREFILLS="2",
+        PENROZ_BENCH_RAGGED_LONG="96",
+        PENROZ_BENCH_MAX_NEW="32",
+        PENROZ_BENCH_CHUNK="16",
+        PENROZ_BENCH_JSON_OUT=str(out_path),
+    )
+    proc = subprocess.run([sys.executable, SCRIPT, "--ragged"],
+                          capture_output=True, text=True, timeout=900,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.loads(out_path.read_text()) == results
+
+    assert results["mode"] == "ragged"
+    assert results["parity_ok"] is True, results       # never wrong tokens
+    cont, paged = results["contiguous"], results["paged"]
+    # the headline gate: paged ≥ contiguous on mixed traffic
+    assert results["paged_ge_contiguous"] is True, results
+    assert paged["tokens_per_dispatch_avg"] >= \
+        cont["tokens_per_dispatch_avg"], results
+    assert paged["dispatches_total"] < cont["dispatches_total"], results
+    # the legacy path never takes the unified tick; the paged path always
+    # does, and its mixed ticks fuse n>1 decode steps alongside chunks
+    assert cont["unified_ticks"] == 0, results
+    assert paged["unified_ticks"] > 0, results
+    assert paged["mixed_ticks"] > 0, results
+    assert paged["mixed_fused_superstep_max"] > 1, results
+    for phase in (cont, paged):
+        assert phase["mixed_itl_ms_p99"] is not None
+        assert phase["long_ttft_ms_p50"] > 0
+    delta = results["metrics_delta"]
+    assert delta["penroz_dispatches_total"] > 0, delta
+    assert delta["penroz_prefill_chunks_total"] > 0, delta
+
+
 def test_chaos_matrix_fast_subset(tmp_path):
-    """scripts/chaos_matrix.sh CHAOS_FAST=1 (PR 8): the qos.preempt x
-    superstep-8 combo through the chaos overload bench — the injected
+    """scripts/chaos_matrix.sh CHAOS_FAST=1: the qos.preempt x unified
+    combo through the chaos overload bench — the injected
     crash-at-preemption must surface only 200/429/503/504 (+ the crash's
     own 500s), recover, and replay every prompt greedy-identical.  The
-    full site x superstep matrix is the same script without CHAOS_FAST."""
+    full fault-site x {unified, phased} matrix is the same script
+    without CHAOS_FAST."""
     script = os.path.join(REPO, "scripts", "chaos_matrix.sh")
     env = dict(
         os.environ,
@@ -308,6 +361,7 @@ def test_chaos_matrix_fast_subset(tmp_path):
     assert results["mode"] == "chaos"
     assert results["site"] == "qos.preempt"
     assert results["superstep"] == 8
+    assert results["sched_mode"] == "unified"
     assert results["ok"] is True, results
     assert results["disallowed"] == {}, results
     # the fault really fired: the preemption path crashed and recovered

@@ -1,0 +1,108 @@
+"""Property tests for utils/bucketing.py — the shared pow-2 arithmetic
+behind chunked prefill, the fused superstep planner, and the ragged
+descriptor shape buckets.  Exhaustive over small ranges (cheap and
+total) instead of sampled."""
+
+import pytest
+
+from penroz_tpu.utils import bucketing as B
+
+
+def _is_pow2(x: int) -> bool:
+    return x >= 1 and (x & (x - 1)) == 0
+
+
+def test_pow2_floor_and_ceil_bracket_n():
+    for n in range(1, 2050):
+        lo, hi = B.pow2_floor(n), B.pow2_ceil(n)
+        assert _is_pow2(lo) and _is_pow2(hi)
+        assert lo <= n <= hi
+        # tight: the next power down/up is on the wrong side
+        assert lo * 2 > n
+        assert hi // 2 < n or hi == 1
+
+
+def test_pow2_floor_ceil_fixed_points():
+    for b in range(12):
+        p = 1 << b
+        assert B.pow2_floor(p) == p
+        assert B.pow2_ceil(p) == p
+
+
+@pytest.mark.parametrize("fn", [B.pow2_floor, B.pow2_ceil])
+def test_pow2_rejects_nonpositive(fn):
+    for bad in (0, -1, -7):
+        with pytest.raises(ValueError):
+            fn(bad)
+
+
+def test_pow2_tail_is_descending_binary_expansion():
+    assert B.pow2_tail(0) == []
+    for rem in range(0, 1025):
+        tail = B.pow2_tail(rem)
+        assert sum(tail) == rem
+        assert all(_is_pow2(p) for p in tail)
+        assert tail == sorted(tail, reverse=True)
+        assert len(set(tail)) == len(tail)  # strictly descending
+    with pytest.raises(ValueError):
+        B.pow2_tail(-1)
+
+
+def test_chunk_plan_covers_n_with_bounded_shape_set():
+    for chunk in (1, 2, 7, 8, 16, 256):
+        shapes = set()
+        for n in range(0, 4 * chunk + 3):
+            plan = B.chunk_plan(n, chunk)
+            assert sum(plan) == n
+            assert all(0 < p <= chunk for p in plan)
+            # every piece is the full chunk or a pow-2 below it
+            assert all(p == chunk or _is_pow2(p) for p in plan)
+            # full chunks first, then the strictly-descending tail
+            tail = plan[n // chunk:]
+            assert tail == sorted(tail, reverse=True)
+            shapes.update(plan)
+        # compile-churn guard: O(log chunk) distinct shapes ever emitted
+        assert len(shapes) <= chunk.bit_length() + 1
+
+
+def test_chunk_plan_rejects_bad_args():
+    with pytest.raises(ValueError):
+        B.chunk_plan(5, 0)
+    with pytest.raises(ValueError):
+        B.chunk_plan(-1, 8)
+
+
+def test_clamp_pow2_floor_never_overshoots():
+    for n in range(1, 300):
+        for hi in (None, 1, 4, 8, 64):
+            got = B.clamp_pow2_floor(n, lo=1, hi=hi)
+            assert _is_pow2(got)
+            assert got <= n  # a fused plan never exceeds remaining need
+            if hi is not None:
+                assert got <= hi
+    # lo pulls a too-small n up to the floor bucket of lo
+    assert B.clamp_pow2_floor(0, lo=4) == 4
+    assert B.clamp_pow2_floor(3, lo=8, hi=16) == 8
+
+
+def test_bucket_count_invariants():
+    for minimum in (1, 2, 3, 8):
+        buckets = set()
+        for n in range(0, 600):
+            got = B.bucket_count(n, minimum=minimum)
+            assert _is_pow2(got)
+            assert got >= max(n, 1)
+            assert got >= minimum
+            assert got < 2 * max(n, minimum, 1)  # tight within one doubling
+            buckets.add(got)
+        # log-bounded program set across the whole workload range
+        assert len(buckets) <= 11
+
+
+def test_bucket_count_monotone():
+    for minimum in (1, 4):
+        prev = 0
+        for n in range(0, 200):
+            got = B.bucket_count(n, minimum=minimum)
+            assert got >= prev
+            prev = got

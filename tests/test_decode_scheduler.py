@@ -1466,3 +1466,139 @@ def test_non_greedy_seeded_output_invariant_under_superstep(
         ]
         engine.shutdown()
     assert outs[1] == outs[8]
+
+
+# -- ragged unified prefill+decode ticks (one mixed dispatch per tick) -------
+
+
+UNIFIED_MATRIX = [
+    # (prefix, int8, superstep, spec, chunk) — an L8-style cover: every
+    # axis hits both values and the heavy pairings (int8×fused,
+    # prefix×spec, spec×chunked) all appear at least once.
+    (0, 0, "1", 0, "16"),
+    (1, 0, "8", 1, "2"),
+    (0, 1, "8", 0, "2"),
+    (1, 1, "1", 1, "16"),
+    (1, 1, "8", 0, "16"),
+    (0, 0, "8", 1, "16"),
+    (1, 0, "1", 0, "2"),
+    (0, 1, "1", 1, "2"),
+]
+
+
+@pytest.mark.parametrize("prefix,int8,superstep,spec,chunk", UNIFIED_MATRIX)
+def test_unified_parity_matrix(gpt_model, make_engine, monkeypatch,
+                               prefix, int8, superstep, spec, chunk):
+    """THE unified-tick acceptance matrix: with the paged cache on, the
+    ragged one-dispatch scheduler returns greedy tokens identical to the
+    legacy phased scheduler AND to the standalone legacy path — across
+    prefix cache, int8 KV, superstep {1,8}, spec decode (oracle drafts)
+    and chunked/one-shot prefill, with two overlapping rows per run so
+    the dispatch is genuinely mixed."""
+    from penroz_tpu.serve import decode_scheduler, spec_decode
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    if prefix:
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "16")
+    if int8:
+        monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, superstep)
+    monkeypatch.setenv(decode_scheduler.PREFILL_CHUNK_ENV, chunk)
+    pa, pb = REP_PROMPT, [5, 6, 5, 6]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 6, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 6, temperature=0.0)
+    if spec:
+        monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+        monkeypatch.setattr(spec_decode, "propose",
+                            _oracle_drafter([base_a, base_b]))
+    for ragged in ("1", "0"):
+        monkeypatch.setenv(decode_scheduler.RAGGED_ENV, ragged)
+        engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+        ca = _submit(engine, pa, 6)
+        cb = _submit(engine, pb, 6)
+        assert ca.result() == base_a, f"row A diverged (ragged={ragged})"
+        assert cb.result() == base_b, f"row B diverged (ragged={ragged})"
+        stats = engine.stats()
+        unified_ticks = [e for e in stats["tick_timeline"]
+                         if e.get("unified")]
+        if ragged == "1":
+            assert unified_ticks, "paged engine must take the unified path"
+        else:
+            assert not unified_ticks, \
+                "PENROZ_RAGGED_ATTENTION=0 must restore phased ticks"
+        if spec:
+            assert stats["spec_verify_steps"] > 0
+            assert stats["spec_accept_rate"] == 1.0
+        engine.shutdown()
+
+
+def test_unified_tick_fuses_chunks_and_drafts(gpt_model, make_engine,
+                                              monkeypatch):
+    """Superstep-fallback removal, asserted from the tick timeline: a
+    unified tick holding BOTH pending prefill chunks and a spec-verify
+    span still dispatches a fused block (superstep > 1).  The legacy
+    scheduler dropped to single-step whenever either was present; the
+    ragged dispatch has no such fallback."""
+    from penroz_tpu.serve import decode_scheduler, spec_decode
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "8")
+    monkeypatch.setenv(decode_scheduler.PREFILL_CHUNK_ENV, "2")
+    monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+    monkeypatch.setenv("PENROZ_SPEC_K", "2")
+    pa, pb = [1, 2], [1, 2, 3, 1, 2, 3, 1, 2, 3, 1, 2, 3]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 8, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 4, temperature=0.0)
+    monkeypatch.setattr(spec_decode, "propose",
+                        _oracle_drafter([base_a, base_b]))
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=2)
+    ca = _submit(engine, pa, 8)
+    # wait until row A is decoding (first token out) before admitting the
+    # long chunked prompt, so some later tick plans A's verify span
+    # alongside B's prefill chunks
+    deadline = time.monotonic() + 60
+    while ca.q.qsize() == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert ca.q.qsize() > 0, "row A produced no token within 60s"
+    cb = _submit(engine, pb, 4)
+    assert ca.result() == base_a
+    assert cb.result() == base_b
+    fused_mixed = [e for e in engine.stats()["tick_timeline"]
+                   if e.get("unified") and e["prefill_chunks"] > 0
+                   and e["verify_rows"] > 0 and e["superstep"] > 1]
+    assert fused_mixed, \
+        "no tick fused prefill chunks with a verify span at superstep > 1"
+
+
+def test_unified_compile_budget(gpt_model, make_engine, monkeypatch):
+    """Compile-churn guard end to end: 50 requests with varied prompt and
+    output lengths through the unified path compile a bounded mixed-step
+    program set — descriptor-count buckets (pow-2, utils/bucketing.py)
+    times step-count buckets {1,2,4,8}, never a program per shape."""
+    from penroz_tpu.serve import decode_scheduler
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "8")
+    monkeypatch.setenv(decode_scheduler.PREFILL_CHUNK_ENV, "4")
+    engine = make_engine("schedgpt", BLOCK, 0.0, None, capacity=4)
+    rng = np.random.default_rng(42)
+    pending = []
+    for i in range(50):
+        plen = int(rng.integers(2, 11))
+        max_new = int(rng.integers(1, min(6, BLOCK - plen)))
+        prompt = [int(t) for t in rng.integers(1, 9, size=plen)]
+        pending.append(_submit(engine, prompt, max_new))
+        if len(pending) >= 8:
+            pending.pop(0).result()
+    for collector in pending:
+        collector.result()
+    counts = engine.jit_program_counts()
+    assert counts.get("mixed_step", 0) >= 1, \
+        "the unified path never dispatched"
+    # n ∈ {1,2,4,8} step buckets × NB ∈ {1,2,4,8} descriptor buckets
+    # = 16 is the pow-2 ceiling for this workload (an unbucketed planner
+    # would compile a program per distinct (plen, max_new, rows) shape —
+    # dozens); the exact subset reached depends on admission timing
+    assert counts["mixed_step"] <= 16, \
+        f"mixed-step program churn: {counts['mixed_step']} programs"

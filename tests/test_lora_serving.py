@@ -541,3 +541,46 @@ def test_mixed_adapter_superstep_parity(gpt_model, tenants, make_engine,
     assert stats["lora_active_adapters"] == 2
     if superstep > 1:
         assert any(e["superstep"] > 1 for e in stats["tick_timeline"])
+
+
+def test_unified_mixed_adapter_parity(gpt_model, tenants, make_engine,
+                                      monkeypatch):
+    """The ragged unified tick serves a mixed-adapter batch (A, B, base
+    interleaved, paged KV, chunked prefill) token-identically to the
+    legacy phased scheduler AND to each tenant's bound-model standalone
+    run — the per-row LoRA slot gather rides the one mixed dispatch."""
+    from penroz_tpu.serve import decode_scheduler
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "4")
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, "8")
+    jobs = [("tenA", [1, 2, 1, 2, 1, 2]),
+            (None, [5, 6, 5, 6]),
+            ("tenB", [7, 8, 7, 8, 7])]
+    max_new = 6
+    oracles = {}
+    for aid, prompt in jobs:
+        model = gpt_model
+        if aid is not None:
+            entry = tenants[aid]
+            model = lora.bind_model(gpt_model, entry.params, entry.config)
+        oracles[aid] = model.generate_tokens([prompt], BLOCK, max_new,
+                                             temperature=0.0)
+    for ragged in ("1", "0"):
+        monkeypatch.setenv(decode_scheduler.RAGGED_ENV, ragged)
+        engine = make_engine("mtgpt", BLOCK, 0.0, None, capacity=3)
+        collectors = [(aid, _submit(engine, prompt, max_new,
+                                    adapter=tenants.get(aid)))
+                      for aid, prompt in jobs]
+        for aid, collector in collectors:
+            assert collector.result() == oracles[aid], \
+                f"adapter {aid} diverged (ragged={ragged})"
+        stats = engine.stats()
+        assert stats["lora_active_adapters"] == 2
+        unified_ticks = [e for e in stats["tick_timeline"]
+                         if e.get("unified")]
+        if ragged == "1":
+            assert unified_ticks, "paged engine must take the unified path"
+        else:
+            assert not unified_ticks, "escape hatch must restore phased"
+        engine.shutdown()

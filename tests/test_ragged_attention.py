@@ -1,0 +1,177 @@
+"""Parity tests for the ragged unified prefill+decode paged-attention path:
+the Pallas kernel (interpret mode) vs the jnp packed oracle, and the packed
+oracle vs a hand-rolled per-span numpy softmax — a mixed batch of {prefill
+chunk, decode step, spec-verify span} in ONE dispatch must equal running
+each phase sequentially."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from penroz_tpu.ops import attention as A
+from penroz_tpu.ops import kv_cache as KV
+from penroz_tpu.ops.pallas import ragged_paged_attention as RPA
+
+# One mixed batch shared by every test: row 0 mid-prefill (chunk of 6 at
+# position 5), row 1 decoding (T=1 at position 13), row 2 verifying a
+# drafted span (K+1 = 3 at position 9).  BQ = 8 cuts them into one
+# descriptor block each; NB = 4 leaves one (-1) padding block.
+SPANS = [(0, 5, 6), (1, 13, 1), (2, 9, 3)]
+BQ = 8
+NB = 4
+S = 16  # every row's pool holds S tokens; descs' kv_len masks the tail
+
+
+def _mixed_case(quantized=False, Hq=4, Hkv=2, D=64, P=8, seed=0):
+    rng = np.random.default_rng(seed)
+    cls = KV.QuantPagedKVState if quantized else KV.PagedKVState
+    state = cls.create([(Hkv, D)], batch=3, max_len=P * 4, page_size=P)
+    k = jnp.asarray(rng.normal(size=(3, Hkv, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(3, Hkv, S, D)), jnp.float32)
+    state.append_rows(0, k, v)
+    state = state.advanced(S)
+    descs, offsets = KV.build_descriptors(SPANS, BQ, NB)
+    q = jnp.asarray(rng.normal(size=(1, Hq, NB * BQ, D)), jnp.float32)
+    scales = ((state.k_scale[0], state.v_scale[0]) if quantized
+              else (None, None))
+    return q, state, descs, offsets, (k, v), scales
+
+
+def _dequant_dense(state, k_dense, v_dense, scales):
+    """Per-row dense KV as the quantized pool actually stores it."""
+    if scales[0] is None:
+        return np.asarray(k_dense), np.asarray(v_dense)
+    out = []
+    for flat, scale in ((state.k[0], scales[0]), (state.v[0], scales[1])):
+        table = np.maximum(np.asarray(state.block_table), 0)
+        pos = np.arange(S)
+        rows = table[:, pos // state.page_size] * state.page_size \
+            + pos % state.page_size
+        dense = np.take(np.asarray(flat, np.float32), rows, axis=1) \
+            * np.take(np.asarray(scale, np.float32), rows, axis=1)
+        out.append(dense.transpose(1, 0, 2, 3))  # (B, Hkv, S, D)
+    return out[0], out[1]
+
+
+def _numpy_span_oracle(q, descs, offsets, k_dense, v_dense,
+                       alibi=None, scale=None, softcap=None):
+    """Sequential per-phase truth: loop spans, loop tokens, plain softmax."""
+    _, Hq, Tp, D = q.shape
+    Hkv = k_dense.shape[1]
+    group = Hq // Hkv
+    sm = float(scale) if scale is not None else 1.0 / np.sqrt(D)
+    qn = np.asarray(q, np.float64)
+    out = np.zeros((1, Hq, Tp, D))
+    for (row, q0, qlen), off in zip(SPANS, offsets):
+        slots = KV.packed_slots(off, qlen, BQ)
+        for i, slot in enumerate(slots):
+            kv_len = q0 + i + 1  # causal: token sees itself + history
+            for h in range(Hq):
+                kh = np.asarray(k_dense[row, h // group, :kv_len],
+                                np.float64)
+                vh = np.asarray(v_dense[row, h // group, :kv_len],
+                                np.float64)
+                logits = kh @ qn[0, h, slot] * sm
+                if softcap is not None:
+                    logits = softcap * np.tanh(logits / softcap)
+                if alibi is not None:
+                    logits += alibi[h] * (np.arange(kv_len) - (q0 + i))
+                w = np.exp(logits - logits.max())
+                out[0, h, slot] = (w / w.sum()) @ vh
+    return out
+
+
+@pytest.mark.parametrize("quantized", [False, True],
+                         ids=["fp32", "int8"])
+def test_ragged_kernel_matches_reference_interpret(quantized):
+    """Kernel (interpret) vs the packed jnp oracle on the mixed batch —
+    prefill chunk + decode step + verify span in one grid, GQA heads,
+    one padding descriptor.  Int8 pools dequantize in-kernel."""
+    q, state, descs, _, _, (ks, vs) = _mixed_case(quantized=quantized)
+    ref = A.ragged_paged_attention_reference(
+        q, state.k[0], state.v[0], state.block_table, state.page_size,
+        descs, k_scale=ks, v_scale=vs)
+    out = RPA.ragged_paged_attention(
+        q, state.k[0], state.v[0], state.block_table, state.page_size,
+        descs, k_scale=ks, v_scale=vs, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # padding slots (descriptor row -1) come back exactly zero on both
+    pad = np.asarray(out)[0, :, (NB - 1) * BQ:, :]
+    assert np.all(pad == 0.0)
+
+
+def test_ragged_kernel_alibi_softcap_interpret():
+    """ALiBi slopes + logit softcap + scale override through the kernel
+    (interpret) vs the packed oracle — the features the unified dispatch
+    must carry for served model families."""
+    Hq = 4
+    alibi = A.alibi_slopes(Hq)
+    q, state, descs, _, _, _ = _mixed_case(seed=3)
+    kw = dict(alibi=alibi, softcap=30.0, scale=0.2)
+    ref = A.ragged_paged_attention_reference(
+        q, state.k[0], state.v[0], state.block_table, state.page_size,
+        descs, **kw)
+    out = RPA.ragged_paged_attention(
+        q, state.k[0], state.v[0], state.block_table, state.page_size,
+        descs, interpret=True, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("quantized", [False, True],
+                         ids=["fp32", "int8"])
+def test_ragged_reference_matches_sequential_oracle(quantized):
+    """The packed jnp oracle equals a hand-rolled numpy softmax run one
+    span, one token, one head at a time — i.e. the unified mixed batch
+    computes exactly what sequential per-phase attention computes."""
+    q, state, descs, offsets, (k, v), (ks, vs) = _mixed_case(
+        quantized=quantized, seed=7)
+    ref = A.ragged_paged_attention_reference(
+        q, state.k[0], state.v[0], state.block_table, state.page_size,
+        descs, k_scale=ks, v_scale=vs)
+    k_dense, v_dense = _dequant_dense(state, k, v, (ks, vs))
+    want = _numpy_span_oracle(q, descs, offsets, k_dense, v_dense)
+    np.testing.assert_allclose(np.asarray(ref), want, atol=2e-5)
+
+
+def test_ragged_reference_sequential_oracle_alibi_softcap():
+    q, state, descs, offsets, (k, v), _ = _mixed_case(seed=11)
+    alibi = A.alibi_slopes(4)
+    kw = dict(alibi=alibi, softcap=25.0, scale=0.15)
+    ref = A.ragged_paged_attention_reference(
+        q, state.k[0], state.v[0], state.block_table, state.page_size,
+        descs, **kw)
+    want = _numpy_span_oracle(q, descs, offsets, np.asarray(k),
+                              np.asarray(v), **kw)
+    np.testing.assert_allclose(np.asarray(ref), want, atol=2e-5)
+
+
+def test_ragged_kernel_gate():
+    """Dispatch gate: TPU-only, D and page-size tiling limits, and the
+    packed length must divide into the descriptor count."""
+    q = jnp.zeros((1, 4, 16, 64))
+    flat = jnp.zeros((2, 256, 64))
+    table = jnp.zeros((3, 4), jnp.int32)
+    descs = np.zeros((2, 4), np.int32)
+    assert A._use_ragged_kernel(q, flat, table, 8, descs, platform="tpu")
+    assert not A._use_ragged_kernel(q, flat, table, 8, descs,
+                                    platform="cpu")
+    assert not A._use_ragged_kernel(q, flat, table, 7, descs,
+                                    platform="tpu")
+    assert not A._use_ragged_kernel(q, flat, table, 8, descs[:0],
+                                    platform="tpu")
+    odd = jnp.zeros((1, 4, 17, 64))
+    assert not A._use_ragged_kernel(odd, flat, table, 8, descs,
+                                    platform="tpu")
+
+
+def test_ragged_dispatcher_cpu_falls_back_to_reference():
+    """ragged_paged_cached_attention off-TPU returns the oracle verbatim
+    (same array contents), so the serving path is correct anywhere."""
+    q, state, descs, _, _, _ = _mixed_case(seed=5)
+    got = A.ragged_paged_cached_attention(
+        q, state.k[0], state.v[0], state.block_table, state.page_size,
+        descs, platform="cpu")
+    ref = A.ragged_paged_attention_reference(
+        q, state.k[0], state.v[0], state.block_table, state.page_size,
+        descs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))

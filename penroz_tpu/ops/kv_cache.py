@@ -418,6 +418,14 @@ class KVState:
         """Bytes an unquantized fp cache of the same shape would occupy."""
         return self.memory_bytes()
 
+    def hbm_components(self) -> dict:
+        """Byte attribution for the capacity ledger (serve/memledger.py):
+        KV values vs quantization scales vs block-table/counter metadata.
+        Components sum to everything this cache holds resident."""
+        return {"kv_values": self.memory_bytes(),
+                "kv_scales": 0,
+                "kv_block_table": 0}
+
 
 @jax.tree_util.register_pytree_node_class
 class QuantKVState(KVState):
@@ -534,6 +542,12 @@ class QuantKVState(KVState):
     def logical_bytes(self) -> int:
         itemsize = jnp.dtype(self.out_dtype).itemsize
         return sum(int(a.size) * itemsize for a in (*self.k, *self.v))
+
+    def hbm_components(self) -> dict:
+        return {"kv_values": self.memory_bytes(),
+                "kv_scales": sum(int(a.size) * a.dtype.itemsize
+                                 for a in (*self.k_scale, *self.v_scale)),
+                "kv_block_table": 0}
 
 
 def build_descriptors(spans, block_q: int, num_blocks: int):
@@ -1027,6 +1041,15 @@ class PagedKVState(KVState):
         B = self.block_table.shape[0]
         return B * self.max_len * self._row_bytes()
 
+    def _table_bytes(self) -> int:
+        return (int(self.block_table.size) * self.block_table.dtype.itemsize
+                + int(self.counters.size) * self.counters.dtype.itemsize)
+
+    def hbm_components(self) -> dict:
+        return {"kv_values": self.memory_bytes(),
+                "kv_scales": 0,
+                "kv_block_table": self._table_bytes()}
+
 
 @jax.tree_util.register_pytree_node_class
 class QuantPagedKVState(PagedKVState):
@@ -1203,6 +1226,13 @@ class QuantPagedKVState(PagedKVState):
                       for a in (*self.k, *self.v))
         return B * self.max_len * per_row
 
+    def hbm_components(self) -> dict:
+        return {"kv_values": sum(int(a.size) * a.dtype.itemsize
+                                 for a in (*self.k, *self.v)),
+                "kv_scales": sum(int(a.size) * a.dtype.itemsize
+                                 for a in (*self.k_scale, *self.v_scale)),
+                "kv_block_table": self._table_bytes()}
+
 
 def create_kv_state(specs, batch: int, max_len: int, dtype=jnp.float32,
                     quantized: bool | None = None,
@@ -1294,6 +1324,11 @@ class RadixPrefixCache:
         self.hit_tokens = 0
         self.inserted_pages = 0
         self.evicted_pages = 0
+        # Instance-scoped mirror of record_unpin_underflow: the module
+        # global can't say WHICH engine's cache underflowed, and crash
+        # recovery swaps cache instances (serve/memledger.py carries the
+        # retired instance's count forward).
+        self.unpin_underflows = 0
 
     # -- introspection ------------------------------------------------------
 
@@ -1387,7 +1422,55 @@ class RadixPrefixCache:
             nd.refs -= 1
             if nd.refs < 0:  # defensive: never let an unpaired unpin
                 nd.refs = 0  # turn into a negative permanent pin
+                self.unpin_underflows += 1
                 record_unpin_underflow(nd.key)
+
+    def iter_nodes(self):
+        """Every cached node across all namespaces (roots excluded — they
+        own no page).  DFS order; callers must not mutate while iterating."""
+        stack = [nd for root in self._roots.values()
+                 for nd in root.children.values()]
+        while stack:
+            nd = stack.pop()
+            stack.extend(nd.children.values())
+            yield nd
+
+    def page_audit(self) -> list[str]:
+        """Structural invariants of the page bookkeeping, as violation
+        strings (empty = sound).  Checks that cached + free is a PARTITION
+        of the reserved region: no page on both sides, no page on neither
+        (leaked), no page outside the region (foreign), no page under two
+        nodes.  The capacity ledger's strict mode (serve/memledger.py)
+        runs this after every retirement and crash recovery."""
+        problems: list[str] = []
+        region = set(self._pages)
+        free = list(self._free)
+        free_set = set(free)
+        if len(free) != len(free_set):
+            problems.append("duplicate pages on the free list")
+        cached: dict = {}
+        for nd in self.iter_nodes():
+            if nd.page in cached:
+                problems.append(
+                    f"page {nd.page} owned by two nodes")
+            cached[nd.page] = nd
+            if nd.page not in region:
+                problems.append(f"cached page {nd.page} outside the "
+                                f"reserved region")
+            if nd.refs < 0:
+                problems.append(f"page {nd.page}: negative refs {nd.refs}")
+        overlap = free_set & set(cached)
+        if overlap:
+            problems.append(f"pages both free and cached: {sorted(overlap)}")
+        leaked = region - free_set - set(cached)
+        if leaked:
+            problems.append(f"pages neither free nor cached (leaked): "
+                            f"{sorted(leaked)}")
+        foreign = free_set - region
+        if foreign:
+            problems.append(f"free-list pages outside the reserved region: "
+                            f"{sorted(foreign)}")
+        return problems
 
     def insert(self, tokens, limit=None,
                namespace=None) -> list[tuple[int, int]]:

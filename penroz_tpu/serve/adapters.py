@@ -198,6 +198,20 @@ class AdapterRegistry:
         with self._lock:
             return sorted(self._entries)
 
+    def cache_bytes(self) -> int:
+        """Host-RAM bytes of every cached adapter's factor arrays — the
+        ``adapter_host_cache`` component of the capacity ledger
+        (serve/memledger.py; host-side, unlike the on-device LoRA pack)."""
+        with self._lock:
+            total = 0
+            for e in self._entries.values():
+                for arr in (e.params or {}).values():
+                    size = getattr(arr, "size", None)
+                    dtype = getattr(arr, "dtype", None)
+                    if size is not None and dtype is not None:
+                        total += int(size) * dtype.itemsize
+            return total
+
     def entry_state(self, adapter_id: str) -> dict | None:
         with self._lock:
             e = self._entries.get(adapter_id)

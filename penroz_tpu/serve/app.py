@@ -866,13 +866,48 @@ async def trace_detail(request: web.Request):
     """One request's lifecycle span tree (GET /trace/{request_id}):
     queue wait, prefix-cache match, prefill chunks, decode/verify steps,
     crash-recovery events, and the retirement reason — in-flight
-    requests resolve too (their root span is still open)."""
+    requests resolve too (their root span is still open).
+    ``?format=chrome`` renders the same tree as Chrome trace-event JSON
+    (save and load in Perfetto / chrome://tracing)."""
     rid = request.match_info["request_id"]
     trace = tracing.get(rid)
     if trace is None:
         raise KeyError(f"no trace for request id {rid!r} (ring holds "
                        f"PENROZ_TRACE_BUFFER most recent)")
+    fmt = request.query.get("format", "json")
+    if fmt == "chrome":
+        return _json(trace.to_chrome())
+    if fmt != "json":
+        raise web.HTTPUnprocessableEntity(
+            text=json.dumps({"detail": f"unknown format {fmt!r} "
+                             "(expected 'json' or 'chrome')"}),
+            content_type="application/json")
     return _json(trace.to_dict())
+
+
+async def memory_stats(request: web.Request):
+    """The HBM capacity ledger (GET /memory/): every paged-pool page
+    attributed to its owner — free / live row (per tenant and adapter) /
+    pinned or evictable prefix-cache node / preempted-session hold /
+    reserved tail — plus byte accounting for contiguous and int8 KV,
+    the LoRA pack, params, and the adapter host cache, with high-water
+    marks and a token-burn-rate time-to-exhaustion estimate
+    (serve/memledger.py)."""
+    from penroz_tpu.serve import memledger
+    stats = await _run_blocking(memledger.memory_stats)
+    return _json(schemas.MemoryResponse.model_validate(
+        stats).model_dump())
+
+
+async def debug_dump(request: web.Request):
+    """The engine flight recorder (GET /debug/dump): bounded ring of
+    pre-crash snapshots — ledger, tick timeline, per-class/per-tenant
+    queue depths, recent trace ids — captured at every engine crash,
+    circuit-breaker open, and failed reset, before recovery wipes the
+    state (serve/memledger.py FlightRecorder)."""
+    from penroz_tpu.serve import memledger
+    return _json(schemas.DebugDumpResponse.model_validate(
+        memledger.FLIGHT_RECORDER.dump()).model_dump())
 
 
 async def healthz(request: web.Request):
@@ -1068,6 +1103,8 @@ def create_app() -> web.Application:
     app.router.add_get("/progress/", model_progress)
     app.router.add_get("/stats/", model_stats)
     app.router.add_get("/serving_stats/", serving_stats)
+    app.router.add_get("/memory/", memory_stats)
+    app.router.add_get("/debug/dump", debug_dump)
     app.router.add_get("/tenants/", list_tenants)
     app.router.add_put("/tenants/{tenant_id}/quota", put_tenant_quota)
     app.router.add_post("/adapters/", create_adapter)

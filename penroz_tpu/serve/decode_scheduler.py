@@ -162,6 +162,7 @@ from penroz_tpu.models import model as model_mod
 from penroz_tpu.models.model import NeuralNetworkModel
 from penroz_tpu.ops import kv_cache as KV
 from penroz_tpu.serve import adapters as adapters_mod
+from penroz_tpu.serve import memledger
 from penroz_tpu.serve import metrics as serve_metrics
 from penroz_tpu.serve import qos
 from penroz_tpu.serve import spec_decode
@@ -484,6 +485,11 @@ class DecodeEngine:
         # (slot _max_live = the always-zero base slot).
         self._max_live = lora_mod.max_live()
         self._adapter_tokens: dict = {}
+        # Capacity ledger (serve/memledger.py): derives per-page ownership
+        # from the structures below; must exist before the first
+        # _alloc_state so crash recovery can carry counters across
+        # prefix-cache instances.
+        self._ledger = memledger.MemoryLedger(self)
         self._alloc_state()
 
         # Admission queue: per-(tenant, class) sub-queues drained by
@@ -586,6 +592,7 @@ class DecodeEngine:
         tree's to hand out).  Used at construction AND by crash recovery —
         after a failed tick the old KV/prefix state is presumed corrupt
         and nothing from it survives."""
+        old_cache = getattr(self, "_prefix_cache", None)
         self._kv = (KV.create_kv_state(self._model.arch.kv_specs,
                                        self.capacity, self.block_size,
                                        self._model._kv_dtype(),
@@ -608,6 +615,10 @@ class DecodeEngine:
         self._slot_entries: list = [None] * self._max_live
         self._row_adapter = np.full(self.capacity, self._max_live, np.int32)
         self._lora_pack = None
+        # Fold the dying prefix cache's instance counters into the
+        # ledger's lifetime carry (engine-scoped underflow attribution
+        # must survive the recovery that replaces the cache).
+        self._ledger.on_realloc(old_cache)
 
     # -- public surface -----------------------------------------------------
 
@@ -806,6 +817,9 @@ class DecodeEngine:
                 {"age_s": round(now - e["t"], 3),
                  **{k: v for k, v in e.items() if k != "t"}}
                 for e in timeline],
+            "kv_pool_capacity_drops": self._ledger.pool_capacity_drops,
+            "unpin_underflows": self._ledger.unpin_underflows,
+            "memory": self._ledger.snapshot(),
             "queue_rejections": self._queue_rejections,
             "deadline_timeouts": self._deadline_timeouts,
             "breaker_rejections": self._breaker_rejections,
@@ -869,6 +883,11 @@ class DecodeEngine:
                 or 0.0, 3),
         }
 
+    def memory_snapshot(self) -> dict:
+        """The engine's capacity-ledger view (GET /memory/ reads through
+        here — same no-private-state contract as ``stats()``)."""
+        return self._ledger.snapshot()
+
     # -- worker loop --------------------------------------------------------
 
     def _run(self):
@@ -891,7 +910,13 @@ class DecodeEngine:
                 self._tick()
             except Exception as exc:  # noqa: BLE001 — fail requests, not thread
                 log.exception("Decode engine %s failed a tick", self.model_id)
+                # Count the crash, then postmortem BEFORE _fail_all /
+                # _alloc_state destroy the pre-crash ledger/timeline
+                # state the dump exists for — the recorded crashes_total
+                # names which crash the entry belongs to.
                 self._record_crash()
+                memledger.FLIGHT_RECORDER.record(
+                    self, "engine_crash", error=repr(exc))
                 crashed_traces = self._fail_all(exc, crashed=True)
                 try:
                     # Full reset: the exception left KV/prefix state in an
@@ -901,6 +926,12 @@ class DecodeEngine:
                     serve_metrics.ENGINE_RESETS.inc()
                     t_crash = time.monotonic()
                     self._alloc_state()
+                    # Recovery must hand back a provably clean pool: a
+                    # strict audit failure here means _alloc_state itself
+                    # leaked, and the breaker (outer except) is the only
+                    # honest response.
+                    if memledger.strict():
+                        self._ledger.audit("crash_recovery")
                     for tr in crashed_traces:
                         # The failed request's trace carries the recovery it
                         # triggered: crash site → clean engine, so "where
@@ -915,6 +946,7 @@ class DecodeEngine:
                 except Exception:  # noqa: BLE001 — can't trust the engine
                     log.exception("Decode engine %s reset FAILED; opening "
                                   "circuit breaker", self.model_id)
+                    memledger.FLIGHT_RECORDER.record(self, "reset_failed")
                     for tr in crashed_traces:
                         tr.finish("error")
                     with self._cond:
@@ -1287,6 +1319,9 @@ class DecodeEngine:
                     "Decode engine %s: circuit breaker OPEN after %d "
                     "consecutive crashes (next probe in %.0fms)",
                     self.model_id, self._crashes, _breaker_cooldown_ms())
+                # _cond is an RLock via Condition: the recorder's locked
+                # snapshot nests safely under this breaker-open hold.
+                memledger.FLIGHT_RECORDER.record(self, "circuit_open")
 
     def _purge_expired(self):
         """Shed queued requests whose deadline passed (504 before prefill
@@ -1486,18 +1521,27 @@ class DecodeEngine:
         req.enqueue_t = t0
         self._preemptions += 1
         serve_metrics.PREEMPTIONS.inc()
+        # A preemption IS a capacity-pressure event: the pool was too
+        # small for the admitted load and someone's pages were taken.
+        self._ledger.note_pressure()
         if req.trace is not None:
             req.trace.end(state.sp_prefill)
             req.trace.end(state.sp_decode, produced=state.produced)
             sp = req.trace.span("preempt", t0=t0, cached_tokens=cached,
                                 produced=state.produced)
             req.trace.end(sp)
+            req.trace.event("capacity_pressure", reason="preempted",
+                            cached_tokens=cached)
         with self._cond:
             self._pending.push_front(req)
         log.info("Decode engine %s: preempted row %d (%s/%s, %d produced, "
                  "%d tokens cached) for a queued interactive request",
                  self.model_id, row, req.tenant, req.priority,
                  state.produced, cached)
+        # The preempt path hands pages across three owners (row →
+        # preempted-hold → cache); prove the handoff balanced.
+        if memledger.strict():
+            self._ledger.audit("preempt")
 
     def _release_resume(self, req: Request):
         """Drop a preempted request's resume pins (resume admission,
@@ -2094,9 +2138,14 @@ class DecodeEngine:
         if self._lengths[row] >= self.block_size:
             # Defensive: eligibility admits only prompt+max_new <= block,
             # so this is a real pool-capacity truncation — count it.
+            dropped = req.max_new_tokens - state.produced
             KV.record_pool_drop(
-                req.max_new_tokens - state.produced,
+                dropped,
                 context=f"scheduler row hit block_size={self.block_size}")
+            self._ledger.note_pool_drop(dropped)
+            if req.trace is not None:
+                req.trace.event("capacity_pressure", reason="pool_capacity",
+                                dropped_tokens=dropped)
             self._retire(row, reason="pool_capacity")
 
     def _retire(self, row: int, notify: bool = True,
@@ -2132,6 +2181,12 @@ class DecodeEngine:
                     log.info("Decode engine %s: circuit breaker closed "
                              "(probe request completed)", self.model_id)
             self._deliver(state.req, "done", None)
+        # Leak-sanitizer seam: retirement is where every page-ownership
+        # transfer (unpin, reset_row, table restore) must have balanced.
+        # AFTER _deliver so a strict audit failure crashes the tick (→
+        # recovery) instead of hanging the retired request's consumer.
+        if memledger.strict():
+            self._ledger.audit("retire")
 
     def _release_prefix(self, row: int, state):
         """Unpin the row's aliased radix pages and restore its static block
@@ -2427,7 +2482,11 @@ def serving_stats() -> dict:
                                     if tpd["count"] else None),
         "tokens_per_dispatch_p50": _merged_q(per, "tokens_per_dispatch",
                                              0.5),
+        # Process-wide module totals, kept byte-compatible with the
+        # /metrics counters; the per-engine attribution lives in each
+        # engine's ledger-backed stats() fields of the same names.
         "kv_pool_capacity_drops": KV.pool_drop_count(),
+        "unpin_underflows": KV.unpin_underflow_count(),
     }
 
 

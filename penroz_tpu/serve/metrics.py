@@ -166,6 +166,31 @@ JIT_PROGRAMS = REGISTRY.register(m.Gauge(
     "engines — flat between scrapes means descriptor shape bucketing "
     "is holding; unbounded growth under steady traffic is compile churn",
     labelnames=("function",)))
+POOL_PAGES = REGISTRY.register(m.Gauge(
+    "penroz_pool_pages",
+    "Paged KV pool pages by owner state across engines (capacity ledger, "
+    "serve/memledger.py) — the states partition the pool, so the series "
+    "sum to total pool capacity", labelnames=("state",)))
+POOL_PAGES_HWM = REGISTRY.register(m.Gauge(
+    "penroz_pool_pages_hwm",
+    "High-water mark of pool pages per ledger state since engine start "
+    "('used' = total minus free — the capacity-planning peak)",
+    labelnames=("state",)))
+TENANT_KV_PAGES = REGISTRY.register(m.Gauge(
+    "penroz_tenant_kv_pages",
+    "Pool pages owned by live rows per tenant (page-granular HBM "
+    "attribution; prefix/preempted pages are shared, not tenant-owned)",
+    labelnames=("tenant",)))
+HBM_BYTES = REGISTRY.register(m.Gauge(
+    "penroz_hbm_bytes",
+    "Serving memory bytes by component: kv_values/kv_scales/"
+    "kv_block_table (device), lora_pack (device), params (device), "
+    "adapter_host_cache (host RAM)", labelnames=("component",)))
+KV_TTE = REGISTRY.register(m.Gauge(
+    "penroz_kv_time_to_exhaustion_s",
+    "Most-pressed engine's free-pool runway at the current token burn "
+    "rate, seconds — series ABSENT (not 0) when no engine has a recent "
+    "burn rate"))
 
 
 def _wire_gauges():
@@ -204,6 +229,15 @@ def _wire_gauges():
         return out
 
     JIT_PROGRAMS.set_function(jit_programs)
+
+    # Capacity-ledger gauges (lazy import: memledger lazy-imports the
+    # scheduler registry back, and neither may cycle at module load).
+    from penroz_tpu.serve import memledger
+    POOL_PAGES.set_function(memledger.pool_page_totals)
+    POOL_PAGES_HWM.set_function(memledger.pool_page_hwm_totals)
+    TENANT_KV_PAGES.set_function(memledger.tenant_page_totals)
+    HBM_BYTES.set_function(memledger.hbm_byte_totals)
+    KV_TTE.set_function(memledger.min_time_to_exhaustion)
 
 
 _WIRED = False

@@ -111,9 +111,46 @@ def _routes() -> list[dict]:
                      "prefix-cache match, prefill chunks, decode/verify "
                      "steps, crash-recovery events, retirement reason "
                      "(request ids come from the X-Request-Id response "
-                     "header)",
-             responses=dict([_resp(200, "Span tree"),
-                             _resp(404, "Unknown/evicted request id")])),
+                     "header); ?format=chrome returns the same tree as "
+                     "Chrome trace-event JSON loadable in Perfetto / "
+                     "chrome://tracing",
+             params=[{"name": "format", "in": "query", "required": False,
+                      "schema": {"type": "string",
+                                 "enum": ["json", "chrome"],
+                                 "default": "json"}}],
+             responses=dict([_resp(200, "Span tree (or Chrome "
+                                        "trace-event JSON)"),
+                             _resp(404, "Unknown/evicted request id"),
+                             _resp(422, "Unknown format")])),
+        dict(method="get", path="/memory/",
+             summary="HBM capacity ledger: every paged-pool page "
+                     "attributed to an owner (free / active row / "
+                     "prefix-cache pinned vs evictable / preempted "
+                     "session / reserved tail), per-tenant and "
+                     "per-adapter page counts, byte accounting per HBM "
+                     "component (KV values/scales/block tables, LoRA "
+                     "pack, params), high-water marks, and a token-burn "
+                     "time-to-exhaustion estimate "
+                     "(PENROZ_MEMLEDGER gates the ledger; "
+                     "PENROZ_MEMLEDGER_STRICT turns audit failures into "
+                     "crashes)",
+             responses={"200": {
+                 "description": "Memory ledger",
+                 "content": {"application/json": {"schema": {
+                     "$ref": "#/components/schemas/MemoryResponse"}}},
+             }}),
+        dict(method="get", path="/debug/dump",
+             summary="Crash flight recorder: the last "
+                     "PENROZ_DEBUG_DUMP_RING engine_crash / circuit_open "
+                     "snapshots, each carrying the pre-crash memory "
+                     "ledger, the last PENROZ_DEBUG_DUMP_TICKS tick "
+                     "records, per-class/per-tenant queue depths, and "
+                     "recent trace ids",
+             responses={"200": {
+                 "description": "Flight-recorder dump",
+                 "content": {"application/json": {"schema": {
+                     "$ref": "#/components/schemas/DebugDumpResponse"}}},
+             }}),
         dict(method="post", path="/model/",
              summary="Create a model from the layer/optimizer DSL",
              body=_body("CreateModelRequest", gpt2_124m_example()),
@@ -262,7 +299,8 @@ def build_spec() -> dict:
         schemas.DecodeTokensRequest,
         schemas.TrainingRequest, schemas.ProfileRequest,
         schemas.CreateAdapterRequest, schemas.TenantQuotaRequest,
-        schemas.ServingStatsResponse,
+        schemas.ServingStatsResponse, schemas.MemoryResponse,
+        schemas.DebugDumpResponse,
     ]
     _, defs = models_json_schema(
         [(m, "validation") for m in models],

@@ -137,6 +137,15 @@ class WFQueue:
     def class_depths(self) -> dict:
         return {cls: self._class_depth[cls] for cls in PRIORITIES}
 
+    def tenant_depths(self) -> dict:
+        """Queued requests per tenant (flight-recorder postmortems: WHOSE
+        work was waiting when the engine died)."""
+        depths: dict = {}
+        for (tenant, _cls), dq in self._queues.items():
+            if dq:
+                depths[tenant] = depths.get(tenant, 0) + len(dq)
+        return depths
+
     def _key(self, req):
         return (req.tenant, req.priority)
 

@@ -235,6 +235,59 @@ class TickRecord(BaseModel):
                              "phase at tick start (mixed-composition view)")
 
 
+class EngineMemory(BaseModel):
+    """One engine's capacity-ledger snapshot (serve/memledger.py): every
+    paged-pool page attributed to exactly one owner state, plus byte
+    accounting for the non-paged components.  The page states PARTITION
+    the pool — their sum equals pool_pages_total (audited in strict
+    mode)."""
+    paged: bool = Field(..., description="True when the engine runs the "
+                        "paged pool (page states populated); contiguous "
+                        "engines report bytes only")
+    page_size: int = Field(0, description="Tokens per pool page "
+                           "(PENROZ_KV_PAGE_SIZE; 0 when not paged)")
+    pool_pages_total: int = Field(0, description="Total pages in the "
+                                  "engine's paged pool (row partition + "
+                                  "reserved prefix-cache region)")
+    pool_pages: dict[str, int] = Field(
+        default_factory=dict, description="Pages per owner state: free | "
+        "row (live-row KV) | prefix_pinned (radix pages aliased by a live "
+        "row) | prefix_evictable (cached, unpinned) | preempted (pinned "
+        "by a queued preempted session's resume hold) | reserved (radix "
+        "free list).  States sum to pool_pages_total")
+    tenant_pages: dict[str, int] = Field(
+        default_factory=dict, description="Row-owned pages per tenant id "
+        "(page-granular HBM attribution)")
+    adapter_pages: dict[str, int] = Field(
+        default_factory=dict, description="Row-owned pages per LoRA "
+        "adapter id (adapter-bound rows only)")
+    hbm_bytes: dict[str, int] = Field(
+        default_factory=dict, description="Bytes per component: "
+        "kv_values / kv_scales (int8 variants) / kv_block_table / "
+        "lora_pack / params")
+    high_water_pages: dict[str, int] = Field(
+        default_factory=dict, description="Peak pages per state since "
+        "engine start ('used' = total minus free)")
+    time_to_exhaustion_s: Optional[float] = Field(
+        None, description="Free-pool runway at the recent token burn "
+        "rate, seconds (null when idle or not paged — unknown is not "
+        "exhausted)")
+    kv_pool_capacity_drops: int = Field(
+        0, description="THIS engine's pool-capacity truncations "
+        "(engine-scoped; /serving_stats/ top level keeps the "
+        "process-wide total)")
+    unpin_underflows: int = Field(
+        0, description="THIS engine's prefix-cache refcount underflows, "
+        "carried across crash-recovery cache reallocations — any nonzero "
+        "value is a pin/unpin pairing bug")
+    pressure_events: int = Field(
+        0, description="Capacity-pressure events: pool-capacity "
+        "truncations + QoS preemptions")
+    audit_failures: int = Field(
+        0, description="Ledger audits that found leaked/orphaned pages "
+        "(raises in PENROZ_MEMLEDGER_STRICT=1, counts always)")
+
+
 class EngineStats(BaseModel):
     """Per-engine snapshot inside ServingStatsResponse (one continuous-
     batching engine per (model, block_size, sampling config))."""
@@ -268,6 +321,17 @@ class EngineStats(BaseModel):
     prefix_cache: Optional[PrefixCacheStats] = Field(
         None, description="null unless PENROZ_PREFIX_CACHE=1 with the "
         "paged pool")
+    kv_pool_capacity_drops: int = Field(
+        0, description="Pool-capacity truncations attributed to THIS "
+        "engine by its ledger (the process-wide total stays on "
+        "/serving_stats/ and /metrics)")
+    unpin_underflows: int = Field(
+        0, description="Prefix-cache refcount underflows attributed to "
+        "THIS engine, surviving crash-recovery cache swaps")
+    memory: EngineMemory = Field(..., description="Capacity-ledger "
+                                 "snapshot: per-page ownership, byte "
+                                 "components, high-water marks, "
+                                 "time-to-exhaustion")
     queue_rejections: int = Field(0, description="Requests shed 429 at a "
                                   "full admission queue "
                                   "(PENROZ_SCHED_MAX_QUEUE / per-class "
@@ -481,6 +545,75 @@ class ServingStatsResponse(BaseModel):
     kv_pool_capacity_drops: int = Field(..., description="KV writes dropped "
                                         "at pool capacity (process-wide; "
                                         "ops/kv_cache.py record_pool_drop)")
+    unpin_underflows: int = Field(0, description="Prefix-cache refcount "
+                                  "underflows (process-wide module "
+                                  "counter, byte-compatible with the "
+                                  "/metrics gauge; per-engine attribution "
+                                  "lives on each engine's ledger)")
+
+
+class MemoryEngineEntry(EngineMemory):
+    """Per-engine entry of MemoryResponse: the ledger snapshot plus the
+    engine identity it belongs to."""
+    model_id: str
+    block_size: int
+    capacity: int = Field(..., description="Decode batch rows "
+                          "(PENROZ_SCHED_MAX_ROWS)")
+
+
+class MemoryResponse(BaseModel):
+    """GET /memory/ — the HBM capacity ledger (serve/memledger.py):
+    who owns every page of serving memory right now, across engines."""
+    memledger_enabled: bool = Field(..., description="False only with "
+                                    "PENROZ_MEMLEDGER=0 (page walks "
+                                    "skipped; snapshots empty)")
+    engines: list[MemoryEngineEntry]
+    pool_pages: dict[str, int] = Field(
+        default_factory=dict, description="Aggregate pages per owner "
+        "state across engines (penroz_pool_pages{state} mirrors this)")
+    tenant_pages: dict[str, int] = Field(
+        default_factory=dict, description="Aggregate row-owned pages per "
+        "tenant (penroz_tenant_kv_pages{tenant})")
+    hbm_bytes: dict[str, int] = Field(
+        default_factory=dict, description="Aggregate bytes per component "
+        "incl. adapter_host_cache (penroz_hbm_bytes{component})")
+    high_water_pages: dict[str, int] = Field(
+        default_factory=dict, description="Aggregate per-state peaks "
+        "(sum of engine peaks — engines peak independently)")
+    time_to_exhaustion_s: Optional[float] = Field(
+        None, description="MOST-PRESSED engine's free-pool runway at its "
+        "current burn rate (null when no engine has a recent rate)")
+    kv_pool_capacity_drops: int = Field(
+        0, description="Process-wide pool-capacity truncations "
+        "(ops/kv_cache.py counter — byte-compatible with /metrics)")
+    unpin_underflows: int = Field(
+        0, description="Process-wide prefix-cache refcount underflows")
+    pressure_events: int = Field(
+        0, description="Aggregate capacity-pressure events")
+    audit_failures: int = Field(
+        0, description="Aggregate ledger-audit failures (leaks/orphans)")
+    flight_records: int = Field(
+        0, description="Crash snapshots captured into the flight-recorder "
+        "ring (GET /debug/dump)")
+
+
+class DebugDumpResponse(BaseModel):
+    """GET /debug/dump — the engine flight recorder: bounded ring of
+    pre-crash snapshots (ledger + tick timeline + queue depths + recent
+    trace ids) captured at every engine_crash / circuit_open /
+    reset_failed, BEFORE recovery throws the evidence away."""
+    capacity: int = Field(..., description="Ring size "
+                          "(PENROZ_DEBUG_DUMP_RING, default 8)")
+    recorded: int = Field(..., description="Snapshots captured over the "
+                          "process lifetime (ring keeps the newest)")
+    entries: list[dict] = Field(
+        default_factory=list, description="Oldest-first ring contents; "
+        "each entry: unix_ts, reason (engine_crash|circuit_open|"
+        "reset_failed), error, model_id, block_size, crashes_total, "
+        "engine_resets, active_rows, queue_depth, ledger (EngineMemory "
+        "shape), tick_timeline (last PENROZ_DEBUG_DUMP_TICKS TickRecords), "
+        "queue_depth_by_class, queue_depth_by_tenant, recent_traces "
+        "{completed, live}")
 
 
 class ProfileRequest(BaseModel):

@@ -362,6 +362,97 @@ function renderTickStrip(data) {
   drawLabel(ctx, "occupancy", w - 68, 12, "#7fd1b9");
 }
 
+/* ---- HBM capacity ledger (/memory/) ------------------------------------ */
+
+/* Owner states in stacked-bar order (occupied states bottom-up, free on
+ * top) with their colors — mirrors serve/memledger.py PAGE_STATES. */
+const MEM_STATES = ["row", "prefix_pinned", "prefix_evictable", "preempted",
+                    "reserved", "free"];
+const MEM_COLORS = {
+  row: "#7aa2f7", prefix_pinned: "#b58cd9", prefix_evictable: "#56b6c2",
+  preempted: "#d19a66", reserved: "#5d7285", free: "#22303c",
+};
+
+function fmtBytes(n) {
+  if (n >= 1073741824) return (n / 1073741824).toFixed(2) + "GiB";
+  if (n >= 1048576) return (n / 1048576).toFixed(1) + "MiB";
+  if (n >= 1024) return (n / 1024).toFixed(1) + "KiB";
+  return `${n}B`;
+}
+
+/* Rolling client-side stacked history of the pool page states (same idea
+ * as servingHistory: /memory/ reports an instantaneous partition). */
+const memoryHistory = [];
+
+function renderMemory(data) {
+  const meta = $("memory-meta");
+  const canvas = $("memory-chart");
+  if (!meta || !canvas) return;
+  if (!data) {
+    meta.textContent = "memory ledger unavailable";
+    prepCanvas(canvas);
+    return;
+  }
+  if (!data.memledger_enabled) {
+    meta.textContent = "memory ledger off (PENROZ_MEMLEDGER=1 to enable)";
+    prepCanvas(canvas);
+    return;
+  }
+  const pool = data.pool_pages || {};
+  const total = MEM_STATES.reduce((a, s) => a + (pool[s] || 0), 0);
+  const used = total - (pool.free || 0);
+  const hwmUsed = (data.high_water_pages || {}).used || 0;
+  const pagesTxt = total === 0
+    ? "no paged pool (PAGED_KV_CACHE=1 for page-granular attribution)"
+    : `pages ${used}/${total} used (rows ${pool.row || 0} · pinned ` +
+      `${pool.prefix_pinned || 0} · evictable ` +
+      `${pool.prefix_evictable || 0} · preempted ${pool.preempted || 0} ` +
+      `· reserved ${pool.reserved || 0} · free ${pool.free || 0}) · ` +
+      `hwm ${hwmUsed}`;
+  const tenants = Object.entries(data.tenant_pages || {});
+  const tenantTxt = tenants.length === 0 ? ""
+    : ` · tenant pages ${tenants.slice(0, 4)
+        .map(([t, n]) => `${t}:${n}`).join(" ")}` +
+      (tenants.length > 4 ? ` +${tenants.length - 4}` : "");
+  const hbm = data.hbm_bytes || {};
+  const hbmTotal = Object.values(hbm).reduce((a, b) => a + b, 0);
+  const kvBytes = (hbm.kv_values || 0) + (hbm.kv_scales || 0) +
+    (hbm.kv_block_table || 0);
+  const hbmTxt = ` · HBM ${fmtBytes(hbmTotal)} (kv ${fmtBytes(kvBytes)})`;
+  const tte = data.time_to_exhaustion_s;
+  const tteTxt = ` · exhaustion ${tte == null ? "—" : tte.toFixed(0) + "s"}`;
+  /* Leak/pressure health readouts: any nonzero underflow or audit
+   * failure is a pin-accounting bug, not load. */
+  const healthTxt = ` · pool drops ${data.kv_pool_capacity_drops || 0}` +
+    ` · underflows ${data.unpin_underflows || 0}` +
+    ` · audit failures ${data.audit_failures || 0}` +
+    ` · flight records ${data.flight_records || 0}`;
+  meta.textContent = pagesTxt + tenantTxt + hbmTxt + tteTxt + healthTxt;
+
+  memoryHistory.push({ pool, total });
+  if (memoryHistory.length > 200) memoryHistory.shift();
+  const ctx = prepCanvas(canvas);
+  const w = canvas.width, h = canvas.height, pad = 8;
+  const hi = Math.max(...memoryHistory.map((m) => m.total), 1);
+  const bw = (w - 2 * pad) / memoryHistory.length;
+  memoryHistory.forEach((m, i) => {
+    let y = h - pad;
+    MEM_STATES.forEach((s) => {
+      const bh = (m.pool[s] || 0) / hi * (h - 2 * pad);
+      if (bh <= 0) return;
+      ctx.fillStyle = MEM_COLORS[s];
+      ctx.fillRect(pad + i * bw, y - bh, Math.max(1, bw - 1), bh);
+      y -= bh;
+    });
+  });
+  drawLabel(ctx, `${hi} pages`, 4, 12);
+  let lx = w - 440;
+  MEM_STATES.forEach((s) => {
+    drawLabel(ctx, s.replace("prefix_", ""), lx, 12, MEM_COLORS[s]);
+    lx += 74;
+  });
+}
+
 /* ---- per-request trace waterfall (/trace/, /trace/{id}) ---------------- */
 
 const SPAN_COLORS = {
@@ -440,6 +531,11 @@ async function refresh() {
   } catch (e) {
     renderServing(null);
     renderTickStrip(null);
+  }
+  try {
+    renderMemory(await fetchJson("/memory/"));
+  } catch (e) {
+    renderMemory(null);
   }
   await refreshTrace();
   if (!modelId) return;

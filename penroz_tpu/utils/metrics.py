@@ -239,9 +239,16 @@ class Gauge(Metric):
         v = self._value
         if self._fn is not None:
             try:
-                v = float(self._fn())
+                v = self._fn()
             except Exception:  # noqa: BLE001 — a scrape must never 500
                 v = self._value
+            # A callback returning None means "no value right now": the
+            # series is ABSENT from the scrape rather than rendered as a
+            # misleading 0 (same contract as quantile_of on an empty
+            # histogram) — e.g. time-to-exhaustion with no burn rate.
+            if v is None:
+                return []
+            v = float(v)
         return [f"{self.name} {_fmt_value(v)}"]
 
 

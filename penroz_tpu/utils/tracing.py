@@ -240,6 +240,41 @@ class Trace:
                 "root": self.root.to_dict(self.t0),
             }
 
+    def to_chrome(self) -> dict:
+        """The span tree as Chrome trace-event JSON (the ``traceEvents``
+        array format) — ``GET /trace/{id}?format=chrome`` loads directly
+        into Perfetto / chrome://tracing.  Complete events (``ph: "X"``)
+        with microsecond ``ts`` relative to the trace start (monotonic,
+        so events never go backwards); ``pid`` is the request id and
+        ``tid`` the span depth, which renders the tree as nested tracks.
+        In-flight spans clamp to "now" — a live snapshot is still a
+        valid, loadable file."""
+        with self._lock:
+            now = time.monotonic()
+            events = []
+            stack = [(self.root, 0)]
+            while stack:
+                sp, depth = stack.pop()
+                t1 = sp.t1 if sp.t1 is not None else now
+                ev = {
+                    "name": sp.name,
+                    "ph": "X",
+                    "ts": round((sp.t0 - self.t0) * 1e6, 1),
+                    "dur": round(max(0.0, t1 - sp.t0) * 1e6, 1),
+                    "pid": self.request_id,
+                    "tid": depth,
+                }
+                args = dict(sp.meta)
+                if sp is self.root:
+                    args.update(self.meta)
+                    args["started_unix"] = round(self.started_unix, 3)
+                if args:
+                    ev["args"] = args
+                events.append(ev)
+                stack.extend((c, depth + 1) for c in sp.children)
+            events.sort(key=lambda e: e["ts"])
+            return {"traceEvents": events, "displayTimeUnit": "ms"}
+
 
 # -- registry ---------------------------------------------------------------
 

@@ -92,6 +92,19 @@ prefill chunks AND n>1 fused decode steps — the regime the PR 7
 fallbacks forbade).  Scale knobs: ``PENROZ_BENCH_RAGGED_STREAMS/
 _PREFILLS/_PROMPT/_LONG/_PREFILL_NEW`` plus the shared set.
 
+``--memory`` switches to the capacity-ledger workload
+(serve/memledger.py): sequential streaming ITLs with the ledger off
+(``PENROZ_MEMLEDGER=0``) vs on, greedy parity asserted and the delta
+recorded (the ledger derives ownership at read time, so decode must not
+pay for it) — then two tenants decode concurrently while ``GET
+/memory/`` is polled: both must show nonzero per-tenant page counts and
+every poll must see the page states sum to pool capacity.  Runs with
+``PENROZ_MEMLEDGER_STRICT=1`` (a leaked page fails the bench) and gates
+``ok`` on parity + invariant + attribution + zero lifetime
+drop/underflow/audit counters.  Scale knobs: ``PENROZ_BENCH_MEM_PAGE``,
+``PENROZ_BENCH_MEM_PROMPT``, plus the shared ``PENROZ_BENCH_SERVING_*``
+/ ``PENROZ_BENCH_REQUESTS`` / ``PENROZ_BENCH_MAX_NEW`` set.
+
 ``--chaos`` arms ONE fault site (``PENROZ_BENCH_CHAOS_SITE``, default
 ``qos.preempt``; Nth trigger via ``PENROZ_BENCH_CHAOS_AT``) and drives
 mixed-priority overload waves through it — the building block
@@ -1334,6 +1347,148 @@ async def _bench_ragged() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --memory: capacity-ledger overhead + mixed-tenant attribution
+# ---------------------------------------------------------------------------
+
+async def _bench_memory() -> dict:
+    """Capacity-ledger workload (serve/memledger.py): the ledger derives
+    ownership at read time, so its cost must be invisible on the decode
+    path.  Phase one streams sequential requests with PENROZ_MEMLEDGER=0
+    then =1 (greedy parity asserted, ITL delta recorded — the acceptance
+    bar is 'within noise', so the capture records evidence, not a flaky
+    threshold).  Phase two fires two tenants concurrently and polls
+    ``GET /memory/`` while they decode: both tenants must show up with
+    nonzero page counts and every engine's page states must sum to its
+    pool capacity on every poll.  Runs STRICT (a leaked page raises in
+    the worker and fails the bench), and the final snapshot must carry
+    zero audit failures, pool drops, and unpin underflows."""
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler, memledger
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 256)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 256)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 4)
+    per_tenant = _env_i("PENROZ_BENCH_REQUESTS", 3)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 32)
+    page = _env_i("PENROZ_BENCH_MEM_PAGE", 16)
+    prompt_len = _env_i("PENROZ_BENCH_MEM_PROMPT", 24)
+    vocab = 512
+    assert prompt_len + max_new <= block
+
+    env = {decode_scheduler.ENABLE_ENV: "1",
+           "PAGED_KV_CACHE": "1",
+           "PENROZ_KV_PAGE_SIZE": str(page),
+           "PENROZ_PREFIX_CACHE": "1",
+           memledger.STRICT_ENV: "1"}
+    saved = {k: os.environ.get(k) for k in (*env, memledger.ENABLE_ENV)}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+               for _ in range(2 * per_tenant)]
+
+    def payload(prompt, tenant=None):
+        body = {"model_id": "bench-mem", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+        if tenant is not None:
+            body["tenant"] = tenant
+        return body
+
+    results: dict = {"mode": "memory", "block_size": block,
+                     "page_size": page, "requests_per_tenant": per_tenant,
+                     "max_new_tokens": max_new, "prompt_len": prompt_len,
+                     "model_d": d, "model_depth": depth}
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-mem",
+            "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
+
+        # -- phase 1: ledger on/off ITL (warm per mode: the compile and
+        # prefix-cache state must not masquerade as ledger cost)
+        seqs = {}
+        for mode in ("off", "on"):
+            os.environ[memledger.ENABLE_ENV] = "0" if mode == "off" else "1"
+            decode_scheduler.reset()
+            await _stream_one(client, payload(prompts[0]))
+            itls, toks_all = [], []
+            for p in prompts:
+                toks, _, gaps = await _stream_one(client, payload(p))
+                itls.extend(gaps)
+                toks_all.append(toks)
+            seqs[mode] = toks_all
+            results[f"ledger_{mode}"] = {
+                "itl_ms_p50": round(_pct(itls, 0.5), 3),
+                "itl_ms_p99": round(_pct(itls, 0.99), 3),
+            }
+        results["itl_p50_delta_ms"] = round(
+            results["ledger_on"]["itl_ms_p50"]
+            - results["ledger_off"]["itl_ms_p50"], 3)
+        parity_ok = seqs["off"] == seqs["on"]
+
+        # -- phase 2: mixed tenants decoding while /memory/ attributes
+        os.environ[memledger.ENABLE_ENV] = "1"
+        decode_scheduler.reset()
+        jobs = [(p, "mem-a" if i % 2 == 0 else "mem-b")
+                for i, p in enumerate(prompts)]
+        gen = asyncio.gather(*[_stream_one(client, payload(p, t))
+                               for p, t in jobs])
+        peak_tenants: dict = {}
+        invariant_ok = True
+        polls = 0
+        while not gen.done():
+            resp = await client.get("/memory/")
+            mem = await resp.json()
+            polls += 1
+            for e in mem["engines"]:
+                invariant_ok = invariant_ok and (
+                    sum(e["pool_pages"].values()) == e["pool_pages_total"])
+            tp = mem["tenant_pages"]
+            if sum(tp.values()) > sum(peak_tenants.values() or [0]):
+                peak_tenants = dict(tp)
+            await asyncio.sleep(0.02)
+        outs = await gen
+        mixed_seqs = [toks for toks, _, _ in outs]
+        parity_ok = parity_ok and mixed_seqs == seqs["on"]
+        attribution_ok = (peak_tenants.get("mem-a", 0) > 0
+                          and peak_tenants.get("mem-b", 0) > 0)
+        results["attribution"] = {
+            "polls": polls, "tenant_pages_peak": peak_tenants,
+            "ok": attribution_ok}
+
+        # -- final snapshot: a clean pool and zero lifetime leak counters
+        resp = await client.get("/memory/")
+        final = await resp.json()
+        final.pop("engines", None)
+        results["final_memory"] = final
+        clean = (final["audit_failures"] == 0
+                 and final["kv_pool_capacity_drops"] == 0
+                 and final["unpin_underflows"] == 0)
+        results["parity_ok"] = parity_ok
+        results["invariant_ok"] = invariant_ok
+        results["ok"] = bool(parity_ok and invariant_ok
+                             and attribution_ok and clean)
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
 # --chaos: one armed fault site under overload (scripts/chaos_matrix.sh)
 # ---------------------------------------------------------------------------
 
@@ -1479,7 +1634,7 @@ def main():
     args = [a for a in sys.argv[1:]
             if a not in ("--shared-prefix", "--overload", "--speculative",
                          "--multi-adapter", "--multistep", "--mixed-slo",
-                         "--chaos", "--ragged")]
+                         "--chaos", "--ragged", "--memory")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     speculative = "--speculative" in sys.argv[1:]
@@ -1488,6 +1643,7 @@ def main():
     mixed_slo = "--mixed-slo" in sys.argv[1:]
     chaos = "--chaos" in sys.argv[1:]
     ragged = "--ragged" in sys.argv[1:]
+    memory = "--memory" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
@@ -1524,6 +1680,9 @@ def main():
         return
     if ragged:
         _emit(asyncio.run(_bench_ragged()))
+        return
+    if memory:
+        _emit(asyncio.run(_bench_memory()))
         return
     concurrency = int(args[0]) if len(args) > 0 else 8
     max_new = int(args[1]) if len(args) > 1 else 48

@@ -127,6 +127,20 @@ while true; do
           -- "BENCH_RAGGED_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) ragged capture committed" >> logs/bench_watch.log
     fi
+    # Capacity-ledger capture (same shape as the shared-prefix hook):
+    # ledger on/off ITL delta + mixed-tenant /memory/ attribution under
+    # PENROZ_MEMLEDGER_STRICT=1.  Opt-in; failures must not block the
+    # main capture.
+    if [ "${PENROZ_WATCH_MEMORY:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_MEM_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --memory \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_MEM_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: capacity-ledger capture" \
+          -- "BENCH_MEM_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) capacity-ledger capture committed" >> logs/bench_watch.log
+    fi
     # Multi-tenant LoRA capture (same shape as the shared-prefix hook):
     # mixed-adapter ITL/wall vs per-adapter serial groups + parity.
     # Opt-in; failures must not block the main capture.

@@ -36,7 +36,11 @@ for site in $SITES; do
     ragged=1
     [ "$mode" = "phased" ] && ragged=0
     echo "=== chaos: site=$site mode=$mode ===" >&2
+    # Strict memory ledger: every retirement/preemption/crash recovery in
+    # the sweep re-proves the page-ownership invariant (serve/memledger.py)
+    # — a leaked page raises in the engine worker and fails the combo.
     out=$(PENROZ_BENCH_CHAOS_SITE="$site" PENROZ_RAGGED_ATTENTION="$ragged" \
+            PENROZ_MEMLEDGER_STRICT=1 \
             timeout 900 python scripts/bench_serving.py --chaos)
     rc=$?
     echo "$out"

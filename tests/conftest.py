@@ -13,6 +13,11 @@ import os
 # remote accelerator at backend init — for full hermeticity also launch
 # pytest with a scrubbed PYTHONPATH (no plugin site dir).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Leak-sanitizer mode for the whole suite: every retirement, preemption,
+# and crash recovery re-proves the HBM ledger invariant (owned + free ==
+# pool capacity, refcounts == derivable pins) and raises on violations
+# (serve/memledger.py).  setdefault so a run can opt out explicitly.
+os.environ.setdefault("PENROZ_MEMLEDGER_STRICT", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +

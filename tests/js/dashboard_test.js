@@ -25,6 +25,8 @@ const fixtures = {
     fs.readFileSync(path.join(HERE, "fixtures/stats_plain.json"))),
   serving: JSON.parse(
     fs.readFileSync(path.join(HERE, "fixtures/serving.json"))),
+  memory: JSON.parse(
+    fs.readFileSync(path.join(HERE, "fixtures/memory.json"))),
   traceList: JSON.parse(
     fs.readFileSync(path.join(HERE, "fixtures/trace_list.json"))),
   traceDetail: JSON.parse(

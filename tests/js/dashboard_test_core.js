@@ -60,6 +60,7 @@ const PANEL_IDS = ["model-id", "layer-filter", "refresh-btn", "auto-refresh",
                    "speed-chart", "ratio-chart", "hist-grid",
                    "serving-meta", "serving-chart",
                    "tick-meta", "tick-strip",
+                   "memory-meta", "memory-chart",
                    "trace-id", "trace-meta", "trace-waterfall"];
 
 function makeDocument() {
@@ -85,6 +86,7 @@ function gridCells(grid) {
 }
 
 async function runDashboard(src, { progress, stats, serving = null,
+                                   memory = null,
                                    traceList = null, traceDetail = null,
                                    progressStatus = 200 }) {
   const document = makeDocument();
@@ -102,6 +104,10 @@ async function runDashboard(src, { progress, stats, serving = null,
     if (url.startsWith("/serving_stats/")) {
       return { ok: serving !== null, status: serving === null ? 500 : 200,
                json: async () => serving };
+    }
+    if (url.startsWith("/memory/")) {
+      return { ok: memory !== null, status: memory === null ? 500 : 200,
+               json: async () => memory };
     }
     if (url === "/trace/") {
       return { ok: traceList !== null,
@@ -142,10 +148,12 @@ async function runDashboardTests(src, fixtures) {
   {
     const { document, fetched } = await runDashboard(src, {
       progress: fixtures.progress, stats: fixtures.statsMoe,
-      serving: fixtures.serving, traceList: fixtures.traceList,
+      serving: fixtures.serving, memory: fixtures.memory,
+      traceList: fixtures.traceList,
       traceDetail: fixtures.traceDetail });
-    assertEq(fetched.length, 5,
-             "fetches /serving_stats/, /trace/ (x2), /progress/, /stats/");
+    assertEq(fetched.length, 6,
+             "fetches /serving_stats/, /memory/, /trace/ (x2), " +
+             "/progress/, /stats/");
     const servingMeta = document.byId["serving-meta"].textContent;
     assertOk(servingMeta.includes("tok/s"),
              "serving tile shows decode throughput");
@@ -239,6 +247,35 @@ async function runDashboardTests(src, fixtures) {
       .filter((o) => o[0] === "fillText").map((o) => String(o[1]));
     assertOk(tickLabels.some((l) => l.includes("mixed")),
              "tick strip legends the unified mixed phase");
+    // HBM capacity ledger panel: per-state page ownership, tenant
+    // attribution, time-to-exhaustion, and the leak health counters
+    const memPool = fixtures.memory.pool_pages;
+    const memTotal = Object.values(memPool).reduce((a, b) => a + b, 0);
+    const memMeta = document.byId["memory-meta"].textContent;
+    assertOk(memMeta.includes(
+               `pages ${memTotal - memPool.free}/${memTotal} used`),
+             "memory panel shows the used/total page partition");
+    assertOk(memMeta.includes(`rows ${memPool.row}`),
+             "memory panel counts live-row pages");
+    assertOk(memMeta.includes(`pinned ${memPool.prefix_pinned}`),
+             "memory panel counts pinned prefix-cache pages");
+    assertOk(memMeta.includes(`preempted ${memPool.preempted}`),
+             "memory panel counts preempted-session resume pages");
+    assertOk(memMeta.includes("tenant pages tenant-a:" +
+               fixtures.memory.tenant_pages["tenant-a"]),
+             "memory panel attributes pages per tenant");
+    assertOk(memMeta.includes("exhaustion " +
+               fixtures.memory.time_to_exhaustion_s.toFixed(0) + "s"),
+             "memory panel shows time-to-exhaustion");
+    assertOk(memMeta.includes(
+               `underflows ${fixtures.memory.unpin_underflows}`),
+             "memory panel surfaces unpin underflows");
+    assertOk(memMeta.includes(
+               `audit failures ${fixtures.memory.audit_failures}`),
+             "memory panel surfaces ledger audit failures");
+    const memOps = document.byId["memory-chart"]._ops.map((o) => o[0]);
+    assertOk(memOps.includes("fillRect"),
+             "memory chart drew the stacked ownership bars");
     // per-request waterfall: newest completed trace, span labels visible
     const traceMeta = document.byId["trace-meta"].textContent;
     assertOk(traceMeta.includes(fixtures.traceDetail.request_id),
@@ -270,8 +307,23 @@ async function runDashboardTests(src, fixtures) {
              "serving tile reports unavailable endpoint without crashing");
     assertOk(document.byId["tick-meta"].textContent.includes("no ticks"),
              "tick strip degrades without serving stats");
+    assertOk(document.byId["memory-meta"].textContent.includes("unavailable"),
+             "memory panel degrades without the ledger endpoint");
     assertOk(document.byId["trace-meta"].textContent.includes("no traces"),
              "waterfall degrades without any trace");
+  }
+
+  // 2e. ledger disabled (PENROZ_MEMLEDGER=0): the panel says so instead
+  //     of rendering an all-zero pool as if memory were free
+  {
+    const memoryOff = Object.assign({}, fixtures.memory, {
+      memledger_enabled: false });
+    const { document } = await runDashboard(src, {
+      progress: fixtures.progress, stats: fixtures.statsPlain,
+      serving: fixtures.serving, memory: memoryOff });
+    assertOk(document.byId["memory-meta"].textContent.includes(
+               "memory ledger off"),
+             "memory panel shows the disabled state");
   }
 
   // 2b. serving stats without prefix-cache / spec-decode fields (features

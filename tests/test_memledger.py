@@ -1,0 +1,593 @@
+"""HBM capacity-ledger tests (serve/memledger.py): page-granularity
+ownership attribution, the strict-mode leak sanitizer, and the engine
+crash flight recorder.
+
+The load-bearing invariants:
+
+- **The partition holds everywhere** — every paged-pool page is in
+  exactly one owner state and the states sum to pool capacity, across
+  the full serving matrix (prefix cache × int8 KV × supersteps × spec
+  decode × LoRA) and after every injected fault-site crash.  The whole
+  suite runs with ``PENROZ_MEMLEDGER_STRICT=1`` (tests/conftest.py), so
+  every retirement/preemption/crash-recovery seam re-proves it in the
+  worker thread too — a leak anywhere fails the request, not just this
+  file.
+- **Attribution is honest** — ``GET /memory/`` per-tenant page counts
+  are pinned against an INDEPENDENT walk of the device block table
+  (assigned entries minus radix-aliased pages), not against the
+  ledger's own arithmetic.
+- **The flight recorder keeps the evidence** — ``GET /debug/dump``
+  after an injected ``decode.step`` crash serves the PRE-crash ledger
+  and tick timeline that ``_alloc_state`` then throws away.
+"""
+
+import asyncio
+import json
+import queue
+import re
+import time
+
+import numpy as np
+import pytest
+
+from penroz_tpu.models import lora
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+from penroz_tpu.utils import faults
+
+pytestmark = pytest.mark.runtime
+
+BLOCK = 16
+SGD = {"sgd": {"lr": 0.1}}
+PAGE = 4
+# Repetitive prompt: the 1-gram prompt-lookup matcher drafts early, so
+# spec combos provably exercise the verify path.
+REP_PROMPT = [1, 2, 3, 1, 2, 3, 1, 2]
+
+
+@pytest.fixture(autouse=True)
+def _ledger_state(workdir):
+    """Fresh engine registry + every process-wide counter the ledger
+    reads or feeds: fault ordinals, QoS quotas, KV drop/underflow
+    globals, the adapter host cache, the serve-metrics registry, and the
+    flight-recorder ring (process-wide — it survives
+    decode_scheduler.reset() by design, so tests must drop it)."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import adapters, decode_scheduler, memledger, qos
+    from penroz_tpu.serve import metrics as serve_metrics
+    from penroz_tpu.utils import tracing
+
+    def _zero():
+        faults.reset()
+        qos.reset()
+        tracing.reset()
+        serve_metrics.reset()
+        KV.reset_pool_drop_count()
+        KV.reset_unpin_underflow_count()
+        adapters.REGISTRY.reset()
+        memledger.reset()
+
+    _zero()
+    yield
+    decode_scheduler.reset()
+    _zero()
+
+
+@pytest.fixture
+def gpt_model(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("memgpt", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    return model
+
+
+@pytest.fixture
+def make_engine():
+    from penroz_tpu.serve import decode_scheduler
+    engines = []
+
+    def build(*args, **kwargs):
+        engine = decode_scheduler.DecodeEngine(*args, **kwargs)
+        engines.append(engine)
+        return engine
+
+    yield build
+    for engine in engines:
+        engine.shutdown()
+
+
+@pytest.fixture
+def paged_env(monkeypatch):
+    """Paged pool + radix prefix cache + chunked prefill sized to the
+    BLOCK=16 toy prompts (page = 4 tokens, cache region = 8 pages)."""
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", str(PAGE))
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "4")
+    return monkeypatch
+
+
+@pytest.fixture
+def client(workdir):
+    from penroz_tpu.serve import app as app_mod
+    app_mod.model_locks.clear()
+    app_mod.dataset_locks.clear()
+    from aiohttp.test_utils import TestClient, TestServer
+    loop = asyncio.new_event_loop()
+    client = TestClient(TestServer(app_mod.create_app()), loop=loop)
+    loop.run_until_complete(client.start_server())
+    yield client, loop
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+def _request(client_loop, method, path, **kw):
+    client, loop = client_loop
+
+    async def go():
+        resp = await client.request(method, path, **kw)
+        body = await resp.read()
+        return resp, body
+
+    return loop.run_until_complete(go())
+
+
+def _json(client_loop, method, path, **kw):
+    resp, body = _request(client_loop, method, path, **kw)
+    return resp.status, (json.loads(body) if body else None)
+
+
+class _Collector:
+    def __init__(self, prompt):
+        self.q = queue.Queue()
+        self.tokens = list(prompt)
+
+    def on_event(self, kind, value):
+        self.q.put((kind, value))
+
+    def result(self, timeout=180):
+        deadline = time.monotonic() + timeout
+        while True:
+            kind, value = self.q.get(
+                timeout=max(deadline - time.monotonic(), 0.1))
+            if kind == "token":
+                self.tokens.append(value)
+            elif kind == "done":
+                return self.tokens
+            else:
+                raise value
+
+
+def _submit(engine, prompt, max_new, tenant=None, adapter=None):
+    from penroz_tpu.serve import decode_scheduler
+    collector = _Collector(prompt)
+    engine.submit(decode_scheduler.Request(prompt, max_new, None,
+                                           collector.on_event,
+                                           tenant=tenant, adapter=adapter))
+    return collector
+
+
+def _settle(engine, timeout=30):
+    """Wait for the tick that retired the last request to finish (the
+    'done' event ships from inside the emit loop, before the tick's
+    retirement bookkeeping runs)."""
+    deadline = time.monotonic() + timeout
+    stats = engine.stats()
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        nxt = engine.stats()
+        if (engine.idle()
+                and nxt["decode_tokens"] == stats["decode_tokens"]
+                and len(nxt["tick_timeline"]) == len(stats["tick_timeline"])):
+            return
+        stats = nxt
+
+
+def _block_table_walk(engine):
+    """INDEPENDENT per-tenant page attribution: walk the device block
+    table counting assigned physical pages within each live row's valid
+    length, minus the radix-cache pages the row merely aliases.  Shares
+    no arithmetic with MemoryLedger._snapshot_locked (set difference on
+    physical page ids vs. ceil-division on counts) — caller holds
+    ``engine._cond``."""
+    kv = engine._kv
+    page = kv.page_size
+    table = np.asarray(kv.block_table)
+    row_pages, tenants = 0, {}
+    for i, row in enumerate(engine._rows):
+        if row is None:
+            continue
+        used = -(-int(engine._lengths[i]) // page)
+        assigned = {int(p) for p in table[i, :used].tolist() if int(p) >= 0}
+        aliased = {nd.page for nd in row.prefix_nodes}
+        owned = len(assigned - aliased)
+        row_pages += owned
+        t = row.req.tenant
+        tenants[t] = tenants.get(t, 0) + owned
+    return row_pages, tenants
+
+
+def _oracle_drafter(bases):
+    """Draft the exact greedy continuation so the verify path provably
+    engages (full acceptance, multi-token emission)."""
+    def propose(history, k, n):
+        for base in bases:
+            if len(history) < len(base) and history == base[:len(history)]:
+                return [int(t) for t in base[len(history):len(history) + k]]
+        return []
+    return propose
+
+
+# ---------------------------------------------------------------------------
+# THE invariant matrix: partition + parity across every serving variant
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("paged,int8,superstep,spec,use_lora", [
+    (0, 0, 1, 0, 0), (1, 0, 1, 0, 0), (1, 1, 1, 0, 0), (1, 0, 4, 0, 0),
+    (1, 1, 8, 0, 0), (1, 0, 1, 1, 0), (1, 0, 1, 0, 1)],
+    ids=["fp-contig", "paged-prefix", "int8-paged-prefix", "superstep4",
+         "int8-superstep8", "spec-paged-prefix", "lora-paged-prefix"])
+def test_ledger_invariant_parity_matrix(gpt_model, make_engine, monkeypatch,
+                                        paged, int8, superstep, spec,
+                                        use_lora):
+    """Across prefix cache × int8 KV × supersteps × spec decode × LoRA:
+    greedy outputs stay token-identical to the standalone path (the
+    ledger observes, never steers), every page lands in exactly one
+    owner state, the states sum to pool capacity, and an explicit final
+    audit finds nothing — with strict mode having already re-proved the
+    invariant at every retirement seam inside the worker."""
+    from penroz_tpu.serve import adapters, spec_decode
+    if paged:
+        monkeypatch.setenv("PAGED_KV_CACHE", "1")
+        monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", str(PAGE))
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+        monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "4")
+    if int8:
+        monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    if superstep > 1:
+        from penroz_tpu.serve import decode_scheduler
+        monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, str(superstep))
+    pa, pb = list(REP_PROMPT), [5, 6, 7]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 6, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 8, temperature=0.0)
+    if spec:
+        monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+        monkeypatch.setattr(spec_decode, "propose",
+                            _oracle_drafter([base_a, base_b]))
+    adapter = None
+    if use_lora:
+        # Zero-init adapter: serves exactly the base model, so the LoRA
+        # row path (pack bytes, adapter attribution) runs under parity.
+        cfg = lora.validate_config({"rank": 4})
+        params = lora.init_params(gpt_model.arch, cfg, seed=7)
+        lora.save_adapter("memled-a", "memgpt", cfg, params,
+                          {"code": "Created"}, sync_flush=True)
+        adapter = adapters.REGISTRY.acquire("memled-a", "memgpt")
+
+    engine = make_engine("memgpt", BLOCK, 0.0, None, capacity=2)
+    ca = _submit(engine, pa, 6, adapter=adapter)
+    cb = _submit(engine, pb, 8)
+    # A mid-flight snapshot (any live row) seeds the high-water marks.
+    deadline = time.monotonic() + 60
+    while engine.active_rows == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    engine.memory_snapshot()
+    assert ca.result() == base_a
+    assert cb.result() == base_b
+    # second wave: prefix-cache hits (when on) over cached pages
+    assert _submit(engine, pa, 6, adapter=adapter).result() == base_a
+    _settle(engine)
+
+    snap = engine.memory_snapshot()
+    states = snap["pool_pages"]
+    assert sum(states.values()) == snap["pool_pages_total"]
+    assert all(n >= 0 for n in states.values())
+    assert engine._ledger.audit("test-final") == []
+    assert snap["audit_failures"] == 0
+    assert snap["kv_pool_capacity_drops"] == 0
+    assert snap["unpin_underflows"] == 0
+    if paged:
+        assert snap["paged"] is True
+        assert snap["page_size"] == PAGE
+        assert snap["pool_pages_total"] > 0
+        # engine idle: nothing owned by rows, nothing pinned or held
+        assert states["row"] == 0
+        assert states["prefix_pinned"] == 0
+        assert states["preempted"] == 0
+        # retirements inserted pages into the radix cache
+        assert states["prefix_evictable"] > 0
+        assert snap["tenant_pages"] == {}
+        assert snap["high_water_pages"]["used"] >= 1
+        assert snap["high_water_pages"]["row"] >= 1
+    else:
+        assert snap["paged"] is False
+        assert snap["pool_pages_total"] == 0
+    hbm = snap["hbm_bytes"]
+    assert hbm["kv_values"] > 0
+    assert hbm["params"] > 0
+    assert (hbm["kv_scales"] > 0) == bool(int8)
+    assert (hbm["lora_pack"] > 0) == bool(use_lora)
+
+
+# ---------------------------------------------------------------------------
+# GET /memory/ attribution vs. the independent block-table walk
+# ---------------------------------------------------------------------------
+
+def test_mixed_tenant_attribution_matches_block_table_walk(
+        gpt_model, client, paged_env):
+    """Three live rows (tenants a, a, b) slowed mid-decode by a sleep
+    fault: GET /memory/ per-tenant page counts equal the independent
+    device block-table walk, and the /metrics tenant/pool gauges agree.
+
+    The three views cannot be read under one lock (the HTTP handlers run
+    the snapshot on an executor thread, which would deadlock on the
+    engine lock this thread held), so consistency comes from a
+    read-walk-read sandwich instead: live rows only GROW their page
+    counts, so when the two HTTP reads on either side of the lock-held
+    walk agree, the walk's value is squeezed between them and all three
+    describe the same state."""
+    from penroz_tpu.serve import decode_scheduler
+    paged_env.setenv(faults.ENV, "decode.step:sleep@400")
+    engine = decode_scheduler.get_engine("memgpt", BLOCK, 0.0, None)
+    cols = [_submit(engine, [1, 2, 3, 4, 5], 8, tenant="tenant-a"),
+            _submit(engine, [7, 8, 9], 8, tenant="tenant-a"),
+            _submit(engine, [11, 12, 13, 14, 15], 8, tenant="tenant-b")]
+    def rows_prefilled():
+        """All three rows live with KV written (zero-length rows own no
+        pages yet — the interesting attribution starts after prefill)."""
+        with engine._cond:
+            live = [i for i, r in enumerate(engine._rows) if r is not None]
+            return (len(live) == 3
+                    and all(int(engine._lengths[i]) > 0 for i in live))
+
+    deadline = time.monotonic() + 120
+    while not rows_prefilled():
+        assert time.monotonic() < deadline, "rows never all prefilled"
+        time.sleep(0.02)
+
+    def mem_entry():
+        status, body = _json(client, "GET", "/memory/")
+        assert status == 200 and body["memledger_enabled"] is True
+        return body, next(e for e in body["engines"]
+                          if e["model_id"] == "memgpt")
+
+    matched = False
+    while not matched:
+        assert time.monotonic() < deadline, \
+            "no stable read-walk-read window before the rows retired"
+        body1, e1 = mem_entry()
+        mstatus, mbody = _request(client, "GET", "/metrics")
+        with engine._cond:
+            live = sum(r is not None for r in engine._rows)
+            truth_rows, truth_tenants = _block_table_walk(engine)
+        body2, e2 = mem_entry()
+        if live < 3 or (e1["tenant_pages"], e1["pool_pages"]["row"]) != \
+                (e2["tenant_pages"], e2["pool_pages"]["row"]):
+            continue  # a tick advanced mid-sandwich; try again
+        matched = True
+
+    assert e1["tenant_pages"] == truth_tenants
+    assert set(truth_tenants) == {"tenant-a", "tenant-b"}
+    assert e1["pool_pages"]["row"] == truth_rows
+    assert truth_rows >= 3  # every live row owns at least one page
+    assert sum(e1["pool_pages"].values()) == e1["pool_pages_total"]
+    # the aggregate view is the same single engine
+    assert body1["pool_pages"] == e1["pool_pages"]
+    assert body1["tenant_pages"] == truth_tenants
+
+    assert mstatus.status == 200
+    text = mbody.decode()
+
+    def gauge(name, **labels):
+        lab = ("{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+               if labels else "")
+        m = re.search(rf"^{re.escape(name + lab)} (\S+)$", text, re.M)
+        assert m, f"no sample for {name}{lab}"
+        return float(m.group(1))
+
+    for tenant, pages in truth_tenants.items():
+        assert gauge("penroz_tenant_kv_pages", tenant=tenant) == pages
+    assert gauge("penroz_pool_pages", state="row") == truth_rows
+    assert gauge("penroz_pool_pages", state="free") == \
+        e1["pool_pages"]["free"]
+
+    for c in cols:
+        c.result()
+    _settle(engine)
+    final = engine.memory_snapshot()
+    assert final["pool_pages"]["row"] == 0
+    assert final["tenant_pages"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Chaos sites: every injected crash leaves a provably clean pool
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec_site", [
+    ("decode.step:raise@2", False),
+    ("decode.prefill_chunk:raise@1", False),
+    ("decode.verify:raise@1", True)],
+    ids=["step", "prefill_chunk", "verify"])
+def test_chaos_fault_sites_leave_clean_ledger(gpt_model, make_engine,
+                                              paged_env, spec_site):
+    """Each registered decode fault site crashes the engine mid-flight;
+    strict mode audited crash recovery INSIDE the worker (a leaked page
+    there would open the breaker), the resubmitted request is
+    greedy-identical, and the final explicit audit is clean."""
+    site, need_spec = spec_site
+    from penroz_tpu.serve import spec_decode
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                     temperature=0.0)
+    if need_spec:
+        paged_env.setenv("PENROZ_SPEC_DECODE", "1")
+        paged_env.setattr(spec_decode, "propose", _oracle_drafter([base]))
+    paged_env.setenv(faults.ENV, site)
+    engine = make_engine("memgpt", BLOCK, 0.0, None, capacity=2)
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, REP_PROMPT, 6).result()
+    paged_env.delenv(faults.ENV)
+    faults.reset()
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    _settle(engine)
+    stats = engine.stats()
+    assert stats["crashes_total"] == 1
+    assert stats["breaker_open"] is False  # strict recovery audit passed
+    snap = engine.memory_snapshot()
+    assert sum(snap["pool_pages"].values()) == snap["pool_pages_total"]
+    assert snap["pool_pages"]["row"] == 0
+    assert snap["pool_pages"]["prefix_pinned"] == 0
+    assert engine._ledger.audit("test-after-crash") == []
+    assert snap["audit_failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: GET /debug/dump serves the pre-crash evidence
+# ---------------------------------------------------------------------------
+
+def test_debug_dump_captures_pre_crash_ledger(gpt_model, client, paged_env):
+    """decode.step:raise@3 kills the third decode tick; the recorder
+    snapshots BEFORE _fail_all/_alloc_state destroy the state, so the
+    dump's ledger still shows the crashed row's pages and the tick
+    timeline that led up to it."""
+    from penroz_tpu.serve import decode_scheduler
+    paged_env.setenv(faults.ENV, "decode.step:raise@3")
+    engine = decode_scheduler.get_engine("memgpt", BLOCK, 0.0, None)
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, [1, 2, 3, 4, 5], 10).result()
+
+    status, dump = _json(client, "GET", "/debug/dump")
+    assert status == 200
+    assert dump["capacity"] == 8  # PENROZ_DEBUG_DUMP_RING default
+    assert dump["recorded"] == 1 and len(dump["entries"]) == 1
+    entry = dump["entries"][0]
+    assert entry["reason"] == "engine_crash"
+    assert "InjectedFault" in entry["error"]
+    assert entry["model_id"] == "memgpt"
+    assert entry["crashes_total"] == 1
+    assert entry["active_rows"] == 1
+    # the PRE-crash ledger: the dying row still owns its pages
+    ledger = entry["ledger"]
+    assert ledger["paged"] is True
+    assert ledger["pool_pages"]["row"] >= 1
+    assert sum(ledger["pool_pages"].values()) == ledger["pool_pages_total"]
+    # tick timeline tail + queue state + trace correlation keys
+    assert entry["tick_timeline"]
+    assert all("age_s" in t for t in entry["tick_timeline"])
+    assert isinstance(entry["queue_depth_by_class"], dict)
+    assert isinstance(entry["queue_depth_by_tenant"], dict)
+    assert set(entry["recent_traces"]) == {"completed", "live"}
+
+    # the aggregate ledger carries the recorder count, and the engine
+    # came back with a clean (reset) pool
+    status, mem = _json(client, "GET", "/memory/")
+    assert status == 200 and mem["flight_records"] == 1
+    mentry = next(e for e in mem["engines"] if e["model_id"] == "memgpt")
+    assert mentry["pool_pages"]["row"] == 0
+    assert mentry["audit_failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# /metrics gauge exposure + engine-scoped counter attribution
+# ---------------------------------------------------------------------------
+
+def test_metrics_memory_gauge_families(gpt_model, client, paged_env):
+    """After one completed request every capacity-ledger gauge family is
+    declared and the labeled series match the engine snapshot (the
+    partition sum shows up ON the scrape: states sum to capacity)."""
+    from penroz_tpu.serve import decode_scheduler, memledger
+    engine = decode_scheduler.get_engine("memgpt", BLOCK, 0.0, None)
+    _submit(engine, [1, 2, 3, 4, 5], 6).result()
+    _settle(engine)
+    status, body = _request(client, "GET", "/metrics")
+    assert status.status == 200
+    text = body.decode()
+    for fam in ("penroz_pool_pages", "penroz_pool_pages_hwm",
+                "penroz_tenant_kv_pages", "penroz_hbm_bytes",
+                "penroz_kv_time_to_exhaustion_s"):
+        assert f"# TYPE {fam} gauge" in text
+
+    def gauge(name, **labels):
+        lab = "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+        m = re.search(rf"^{re.escape(name + lab)} (\S+)$", text, re.M)
+        assert m, f"no sample for {name}{lab}"
+        return float(m.group(1))
+
+    snap = engine.memory_snapshot()
+    for state in memledger.PAGE_STATES:
+        assert gauge("penroz_pool_pages", state=state) == \
+            snap["pool_pages"][state]
+    assert sum(gauge("penroz_pool_pages", state=s)
+               for s in memledger.PAGE_STATES) == snap["pool_pages_total"]
+    assert gauge("penroz_pool_pages_hwm", state="used") >= 1
+    assert gauge("penroz_hbm_bytes", component="kv_values") > 0
+    assert gauge("penroz_hbm_bytes", component="params") > 0
+    assert gauge("penroz_hbm_bytes", component="adapter_host_cache") >= 0
+    # TTE is absent-or-nonnegative, never a misleading rendered zero
+    m = re.search(r"^penroz_kv_time_to_exhaustion_s (\S+)$", text, re.M)
+    if m:
+        assert float(m.group(1)) >= 0
+
+
+def test_engine_scoped_drop_and_underflow_attribution(gpt_model,
+                                                      make_engine):
+    """Satellite 1: the ledger refines the process-wide KV globals into
+    per-engine attribution — engine counters move without touching the
+    byte-compatible /metrics totals, and the underflow carry survives
+    crash-recovery cache replacement."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import memledger
+    engine = make_engine("memgpt", BLOCK, 0.0, None, capacity=2)
+    _submit(engine, [1, 2, 3], 4).result()
+    _settle(engine)
+    assert engine.stats()["kv_pool_capacity_drops"] == 0
+
+    engine._ledger.note_pool_drop(5)
+    stats = engine.stats()
+    assert stats["kv_pool_capacity_drops"] == 1
+    snap = engine.memory_snapshot()
+    assert snap["kv_pool_capacity_drops"] == 1
+    assert snap["pressure_events"] == 1
+    assert engine._ledger.dropped_tokens == 5
+    # the process-wide total (what /metrics exports) is untouched: the
+    # engine-scoped ledger refines it, never double-counts into it
+    assert KV.pool_drop_count() == 0
+    assert memledger.memory_stats()["kv_pool_capacity_drops"] == 0
+
+    # crash recovery replaces the prefix cache; the dying instance's
+    # underflow count folds into the lifetime carry
+    class _DyingCache:
+        unpin_underflows = 3
+
+    assert engine.stats()["unpin_underflows"] == 0
+    engine._ledger.on_realloc(_DyingCache())
+    assert engine._ledger.unpin_underflows == 3
+    assert engine.stats()["unpin_underflows"] == 3
+    assert KV.unpin_underflow_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# Kill switch: PENROZ_MEMLEDGER=0 degrades to zeros, never to lies
+# ---------------------------------------------------------------------------
+
+def test_ledger_disabled_degrades_gracefully(gpt_model, make_engine,
+                                             paged_env):
+    """With the ledger off: serving is untouched (greedy parity), the
+    snapshot reports zeros instead of guesses, audits are no-ops even in
+    strict mode, and the flight recorder drops its captures."""
+    from penroz_tpu.serve import memledger
+    paged_env.setenv("PENROZ_MEMLEDGER", "0")
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                     temperature=0.0)
+    engine = make_engine("memgpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    _settle(engine)
+    snap = engine.memory_snapshot()
+    assert snap["pool_pages_total"] == 0
+    assert all(n == 0 for n in snap["pool_pages"].values())
+    assert all(n == 0 for n in snap["hbm_bytes"].values())
+    assert engine._ledger.audit("disabled") == []
+    memledger.FLIGHT_RECORDER.record(engine, "engine_crash")
+    assert memledger.FLIGHT_RECORDER.recorded == 0
+    stats = memledger.memory_stats()
+    assert stats["memledger_enabled"] is False

@@ -486,6 +486,48 @@ def test_trace_sampling_and_ring_bound(client, gpt_model, monkeypatch):
     assert status == 404
 
 
+def test_trace_chrome_export_grammar(client, gpt_model, monkeypatch):
+    """``GET /trace/{id}?format=chrome`` emits Chrome trace-event JSON
+    that loads in Perfetto / chrome://tracing: complete events
+    (``ph: "X"``) with pid/tid/ts/dur, microsecond timestamps that never
+    go backwards, and the span tree rendered as tid = depth."""
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    resp, _ = _request(client, "POST", "/generate/", json=_gen_payload())
+    assert resp.status == 200
+    rid = resp.headers["X-Request-Id"]
+    _trace_for(client, rid)
+    status, doc = _json(client, "GET", f"/trace/{rid}?format=chrome")
+    assert status == 200
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    assert events
+    for e in events:
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert e["pid"] == rid
+        assert isinstance(e["tid"], int) and e["tid"] >= 0
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts), "trace events must be ts-monotonic"
+    # the root event leads, spans the request, and carries the trace meta
+    root = events[0]
+    assert root["name"] == "request" and root["tid"] == 0
+    assert root["args"]["retire_reason"] == "max_new_tokens"
+    assert root["dur"] >= max(e["ts"] + e["dur"] for e in events) - 1.0
+    names = {e["name"] for e in events}
+    assert {"queue", "prefill", "decode"} <= names
+    # nesting survives the flattening: decode_step/chunk events sit at
+    # depth ≥ 2 under request → decode/prefill
+    assert max(e["tid"] for e in events) >= 2
+    # unknown format is a 422, and the default JSON tree is unchanged
+    status, _ = _json(client, "GET", f"/trace/{rid}?format=bogus")
+    assert status == 422
+    status, tree = _json(client, "GET", f"/trace/{rid}")
+    assert status == 200
+    assert tree["root"]["name"] == "request"
+
+
 def test_profiler_trace_alias_roundtrip(client, tmp_path):
     """POST /profiler/trace/ start → stop aliases /profile/ and writes a
     capture directory."""
@@ -541,3 +583,36 @@ def test_serving_stats_schema_sync(client, gpt_model, monkeypatch):
     tick_fields = set(schemas.TickRecord.model_fields)
     for entry in fixture["tick_timeline"]:
         assert set(entry) == tick_fields
+
+    # the per-engine memory ledger block embedded in /serving_stats/
+    # (and its fixture copy) matches EngineMemory key-for-key
+    emem_fields = set(schemas.EngineMemory.model_fields)
+    assert set(stats["engines"][0]["memory"]) == emem_fields
+    assert set(fixture["engines"][0]["memory"]) == emem_fields
+    assert set(spec["components"]["schemas"]["EngineMemory"]
+               ["properties"]) == emem_fields
+
+    # GET /memory/ — the same no-drift contract for the capacity ledger:
+    # live payload == MemoryResponse == OpenAPI == tests/js/fixtures/
+    # memory.json, all key-for-key
+    status, mem = _json(client, "GET", "/memory/")
+    assert status == 200
+    mem_fields = set(schemas.MemoryResponse.model_fields)
+    ment_fields = set(schemas.MemoryEngineEntry.model_fields)
+    assert set(mem) == mem_fields
+    assert mem["engines"] and set(mem["engines"][0]) == ment_fields
+    assert set(spec["components"]["schemas"]["MemoryResponse"]
+               ["properties"]) == mem_fields
+    assert set(spec["components"]["schemas"]["MemoryEngineEntry"]
+               ["properties"]) == ment_fields
+    mem_fixture = json.load(open(os.path.join(HERE, "js", "fixtures",
+                                              "memory.json")))
+    assert set(mem_fixture) == mem_fields, (
+        "tests/js/fixtures/memory.json drifted from MemoryResponse — "
+        "update the fixture with the schema")
+    assert set(mem_fixture["engines"][0]) == ment_fields
+
+    # /debug/dump validates through DebugDumpResponse (empty ring here)
+    status, dump = _json(client, "GET", "/debug/dump")
+    assert status == 200
+    assert set(dump) == set(schemas.DebugDumpResponse.model_fields)

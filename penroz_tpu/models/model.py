@@ -2156,6 +2156,76 @@ class NeuralNetworkModel:
             for k, v in self.buffers.items()}
         return jax.device_put(kv, self._kv_sharding_tree(kv, mesh, batch))
 
+    def _serve_mesh(self):
+        """Serving mesh for a continuous-batching DecodeEngine (None =
+        single-device, today's layout).  Opt-in via ``PENROZ_SERVE_MESH=1``
+        with ``PENROZ_SERVE_MESH_MODEL`` tensor-parallel devices — unlike
+        :meth:`_decode_mesh` this path DOES cover the paged and int8
+        layouts (the page pools shard their head dim; block tables and
+        allocator counters stay replicated, the scheduler keeps authoring
+        them host-side)."""
+        if os.environ.get("PENROZ_SERVE_MESH", "0") != "1":
+            return None
+        if dist.process_count() > 1:
+            return None  # engines are per-host; scale-out is the router
+        try:
+            model = int(os.environ.get("PENROZ_SERVE_MESH_MODEL", "1"))
+        except ValueError:
+            log.warning("Invalid PENROZ_SERVE_MESH_MODEL; serving "
+                        "single-device")
+            return None
+        if model < 1:
+            return None
+        try:
+            platform = (self.device.platform if self.device is not None
+                        else None)
+            devices = (jax.local_devices(backend=platform) if platform
+                       else jax.local_devices())
+        except RuntimeError:
+            return None
+        if len(devices) < model:
+            log.warning("PENROZ_SERVE_MESH_MODEL=%d exceeds %d local "
+                        "devices; serving single-device", model,
+                        len(devices))
+            return None
+        return mesh_lib.serve_mesh(model=model, devices=devices)
+
+    def enter_serve_mesh(self, kv):
+        """Place params/buffers and a DecodeEngine's freshly allocated KV
+        state on the serving mesh (``PENROZ_SERVE_MESH=1``).  Returns
+        ``(kv, devices)`` where ``devices`` is the mesh size (1 when
+        unmeshed).  A 1-device mesh is numerically a GSPMD no-op —
+        token-identical to the unmeshed engine — which is what lets the
+        CPU tier-1 parity matrix keep proving correctness for the sharded
+        serving path."""
+        mesh = self._serve_mesh()
+        if mesh is None:
+            return kv, 1
+        if any(k.startswith("__pipe__") for k in self.params):
+            return kv, 1  # mid-pipeline-training layout: leave it alone
+        live = [v for v in self.params.values()
+                if isinstance(getattr(v, "sharding", None),
+                              jax.sharding.NamedSharding)
+                and len(v.sharding.device_set) > 1]
+        if live:
+            # Same rule as _enter_decode_mesh: params already living on a
+            # multi-device (training/eval) mesh are NOT reshuffled —
+            # gathering ZeRO-3 storage could OOM the exact models FSDP
+            # exists for.  The engine's KV simply follows that mesh.
+            mesh = live[0].sharding.mesh
+        else:
+            log.info("Serving over device mesh %s", dict(mesh.shape))
+            self.params = sharding_lib.shard_params(self.params, mesh)
+            self.buffers = {
+                k: sharding_lib.place(v, mesh_lib.replicated(mesh))
+                for k, v in self.buffers.items()}
+        if isinstance(kv, KV.PagedKVState):
+            tree = sharding_lib.paged_kv_sharding_tree(
+                kv, mesh, self.arch.kv_specs)
+        else:
+            tree = self._kv_sharding_tree(kv, mesh)
+        return jax.device_put(kv, tree), mesh.size
+
     def _kv_specs(self, batch: int = 1, max_len: int = 0):
         return self.arch.kv_specs
 

@@ -175,6 +175,30 @@ def _dequantize_int8(q, scale, dtype):
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
+def array_device_bytes(a) -> int:
+    """Per-device HBM bytes for one array — the shard size, not the
+    logical global size.
+
+    Under a serving mesh a head-sharded page pool occupies ``1/tp`` of
+    its logical size on each device; the capacity ledger
+    (serve/memledger.py) and every ``memory_bytes`` below account what a
+    device actually holds, so HBM headroom math stays honest when the
+    engine shards.  Replicated arrays, committed single-device arrays
+    (``SingleDeviceSharding.shard_shape`` is the identity) and plain
+    numpy all report the global size — every unmeshed byte count is
+    bit-for-bit what it was before this helper existed.
+    """
+    shape = tuple(getattr(a, "shape", ()))
+    sharding = getattr(a, "sharding", None)
+    if sharding is not None:
+        try:
+            shape = tuple(sharding.shard_shape(shape))
+        except (TypeError, ValueError, AttributeError):
+            pass  # exotic shardings: report the logical size
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return size * np.dtype(a.dtype).itemsize
+
+
 @jax.tree_util.register_pytree_node_class
 class KVState:
     """Preallocated functional KV buffers: per-layer (B, Hkv, S_max, D).
@@ -410,9 +434,10 @@ class KVState:
         the paged variants override this with a fixed page partition."""
         return self
 
-    # Observability: bytes resident in HBM for this cache.
+    # Observability: per-device bytes resident in HBM for this cache
+    # (shard bytes under a serving mesh — see array_device_bytes).
     def memory_bytes(self) -> int:
-        return sum(int(a.size) * a.dtype.itemsize for a in (*self.k, *self.v))
+        return sum(array_device_bytes(a) for a in (*self.k, *self.v))
 
     def logical_bytes(self) -> int:
         """Bytes an unquantized fp cache of the same shape would occupy."""
@@ -545,7 +570,7 @@ class QuantKVState(KVState):
 
     def hbm_components(self) -> dict:
         return {"kv_values": self.memory_bytes(),
-                "kv_scales": sum(int(a.size) * a.dtype.itemsize
+                "kv_scales": sum(array_device_bytes(a)
                                  for a in (*self.k_scale, *self.v_scale)),
                 "kv_block_table": 0}
 
@@ -1215,7 +1240,7 @@ class QuantPagedKVState(PagedKVState):
         return values + scales
 
     def memory_bytes(self) -> int:
-        return sum(int(a.size) * a.dtype.itemsize
+        return sum(array_device_bytes(a)
                    for a in (*self.k, *self.v, *self.k_scale, *self.v_scale))
 
     def logical_bytes(self) -> int:
@@ -1227,9 +1252,9 @@ class QuantPagedKVState(PagedKVState):
         return B * self.max_len * per_row
 
     def hbm_components(self) -> dict:
-        return {"kv_values": sum(int(a.size) * a.dtype.itemsize
+        return {"kv_values": sum(array_device_bytes(a)
                                  for a in (*self.k, *self.v)),
-                "kv_scales": sum(int(a.size) * a.dtype.itemsize
+                "kv_scales": sum(array_device_bytes(a)
                                  for a in (*self.k_scale, *self.v_scale)),
                 "kv_block_table": self._table_bytes()}
 

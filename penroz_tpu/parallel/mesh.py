@@ -77,6 +77,22 @@ def make_mesh(devices=None, *, data: Optional[int] = None, model: int = 1,
                       PIPE_AXIS))
 
 
+def serve_mesh(model: int = 1, devices=None) -> Mesh:
+    """Serving mesh for ONE decode engine: ``model`` tensor-parallel
+    devices, every other axis trivial.  Data parallelism across engines is
+    the router's job (serve/router.py) — replicas own disjoint meshes
+    rather than sharing a ``data`` axis, so one replica's crash recovery
+    never invalidates another's compiled programs.  Built over the FIRST
+    ``model`` local devices so a 1-wide mesh on a multi-device host stays
+    on device 0 exactly like the unmeshed engine (the token-parity
+    guarantee the CPU suite proves rides on this)."""
+    devices = list(devices if devices is not None else jax.local_devices())
+    if model < 1 or model > len(devices):
+        raise ValueError(f"serve mesh needs 1 <= model <= {len(devices)} "
+                         f"local devices (got model={model})")
+    return make_mesh(devices[:model], model=model)
+
+
 def batch_sharding(mesh: Mesh, batch_ndim: int = 2) -> NamedSharding:
     """Shard the leading batch dim over ``data``.  For sequence sharding use
     ``parallel.sharding.shard_batch`` (spec-based, handles both axes)."""

@@ -109,6 +109,30 @@ def shard_params(params: dict, mesh: Mesh, fsdp: bool = False) -> dict:
     return {k: place(v, shardings[k]) for k, v in params.items()}
 
 
+def paged_kv_sharding_tree(kv, mesh: Mesh, kv_specs):
+    """Sharding pytree for a paged KV state (ops/kv_cache.py PagedKVState /
+    QuantPagedKVState) under a serving mesh: every layer's flat
+    ``(Hkv, pages*page_size, D)`` page pool shards its head dim over
+    ``model`` when every attention layer's KV head count divides the axis
+    (GQA models with too few KV heads stay replicated — a torn head is
+    worse than a copied pool); the int8 variants' ``(Hkv, rows, 1)`` scale
+    planes follow their pools leaf-by-leaf.  The block table, the packed
+    allocator counters and the ragged lengths stay replicated: page
+    indices are host-authored and every head shard walks the same map.
+    """
+    import jax
+    tp = mesh.shape[MODEL_AXIS]
+    heads_ok = tp > 1 and all(h % tp == 0 for h, _ in kv_specs)
+    pool = NamedSharding(
+        mesh, P(MODEL_AXIS if heads_ok else None, None, None))
+    repl = NamedSharding(mesh, P())
+
+    def leaf_sharding(leaf):
+        return pool if getattr(leaf, "ndim", 0) == 3 else repl
+
+    return jax.tree.map(leaf_sharding, kv)
+
+
 def batch_spec(mesh: Mesh, *, leading_steps: bool = False,
                shard_sequence: bool = False) -> P:
     """Spec for (B, T) or (num_steps, B, T) token batches."""

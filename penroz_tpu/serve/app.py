@@ -321,6 +321,9 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
     if not decode_scheduler.eligible(prompt, body.block_size,
                                      body.max_new_tokens):
         return None
+    # Under PENROZ_SCHED_REPLICAS > 1 this is a serve/router.py
+    # EngineRouter over N data-parallel replica engines — same submit()
+    # surface, so everything below is placement-agnostic.
     engine = await decode_scheduler.acquire_engine(
         body.model_id, body.block_size, body.temperature, body.top_k)
     if engine is None:  # registry at capacity with nothing evictable
@@ -918,9 +921,11 @@ async def healthz(request: web.Request):
 
 
 async def readyz(request: web.Request):
-    """Readiness: 503 while any engine's circuit breaker is open or the
-    server is draining for shutdown — load balancers stop routing here
-    while the scheduler path cannot serve."""
+    """Readiness: 503 while the scheduler path cannot serve — an open
+    standalone-engine breaker, or (PENROZ_SCHED_REPLICAS > 1) a replica
+    group with EVERY breaker open, or a drain in progress.  One healthy
+    replica keeps its model ready: the router fails admissions over to it
+    instead of 503ing, so load balancers keep routing here."""
     from penroz_tpu.serve import decode_scheduler
     breaker_open = decode_scheduler.breaker_open_engines()
     draining = decode_scheduler.draining()

@@ -188,6 +188,7 @@ DRAIN_S_ENV = "PENROZ_DRAIN_S"
 TICK_TIMELINE_ENV = "PENROZ_TICK_TIMELINE"
 SUPERSTEP_ENV = "PENROZ_SCHED_SUPERSTEP"
 RAGGED_ENV = "PENROZ_RAGGED_ATTENTION"
+REPLICAS_ENV = "PENROZ_SCHED_REPLICAS"
 
 # Max tick-timeline entries served per /serving_stats/ payload (the ring
 # itself holds PENROZ_TICK_TIMELINE entries).
@@ -263,6 +264,13 @@ def _max_rows() -> int:
 
 def _max_engines() -> int:
     return _env_int(MAX_ENGINES_ENV, 4)
+
+
+def _replicas() -> int:
+    """Data-parallel engine replicas per (model, config) key.  > 1 routes
+    acquisition through serve/router.py; 1 (the default) is byte-for-byte
+    today's single-engine registry."""
+    return _env_int(REPLICAS_ENV, 1)
 
 
 def _admit_ms() -> float:
@@ -458,13 +466,19 @@ class DecodeEngine:
     """
 
     def __init__(self, model_id: str, block_size: int, temperature,
-                 top_k, capacity: int | None = None):
+                 top_k, capacity: int | None = None, replica: int = 0):
         self.model_id = model_id
         self.block_size = int(block_size)
         self.temperature = temperature
         self.top_k = top_k
         self.capacity = capacity or _max_rows()
         self.greedy = temperature is None or float(temperature) == 0.0
+        # Data-parallel replica index within a serve/router.py group (0 for
+        # standalone engines); router-owned engines are exempt from the
+        # registry's idle eviction — the router owns their lifecycle.
+        self.replica = int(replica)
+        self._router_owned = False
+        self._mesh_devices = 1  # set by _alloc_state under PENROZ_SERVE_MESH
 
         self._model = NeuralNetworkModel.deserialize(model_id)
         self._ckpt_stamp_v = self._ckpt_stamp()
@@ -599,6 +613,11 @@ class DecodeEngine:
                                        extra_pool_pages=self._extra_pages)
                     .with_static_table()
                     .with_lengths(np.zeros(self.capacity, np.int32)))
+        # Serving mesh (PENROZ_SERVE_MESH=1): params/buffers shard over the
+        # model axis once, the fresh KV pools follow; a 1-device mesh is a
+        # GSPMD no-op so the CPU parity suite covers this path.  Block
+        # table and lengths stay host-authored either way.
+        self._kv, self._mesh_devices = self._model.enter_serve_mesh(self._kv)
         self._prefix_cache = None
         if self._extra_pages > 0 and isinstance(self._kv, KV.PagedKVState):
             base = self.capacity * self._kv.pages_per_seq
@@ -847,6 +866,8 @@ class DecodeEngine:
             "temperature": 0.0 if self.greedy else float(self.temperature),
             "top_k": self.top_k,
             "capacity": self.capacity,
+            "replica": self.replica,
+            "mesh_devices": self._mesh_devices,
             "active_rows": active,
             "queue_depth": self.queue_depth,
             "occupancy": active / self.capacity,
@@ -2314,6 +2335,14 @@ def get_engine(model_id, block_size, temperature, top_k):
     (HTTP 404)."""
     if _DRAINING:
         return None
+    if _replicas() > 1:
+        # Data-parallel replica group: the router owns engine creation and
+        # per-request placement; it quacks like an engine (submit) so the
+        # HTTP layer is unchanged.  Lazy import — router imports this
+        # module at its top.
+        from penroz_tpu.serve import router as router_mod
+        return router_mod.get_router(model_id, block_size, temperature,
+                                     top_k)
     key = _engine_key(model_id, block_size, temperature, top_k)
     with _REG_LOCK:
         engine = _ENGINES.get(key)
@@ -2322,7 +2351,11 @@ def get_engine(model_id, block_size, temperature, top_k):
         if engine is not None:
             del _ENGINES[key]
         if len(_ENGINES) >= _max_engines():
-            victim = next((k for k, e in _ENGINES.items() if e.idle()), None)
+            # Router-owned replicas are never eviction victims: their
+            # lifecycle belongs to their router, and silently shutting one
+            # down would strand the group's affinity index.
+            victim = next((k for k, e in _ENGINES.items()
+                           if e.idle() and not e._router_owned), None)
             if victim is None:
                 log.warning("Decode engine registry full (%d) with no idle "
                             "engine; request falls back to the per-request "
@@ -2337,6 +2370,8 @@ def get_engine(model_id, block_size, temperature, top_k):
 def reset():
     """Shut every engine down and clear the registry (tests, reloads)."""
     global _DRAINING
+    from penroz_tpu.serve import router as router_mod
+    router_mod.clear()
     with _REG_LOCK:
         engines = list(_ENGINES.values())
         _ENGINES.clear()
@@ -2350,11 +2385,22 @@ def draining() -> bool:
 
 
 def breaker_open_engines() -> list[str]:
-    """model_ids of engines whose circuit breaker is currently open
-    (the /readyz not-ready signal)."""
+    """model_ids the scheduler path cannot currently serve — the /readyz
+    not-ready signal.  A standalone engine with an open breaker reports
+    its model, exactly as before; a router-owned replica GROUP reports
+    only when EVERY replica's breaker is open — one healthy replica keeps
+    the model ready because the router routes around the open ones."""
     with _REG_LOCK:
-        return sorted({e.model_id for e in _ENGINES.values()
-                       if not e._shutdown and e._breaker_open})
+        live = [e for e in _ENGINES.values() if not e._shutdown]
+    out = set()
+    groups: dict = {}
+    for e in live:
+        if e._router_owned:
+            groups.setdefault(e.model_id, []).append(e._breaker_open)
+        elif e._breaker_open:
+            out.add(e.model_id)
+    out.update(m for m, opens in groups.items() if all(opens))
+    return sorted(out)
 
 
 def drain_and_shutdown(drain_s: float | None = None) -> bool:
@@ -2366,6 +2412,8 @@ def drain_and_shutdown(drain_s: float | None = None) -> bool:
     _DRAINING = True
     if drain_s is None:
         drain_s = _drain_s()
+    from penroz_tpu.serve import router as router_mod
+    router_mod.clear()
     with _REG_LOCK:
         engines = list(_ENGINES.values())
         _ENGINES.clear()
@@ -2397,6 +2445,9 @@ def serving_stats() -> dict:
     ``DecodeEngine.stats()``; percentiles aggregate by merging the
     engines' histogram bucket snapshots (identical layouts), never by
     re-reading raw samples."""
+    from penroz_tpu.serve import router as router_mod
+    router = router_mod.stats_totals()
+    router_lookups = router["affinity_hits"] + router["affinity_misses"]
     with _REG_LOCK:
         engines = [e for e in _ENGINES.values() if not e._shutdown]
     per = [e.stats() for e in engines]
@@ -2487,6 +2538,14 @@ def serving_stats() -> dict:
         # engine's ledger-backed stats() fields of the same names.
         "kv_pool_capacity_drops": KV.pool_drop_count(),
         "unpin_underflows": KV.unpin_underflow_count(),
+        # Replica router (serve/router.py): 0 replicas = no router live
+        # (PENROZ_SCHED_REPLICAS=1, today's single-engine registry).
+        "router_replicas": router["replicas"],
+        "router_affinity_hits": router["affinity_hits"],
+        "router_affinity_misses": router["affinity_misses"],
+        "router_affinity_hit_rate": stats_util.rate(
+            router["affinity_hits"], router_lookups),
+        "router_failovers": router["failovers"],
     }
 
 

@@ -112,13 +112,15 @@ class LedgerAuditError(AssertionError):
 
 
 def _tree_bytes(tree) -> int:
-    """Total bytes of every array leaf in a pytree (LoRA pack, params)."""
+    """Per-device bytes of every array leaf in a pytree (LoRA pack,
+    params).  Routed through ``KV.array_device_bytes`` so a param dict
+    sharded over a serving mesh is charged its shard bytes — what one
+    device's HBM actually holds — not the logical global size; unmeshed
+    leaves report exactly what they always did."""
     total = 0
     for leaf in jax.tree_util.tree_leaves(tree):
-        size = getattr(leaf, "size", None)
-        dtype = getattr(leaf, "dtype", None)
-        if size is not None and dtype is not None:
-            total += int(size) * dtype.itemsize
+        if getattr(leaf, "dtype", None) is not None and hasattr(leaf, "shape"):
+            total += KV.array_device_bytes(leaf)
     return total
 
 
@@ -457,7 +459,8 @@ def memory_stats() -> dict:
     from penroz_tpu.serve import adapters as adapters_mod
     pairs = _engine_snapshots()
     per = [dict(snap, model_id=e.model_id, block_size=e.block_size,
-                capacity=e.capacity) for e, snap in pairs]
+                capacity=e.capacity, replica=getattr(e, "replica", 0))
+           for e, snap in pairs]
     pool = {s: sum(p["pool_pages"][s] for p in per) for s in PAGE_STATES}
     tenant: dict = {}
     hwm: dict = {}

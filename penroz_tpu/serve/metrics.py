@@ -108,6 +108,15 @@ RESUME_CACHED_TOKENS = REGISTRY.register(m.Counter(
     "penroz_preempted_resume_cached_tokens_total",
     "Prompt+generated tokens restored from the prefix cache (zero "
     "recompute) when preempted requests resumed"))
+ROUTER_AFFINITY = REGISTRY.register(m.Counter(
+    "penroz_router_affinity_total",
+    "Replica-router placements of fingerprinted prompts: 'hit' landed on "
+    "the replica whose prefix cache holds the prompt's pages, 'miss' "
+    "anywhere else", ("outcome",)))
+ROUTER_FAILOVERS = REGISTRY.register(m.Counter(
+    "penroz_router_failovers_total",
+    "Admissions rerouted past a refusing replica (breaker open, queue "
+    "full, draining) to a live sibling"))
 
 # -- histograms (engine observes the global mirror alongside its own) -------
 

@@ -1,0 +1,248 @@
+"""Front-of-house router over N data-parallel decode-engine replicas.
+
+``PENROZ_SCHED_REPLICAS=N`` (N > 1) turns one (model, config) registry key
+into a replica GROUP: N independent :class:`DecodeEngine` workers, each
+with its own KV pool, prefix cache, worker thread and circuit breaker —
+and, under ``PENROZ_SERVE_MESH=1``, its own serving mesh.  The router is
+what ``decode_scheduler.get_engine`` hands back for the group; it quacks
+like an engine (``submit``) so serve/app.py's request paths are untouched.
+Scale-out shape follows the PAPERS.md pjit/weight-update-sharding pair:
+shard *within* a replica via GSPMD, replicate *across* engines for
+throughput.
+
+Placement policy, in order:
+
+1. **Prefix affinity** — a page-granularity fingerprint index maps prompt
+   prefixes to the replica that served them last, i.e. the replica whose
+   radix prefix cache holds those pages.  Repeated-prefix families land
+   where their KV already lives instead of re-prefilling cold on a
+   round-robin peer.  ``PENROZ_ROUTER_AFFINITY=0`` disables steering;
+   the index is bounded (``PENROZ_ROUTER_AFFINITY_INDEX`` entries, LRU).
+2. **Half-open probes** — a replica whose breaker cooldown has elapsed is
+   offered exactly the next admission (the probe): its success closes the
+   breaker and re-admits the replica; its failure re-arms the cooldown.
+   Without this, a fully healthy sibling would absorb all traffic and the
+   broken replica would never get the probe it needs to recover.
+3. **Least-loaded within the request's tenant class** — primary key is
+   the replica's queue depth for ``req.priority`` (the PR 8 WFQ class),
+   tie-broken by total load then replica index (deterministic placement
+   for the parity tests).
+
+Failover: a replica that refuses (breaker open, queue full, draining) is
+skipped and the next candidate tried — the client only sees an error when
+EVERY replica refuses, so one crashed replica never 503s a request a
+healthy sibling could serve.  Tenant-quota sheds are re-raised
+immediately: the token buckets are process-wide, so no sibling would
+answer differently.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+
+from penroz_tpu.ops import kv_cache as KV
+from penroz_tpu.serve import decode_scheduler as ds
+from penroz_tpu.serve import metrics as serve_metrics
+from penroz_tpu.serve.qos import TenantQuotaExceeded
+
+log = logging.getLogger(__name__)
+
+AFFINITY_ENV = "PENROZ_ROUTER_AFFINITY"
+AFFINITY_INDEX_ENV = "PENROZ_ROUTER_AFFINITY_INDEX"
+
+
+def _affinity_enabled() -> bool:
+    return os.environ.get(AFFINITY_ENV, "1") != "0"
+
+
+def _affinity_index_cap() -> int:
+    return ds._env_int(AFFINITY_INDEX_ENV, 4096)
+
+
+class EngineRouter:
+    """One replica group's router.  Thread-safe; ``submit`` may be called
+    from any number of event-loop executor threads concurrently."""
+
+    def __init__(self, model_id, block_size, temperature, top_k, n: int):
+        self.model_id = model_id
+        self.block_size = int(block_size)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.greedy = temperature is None or float(temperature) == 0.0
+        key = ds._engine_key(model_id, block_size, temperature, top_k)
+        self.replicas: list = []
+        for i in range(n):
+            engine = ds.DecodeEngine(model_id, block_size, temperature,
+                                     top_k, replica=i)
+            engine._router_owned = True
+            with ds._REG_LOCK:
+                # Replicas live in the ONE engine registry under the group
+                # key extended by their index, so serving_stats, /memory/,
+                # reset and drain_and_shutdown aggregate and tear them
+                # down with zero new plumbing.
+                ds._ENGINES[key + (i,)] = engine
+            self.replicas.append(engine)
+        self._lock = threading.Lock()
+        # prefix fingerprint -> replica index, LRU-bounded
+        self._affinity: collections.OrderedDict = collections.OrderedDict()
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.failovers = 0
+
+    # -- prefix affinity ----------------------------------------------------
+
+    def _fingerprints(self, prompt) -> list:
+        """Rolling page-aligned prefix fingerprints, shortest first —
+        ``fps[k-1]`` covers the prompt's first ``k`` full pages, matching
+        the page granularity the radix prefix cache shares KV at."""
+        if not (_affinity_enabled() and KV.paged_enabled()
+                and KV.prefix_cache_enabled()):
+            return []
+        page = KV.default_page_size()
+        fps, h = [], 0
+        for k in range(len(prompt) // page):
+            h = hash((h, tuple(prompt[k * page:(k + 1) * page])))
+            fps.append(h)
+        return fps
+
+    def _affinity_target(self, fps):
+        """Longest-known-prefix lookup: the replica that last served the
+        deepest matching prefix holds the most reusable pages."""
+        with self._lock:
+            for fp in reversed(fps):
+                idx = self._affinity.get(fp)
+                if idx is not None:
+                    self._affinity.move_to_end(fp)
+                    return idx
+        return None
+
+    def _remember(self, fps, idx: int):
+        cap = _affinity_index_cap()
+        with self._lock:
+            for fp in fps:
+                self._affinity[fp] = idx
+                self._affinity.move_to_end(fp)
+            while len(self._affinity) > cap:
+                self._affinity.popitem(last=False)
+
+    # -- placement ----------------------------------------------------------
+
+    def _candidates(self, req, target) -> list:
+        """Replica attempt order (see module docstring).  Cooling
+        breaker-open replicas go LAST rather than being dropped: when the
+        whole group is open, the client still gets the engine's own
+        CircuitOpenError with its cooldown-derived Retry-After."""
+        now = time.monotonic()
+        cooldown_s = ds._breaker_cooldown_ms() / 1000.0
+        healthy, probes, cooling = [], [], []
+        for e in self.replicas:
+            if e._shutdown or e._draining:
+                continue
+            if e._breaker_open:
+                if (now >= e._breaker_open_t + cooldown_s
+                        and not e._probe_inflight):
+                    probes.append(e)
+                else:
+                    cooling.append(e)
+                continue
+            healthy.append(e)
+
+        def load(e):
+            with e._cond:
+                cls_depth = e._pending.class_depth(req.priority)
+                total = e.active_rows + len(e._pending)
+            return (cls_depth, total, e.replica)
+
+        healthy.sort(key=load)
+        order = []
+        if target is not None and target < len(self.replicas):
+            te = self.replicas[target]
+            if te in healthy:
+                healthy.remove(te)
+                order.append(te)
+        return order + probes + healthy + cooling
+
+    def submit(self, req):
+        """Place ``req`` on a replica; raises only when every live replica
+        refuses (the last refusal propagates, typed Retry-After intact)."""
+        fps = self._fingerprints(req.prompt)
+        target = self._affinity_target(fps) if fps else None
+        order = self._candidates(req, target)
+        if not order:
+            raise RuntimeError("decode engine is shut down")
+        last_exc = None
+        for pos, engine in enumerate(order):
+            try:
+                engine.submit(req)
+            except TenantQuotaExceeded:
+                raise  # process-wide buckets: every sibling says the same
+            except RuntimeError as exc:
+                # CircuitOpenError, QueueFullError, a draining replica —
+                # all refusals at the door; the request never started.
+                last_exc = exc
+                if pos + 1 < len(order):
+                    self.failovers += 1
+                    serve_metrics.ROUTER_FAILOVERS.inc()
+                continue
+            if fps:
+                if target is not None and engine is self.replicas[target]:
+                    self.affinity_hits += 1
+                    serve_metrics.ROUTER_AFFINITY.inc(outcome="hit")
+                else:
+                    self.affinity_misses += 1
+                    serve_metrics.ROUTER_AFFINITY.inc(outcome="miss")
+                self._remember(fps, engine.replica)
+            return
+        raise last_exc
+
+
+# ---------------------------------------------------------------------------
+# Router registry (parallel to decode_scheduler._ENGINES, same lifecycle)
+# ---------------------------------------------------------------------------
+
+_ROUTERS: dict = {}
+_ROUTER_LOCK = threading.Lock()
+
+
+def get_router(model_id, block_size, temperature, top_k) -> EngineRouter:
+    """Lookup/create the replica group's router (the get_engine of the
+    replicated world).  A router whose replica count no longer matches
+    ``PENROZ_SCHED_REPLICAS`` or whose engines were shut down externally
+    is rebuilt; its old engines are already gone from/owned by the engine
+    registry either way."""
+    n = ds._replicas()
+    key = ds._engine_key(model_id, block_size, temperature, top_k)
+    with _ROUTER_LOCK:
+        router = _ROUTERS.get(key)
+        if (router is not None and len(router.replicas) == n
+                and not any(e._shutdown for e in router.replicas)):
+            return router
+        router = EngineRouter(model_id, block_size, temperature, top_k, n)
+        _ROUTERS[key] = router
+        return router
+
+
+def stats_totals() -> dict:
+    """Cross-router totals for /serving_stats/ (replicas counts live,
+    non-shutdown engines; 0 means no router is live)."""
+    with _ROUTER_LOCK:
+        routers = list(_ROUTERS.values())
+    return {
+        "replicas": sum(sum(1 for e in r.replicas if not e._shutdown)
+                        for r in routers),
+        "affinity_hits": sum(r.affinity_hits for r in routers),
+        "affinity_misses": sum(r.affinity_misses for r in routers),
+        "failovers": sum(r.failovers for r in routers),
+    }
+
+
+def clear():
+    """Drop every router (decode_scheduler.reset / drain_and_shutdown —
+    the engines themselves live in the engine registry, which those same
+    callers shut down)."""
+    with _ROUTER_LOCK:
+        _ROUTERS.clear()

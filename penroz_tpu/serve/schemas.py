@@ -297,6 +297,14 @@ class EngineStats(BaseModel):
     top_k: Optional[int] = None
     capacity: int = Field(..., description="Decode batch rows "
                           "(PENROZ_SCHED_MAX_ROWS)")
+    replica: int = Field(0, description="Data-parallel replica index "
+                         "within this model's router group "
+                         "(PENROZ_SCHED_REPLICAS; 0 for standalone "
+                         "engines)")
+    mesh_devices: int = Field(1, description="Devices in this engine's "
+                              "serving mesh (PENROZ_SERVE_MESH / "
+                              "PENROZ_SERVE_MESH_MODEL; 1 = unmeshed "
+                              "single-device engine)")
     active_rows: int
     queue_depth: int
     occupancy: float = Field(..., description="active_rows / capacity now")
@@ -550,6 +558,23 @@ class ServingStatsResponse(BaseModel):
                                   "counter, byte-compatible with the "
                                   "/metrics gauge; per-engine attribution "
                                   "lives on each engine's ledger)")
+    router_replicas: int = Field(
+        0, description="Live data-parallel engine replicas owned by "
+        "routers (serve/router.py; 0 = no router, "
+        "PENROZ_SCHED_REPLICAS=1 single-engine registry)")
+    router_affinity_hits: int = Field(
+        0, description="Fingerprinted admissions steered to the replica "
+        "whose radix prefix cache holds the prompt's pages")
+    router_affinity_misses: int = Field(
+        0, description="Fingerprinted admissions placed anywhere else "
+        "(cold prefix, affinity off, or target replica refused)")
+    router_affinity_hit_rate: Optional[float] = Field(
+        None, description="hits / (hits + misses); null before any "
+        "fingerprinted admission")
+    router_failovers: int = Field(
+        0, description="Admissions rerouted past a refusing replica "
+        "(breaker open, queue full, draining) to a live sibling — the "
+        "no-503-while-one-replica-is-healthy counter")
 
 
 class MemoryEngineEntry(EngineMemory):
@@ -559,6 +584,10 @@ class MemoryEngineEntry(EngineMemory):
     block_size: int
     capacity: int = Field(..., description="Decode batch rows "
                           "(PENROZ_SCHED_MAX_ROWS)")
+    replica: int = Field(0, description="Data-parallel replica index "
+                         "within the model's router group (0 for "
+                         "standalone engines) — the partition invariant "
+                         "holds per replica")
 
 
 class MemoryResponse(BaseModel):

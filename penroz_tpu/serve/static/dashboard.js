@@ -287,6 +287,15 @@ function renderServing(data) {
     : `ttft p99 [${clsTxt || "—"}] · preempts ${preempts} ` +
       `(${data.preempted_resume_cached_tokens || 0} tok resumed cached)` +
       tenantTxt;
+  /* Replica router (PENROZ_SCHED_REPLICAS > 1): affinity hit rate of the
+   * prefix-fingerprint steering plus the failover count — "router off"
+   * on the single-engine registry. */
+  const replicas = data.router_replicas || 0;
+  const affRate = data.router_affinity_hit_rate;
+  const routerTxt = replicas === 0 ? "router off"
+    : `router ${replicas} replicas · affinity ` +
+      `${affRate == null ? "—" : (affRate * 100).toFixed(0) + "%"} · ` +
+      `failovers ${data.router_failovers || 0}`;
   meta.textContent =
     `rows ${data.active_rows}/${data.capacity} (occupancy ` +
     `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +
@@ -297,7 +306,7 @@ function renderServing(data) {
        : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
     `${multistepTxt} · ` +
-    `${specTxt} · ${loraTxt} · ${prefixTxt} · ${qosTxt} · ` +
+    `${specTxt} · ${loraTxt} · ${prefixTxt} · ${qosTxt} · ${routerTxt} · ` +
     `KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();

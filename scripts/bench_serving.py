@@ -431,6 +431,152 @@ async def _bench_overload() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --replicas: goodput-vs-replicas curve through the router (PR 14)
+# ---------------------------------------------------------------------------
+
+async def _bench_replicas() -> dict:
+    """Same overload shape as --overload, swept over PENROZ_SCHED_REPLICAS:
+    per-replica capacity is fixed, so the group's admitted load — and with
+    it goodput — should scale with the replica count while shed rate
+    falls.  Prompts are page-aligned shared-prefix families so the
+    router's affinity index engages (hit rate in the capture)."""
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = int(os.environ.get("PENROZ_BENCH_SERVING_BLOCK", "128"))
+    rows = int(os.environ.get("PENROZ_BENCH_OVER_ROWS", "2"))
+    queue = int(os.environ.get("PENROZ_BENCH_OVER_QUEUE", "2"))
+    offered = int(os.environ.get("PENROZ_BENCH_OVER_N", "16"))
+    waves = int(os.environ.get("PENROZ_BENCH_OVER_WAVES", "3"))
+    max_new = int(os.environ.get("PENROZ_BENCH_MAX_NEW", "16"))
+    page = int(os.environ.get("PENROZ_BENCH_PREFIX_PAGE", "8"))
+    replica_set = [int(r) for r in os.environ.get(
+        "PENROZ_BENCH_REPLICA_SET", "1,2,4").split(",")]
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        decode_scheduler.MAX_ROWS_ENV: str(rows),
+        decode_scheduler.MAX_QUEUE_ENV: str(queue),
+        "PAGED_KV_CACHE": "1",
+        "PENROZ_KV_PAGE_SIZE": str(page),
+        "PENROZ_PREFIX_CACHE": "1",
+        "PENROZ_PREFIX_CACHE_PAGES": "16",
+        "PENROZ_SERVE_MESH": "1",
+    }
+    saved = {k: os.environ.get(k)
+             for k in (*env, decode_scheduler.REPLICAS_ENV)}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    # Four shared-prefix families (2 pages each), distinct suffixes: the
+    # affinity index steers a family to the replica holding its pages.
+    rng = np.random.default_rng(0)
+    families = [[int(t) for t in rng.integers(1, 255, 2 * page)]
+                for _ in range(4)]
+    prompts = [families[i % 4] + [int(t) for t in rng.integers(1, 255, 2)]
+               for i in range(offered)]
+
+    def payload(prompt):
+        return {"model_id": "bench-replicas", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+
+    async def one(prompt):
+        t0 = time.perf_counter()
+        resp = await client.post("/generate/", json=payload(prompt))
+        body = await resp.json() if resp.status != 204 else None
+        return resp.status, (time.perf_counter() - t0) * 1000.0, body
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-replicas", "layers": _toy_gpt(
+                d=128, depth=2, block=block),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+
+        # Solo greedy baselines (1 engine, no contention): the parity
+        # reference every admitted response in every phase must match.
+        os.environ[decode_scheduler.REPLICAS_ENV] = "1"
+        baselines = {}
+        for p in prompts:
+            status, _, body = await one(p)
+            assert status == 200, body
+            baselines[tuple(p)] = body["tokens"]
+
+        phases = []
+        parity_ok = True
+        for n_replicas in replica_set:
+            decode_scheduler.reset()  # fresh group at the new width
+            os.environ[decode_scheduler.REPLICAS_ENV] = str(n_replicas)
+            # Untimed warm wave: spills load across the whole group so
+            # every replica compiles its programs before the clock runs.
+            await asyncio.gather(*[one(p) for p in prompts])
+            statuses: dict = {}
+            latencies = []
+            completed = 0
+            t0 = time.perf_counter()
+            for _ in range(waves):
+                results = await asyncio.gather(*[one(p) for p in prompts])
+                for p, (status, ms, body) in zip(prompts, results):
+                    statuses[status] = statuses.get(status, 0) + 1
+                    if status == 200:
+                        completed += 1
+                        latencies.append(ms)
+                        parity_ok = parity_ok \
+                            and body["tokens"] == baselines[tuple(p)]
+            wall_s = time.perf_counter() - t0
+            shed = statuses.get(429, 0)
+            total = sum(statuses.values())
+            resp = await client.get("/serving_stats/")
+            stats = await resp.json()
+            phases.append({
+                "replicas": n_replicas,
+                "offered_requests": total, "completed": completed,
+                "shed_429": shed, "failed_other": total - completed - shed,
+                "shed_rate": round(shed / total, 3) if total else None,
+                # Per-wave: under a fixed offered load the group admits up
+                # to N× one replica's capacity — the scaling replication
+                # buys.  Per-second stays honest about the host: replicas
+                # on one CPU share cores, on N chips they don't.
+                "goodput_req_per_wave": round(completed / waves, 2),
+                "goodput_req_per_sec": round(completed / wall_s, 2),
+                "goodput_ms_p50": (round(_pct(latencies, 0.5), 3)
+                                   if latencies else None),
+                "goodput_ms_p99": (round(_pct(latencies, 0.99), 3)
+                                   if latencies else None),
+                "router_affinity_hits": stats["router_affinity_hits"],
+                "router_affinity_misses": stats["router_affinity_misses"],
+                "router_affinity_hit_rate": stats["router_affinity_hit_rate"],
+                "router_failovers": stats["router_failovers"],
+            })
+
+        by_n = {p["replicas"]: p for p in phases}
+        speedup = None
+        if 1 in by_n and 2 in by_n and by_n[1]["goodput_req_per_wave"]:
+            speedup = round(by_n[2]["goodput_req_per_wave"]
+                            / by_n[1]["goodput_req_per_wave"], 3)
+        return {
+            "mode": "replicas", "block_size": block,
+            "capacity_rows_per_replica": rows, "max_queue_per_replica": queue,
+            "offered_concurrency": offered, "waves": waves,
+            "max_new_tokens": max_new, "page_size": page,
+            "replica_set": replica_set, "phases": phases,
+            "goodput_speedup_2x_vs_1x": speedup,
+            "parity_ok": parity_ok,
+        }
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
 # --shared-prefix: chunked prefill + radix prefix-KV cache TTFT workload
 # ---------------------------------------------------------------------------
 
@@ -1634,9 +1780,10 @@ def main():
     args = [a for a in sys.argv[1:]
             if a not in ("--shared-prefix", "--overload", "--speculative",
                          "--multi-adapter", "--multistep", "--mixed-slo",
-                         "--chaos", "--ragged", "--memory")]
+                         "--chaos", "--ragged", "--memory", "--replicas")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
+    replicas = "--replicas" in sys.argv[1:]
     speculative = "--speculative" in sys.argv[1:]
     multi_adapter = "--multi-adapter" in sys.argv[1:]
     multistep = "--multistep" in sys.argv[1:]
@@ -1659,6 +1806,9 @@ def main():
     os.chdir(workdir)
     if overload:
         _emit(asyncio.run(_bench_overload()))
+        return
+    if replicas:
+        _emit(asyncio.run(_bench_replicas()))
         return
     if shared_prefix:
         _emit(asyncio.run(_bench_shared_prefix()))

@@ -141,6 +141,20 @@ while true; do
           -- "BENCH_MEM_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) capacity-ledger capture committed" >> logs/bench_watch.log
     fi
+    # Replica-router capture (same shape as the shared-prefix hook):
+    # goodput-vs-replicas curve under overload (shed rate, per-wave
+    # goodput, prefix-affinity hit rate) with greedy parity across
+    # widths.  Opt-in; failures must not block the main capture.
+    if [ "${PENROZ_WATCH_REPLICAS:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_SHARD_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --replicas \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_SHARD_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: replica-router goodput capture" \
+          -- "BENCH_SHARD_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) replica-router capture committed" >> logs/bench_watch.log
+    fi
     # Multi-tenant LoRA capture (same shape as the shared-prefix hook):
     # mixed-adapter ITL/wall vs per-adapter serial groups + parity.
     # Opt-in; failures must not block the main capture.

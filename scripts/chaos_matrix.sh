@@ -16,13 +16,17 @@
 # soak entry point.
 #
 # Env passthrough: PENROZ_BENCH_SERVING_PLATFORM, PENROZ_BENCH_* scale
-# knobs.  CHAOS_SITES / CHAOS_MODES override the swept sets
-# (space-separated).
+# knobs.  CHAOS_SITES / CHAOS_MODES / CHAOS_REPLICAS override the swept
+# sets (space-separated).  CHAOS_REPLICAS > 1 runs the combo through the
+# replica router (serve/router.py): a fault that crashes one replica must
+# leave its siblings' in-flight rows untouched, and the post-fault solo
+# replay parity gate holds for the whole group.
 set -u
 cd "$(dirname "$0")/.."
 
 SITES="${CHAOS_SITES:-decode.step decode.prefill_chunk decode.verify ckpt.write data.download lora.load qos.preempt}"
 MODES="${CHAOS_MODES:-unified phased}"
+REPLICAS="${CHAOS_REPLICAS:-1}"
 if [ "${CHAOS_FAST:-0}" = "1" ]; then
   SITES="qos.preempt"
   MODES="unified"
@@ -32,28 +36,30 @@ fail=0
 ran=0
 for site in $SITES; do
   for mode in $MODES; do
-    ran=$((ran + 1))
-    ragged=1
-    [ "$mode" = "phased" ] && ragged=0
-    echo "=== chaos: site=$site mode=$mode ===" >&2
-    # Strict memory ledger: every retirement/preemption/crash recovery in
-    # the sweep re-proves the page-ownership invariant (serve/memledger.py)
-    # — a leaked page raises in the engine worker and fails the combo.
-    out=$(PENROZ_BENCH_CHAOS_SITE="$site" PENROZ_RAGGED_ATTENTION="$ragged" \
-            PENROZ_MEMLEDGER_STRICT=1 \
-            timeout 900 python scripts/bench_serving.py --chaos)
-    rc=$?
-    echo "$out"
-    if [ "$rc" -ne 0 ]; then
-      echo "FAIL site=$site mode=$mode rc=$rc" >&2
-      fail=1
-      continue
-    fi
-    if ! printf '%s' "$out" | python -c \
-        'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") else 1)'; then
-      echo "FAIL site=$site mode=$mode: disallowed statuses or parity break" >&2
-      fail=1
-    fi
+    for nrep in $REPLICAS; do
+      ran=$((ran + 1))
+      ragged=1
+      [ "$mode" = "phased" ] && ragged=0
+      echo "=== chaos: site=$site mode=$mode replicas=$nrep ===" >&2
+      # Strict memory ledger: every retirement/preemption/crash recovery in
+      # the sweep re-proves the page-ownership invariant (serve/memledger.py)
+      # — a leaked page raises in the engine worker and fails the combo.
+      out=$(PENROZ_BENCH_CHAOS_SITE="$site" PENROZ_RAGGED_ATTENTION="$ragged" \
+              PENROZ_MEMLEDGER_STRICT=1 PENROZ_SCHED_REPLICAS="$nrep" \
+              timeout 900 python scripts/bench_serving.py --chaos)
+      rc=$?
+      echo "$out"
+      if [ "$rc" -ne 0 ]; then
+        echo "FAIL site=$site mode=$mode replicas=$nrep rc=$rc" >&2
+        fail=1
+        continue
+      fi
+      if ! printf '%s' "$out" | python -c \
+          'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") else 1)'; then
+        echo "FAIL site=$site mode=$mode replicas=$nrep: disallowed statuses or parity break" >&2
+        fail=1
+      fi
+    done
   done
 done
 

@@ -209,6 +209,13 @@ async function runDashboardTests(src, fixtures) {
     assertOk(servingMeta.includes("tenant-a:" +
                fixtures.serving.tenant_tokens["tenant-a"]),
              "serving tile shows the per-tenant token breakdown");
+    assertOk(servingMeta.includes(
+               `router ${fixtures.serving.router_replicas} replicas · ` +
+               "affinity " +
+               (fixtures.serving.router_affinity_hit_rate * 100)
+                 .toFixed(0) + "% · " +
+               `failovers ${fixtures.serving.router_failovers}`),
+             "serving tile shows replica-router affinity + failovers");
     const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
     assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];

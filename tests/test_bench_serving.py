@@ -188,6 +188,50 @@ def test_overload_bench_smoke(tmp_path):
         results["shed_429"]
 
 
+def test_replicas_bench_smoke(tmp_path):
+    """--replicas (PR 14): doubling the data-parallel replica count under
+    a fixed overload must lift per-wave goodput ≥1.5× (each replica
+    brings its own rows+queue; observed ~2× at this scale) and cut the
+    shed rate, the prefix-affinity index must steer the shared-prefix
+    families (hit rate > 0), and every admitted response keeps exact
+    greedy parity with the solo baseline across both replica widths."""
+    out_path = tmp_path / "replicas.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PENROZ_BENCH_SERVING_BLOCK="64",
+        PENROZ_BENCH_OVER_ROWS="2",
+        PENROZ_BENCH_OVER_QUEUE="4",
+        PENROZ_BENCH_OVER_N="16",
+        PENROZ_BENCH_OVER_WAVES="2",
+        PENROZ_BENCH_MAX_NEW="8",
+        PENROZ_BENCH_REPLICA_SET="1,2",
+        PENROZ_BENCH_JSON_OUT=str(out_path),
+    )
+    proc = subprocess.run([sys.executable, SCRIPT, "--replicas"],
+                          capture_output=True, text=True, timeout=900,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.loads(out_path.read_text()) == results
+
+    assert results["mode"] == "replicas"
+    assert results["parity_ok"] is True, results
+    by_n = {p["replicas"]: p for p in results["phases"]}
+    for phase in by_n.values():
+        assert phase["failed_other"] == 0, phase   # shed cleanly or serve
+    assert by_n[1]["shed_429"] > 0, results        # overload really shed
+    assert by_n[2]["shed_rate"] < by_n[1]["shed_rate"], results
+    assert results["goodput_speedup_2x_vs_1x"] >= 1.5, results
+    # the shared-prefix families were steered onto their page-holding
+    # replica, not sprayed round-robin
+    assert by_n[2]["router_affinity_hits"] > 0, results
+    assert by_n[2]["router_affinity_hit_rate"] > 0, results
+    # a replica group sheds only when EVERY replica refuses, so the
+    # single-replica phase reports no failover at all
+    assert by_n[1]["router_failovers"] == 0, results
+
+
 def test_multistep_bench_smoke(tmp_path):
     """--multistep: fusing decode steps into one on-device superstep must
     cut the single-row mean ITL ≥1.5× at micro scale (observed ~3× — with

@@ -222,14 +222,16 @@ def _oracle_drafter(bases):
 # THE invariant matrix: partition + parity across every serving variant
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("paged,int8,superstep,spec,use_lora", [
-    (0, 0, 1, 0, 0), (1, 0, 1, 0, 0), (1, 1, 1, 0, 0), (1, 0, 4, 0, 0),
-    (1, 1, 8, 0, 0), (1, 0, 1, 1, 0), (1, 0, 1, 0, 1)],
+@pytest.mark.parametrize("paged,int8,superstep,spec,use_lora,mesh", [
+    (0, 0, 1, 0, 0, 0), (1, 0, 1, 0, 0, 0), (1, 1, 1, 0, 0, 0),
+    (1, 0, 4, 0, 0, 0), (1, 1, 8, 0, 0, 0), (1, 0, 1, 1, 0, 0),
+    (1, 0, 1, 0, 1, 0), (1, 0, 1, 0, 0, 1)],
     ids=["fp-contig", "paged-prefix", "int8-paged-prefix", "superstep4",
-         "int8-superstep8", "spec-paged-prefix", "lora-paged-prefix"])
+         "int8-superstep8", "spec-paged-prefix", "lora-paged-prefix",
+         "mesh-paged-prefix"])
 def test_ledger_invariant_parity_matrix(gpt_model, make_engine, monkeypatch,
                                         paged, int8, superstep, spec,
-                                        use_lora):
+                                        use_lora, mesh):
     """Across prefix cache × int8 KV × supersteps × spec decode × LoRA:
     greedy outputs stay token-identical to the standalone path (the
     ledger observes, never steers), every page lands in exactly one
@@ -245,6 +247,11 @@ def test_ledger_invariant_parity_matrix(gpt_model, make_engine, monkeypatch,
         monkeypatch.setenv("PENROZ_PREFILL_CHUNK", "4")
     if int8:
         monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    if mesh:
+        # 1-device serving mesh: byte attribution must stay identical to
+        # the unsharded engine (shard_shape is the identity there).
+        monkeypatch.setenv("PENROZ_SERVE_MESH", "1")
+        monkeypatch.setenv("PENROZ_SERVE_MESH_MODEL", "1")
     if superstep > 1:
         from penroz_tpu.serve import decode_scheduler
         monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, str(superstep))

@@ -1,0 +1,233 @@
+"""Replica-group router tests (serve/router.py).
+
+Tier-1-safe: CPU, small shapes, no `slow` marker.  Three contracts carry
+the weight here: (1) greedy parity — routing a request through any number
+of replicas returns exactly the tokens the legacy single-engine path
+returns; (2) failover — one breaker-tripped replica never surfaces a
+client-visible 503 while a healthy sibling exists, and the half-open
+probe re-admits it afterwards; (3) affinity — a repeated page-aligned
+prefix family is steered to the replica whose prefix cache holds the
+pages.
+"""
+
+import queue
+import time
+
+import pytest
+
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+
+# CI tier: heavier compiles (serving stack), same tier as test_app.
+pytestmark = pytest.mark.runtime
+
+BLOCK = 16
+SGD = {"sgd": {"lr": 0.1}}
+
+
+@pytest.fixture(autouse=True)
+def _router_registry(workdir):
+    """Fresh engine+router registries and fault/QoS counters per test."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import decode_scheduler, qos
+    from penroz_tpu.utils import faults
+    faults.reset()
+    qos.reset()
+    KV.reset_unpin_underflow_count()
+    yield
+    decode_scheduler.reset()
+    faults.reset()
+    qos.reset()
+    KV.reset_unpin_underflow_count()
+
+
+@pytest.fixture
+def gpt_model(workdir, toy_gpt_layers):
+    """A serialized toy GPT (attention + KV cache on the decode path)."""
+    model = NeuralNetworkModel("schedgpt", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    return model
+
+
+class _Collector:
+    def __init__(self, prompt):
+        self.q = queue.Queue()
+        self.tokens = list(prompt)
+
+    def on_event(self, kind, value):
+        self.q.put((kind, value))
+
+    def result(self, timeout=180):
+        deadline = time.monotonic() + timeout
+        while True:
+            kind, value = self.q.get(
+                timeout=max(deadline - time.monotonic(), 0.1))
+            if kind == "token":
+                self.tokens.append(value)
+            elif kind == "done":
+                return self.tokens
+            else:
+                raise value
+
+
+def _submit(router, prompt, max_new):
+    from penroz_tpu.serve import decode_scheduler
+    collector = _Collector(prompt)
+    router.submit(decode_scheduler.Request(prompt, max_new, None,
+                                           collector.on_event))
+    return collector
+
+
+def _get_router(monkeypatch, n=2):
+    """The production seam: get_engine hands back a router when
+    PENROZ_SCHED_REPLICAS > 1."""
+    from penroz_tpu.serve import decode_scheduler, router
+    monkeypatch.setenv(decode_scheduler.REPLICAS_ENV, str(n))
+    engine = decode_scheduler.get_engine("schedgpt", BLOCK, 0.0, None)
+    assert isinstance(engine, router.EngineRouter)
+    assert len(engine.replicas) == n
+    return engine
+
+
+def test_router_failover_then_probe_readmission(gpt_model, monkeypatch):
+    """Breaker trips on replica 0 → requests reroute to replica 1 with no
+    client-visible refusal; after the cooldown the half-open probe goes to
+    replica 0 first and its success re-admits it."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    prompt = [1, 2, 3]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    monkeypatch.setenv(decode_scheduler.MAX_CRASHES_ENV, "2")
+    monkeypatch.setenv(decode_scheduler.BREAKER_COOLDOWN_ENV, "100000")
+    monkeypatch.setenv(faults.ENV,
+                       "decode.step:raise@1,decode.step:raise@2")
+    router = _get_router(monkeypatch, n=2)
+    # Idle group → deterministic tie-break: both crashes land on replica 0.
+    with pytest.raises(faults.InjectedFault):
+        _submit(router, prompt, 5).result()
+    with pytest.raises(faults.InjectedFault):
+        _submit(router, prompt, 5).result()
+    r0, r1 = router.replicas
+    assert r0.stats()["breaker_open"] is True
+    # One open replica must NOT mark the model not-ready: a healthy
+    # sibling still serves.
+    assert "schedgpt" not in decode_scheduler.breaker_open_engines()
+    # Reroute: submissions succeed on replica 1, no CircuitOpenError.
+    for _ in range(2):
+        assert _submit(router, prompt, 5).result() == base
+    assert r1.stats()["completed"] == 2
+    assert r0.stats()["completed"] == 0
+    # Cooldown over (0ms): probes outrank healthy replicas, so the next
+    # admission IS the probe.
+    monkeypatch.setenv(decode_scheduler.BREAKER_COOLDOWN_ENV, "0")
+    assert _submit(router, prompt, 5).result() == base
+    s0 = r0.stats()
+    assert s0["completed"] == 1          # the probe ran on replica 0
+    assert s0["breaker_open"] is False   # and closed the breaker
+    assert s0["consecutive_crashes"] == 0
+
+
+def test_router_all_replicas_open_surfaces_circuit_error(gpt_model,
+                                                         monkeypatch):
+    """Only when EVERY replica's breaker is open does the client see
+    CircuitOpenError — and only then is the model listed not-ready."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    prompt = [1, 2, 3]
+    monkeypatch.setenv(decode_scheduler.MAX_CRASHES_ENV, "1")
+    monkeypatch.setenv(decode_scheduler.BREAKER_COOLDOWN_ENV, "100000")
+    monkeypatch.setenv(faults.ENV,
+                       "decode.step:raise@1,decode.step:raise@2")
+    router = _get_router(monkeypatch, n=2)
+    with pytest.raises(faults.InjectedFault):
+        _submit(router, prompt, 5).result()      # replica 0 opens
+    assert decode_scheduler.breaker_open_engines() == []
+    with pytest.raises(faults.InjectedFault):
+        _submit(router, prompt, 5).result()      # replica 1 opens
+    assert decode_scheduler.breaker_open_engines() == ["schedgpt"]
+    with pytest.raises(decode_scheduler.CircuitOpenError):
+        _submit(router, prompt, 5)
+
+
+@pytest.mark.parametrize("replicas,affinity", [(1, "1"), (2, "1"), (2, "0")])
+@pytest.mark.parametrize("prefix", [False, True])
+@pytest.mark.parametrize("superstep", ["1", "8"])
+def test_router_greedy_parity_matrix(gpt_model, monkeypatch, replicas,
+                                     affinity, prefix, superstep):
+    """Token parity through the router under {1 replica, 2 affinity-on,
+    2 affinity-off} × prefix-cache × superstep, with the 1-device serving
+    mesh active throughout."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.serve import router as router_mod
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, superstep)
+    monkeypatch.setenv(router_mod.AFFINITY_ENV, affinity)
+    monkeypatch.setenv("PENROZ_SERVE_MESH", "1")
+    if prefix:
+        monkeypatch.setenv("PAGED_KV_CACHE", "1")
+        monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    # A page-aligned shared-prefix pair plus a disjoint prompt: exercises
+    # steering (when on) and cold placement in the same run.
+    prompts = [[1, 2, 3, 4, 5, 6, 7, 8],
+               [1, 2, 3, 4, 5, 6, 7, 8, 9],
+               [11, 12]]
+    bases = [gpt_model.generate_tokens([p], BLOCK, 5, temperature=0.0)
+             for p in prompts]
+    monkeypatch.setenv(decode_scheduler.REPLICAS_ENV, str(replicas))
+    engine = decode_scheduler.get_engine("schedgpt", BLOCK, 0.0, None)
+    if replicas > 1:
+        assert isinstance(engine, router_mod.EngineRouter)
+    collectors = [_submit(engine, p, 5) for p in prompts]
+    for collector, base in zip(collectors, bases):
+        assert collector.result() == base
+    stats = decode_scheduler.serving_stats()
+    assert stats["router_replicas"] == (replicas if replicas > 1 else 0)
+
+
+def test_router_prefix_affinity_steers_family_to_one_replica(gpt_model,
+                                                             monkeypatch):
+    """A repeated-prefix family (same two leading pages, different tails)
+    lands on the replica that cached those pages: first request is the
+    cold miss, every later one an affinity hit on the same replica."""
+    from penroz_tpu.serve import decode_scheduler
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    router = _get_router(monkeypatch, n=2)
+    shared = [1, 2, 3, 4, 5, 6, 7, 8]          # two full pages
+    family = [shared + tail for tail in ([9], [10, 11], [12], [13])]
+    bases = [gpt_model.generate_tokens([p], BLOCK, 5, temperature=0.0)
+             for p in family]
+    for prompt, base in zip(family, bases):
+        assert _submit(router, prompt, 5).result() == base
+    assert router.affinity_misses == 1          # the cold first request
+    assert router.affinity_hits == len(family) - 1
+    done = [e.stats()["completed"] for e in router.replicas]
+    assert sorted(done) == [0, len(family)]     # whole family, one replica
+    stats = decode_scheduler.serving_stats()
+    assert stats["router_affinity_hits"] == len(family) - 1
+    assert stats["router_affinity_misses"] == 1
+    assert stats["router_affinity_hit_rate"] == pytest.approx(0.75)
+
+
+def test_router_replicas_visible_in_stats_and_memory(gpt_model,
+                                                     monkeypatch):
+    """Replica engines surface individually in /serving_stats/ and the
+    memledger /memory/ view, tagged with their replica index, and each
+    reports its own partition-invariant pool."""
+    from penroz_tpu.serve import decode_scheduler, memledger
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    router = _get_router(monkeypatch, n=2)
+    base = gpt_model.generate_tokens([[1, 2, 3]], BLOCK, 4, temperature=0.0)
+    assert _submit(router, [1, 2, 3], 4).result() == base
+    engines = decode_scheduler.serving_stats()["engines"]
+    assert [(e["replica"], e["mesh_devices"]) for e in engines] == \
+        [(0, 1), (1, 1)]
+    mem = memledger.memory_stats()
+    assert [e["replica"] for e in mem["engines"]] == [0, 1]
+    for entry in mem["engines"]:
+        pools = entry["pool_pages"]
+        assert sum(pools.values()) == entry["pool_pages_total"]

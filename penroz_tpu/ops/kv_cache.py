@@ -1044,6 +1044,59 @@ class PagedKVState(KVState):
         out.v = [a.at[:, dst_rows].set(a[:, src_rows]) for a in self.v]
         return out
 
+    def _export_pool_rows(self, row: int, n_pages: int):
+        """Flat pool-row indices of row ``row``'s first ``n_pages`` logical
+        pages, resolved through its block table (host op)."""
+        phys = np.asarray(self.block_table)[int(row), :n_pages].astype(
+            np.int64)
+        return (phys[:, None] * self.page_size
+                + np.arange(self.page_size)).reshape(-1)
+
+    def export_row_pages(self, row, length) -> dict:
+        """Gather row ``row``'s first ``ceil(length/page_size)`` logical
+        pages through its block table as host arrays — the disaggregated
+        prefill export.  The gather follows the table, so prefix-aliased
+        leading pages come out position-ordered exactly like row-private
+        ones.  Eager host op; ``row``/``length`` are host ints."""
+        P = self.page_size
+        n = -(-int(length) // P)
+        if n > self.pages_per_seq:
+            raise ValueError(f"export of {n} pages exceeds "
+                             f"pages_per_seq={self.pages_per_seq}")
+        pool_rows = self._export_pool_rows(row, n)
+        return {"page_size": P, "pages": n, "length": int(length),
+                "quantized": bool(getattr(self, "quantized", False)),
+                "k": [np.asarray(a[:, pool_rows]) for a in self.k],
+                "v": [np.asarray(a[:, pool_rows]) for a in self.v]}
+
+    def import_row_pages(self, row, blob: dict):
+        """Scatter an :meth:`export_row_pages` blob into row ``row``'s own
+        static-partition pages (table entries restored to static first, so
+        a stale prefix alias can never be written through).  The inverse
+        hand-off op on the decode replica; eager, ``row`` is a host int."""
+        P, S = self.page_size, self.pages_per_seq
+        if int(blob["page_size"]) != P:
+            raise ValueError(f"page blob page_size {blob['page_size']} != "
+                             f"pool page_size {P}")
+        if bool(blob["quantized"]) != bool(getattr(self, "quantized", False)):
+            raise ValueError("page blob quantization does not match pool")
+        n = int(blob["pages"])
+        if n > S:
+            raise ValueError(f"import of {n} pages exceeds pages_per_seq={S}")
+        # dynamic start: the scatter's compiled program is keyed on the
+        # update SHAPE only, so every destination row shares one program
+        # instead of paying an XLA compile per (row, pages) combination
+        start = jnp.int32(int(row) * S * P)
+        zero = jnp.int32(0)
+        out = self.with_row_prefix(row, ())
+        out.k = [jax.lax.dynamic_update_slice(
+                     a, jnp.asarray(s, a.dtype), (zero, start, zero))
+                 for a, s in zip(out.k, blob["k"])]
+        out.v = [jax.lax.dynamic_update_slice(
+                     a, jnp.asarray(s, a.dtype), (zero, start, zero))
+                 for a, s in zip(out.v, blob["v"])]
+        return out
+
     def _row_bytes(self) -> int:
         """Bytes per token row summed over every layer's K and V pool."""
         return sum(a.shape[0] * a.shape[2] * a.dtype.itemsize
@@ -1230,6 +1283,26 @@ class QuantPagedKVState(PagedKVState):
                        for a in self.k_scale]
         out.v_scale = [a.at[:, dst_rows].set(a[:, src_rows])
                        for a in self.v_scale]
+        return out
+
+    def export_row_pages(self, row, length) -> dict:
+        out = super().export_row_pages(row, length)
+        pool_rows = self._export_pool_rows(row, out["pages"])
+        out["k_scale"] = [np.asarray(a[:, pool_rows]) for a in self.k_scale]
+        out["v_scale"] = [np.asarray(a[:, pool_rows]) for a in self.v_scale]
+        return out
+
+    def import_row_pages(self, row, blob: dict):
+        out = super().import_row_pages(row, blob)
+        P, S = self.page_size, self.pages_per_seq
+        start = jnp.int32(int(row) * S * P)
+        zero = jnp.int32(0)
+        out.k_scale = [jax.lax.dynamic_update_slice(
+                           a, jnp.asarray(s, a.dtype), (zero, start, zero))
+                       for a, s in zip(out.k_scale, blob["k_scale"])]
+        out.v_scale = [jax.lax.dynamic_update_slice(
+                           a, jnp.asarray(s, a.dtype), (zero, start, zero))
+                       for a, s in zip(out.v_scale, blob["v_scale"])]
         return out
 
     def _row_bytes(self) -> int:

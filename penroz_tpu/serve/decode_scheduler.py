@@ -376,7 +376,7 @@ class Request:
                  "enqueue_t", "cancelled", "deadline", "adapter",
                  "request_id", "trace", "priority", "tenant",
                  "resume_history", "resume_produced", "resume_nodes",
-                 "preempted")
+                 "preempted", "handoff")
 
     def __init__(self, prompt, max_new_tokens, stop_token, on_event,
                  timeout_ms=None, adapter=None, request_id=None,
@@ -405,6 +405,11 @@ class Request:
         self.resume_produced = 0
         self.resume_nodes: list = []
         self.preempted = 0
+        # Disaggregated-prefill hand-off: set by the prefill replica after a
+        # successful export ({"blob_id", "kv_len", "first_token", "t0"});
+        # the decode replica consumes it at admission (import path) and the
+        # request was already quota-admitted on the prefill side.
+        self.handoff = None
         # utils/tracing.py: request_id is the X-Request-Id correlation
         # key; trace (None when sampled out / tracing off) records the
         # lifecycle span tree — every recording site below is None-guarded
@@ -424,7 +429,7 @@ class _Row:
     __slots__ = ("req", "produced", "finished", "prefilling", "prefilled",
                  "chunks", "chunk_idx", "prefix_nodes", "history",
                  "last_emit_t", "sp_prefill", "sp_decode", "admit_t",
-                 "resumed")
+                 "resumed", "transit")
 
     def __init__(self, req):
         self.req = req
@@ -453,6 +458,9 @@ class _Row:
         self.chunks: list = []
         self.chunk_idx = 0
         self.prefix_nodes: list = []
+        # Hand-off import in flight: the row's pages are owned but not yet
+        # decode-visible — the memledger attributes them to ``transit``.
+        self.transit = False
 
 
 class DecodeEngine:
@@ -466,7 +474,8 @@ class DecodeEngine:
     """
 
     def __init__(self, model_id: str, block_size: int, temperature,
-                 top_k, capacity: int | None = None, replica: int = 0):
+                 top_k, capacity: int | None = None, replica: int = 0,
+                 role: str = "decode"):
         self.model_id = model_id
         self.block_size = int(block_size)
         self.temperature = temperature
@@ -479,6 +488,13 @@ class DecodeEngine:
         self.replica = int(replica)
         self._router_owned = False
         self._mesh_devices = 1  # set by _alloc_state under PENROZ_SERVE_MESH
+        # Disaggregated prefill (serve/router.py): "prefill" replicas run
+        # chunked prefill to completion, export the row's KV pages as a
+        # checkpoint page blob, and hand the request to a decode replica
+        # through ``_handoff_sink`` (router._place_handoff); "decode"
+        # replicas import the blob at admission and skip prefill entirely.
+        self.role = role
+        self._handoff_sink = None
 
         self._model = NeuralNetworkModel.deserialize(model_id)
         self._ckpt_stamp_v = self._ckpt_stamp()
@@ -592,6 +608,14 @@ class DecodeEngine:
         self._spec_drafted_tokens = 0
         self._spec_accepted_tokens = 0
 
+        # Disaggregated-prefill hand-off accounting (both roles: exports on
+        # prefill replicas, imports on decode replicas; failures on either
+        # side of the seam).
+        self._disagg_exports = 0
+        self._disagg_imports = 0
+        self._disagg_handoff_failures = 0
+        self._h_handoff = metrics_util.Hist()
+
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"penroz-sched-{model_id}-{self.block_size}")
@@ -691,15 +715,17 @@ class DecodeEngine:
                 self._probe_inflight = True
             # Tenant token quota: an exhausted bucket sheds THIS tenant's
             # new admissions (429 + refill-derived Retry-After); in-flight
-            # rows — anyone's — are never touched.
-            try:
-                qos.QUOTAS.admit(req.tenant)
-            except TenantQuotaExceeded:
-                self._quota_rejections += 1
-                serve_metrics.QUOTA_REJECTIONS.inc(tenant=req.tenant)
-                serve_metrics.REQUESTS.inc(outcome="quota")
-                self._shed_span(req, "quota")
-                raise
+            # rows — anyone's — are never touched.  Hand-off arrivals were
+            # already admitted (and prompt-charged) on the prefill replica.
+            if req.handoff is None:
+                try:
+                    qos.QUOTAS.admit(req.tenant)
+                except TenantQuotaExceeded:
+                    self._quota_rejections += 1
+                    serve_metrics.QUOTA_REJECTIONS.inc(tenant=req.tenant)
+                    serve_metrics.REQUESTS.inc(outcome="quota")
+                    self._shed_span(req, "quota")
+                    raise
             # Per-class bound when PENROZ_QOS_MAX_QUEUE_<CLASS> is set
             # (0 = explicitly unbounded); otherwise the pre-QoS aggregate
             # PENROZ_SCHED_MAX_QUEUE applies unchanged.
@@ -820,6 +846,7 @@ class DecodeEngine:
                 "queue_wait_ms_by_class": {
                     c: h.snapshot()
                     for c, h in self._h_queue_wait_cls.items()},
+                "handoff_ms": self._h_handoff.snapshot(),
             },
             "superstep": _superstep_max(),
             "dispatches_total": self._dispatches,
@@ -868,6 +895,12 @@ class DecodeEngine:
             "capacity": self.capacity,
             "replica": self.replica,
             "mesh_devices": self._mesh_devices,
+            "role": self.role,
+            "disagg_exports": self._disagg_exports,
+            "disagg_imports": self._disagg_imports,
+            "disagg_handoff_failures": self._disagg_handoff_failures,
+            "disagg_handoff_ms_p50": self._round_q(self._h_handoff, 0.5),
+            "disagg_handoff_ms_p99": self._round_q(self._h_handoff, 0.99),
             "active_rows": active,
             "queue_depth": self.queue_depth,
             "occupancy": active / self.capacity,
@@ -1447,6 +1480,9 @@ class DecodeEngine:
                 with self._cond:
                     self._pending.push_front(req)
                 return
+            if req.handoff is not None:
+                self._admit_handoff(row, req, slot)
+                continue
             self._begin_prefill(row, req, slot)
 
     # -- preemption (preempt-to-prefix-cache, resume with zero recompute) ----
@@ -1790,7 +1826,21 @@ class DecodeEngine:
 
     def _finish_prefill(self, row: int, state: _Row, first: int):
         """Final chunk done: its sampled token IS the request's first token
-        (same logits position and program family as one-shot prefill)."""
+        (same logits position and program family as one-shot prefill).
+
+        On a disaggregated prefill replica this is the hand-off seam: the
+        finished row's KV pages ship to a decode replica and the row frees
+        without emitting — the first token travels inside the hand-off and
+        is emitted after the import, exactly once.  Rows that cannot hand
+        off (single-token requests, resumed rows, export failure with no
+        reachable decode replica) fall through and decode locally."""
+        req = state.req
+        if (self.role == "prefill" and self._handoff_sink is not None
+                and req.handoff is None and req.max_new_tokens > 1
+                and not state.resumed and not req.cancelled
+                and isinstance(self._kv, KV.PagedKVState)):
+            if self._export_handoff(row, state, first):
+                return
         state.prefilling = False
         self._lengths[row] = state.prefilled  # == len(effective prompt)
         self._last_tok[row] = first
@@ -1826,6 +1876,190 @@ class DecodeEngine:
             self._kv = self._kv.copy_pages(
                 [row * S + b for b, _ in created],
                 [page for _, page in created])
+
+    # -- disaggregated prefill (export / hand-off / import) ------------------
+
+    def _free_handoff_row(self, row: int, state: _Row):
+        """Release a row whose request left this engine through the hand-off
+        seam (export shipped, or requeued for monolithic prefill elsewhere).
+        Mirrors ``_preempt_row``'s release — no terminal event is emitted;
+        the request's stream stays open and finishes on the target replica."""
+        self._rows[row] = None
+        self._lengths[row] = 0
+        self._last_tok[row] = 0
+        self._row_adapter[row] = self._max_live
+        self._release_prefix(row, state)
+        self._kv = self._kv.reset_row(row)
+
+    def _export_handoff(self, row: int, state: _Row, first: int) -> bool:
+        """Prefill replica: export the finished row's KV pages as a shm page
+        blob and hand the request to a decode replica via ``_handoff_sink``.
+        Returns True when the row left this engine (shipped or requeued
+        remotely); False means the caller finishes the row locally.
+
+        Ordering is crash-shaped: the fault site, the device export, and
+        the blob write all happen BEFORE any engine mutation, so a failure
+        there leaves the row intact and either requeues it for monolithic
+        prefill on a decode replica (greedy-identical replay) or falls back
+        to decoding right here."""
+        req = state.req
+        t0 = time.monotonic()
+        blob_id = (f"{self.model_id}-{self.replica}-{id(req):x}"
+                   f"-{self._dispatch}")
+        try:
+            # disagg.handoff ordinal 1 = mid-export crash (chaos matrix).
+            faults.check("disagg.handoff")
+            kv_len = int(state.prefilled)
+            blob = self._kv.export_row_pages(row, kv_len)
+            blob["first_token"] = int(first)
+            checkpoint.save_page_blob(blob_id, blob)
+        except Exception as e:
+            self._disagg_handoff_failures += 1
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="export_failed")
+            checkpoint.delete_page_blob(blob_id)
+            req.handoff = None
+            log.warning("engine %s[%d]: hand-off export failed (%s); "
+                        "falling back to monolithic prefill",
+                        self.model_id, self.replica, e)
+            if self._requeue_monolithic(row, state):
+                return True
+            return False
+        # Local prefix registration first: the exported prompt's pages feed
+        # THIS replica's radix tree, so a repeat of the prompt prefills warm
+        # here regardless of where it decodes.
+        self._register_prefix(row, state)
+        req.handoff = {"blob_id": blob_id, "kv_len": kv_len,
+                       "first_token": int(first), "t0": t0}
+        try:
+            self._handoff_sink(req)
+        except Exception as e:
+            checkpoint.delete_page_blob(blob_id)
+            req.handoff = None
+            self._disagg_handoff_failures += 1
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="export_failed")
+            log.warning("engine %s[%d]: hand-off placement failed (%s); "
+                        "decoding locally", self.model_id, self.replica, e)
+            return False
+        self._disagg_exports += 1
+        trace = req.trace
+        if trace is not None:
+            trace.end(state.sp_prefill)
+            state.sp_prefill = None
+            trace.event("handoff_export", blob_id=blob_id, kv_len=kv_len,
+                        replica=self.replica)
+        self._free_handoff_row(row, state)
+        self._ledger.audit("disagg.export")
+        return True
+
+    def _requeue_monolithic(self, row: int, state: _Row) -> bool:
+        """Export failed before anything shipped: push the request back
+        through the router so a decode replica runs monolithic prefill from
+        scratch (greedy-identical — nothing was emitted).  Returns True when
+        the requeue landed; False keeps the row local."""
+        sink = self._handoff_sink
+        req = state.req
+        req.handoff = None
+        if sink is None:
+            return False
+        try:
+            sink(req)
+        except Exception:
+            return False
+        trace = req.trace
+        if trace is not None:
+            trace.end(state.sp_prefill)
+            state.sp_prefill = None
+            trace.event("handoff_fallback", replica=self.replica)
+        self._free_handoff_row(row, state)
+        self._ledger.audit("disagg.fallback")
+        return True
+
+    def _admit_handoff(self, row: int, req: Request, slot: int | None):
+        """Decode replica: admit a hand-off arrival directly in the DECODE
+        phase — import the staged page blob into the row's block table, emit
+        the first token the prefill replica sampled, and join the shared
+        decode step.  Import failure falls back to monolithic prefill on
+        THIS replica (nothing was emitted yet, so greedy output is
+        unchanged).  While the import is in flight the row is marked
+        ``transit`` so memledger snapshots attribute its pages honestly."""
+        h = req.handoff
+        req.handoff = None
+        state = _Row(req)
+        state.transit = True
+        state.prefilling = False
+        self._row_adapter[row] = (slot if slot is not None
+                                  else self._max_live)
+        trace = req.trace
+        if trace is not None:
+            sp = trace.span("queue", t0=req.enqueue_t)
+            trace.end(sp)
+        self._rows[row] = state
+        self._lengths[row] = 0
+        try:
+            # disagg.handoff ordinal 2 = mid-import crash (chaos matrix).
+            faults.check("disagg.handoff")
+            blob = checkpoint.load_page_blob(h["blob_id"])
+            if not isinstance(self._kv, KV.PagedKVState):
+                raise RuntimeError("hand-off import needs a paged KV pool")
+            kv_len = int(h["kv_len"])
+            # lengths first: a concurrent ledger snapshot between here and
+            # the import's completion sees the pages under ``transit``.
+            self._lengths[row] = kv_len
+            state.prefilled = kv_len
+            self._kv = self._kv.import_row_pages(row, blob)
+            first = int(h["first_token"])
+        except Exception as e:
+            self._disagg_handoff_failures += 1
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="import_failed")
+            checkpoint.delete_page_blob(h["blob_id"])
+            self._rows[row] = None
+            self._lengths[row] = 0
+            self._last_tok[row] = 0
+            self._row_adapter[row] = self._max_live
+            self._kv = self._kv.reset_row(row)
+            if trace is not None:
+                trace.event("handoff_import_failed", reason=str(e))
+            self._ledger.audit("disagg.import_failed")
+            log.warning("engine %s[%d]: hand-off import failed (%s); "
+                        "re-prefilling monolithically",
+                        self.model_id, self.replica, e)
+            self._begin_prefill(row, req, slot)
+            return
+        checkpoint.delete_page_blob(h["blob_id"])
+        state.transit = False
+        self._last_tok[row] = first
+        self._disagg_imports += 1
+        self._admissions += 1
+        self._class_admissions[req.priority] += 1
+        serve_metrics.CLASS_ADMISSIONS.inc(priority=req.priority)
+        # No quota charge here: the prefill replica admitted and charged the
+        # prompt; decode tokens bill per-token in _emit_token as usual.
+        wait_ms = (time.monotonic() - req.enqueue_t) * 1000.0
+        self._h_queue_wait.observe(wait_ms)
+        self._h_queue_wait_cls[req.priority].observe(wait_ms)
+        serve_metrics.QUEUE_WAIT_MS.observe(wait_ms)
+        serve_metrics.QUEUE_WAIT_BY_CLASS.observe(wait_ms,
+                                                  priority=req.priority)
+        # TTFT anchored at the ORIGINAL enqueue — the hand-off latency is
+        # part of the first token's wait, so it is not hidden.
+        ttft_ms = (time.monotonic() - req.enqueue_t) * 1000.0
+        self._h_ttft.observe(ttft_ms)
+        self._h_ttft_cls[req.priority].observe(ttft_ms)
+        serve_metrics.TTFT_MS.observe(ttft_ms)
+        serve_metrics.TTFT_BY_CLASS.observe(ttft_ms, priority=req.priority)
+        handoff_ms = (time.monotonic() - h["t0"]) * 1000.0
+        self._h_handoff.observe(handoff_ms)
+        serve_metrics.DISAGG_HANDOFF_MS.observe(handoff_ms)
+        serve_metrics.DISAGG_HANDOFFS.inc(outcome="ok")
+        if trace is not None:
+            trace.event("handoff_import", kv_len=int(h["kv_len"]),
+                        handoff_ms=round(handoff_ms, 3))
+            state.sp_decode = trace.span("decode", ttft_ms=round(ttft_ms, 3))
+        # The imported prompt's pages feed this replica's radix tree — the
+        # router's fingerprint ledger points here now, so make it true.
+        self._register_prefix(row, state)
+        self._emit_token(row, state, first)
+        self._ledger.audit("disagg.import")
 
     def _step(self):
         """One decode tick: a multi-token verify step for every row whose
@@ -2546,6 +2780,13 @@ def serving_stats() -> dict:
         "router_affinity_hit_rate": stats_util.rate(
             router["affinity_hits"], router_lookups),
         "router_failovers": router["failovers"],
+        "disagg_prefill_replicas": router["prefill_replicas"],
+        "disagg_exports": sum(p["disagg_exports"] for p in per),
+        "disagg_imports": sum(p["disagg_imports"] for p in per),
+        "disagg_handoff_failures": sum(
+            p["disagg_handoff_failures"] for p in per),
+        "disagg_handoff_ms_p50": _merged_q(per, "handoff_ms", 0.5),
+        "disagg_handoff_ms_p99": _merged_q(per, "handoff_ms", 0.99),
     }
 
 

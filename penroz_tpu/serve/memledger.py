@@ -17,6 +17,10 @@ of an engine's paged KV pool is attributed to exactly ONE owner state:
                          the zero-recompute resume guarantee)
 - ``reserved``         — the prefix-cache region's unallocated tail (the
                          radix free list)
+- ``transit``          — pages of a disaggregated-prefill hand-off row
+                         mid-import on a decode replica (admitted but not
+                         yet emitting; the partition invariant must sum
+                         through the hand-off window too)
 
 plus a byte ledger for the non-paged components (contiguous / int8 KV,
 the stacked LoRA adapter pack, model params, the adapter host cache).
@@ -72,7 +76,7 @@ DUMP_TICKS_ENV = "PENROZ_DEBUG_DUMP_TICKS"
 #: Every paged-pool page is in exactly one of these states; their sum is
 #: the pool capacity (the audited invariant).
 PAGE_STATES = ("free", "row", "prefix_pinned", "prefix_evictable",
-               "preempted", "reserved")
+               "preempted", "reserved", "transit")
 
 #: Fixed keys of the per-engine byte ledger (``hbm_bytes``); the
 #: aggregate adds ``adapter_host_cache`` (process-wide, host RAM).
@@ -207,12 +211,16 @@ class MemoryLedger:
             total = kv.num_pool_pages
             resume_pages = self._resume_pages()
             row_pages = 0
+            transit_pages = 0
             for i, state in enumerate(e._rows):
                 if state is None:
                     continue
                 used = -(-int(e._lengths[i]) // page_size)  # ceil
                 owned = max(0, used - len(state.prefix_nodes))
-                row_pages += owned
+                if getattr(state, "transit", False):
+                    transit_pages += owned
+                else:
+                    row_pages += owned
                 tenant = state.req.tenant
                 tenant_pages[tenant] = tenant_pages.get(tenant, 0) + owned
                 if state.req.adapter is not None:
@@ -233,7 +241,8 @@ class MemoryLedger:
                         evictable += 1
             states.update({
                 "row": row_pages,
-                "free": (total - cache_pages) - row_pages,
+                "transit": transit_pages,
+                "free": (total - cache_pages) - row_pages - transit_pages,
                 "prefix_pinned": pinned,
                 "prefix_evictable": evictable,
                 "preempted": preempted,
@@ -459,7 +468,8 @@ def memory_stats() -> dict:
     from penroz_tpu.serve import adapters as adapters_mod
     pairs = _engine_snapshots()
     per = [dict(snap, model_id=e.model_id, block_size=e.block_size,
-                capacity=e.capacity, replica=getattr(e, "replica", 0))
+                capacity=e.capacity, replica=getattr(e, "replica", 0),
+                role=getattr(e, "role", "decode"))
            for e, snap in pairs]
     pool = {s: sum(p["pool_pages"][s] for p in per) for s in PAGE_STATES}
     tenant: dict = {}

@@ -117,6 +117,11 @@ ROUTER_FAILOVERS = REGISTRY.register(m.Counter(
     "penroz_router_failovers_total",
     "Admissions rerouted past a refusing replica (breaker open, queue "
     "full, draining) to a live sibling"))
+DISAGG_HANDOFFS = REGISTRY.register(m.Counter(
+    "penroz_disagg_handoffs_total",
+    "Disaggregated-prefill page hand-offs by outcome: 'ok' (exported, "
+    "imported, decoding), 'export_failed' / 'import_failed' (fell back "
+    "to monolithic prefill on a decode replica)", ("outcome",)))
 
 # -- histograms (engine observes the global mirror alongside its own) -------
 
@@ -143,6 +148,10 @@ TTFT_BY_CLASS = REGISTRY.register(m.Histogram(
 QUEUE_WAIT_BY_CLASS = REGISTRY.register(m.Histogram(
     "penroz_queue_wait_ms_by_class",
     "Enqueue to admission per SLO class, ms", labelnames=("priority",)))
+DISAGG_HANDOFF_MS = REGISTRY.register(m.Histogram(
+    "penroz_disagg_handoff_ms",
+    "Prefill-complete to decode-replica first token per hand-off, ms "
+    "(export + blob staging + router placement + import)"))
 
 # -- gauges (scrape-time reads of live state) -------------------------------
 

@@ -124,6 +124,7 @@ class WFQueue:
         self._cursor = 0
         self._len = 0
         self._class_depth = collections.Counter()
+        self._class_tokens = collections.Counter()
 
     def __len__(self) -> int:
         return self._len
@@ -133,6 +134,12 @@ class WFQueue:
 
     def class_depth(self, cls: str) -> int:
         return self._class_depth[cls]
+
+    def class_tokens(self, cls: str) -> int:
+        """Queued PROMPT tokens of ``cls`` — the router's within-class
+        load signal (a 100k-token prompt is not the same wait as a
+        20-token one, which equal queue *depths* would claim)."""
+        return self._class_tokens[cls]
 
     def class_depths(self) -> dict:
         return {cls: self._class_depth[cls] for cls in PRIORITIES}
@@ -163,6 +170,7 @@ class WFQueue:
         self._activate(key)
         self._len += 1
         self._class_depth[req.priority] += 1
+        self._class_tokens[req.priority] += len(req.prompt)
 
     def push_front(self, req) -> None:
         """Head-requeue (adapter-slot-busy backoff, preemption resume):
@@ -175,6 +183,7 @@ class WFQueue:
         self._activate(key)
         self._len += 1
         self._class_depth[req.priority] += 1
+        self._class_tokens[req.priority] += len(req.prompt)
 
     def _retire_key(self, idx, key):
         self._active.pop(idx)
@@ -187,6 +196,7 @@ class WFQueue:
         req = self._queues[key].popleft()
         self._len -= 1
         self._class_depth[req.priority] -= 1
+        self._class_tokens[req.priority] -= len(req.prompt)
         if not self._queues[key]:
             self._retire_key(idx, key)
         return req
@@ -245,6 +255,7 @@ class WFQueue:
                     dropped.append(req)
                     self._len -= 1
                     self._class_depth[req.priority] -= 1
+                    self._class_tokens[req.priority] -= len(req.prompt)
                 else:
                     keep.append(req)
             if keep:
@@ -268,6 +279,7 @@ class WFQueue:
         self._cursor = 0
         self._len = 0
         self._class_depth.clear()
+        self._class_tokens.clear()
         return out
 
     def __iter__(self):

@@ -24,9 +24,10 @@ Placement policy, in order:
    Without this, a fully healthy sibling would absorb all traffic and the
    broken replica would never get the probe it needs to recover.
 3. **Least-loaded within the request's tenant class** — primary key is
-   the replica's queue depth for ``req.priority`` (the PR 8 WFQ class),
-   tie-broken by total load then replica index (deterministic placement
-   for the parity tests).
+   the replica's queued prompt TOKENS for ``req.priority`` (one queued
+   100k-token prompt is more wait than five 20-token ones, which equal
+   queue depths would deny), then class queue depth, then total load,
+   then replica index (deterministic placement for the parity tests).
 
 Failover: a replica that refuses (breaker open, queue full, draining) is
 skipped and the next candidate tried — the client only sees an error when
@@ -34,6 +35,22 @@ EVERY replica refuses, so one crashed replica never 503s a request a
 healthy sibling could serve.  Tenant-quota sheds are re-raised
 immediately: the token buckets are process-wide, so no sibling would
 answer differently.
+
+**Disaggregated prefill** (``PENROZ_DISAGG_PREFILL=1``, paged KV, N ≥ 2):
+the first ``PENROZ_DISAGG_PREFILL_REPLICAS`` replicas become
+prefill-only.  Fresh admissions steer to them (affinity hits still win —
+cached pages beat phase placement); when a prefill replica finishes a
+prompt it exports the row's KV pages to a staged shm blob
+(utils/checkpoint page-blob family) and hands the request to
+:meth:`EngineRouter._place_handoff`, which places the import on the
+affinity-preferred decode replica and records the placement in the
+fingerprint index — the hand-off ledger, updated exactly like a finished
+request's prefix registration.  Decode replicas stay the monolithic
+fallback: if every prefill replica refuses, or a hand-off fails
+(``disagg.handoff`` fault site), the request runs prefill+decode on a
+decode replica with greedy-identical output.  With the flag off the
+role split, the sinks, and the phase steering are all absent — routing
+is exactly the flat PR 14 policy above.
 """
 
 from __future__ import annotations
@@ -53,6 +70,8 @@ log = logging.getLogger(__name__)
 
 AFFINITY_ENV = "PENROZ_ROUTER_AFFINITY"
 AFFINITY_INDEX_ENV = "PENROZ_ROUTER_AFFINITY_INDEX"
+DISAGG_ENV = "PENROZ_DISAGG_PREFILL"
+DISAGG_REPLICAS_ENV = "PENROZ_DISAGG_PREFILL_REPLICAS"
 
 
 def _affinity_enabled() -> bool:
@@ -61,6 +80,30 @@ def _affinity_enabled() -> bool:
 
 def _affinity_index_cap() -> int:
     return ds._env_int(AFFINITY_INDEX_ENV, 4096)
+
+
+def _disagg_requested() -> bool:
+    return os.environ.get(DISAGG_ENV, "0") == "1"
+
+
+def _expected_roles(n: int) -> list:
+    """Per-replica role vector for an N-replica group under the current
+    env.  Disaggregation needs at least one replica of each role and the
+    paged pool (page export/import rides the block table); anything else
+    degrades to the flat all-decode group with a warning."""
+    if not _disagg_requested():
+        return ["decode"] * n
+    if n < 2:
+        log.warning("%s=1 needs PENROZ_SCHED_REPLICAS >= 2 (got %d); "
+                    "disaggregation disabled", DISAGG_ENV, n)
+        return ["decode"] * n
+    if not KV.paged_enabled():
+        log.warning("%s=1 needs PAGED_KV_CACHE=1 (page export/import reads "
+                    "through the block table); disaggregation disabled",
+                    DISAGG_ENV)
+        return ["decode"] * n
+    k = min(max(1, ds._env_int(DISAGG_REPLICAS_ENV, 1)), n - 1)
+    return ["prefill"] * k + ["decode"] * (n - k)
 
 
 class EngineRouter:
@@ -74,11 +117,17 @@ class EngineRouter:
         self.top_k = top_k
         self.greedy = temperature is None or float(temperature) == 0.0
         key = ds._engine_key(model_id, block_size, temperature, top_k)
+        roles = _expected_roles(n)
+        self.disagg = "prefill" in roles
         self.replicas: list = []
         for i in range(n):
             engine = ds.DecodeEngine(model_id, block_size, temperature,
-                                     top_k, replica=i)
+                                     top_k, replica=i, role=roles[i])
             engine._router_owned = True
+            if roles[i] == "prefill":
+                # Export seam: a prefill replica finishing a prompt hands
+                # the request here for decode-side placement.
+                engine._handoff_sink = self._place_handoff
             with ds._REG_LOCK:
                 # Replicas live in the ONE engine registry under the group
                 # key extended by their index, so serving_stats, /memory/,
@@ -131,15 +180,17 @@ class EngineRouter:
 
     # -- placement ----------------------------------------------------------
 
-    def _candidates(self, req, target) -> list:
+    def _candidates(self, req, target, pool=None) -> list:
         """Replica attempt order (see module docstring).  Cooling
         breaker-open replicas go LAST rather than being dropped: when the
         whole group is open, the client still gets the engine's own
-        CircuitOpenError with its cooldown-derived Retry-After."""
+        CircuitOpenError with its cooldown-derived Retry-After.
+        ``pool`` restricts the considered replicas (the hand-off path
+        places on decode replicas only)."""
         now = time.monotonic()
         cooldown_s = ds._breaker_cooldown_ms() / 1000.0
         healthy, probes, cooling = [], [], []
-        for e in self.replicas:
+        for e in (self.replicas if pool is None else pool):
             if e._shutdown or e._draining:
                 continue
             if e._breaker_open:
@@ -153,11 +204,20 @@ class EngineRouter:
 
         def load(e):
             with e._cond:
+                cls_tokens = e._pending.class_tokens(req.priority)
                 cls_depth = e._pending.class_depth(req.priority)
                 total = e.active_rows + len(e._pending)
-            return (cls_depth, total, e.replica)
+            return (cls_tokens, cls_depth, total, e.replica)
 
-        healthy.sort(key=load)
+        if self.disagg and pool is None:
+            # Phase steering: fresh admissions land on prefill replicas;
+            # healthy decode replicas stay in the order as the monolithic
+            # fallback (all prefill replicas refusing must not 503 a
+            # request a decode replica could serve whole).
+            healthy.sort(key=lambda e: (0 if e.role == "prefill" else 1,
+                                        *load(e)))
+        else:
+            healthy.sort(key=load)
         order = []
         if target is not None and target < len(self.replicas):
             te = self.replicas[target]
@@ -195,6 +255,45 @@ class EngineRouter:
                 else:
                     self.affinity_misses += 1
                     serve_metrics.ROUTER_AFFINITY.inc(outcome="miss")
+                if not (self.disagg and engine.role == "prefill"):
+                    # A prefill replica is a waypoint: the pages end up on
+                    # the decode replica the hand-off chooses, and THAT
+                    # placement writes the ledger (_place_handoff).
+                    self._remember(fps, engine.replica)
+            return
+        raise last_exc
+
+    def _place_handoff(self, req):
+        """Decode-side placement for a prefill replica's finished request:
+        with ``req.handoff`` set, the staged page blob is imported by the
+        chosen decode replica; with it None (a failed hand-off falling
+        back), the request re-runs monolithic prefill there.  The
+        affinity-preferred decode replica wins, then queued-token
+        least-loaded; a successful placement records the fingerprint →
+        replica mapping — the hand-off ledger entry, exactly like a
+        finished request's registration.  Raises when every decode
+        replica refuses (caller keeps the request local)."""
+        decode = [e for e in self.replicas if e.role == "decode"]
+        fps = self._fingerprints(req.prompt)
+        target = self._affinity_target(fps) if fps else None
+        if target is not None and self.replicas[target].role != "decode":
+            target = None
+        order = self._candidates(req, target, pool=decode)
+        if not order:
+            raise RuntimeError("no decode replica accepting hand-offs")
+        last_exc = None
+        for pos, engine in enumerate(order):
+            try:
+                engine.submit(req)
+            except TenantQuotaExceeded:
+                raise
+            except RuntimeError as exc:
+                last_exc = exc
+                if pos + 1 < len(order):
+                    self.failovers += 1
+                    serve_metrics.ROUTER_FAILOVERS.inc()
+                continue
+            if fps:
                 self._remember(fps, engine.replica)
             return
         raise last_exc
@@ -219,6 +318,7 @@ def get_router(model_id, block_size, temperature, top_k) -> EngineRouter:
     with _ROUTER_LOCK:
         router = _ROUTERS.get(key)
         if (router is not None and len(router.replicas) == n
+                and [e.role for e in router.replicas] == _expected_roles(n)
                 and not any(e._shutdown for e in router.replicas)):
             return router
         router = EngineRouter(model_id, block_size, temperature, top_k, n)
@@ -234,6 +334,10 @@ def stats_totals() -> dict:
     return {
         "replicas": sum(sum(1 for e in r.replicas if not e._shutdown)
                         for r in routers),
+        "prefill_replicas": sum(
+            sum(1 for e in r.replicas
+                if not e._shutdown and e.role == "prefill")
+            for r in routers),
         "affinity_hits": sum(r.affinity_hits for r in routers),
         "affinity_misses": sum(r.affinity_misses for r in routers),
         "failovers": sum(r.failovers for r in routers),

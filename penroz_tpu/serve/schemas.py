@@ -254,7 +254,8 @@ class EngineMemory(BaseModel):
         "row (live-row KV) | prefix_pinned (radix pages aliased by a live "
         "row) | prefix_evictable (cached, unpinned) | preempted (pinned "
         "by a queued preempted session's resume hold) | reserved (radix "
-        "free list).  States sum to pool_pages_total")
+        "free list) | transit (disaggregated-prefill hand-off import in "
+        "flight).  States sum to pool_pages_total")
     tenant_pages: dict[str, int] = Field(
         default_factory=dict, description="Row-owned pages per tenant id "
         "(page-granular HBM attribution)")
@@ -305,6 +306,26 @@ class EngineStats(BaseModel):
                               "serving mesh (PENROZ_SERVE_MESH / "
                               "PENROZ_SERVE_MESH_MODEL; 1 = unmeshed "
                               "single-device engine)")
+    role: str = Field("decode", description="Disaggregated-prefill role "
+                      "(PENROZ_DISAGG_PREFILL=1): 'prefill' replicas run "
+                      "chunked prefill and export KV page blobs; 'decode' "
+                      "replicas import them and run the token loop — "
+                      "'decode' for every replica when disaggregation "
+                      "is off")
+    disagg_exports: int = Field(0, description="Finished prefills exported "
+                                "as page blobs and handed to a decode "
+                                "replica (prefill replicas)")
+    disagg_imports: int = Field(0, description="Hand-off page blobs "
+                                "imported and admitted directly in the "
+                                "DECODE phase (decode replicas)")
+    disagg_handoff_failures: int = Field(
+        0, description="Hand-offs that fell back to monolithic prefill "
+        "(export or import failure; the request still completes)")
+    disagg_handoff_ms_p50: Optional[float] = Field(
+        None, description="Median prefill-complete → decode-replica first "
+        "token per hand-off (export + blob staging + placement + import)")
+    disagg_handoff_ms_p99: Optional[float] = Field(
+        None, description="p99 hand-off latency")
     active_rows: int
     queue_depth: int
     occupancy: float = Field(..., description="active_rows / capacity now")
@@ -575,6 +596,22 @@ class ServingStatsResponse(BaseModel):
         0, description="Admissions rerouted past a refusing replica "
         "(breaker open, queue full, draining) to a live sibling — the "
         "no-503-while-one-replica-is-healthy counter")
+    disagg_prefill_replicas: int = Field(
+        0, description="Live prefill-only replicas across routers "
+        "(PENROZ_DISAGG_PREFILL=1 + PENROZ_DISAGG_PREFILL_REPLICAS; "
+        "0 = disaggregation off, every replica co-locates both phases)")
+    disagg_exports: int = Field(0, description="Aggregate KV page-blob "
+                                "exports by prefill replicas")
+    disagg_imports: int = Field(0, description="Aggregate hand-off "
+                                "imports admitted by decode replicas")
+    disagg_handoff_failures: int = Field(
+        0, description="Aggregate hand-offs that fell back to monolithic "
+        "prefill")
+    disagg_handoff_ms_p50: Optional[float] = Field(
+        None, description="Median hand-off latency across engines "
+        "(merged histogram buckets)")
+    disagg_handoff_ms_p99: Optional[float] = Field(
+        None, description="p99 hand-off latency across engines")
 
 
 class MemoryEngineEntry(EngineMemory):
@@ -588,6 +625,9 @@ class MemoryEngineEntry(EngineMemory):
                          "within the model's router group (0 for "
                          "standalone engines) — the partition invariant "
                          "holds per replica")
+    role: str = Field("decode", description="Disaggregated-prefill role "
+                      "of this replica ('prefill' | 'decode'; 'decode' "
+                      "when disaggregation is off)")
 
 
 class MemoryResponse(BaseModel):

@@ -296,6 +296,18 @@ function renderServing(data) {
     : `router ${replicas} replicas · affinity ` +
       `${affRate == null ? "—" : (affRate * 100).toFixed(0) + "%"} · ` +
       `failovers ${data.router_failovers || 0}`;
+  /* Disaggregated prefill (PENROZ_DISAGG_PREFILL=1): per-replica role
+   * chips (P = prefill-only, D = decode) plus the hand-off health line —
+   * "disagg off" when no prefill replica is live. */
+  const prefillReplicas = data.disagg_prefill_replicas || 0;
+  const roleChips = (data.engines || [])
+    .map((e) => `r${e.replica}:${(e.role || "decode")[0].toUpperCase()}`)
+    .join(" ");
+  const handoffP99 = data.disagg_handoff_ms_p99;
+  const disaggTxt = prefillReplicas === 0 ? "disagg off"
+    : `disagg ${roleChips} · handoffs ${data.disagg_imports || 0} ` +
+      `(${data.disagg_handoff_failures || 0} failed) · handoff p99 ` +
+      `${handoffP99 == null ? "—" : handoffP99.toFixed(0) + "ms"}`;
   meta.textContent =
     `rows ${data.active_rows}/${data.capacity} (occupancy ` +
     `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +
@@ -307,7 +319,7 @@ function renderServing(data) {
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
     `${multistepTxt} · ` +
     `${specTxt} · ${loraTxt} · ${prefixTxt} · ${qosTxt} · ${routerTxt} · ` +
-    `KV pool drops ${drops}`;
+    `${disaggTxt} · KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();
   const xs = servingHistory.map((_, i) => i);

@@ -486,6 +486,42 @@ def delete_adapter(adapter_id: str):
     _remove_quietly(adapter_path(adapter_id))
 
 
+# ---------------------------------------------------------------------------
+# KV page blobs (disaggregated prefill hand-off, serve/decode_scheduler.py)
+# ---------------------------------------------------------------------------
+#
+# The page transport for prefill→decode hand-off rides the SAME container
+# format (CRC32 per array stream) but stays shm-only: a blob is a
+# transit artifact that lives for one hand-off, so there is no durable
+# flush and no background thread.  The ``pageblob_<id>.ckpt`` family never
+# collides with the ``model_*`` or ``adapter_*`` globs.
+
+def page_blob_path(blob_id: str) -> str:
+    return os.path.join(SHM_PATH, MODELS_FOLDER, f"pageblob_{blob_id}.ckpt")
+
+
+def save_page_blob(blob_id: str, data: dict):
+    """Stage one hand-off blob in shm (atomic write, CRC per stream).
+    Shm-only on purpose — a crash just orphans a transit file that
+    :func:`delete_page_blob` or the tmpdir teardown reclaims."""
+    os.makedirs(os.path.join(SHM_PATH, MODELS_FOLDER), exist_ok=True)
+    _atomic_write(page_blob_path(blob_id), data)
+
+
+def load_page_blob(blob_id: str) -> dict:
+    """Read a staged hand-off blob (CRC-verified).  :raises KeyError: if
+    the blob was never staged or already consumed."""
+    try:
+        return _read(page_blob_path(blob_id))
+    except FileNotFoundError:
+        raise KeyError(f"Page blob {blob_id} not staged.")
+
+
+def delete_page_blob(blob_id: str) -> bool:
+    """Reclaim a consumed (or abandoned) hand-off blob."""
+    return _remove_quietly(page_blob_path(blob_id))
+
+
 def save(model_id: str, data: dict, sync_flush: bool = False):
     """Write checkpoint to shm and flush to disk in the background.
 

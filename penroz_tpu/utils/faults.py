@@ -28,7 +28,11 @@ Registered production sites: ``decode.step`` (shared decode step),
 (checkpoint container write), ``data.download`` (dataset download
 attempt), ``lora.load`` (adapter-checkpoint load into the serving
 registry, serve/adapters.py), ``qos.preempt`` (top of the QoS row-eviction
-path, serve/decode_scheduler.py — crash-during-preemption recovery).
+path, serve/decode_scheduler.py — crash-during-preemption recovery),
+``disagg.handoff`` (disaggregated-prefill page hand-off: fired once on
+the prefill replica's export and once on the decode replica's import, so
+``raise@1`` crashes mid-export and ``raise@2`` crashes mid-import —
+both must fall back to monolithic prefill with greedy parity).
 Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.
 """

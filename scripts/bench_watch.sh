@@ -127,6 +127,20 @@ while true; do
           -- "BENCH_RAGGED_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) ragged capture committed" >> logs/bench_watch.log
     fi
+    # Disaggregated-prefill capture (same shape as the shared-prefix
+    # hook): decode ITL + long-prompt TTFT + hand-off latency with
+    # PENROZ_DISAGG_PREFILL off vs on over a 2-replica group, greedy
+    # parity gated.  Opt-in; failures must not block the main capture.
+    if [ "${PENROZ_WATCH_DISAGG:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_DISAGG_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --disagg \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_DISAGG_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: disaggregated-prefill capture" \
+          -- "BENCH_DISAGG_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) disaggregated-prefill capture committed" >> logs/bench_watch.log
+    fi
     # Capacity-ledger capture (same shape as the shared-prefix hook):
     # ledger on/off ITL delta + mixed-tenant /memory/ attribution under
     # PENROZ_MEMLEDGER_STRICT=1.  Opt-in; failures must not block the

@@ -63,6 +63,35 @@ for site in $SITES; do
   done
 done
 
+# Disaggregated-prefill hand-off sweep (PR 15): disagg.handoff fires once
+# per export and once per import, so ordinal 3 crashes the second
+# hand-off mid-export and ordinal 4 crashes it mid-import.  Both must
+# fall back to monolithic prefill with greedy parity, leak no transit
+# pages (strict ledger) and no staged page blobs.  Skipped under
+# CHAOS_FAST (the tier-1 representative combo stays single-replica).
+if [ "${CHAOS_FAST:-0}" != "1" ]; then
+  for at in ${CHAOS_DISAGG_ATS:-3 4}; do
+    ran=$((ran + 1))
+    echo "=== chaos: site=disagg.handoff at=$at replicas=2 disagg=1 ===" >&2
+    out=$(PENROZ_BENCH_CHAOS_SITE=disagg.handoff PENROZ_BENCH_CHAOS_AT="$at" \
+            PENROZ_DISAGG_PREFILL=1 PENROZ_SCHED_REPLICAS=2 \
+            PENROZ_RAGGED_ATTENTION=1 PENROZ_MEMLEDGER_STRICT=1 \
+            timeout 900 python scripts/bench_serving.py --chaos)
+    rc=$?
+    echo "$out"
+    if [ "$rc" -ne 0 ]; then
+      echo "FAIL site=disagg.handoff at=$at rc=$rc" >&2
+      fail=1
+      continue
+    fi
+    if ! printf '%s' "$out" | python -c \
+        'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") else 1)'; then
+      echo "FAIL site=disagg.handoff at=$at: disallowed statuses or parity break" >&2
+      fail=1
+    fi
+  done
+fi
+
 if [ "$fail" -ne 0 ]; then
   echo "chaos matrix: FAILED (of $ran combos)" >&2
   exit 1

@@ -378,6 +378,69 @@ def test_ragged_bench_smoke(tmp_path):
     assert delta["penroz_prefill_chunks_total"] > 0, delta
 
 
+@pytest.mark.slow
+def test_disagg_bench_smoke(tmp_path):
+    """--disagg (PR 15): on mixed traffic over a 2-replica group, the
+    disaggregated split (replica 0 prefill-only, exporting finished KV
+    pages; replica 1 decode-only, importing them) must beat the
+    co-located baseline on the interactive streams' ITL p99 — the decode
+    replica's token gaps no longer absorb long-prompt chunk dispatches.
+    The isolation itself is counted, not timed: the decode-role replica
+    runs ZERO prefill chunks, every request is exported exactly once and
+    imported exactly once, and greedy parity holds between phases.  The
+    ITL margin is structural (a 64-token prefill chunk through the model
+    vs a page-blob copy; observed 1.3-1.7x), so the >1.0 bound is not a
+    timing accident.  Marked slow (the compile warmup makes this the
+    heaviest smoke in the file); the tier-1 gate still pins the disagg
+    invariants through tests/test_router.py, and the committed
+    BENCH_DISAGG capture carries the timing evidence."""
+    out_path = tmp_path / "disagg.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PENROZ_BENCH_SERVING_BLOCK="384",
+        PENROZ_BENCH_SERVING_D="128",
+        PENROZ_BENCH_SERVING_DEPTH="2",
+        PENROZ_BENCH_DISAGG_STREAMS="3",
+        PENROZ_BENCH_DISAGG_PREFILLS="2",
+        PENROZ_BENCH_DISAGG_LONG="320",
+        PENROZ_BENCH_DISAGG_ROUNDS="2",
+        PENROZ_BENCH_MAX_NEW="16",
+        PENROZ_BENCH_CHUNK="64",
+        PENROZ_BENCH_JSON_OUT=str(out_path),
+    )
+    proc = subprocess.run([sys.executable, SCRIPT, "--disagg"],
+                          capture_output=True, text=True, timeout=900,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.loads(out_path.read_text()) == results
+
+    assert results["mode"] == "disagg"
+    assert results["parity_ok"] is True, results       # never wrong tokens
+    assert results["ok"] is True, results
+    col, dis = results["colocated"], results["disagg"]
+    # the role split engaged, and ONLY under the flag
+    assert col["roles"] == ["decode", "decode"], results
+    assert dis["roles"] == ["prefill", "decode"], results
+    assert col["disagg_imports"] == 0, results
+    # exactly-once hand-off for every request (warm rounds included)
+    assert dis["disagg_imports"] == dis["disagg_exports"] > 0, results
+    assert dis["disagg_handoff_failures"] == 0, results
+    assert dis["handoffs_measured"] == 10, results     # 2 rounds x 5 reqs
+    # the point of the PR, counted: the decode replica never ran a chunk
+    assert dis["decode_replica_prefill_chunks"] == 0, results
+    assert col["decode_replica_prefill_chunks"] > 0, results
+    # ...and timed: interactive ITL p99 beats the co-located baseline
+    assert results["itl_p99_improved"] is True, results
+    assert results["decode_itl_p99_colocated_vs_disagg"] > 1.0, results
+    assert dis["disagg_handoff_ms_p50"] is not None, results
+    assert dis["disagg_handoff_ms_mean_measured"] > 0, results
+    delta = results["metrics_delta"]
+    assert delta['penroz_disagg_handoffs_total{outcome="ok"}'] > 0, delta
+    assert delta["penroz_disagg_handoff_ms_count"] > 0, delta
+
+
 def test_chaos_matrix_fast_subset(tmp_path):
     """scripts/chaos_matrix.sh CHAOS_FAST=1: the qos.preempt x unified
     combo through the chaos overload bench — the injected

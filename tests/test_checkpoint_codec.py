@@ -189,3 +189,25 @@ def test_list_model_ids_shard_suffix_only(tmp_path, monkeypatch):
     checkpoint.save_shard("plain", 1, {"tag": 0, "pieces": {}},
                           sync_flush=True)
     assert checkpoint.list_model_ids() == ["odd.shard", "plain", "v1.sharded"]
+
+
+def test_page_blob_save_load_delete(tmp_path, monkeypatch):
+    """Disaggregated-prefill transport: a staged page blob round-trips
+    arrays and scalar leaves through the CRC-checked container, load of a
+    missing id is a typed KeyError, and delete is idempotent."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(checkpoint, "SHM_PATH", str(tmp_path / "shm"))
+    blob = {"page_size": 4, "pages": 2, "length": 7, "quantized": False,
+            "first_token": 42,
+            "k": [np.arange(32, dtype=np.float32).reshape(2, 16)],
+            "v": [np.arange(32, 64, dtype=np.float32).reshape(2, 16)]}
+    checkpoint.save_page_blob("h1", blob)
+    out = checkpoint.load_page_blob("h1")
+    assert out["page_size"] == 4 and out["length"] == 7
+    assert out["first_token"] == 42 and out["quantized"] is False
+    np.testing.assert_array_equal(out["k"][0], blob["k"][0])
+    np.testing.assert_array_equal(out["v"][0], blob["v"][0])
+    assert checkpoint.delete_page_blob("h1") is True
+    assert checkpoint.delete_page_blob("h1") is False   # idempotent
+    with pytest.raises(KeyError, match="h1"):
+        checkpoint.load_page_blob("h1")

@@ -721,3 +721,49 @@ def test_rollback_row_never_frees_pinned_prefix_pages(cls):
     nines = 9.0 * jnp.ones((2, 1, 1, 4))
     kv.append_rows(0, nines, nines)
     np.testing.assert_array_equal(_read_k(kv)[1, :, :4], shared_before)
+
+
+@pytest.mark.parametrize("cls", [KV.PagedKVState, KV.QuantPagedKVState])
+def test_export_import_row_pages_roundtrip_across_pools(cls):
+    """Disaggregated-prefill seam: export a prefilled row's finished pages
+    from one pool and import them into a DIFFERENT pool (different row
+    index) — the imported row reads token-identical KV, the blob carries
+    page_size/quantized so mismatched pools are rejected, and the import
+    re-bases the row on its static partition (no stale alias)."""
+    specs = [(1, 4), (1, 4)]
+    src = cls.create(specs, batch=2, max_len=8, page_size=4) \
+        .with_static_table().with_lengths([0, 0])
+    view = src.row_view(0, 0)
+    rng = np.random.default_rng(3)
+    k = jnp.asarray(rng.normal(size=(1, 1, 7, 4)).astype(np.float32))
+    for layer in range(len(specs)):
+        view.append_rows(layer, k, 2 * k)
+    src = src.merge_row(0, view.advanced(7))
+    blob = src.export_row_pages(0, 7)
+    assert blob["pages"] == 2 and blob["length"] == 7
+    assert blob["quantized"] is (cls is KV.QuantPagedKVState)
+
+    dst = cls.create(specs, batch=2, max_len=8, page_size=4) \
+        .with_static_table().with_lengths([0, 0])
+    dst = dst.import_row_pages(1, blob)
+    assert isinstance(dst, cls)
+    for layer in range(len(specs)):
+        src_read = np.asarray(src._gather(src.k[layer]), np.float32)
+        dst_read = np.asarray(dst._gather(dst.k[layer]), np.float32)
+        np.testing.assert_array_equal(dst_read[1, :, :7], src_read[0, :, :7])
+        if cls is KV.QuantPagedKVState:
+            np.testing.assert_array_equal(
+                np.asarray(dst.k_scale[layer])[:, 8:16],
+                np.asarray(src.k_scale[layer])[:, 0:8])
+    # other row untouched
+    assert float(np.abs(np.asarray(
+        dst._gather(dst.k[0]), np.float32)[0, :, :7]).max()) == 0.0
+    # page_size / quantization mismatches are typed errors
+    with pytest.raises(ValueError, match="page_size"):
+        cls.create(specs, batch=2, max_len=16, page_size=8) \
+            .with_static_table().import_row_pages(0, blob)
+    other = (KV.PagedKVState if cls is KV.QuantPagedKVState
+             else KV.QuantPagedKVState)
+    with pytest.raises(ValueError, match="quant"):
+        other.create(specs, batch=2, max_len=8, page_size=4) \
+            .with_static_table().import_row_pages(0, blob)

@@ -210,6 +210,42 @@ def test_wfq_weights_env_parsing(monkeypatch):
     assert qos.class_queue_bound("interactive") is None  # unset → aggregate
 
 
+def test_wfq_class_tokens_tracks_queued_prompt_tokens():
+    """class_tokens(cls) is the sum of queued prompt lengths per class —
+    the router's least-loaded scoring reads it so a queue of three 8k
+    prompts outweighs a queue of five 3-token prompts.  Every mutation
+    path (push, push_front, pop, purge via _take, drain) keeps it exact."""
+    from penroz_tpu.serve import decode_scheduler, qos
+
+    def mk(n_tokens, priority=None):
+        return decode_scheduler.Request(list(range(1, n_tokens + 1)), 1,
+                                        None, lambda *a: None,
+                                        priority=priority)
+
+    q = qos.WFQueue()
+    assert q.class_tokens("standard") == 0
+    q.push(mk(5))
+    q.push(mk(7))
+    q.push(mk(100, priority="batch"))
+    assert q.class_tokens("standard") == 12
+    assert q.class_tokens("batch") == 100
+    q.push_front(mk(3))
+    assert q.class_tokens("standard") == 15
+    popped = q.pop()                      # head of standard: the 3-token
+    assert len(popped.prompt) == 3
+    assert q.class_tokens("standard") == 12
+    # purge (deadline/cancel sweep) decrements exactly the dropped prompts
+    stale = mk(9)
+    stale.cancelled = True
+    q.push(stale)
+    assert q.class_tokens("standard") == 21
+    dropped = q.purge(lambda r: r.cancelled)
+    assert dropped == [stale]
+    assert q.class_tokens("standard") == 12
+    q.drain()
+    assert all(q.class_tokens(c) == 0 for c in qos.PRIORITIES)
+
+
 def test_quota_bucket_retry_after_tracks_refill(monkeypatch):
     """Satellite: the quota 429's Retry-After is the bucket's refill time
     (deficit / rate, ceil, clamped) — a deeper deficit means a longer

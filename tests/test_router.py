@@ -212,6 +212,216 @@ def test_router_prefix_affinity_steers_family_to_one_replica(gpt_model,
     assert stats["router_affinity_hit_rate"] == pytest.approx(0.75)
 
 
+# ---------------------------------------------------------------------------
+# Disaggregated prefill (PENROZ_DISAGG_PREFILL=1)
+# ---------------------------------------------------------------------------
+
+def _disagg_env(monkeypatch, prefill_replicas="1", prefix=True):
+    from penroz_tpu.serve import router as router_mod
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    if prefix:
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    monkeypatch.setenv("PENROZ_MEMLEDGER_STRICT", "1")
+    monkeypatch.setenv(router_mod.DISAGG_ENV, "1")
+    monkeypatch.setenv(router_mod.DISAGG_REPLICAS_ENV, prefill_replicas)
+
+
+def _assert_no_transit_or_blob_leaks():
+    """Strict partition check after a disagg run: every page owned, no
+    lingering transit attribution, no staged blob left on shm."""
+    import glob
+    import os
+    from penroz_tpu.serve import memledger
+    from penroz_tpu.utils import checkpoint
+    mem = memledger.memory_stats()
+    for entry in mem["engines"]:
+        pools = entry["pool_pages"]
+        assert pools.get("transit", 0) == 0, pools
+        assert sum(pools.values()) == entry["pool_pages_total"]
+    blobs = glob.glob(os.path.join(checkpoint.SHM_PATH, "**", "pageblob_*"),
+                      recursive=True)
+    assert blobs == [], blobs
+
+
+@pytest.mark.parametrize("int8", [False, True])
+@pytest.mark.parametrize("prefix", [False, True])
+@pytest.mark.parametrize("superstep", ["1", "8"])
+def test_router_disagg_greedy_parity_matrix(gpt_model, monkeypatch, int8,
+                                            prefix, superstep):
+    """Tentpole acceptance: disaggregated prefill is token-identical to the
+    legacy single-engine path across int8 KV × prefix-cache × superstep —
+    and every request provably travelled the export → import seam (no
+    silent monolithic fallback)."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.serve import router as router_mod
+    _disagg_env(monkeypatch, prefix=prefix)
+    if int8:
+        monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, superstep)
+    prompts = [[1, 2, 3, 4, 5, 6, 7, 8],
+               [1, 2, 3, 4, 5, 6, 7, 8, 9],
+               [11, 12]]
+    # legacy baseline under the same KV env flags
+    bases = [gpt_model.generate_tokens([p], BLOCK, 5, temperature=0.0)
+             for p in prompts]
+    router = _get_router(monkeypatch, n=2)
+    assert [e.role for e in router.replicas] == ["prefill", "decode"]
+    collectors = [_submit(router, p, 5) for p in prompts]
+    for collector, base in zip(collectors, bases):
+        assert collector.result() == base
+    per = [e.stats() for e in router.replicas]
+    assert sum(p["disagg_exports"] for p in per) == len(prompts)
+    assert sum(p["disagg_imports"] for p in per) == len(prompts)
+    assert sum(p["disagg_handoff_failures"] for p in per) == 0
+    # prefill replicas never decode: every emitted token is the decode
+    # replica's (the first token ships inside the hand-off)
+    assert per[0]["completed"] == 0
+    assert per[1]["completed"] == len(prompts)
+    stats = decode_scheduler.serving_stats()
+    assert stats["disagg_prefill_replicas"] == 1
+    assert stats["disagg_exports"] == len(prompts)
+    assert stats["disagg_imports"] == len(prompts)
+    assert stats["disagg_handoff_ms_p99"] is not None
+    assert [e["role"] for e in stats["engines"]] == ["prefill", "decode"]
+    _assert_no_transit_or_blob_leaks()
+
+
+def test_router_disagg_off_keeps_flat_routing(gpt_model, monkeypatch):
+    """PENROZ_DISAGG_PREFILL=0 (or unset) leaves the PR 14 flat group:
+    every replica role 'decode', no sinks installed, zero disagg counters
+    in /serving_stats/."""
+    from penroz_tpu.serve import decode_scheduler
+    router = _get_router(monkeypatch, n=2)
+    assert [e.role for e in router.replicas] == ["decode", "decode"]
+    assert all(e._handoff_sink is None for e in router.replicas)
+    assert router.disagg is False
+    base = gpt_model.generate_tokens([[1, 2, 3]], BLOCK, 4, temperature=0.0)
+    assert _submit(router, [1, 2, 3], 4).result() == base
+    stats = decode_scheduler.serving_stats()
+    assert stats["disagg_prefill_replicas"] == 0
+    assert stats["disagg_exports"] == 0
+    assert stats["disagg_imports"] == 0
+
+
+@pytest.mark.parametrize("ordinal,phase", [(1, "export"), (2, "import")])
+def test_router_disagg_handoff_failure_falls_back_with_parity(
+        gpt_model, monkeypatch, ordinal, phase):
+    """disagg.handoff crash mid-export (@1) or mid-import (@2): the request
+    falls back to monolithic prefill on a decode replica, output is
+    greedy-identical, the failure is counted, and neither a transit page
+    nor a staged blob outlives the hand-off."""
+    from penroz_tpu.utils import faults
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv(faults.ENV, f"disagg.handoff:raise@{ordinal}")
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    router = _get_router(monkeypatch, n=2)
+    assert _submit(router, prompt, 5).result() == base
+    per = [e.stats() for e in router.replicas]
+    assert sum(p["disagg_handoff_failures"] for p in per) == 1, phase
+    assert sum(p["disagg_imports"] for p in per) == 0
+    # the decode replica ran the request whole either way
+    assert per[1]["completed"] == 1
+    _assert_no_transit_or_blob_leaks()
+
+
+def test_router_disagg_drain_finishes_inflight_export(gpt_model,
+                                                      monkeypatch):
+    """Draining a prefill replica lets its in-flight export complete
+    before the worker stops: the hand-off lands on the decode replica and
+    the client sees the full greedy output, not an error."""
+    import time as time_mod
+    from penroz_tpu.utils import faults
+    _disagg_env(monkeypatch)
+    # widen the export window so the drain provably overlaps it
+    monkeypatch.setenv(faults.ENV, "disagg.handoff:sleep@300")
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    router = _get_router(monkeypatch, n=2)
+    r0, r1 = router.replicas
+    collector = _submit(router, prompt, 5)
+    deadline = time_mod.monotonic() + 120
+    while r0.active_rows == 0 and time_mod.monotonic() < deadline:
+        time_mod.sleep(0.002)
+    assert r0.active_rows == 1          # prefill (or export) in flight
+    assert r0.shutdown(timeout=60, drain_s=60) is True
+    assert r0.stats()["disagg_exports"] == 1
+    assert collector.result() == base
+    assert r1.stats()["disagg_imports"] == 1
+
+
+def test_router_disagg_prefill_breakers_open_decode_serves_monolithic(
+        gpt_model, monkeypatch):
+    """All prefill replicas breaker-open: /readyz stays ready (a healthy
+    decode replica can serve the request whole) and submissions complete
+    monolithically on the decode replica with greedy parity."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv(decode_scheduler.MAX_CRASHES_ENV, "2")
+    monkeypatch.setenv(decode_scheduler.BREAKER_COOLDOWN_ENV, "100000")
+    monkeypatch.setenv(faults.ENV,
+                       "decode.prefill_chunk:raise@1,"
+                       "decode.prefill_chunk:raise@2")
+    prompt = [1, 2, 3, 4, 5]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    router = _get_router(monkeypatch, n=2)
+    r0, r1 = router.replicas
+    assert r0.role == "prefill"
+    # phase steering sends both doomed prefills to the prefill replica
+    for _ in range(2):
+        with pytest.raises(faults.InjectedFault):
+            _submit(router, prompt, 5).result()
+    assert r0.stats()["breaker_open"] is True
+    # every prefill replica open but decode healthy → still ready
+    assert "schedgpt" not in decode_scheduler.breaker_open_engines()
+    assert _submit(router, prompt, 5).result() == base
+    s1 = r1.stats()
+    assert s1["completed"] == 1
+    assert s1["disagg_imports"] == 0    # monolithic, not an import
+    assert r0.stats()["completed"] == 0
+
+
+def test_router_disagg_scoring_counts_queued_prefill_tokens():
+    """Satellite: least-loaded placement ranks by queued prompt TOKENS of
+    the request's class before queue depth — a replica holding two
+    100-token prompts is more loaded than one holding five 3-token
+    prompts, which depth-based scoring would get backwards."""
+    import threading
+    from penroz_tpu.serve import decode_scheduler, qos
+    from penroz_tpu.serve import router as router_mod
+
+    class _FakeEngine:
+        def __init__(self, replica):
+            self.replica = replica
+            self.role = "decode"
+            self._shutdown = False
+            self._draining = False
+            self._breaker_open = False
+            self._probe_inflight = False
+            self._breaker_open_t = 0.0
+            self._cond = threading.Condition()
+            self._pending = qos.WFQueue()
+            self.active_rows = 0
+
+    def _req(n_tokens):
+        return decode_scheduler.Request(list(range(1, n_tokens + 1)), 1,
+                                        None, lambda *a: None)
+
+    router = object.__new__(router_mod.EngineRouter)
+    router.replicas = [_FakeEngine(0), _FakeEngine(1)]
+    router.disagg = False
+    few_huge, many_tiny = router.replicas
+    for _ in range(2):
+        few_huge._pending.push(_req(100))     # depth 2, 200 tokens
+    for _ in range(5):
+        many_tiny._pending.push(_req(3))      # depth 5, 15 tokens
+    order = router._candidates(_req(4), target=None)
+    assert [e.replica for e in order] == [1, 0]
+
+
 def test_router_replicas_visible_in_stats_and_memory(gpt_model,
                                                      monkeypatch):
     """Replica engines surface individually in /serving_stats/ and the

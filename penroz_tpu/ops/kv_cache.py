@@ -1052,22 +1052,41 @@ class PagedKVState(KVState):
         return (phys[:, None] * self.page_size
                 + np.arange(self.page_size)).reshape(-1)
 
-    def export_row_pages(self, row, length) -> dict:
+    def export_row_pages(self, row, length, device: bool = False) -> dict:
         """Gather row ``row``'s first ``ceil(length/page_size)`` logical
-        pages through its block table as host arrays — the disaggregated
-        prefill export.  The gather follows the table, so prefix-aliased
-        leading pages come out position-ordered exactly like row-private
-        ones.  Eager host op; ``row``/``length`` are host ints."""
+        pages through its block table — the disaggregated prefill export.
+        The gather follows the table, so prefix-aliased leading pages come
+        out position-ordered exactly like row-private ones.  With
+        ``device=False`` (the host-staged / crash-safe transport) the
+        planes come back as host arrays ready for the CRC blob codec;
+        ``device=True`` (d2d transport) keeps them as device arrays so the
+        hand-off never round-trips through host memory.  Eager op;
+        ``row``/``length`` are host ints."""
         P = self.page_size
         n = -(-int(length) // P)
         if n > self.pages_per_seq:
             raise ValueError(f"export of {n} pages exceeds "
                              f"pages_per_seq={self.pages_per_seq}")
         pool_rows = self._export_pool_rows(row, n)
+        gather = ((lambda a: a[:, pool_rows]) if device
+                  else (lambda a: np.asarray(a[:, pool_rows])))
         return {"page_size": P, "pages": n, "length": int(length),
                 "quantized": bool(getattr(self, "quantized", False)),
-                "k": [np.asarray(a[:, pool_rows]) for a in self.k],
-                "v": [np.asarray(a[:, pool_rows]) for a in self.v]}
+                "k": [gather(a) for a in self.k],
+                "v": [gather(a) for a in self.v]}
+
+    @staticmethod
+    def _import_operand(s, a):
+        """Hand-off update operand for one pool leaf: host-blob planes
+        convert on device as before; device planes (d2d transport)
+        re-shard onto the destination pool's own layout first so the
+        scatter stays one XLA program with co-sharded operands."""
+        if isinstance(s, jax.Array):
+            from penroz_tpu.parallel import sharding as sharding_mod
+            if s.dtype != a.dtype:
+                s = s.astype(a.dtype)
+            return sharding_mod.place_update(s, a)
+        return jnp.asarray(s, a.dtype)
 
     def import_row_pages(self, row, blob: dict):
         """Scatter an :meth:`export_row_pages` blob into row ``row``'s own
@@ -1090,10 +1109,10 @@ class PagedKVState(KVState):
         zero = jnp.int32(0)
         out = self.with_row_prefix(row, ())
         out.k = [jax.lax.dynamic_update_slice(
-                     a, jnp.asarray(s, a.dtype), (zero, start, zero))
+                     a, self._import_operand(s, a), (zero, start, zero))
                  for a, s in zip(out.k, blob["k"])]
         out.v = [jax.lax.dynamic_update_slice(
-                     a, jnp.asarray(s, a.dtype), (zero, start, zero))
+                     a, self._import_operand(s, a), (zero, start, zero))
                  for a, s in zip(out.v, blob["v"])]
         return out
 
@@ -1285,11 +1304,13 @@ class QuantPagedKVState(PagedKVState):
                        for a in self.v_scale]
         return out
 
-    def export_row_pages(self, row, length) -> dict:
-        out = super().export_row_pages(row, length)
+    def export_row_pages(self, row, length, device: bool = False) -> dict:
+        out = super().export_row_pages(row, length, device=device)
         pool_rows = self._export_pool_rows(row, out["pages"])
-        out["k_scale"] = [np.asarray(a[:, pool_rows]) for a in self.k_scale]
-        out["v_scale"] = [np.asarray(a[:, pool_rows]) for a in self.v_scale]
+        gather = ((lambda a: a[:, pool_rows]) if device
+                  else (lambda a: np.asarray(a[:, pool_rows])))
+        out["k_scale"] = [gather(a) for a in self.k_scale]
+        out["v_scale"] = [gather(a) for a in self.v_scale]
         return out
 
     def import_row_pages(self, row, blob: dict):
@@ -1298,10 +1319,10 @@ class QuantPagedKVState(PagedKVState):
         start = jnp.int32(int(row) * S * P)
         zero = jnp.int32(0)
         out.k_scale = [jax.lax.dynamic_update_slice(
-                           a, jnp.asarray(s, a.dtype), (zero, start, zero))
+                           a, self._import_operand(s, a), (zero, start, zero))
                        for a, s in zip(out.k_scale, blob["k_scale"])]
         out.v_scale = [jax.lax.dynamic_update_slice(
-                           a, jnp.asarray(s, a.dtype), (zero, start, zero))
+                           a, self._import_operand(s, a), (zero, start, zero))
                        for a, s in zip(out.v_scale, blob["v_scale"])]
         return out
 

@@ -103,6 +103,25 @@ def place_tree(tree, sharding_tree):
                         is_leaf=lambda x: x is None)
 
 
+def place_update(update, dst):
+    """Re-shard a hand-off update operand onto ``dst``'s own layout.
+
+    The disaggregated-prefill d2d transport hands the importer *device*
+    page planes gathered on the exporter's mesh.  The
+    ``lax.dynamic_update_slice`` scatter wants both operands co-sharded,
+    and the pool specs from :func:`paged_kv_sharding_tree` are
+    shape-polymorphic on the row dim (``P(model|None, None, None)``), so
+    the destination pool array's committed sharding applies verbatim to
+    the smaller update slab.  No-op when ``dst`` is uncommitted (single
+    device) or the layouts already match.
+    """
+    import jax
+    sharding = getattr(dst, "sharding", None)
+    if sharding is None or getattr(update, "sharding", None) == sharding:
+        return update
+    return jax.device_put(update, sharding)
+
+
 def shard_params(params: dict, mesh: Mesh, fsdp: bool = False) -> dict:
     """Place a flat param dict onto the mesh under the TP (+FSDP) layout."""
     shardings = param_shardings(params, mesh, fsdp=fsdp)

@@ -189,6 +189,13 @@ TICK_TIMELINE_ENV = "PENROZ_TICK_TIMELINE"
 SUPERSTEP_ENV = "PENROZ_SCHED_SUPERSTEP"
 RAGGED_ENV = "PENROZ_RAGGED_ATTENTION"
 REPLICAS_ENV = "PENROZ_SCHED_REPLICAS"
+# Disaggregated-prefill hand-off transport: "d2d" (device arrays handed
+# over in-process, re-sharded onto the importer's pools — the default
+# when source and destination replicas live in the same process) or
+# "host" (the CRC-checked shm page-blob codec, which also remains the
+# crash-safe fallback whenever the d2d path fails mid-hand-off).
+DISAGG_TRANSPORT_ENV = "PENROZ_DISAGG_TRANSPORT"
+DISAGG_ACK_TIMEOUT_ENV = "PENROZ_DISAGG_ACK_TIMEOUT_MS"
 
 # Max tick-timeline entries served per /serving_stats/ payload (the ring
 # itself holds PENROZ_TICK_TIMELINE entries).
@@ -275,6 +282,24 @@ def _replicas() -> int:
 
 def _admit_ms() -> float:
     return _env_float(ADMIT_MS_ENV, 0.0)
+
+
+def _disagg_transport() -> str:
+    """Hand-off transport for disaggregated prefill: ``d2d`` by default
+    (all replicas of a router group share this process, so device arrays
+    hand over without host staging); ``host`` forces the blob codec."""
+    v = os.environ.get(DISAGG_TRANSPORT_ENV, "d2d").strip().lower()
+    if v not in ("d2d", "host"):
+        log.warning("Unknown %s=%r; using d2d", DISAGG_TRANSPORT_ENV, v)
+        return "d2d"
+    return v
+
+
+def _ack_timeout_s() -> float:
+    """How long an exporter row parks awaiting the importer's d2d ack
+    before its pages are reaped (the importer owns the request's stream by
+    then, so a lost ack must not leak transit pages forever)."""
+    return _env_float(DISAGG_ACK_TIMEOUT_ENV, 10000.0) / 1000.0
 
 
 def _prefill_chunk() -> int:
@@ -495,6 +520,16 @@ class DecodeEngine:
         # replicas import the blob at admission and skip prefill entirely.
         self.role = role
         self._handoff_sink = None
+        # d2d free-after-ack protocol: rows whose device planes shipped but
+        # whose import is unacknowledged park in _transit_rows (pages stay
+        # owned, attributed to ``transit`` by the ledger); importer acks
+        # land in _acks from the importing thread and drain at worker-loop
+        # boundaries.  _requested_role is the elastic rebalancer's pending
+        # flip, applied by the worker at a drain boundary.
+        self._transit_rows: dict = {}
+        self._acks: list = []
+        self._requested_role = None
+        self._disagg_role_changes = 0
 
         self._model = NeuralNetworkModel.deserialize(model_id)
         self._ckpt_stamp_v = self._ckpt_stamp()
@@ -800,6 +835,11 @@ class DecodeEngine:
         return self.active_rows == 0 and not self._pending
 
     @property
+    def disagg_transport(self) -> str:
+        """Live hand-off transport this engine exports with."""
+        return _disagg_transport()
+
+    @property
     def live_adapters(self) -> int:
         return sum(1 for e in self._slot_entries if e is not None)
 
@@ -901,6 +941,8 @@ class DecodeEngine:
             "disagg_handoff_failures": self._disagg_handoff_failures,
             "disagg_handoff_ms_p50": self._round_q(self._h_handoff, 0.5),
             "disagg_handoff_ms_p99": self._round_q(self._h_handoff, 0.99),
+            "disagg_transport": _disagg_transport(),
+            "disagg_role_changes": self._disagg_role_changes,
             "active_rows": active,
             "queue_depth": self.queue_depth,
             "occupancy": active / self.capacity,
@@ -948,16 +990,27 @@ class DecodeEngine:
         while True:
             with self._cond:
                 while (not self._shutdown and not self._pending
-                       and self.active_rows == 0):
+                       and not self._acks and self._requested_role is None
+                       and self.active_rows == len(self._transit_rows)):
                     # Untimed wait: every state change the predicate reads
-                    # notifies (submit, shutdown, drain), so an idle engine
-                    # parks on the condition variable and burns zero CPU —
-                    # no periodic wake, no empty ticks (tested).
-                    self._cond.wait()
+                    # notifies (submit, shutdown, drain, hand-off ack, role
+                    # request), so an idle engine parks on the condition
+                    # variable and burns zero CPU — no periodic wake, no
+                    # empty ticks (tested).  With rows parked awaiting d2d
+                    # importer acks the wait turns timed, so a lost ack is
+                    # reaped at its deadline instead of never.
+                    if self._transit_rows:
+                        self._cond.wait(timeout=0.05)
+                        if self._ack_overdue():
+                            break
+                    else:
+                        self._cond.wait()
                 if self._shutdown:
                     break
             self._loops += 1
             try:
+                self._drain_acks()
+                self._maybe_flip_role()
                 self._purge_expired()
                 self._coalesce_burst()
                 self._admit()
@@ -1117,7 +1170,8 @@ class DecodeEngine:
         set stays O(log²) for any workload)."""
         from penroz_tpu.ops.pallas.ragged_paged_attention import (
             default_block_q)
-        rows = [(i, r) for i, r in enumerate(self._rows) if r is not None]
+        rows = [(i, r) for i, r in enumerate(self._rows)
+                if r is not None and not r.transit]
         if not rows:
             return None
         block_q = default_block_q()
@@ -1440,9 +1494,11 @@ class DecodeEngine:
 
     def _decoding_rows(self) -> list[int]:
         """Rows with prefill complete — the shared decode step's real
-        participants (prefilling/free rows ride along parked)."""
+        participants (prefilling/free/transit rows ride along parked; a
+        transit row's pages belong to an in-flight hand-off, not a decode
+        participant)."""
         return [i for i, r in enumerate(self._rows)
-                if r is not None and not r.prefilling]
+                if r is not None and not r.prefilling and not r.transit]
 
     def _admit(self):
         while True:
@@ -1751,7 +1807,7 @@ class DecodeEngine:
         arrivals."""
         best = None
         for i, r in enumerate(self._rows):
-            if r is None or not r.prefilling:
+            if r is None or not r.prefilling or r.transit:
                 continue
             if best is None or r.req.enqueue_t \
                     < self._rows[best].req.enqueue_t:
@@ -1841,6 +1897,14 @@ class DecodeEngine:
                 and isinstance(self._kv, KV.PagedKVState)):
             if self._export_handoff(row, state, first):
                 return
+        self._finish_prefill_local(row, state, first)
+
+    def _finish_prefill_local(self, row: int, state: _Row, first: int):
+        """Emit the first token and join the decode batch on THIS replica —
+        the non-disaggregated tail of ``_finish_prefill``, also the last
+        resort when a hand-off cannot leave the engine (export failed with
+        no reachable decode replica, or a refused d2d hand-off whose host
+        re-stage failed too)."""
         state.prefilling = False
         self._lengths[row] = state.prefilled  # == len(effective prompt)
         self._last_tok[row] = first
@@ -1892,30 +1956,61 @@ class DecodeEngine:
         self._kv = self._kv.reset_row(row)
 
     def _export_handoff(self, row: int, state: _Row, first: int) -> bool:
-        """Prefill replica: export the finished row's KV pages as a shm page
-        blob and hand the request to a decode replica via ``_handoff_sink``.
-        Returns True when the row left this engine (shipped or requeued
-        remotely); False means the caller finishes the row locally.
+        """Prefill replica: ship the finished row's KV pages to a decode
+        replica via ``_handoff_sink`` — device arrays over the d2d
+        transport by default, the host-staged shm page blob otherwise (and
+        as the in-flight fallback whenever d2d fails).  Returns True when
+        the row left this engine (shipped, parked awaiting the importer's
+        ack, or requeued remotely); False means the caller finishes it
+        locally.
 
-        Ordering is crash-shaped: the fault site, the device export, and
-        the blob write all happen BEFORE any engine mutation, so a failure
-        there leaves the row intact and either requeues it for monolithic
-        prefill on a decode replica (greedy-identical replay) or falls back
-        to decoding right here."""
-        req = state.req
+        Ordering is crash-shaped: the fault site and all export work happen
+        BEFORE any engine mutation, so a failure there leaves the row
+        intact and either requeues it for monolithic prefill on a decode
+        replica (greedy-identical replay) or falls back to decoding right
+        here."""
         t0 = time.monotonic()
+        try:
+            # disagg.handoff ordinal 1 = mid-export crash (chaos matrix) —
+            # the hand-off seam itself, upstream of the transport choice.
+            faults.check("disagg.handoff")
+        except Exception as e:
+            self._disagg_handoff_failures += 1
+            serve_metrics.DISAGG_HANDOFFS.inc(
+                outcome="export_failed", transport=_disagg_transport())
+            state.req.handoff = None
+            log.warning("engine %s[%d]: hand-off export failed (%s); "
+                        "falling back to monolithic prefill",
+                        self.model_id, self.replica, e)
+            if self._requeue_monolithic(row, state):
+                return True
+            return False
+        if _disagg_transport() == "d2d":
+            if self._export_handoff_d2d(row, state, first, t0):
+                return True
+            # d2d failed before anything shipped: the row is intact, so the
+            # SAME hand-off re-stages through the host blob codec (the
+            # crash-safe fallback transport) — still greedy-identical.
+        return self._export_handoff_host(row, state, first, t0)
+
+    def _export_handoff_host(self, row: int, state: _Row, first: int,
+                             t0: float) -> bool:
+        """Host-staged transport: serialize the row's pages as a CRC-checked
+        shm page blob and hand the blob id to a decode replica.  The row
+        frees as soon as the sink accepts — the staged blob IS the
+        crash-safe copy, so there is nothing to ack."""
+        req = state.req
         blob_id = (f"{self.model_id}-{self.replica}-{id(req):x}"
                    f"-{self._dispatch}")
         try:
-            # disagg.handoff ordinal 1 = mid-export crash (chaos matrix).
-            faults.check("disagg.handoff")
             kv_len = int(state.prefilled)
             blob = self._kv.export_row_pages(row, kv_len)
             blob["first_token"] = int(first)
             checkpoint.save_page_blob(blob_id, blob)
         except Exception as e:
             self._disagg_handoff_failures += 1
-            serve_metrics.DISAGG_HANDOFFS.inc(outcome="export_failed")
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="export_failed",
+                                              transport="host")
             checkpoint.delete_page_blob(blob_id)
             req.handoff = None
             log.warning("engine %s[%d]: hand-off export failed (%s); "
@@ -1928,28 +2023,197 @@ class DecodeEngine:
         # THIS replica's radix tree, so a repeat of the prompt prefills warm
         # here regardless of where it decodes.
         self._register_prefix(row, state)
-        req.handoff = {"blob_id": blob_id, "kv_len": kv_len,
-                       "first_token": int(first), "t0": t0}
+        req.handoff = {"transport": "host", "blob_id": blob_id,
+                       "kv_len": kv_len, "first_token": int(first),
+                       "t0": t0}
         try:
             self._handoff_sink(req)
         except Exception as e:
             checkpoint.delete_page_blob(blob_id)
             req.handoff = None
             self._disagg_handoff_failures += 1
-            serve_metrics.DISAGG_HANDOFFS.inc(outcome="export_failed")
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="export_failed",
+                                              transport="host")
             log.warning("engine %s[%d]: hand-off placement failed (%s); "
                         "decoding locally", self.model_id, self.replica, e)
             return False
         self._disagg_exports += 1
+        serve_metrics.DISAGG_HANDOFF_BYTES.observe(
+            checkpoint.page_blob_nbytes(blob))
         trace = req.trace
         if trace is not None:
             trace.end(state.sp_prefill)
             state.sp_prefill = None
             trace.event("handoff_export", blob_id=blob_id, kv_len=kv_len,
-                        replica=self.replica)
+                        replica=self.replica, transport="host")
         self._free_handoff_row(row, state)
         self._ledger.audit("disagg.export")
         return True
+
+    def _export_handoff_d2d(self, row: int, state: _Row, first: int,
+                            t0: float) -> bool:
+        """d2d transport: gather the row's page planes as DEVICE arrays and
+        hand them to the importer in-process — no host serialize, no CRC,
+        no shm staging on the fast path.  On success the row does NOT free:
+        it parks with its pages under the ledger's ``transit`` state until
+        the importer acks (free-after-ack) — the source copy is the retry
+        capital, so a refused import re-stages the same hand-off host-side,
+        still greedy-identical because nothing was emitted.  Returns False
+        with the row untouched when the transport fails before the sink."""
+        req = state.req
+        try:
+            # disagg.d2d exporter-side ordinal (one per d2d hand-off; the
+            # importer-side check in _admit_handoff is the other).
+            faults.check("disagg.d2d")
+            kv_len = int(state.prefilled)
+            blob = self._kv.export_row_pages(row, kv_len, device=True)
+            blob["first_token"] = int(first)
+        except Exception as e:
+            self._disagg_handoff_failures += 1
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="export_failed",
+                                              transport="d2d")
+            log.warning("engine %s[%d]: d2d hand-off export failed (%s); "
+                        "re-staging through the host blob codec",
+                        self.model_id, self.replica, e)
+            return False
+        self._register_prefix(row, state)
+        req.handoff = {"transport": "d2d", "planes": blob, "kv_len": kv_len,
+                       "first_token": int(first), "t0": t0,
+                       "ack": self._make_ack(row)}
+        try:
+            self._handoff_sink(req)
+        except Exception as e:
+            req.handoff = None
+            self._disagg_handoff_failures += 1
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="export_failed",
+                                              transport="d2d")
+            log.warning("engine %s[%d]: d2d hand-off placement failed "
+                        "(%s); re-staging through the host blob codec",
+                        self.model_id, self.replica, e)
+            return False
+        self._disagg_exports += 1
+        serve_metrics.DISAGG_HANDOFF_BYTES.observe(
+            checkpoint.page_blob_nbytes(blob))
+        trace = req.trace
+        if trace is not None:
+            trace.end(state.sp_prefill)
+            state.sp_prefill = None
+            trace.event("handoff_export", kv_len=kv_len,
+                        replica=self.replica, transport="d2d")
+        # Free-after-ack: the pages stay owned (ledger state ``transit``)
+        # until the importer confirms the scatter landed.
+        with self._cond:
+            state.transit = True
+            self._transit_rows[row] = {"state": state, "first": int(first),
+                                       "t0": t0, "t": time.monotonic()}
+        return True
+
+    def _make_ack(self, row: int):
+        """Importer-side callback for a d2d hand-off: records the verdict
+        and wakes this (exporting) engine's worker, which frees the parked
+        source row (ok) or re-stages the hand-off host-side (refused) at
+        its next loop boundary.  Called from the importing engine's worker
+        thread; takes only this engine's lock, briefly."""
+        def ack(ok: bool):
+            with self._cond:
+                self._acks.append((row, bool(ok)))
+                self._cond.notify_all()
+        return ack
+
+    def _ack_overdue(self) -> bool:
+        deadline = _ack_timeout_s()
+        now = time.monotonic()
+        return any(now - e["t"] > deadline
+                   for e in self._transit_rows.values())
+
+    def _drain_acks(self):
+        """Exporter side of the d2d free-after-ack protocol, run at loop
+        boundaries (the only thread that may mutate rows): an acked row
+        frees; a refused one re-stages the SAME hand-off through the host
+        blob codec from the intact source pages (greedy parity — nothing
+        was emitted); an overdue one frees without touching the stream,
+        because the importer owns the request by then and has already
+        terminated it one way or the other."""
+        if not self._transit_rows and not self._acks:
+            return
+        with self._cond:
+            acks, self._acks = self._acks, []
+        for row, ok in acks:
+            entry = self._transit_rows.pop(row, None)
+            if entry is None or self._rows[row] is not entry["state"]:
+                continue
+            state = entry["state"]
+            state.transit = False
+            if ok:
+                self._free_handoff_row(row, state)
+                self._ledger.audit("disagg.export")
+                continue
+            # Failure already counted importer-side (import_failed/d2d);
+            # this side just re-sends from the intact source row.
+            log.warning("engine %s[%d]: d2d import refused for row %d; "
+                        "re-staging through the host blob codec",
+                        self.model_id, self.replica, row)
+            if not self._export_handoff_host(row, state, entry["first"],
+                                             entry["t0"]):
+                # No decode replica reachable: decode it right here.
+                self._finish_prefill_local(row, state, entry["first"])
+        deadline = _ack_timeout_s()
+        now = time.monotonic()
+        for row in [r for r, e in self._transit_rows.items()
+                    if now - e["t"] > deadline]:
+            entry = self._transit_rows.pop(row)
+            state = entry["state"]
+            if self._rows[row] is not state:
+                continue
+            state.transit = False
+            self._disagg_handoff_failures += 1
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="ack_timeout",
+                                              transport="d2d")
+            log.warning("engine %s[%d]: d2d hand-off ack overdue for row "
+                        "%d; releasing the parked source pages",
+                        self.model_id, self.replica, row)
+            self._free_handoff_row(row, state)
+            self._ledger.audit("disagg.export")
+
+    def request_role(self, role: str):
+        """Ask the worker to flip this replica's disaggregation role at its
+        next drain boundary (elastic rebalancing, serve/router.py).  The
+        flip waits for in-flight d2d exports to be acked; queued and
+        in-flight requests are untouched — only where FUTURE finished
+        prefills go changes, so a flipping prefill replica finishes its
+        rows locally and a flipping decode replica keeps decoding."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"unknown disaggregation role {role!r}")
+        with self._cond:
+            if role == self.role:
+                self._requested_role = None
+                return
+            self._requested_role = role
+            self._cond.notify_all()
+
+    def _maybe_flip_role(self):
+        """Apply a pending elastic role flip at a drain boundary: every
+        in-flight d2d export acked first, fault site BEFORE the mutation so
+        an injected ``disagg.rebalance`` crash cancels cleanly (role
+        registry consistent, strict ledger audit green) and the flip
+        retries at the next boundary."""
+        target = self._requested_role
+        if target is None:
+            return
+        if target == self.role:
+            self._requested_role = None
+            return
+        if self._transit_rows:
+            return
+        faults.check("disagg.rebalance")
+        with self._cond:
+            self.role = target
+            self._requested_role = None
+        self._disagg_role_changes += 1
+        serve_metrics.DISAGG_ROLE_CHANGES.inc()
+        self._ledger.audit("disagg.rebalance")
+        log.info("engine %s[%d]: role -> %s (elastic rebalance)",
+                 self.model_id, self.replica, target)
 
     def _requeue_monolithic(self, row: int, state: _Row) -> bool:
         """Export failed before anything shipped: push the request back
@@ -1974,6 +2238,15 @@ class DecodeEngine:
         self._ledger.audit("disagg.fallback")
         return True
 
+    def _abandon_import_row(self, row: int) -> None:
+        """Return a half-imported hand-off row to the pool (import failed
+        before anything was emitted)."""
+        self._rows[row] = None
+        self._lengths[row] = 0
+        self._last_tok[row] = 0
+        self._row_adapter[row] = self._max_live
+        self._kv = self._kv.reset_row(row)
+
     def _admit_handoff(self, row: int, req: Request, slot: int | None):
         """Decode replica: admit a hand-off arrival directly in the DECODE
         phase — import the staged page blob into the row's block table, emit
@@ -1984,6 +2257,7 @@ class DecodeEngine:
         ``transit`` so memledger snapshots attribute its pages honestly."""
         h = req.handoff
         req.handoff = None
+        transport = h.get("transport", "host")
         state = _Row(req)
         state.transit = True
         state.prefilling = False
@@ -1998,7 +2272,6 @@ class DecodeEngine:
         try:
             # disagg.handoff ordinal 2 = mid-import crash (chaos matrix).
             faults.check("disagg.handoff")
-            blob = checkpoint.load_page_blob(h["blob_id"])
             if not isinstance(self._kv, KV.PagedKVState):
                 raise RuntimeError("hand-off import needs a paged KV pool")
             kv_len = int(h["kv_len"])
@@ -2006,26 +2279,60 @@ class DecodeEngine:
             # the import's completion sees the pages under ``transit``.
             self._lengths[row] = kv_len
             state.prefilled = kv_len
-            self._kv = self._kv.import_row_pages(row, blob)
+            if transport == "d2d":
+                try:
+                    # disagg.d2d importer-side ordinal: transport failure
+                    # mid-device_put refuses the hand-off back to the
+                    # exporter, which re-stages through the host codec —
+                    # generic disagg.handoff failures (the outer except)
+                    # fall back to monolithic prefill instead.
+                    faults.check("disagg.d2d")
+                    self._kv = self._kv.import_row_pages(row, h["planes"])
+                except Exception as e:
+                    self._disagg_handoff_failures += 1
+                    serve_metrics.DISAGG_HANDOFFS.inc(
+                        outcome="import_failed", transport="d2d")
+                    self._abandon_import_row(row)
+                    if trace is not None:
+                        trace.event("handoff_import_failed", reason=str(e),
+                                    transport="d2d")
+                    self._ledger.audit("disagg.import_failed")
+                    log.warning("engine %s[%d]: d2d hand-off import failed "
+                                "(%s); refusing back to the exporter",
+                                self.model_id, self.replica, e)
+                    if h.get("ack") is not None:
+                        # Exporter still holds the source pages (free-
+                        # after-ack): the refusal makes it re-send host-
+                        # staged — greedy parity, nothing was emitted here.
+                        h["ack"](False)
+                    return
+            else:
+                blob = checkpoint.load_page_blob(h["blob_id"])
+                self._kv = self._kv.import_row_pages(row, blob)
             first = int(h["first_token"])
         except Exception as e:
             self._disagg_handoff_failures += 1
-            serve_metrics.DISAGG_HANDOFFS.inc(outcome="import_failed")
-            checkpoint.delete_page_blob(h["blob_id"])
-            self._rows[row] = None
-            self._lengths[row] = 0
-            self._last_tok[row] = 0
-            self._row_adapter[row] = self._max_live
-            self._kv = self._kv.reset_row(row)
+            serve_metrics.DISAGG_HANDOFFS.inc(outcome="import_failed",
+                                              transport=transport)
+            if transport == "host":
+                checkpoint.delete_page_blob(h["blob_id"])
+            self._abandon_import_row(row)
             if trace is not None:
-                trace.event("handoff_import_failed", reason=str(e))
+                trace.event("handoff_import_failed", reason=str(e),
+                            transport=transport)
             self._ledger.audit("disagg.import_failed")
+            if transport == "d2d" and h.get("ack") is not None:
+                # This replica keeps the request (monolithic re-prefill
+                # below), so the exporter's parked source pages are dead
+                # weight — ack success to release them.
+                h["ack"](True)
             log.warning("engine %s[%d]: hand-off import failed (%s); "
                         "re-prefilling monolithically",
                         self.model_id, self.replica, e)
             self._begin_prefill(row, req, slot)
             return
-        checkpoint.delete_page_blob(h["blob_id"])
+        if transport == "host":
+            checkpoint.delete_page_blob(h["blob_id"])
         state.transit = False
         self._last_tok[row] = first
         self._disagg_imports += 1
@@ -2050,10 +2357,14 @@ class DecodeEngine:
         handoff_ms = (time.monotonic() - h["t0"]) * 1000.0
         self._h_handoff.observe(handoff_ms)
         serve_metrics.DISAGG_HANDOFF_MS.observe(handoff_ms)
-        serve_metrics.DISAGG_HANDOFFS.inc(outcome="ok")
+        serve_metrics.DISAGG_HANDOFFS.inc(outcome="ok", transport=transport)
+        if transport == "d2d" and h.get("ack") is not None:
+            # Scatter landed: release the exporter's parked source pages.
+            h["ack"](True)
         if trace is not None:
             trace.event("handoff_import", kv_len=int(h["kv_len"]),
-                        handoff_ms=round(handoff_ms, 3))
+                        handoff_ms=round(handoff_ms, 3),
+                        transport=transport)
             state.sp_decode = trace.span("decode", ttft_ms=round(ttft_ms, 3))
         # The imported prompt's pages feed this replica's radix tree — the
         # router's fingerprint ledger points here now, so make it true.
@@ -2468,6 +2779,12 @@ class DecodeEngine:
         open_traces: list = []
         for i, state in enumerate(self._rows):
             if state is not None:
+                # A row parked awaiting a d2d import ack handed its request
+                # to the importing replica — release the source copy here
+                # WITHOUT touching the stream (the importer owns every
+                # terminal path for it now).
+                handed_off = (i in self._transit_rows
+                              and self._transit_rows[i]["state"] is state)
                 self._rows[i] = None
                 self._lengths[i] = 0
                 self._last_tok[i] = 0
@@ -2478,6 +2795,8 @@ class DecodeEngine:
                     # the failing thing; admission re-bases the row's table
                     # anyway (_begin_prefill), so only log.
                     log.exception("Failed to restore row %d block table", i)
+                if handed_off:
+                    continue
                 serve_metrics.REQUESTS.inc(outcome="error")
                 trace = state.req.trace
                 if trace is not None:
@@ -2490,6 +2809,8 @@ class DecodeEngine:
                         trace.finish("error")
                 self._deliver(state.req, "error", exc)
         with self._cond:
+            self._transit_rows.clear()
+            self._acks.clear()
             pending = self._pending.drain()
             if self._probe_inflight:
                 # The probe died with everything else: stay open and re-arm
@@ -2787,6 +3108,8 @@ def serving_stats() -> dict:
             p["disagg_handoff_failures"] for p in per),
         "disagg_handoff_ms_p50": _merged_q(per, "handoff_ms", 0.5),
         "disagg_handoff_ms_p99": _merged_q(per, "handoff_ms", 0.99),
+        "disagg_transport": _disagg_transport(),
+        "disagg_role_changes": sum(p["disagg_role_changes"] for p in per),
     }
 
 

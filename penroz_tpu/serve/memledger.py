@@ -469,7 +469,8 @@ def memory_stats() -> dict:
     pairs = _engine_snapshots()
     per = [dict(snap, model_id=e.model_id, block_size=e.block_size,
                 capacity=e.capacity, replica=getattr(e, "replica", 0),
-                role=getattr(e, "role", "decode"))
+                role=getattr(e, "role", "decode"),
+                disagg_transport=getattr(e, "disagg_transport", "d2d"))
            for e, snap in pairs]
     pool = {s: sum(p["pool_pages"][s] for p in per) for s in PAGE_STATES}
     tenant: dict = {}

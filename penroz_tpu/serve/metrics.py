@@ -20,7 +20,10 @@ counters   requests_total{outcome}, decode_tokens_total,
            lora_adapter_tokens_total{adapter_id}, traces_completed_total,
            dispatches_total, quota_rejections_total{tenant},
            class_admissions_total{priority}, tenant_tokens_total{tenant},
-           preemptions_total, preempted_resume_cached_tokens_total
+           preemptions_total, preempted_resume_cached_tokens_total,
+           router_affinity_total{outcome},
+           disagg_handoffs_total{outcome,transport},
+           disagg_role_changes_total
 gauges     engines, active_rows, queue_depth, batch_occupancy,
            breaker_open, draining, lora_live_adapters,
            kv_pool_capacity_drops, prefix_cache_unpin_underflow
@@ -35,7 +38,8 @@ histograms ttft_ms, itl_ms, queue_wait_ms, chunk_stall_ms, tick_ms
            the compiled multi-step decode headline), and the labeled
            QoS pair ttft_ms_by_class{priority} /
            queue_wait_ms_by_class{priority} (one series family per
-           SLO class)
+           SLO class), plus the disagg pair disagg_handoff_ms /
+           disagg_handoff_bytes (hand-off latency and payload size)
 """
 
 from __future__ import annotations
@@ -112,16 +116,24 @@ ROUTER_AFFINITY = REGISTRY.register(m.Counter(
     "penroz_router_affinity_total",
     "Replica-router placements of fingerprinted prompts: 'hit' landed on "
     "the replica whose prefix cache holds the prompt's pages, 'miss' "
-    "anywhere else", ("outcome",)))
+    "anywhere else, 'stale_role' an index entry aged out because its "
+    "replica became prefill-role (elastic rebalance)", ("outcome",)))
 ROUTER_FAILOVERS = REGISTRY.register(m.Counter(
     "penroz_router_failovers_total",
     "Admissions rerouted past a refusing replica (breaker open, queue "
     "full, draining) to a live sibling"))
 DISAGG_HANDOFFS = REGISTRY.register(m.Counter(
     "penroz_disagg_handoffs_total",
-    "Disaggregated-prefill page hand-offs by outcome: 'ok' (exported, "
-    "imported, decoding), 'export_failed' / 'import_failed' (fell back "
-    "to monolithic prefill on a decode replica)", ("outcome",)))
+    "Disaggregated-prefill page hand-offs by outcome and transport "
+    "('d2d' device-array hand-over, 'host' staged shm blob): 'ok' "
+    "(exported, imported, decoding), 'export_failed' / 'import_failed' "
+    "(fell back — d2d re-stages host-side, host falls back to "
+    "monolithic prefill), 'ack_timeout' (d2d importer never acked; "
+    "parked source pages reaped)", ("outcome", "transport")))
+DISAGG_ROLE_CHANGES = REGISTRY.register(m.Counter(
+    "penroz_disagg_role_changes_total",
+    "Elastic prefill/decode role flips applied by engines at drain "
+    "boundaries (PENROZ_DISAGG_ELASTIC=1)"))
 
 # -- histograms (engine observes the global mirror alongside its own) -------
 
@@ -151,7 +163,15 @@ QUEUE_WAIT_BY_CLASS = REGISTRY.register(m.Histogram(
 DISAGG_HANDOFF_MS = REGISTRY.register(m.Histogram(
     "penroz_disagg_handoff_ms",
     "Prefill-complete to decode-replica first token per hand-off, ms "
-    "(export + blob staging + router placement + import)"))
+    "(export + transport — d2d device hand-over or host blob staging — "
+    "+ router placement + import)"))
+DISAGG_HANDOFF_BYTES = REGISTRY.register(m.Histogram(
+    "penroz_disagg_handoff_bytes",
+    "KV payload per hand-off (page planes + int8 scale planes), bytes — "
+    "observed at export for both transports, so d2d and host-staged "
+    "size distributions compare directly",
+    buckets=(4096, 16384, 65536, 262144, 1048576, 4194304, 16777216,
+             67108864)))
 
 # -- gauges (scrape-time reads of live state) -------------------------------
 

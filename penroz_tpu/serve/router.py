@@ -51,6 +51,20 @@ fallback: if every prefill replica refuses, or a hand-off fails
 decode replica with greedy-identical output.  With the flag off the
 role split, the sinks, and the phase steering are all absent — routing
 is exactly the flat PR 14 policy above.
+
+**Elastic roles** (``PENROZ_DISAGG_ELASTIC=1``): instead of pinning the
+prefill pool size at startup, :meth:`EngineRouter.maybe_rebalance`
+(piggybacked on the submit path, cooldown-gated) compares the prefill
+backlog — queued prompt tokens across prefill replicas, the same
+``WFQueue.class_tokens`` signal placement already reads — against decode
+occupancy, and when the ratio crosses a hysteresis threshold
+(``PENROZ_DISAGG_REBALANCE_UP``/``_DOWN``) asks one replica to flip role
+within ``PENROZ_DISAGG_PREFILL_MIN``/``_MAX`` bounds (always ≥ 1 of each
+role).  The flip is applied by the ENGINE at a drain boundary
+(in-flight d2d exports acked first); placement reads live roles, and
+prefix-affinity entries pointing at a replica that became prefill-role
+age out on lookup (``outcome="stale_role"``) instead of steering decode
+traffic at it.
 """
 
 from __future__ import annotations
@@ -64,6 +78,7 @@ import time
 from penroz_tpu.ops import kv_cache as KV
 from penroz_tpu.serve import decode_scheduler as ds
 from penroz_tpu.serve import metrics as serve_metrics
+from penroz_tpu.serve import qos
 from penroz_tpu.serve.qos import TenantQuotaExceeded
 
 log = logging.getLogger(__name__)
@@ -72,6 +87,17 @@ AFFINITY_ENV = "PENROZ_ROUTER_AFFINITY"
 AFFINITY_INDEX_ENV = "PENROZ_ROUTER_AFFINITY_INDEX"
 DISAGG_ENV = "PENROZ_DISAGG_PREFILL"
 DISAGG_REPLICAS_ENV = "PENROZ_DISAGG_PREFILL_REPLICAS"
+DISAGG_ELASTIC_ENV = "PENROZ_DISAGG_ELASTIC"
+DISAGG_PREFILL_MIN_ENV = "PENROZ_DISAGG_PREFILL_MIN"
+DISAGG_PREFILL_MAX_ENV = "PENROZ_DISAGG_PREFILL_MAX"
+# Hysteresis thresholds over the prefill-backlog / decode-occupancy
+# ratio (queued prompt tokens per unit of decode-row occupancy): grow
+# the prefill pool above UP, shrink it below DOWN.  The gap between the
+# two is what keeps a workload hovering near one threshold from flapping
+# roles; COOLDOWN_MS bounds the flip rate outright.
+REBALANCE_UP_ENV = "PENROZ_DISAGG_REBALANCE_UP"
+REBALANCE_DOWN_ENV = "PENROZ_DISAGG_REBALANCE_DOWN"
+REBALANCE_COOLDOWN_ENV = "PENROZ_DISAGG_REBALANCE_COOLDOWN_MS"
 
 
 def _affinity_enabled() -> bool:
@@ -84,6 +110,10 @@ def _affinity_index_cap() -> int:
 
 def _disagg_requested() -> bool:
     return os.environ.get(DISAGG_ENV, "0") == "1"
+
+
+def _elastic_enabled() -> bool:
+    return os.environ.get(DISAGG_ELASTIC_ENV, "0") == "1"
 
 
 def _expected_roles(n: int) -> list:
@@ -124,9 +154,12 @@ class EngineRouter:
             engine = ds.DecodeEngine(model_id, block_size, temperature,
                                      top_k, replica=i, role=roles[i])
             engine._router_owned = True
-            if roles[i] == "prefill":
+            if self.disagg:
                 # Export seam: a prefill replica finishing a prompt hands
-                # the request here for decode-side placement.
+                # the request here for decode-side placement.  Installed on
+                # EVERY replica of a disaggregated group — an elastic flip
+                # can make any of them prefill-role, and the engine-side
+                # gate only exports while role == "prefill".
                 engine._handoff_sink = self._place_handoff
             with ds._REG_LOCK:
                 # Replicas live in the ONE engine registry under the group
@@ -140,7 +173,12 @@ class EngineRouter:
         self._affinity: collections.OrderedDict = collections.OrderedDict()
         self.affinity_hits = 0
         self.affinity_misses = 0
+        self.affinity_stale_roles = 0
         self.failovers = 0
+        # Elastic rebalancer bookkeeping (under _lock): last flip-request
+        # time (cooldown) and how many flips this router has asked for.
+        self._last_rebalance_t = 0.0
+        self.role_changes_requested = 0
 
     # -- prefix affinity ----------------------------------------------------
 
@@ -160,13 +198,24 @@ class EngineRouter:
 
     def _affinity_target(self, fps):
         """Longest-known-prefix lookup: the replica that last served the
-        deepest matching prefix holds the most reusable pages."""
+        deepest matching prefix holds the most reusable pages.  Under
+        disaggregation an entry pointing at a replica that has since
+        become prefill-role (elastic flip) is stale — decode traffic must
+        not steer at it — so it ages out here (``outcome="stale_role"``)
+        and the scan falls through to shorter prefixes."""
         with self._lock:
             for fp in reversed(fps):
                 idx = self._affinity.get(fp)
-                if idx is not None:
-                    self._affinity.move_to_end(fp)
-                    return idx
+                if idx is None:
+                    continue
+                if (self.disagg and idx < len(self.replicas)
+                        and self.replicas[idx].role != "decode"):
+                    del self._affinity[fp]
+                    self.affinity_stale_roles += 1
+                    serve_metrics.ROUTER_AFFINITY.inc(outcome="stale_role")
+                    continue
+                self._affinity.move_to_end(fp)
+                return idx
         return None
 
     def _remember(self, fps, idx: int):
@@ -226,9 +275,82 @@ class EngineRouter:
                 order.append(te)
         return order + probes + healthy + cooling
 
+    # -- elastic roles ------------------------------------------------------
+
+    @staticmethod
+    def _role_of(e) -> str:
+        """Effective role for rebalancing decisions: a pending flip counts
+        as already applied, so one burst cannot stack N flip requests on N
+        different replicas before the first one lands."""
+        return e._requested_role or e.role
+
+    @staticmethod
+    def _queued_tokens(e) -> int:
+        with e._cond:
+            return sum(e._pending.class_tokens(c) for c in qos.PRIORITIES)
+
+    def maybe_rebalance(self):
+        """Elastic prefill/decode split (``PENROZ_DISAGG_ELASTIC=1``):
+        compare the prefill backlog (queued prompt tokens across
+        prefill-role replicas) against decode occupancy and ask ONE
+        replica to flip role per call when the ratio crosses a hysteresis
+        threshold — grow the prefill pool on a prefill burst, hand
+        replicas back to decode as the backlog drains.  Bounded by
+        ``PENROZ_DISAGG_PREFILL_MIN``/``_MAX`` and ≥ 1 replica of each
+        role.  A flip is a REQUEST: the engine applies it at its next
+        drain boundary (``DecodeEngine._maybe_flip_role``), so this is
+        cheap enough to ride the submit path.  Returns the engine a flip
+        was requested on, or None."""
+        if not (self.disagg and _elastic_enabled()):
+            return None
+        now = time.monotonic()
+        cooldown_s = ds._env_float(REBALANCE_COOLDOWN_ENV, 2000.0) / 1000.0
+        with self._lock:
+            if now - self._last_rebalance_t < cooldown_s:
+                return None
+        live = [e for e in self.replicas if not e._shutdown]
+        prefill = [e for e in live if self._role_of(e) == "prefill"]
+        decode = [e for e in live if self._role_of(e) == "decode"]
+        if not prefill or not decode:
+            return None
+        backlog = sum(self._queued_tokens(e) for e in prefill)
+        occ = (sum(e.active_rows for e in decode)
+               / max(1, sum(e.capacity for e in decode)))
+        # Tokens queued per unit of decode occupancy; the floor keeps an
+        # idle decode pool from dividing by zero (any backlog over idle
+        # decode replicas reads as extreme prefill pressure, which it is).
+        ratio = backlog / max(occ, 1e-3)
+        up = ds._env_float(REBALANCE_UP_ENV, 4096.0)
+        down = ds._env_float(REBALANCE_DOWN_ENV, 64.0)
+        n = len(live)
+        lo = min(max(1, ds._env_int(DISAGG_PREFILL_MIN_ENV, 1)), n - 1)
+        hi = min(max(lo, ds._env_int(DISAGG_PREFILL_MAX_ENV, n - 1)), n - 1)
+        victim, target = None, None
+        if ratio > up and len(prefill) < hi and len(decode) > 1:
+            # Least-busy decode replica joins the prefill pool.
+            victim = min(decode, key=lambda e: (e.active_rows
+                                                + len(e._pending),
+                                                e.replica))
+            target = "prefill"
+        elif ratio < down and len(prefill) > lo:
+            # Emptiest prefill replica goes back to decoding.
+            victim = min(prefill, key=lambda e: (len(e._pending), e.replica))
+            target = "decode"
+        if victim is None:
+            return None
+        with self._lock:
+            self._last_rebalance_t = now
+            self.role_changes_requested += 1
+        log.info("router %s: elastic rebalance -> replica %d to %s "
+                 "(backlog=%d tokens, decode occupancy=%.2f)",
+                 self.model_id, victim.replica, target, backlog, occ)
+        victim.request_role(target)
+        return victim
+
     def submit(self, req):
         """Place ``req`` on a replica; raises only when every live replica
         refuses (the last refusal propagates, typed Retry-After intact)."""
+        self.maybe_rebalance()
         fps = self._fingerprints(req.prompt)
         target = self._affinity_target(fps) if fps else None
         order = self._candidates(req, target)
@@ -307,6 +429,21 @@ _ROUTERS: dict = {}
 _ROUTER_LOCK = threading.Lock()
 
 
+def _roles_ok(router: EngineRouter, n: int) -> bool:
+    """A cached router's role vector is still valid: exactly the expected
+    startup split, or — under elastic disaggregation — any drifted split
+    the rebalancer produced (both roles still represented; the bounds are
+    the rebalancer's own invariant).  Disagg toggling, replica-count
+    changes, and a collapsed role set still force a rebuild."""
+    roles = [e.role for e in router.replicas]
+    expected = _expected_roles(n)
+    if roles == expected:
+        return True
+    return (_elastic_enabled() and router.disagg
+            and "prefill" in expected
+            and "prefill" in roles and "decode" in roles)
+
+
 def get_router(model_id, block_size, temperature, top_k) -> EngineRouter:
     """Lookup/create the replica group's router (the get_engine of the
     replicated world).  A router whose replica count no longer matches
@@ -318,7 +455,7 @@ def get_router(model_id, block_size, temperature, top_k) -> EngineRouter:
     with _ROUTER_LOCK:
         router = _ROUTERS.get(key)
         if (router is not None and len(router.replicas) == n
-                and [e.role for e in router.replicas] == _expected_roles(n)
+                and _roles_ok(router, n)
                 and not any(e._shutdown for e in router.replicas)):
             return router
         router = EngineRouter(model_id, block_size, temperature, top_k, n)

@@ -326,6 +326,14 @@ class EngineStats(BaseModel):
         "token per hand-off (export + blob staging + placement + import)")
     disagg_handoff_ms_p99: Optional[float] = Field(
         None, description="p99 hand-off latency")
+    disagg_transport: str = Field(
+        "d2d", description="Hand-off transport in effect "
+        "(PENROZ_DISAGG_TRANSPORT): 'd2d' hands device arrays across "
+        "meshes via jax.device_put, 'host' stages a CRC-checked shm "
+        "page blob; d2d falls back to host per hand-off on failure")
+    disagg_role_changes: int = Field(
+        0, description="Elastic role flips this engine applied at drain "
+        "boundaries (PENROZ_DISAGG_ELASTIC=1)")
     active_rows: int
     queue_depth: int
     occupancy: float = Field(..., description="active_rows / capacity now")
@@ -612,6 +620,13 @@ class ServingStatsResponse(BaseModel):
         "(merged histogram buckets)")
     disagg_handoff_ms_p99: Optional[float] = Field(
         None, description="p99 hand-off latency across engines")
+    disagg_transport: str = Field(
+        "d2d", description="Hand-off transport in effect "
+        "(PENROZ_DISAGG_TRANSPORT): 'd2d' device-array hand-over, "
+        "'host' staged shm page blob")
+    disagg_role_changes: int = Field(
+        0, description="Aggregate elastic role flips applied across "
+        "engines (PENROZ_DISAGG_ELASTIC=1)")
 
 
 class MemoryEngineEntry(EngineMemory):
@@ -628,6 +643,9 @@ class MemoryEngineEntry(EngineMemory):
     role: str = Field("decode", description="Disaggregated-prefill role "
                       "of this replica ('prefill' | 'decode'; 'decode' "
                       "when disaggregation is off)")
+    disagg_transport: str = Field(
+        "d2d", description="Hand-off transport in effect for this "
+        "replica (PENROZ_DISAGG_TRANSPORT: 'd2d' | 'host')")
 
 
 class MemoryResponse(BaseModel):

@@ -304,10 +304,13 @@ function renderServing(data) {
     .map((e) => `r${e.replica}:${(e.role || "decode")[0].toUpperCase()}`)
     .join(" ");
   const handoffP99 = data.disagg_handoff_ms_p99;
+  const roleChanges = data.disagg_role_changes || 0;
   const disaggTxt = prefillReplicas === 0 ? "disagg off"
-    : `disagg ${roleChips} · handoffs ${data.disagg_imports || 0} ` +
+    : `disagg ${roleChips} · ${data.disagg_transport || "d2d"} · ` +
+      `handoffs ${data.disagg_imports || 0} ` +
       `(${data.disagg_handoff_failures || 0} failed) · handoff p99 ` +
-      `${handoffP99 == null ? "—" : handoffP99.toFixed(0) + "ms"}`;
+      `${handoffP99 == null ? "—" : handoffP99.toFixed(0) + "ms"}` +
+      `${roleChanges ? ` · flips ${roleChanges}` : ""}`;
   meta.textContent =
     `rows ${data.active_rows}/${data.capacity} (occupancy ` +
     `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +

@@ -522,6 +522,19 @@ def delete_page_blob(blob_id: str) -> bool:
     return _remove_quietly(page_blob_path(blob_id))
 
 
+def page_blob_nbytes(blob: dict) -> int:
+    """Payload size of a hand-off blob: the KV page planes (+ int8 scale
+    planes) it carries, summed over layers.  Works on host arrays (staged
+    blob codec) and device arrays (d2d transport) alike — the
+    ``penroz_disagg_handoff_bytes`` histogram observes through here for
+    both, so the two transports' size distributions are comparable."""
+    total = 0
+    for key in ("k", "v", "k_scale", "v_scale"):
+        for plane in blob.get(key, ()):
+            total += int(plane.nbytes)
+    return total
+
+
 def save(model_id: str, data: dict, sync_flush: bool = False):
     """Write checkpoint to shm and flush to disk in the background.
 

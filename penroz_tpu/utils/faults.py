@@ -32,7 +32,15 @@ path, serve/decode_scheduler.py — crash-during-preemption recovery),
 ``disagg.handoff`` (disaggregated-prefill page hand-off: fired once on
 the prefill replica's export and once on the decode replica's import, so
 ``raise@1`` crashes mid-export and ``raise@2`` crashes mid-import —
-both must fall back to monolithic prefill with greedy parity).
+both must fall back to monolithic prefill with greedy parity),
+``disagg.d2d`` (the device-to-device transport specifically: fired once
+in the exporter's device-array hand-over and once in the importer's
+re-shard+scatter — a failure at either end must fall back to the
+host-staged blob for that hand-off, same greedy parity),
+``disagg.rebalance`` (elastic role flip at an engine drain boundary,
+fired before any mutation — a crash must leave the role registry
+consistent and the memledger audit clean, with the flip retried at the
+next boundary).
 Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.
 """

@@ -141,6 +141,20 @@ while true; do
           -- "BENCH_DISAGG_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) disaggregated-prefill capture committed" >> logs/bench_watch.log
     fi
+    # D2D hand-off + elastic-roles capture (same shape as the
+    # shared-prefix hook): hand-off p50/p99 host vs d2d transport, plus
+    # prefill-burst -> decode-burst ITL elastic vs pinned with role-flip
+    # evidence.  Opt-in; failures must not block the main capture.
+    if [ "${PENROZ_WATCH_D2D:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_D2D_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --disagg-elastic \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_D2D_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: d2d hand-off + elastic-roles capture" \
+          -- "BENCH_D2D_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) d2d hand-off capture committed" >> logs/bench_watch.log
+    fi
     # Capacity-ledger capture (same shape as the shared-prefix hook):
     # ledger on/off ITL delta + mixed-tenant /memory/ attribution under
     # PENROZ_MEMLEDGER_STRICT=1.  Opt-in; failures must not block the

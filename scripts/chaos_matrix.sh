@@ -90,6 +90,53 @@ if [ "${CHAOS_FAST:-0}" != "1" ]; then
       fail=1
     fi
   done
+
+  # disagg.d2d (PR 16): the device-to-device transport specifically —
+  # ordinal 3 crashes an exporter-side device hand-over, ordinal 4 an
+  # importer-side re-shard+scatter.  Both must fall back to the
+  # host-staged blob for THAT hand-off (not monolithic prefill) with
+  # greedy parity and a clean strict ledger.
+  for at in ${CHAOS_D2D_ATS:-3 4}; do
+    ran=$((ran + 1))
+    echo "=== chaos: site=disagg.d2d at=$at replicas=2 disagg=1 ===" >&2
+    out=$(PENROZ_BENCH_CHAOS_SITE=disagg.d2d PENROZ_BENCH_CHAOS_AT="$at" \
+            PENROZ_DISAGG_PREFILL=1 PENROZ_SCHED_REPLICAS=2 \
+            PENROZ_RAGGED_ATTENTION=1 PENROZ_MEMLEDGER_STRICT=1 \
+            timeout 900 python scripts/bench_serving.py --chaos)
+    rc=$?
+    echo "$out"
+    if [ "$rc" -ne 0 ]; then
+      echo "FAIL site=disagg.d2d at=$at rc=$rc" >&2
+      fail=1
+      continue
+    fi
+    if ! printf '%s' "$out" | python -c \
+        'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") else 1)'; then
+      echo "FAIL site=disagg.d2d at=$at: disallowed statuses or parity break" >&2
+      fail=1
+    fi
+  done
+
+  # disagg.rebalance (PR 16): crash the first elastic role-flip attempt
+  # (the bench arms elastic together with the fault, so flip #1 runs
+  # armed).  The crash must recover with the role registry consistent
+  # and the flip applied on retry — the bench's ok gate plus role
+  # evidence in its disagg_role_changes field.
+  ran=$((ran + 1))
+  echo "=== chaos: site=disagg.rebalance at=1 replicas=3 elastic=1 ===" >&2
+  out=$(PENROZ_BENCH_CHAOS_SITE=disagg.rebalance PENROZ_BENCH_CHAOS_AT=1 \
+          PENROZ_RAGGED_ATTENTION=1 PENROZ_MEMLEDGER_STRICT=1 \
+          timeout 900 python scripts/bench_serving.py --chaos)
+  rc=$?
+  echo "$out"
+  if [ "$rc" -ne 0 ]; then
+    echo "FAIL site=disagg.rebalance rc=$rc" >&2
+    fail=1
+  elif ! printf '%s' "$out" | python -c \
+      'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") and r.get("disagg_role_changes", 0) > 0 else 1)'; then
+    echo "FAIL site=disagg.rebalance: disallowed statuses, parity break, or no role flip" >&2
+    fail=1
+  fi
 fi
 
 if [ "$fail" -ne 0 ]; then

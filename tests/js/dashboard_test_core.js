@@ -219,11 +219,13 @@ async function runDashboardTests(src, fixtures) {
     assertOk(servingMeta.includes(
                `disagg r${fixtures.serving.engines[0].replica}:` +
                fixtures.serving.engines[0].role[0].toUpperCase() +
-               ` · handoffs ${fixtures.serving.disagg_imports} ` +
+               ` · ${fixtures.serving.disagg_transport} · ` +
+               `handoffs ${fixtures.serving.disagg_imports} ` +
                `(${fixtures.serving.disagg_handoff_failures} failed) · ` +
                "handoff p99 " +
-               fixtures.serving.disagg_handoff_ms_p99.toFixed(0) + "ms"),
-             "serving tile shows disagg role chips + hand-off health");
+               fixtures.serving.disagg_handoff_ms_p99.toFixed(0) + "ms" +
+               ` · flips ${fixtures.serving.disagg_role_changes}`),
+             "serving tile shows disagg transport, role chips, flips");
     const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
     assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];

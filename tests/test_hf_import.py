@@ -733,7 +733,7 @@ def _tiny_olmo(clip_qkv=None):
     return config, OlmoForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("clip_qkv", [None, 0.5])
+@pytest.mark.parametrize("clip_qkv", [None, pytest.param(0.5, marks=pytest.mark.slow)])
 def test_olmo_import_logit_parity(workdir, clip_qkv):
     """OLMo v1: NON-PARAMETRIC LayerNorms (no weights to map at all) and
     optional clip_qkv (fused QKV output clamped to ±clip via the clamp
@@ -780,7 +780,7 @@ def _tiny_stablelm(use_qkv_bias=True):
     return config, StableLmForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("use_qkv_bias", [True, False])
+@pytest.mark.parametrize("use_qkv_bias", [True, pytest.param(False, marks=pytest.mark.slow)])
 def test_stablelm_import_logit_parity_and_generate(workdir, use_qkv_bias):
     """StableLM: llama-shaped blocks with LayerNorm (weight+bias) norms,
     partial rotary, qkv bias on and off (the DSL bias flag is config-
@@ -881,7 +881,7 @@ def _tiny_falcon(new_arch=False):
     return config, FalconForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("new_arch", [False, True])
+@pytest.mark.parametrize("new_arch", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_falcon_import_logit_parity_and_generate(workdir, new_arch):
     """Falcon, both decoder architectures: 7B-style MQA with one shared
     input_layernorm feeding parallel branches, and 40B-style GQA with
@@ -937,7 +937,7 @@ def _tiny_bigcode(multi_query=True):
     return config, GPTBigCodeForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("multi_query", [True, False])
+@pytest.mark.parametrize("multi_query", [True, pytest.param(False, marks=pytest.mark.slow)])
 def test_bigcode_import_logit_parity_and_generate(workdir, multi_query):
     """GPT-BigCode (StarCoder): the GPT-2 structure with multi-query
     attention — the MQA-fused c_attn is already our [q; k; v] layout —
@@ -981,7 +981,7 @@ def _tiny_phi3(partial_rotary_factor=1.0):
     return config, Phi3ForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("partial_rotary_factor", [1.0, 0.5])
+@pytest.mark.parametrize("partial_rotary_factor", [pytest.param(1.0, marks=pytest.mark.slow), 0.5])
 def test_phi3_import_logit_parity_and_generate(workdir,
                                                partial_rotary_factor):
     """Phi-3: llama block structure with PRE-FUSED projections — qkv_proj
@@ -1166,7 +1166,7 @@ def _tiny_mpt(clip_qkv=None):
     return config, MptForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("clip_qkv", [None, 4.0])
+@pytest.mark.parametrize("clip_qkv", [None, pytest.param(4.0, marks=pytest.mark.slow)])
 def test_mpt_import_logit_parity_and_generate(workdir, clip_qkv):
     """MPT: ALiBi (MPT's slope·(k−T+1) absolute form is softmax-shift-
     equivalent to our slope·(k−q)), weight-only LayerNorms, bias-free
@@ -1230,7 +1230,7 @@ def _tiny_qwen2_moe(norm_topk=False):
     return config, Qwen2MoeForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("norm_topk", [False, True])
+@pytest.mark.parametrize("norm_topk", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_qwen2_moe_import_logit_parity_and_generate(workdir, norm_topk):
     """Qwen2-MoE: fine-grained routed experts (norm_topk_prob both ways —
     the default False keeps raw softmax mass on the selected experts)

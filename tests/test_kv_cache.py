@@ -767,3 +767,55 @@ def test_export_import_row_pages_roundtrip_across_pools(cls):
     with pytest.raises(ValueError, match="quant"):
         other.create(specs, batch=2, max_len=8, page_size=4) \
             .with_static_table().import_row_pages(0, blob)
+
+
+@pytest.mark.parametrize("device", [False, True])
+@pytest.mark.parametrize("cls", [KV.PagedKVState, KV.QuantPagedKVState])
+@pytest.mark.parametrize("length,pages", [(3, 1), (8, 2), (11, 3)])
+def test_export_import_row_pages_property(cls, length, pages, device):
+    """Hand-off codec property, both transports: the host-gathered blob
+    (``device=False``, the crash-safe staged format) and the device-array
+    hand-over (``device=True``, the d2d transport) round-trip EXACTLY —
+    page counts {1 partial, full-page boundary, multi-page} × fp32/int8
+    (scale planes ride along), destination row != source row, destination
+    pool a different object than the source pool."""
+    import jax
+    from penroz_tpu.utils import checkpoint
+    specs = [(1, 4), (1, 4)]
+    src = cls.create(specs, batch=2, max_len=16, page_size=4) \
+        .with_static_table().with_lengths([0, 0])
+    view = src.row_view(0, 0)
+    rng = np.random.default_rng(length)
+    k = jnp.asarray(rng.normal(size=(1, 1, length, 4)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, 1, length, 4)).astype(np.float32))
+    for layer in range(len(specs)):
+        view.append_rows(layer, k, v)
+    src = src.merge_row(0, view.advanced(length))
+    blob = src.export_row_pages(0, length, device=device)
+    assert blob["pages"] == pages and blob["length"] == length
+    planes = [*blob["k"], *blob["v"],
+              *blob.get("k_scale", ()), *blob.get("v_scale", ())]
+    kind = jax.Array if device else np.ndarray
+    assert all(isinstance(p, kind) for p in planes), [type(p) for p in planes]
+    assert checkpoint.page_blob_nbytes(blob) == \
+        sum(int(p.nbytes) for p in planes) > 0
+    dst = cls.create(specs, batch=2, max_len=16, page_size=4) \
+        .with_static_table().with_lengths([0, 0])
+    dst = dst.import_row_pages(1, blob)
+    for layer in range(len(specs)):
+        for field in ("k", "v"):
+            src_read = np.asarray(
+                src._gather(getattr(src, field)[layer]), np.float32)
+            dst_read = np.asarray(
+                dst._gather(getattr(dst, field)[layer]), np.float32)
+            np.testing.assert_array_equal(dst_read[1, :, :length],
+                                          src_read[0, :, :length])
+    if cls is KV.QuantPagedKVState:
+        S, P = src.pages_per_seq, src.page_size
+        for layer in range(len(specs)):
+            np.testing.assert_array_equal(
+                np.asarray(dst.k_scale[layer])[:, S * P:S * P + pages * P],
+                np.asarray(src.k_scale[layer])[:, 0:pages * P])
+    # the destination's untouched row stays zero — no bleed past the scatter
+    assert float(np.abs(np.asarray(
+        dst._gather(dst.k[0]), np.float32)[0, :, :length]).max()) == 0.0

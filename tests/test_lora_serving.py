@@ -113,7 +113,8 @@ def _submit(engine, prompt, max_new, adapter=None):
 @pytest.mark.parametrize("prefix_cache", [False, True],
                          ids=["nocache", "prefix"])
 @pytest.mark.parametrize("spec", [False, True], ids=["nospec", "spec"])
-@pytest.mark.parametrize("chunked", [False, True],
+@pytest.mark.parametrize("chunked", [pytest.param(False, marks=pytest.mark.slow),
+                                     True],
                          ids=["oneshot", "chunked"])
 def test_mixed_adapter_parity_matrix(gpt_model, tenants, make_engine,
                                      monkeypatch, prefix_cache, spec,

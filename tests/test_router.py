@@ -245,18 +245,21 @@ def _assert_no_transit_or_blob_leaks():
     assert blobs == [], blobs
 
 
+@pytest.mark.parametrize("transport", ["d2d", "host"])
 @pytest.mark.parametrize("int8", [False, True])
 @pytest.mark.parametrize("prefix", [False, True])
 @pytest.mark.parametrize("superstep", ["1", "8"])
 def test_router_disagg_greedy_parity_matrix(gpt_model, monkeypatch, int8,
-                                            prefix, superstep):
+                                            prefix, superstep, transport):
     """Tentpole acceptance: disaggregated prefill is token-identical to the
-    legacy single-engine path across int8 KV × prefix-cache × superstep —
-    and every request provably travelled the export → import seam (no
-    silent monolithic fallback)."""
+    legacy single-engine path across int8 KV × prefix-cache × superstep ×
+    hand-off transport (d2d device arrays / host-staged blob) — and every
+    request provably travelled the export → import seam (no silent
+    monolithic fallback)."""
     from penroz_tpu.serve import decode_scheduler
     from penroz_tpu.serve import router as router_mod
     _disagg_env(monkeypatch, prefix=prefix)
+    monkeypatch.setenv(decode_scheduler.DISAGG_TRANSPORT_ENV, transport)
     if int8:
         monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
     monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, superstep)
@@ -284,7 +287,10 @@ def test_router_disagg_greedy_parity_matrix(gpt_model, monkeypatch, int8,
     assert stats["disagg_exports"] == len(prompts)
     assert stats["disagg_imports"] == len(prompts)
     assert stats["disagg_handoff_ms_p99"] is not None
+    assert stats["disagg_transport"] == transport
     assert [e["role"] for e in stats["engines"]] == ["prefill", "decode"]
+    assert all(e["disagg_transport"] == transport
+               for e in stats["engines"])
     _assert_no_transit_or_blob_leaks()
 
 
@@ -350,6 +356,158 @@ def test_router_disagg_drain_finishes_inflight_export(gpt_model,
     assert r0.stats()["disagg_exports"] == 1
     assert collector.result() == base
     assert r1.stats()["disagg_imports"] == 1
+
+
+@pytest.mark.parametrize("ordinal,phase", [(1, "export"), (2, "import")])
+def test_router_disagg_d2d_fault_falls_back_to_host_transport(
+        gpt_model, monkeypatch, ordinal, phase):
+    """disagg.d2d transport failure at either end — the exporter's device
+    gather (@1) or the importer's re-shard+scatter (@2, which refuses the
+    hand-off back so the exporter re-sends from its parked source pages) —
+    falls back to the host-staged blob codec FOR THAT HAND-OFF: greedy
+    parity, the import still lands, and neither a transit page nor a
+    staged blob outlives the request."""
+    from penroz_tpu.utils import faults
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv(faults.ENV, f"disagg.d2d:raise@{ordinal}")
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    router = _get_router(monkeypatch, n=2)
+    assert _submit(router, prompt, 5).result() == base
+    per = [e.stats() for e in router.replicas]
+    assert sum(p["disagg_imports"] for p in per) == 1, phase
+    assert sum(p["disagg_handoff_failures"] for p in per) == 1, phase
+    # the hand-off ultimately shipped host-side and decoded remotely
+    assert per[0]["completed"] == 0 and per[1]["completed"] == 1
+    _assert_no_transit_or_blob_leaks()
+
+
+def test_router_disagg_d2d_midstream_fallback_parity(gpt_model,
+                                                     monkeypatch):
+    """Acceptance: a d2d failure in the MIDDLE of a hand-off stream
+    downgrades only THAT hand-off to the host codec — its neighbours stay
+    d2d, every output is greedy-identical, and nothing leaks."""
+    from penroz_tpu.utils import faults
+    _disagg_env(monkeypatch)
+    # Sequential submits make the site ordinals deterministic: calls 1+2
+    # are hand-off A's export+import, call 3 is hand-off B's exporter-side
+    # device gather (fails -> host re-stage, no importer d2d call), calls
+    # 4+5 are hand-off C back on the fast path.
+    monkeypatch.setenv(faults.ENV, "disagg.d2d:raise@3")
+    prompts = [[1, 2, 3, 4, 5], [6, 7, 8], [9, 10, 11, 12]]
+    bases = [gpt_model.generate_tokens([p], BLOCK, 5, temperature=0.0)
+             for p in prompts]
+    router = _get_router(monkeypatch, n=2)
+    for prompt, base in zip(prompts, bases):
+        assert _submit(router, prompt, 5).result() == base
+    per = [e.stats() for e in router.replicas]
+    assert sum(p["disagg_exports"] for p in per) == len(prompts)
+    assert sum(p["disagg_imports"] for p in per) == len(prompts)
+    assert sum(p["disagg_handoff_failures"] for p in per) == 1
+    _assert_no_transit_or_blob_leaks()
+
+
+# ---------------------------------------------------------------------------
+# Elastic roles (PENROZ_DISAGG_ELASTIC=1)
+# ---------------------------------------------------------------------------
+
+def _wait_for_roles(router, want, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if sorted(e.role for e in router.replicas) == sorted(want):
+            return
+        time.sleep(0.005)
+    raise AssertionError([e.role for e in router.replicas])
+
+
+def test_router_affinity_stale_role_entry_ages_out(gpt_model, monkeypatch):
+    """Affinity-index hygiene satellite: a fingerprint entry pointing at a
+    replica that has since flipped to prefill-role is deleted on lookup
+    (outcome="stale_role") instead of steering decode traffic at it — the
+    repeat prompt still completes, on a replica that actually decodes."""
+    from penroz_tpu.serve import metrics as serve_metrics
+    _disagg_env(monkeypatch)
+    router = _get_router(monkeypatch, n=3)
+    assert [e.role for e in router.replicas] == \
+        ["prefill", "decode", "decode"]
+    shared = [1, 2, 3, 4, 5, 6, 7, 8]        # two full pages
+    base = gpt_model.generate_tokens([shared + [9]], BLOCK, 5,
+                                     temperature=0.0)
+    assert _submit(router, shared + [9], 5).result() == base
+    with router._lock:
+        warm_idx = set(router._affinity.values())
+    assert warm_idx and all(i in (1, 2) for i in warm_idx)
+    victim = router.replicas[min(warm_idx)]
+    victim_done = victim.stats()["completed"]
+    victim.request_role("prefill")           # the elastic flip, applied by
+    _wait_for_roles(router, ["prefill", "prefill", "decode"])  # the worker
+    before = serve_metrics.ROUTER_AFFINITY.value(outcome="stale_role")
+    assert _submit(router, shared + [10], 5).result() == \
+        gpt_model.generate_tokens([shared + [10]], BLOCK, 5, temperature=0.0)
+    assert router.affinity_stale_roles >= 1
+    assert serve_metrics.ROUTER_AFFINITY.value(outcome="stale_role") > before
+    with router._lock:                        # the index self-cleaned
+        assert victim.replica not in set(router._affinity.values())
+    # the repeat prompt decoded elsewhere — the stale target got nothing
+    assert victim.stats()["completed"] == victim_done
+    _assert_no_transit_or_blob_leaks()
+
+
+def test_router_elastic_shrink_flips_idle_prefill_to_decode(gpt_model,
+                                                            monkeypatch):
+    """Elastic rebalance, shrink direction: with the backlog/occupancy
+    ratio parked below PENROZ_DISAGG_REBALANCE_DOWN, the submit-path
+    rebalancer asks the emptiest prefill replica to flip to decode; the
+    engine applies it at a drain boundary, the counters record it, and the
+    cached router survives the drifted role vector (no rebuild)."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.serve import metrics as serve_metrics
+    from penroz_tpu.serve import router as router_mod
+    _disagg_env(monkeypatch, prefill_replicas="2")
+    monkeypatch.setenv(router_mod.DISAGG_ELASTIC_ENV, "1")
+    monkeypatch.setenv(router_mod.REBALANCE_COOLDOWN_ENV, "0")
+    monkeypatch.setenv(router_mod.REBALANCE_DOWN_ENV, "1000000000")
+    router = _get_router(monkeypatch, n=3)
+    assert [e.role for e in router.replicas] == \
+        ["prefill", "prefill", "decode"]
+    before = serve_metrics.DISAGG_ROLE_CHANGES.value()
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    base = gpt_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    assert _submit(router, prompt, 5).result() == base
+    assert router.role_changes_requested >= 1
+    _wait_for_roles(router, ["prefill", "decode", "decode"])
+    stats = decode_scheduler.serving_stats()
+    assert stats["disagg_role_changes"] >= 1
+    assert serve_metrics.DISAGG_ROLE_CHANGES.value() > before
+    # PENROZ_DISAGG_PREFILL_MIN floor: never flips the last prefill away
+    assert "prefill" in [e.role for e in router.replicas]
+    assert decode_scheduler.get_engine("schedgpt", BLOCK, 0.0, None) \
+        is router
+    _assert_no_transit_or_blob_leaks()
+
+
+def test_engine_role_flip_chaos_retries_and_audits_clean(gpt_model,
+                                                         monkeypatch):
+    """disagg.rebalance crash mid-flip: the fault fires BEFORE the
+    mutation, so the role registry stays consistent through crash
+    recovery, the strict ledger audit is green, and the flip retries at
+    the next drain boundary (grow direction, at the engine seam)."""
+    from penroz_tpu.serve import metrics as serve_metrics
+    from penroz_tpu.utils import faults
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv(faults.ENV, "disagg.rebalance:raise@1")
+    router = _get_router(monkeypatch, n=2)
+    r0, r1 = router.replicas
+    assert [r0.role, r1.role] == ["prefill", "decode"]
+    before = serve_metrics.DISAGG_ROLE_CHANGES.value()
+    r1.request_role("prefill")
+    _wait_for_roles(router, ["prefill", "prefill"])
+    assert r1.stats()["disagg_role_changes"] == 1
+    assert serve_metrics.DISAGG_ROLE_CHANGES.value() == before + 1
+    assert r1._requested_role is None
+    _assert_no_transit_or_blob_leaks()
+    r1.request_role("decode")                # restore the startup split
+    _wait_for_roles(router, ["prefill", "decode"])
 
 
 def test_router_disagg_prefill_breakers_open_decode_serves_monolithic(

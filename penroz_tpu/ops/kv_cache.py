@@ -1116,6 +1116,58 @@ class PagedKVState(KVState):
                  for a, s in zip(out.v, blob["v"])]
         return out
 
+    def _page_pool_rows(self, pages):
+        """Flat pool-row indices of an explicit physical page list (host
+        op) — the page-granular sibling of :meth:`_export_pool_rows`, for
+        pages that have no row block table (radix-cache pages)."""
+        return (np.asarray(list(pages), np.int64)[:, None] * self.page_size
+                + np.arange(self.page_size)).reshape(-1)
+
+    def export_pages(self, pages, length, device: bool = False) -> dict:
+        """Gather an explicit physical page list into an
+        :meth:`export_row_pages`-shaped blob — the hibernation export:
+        radix-cache pages are pool-resident but belong to no row, so there
+        is no block table to resolve through.  ``pages`` must be
+        position-ordered (root → leaf) for the blob to replay as a prefix.
+        Eager op; ``length`` is the token count the pages cover."""
+        pool_rows = self._page_pool_rows(pages)
+        gather = ((lambda a: a[:, pool_rows]) if device
+                  else (lambda a: np.asarray(a[:, pool_rows])))
+        return {"page_size": self.page_size, "pages": len(pages),
+                "length": int(length),
+                "quantized": bool(getattr(self, "quantized", False)),
+                "k": [gather(a) for a in self.k],
+                "v": [gather(a) for a in self.v]}
+
+    def import_pages(self, pages, blob: dict, blob_offset: int = 0):
+        """Scatter an :meth:`export_pages`/:meth:`export_row_pages` blob
+        into an explicit physical page list — the promotion import: the
+        destination pages are freshly ``insert()``-created radix slots, so
+        unlike :meth:`import_row_pages` there is no row whose table needs
+        restoring.  ``blob_offset`` skips leading blob pages (a partially
+        radix-resident session only promotes the tail blocks ``insert``
+        newly created); a blob longer than ``blob_offset + len(pages)``
+        is fine — the surplus just stays hibernated.  Eager op."""
+        P = self.page_size
+        if int(blob["page_size"]) != P:
+            raise ValueError(f"page blob page_size {blob['page_size']} != "
+                             f"pool page_size {P}")
+        if bool(blob["quantized"]) != bool(getattr(self, "quantized", False)):
+            raise ValueError("page blob quantization does not match pool")
+        n = len(pages)
+        off = int(blob_offset)
+        if off + n > int(blob["pages"]):
+            raise ValueError(f"import of pages [{off}, {off + n}) exceeds "
+                             f"blob pages={blob['pages']}")
+        lo, hi = off * P, (off + n) * P
+        pool_rows = self._page_pool_rows(pages)
+        out = self._with_length(self.length)
+        out.k = [a.at[:, pool_rows].set(self._import_operand(s[:, lo:hi], a))
+                 for a, s in zip(self.k, blob["k"])]
+        out.v = [a.at[:, pool_rows].set(self._import_operand(s[:, lo:hi], a))
+                 for a, s in zip(self.v, blob["v"])]
+        return out
+
     def _row_bytes(self) -> int:
         """Bytes per token row summed over every layer's K and V pool."""
         return sum(a.shape[0] * a.shape[2] * a.dtype.itemsize
@@ -1324,6 +1376,28 @@ class QuantPagedKVState(PagedKVState):
         out.v_scale = [jax.lax.dynamic_update_slice(
                            a, self._import_operand(s, a), (zero, start, zero))
                        for a, s in zip(out.v_scale, blob["v_scale"])]
+        return out
+
+    def export_pages(self, pages, length, device: bool = False) -> dict:
+        out = super().export_pages(pages, length, device=device)
+        pool_rows = self._page_pool_rows(pages)
+        gather = ((lambda a: a[:, pool_rows]) if device
+                  else (lambda a: np.asarray(a[:, pool_rows])))
+        out["k_scale"] = [gather(a) for a in self.k_scale]
+        out["v_scale"] = [gather(a) for a in self.v_scale]
+        return out
+
+    def import_pages(self, pages, blob: dict, blob_offset: int = 0):
+        out = super().import_pages(pages, blob, blob_offset=blob_offset)
+        P = self.page_size
+        lo, hi = int(blob_offset) * P, (int(blob_offset) + len(pages)) * P
+        pool_rows = self._page_pool_rows(pages)
+        out.k_scale = [a.at[:, pool_rows].set(
+                           self._import_operand(s[:, lo:hi], a))
+                       for a, s in zip(self.k_scale, blob["k_scale"])]
+        out.v_scale = [a.at[:, pool_rows].set(
+                           self._import_operand(s[:, lo:hi], a))
+                       for a, s in zip(self.v_scale, blob["v_scale"])]
         return out
 
     def _row_bytes(self) -> int:

@@ -22,6 +22,7 @@ import gzip
 import json
 import logging
 import os
+import re
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict
 
@@ -341,7 +342,8 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
             tokens = await decode_scheduler.run_request(
                 engine, prompt, body.max_new_tokens, body.stop_token,
                 body.timeout_ms, adapter=adapter, request_id=rid,
-                trace=trace, priority=body.priority, tenant=body.tenant)
+                trace=trace, priority=body.priority, tenant=body.tenant,
+                session_id=body.session_id)
             return _json({"tokens": tokens})
         log.info("Streaming token generation for model %s via the "
                  "continuous-batching scheduler", body.model_id)
@@ -350,7 +352,8 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
         req, queue = decode_scheduler.start_stream(
             engine, prompt, body.max_new_tokens, body.stop_token,
             body.timeout_ms, adapter=adapter, request_id=rid, trace=trace,
-            priority=body.priority, tenant=body.tenant)
+            priority=body.priority, tenant=body.tenant,
+            session_id=body.session_id)
     except decode_scheduler.CircuitOpenError as exc:
         if trace is not None:
             trace.finish("breaker_open")
@@ -554,6 +557,31 @@ async def _resolve_batch_adapters(body):
     return [entries.get(aid) for aid in row_ids], entries
 
 
+_SESSION_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,120}$")
+
+
+def _batch_session_ids(body, n: int) -> list:
+    """Per-row session ids for /generate_batch/ (``session_ids``, null =
+    no session), validated with the same pattern as
+    ``GenerateRequest.session_id`` — the id names a disk-tier blob file,
+    so path-safe characters only.  ValueError → 400 (all-or-nothing,
+    like adapter_ids)."""
+    if body.session_ids is None:
+        return [None] * n
+    if len(body.session_ids) != n:
+        raise ValueError(
+            f"session_ids has {len(body.session_ids)} entries for "
+            f"{n} input row(s); pass one per row (null = no session)")
+    bad = [i for i, sid in enumerate(body.session_ids)
+           if sid is not None and not _SESSION_ID_RE.match(sid)]
+    if bad:
+        raise ValueError(
+            "batched generation rejected: invalid session_id at row(s) "
+            + ", ".join(str(i) for i in bad[:8])
+            + " (allowed: [A-Za-z0-9._-]{1,120})")
+    return list(body.session_ids)
+
+
 async def model_generate_batch(request: web.Request):
     """Ragged batched generation — N prompts share one forward per step
     (beyond the reference surface; its /generate/ is single-sequence).
@@ -597,6 +625,7 @@ async def _model_generate_batch_inner(request, body, row_entries):
             # Per-row traces under suffixed ids (rid-r0, rid-r1, ...): each
             # row has its own scheduler lifecycle, so each gets its own
             # span tree; shed rows are finished in the error sweep below.
+            sids = _batch_session_ids(body, len(prompts))
             rows = [(f"{rid}-r{i}",
                      tracing.maybe_trace(f"{rid}-r{i}",
                                          route="/generate_batch/",
@@ -607,9 +636,9 @@ async def _model_generate_batch_inner(request, body, row_entries):
                     engine, p, body.max_new_tokens, body.stop_token,
                     body.timeout_ms, adapter=entry, request_id=row_rid,
                     trace=row_trace, priority=body.priority,
-                    tenant=body.tenant)
-                for (p, entry, (row_rid, row_trace))
-                in zip(prompts, row_entries, rows)],
+                    tenant=body.tenant, session_id=sid)
+                for (p, entry, sid, (row_rid, row_trace))
+                in zip(prompts, row_entries, sids, rows)],
                 return_exceptions=True)
             reason_of = {
                 decode_scheduler.QueueFullError: "queue_full",
@@ -820,12 +849,42 @@ async def put_tenant_quota(request: web.Request):
         raise ValueError("tokens_per_s must be >= 0 (or null to clear "
                          "the override)")
     qos.QUOTAS.set_rate(tenant_id, body.tokens_per_s)
+    if "tier_mb" in body.model_fields_set:
+        if body.tier_mb is not None and body.tier_mb < 0:
+            raise ValueError("tier_mb must be >= 0 (or null to clear "
+                             "the override)")
+        qos.QUOTAS.set_tier_mb(tenant_id, body.tier_mb)
     log.info("Tenant %s quota %s", tenant_id,
              "cleared (env default)" if body.tokens_per_s is None
              else f"set to {body.tokens_per_s} tokens/s")
     return _json({"tenant": tenant_id,
                   "tokens_per_s": qos.QUOTAS.rate_for(tenant_id),
-                  "override": body.tokens_per_s is not None})
+                  "override": body.tokens_per_s is not None,
+                  "tier_bytes": qos.QUOTAS.tier_bytes_for(tenant_id)})
+
+
+async def list_sessions(request: web.Request):
+    """Hibernated-session residency (GET /sessions/): every session
+    parked in the KV tiers (serve/tierstore.py), across all engines and
+    replicas — tier, size, and LRU age per session."""
+    from penroz_tpu.serve import tierstore
+    sessions = tierstore.TIERS.list_sessions()
+    return _json({"sessions": sessions,
+                  "sessions_resident": len(sessions),
+                  "sessions_by_tier": tierstore.TIERS.sessions_by_tier(),
+                  "tier_bytes": tierstore.TIERS.tier_bytes()})
+
+
+async def delete_session(request: web.Request):
+    """Evict one hibernated session from every tier (DELETE
+    /sessions/{session_id}).  Idempotent: deleting a non-resident id is
+    a 200 with deleted=false."""
+    from penroz_tpu.serve import tierstore
+    sid = request.match_info["session_id"]
+    deleted = tierstore.TIERS.drop(sid, "api")
+    log.info("Session %s %s", sid,
+             "evicted from the KV tiers" if deleted else "not resident")
+    return _json({"session_id": sid, "deleted": deleted})
 
 
 async def list_tenants(request: web.Request):
@@ -1112,6 +1171,8 @@ def create_app() -> web.Application:
     app.router.add_get("/debug/dump", debug_dump)
     app.router.add_get("/tenants/", list_tenants)
     app.router.add_put("/tenants/{tenant_id}/quota", put_tenant_quota)
+    app.router.add_get("/sessions/", list_sessions)
+    app.router.add_delete("/sessions/{session_id}", delete_session)
     app.router.add_post("/adapters/", create_adapter)
     app.router.add_get("/adapters/", list_adapters)
     app.router.add_delete("/adapters/", delete_adapter)

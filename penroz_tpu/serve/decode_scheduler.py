@@ -166,6 +166,7 @@ from penroz_tpu.serve import memledger
 from penroz_tpu.serve import metrics as serve_metrics
 from penroz_tpu.serve import qos
 from penroz_tpu.serve import spec_decode
+from penroz_tpu.serve import tierstore
 from penroz_tpu.serve.qos import TenantQuotaExceeded  # noqa: F401 — re-export
 from penroz_tpu.utils import bucketing, checkpoint, faults, profiling
 from penroz_tpu.utils import metrics as metrics_util
@@ -401,11 +402,11 @@ class Request:
                  "enqueue_t", "cancelled", "deadline", "adapter",
                  "request_id", "trace", "priority", "tenant",
                  "resume_history", "resume_produced", "resume_nodes",
-                 "preempted", "handoff")
+                 "preempted", "handoff", "session_id")
 
     def __init__(self, prompt, max_new_tokens, stop_token, on_event,
                  timeout_ms=None, adapter=None, request_id=None,
-                 trace=None, priority=None, tenant=None):
+                 trace=None, priority=None, tenant=None, session_id=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.stop_token = stop_token
@@ -435,6 +436,10 @@ class Request:
         # the decode replica consumes it at admission (import path) and the
         # request was already quota-admitted on the prefill side.
         self.handoff = None
+        # Session hibernation (serve/tierstore.py): a retirement carrying a
+        # session id parks the row's full prompt+generated KV in the tier
+        # store instead of letting it die with the row.
+        self.session_id = session_id
         # utils/tracing.py: request_id is the X-Request-Id correlation
         # key; trace (None when sampled out / tracing off) records the
         # lifecycle span tree — every recording site below is None-guarded
@@ -454,7 +459,7 @@ class _Row:
     __slots__ = ("req", "produced", "finished", "prefilling", "prefilled",
                  "chunks", "chunk_idx", "prefix_nodes", "history",
                  "last_emit_t", "sp_prefill", "sp_decode", "admit_t",
-                 "resumed", "transit")
+                 "resumed", "transit", "session_wake")
 
     def __init__(self, req):
         self.req = req
@@ -486,6 +491,10 @@ class _Row:
         # Hand-off import in flight: the row's pages are owned but not yet
         # decode-visible — the memledger attributes them to ``transit``.
         self.transit = False
+        # Admission matched a hibernated session (radix-resident pages or
+        # a host/disk-tier promotion): first token observes the
+        # session-resume TTFT histogram alongside the plain one.
+        self.session_wake = False
 
 
 class DecodeEngine:
@@ -651,6 +660,13 @@ class DecodeEngine:
         self._disagg_handoff_failures = 0
         self._h_handoff = metrics_util.Hist()
 
+        # Session hibernation accounting (serve/tierstore.py): lifetime
+        # hibernations and tier promotions this engine performed, plus the
+        # enqueue→first-token distribution of session-resume admissions.
+        self._sessions_hibernated = 0
+        self._session_promotions = 0
+        self._h_resume_ttft = metrics_util.Hist()
+
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"penroz-sched-{model_id}-{self.block_size}")
@@ -686,6 +702,14 @@ class DecodeEngine:
         self._lengths[:] = 0
         self._last_tok[:] = 0
         self._rows = [None] * self.capacity
+        # Hibernation holds: session_id -> pinned radix node chain whose
+        # pages the ledger counts ``hibernating`` until the background
+        # demotion exports them.  A reallocation killed the pool those
+        # pages lived in, so the holds die here and the tier store drops
+        # the matching tier-"hbm" records (host/disk copies survive).
+        self._hib_holds: dict = {}
+        self._hib_pending: collections.deque = collections.deque()
+        tierstore.TIERS.drop_owner(id(self), "engine_reset")
         # Adapter row tables rebuild with the rest of the engine state:
         # after a crash nothing about the old slot assignment is trusted —
         # every row re-parks on the base slot and the stacked pack drops
@@ -821,6 +845,11 @@ class DecodeEngine:
             log.error("Decode engine %s: worker thread failed to join "
                       "within %.1fs (leaked)", self.model_id, timeout)
             return False
+        # HBM-tier session records die with the engine's pool; demoted
+        # host/disk copies survive and wake on the next engine (restart or
+        # another replica) via the content-addressed match.
+        self._drop_hib_holds()
+        tierstore.TIERS.drop_owner(id(self), "engine_shutdown")
         return True
 
     @property
@@ -887,6 +916,7 @@ class DecodeEngine:
                     c: h.snapshot()
                     for c, h in self._h_queue_wait_cls.items()},
                 "handoff_ms": self._h_handoff.snapshot(),
+                "session_resume_ttft_ms": self._h_resume_ttft.snapshot(),
             },
             "superstep": _superstep_max(),
             "dispatches_total": self._dispatches,
@@ -943,6 +973,12 @@ class DecodeEngine:
             "disagg_handoff_ms_p99": self._round_q(self._h_handoff, 0.99),
             "disagg_transport": _disagg_transport(),
             "disagg_role_changes": self._disagg_role_changes,
+            "sessions_hibernated": self._sessions_hibernated,
+            "session_promotions": self._session_promotions,
+            "session_resume_ttft_ms_p50": self._round_q(
+                self._h_resume_ttft, 0.5),
+            "session_resume_ttft_ms_p99": self._round_q(
+                self._h_resume_ttft, 0.99),
             "active_rows": active,
             "queue_depth": self.queue_depth,
             "occupancy": active / self.capacity,
@@ -991,6 +1027,7 @@ class DecodeEngine:
             with self._cond:
                 while (not self._shutdown and not self._pending
                        and not self._acks and self._requested_role is None
+                       and not self._hib_pending
                        and self.active_rows == len(self._transit_rows)):
                     # Untimed wait: every state change the predicate reads
                     # notifies (submit, shutdown, drain, hand-off ack, role
@@ -1015,6 +1052,10 @@ class DecodeEngine:
                 self._coalesce_burst()
                 self._admit()
                 self._tick()
+                # Background demotion AFTER the tick: hibernated pages
+                # spill down a tier only once live traffic has been
+                # served this iteration (the hot path never exports).
+                self._process_demotions()
             except Exception as exc:  # noqa: BLE001 — fail requests, not thread
                 log.exception("Decode engine %s failed a tick", self.model_id)
                 # Count the crash, then postmortem BEFORE _fail_all /
@@ -1745,6 +1786,19 @@ class DecodeEngine:
             nodes = self._prefix_cache.match(eff_prompt,
                                              limit=len(eff_prompt) - 1,
                                              namespace=self._prefix_ns(req))
+            # Promote-on-match: a hibernated session whose KV covers MORE
+            # of this prompt than the radix cache does imports its blob
+            # pages into fresh radix slots, then aliases like a normal hit.
+            try:
+                nodes = self._promote_session(state, req, eff_prompt, nodes)
+            except BaseException:
+                # Mid-admission failure (tier.promote fault, import error):
+                # the request is already off the queue but not yet in
+                # _rows — park the partly-built row so crash recovery's
+                # _fail_all fails ITS waiter too instead of orphaning the
+                # client on a request that no longer exists anywhere.
+                self._rows[row] = state
+                raise
             if nodes:
                 self._prefix_cache.pin(nodes)
                 state.prefix_nodes = nodes
@@ -1800,6 +1854,72 @@ class DecodeEngine:
             state.sp_prefill = trace.span(
                 "prefill", prompt_tokens=len(eff_prompt),
                 cached_tokens=state.prefilled, chunks=len(state.chunks))
+
+    def _promote_session(self, state: _Row, req: Request, eff_prompt,
+                         nodes: list) -> list:
+        """Wake a hibernated session for this admission (serve/tierstore.py).
+
+        Content-addressed: the prompt's page fingerprints are matched
+        against the tier store regardless of whether the request carries a
+        ``session_id``, so a session hibernated on ANOTHER replica — or
+        before an engine restart — wakes here too.  Outcomes:
+
+        - radix already covers the session's depth → HBM-fast wake, no
+          import (``penroz_tier_promotions_total{tier="hbm"}``);
+        - host/disk blob → ``insert()`` fresh radix slots for the blocks
+          the cache lacks and scatter the blob's pages into them
+          (``import_pages``), then re-walk the chain — the caller pins
+          and aliases it exactly like a plain radix hit;
+        - corrupt/vanished blob → counted + dropped by the store's
+          ``fetch``; the admission recomputes (never wrong tokens).
+
+        The ``tier.promote`` fault site fires before any mutation: a
+        crash mid-wake fails the tick, ``_alloc_state`` rebuilds, and the
+        retried admission recomputes from scratch at greedy parity."""
+        if (req.adapter is not None
+                or self._prefix_cache is None
+                or not isinstance(self._kv, KV.PagedKVState)
+                or not tierstore.TIERS.resident_sessions()):
+            return nodes
+        P = self._prefix_cache.page_size
+        rec, depth = tierstore.TIERS.match(
+            eff_prompt, model_id=self.model_id,
+            model_stamp=self._ckpt_stamp_v, page_size=P,
+            quantized=bool(getattr(self._kv, "quantized", False)))
+        if rec is None:
+            return nodes
+        if depth <= len(nodes):
+            # The session's pages are still radix-resident (demoted but
+            # not yet LRU-evicted, or hibernating on this very engine).
+            state.session_wake = True
+            tierstore.TIERS.note_promotion("hbm", "ok")
+            return nodes
+        if rec.tier == "hbm":
+            # Hibernated on another replica whose background demotion has
+            # not run yet — the pages exist only in that engine's pool.
+            return nodes
+        sid, tier = rec.session_id, rec.tier
+        faults.check("tier.promote")
+        blob = tierstore.TIERS.fetch(sid)
+        if blob is None:
+            return nodes
+        created = self._prefix_cache.insert(eff_prompt, limit=depth * P,
+                                            namespace=None)
+        if created:
+            self._kv = self._kv.import_pages(
+                [page for _, page in created], blob,
+                blob_offset=created[0][0])
+        out = self._prefix_cache.chain(eff_prompt,
+                                       limit=len(eff_prompt) - 1,
+                                       namespace=None)
+        state.session_wake = True
+        self._session_promotions += 1
+        tierstore.TIERS.note_promotion(
+            tier, "ok" if len(out) >= depth else "partial")
+        if req.trace is not None:
+            req.trace.event("session_promote", session_id=sid, tier=tier,
+                            imported_pages=len(created), depth_pages=depth)
+        return out
 
     def _next_prefill_row(self):
         """FIFO over prefilling rows (earliest enqueue first) so chunk
@@ -1917,6 +2037,12 @@ class DecodeEngine:
             serve_metrics.TTFT_MS.observe(ttft_ms)
             serve_metrics.TTFT_BY_CLASS.observe(
                 ttft_ms, priority=state.req.priority)
+            if state.session_wake:
+                # Hibernated-session wake: the same TTFT also lands in the
+                # resume histogram so the warm-vs-cold comparison reads
+                # straight off /metrics.
+                self._h_resume_ttft.observe(ttft_ms)
+                serve_metrics.SESSION_RESUME_TTFT_MS.observe(ttft_ms)
         trace = state.req.trace
         if trace is not None:
             trace.end(state.sp_prefill)
@@ -2714,9 +2840,121 @@ class DecodeEngine:
                                 dropped_tokens=dropped)
             self._retire(row, reason="pool_capacity")
 
+    # -- session hibernation (KV tiering, serve/tierstore.py) ---------------
+
+    _HIBERNATE_REASONS = ("stop_token", "max_new_tokens", "pool_capacity")
+
+    def _maybe_hibernate(self, row: int, state, reason: str):
+        """At retirement, park a session-tagged request's full prompt+
+        generated KV in the radix cache and register it with the tier
+        store.  The pages stay pinned under ``_hib_holds`` until the
+        worker-loop demotion pass exports them to the host tier — the
+        retire hot path never serializes KV.  Mirrors ``_preempt_row``:
+        insert + copy_pages + chain + pin, all while the row's pool pages
+        are still live."""
+        if state is None:
+            return
+        req = state.req
+        sid = req.session_id
+        if (sid is None or reason not in self._HIBERNATE_REASONS
+                or req.adapter is not None
+                or self._prefix_cache is None
+                or not isinstance(self._kv, KV.PagedKVState)):
+            return
+        P = self._prefix_cache.page_size
+        pages = int(self._lengths[row]) // P
+        if pages <= 0:
+            return
+        kv_len = pages * P
+        created = self._prefix_cache.insert(state.history, limit=kv_len,
+                                            namespace=None)
+        if created:
+            S = self._kv.pages_per_seq
+            self._kv = self._kv.copy_pages(
+                [row * S + b for b, _ in created],
+                [page for _, page in created])
+        nodes = self._prefix_cache.chain(state.history, limit=kv_len,
+                                         namespace=None)
+        if len(nodes) * P < kv_len:
+            # Radix allocation exhausted mid-insert: a partial blob cannot
+            # resume correctly, so skip hibernation (the cached prefix
+            # remains a plain radix entry).
+            return
+        ok = tierstore.TIERS.register(
+            sid, tenant=req.tenant, model_id=self.model_id,
+            model_stamp=self._ckpt_stamp_v,
+            tokens=tuple(state.history[:kv_len]), kv_len=kv_len,
+            page_size=P,
+            quantized=bool(getattr(self._kv, "quantized", False)),
+            nbytes=kv_len * self._kv._row_bytes(),
+            owner=id(self), replica=self.replica)
+        if not ok:
+            # Tenant tier quota refused the session — nothing was pinned
+            # on its behalf, the radix entry just ages out by LRU.
+            return
+        # A re-registered session id replaces the old record; tierstore
+        # drops it, and the demotion pass below releases any stale hold.
+        old = self._hib_holds.pop(sid, None)
+        if old is not None:
+            self._prefix_cache.unpin(old["nodes"])
+        self._prefix_cache.pin(nodes)
+        self._hib_holds[sid] = {"nodes": nodes, "kv_len": kv_len}
+        self._hib_pending.append(sid)
+        self._sessions_hibernated += 1
+        if req.trace is not None:
+            req.trace.event("session_hibernate", session_id=sid,
+                            kv_len=kv_len, pages=pages)
+        with self._cond:
+            self._cond.notify_all()
+
+    def _process_demotions(self):
+        """Worker-loop tail: spill one pending hibernated session per tick
+        from HBM to the host tier (export happens here, off the admission/
+        decode hot path).  The radix copy stays resident and evictable —
+        an early resume is an HBM-fast wake; LRU pressure reclaims it
+        naturally once unpinned.  Crash-safe: ``tier.demote`` fires before
+        any mutation and a crash fails the tick → ``_alloc_state`` clears
+        holds and drops this engine's hbm-tier records."""
+        if not self._hib_pending:
+            return
+        sid = self._hib_pending.popleft()
+        hold = self._hib_holds.pop(sid, None)
+        if hold is None:
+            return
+        rec = tierstore.TIERS.get(sid)
+        if rec is None or rec.tier != "hbm" or rec.owner != id(self):
+            # Deleted via the API (or replaced) while awaiting demotion:
+            # just release the pin, the pages age out of the radix cache.
+            self._prefix_cache.unpin(hold["nodes"])
+            return
+        faults.check("tier.demote")
+        blob = self._kv.export_pages([n.page for n in hold["nodes"]],
+                                     hold["kv_len"])
+        tierstore.TIERS.demote_to_host(sid, blob)
+        self._prefix_cache.unpin(hold["nodes"])
+        # Demotion hands pages from a pinned hold back to plain cache
+        # residency while a host copy appears — prove the books balanced.
+        if memledger.strict():
+            self._ledger.audit("tier.demote")
+
+    def _drop_hib_holds(self):
+        """Release every pending hibernation pin (reload/shutdown): the
+        prefix cache is about to be cleared or abandoned, so no hold may
+        outlive it.  HBM-tier records die with their owner."""
+        if self._prefix_cache is not None:
+            for hold in self._hib_holds.values():
+                try:
+                    self._prefix_cache.unpin(hold["nodes"])
+                except Exception:  # noqa: BLE001 — teardown must not throw
+                    log.exception("Failed to unpin hibernation hold")
+        self._hib_holds = {}
+        self._hib_pending.clear()
+
     def _retire(self, row: int, notify: bool = True,
                 reason: str = "completed"):
         state = self._rows[row]
+        if state is not None:
+            self._maybe_hibernate(row, state, reason)
         self._rows[row] = None
         self._lengths[row] = 0
         self._last_tok[row] = 0
@@ -2846,7 +3084,13 @@ class DecodeEngine:
             if self._prefix_cache is not None:
                 # Cached prefix K/V was computed with the OLD weights; a hit
                 # against the new ones would silently mix models.  Zero rows
-                # are in flight here, so nothing is pinned.
+                # are in flight here, so nothing is pinned — except pending
+                # hibernation holds, whose HBM pages are about to vanish:
+                # release them and drop this engine's hbm-tier records
+                # (demoted host/disk copies stay, but their stale model
+                # stamp makes every future match drop them).
+                self._drop_hib_holds()
+                tierstore.TIERS.drop_owner(id(self), "model_reload")
                 self._prefix_cache.clear()
             # Same contract for adapters (the prefix-cache-flush mirror):
             # the live slots and the host registry cache hold factors
@@ -3003,6 +3247,7 @@ def serving_stats() -> dict:
     from penroz_tpu.serve import router as router_mod
     router = router_mod.stats_totals()
     router_lookups = router["affinity_hits"] + router["affinity_misses"]
+    tiers = tierstore.TIERS.stats()
     with _REG_LOCK:
         engines = [e for e in _ENGINES.values() if not e._shutdown]
     per = [e.stats() for e in engines]
@@ -3110,6 +3355,22 @@ def serving_stats() -> dict:
         "disagg_handoff_ms_p99": _merged_q(per, "handoff_ms", 0.99),
         "disagg_transport": _disagg_transport(),
         "disagg_role_changes": sum(p["disagg_role_changes"] for p in per),
+        # KV tiering / session hibernation (serve/tierstore.py): the
+        # store is process-wide (shared across engines and replicas), so
+        # residency/tier fields come from it directly; the counters below
+        # it are per-engine sums like everything else here.
+        "sessions_resident": tiers["sessions_resident"],
+        "sessions_by_tier": tiers["sessions_by_tier"],
+        "tier_bytes": tiers["tier_bytes"],
+        "tier_promotions": tiers["tier_promotions"],
+        "tier_demotions": tiers["tier_demotions"],
+        "tier_corrupt_blobs": tiers["tier_corrupt_blobs"],
+        "sessions_hibernated": sum(p["sessions_hibernated"] for p in per),
+        "session_promotions": sum(p["session_promotions"] for p in per),
+        "session_resume_ttft_ms_p50": _merged_q(per, "session_resume_ttft_ms",
+                                                0.5),
+        "session_resume_ttft_ms_p99": _merged_q(per, "session_resume_ttft_ms",
+                                                0.99),
     }
 
 
@@ -3134,7 +3395,7 @@ async def acquire_engine(model_id, block_size, temperature, top_k):
 
 def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None,
                    adapter=None, request_id=None, trace=None,
-                   priority=None, tenant=None):
+                   priority=None, tenant=None, session_id=None):
     loop = asyncio.get_running_loop()
     queue: asyncio.Queue = asyncio.Queue()
 
@@ -3144,13 +3405,14 @@ def _async_request(prompt, max_new_tokens, stop_token, timeout_ms=None,
     return (Request(prompt, max_new_tokens, stop_token, on_event,
                     timeout_ms=timeout_ms, adapter=adapter,
                     request_id=request_id, trace=trace,
-                    priority=priority, tenant=tenant), queue)
+                    priority=priority, tenant=tenant,
+                    session_id=session_id), queue)
 
 
 async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
                       stop_token, timeout_ms=None, adapter=None,
                       request_id=None, trace=None, priority=None,
-                      tenant=None) -> list[int]:
+                      tenant=None, session_id=None) -> list[int]:
     """Submit one request and await the full sequence (prompt + generated,
     the ``generate_tokens`` contract).  Raises DeadlineExceeded /
     QueueFullError / CircuitOpenError on the shed paths; an aiohttp client
@@ -3162,10 +3424,11 @@ async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
     scheduler (utils/tracing.py); the scheduler finishes the trace at
     retirement, the caller finishes it on shed paths.
     ``priority``/``tenant`` are the QoS routing fields (WFQ class +
-    quota bucket)."""
+    quota bucket).  ``session_id`` tags the request for KV hibernation at
+    retirement (serve/tierstore.py)."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
                                 timeout_ms, adapter, request_id, trace,
-                                priority, tenant)
+                                priority, tenant, session_id)
     engine.submit(req)
     tokens = list(req.prompt)
     try:
@@ -3184,7 +3447,7 @@ async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
 
 def start_stream(engine: DecodeEngine, prompt, max_new_tokens, stop_token,
                  timeout_ms=None, adapter=None, request_id=None,
-                 trace=None, priority=None, tenant=None):
+                 trace=None, priority=None, tenant=None, session_id=None):
     """Submit a streaming request; returns ``(req, queue)`` so the HTTP
     layer can consume events AND flip ``req.cancelled`` itself when the
     client goes away mid-stream (a write failure is invisible to an async
@@ -3192,6 +3455,6 @@ def start_stream(engine: DecodeEngine, prompt, max_new_tokens, stop_token,
     disconnect wiring)."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
                                 timeout_ms, adapter, request_id, trace,
-                                priority, tenant)
+                                priority, tenant, session_id)
     engine.submit(req)
     return req, queue

@@ -65,6 +65,7 @@ import time
 import jax
 
 from penroz_tpu.ops import kv_cache as KV
+from penroz_tpu.serve import tierstore
 
 log = logging.getLogger(__name__)
 
@@ -74,9 +75,11 @@ DUMP_RING_ENV = "PENROZ_DEBUG_DUMP_RING"
 DUMP_TICKS_ENV = "PENROZ_DEBUG_DUMP_TICKS"
 
 #: Every paged-pool page is in exactly one of these states; their sum is
-#: the pool capacity (the audited invariant).
+#: the pool capacity (the audited invariant).  ``hibernating`` = radix
+#: pages pinned by a session hold awaiting tier demotion
+#: (decode_scheduler._hib_holds → serve/tierstore.py).
 PAGE_STATES = ("free", "row", "prefix_pinned", "prefix_evictable",
-               "preempted", "reserved", "transit")
+               "preempted", "reserved", "transit", "hibernating")
 
 #: Fixed keys of the per-engine byte ledger (``hbm_bytes``); the
 #: aggregate adds ``adapter_host_cache`` (process-wide, host RAM).
@@ -188,6 +191,14 @@ class MemoryLedger:
                 pages.add(nd.page)
         return pages
 
+    def _hib_pages(self) -> set:
+        """Pages pinned by session-hibernation holds awaiting demotion."""
+        pages: set = set()
+        for hold in getattr(self._engine, "_hib_holds", {}).values():
+            for nd in hold["nodes"]:
+                pages.add(nd.page)
+        return pages
+
     def snapshot(self) -> dict:
         """Derive the full ownership map from the authoritative engine
         structures.  Consistent when called from the worker thread or
@@ -227,7 +238,8 @@ class MemoryLedger:
                     aid = state.req.adapter.adapter_id
                     adapter_pages[aid] = adapter_pages.get(aid, 0) + owned
             cache = e._prefix_cache
-            pinned = evictable = preempted = reserved = 0
+            hib_pages = self._hib_pages()
+            pinned = evictable = preempted = reserved = hibernating = 0
             cache_pages = 0
             if cache is not None:
                 cache_pages = cache.capacity_pages
@@ -235,6 +247,8 @@ class MemoryLedger:
                 for nd in cache.iter_nodes():
                     if nd.page in resume_pages:
                         preempted += 1
+                    elif nd.page in hib_pages:
+                        hibernating += 1
                     elif nd.refs > 0:
                         pinned += 1
                     else:
@@ -247,6 +261,7 @@ class MemoryLedger:
                 "prefix_evictable": evictable,
                 "preempted": preempted,
                 "reserved": reserved,
+                "hibernating": hibernating,
             })
         hbm = {k: 0 for k in BYTE_COMPONENTS}
         if enabled():
@@ -334,6 +349,8 @@ class MemoryLedger:
                     holders.extend(state.prefix_nodes)
             for req in e._pending:
                 holders.extend(req.resume_nodes)
+            for hold in getattr(e, "_hib_holds", {}).values():
+                holders.extend(hold["nodes"])
             for nd in holders:
                 expected[id(nd)] += 1
             in_tree = set()
@@ -483,6 +500,10 @@ def memory_stats() -> dict:
     hbm = {k: sum(p["hbm_bytes"].get(k, 0) for p in per)
            for k in BYTE_COMPONENTS}
     hbm["adapter_host_cache"] = adapters_mod.REGISTRY.cache_bytes()
+    # Off-HBM KV tiers (hibernated session blobs): process-wide like the
+    # adapter host cache, reported alongside it so /memory/ shows where
+    # every cached byte lives.
+    hbm.update(tierstore.TIERS.tier_bytes())
     ttes = [p["time_to_exhaustion_s"] for p in per
             if p["time_to_exhaustion_s"] is not None]
     return {
@@ -532,6 +553,7 @@ def hbm_byte_totals() -> dict:
     out = {k: sum(p["hbm_bytes"].get(k, 0) for p in per)
            for k in BYTE_COMPONENTS}
     out["adapter_host_cache"] = adapters_mod.REGISTRY.cache_bytes()
+    out.update(tierstore.TIERS.tier_bytes())
     return out
 
 

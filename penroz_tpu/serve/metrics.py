@@ -23,7 +23,9 @@ counters   requests_total{outcome}, decode_tokens_total,
            preemptions_total, preempted_resume_cached_tokens_total,
            router_affinity_total{outcome},
            disagg_handoffs_total{outcome,transport},
-           disagg_role_changes_total
+           disagg_role_changes_total,
+           tier_promotions_total{tier,outcome}, tier_demotions_total{tier},
+           tier_corrupt_blobs_total, sessions_hibernated_total
 gauges     engines, active_rows, queue_depth, batch_occupancy,
            breaker_open, draining, lora_live_adapters,
            kv_pool_capacity_drops, prefix_cache_unpin_underflow
@@ -39,7 +41,12 @@ histograms ttft_ms, itl_ms, queue_wait_ms, chunk_stall_ms, tick_ms
            QoS pair ttft_ms_by_class{priority} /
            queue_wait_ms_by_class{priority} (one series family per
            SLO class), plus the disagg pair disagg_handoff_ms /
-           disagg_handoff_bytes (hand-off latency and payload size)
+           disagg_handoff_bytes (hand-off latency and payload size),
+           and session_resume_ttft_ms (hibernated-session wake latency)
+
+The tier/session series (tier_pages{tier}, sessions_resident, and the
+tier_* counters) describe the hierarchical session store
+(serve/tierstore.py): HBM radix cache → host-RAM blob cache → disk.
 """
 
 from __future__ import annotations
@@ -117,7 +124,10 @@ ROUTER_AFFINITY = REGISTRY.register(m.Counter(
     "Replica-router placements of fingerprinted prompts: 'hit' landed on "
     "the replica whose prefix cache holds the prompt's pages, 'miss' "
     "anywhere else, 'stale_role' an index entry aged out because its "
-    "replica became prefill-role (elastic rebalance)", ("outcome",)))
+    "replica became prefill-role (elastic rebalance), 'session_steer' a "
+    "hibernated-session wake steered at its home replica, "
+    "'session_redirect' a wake whose home replica was unhealthy or "
+    "role-flipped so placement chose a healthy sibling", ("outcome",)))
 ROUTER_FAILOVERS = REGISTRY.register(m.Counter(
     "penroz_router_failovers_total",
     "Admissions rerouted past a refusing replica (breaker open, queue "
@@ -134,6 +144,29 @@ DISAGG_ROLE_CHANGES = REGISTRY.register(m.Counter(
     "penroz_disagg_role_changes_total",
     "Elastic prefill/decode role flips applied by engines at drain "
     "boundaries (PENROZ_DISAGG_ELASTIC=1)"))
+TIER_PROMOTIONS = REGISTRY.register(m.Counter(
+    "penroz_tier_promotions_total",
+    "Hibernated-session KV promotions by source tier and outcome: 'ok' "
+    "(blob scattered into the radix cache and aliased), 'partial' "
+    "(radix allocation ran out of unpinned pages mid-import — the "
+    "promoted prefix is shorter but still valid), 'corrupt' (CRC/"
+    "container failure, treated as a miss), 'stale' (model reloaded "
+    "since hibernation; session dropped), 'miss' (blob vanished "
+    "under the record)", ("tier", "outcome")))
+TIER_DEMOTIONS = REGISTRY.register(m.Counter(
+    "penroz_tier_demotions_total",
+    "Hibernated-session KV spills into a tier: 'host' = HBM radix "
+    "pages exported to the pinned host-RAM blob cache (background "
+    "demotion), 'disk' = host blob written to the disk/shm tier under "
+    "host-cap pressure", ("tier",)))
+TIER_CORRUPT = REGISTRY.register(m.Counter(
+    "penroz_tier_corrupt_blobs_total",
+    "Disk-tier blobs that failed CRC/container validation at promotion "
+    "— each is treated as a cache miss (recompute), never an error"))
+SESSIONS_HIBERNATED = REGISTRY.register(m.Counter(
+    "penroz_sessions_hibernated_total",
+    "Session retirements that hibernated the row's full prompt+"
+    "generated KV into the tier store"))
 
 # -- histograms (engine observes the global mirror alongside its own) -------
 
@@ -172,6 +205,12 @@ DISAGG_HANDOFF_BYTES = REGISTRY.register(m.Histogram(
     "size distributions compare directly",
     buckets=(4096, 16384, 65536, 262144, 1048576, 4194304, 16777216,
              67108864)))
+SESSION_RESUME_TTFT_MS = REGISTRY.register(m.Histogram(
+    "penroz_session_resume_ttft_ms",
+    "Enqueue to first token for admissions that resumed a hibernated "
+    "session (radix hit on still-resident pages, or a host/disk-tier "
+    "promotion) — compare against penroz_ttft_ms for the cold-"
+    "re-prefill baseline"))
 
 # -- gauges (scrape-time reads of live state) -------------------------------
 
@@ -229,6 +268,17 @@ KV_TTE = REGISTRY.register(m.Gauge(
     "Most-pressed engine's free-pool runway at the current token burn "
     "rate, seconds — series ABSENT (not 0) when no engine has a recent "
     "burn rate"))
+TIER_PAGES = REGISTRY.register(m.Gauge(
+    "penroz_tier_pages",
+    "KV pages held per storage tier of the hierarchical session store: "
+    "'hbm' = radix pages pinned awaiting background demotion "
+    "(hibernating ledger state), 'host' = pages in the pinned host-RAM "
+    "blob cache, 'disk' = pages in the disk/shm blob store",
+    labelnames=("tier",)))
+SESSIONS_RESIDENT = REGISTRY.register(m.Gauge(
+    "penroz_sessions_resident",
+    "Hibernated sessions currently resident across all tiers (process-"
+    "wide tier store)"))
 
 
 def _wire_gauges():
@@ -276,6 +326,11 @@ def _wire_gauges():
     TENANT_KV_PAGES.set_function(memledger.tenant_page_totals)
     HBM_BYTES.set_function(memledger.hbm_byte_totals)
     KV_TTE.set_function(memledger.min_time_to_exhaustion)
+
+    from penroz_tpu.serve import tierstore
+    TIER_PAGES.set_function(lambda: tierstore.TIERS.pages_by_tier())
+    SESSIONS_RESIDENT.set_function(
+        lambda: tierstore.TIERS.resident_sessions())
 
 
 _WIRED = False

@@ -282,8 +282,28 @@ def _routes() -> list[dict]:
                      "with a refill-derived Retry-After while in-flight "
                      "rows finish",
              body=_body("TenantQuotaRequest"),
-             responses=dict([ok, _resp(400, "Negative tokens_per_s"),
+             responses=dict([ok, _resp(400, "Negative tokens_per_s or "
+                                            "tier_mb"),
                              _resp(422, "Validation error")])),
+        dict(method="get", path="/sessions/",
+             summary="Hibernated-session residency across the KV tiers "
+                     "(HBM radix / host RAM / disk, serve/tierstore.py): "
+                     "tier, size, and LRU age per session — a request "
+                     "whose prompt extends a resident session's history "
+                     "resumes from its pages instead of re-prefilling",
+             responses={"200": {
+                 "description": "Resident hibernated sessions",
+                 "content": {"application/json": {"schema": {
+                     "$ref": "#/components/schemas/SessionsResponse"}}},
+             }}),
+        dict(method="delete", path="/sessions/{session_id}",
+             summary="Evict one hibernated session from every tier "
+                     "(idempotent; deleted=false when not resident)",
+             responses={"200": {
+                 "description": "Eviction result",
+                 "content": {"application/json": {"schema": {
+                     "$ref": "#/components/schemas/DeleteSessionResponse"
+                 }}}}}),
         dict(method="delete", path="/model/", summary="Delete a model",
              params=_query_params("model_id"),
              responses=dict([_resp(204, "Deleted")])),
@@ -300,7 +320,8 @@ def build_spec() -> dict:
         schemas.TrainingRequest, schemas.ProfileRequest,
         schemas.CreateAdapterRequest, schemas.TenantQuotaRequest,
         schemas.ServingStatsResponse, schemas.MemoryResponse,
-        schemas.DebugDumpResponse,
+        schemas.DebugDumpResponse, schemas.SessionsResponse,
+        schemas.DeleteSessionResponse,
     ]
     _, defs = models_json_schema(
         [(m, "validation") for m in models],

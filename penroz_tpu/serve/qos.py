@@ -52,6 +52,7 @@ _DEFAULT_WEIGHTS = {"interactive": 8, "standard": 4, "batch": 1}
 CLASS_QUEUE_ENVS = {
     cls: f"PENROZ_QOS_MAX_QUEUE_{cls.upper()}" for cls in PRIORITIES}
 TENANT_RATE_ENV = "PENROZ_QOS_TENANT_TOKENS_PER_S"
+TENANT_TIER_ENV = "PENROZ_QOS_TENANT_TIER_MB"
 PREEMPT_ENV = "PENROZ_QOS_PREEMPT"
 
 
@@ -320,6 +321,7 @@ class QuotaManager:
         self._lock = threading.Lock()
         self._buckets: dict[str, _Bucket] = {}
         self._overrides: dict[str, float] = {}
+        self._tier_overrides: dict[str, float] = {}
         self.rejections = collections.Counter()   # tenant -> shed count
         self.charged = collections.Counter()      # tenant -> tokens charged
 
@@ -348,6 +350,34 @@ class QuotaManager:
     def overrides(self) -> dict:
         with self._lock:
             return dict(self._overrides)
+
+    def _env_tier_mb(self) -> float:
+        try:
+            return max(0.0, float(os.environ.get(TENANT_TIER_ENV, "0")))
+        except ValueError:
+            return 0.0
+
+    def tier_bytes_for(self, tenant: str) -> float:
+        """The tenant's hibernated-KV residency cap in BYTES (tier store
+        admission, serve/tierstore.py).  0 = unlimited — like token rate
+        0, the default deployment pays nothing for the machinery."""
+        with self._lock:
+            if tenant in self._tier_overrides:
+                return self._tier_overrides[tenant] * 1e6
+        return self._env_tier_mb() * 1e6
+
+    def set_tier_mb(self, tenant: str, mb: float | None) -> None:
+        """Admin override of the tier-residency cap (``PUT
+        /tenants/{id}/quota``); None clears back to the env default."""
+        with self._lock:
+            if mb is None:
+                self._tier_overrides.pop(tenant, None)
+            else:
+                self._tier_overrides[tenant] = max(0.0, float(mb))
+
+    def tier_overrides(self) -> dict:
+        with self._lock:
+            return dict(self._tier_overrides)
 
     def _refill(self, tenant: str, rate: float, now: float) -> _Bucket:
         # Callers hold self._lock.
@@ -394,6 +424,7 @@ class QuotaManager:
         with self._lock:
             return {
                 "overrides": dict(self._overrides),
+                "tier_overrides": dict(self._tier_overrides),
                 "rejections": dict(self.rejections),
                 "charged": dict(self.charged),
             }
@@ -402,6 +433,7 @@ class QuotaManager:
         with self._lock:
             self._buckets.clear()
             self._overrides.clear()
+            self._tier_overrides.clear()
             self.rejections.clear()
             self.charged.clear()
 

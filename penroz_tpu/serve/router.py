@@ -79,6 +79,7 @@ from penroz_tpu.ops import kv_cache as KV
 from penroz_tpu.serve import decode_scheduler as ds
 from penroz_tpu.serve import metrics as serve_metrics
 from penroz_tpu.serve import qos
+from penroz_tpu.serve import tierstore
 from penroz_tpu.serve.qos import TenantQuotaExceeded
 
 log = logging.getLogger(__name__)
@@ -174,6 +175,11 @@ class EngineRouter:
         self.affinity_hits = 0
         self.affinity_misses = 0
         self.affinity_stale_roles = 0
+        # Hibernated-session steering (serve/tierstore.py): wakes steered
+        # at the session's home replica vs redirected to a healthy one
+        # because the home was breaker-open / draining / role-flipped.
+        self.session_steers = 0
+        self.session_redirects = 0
         self.failovers = 0
         # Elastic rebalancer bookkeeping (under _lock): last flip-request
         # time (cooldown) and how many flips this router has asked for.
@@ -217,6 +223,42 @@ class EngineRouter:
                 self._affinity.move_to_end(fp)
                 return idx
         return None
+
+    def _session_target(self, req):
+        """Hibernated-session steering: a prompt whose whole-page prefix
+        matches a resident session (serve/tierstore.py) wakes fastest on
+        the replica that hibernated it — tier "hbm" pages only exist in
+        that replica's radix cache, and even after demotion its radix
+        copy often survives evictable.  The steer is a HINT, not a pin:
+        a home replica that is breaker-open, draining, shut down or
+        elastically flipped to prefill-role is skipped (counted as a
+        ``session_redirects``) and normal placement wakes the session on
+        any healthy decode replica via the process-wide blob import.
+        Unlike the prefix-affinity index, the session record is NOT aged
+        out on a stale role — the home replica may flip back to decode
+        and resume serving HBM-fast wakes, so placement survives role
+        flips instead of forgetting the session's home."""
+        if not (KV.paged_enabled() and KV.prefix_cache_enabled()):
+            return None
+        rec = tierstore.TIERS.placement(
+            req.prompt, model_id=self.model_id,
+            page_size=KV.default_page_size())
+        if rec is None or rec.replica is None:
+            return None
+        idx = int(rec.replica)
+        if not (0 <= idx < len(self.replicas)):
+            return None
+        e = self.replicas[idx]
+        if (e._shutdown or e._draining or e._breaker_open
+                or (self.disagg and e.role != "decode")):
+            with self._lock:
+                self.session_redirects += 1
+            serve_metrics.ROUTER_AFFINITY.inc(outcome="session_redirect")
+            return None
+        with self._lock:
+            self.session_steers += 1
+        serve_metrics.ROUTER_AFFINITY.inc(outcome="session_steer")
+        return idx
 
     def _remember(self, fps, idx: int):
         cap = _affinity_index_cap()
@@ -353,6 +395,11 @@ class EngineRouter:
         self.maybe_rebalance()
         fps = self._fingerprints(req.prompt)
         target = self._affinity_target(fps) if fps else None
+        if target is None and fps:
+            # No live affinity entry (LRU-evicted, or aged out when its
+            # replica flipped role) — a hibernated session still knows
+            # its home replica.
+            target = self._session_target(req)
         order = self._candidates(req, target)
         if not order:
             raise RuntimeError("decode engine is shut down")

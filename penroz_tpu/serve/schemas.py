@@ -107,6 +107,16 @@ class GenerateRequest(ModelRequest):
         "token bucket 429s new admissions with a refill-derived "
         "Retry-After (PENROZ_QOS_TENANT_TOKENS_PER_S / PUT "
         "/tenants/{id}/quota)")
+    session_id: Optional[str] = Field(
+        None, pattern=r"^[A-Za-z0-9._-]{1,120}$",
+        description="Session handle for KV hibernation: at retirement the "
+        "full prompt+generated KV demotes HBM → host RAM → disk "
+        "(PENROZ_TIER_HOST_MB / PENROZ_TIER_DISK_MB) instead of being "
+        "freed, and a later request whose prompt extends the session's "
+        "history resumes from the hibernated pages (promote-on-match) — "
+        "on any replica, and across engine restarts from the disk tier. "
+        "Scheduler path only (base model, no adapter); GET/DELETE "
+        "/sessions/ manage residency")
 
 
 class GenerateBatchRequest(ModelRequest):
@@ -141,6 +151,10 @@ class GenerateBatchRequest(ModelRequest):
         None, description="Tenant id applied to every row for fair "
         "queuing + token quotas (default: the row's adapter id, else "
         "'default')")
+    session_ids: Optional[list[Optional[str]]] = Field(
+        None, description="Per-row session handles for KV hibernation "
+        "(null entries = no session; see GenerateRequest.session_id); "
+        "length must equal inputs")
 
 
 class TenantQuotaRequest(BaseModel):
@@ -151,6 +165,13 @@ class TenantQuotaRequest(BaseModel):
         ..., description="Sustained token budget per second (burst = 1s "
         "of rate, min 1 token); 0 blocks all new admissions for the "
         "tenant; null clears the override back to the env default")
+    tier_mb: Optional[float] = Field(
+        None, description="Hibernated-session KV residency cap for the "
+        "tenant in MB across the host+disk tiers (overrides "
+        "PENROZ_QOS_TENANT_TIER_MB). A hibernation over cap evicts the "
+        "tenant's LRU sessions; one that cannot fit at all is refused "
+        "(the KV is simply freed). 0 = unlimited; null clears the "
+        "override. Omit to leave the tier quota unchanged")
 
 
 class CreateAdapterRequest(ModelRequest):
@@ -255,7 +276,8 @@ class EngineMemory(BaseModel):
         "row) | prefix_evictable (cached, unpinned) | preempted (pinned "
         "by a queued preempted session's resume hold) | reserved (radix "
         "free list) | transit (disaggregated-prefill hand-off import in "
-        "flight).  States sum to pool_pages_total")
+        "flight) | hibernating (pinned by a hibernated session's hold "
+        "awaiting tier demotion).  States sum to pool_pages_total")
     tenant_pages: dict[str, int] = Field(
         default_factory=dict, description="Row-owned pages per tenant id "
         "(page-granular HBM attribution)")
@@ -265,7 +287,9 @@ class EngineMemory(BaseModel):
     hbm_bytes: dict[str, int] = Field(
         default_factory=dict, description="Bytes per component: "
         "kv_values / kv_scales (int8 variants) / kv_block_table / "
-        "lora_pack / params")
+        "lora_pack / params.  The aggregate adds adapter_host_cache "
+        "plus host_tier / disk_tier (hibernated-session blobs outside "
+        "HBM, serve/tierstore.py)")
     high_water_pages: dict[str, int] = Field(
         default_factory=dict, description="Peak pages per state since "
         "engine start ('used' = total minus free)")
@@ -334,6 +358,20 @@ class EngineStats(BaseModel):
     disagg_role_changes: int = Field(
         0, description="Elastic role flips this engine applied at drain "
         "boundaries (PENROZ_DISAGG_ELASTIC=1)")
+    sessions_hibernated: int = Field(
+        0, description="Session-tagged retirements whose KV this engine "
+        "parked in the radix cache for tier demotion instead of freeing "
+        "(serve/tierstore.py)")
+    session_promotions: int = Field(
+        0, description="Admissions this engine woke from a hibernated "
+        "blob (host/disk tier import through the prefix cache) — "
+        "HBM-fast wakes ride the normal radix hit and count only in the "
+        "store's tier_promotions")
+    session_resume_ttft_ms_p50: Optional[float] = Field(
+        None, description="Median enqueue → first token for session-"
+        "resume admissions (any wake tier)")
+    session_resume_ttft_ms_p99: Optional[float] = Field(
+        None, description="p99 session-resume TTFT")
     active_rows: int
     queue_depth: int
     occupancy: float = Field(..., description="active_rows / capacity now")
@@ -627,6 +665,91 @@ class ServingStatsResponse(BaseModel):
     disagg_role_changes: int = Field(
         0, description="Aggregate elastic role flips applied across "
         "engines (PENROZ_DISAGG_ELASTIC=1)")
+    sessions_resident: int = Field(
+        0, description="Hibernated sessions currently resident in any "
+        "tier (process-wide tier store, serve/tierstore.py; "
+        "penroz_sessions_resident)")
+    sessions_by_tier: dict[str, int] = Field(
+        default_factory=dict, description="Resident hibernated sessions "
+        "per tier: hbm (pinned radix pages awaiting demotion) | host "
+        "(pinned host-RAM blob) | disk (CRC-checked blob under "
+        "PENROZ_TIER_DISK_PATH)")
+    tier_bytes: dict[str, int] = Field(
+        default_factory=dict, description="Hibernated-session bytes per "
+        "lower tier (host_tier / disk_tier) — the /memory/ aggregate "
+        "reports the same values inside hbm_bytes")
+    tier_promotions: dict[str, int] = Field(
+        default_factory=dict, description="Session wake attempts by "
+        "outcome: ok | partial (radix alloc exhausted mid-import) | "
+        "stale (model reloaded since hibernation) | corrupt (disk blob "
+        "failed CRC — recomputed, never served) | miss (blob vanished). "
+        "penroz_tier_promotions_total{tier,outcome} keeps the per-tier "
+        "split")
+    tier_demotions: dict[str, int] = Field(
+        default_factory=dict, description="Background demotions per "
+        "destination tier (host = HBM export, disk = host-cap spill; "
+        "penroz_tier_demotions_total{tier})")
+    tier_corrupt_blobs: int = Field(
+        0, description="Disk-tier blobs that failed CRC/container "
+        "validation and were treated as misses "
+        "(penroz_tier_corrupt_blobs_total)")
+    sessions_hibernated: int = Field(
+        0, description="Aggregate session-tagged retirements parked for "
+        "tiering across engines (penroz_sessions_hibernated_total)")
+    session_promotions: int = Field(
+        0, description="Aggregate blob-import session wakes across "
+        "engines")
+    session_resume_ttft_ms_p50: Optional[float] = Field(
+        None, description="Median session-resume TTFT across engines "
+        "(merged histogram buckets; penroz_session_resume_ttft_ms)")
+    session_resume_ttft_ms_p99: Optional[float] = Field(
+        None, description="p99 session-resume TTFT across engines")
+
+
+class SessionInfo(BaseModel):
+    """One hibernated session's residency record (GET /sessions/)."""
+    session_id: str
+    tenant: str = Field(..., description="Tenant charged for the "
+                        "session's tier residency (tier quota)")
+    model_id: str
+    tier: str = Field(..., description="DEEPEST copy: 'hbm' (pinned "
+                      "radix pages awaiting demotion) | 'host' | 'disk'")
+    tokens: int = Field(..., description="Whole-page KV tokens resident "
+                        "(prompt + generated, floor to page size)")
+    pages: int = Field(..., description="KV pool pages the session spans")
+    nbytes: int = Field(..., description="Bytes the resident copy holds "
+                        "in its tier")
+    replica: int = Field(0, description="Replica that hibernated the "
+                         "session (wake may land anywhere — the match "
+                         "is content-addressed)")
+    age_s: float = Field(..., description="Seconds since hibernation "
+                         "registration")
+    idle_s: float = Field(..., description="Seconds since last "
+                          "hibernate/match touch (LRU age)")
+
+
+class SessionsResponse(BaseModel):
+    """GET /sessions/ — hibernated-session residency across every tier
+    (process-wide; one listing covers all engines and replicas)."""
+    sessions: list[SessionInfo] = Field(
+        default_factory=list, description="LRU order, oldest first")
+    sessions_resident: int = Field(0, description="len(sessions)")
+    sessions_by_tier: dict[str, int] = Field(
+        default_factory=dict, description="Resident count per tier "
+        "(hbm/host/disk)")
+    tier_bytes: dict[str, int] = Field(
+        default_factory=dict, description="Bytes per lower tier "
+        "(host_tier/disk_tier)")
+
+
+class DeleteSessionResponse(BaseModel):
+    """DELETE /sessions/{session_id} — evict one hibernated session from
+    every tier (the disk blob is unlinked; a pinned hbm-tier hold is
+    released by its engine at the next loop boundary)."""
+    session_id: str
+    deleted: bool = Field(..., description="False when the session was "
+                          "not resident (still 200 — deletion is "
+                          "idempotent)")
 
 
 class MemoryEngineEntry(EngineMemory):
@@ -663,7 +786,8 @@ class MemoryResponse(BaseModel):
         "tenant (penroz_tenant_kv_pages{tenant})")
     hbm_bytes: dict[str, int] = Field(
         default_factory=dict, description="Aggregate bytes per component "
-        "incl. adapter_host_cache (penroz_hbm_bytes{component})")
+        "incl. adapter_host_cache and the off-HBM KV tiers host_tier / "
+        "disk_tier (penroz_hbm_bytes{component})")
     high_water_pages: dict[str, int] = Field(
         default_factory=dict, description="Aggregate per-state peaks "
         "(sum of engine peaks — engines peak independently)")

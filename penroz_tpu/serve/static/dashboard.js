@@ -311,6 +311,24 @@ function renderServing(data) {
       `(${data.disagg_handoff_failures || 0} failed) · handoff p99 ` +
       `${handoffP99 == null ? "—" : handoffP99.toFixed(0) + "ms"}` +
       `${roleChanges ? ` · flips ${roleChanges}` : ""}`;
+  /* Session hibernation / KV tiering (session_id on /generate/): resident
+   * sessions split by tier, promotion outcome tallies, and the resume-TTFT
+   * tail — "sessions off" until any session hibernates. */
+  const resident = data.sessions_resident || 0;
+  const byTier = data.sessions_by_tier || {};
+  const promos = data.tier_promotions || {};
+  const promoOk = (promos.ok || 0) + (promos.partial || 0);
+  const promoBad = (promos.stale || 0) + (promos.corrupt || 0) +
+    (promos.miss || 0);
+  const resumeP99 = data.session_resume_ttft_ms_p99;
+  const tierTxt = (resident === 0 && !data.sessions_hibernated)
+    ? "sessions off"
+    : `sessions ${resident} (hbm ${byTier.hbm || 0} / host ` +
+      `${byTier.host || 0} / disk ${byTier.disk || 0}) · wakes ` +
+      `${promoOk}${promoBad ? ` (${promoBad} missed)` : ""} · resume p99 ` +
+      `${resumeP99 == null ? "—" : resumeP99.toFixed(0) + "ms"}` +
+      `${data.tier_corrupt_blobs ? ` · CORRUPT ${data.tier_corrupt_blobs}`
+         : ""}`;
   meta.textContent =
     `rows ${data.active_rows}/${data.capacity} (occupancy ` +
     `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +
@@ -322,7 +340,7 @@ function renderServing(data) {
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
     `${multistepTxt} · ` +
     `${specTxt} · ${loraTxt} · ${prefixTxt} · ${qosTxt} · ${routerTxt} · ` +
-    `${disaggTxt} · KV pool drops ${drops}`;
+    `${disaggTxt} · ${tierTxt} · KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();
   const xs = servingHistory.map((_, i) => i);
@@ -391,10 +409,11 @@ function renderTickStrip(data) {
 /* Owner states in stacked-bar order (occupied states bottom-up, free on
  * top) with their colors — mirrors serve/memledger.py PAGE_STATES. */
 const MEM_STATES = ["row", "prefix_pinned", "prefix_evictable", "preempted",
-                    "reserved", "free"];
+                    "hibernating", "reserved", "free"];
 const MEM_COLORS = {
   row: "#7aa2f7", prefix_pinned: "#b58cd9", prefix_evictable: "#56b6c2",
-  preempted: "#d19a66", reserved: "#5d7285", free: "#22303c",
+  preempted: "#d19a66", hibernating: "#c678dd", reserved: "#5d7285",
+  free: "#22303c",
 };
 
 function fmtBytes(n) {
@@ -431,6 +450,7 @@ function renderMemory(data) {
     : `pages ${used}/${total} used (rows ${pool.row || 0} · pinned ` +
       `${pool.prefix_pinned || 0} · evictable ` +
       `${pool.prefix_evictable || 0} · preempted ${pool.preempted || 0} ` +
+      `· hibernating ${pool.hibernating || 0} ` +
       `· reserved ${pool.reserved || 0} · free ${pool.free || 0}) · ` +
       `hwm ${hwmUsed}`;
   const tenants = Object.entries(data.tenant_pages || {});
@@ -442,7 +462,14 @@ function renderMemory(data) {
   const hbmTotal = Object.values(hbm).reduce((a, b) => a + b, 0);
   const kvBytes = (hbm.kv_values || 0) + (hbm.kv_scales || 0) +
     (hbm.kv_block_table || 0);
-  const hbmTxt = ` · HBM ${fmtBytes(hbmTotal)} (kv ${fmtBytes(kvBytes)})`;
+  /* Hibernated-session blob bytes live OFF the device — call them out
+   * separately so the tile reads "HBM X (kv Y) · tiered Z". */
+  const tierBytes = (hbm.host_tier || 0) + (hbm.disk_tier || 0);
+  const hbmTxt = ` · HBM ${fmtBytes(hbmTotal - tierBytes)} ` +
+    `(kv ${fmtBytes(kvBytes)})` +
+    (tierBytes ? ` · tiered ${fmtBytes(tierBytes)} ` +
+      `(host ${fmtBytes(hbm.host_tier || 0)} / disk ` +
+      `${fmtBytes(hbm.disk_tier || 0)})` : "");
   const tte = data.time_to_exhaustion_s;
   const tteTxt = ` · exhaustion ${tte == null ? "—" : tte.toFixed(0) + "s"}`;
   /* Leak/pressure health readouts: any nonzero underflow or audit
@@ -470,7 +497,7 @@ function renderMemory(data) {
     });
   });
   drawLabel(ctx, `${hi} pages`, 4, 12);
-  let lx = w - 440;
+  let lx = w - 516;
   MEM_STATES.forEach((s) => {
     drawLabel(ctx, s.replace("prefix_", ""), lx, 12, MEM_COLORS[s]);
     lx += 74;

@@ -1,0 +1,513 @@
+"""Hierarchical KV tier store: session hibernation below the HBM radix cache.
+
+The radix prefix cache (ops/kv_cache.py) keeps whole-page KV for *recent*
+prompts in a reserved region of the paged pool — but HBM is the scarcest
+tier there is, and production chat traffic is millions of sessions that
+are idle between turns.  This module generalizes that cache into a
+three-tier page store:
+
+    HBM radix cache  →  pinned host-RAM blob cache  →  disk/shm blob store
+    (reserved pool       (``PENROZ_TIER_HOST_MB``)      (``PENROZ_TIER_DISK_PATH``
+     region, fast                                        / ``PENROZ_TIER_DISK_MB``)
+     aliasing)
+
+Lifecycle of a hibernated session (serve/decode_scheduler.py drives it):
+
+1. **Hibernate** — a retirement carrying a ``session_id`` inserts the row's
+   full prompt+generated history into the radix cache (the preempt-to-
+   prefix-cache template) and *pins* the chain under a hibernation hold;
+   the ledger counts those pages ``hibernating``.  Registration here is
+   cheap host bookkeeping — the retirement hot path never exports.
+2. **Demote** (async, off the hot path) — the engine worker drains its
+   demotion queue at loop boundaries: pages are exported to a host blob
+   (``export_pages``), the hold is unpinned (the pages stay radix-resident
+   and *evictable*, so resume is still HBM-fast until LRU pressure takes
+   them), and the session's tier becomes ``host``.  Host-cap overflow
+   spills LRU host blobs to the disk tier (CRC container via
+   utils/checkpoint.py); disk-cap overflow drops LRU sessions entirely.
+3. **Promote on match** — an admission whose prompt's page fingerprints
+   hit a hibernated session imports the blob's pages into freshly
+   ``insert()``-created radix slots (``import_pages``) and aliases them
+   like a normal radix hit; the un-hibernated suffix chunk-prefills as
+   usual.  Content-addressed: no ``session_id`` needed to wake, so a
+   session hibernated on one replica wakes on any other — and, for the
+   disk tier, across ``decode_scheduler.reset()`` / engine restarts.
+
+The store is PROCESS-WIDE (one instance, like qos.QUOTAS): every engine
+replica registers into and promotes from the same tiers.  A session
+hibernated by a breaker-open or since-reset replica therefore stays
+wakeable as long as its blob has left HBM.  Model reloads are fenced by a
+per-session checkpoint stamp — a stale session is dropped at match time,
+never served.
+
+Corruption policy: a disk blob that fails CRC/container validation is a
+*miss* (``penroz_tier_corrupt_blobs_total``), never an error or wrong
+tokens — the admission recomputes.
+
+Per-tenant residency quotas ride the QoS machinery
+(``PENROZ_QOS_TENANT_TIER_MB`` + ``PUT /tenants/{id}/quota`` overrides):
+a hibernation that would put the tenant over cap evicts that tenant's LRU
+sessions first and is refused if the new session alone cannot fit.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+HOST_MB_ENV = "PENROZ_TIER_HOST_MB"
+DISK_MB_ENV = "PENROZ_TIER_DISK_MB"
+
+_DEFAULT_HOST_MB = 64.0
+_DEFAULT_DISK_MB = 256.0
+
+TIERS_ALL = ("hbm", "host", "disk")
+
+#: Promotion outcomes (the ``penroz_tier_promotions_total`` outcome label
+#: values): ``ok`` full wake, ``partial`` radix alloc exhausted mid-import,
+#: ``stale`` model stamp changed since hibernation, ``corrupt`` disk blob
+#: failed CRC, ``miss`` blob vanished.
+OUTCOMES = ("ok", "partial", "stale", "corrupt", "miss")
+
+
+def _env_mb(name: str, default: float) -> float:
+    try:
+        return max(0.0, float(os.environ.get(name, default)))
+    except ValueError:
+        return float(default)
+
+
+def host_cap_bytes() -> int:
+    return int(_env_mb(HOST_MB_ENV, _DEFAULT_HOST_MB) * 1e6)
+
+
+def disk_cap_bytes() -> int:
+    return int(_env_mb(DISK_MB_ENV, _DEFAULT_DISK_MB) * 1e6)
+
+
+class _Session:
+    """One hibernated session's residency record.  ``tier`` names the
+    DEEPEST copy ("hbm" = pinned radix pages awaiting demotion, "host" =
+    blob in the host cache, "disk" = blob on disk); the radix cache may
+    still hold the pages after demotion, which just makes resume cheaper.
+    ``owner`` identifies the engine holding the pinned pages while tier
+    is "hbm" (``id(engine)``) — a crash/reset of that engine drops the
+    record via :meth:`TierStore.drop_owner` because the pages died with
+    the pool."""
+
+    __slots__ = ("session_id", "tenant", "model_id", "model_stamp",
+                 "tokens", "kv_len", "page_size", "quantized", "nbytes",
+                 "tier", "owner", "replica", "created", "last_use", "fps")
+
+    def __init__(self, session_id, tenant, model_id, model_stamp, tokens,
+                 kv_len, page_size, quantized, nbytes, owner, replica, fps):
+        self.session_id = session_id
+        self.tenant = tenant
+        self.model_id = model_id
+        self.model_stamp = model_stamp
+        self.tokens = tokens
+        self.kv_len = int(kv_len)
+        self.page_size = int(page_size)
+        self.quantized = bool(quantized)
+        self.nbytes = int(nbytes)
+        self.tier = "hbm"
+        self.owner = owner
+        self.replica = replica
+        self.created = time.time()
+        self.last_use = self.created
+        self.fps = fps
+
+    @property
+    def pages(self) -> int:
+        return self.kv_len // self.page_size
+
+
+def _fingerprints(tokens, page_size: int, max_pages: int) -> list:
+    """Rolling page-aligned prefix fingerprints, shortest first —
+    ``fps[k-1]`` covers the first ``k`` full pages.  Same hash chain as
+    the router's affinity index (serve/router.py), so both indexes agree
+    on what "the same prefix" means."""
+    fps, h = [], 0
+    for k in range(min(max_pages, len(tokens) // page_size)):
+        h = hash((h, tuple(int(t) for t in
+                           tokens[k * page_size:(k + 1) * page_size])))
+        fps.append(h)
+    return fps
+
+
+class TierStore:
+    """Process-wide registry of hibernated sessions + the host/disk blob
+    tiers.  Thread-safe: engine workers (register/demote/promote) and API
+    threads (list/delete) interleave freely.  Holds no engine references
+    — engines push state in and look content up, so the store survives
+    any engine's crash, reload, or ``decode_scheduler.reset()``."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # session_id -> _Session, LRU order (move_to_end on touch)
+        self._sessions: collections.OrderedDict = collections.OrderedDict()
+        # session_id -> host-tier blob dict (pinned host RAM)
+        self._host: dict = {}
+        # (model_id, page_size, quantized, fp) -> {session_id: depth}
+        # One entry per covered page depth per session: a prompt that
+        # agrees with a session for only k of its pages still finds it.
+        self._index: dict = {}
+        self.hibernated = 0              # lifetime registrations
+        self.demotions = collections.Counter()    # tier -> count
+        self.promotions = collections.Counter()   # (tier, outcome) -> count
+        self.corrupt_blobs = 0
+        self.drops = collections.Counter()        # reason -> count
+
+    # -- registration / demotion --------------------------------------------
+
+    def _index_add(self, rec: _Session):
+        for depth, fp in enumerate(rec.fps, start=1):
+            key = (rec.model_id, rec.page_size, rec.quantized, fp)
+            self._index.setdefault(key, {})[rec.session_id] = depth
+
+    def _index_remove(self, rec: _Session):
+        for fp in rec.fps:
+            key = (rec.model_id, rec.page_size, rec.quantized, fp)
+            bucket = self._index.get(key)
+            if bucket is not None:
+                bucket.pop(rec.session_id, None)
+                if not bucket:
+                    del self._index[key]
+
+    def _tenant_bytes_locked(self, tenant: str) -> int:
+        return sum(r.nbytes for r in self._sessions.values()
+                   if r.tenant == tenant)
+
+    def register(self, session_id: str, *, tenant, model_id, model_stamp,
+                 tokens, kv_len, page_size, quantized, nbytes, owner,
+                 replica) -> bool:
+        """Record a freshly hibernated session (tier "hbm": the engine
+        still holds its pinned radix pages).  Re-registering an existing
+        ``session_id`` replaces it — a multi-turn session's next
+        retirement supersedes the previous hibernation.  Enforces the
+        tenant's tier quota by evicting that tenant's LRU sessions;
+        returns False (nothing registered) when even that cannot fit the
+        new session."""
+        from penroz_tpu.serve import qos
+        tokens = tuple(int(t) for t in tokens)
+        pages = int(kv_len) // int(page_size)
+        if pages < 1:
+            return False
+        fps = _fingerprints(tokens, int(page_size), pages)
+        with self._lock:
+            old = self._sessions.get(session_id)
+            if old is not None:
+                self._drop_locked(old, "replaced")
+            cap = qos.QUOTAS.tier_bytes_for(tenant)
+            if cap > 0:
+                if int(nbytes) > cap:
+                    self.drops["quota_refused"] += 1
+                    return False
+                while (self._tenant_bytes_locked(tenant) + int(nbytes) > cap):
+                    victim = next((r for r in self._sessions.values()
+                                   if r.tenant == tenant), None)
+                    if victim is None:
+                        break
+                    self._drop_locked(victim, "quota")
+            rec = _Session(session_id, tenant, model_id, model_stamp,
+                           tokens, kv_len, page_size, quantized, nbytes,
+                           owner, replica, fps)
+            self._sessions[session_id] = rec
+            self._index_add(rec)
+            self.hibernated += 1
+        from penroz_tpu.serve import metrics as serve_metrics
+        serve_metrics.SESSIONS_HIBERNATED.inc()
+        return True
+
+    def demote_to_host(self, session_id: str, blob: dict) -> bool:
+        """Land a demoted session's blob in the host tier (the engine
+        worker just ran ``export_pages`` off the hot path) and rebalance
+        the lower tiers: host-cap overflow spills LRU host blobs to disk,
+        disk-cap overflow drops LRU disk sessions."""
+        from penroz_tpu.serve import metrics as serve_metrics
+        from penroz_tpu.utils import checkpoint
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            if rec is None or rec.tier != "hbm":
+                return False
+            rec.tier = "host"
+            rec.owner = None
+            rec.nbytes = checkpoint.page_blob_nbytes(blob)
+            self._host[session_id] = blob
+            self.demotions["host"] += 1
+            serve_metrics.TIER_DEMOTIONS.inc(tier="host")
+            self._enforce_caps_locked()
+        return True
+
+    def _tier_bytes_locked(self, tier: str) -> int:
+        return sum(r.nbytes for r in self._sessions.values()
+                   if r.tier == tier)
+
+    def _lru_locked(self, tier: str):
+        return next((r for r in self._sessions.values() if r.tier == tier),
+                    None)
+
+    def _enforce_caps_locked(self):
+        from penroz_tpu.serve import metrics as serve_metrics
+        from penroz_tpu.utils import checkpoint
+        host_cap = host_cap_bytes()
+        while self._tier_bytes_locked("host") > host_cap:
+            rec = self._lru_locked("host")
+            if rec is None:
+                break
+            blob = self._host.pop(rec.session_id)
+            try:
+                checkpoint.save_tier_blob(rec.session_id, blob)
+            except OSError:
+                log.warning("disk-tier write failed; dropping session %s",
+                            rec.session_id, exc_info=True)
+                self._drop_locked(rec, "disk_write_failed")
+                continue
+            rec.tier = "disk"
+            rec.nbytes = checkpoint.tier_blob_nbytes(rec.session_id)
+            self.demotions["disk"] += 1
+            serve_metrics.TIER_DEMOTIONS.inc(tier="disk")
+        disk_cap = disk_cap_bytes()
+        while self._tier_bytes_locked("disk") > disk_cap:
+            rec = self._lru_locked("disk")
+            if rec is None:
+                break
+            self._drop_locked(rec, "disk_cap")
+
+    # -- lookup / promotion --------------------------------------------------
+
+    def match(self, tokens, *, model_id, model_stamp, page_size, quantized,
+              min_pages: int = 1):
+        """Deepest hibernated session agreeing with ``tokens``' whole-page
+        prefix: returns ``(record, depth_pages)`` or ``(None, 0)``.  The
+        usable token count is capped at ``len(tokens) - 1`` (the radix
+        match rule: one real token must remain to produce first-sample
+        logits).  Sessions hibernated under a different model stamp
+        (weights reloaded since) are dropped on sight — stale KV is never
+        served.  Fingerprint candidates are verified token-for-token, so
+        a hash collision degrades to a miss, not a wrong alias."""
+        if not self._sessions:
+            return None, 0
+        P = int(page_size)
+        max_pages = max(0, (len(tokens) - 1) // P)
+        if max_pages < min_pages:
+            return None, 0
+        toks = tuple(int(t) for t in tokens)
+        fps = _fingerprints(toks, P, max_pages)
+        with self._lock:
+            for depth in range(len(fps), max(0, min_pages - 1), -1):
+                key = (model_id, P, bool(quantized), fps[depth - 1])
+                bucket = self._index.get(key)
+                if not bucket:
+                    continue
+                for sid in list(bucket):
+                    rec = self._sessions.get(sid)
+                    if rec is None:
+                        bucket.pop(sid, None)
+                        continue
+                    if rec.model_stamp != model_stamp:
+                        self.note_promotion(rec.tier, "stale")
+                        self._drop_locked(rec, "stale_model")
+                        continue
+                    span = depth * P
+                    if rec.kv_len >= span and rec.tokens[:span] == toks[:span]:
+                        self.touch(sid)
+                        return rec, depth
+            return None, 0
+
+    def placement(self, tokens, *, model_id, page_size: int):
+        """Router-side placement hint: the deepest token-verified resident
+        session for ``tokens``' whole-page prefix, with NO side effects —
+        no LRU touch, no promotion counters, no stamp fence (the router
+        does not know each replica's checkpoint stamp; the engine-side
+        promote still enforces it).  Both quantization variants are
+        scanned — steering is per-model, not per-pool-layout.  Returns
+        the record or None."""
+        P = int(page_size)
+        max_pages = max(0, (len(tokens) - 1) // P)
+        if max_pages < 1 or not self._sessions:
+            return None
+        toks = tuple(int(t) for t in tokens)
+        fps = _fingerprints(toks, P, max_pages)
+        with self._lock:
+            for depth in range(len(fps), 0, -1):
+                for quantized in (False, True):
+                    key = (model_id, P, quantized, fps[depth - 1])
+                    bucket = self._index.get(key)
+                    if not bucket:
+                        continue
+                    span = depth * P
+                    for sid in bucket:
+                        rec = self._sessions.get(sid)
+                        if (rec is not None and rec.kv_len >= span
+                                and rec.tokens[:span] == toks[:span]):
+                            return rec
+            return None
+
+    def fetch(self, session_id: str):
+        """The session's blob for promotion, or None (with the record
+        dropped and the corrupt/miss counters bumped) when the copy is
+        unreadable.  Tier "hbm" has no blob yet — the pages only exist in
+        the owning engine's radix cache — so a cross-replica wake before
+        demotion completes is also a None (the caller recomputes)."""
+        from penroz_tpu.serve import metrics as serve_metrics
+        from penroz_tpu.utils import checkpoint
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            if rec is None:
+                return None
+            if rec.tier == "hbm":
+                return None
+            if rec.tier == "host":
+                return self._host.get(session_id)
+            try:
+                return checkpoint.load_tier_blob(session_id)
+            except ValueError:
+                self.corrupt_blobs += 1
+                serve_metrics.TIER_CORRUPT.inc()
+                self.note_promotion("disk", "corrupt")
+                self._drop_locked(rec, "corrupt")
+                return None
+            except KeyError:
+                self.note_promotion("disk", "miss")
+                self._drop_locked(rec, "blob_missing")
+                return None
+
+    def note_promotion(self, tier: str, outcome: str):
+        from penroz_tpu.serve import metrics as serve_metrics
+        with self._lock:
+            self.promotions[(tier, outcome)] += 1
+        serve_metrics.TIER_PROMOTIONS.inc(tier=tier, outcome=outcome)
+
+    def touch(self, session_id: str):
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            if rec is not None:
+                rec.last_use = time.time()
+                self._sessions.move_to_end(session_id)
+
+    # -- removal -------------------------------------------------------------
+
+    def _drop_locked(self, rec: _Session, reason: str):
+        from penroz_tpu.utils import checkpoint
+        self._sessions.pop(rec.session_id, None)
+        self._host.pop(rec.session_id, None)
+        if rec.tier == "disk":
+            checkpoint.delete_tier_blob(rec.session_id)
+        self._index_remove(rec)
+        self.drops[reason] += 1
+
+    def drop(self, session_id: str, reason: str = "api") -> bool:
+        """Evict one session from every tier (``DELETE /sessions/{id}``).
+        A tier-"hbm" record's pinned pages are released by the owning
+        engine when its demotion queue reaches the now-unregistered id."""
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            if rec is None:
+                return False
+            self._drop_locked(rec, reason)
+            return True
+
+    def drop_owner(self, owner, reason: str = "engine_reset") -> int:
+        """Drop every tier-"hbm" session pinned by engine ``owner`` — its
+        pool (and the pinned pages) just died in a crash-recovery
+        reallocation, reload, or shutdown.  Host/disk-tier sessions
+        survive: their bytes left HBM already."""
+        with self._lock:
+            victims = [r for r in self._sessions.values()
+                       if r.tier == "hbm" and r.owner == owner]
+            for rec in victims:
+                self._drop_locked(rec, reason)
+            return len(victims)
+
+    # -- introspection -------------------------------------------------------
+
+    def get(self, session_id: str):
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    def resident_sessions(self) -> int:
+        return len(self._sessions)
+
+    def sessions_by_tier(self) -> dict:
+        with self._lock:
+            out = {t: 0 for t in TIERS_ALL}
+            for rec in self._sessions.values():
+                out[rec.tier] += 1
+            return out
+
+    def pages_by_tier(self) -> dict:
+        with self._lock:
+            out = {t: 0 for t in TIERS_ALL}
+            for rec in self._sessions.values():
+                out[rec.tier] += rec.pages
+            return out
+
+    def tier_bytes(self) -> dict:
+        """Bytes held OUTSIDE the paged pool, per lower tier (tier-"hbm"
+        sessions live in pool pages the memledger already counts as
+        ``hibernating``, so they are excluded here — no double count)."""
+        with self._lock:
+            return {"host_tier": self._tier_bytes_locked("host"),
+                    "disk_tier": self._tier_bytes_locked("disk")}
+
+    def list_sessions(self) -> list:
+        now = time.time()
+        with self._lock:
+            return [{
+                "session_id": r.session_id,
+                "tenant": r.tenant,
+                "model_id": r.model_id,
+                "tier": r.tier,
+                "tokens": r.kv_len,
+                "pages": r.pages,
+                "nbytes": r.nbytes,
+                "replica": r.replica,
+                "age_s": max(0.0, now - r.created),
+                "idle_s": max(0.0, now - r.last_use),
+            } for r in self._sessions.values()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            promos: collections.Counter = collections.Counter()
+            for (_, outcome), n in self.promotions.items():
+                promos[outcome] += n
+            return {
+                "sessions_resident": len(self._sessions),
+                "sessions_by_tier": {t: sum(1 for r in self._sessions.values()
+                                            if r.tier == t)
+                                     for t in TIERS_ALL},
+                "tier_bytes": {"host_tier": self._tier_bytes_locked("host"),
+                               "disk_tier": self._tier_bytes_locked("disk")},
+                "tier_promotions": {o: promos.get(o, 0) for o in OUTCOMES},
+                "tier_demotions": {t: self.demotions.get(t, 0)
+                                   for t in ("host", "disk")},
+                "tier_corrupt_blobs": self.corrupt_blobs,
+            }
+
+    def reset(self):
+        """Test/bench hook: drop every session (disk files included) and
+        zero the lifetime counters."""
+        with self._lock:
+            for rec in list(self._sessions.values()):
+                self._drop_locked(rec, "reset")
+            self._sessions.clear()
+            self._host.clear()
+            self._index.clear()
+            self.hibernated = 0
+            self.demotions.clear()
+            self.promotions.clear()
+            self.corrupt_blobs = 0
+            self.drops.clear()
+
+
+TIERS = TierStore()
+
+
+def reset() -> None:
+    TIERS.reset()

@@ -535,6 +535,61 @@ def page_blob_nbytes(blob: dict) -> int:
     return total
 
 
+# ---------------------------------------------------------------------------
+# Tier blobs (session hibernation disk tier, serve/tierstore.py)
+# ---------------------------------------------------------------------------
+#
+# Same CRC container as the hand-off page blobs, but a DIFFERENT lifetime:
+# a tier blob is a hibernated session's KV, expected to outlive engine
+# restarts and ``decode_scheduler.reset()``.  The family therefore lives in
+# its own directory (``PENROZ_TIER_DISK_PATH``, default a ``tier/`` subdir
+# of the shm models dir) so reset-time page-blob sweeps and the
+# ``model_*``/``adapter_*``/``pageblob_*`` globs never touch it.
+
+TIER_DISK_ENV = "PENROZ_TIER_DISK_PATH"
+
+
+def tier_dir() -> str:
+    override = os.environ.get(TIER_DISK_ENV)
+    if override:
+        return override
+    return os.path.join(SHM_PATH, MODELS_FOLDER, "tier")
+
+
+def tier_blob_path(blob_id: str) -> str:
+    return os.path.join(tier_dir(), f"tierblob_{blob_id}.ckpt")
+
+
+def save_tier_blob(blob_id: str, data: dict):
+    """Persist one hibernated-session blob (atomic write, CRC per stream)."""
+    os.makedirs(tier_dir(), exist_ok=True)
+    _atomic_write(tier_blob_path(blob_id), data)
+
+
+def load_tier_blob(blob_id: str) -> dict:
+    """Read a hibernated-session blob.  :raises KeyError: never saved or
+    already reclaimed; :raises ValueError: CRC/container corruption (the
+    tier store maps this to a miss + ``penroz_tier_corrupt_blobs_total``)."""
+    try:
+        return _read(tier_blob_path(blob_id))
+    except FileNotFoundError:
+        raise KeyError(f"Tier blob {blob_id} not saved.")
+
+
+def delete_tier_blob(blob_id: str) -> bool:
+    return _remove_quietly(tier_blob_path(blob_id))
+
+
+def tier_blob_nbytes(blob_id: str) -> int:
+    """On-disk size of a stored tier blob (0 if missing) — the disk-tier
+    byte accounting reads the container size, not the decoded payload, so
+    quota math matches what ``du`` would say."""
+    try:
+        return os.path.getsize(tier_blob_path(blob_id))
+    except OSError:
+        return 0
+
+
 def save(model_id: str, data: dict, sync_flush: bool = False):
     """Write checkpoint to shm and flush to disk in the background.
 

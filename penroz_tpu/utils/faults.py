@@ -40,7 +40,13 @@ host-staged blob for that hand-off, same greedy parity),
 ``disagg.rebalance`` (elastic role flip at an engine drain boundary,
 fired before any mutation — a crash must leave the role registry
 consistent and the memledger audit clean, with the flip retried at the
-next boundary).
+next boundary),
+``tier.demote`` (background session demotion HBM → host tier, fired
+before the page export — a crash must leave no leaked ``hibernating``
+pages after recovery and greedy replay must be identical),
+``tier.promote`` (promote-on-match session wake, fired before the blob
+import mutates the radix cache/pool — a crash recovers to a clean audit
+and the admission replays as a cold prefill with the same tokens).
 Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.
 """

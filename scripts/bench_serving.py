@@ -2208,6 +2208,205 @@ async def _bench_memory() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --sessions: hibernated-session resume TTFT across tiers vs cold prefill
+# ---------------------------------------------------------------------------
+
+async def _bench_sessions() -> dict:
+    """Session hibernation / KV tiering workload (serve/tierstore.py).
+
+    N sessions each generate once with a ``session_id`` — full
+    prompt+generated KV hibernates at retirement and demotes off-device in
+    the background — then every session is resumed (its full token history
+    as the prompt) under four placements:
+
+    - ``hbm``: right after retirement, the radix copy is still resident —
+      the wake aliases pages with no import;
+    - ``host``: after ``decode_scheduler.reset()`` destroyed the engine
+      (and its radix tree) — the wake imports the pinned-host blob into a
+      FRESH engine's radix cache;
+    - ``disk``: same, after ``PENROZ_TIER_HOST_MB=0`` forced the spill all
+      the way to the disk blob store;
+    - ``cold``: same prompts with every session record deleted — the full
+      re-prefill baseline the tiers have to beat.
+
+    Greedy parity is asserted across all four placements per prompt.  One
+    extra warm-up session per phase absorbs engine spin-up and XLA
+    compilation so the timed TTFTs measure the wake path, not the first
+    post-reset compile.  The headline gate: host-tier resume TTFT p50 at
+    least 2x faster than cold re-prefill."""
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 512)
+    # Default scale is where the tiering trade is real: prefill compute
+    # (O(d^2) per token) well above the blob-import memcpy (O(d)) — at
+    # toy scale the import cost would mask the recompute saving the
+    # tiers exist to avoid.
+    d = _env_i("PENROZ_BENCH_SERVING_D", 512)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 4)
+    sessions = _env_i("PENROZ_BENCH_SESSIONS", 4)
+    prompt_len = _env_i("PENROZ_BENCH_SESSION_PROMPT", 320)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 8)
+    page = _env_i("PENROZ_BENCH_PREFIX_PAGE", 16)
+    vocab = 512
+    assert prompt_len + 2 * max_new <= block
+
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        "PAGED_KV_CACHE": "1",
+        "PENROZ_KV_PAGE_SIZE": str(page),
+        "PENROZ_PREFIX_CACHE": "1",
+        # room for every session's pages at once plus churn
+        "PENROZ_PREFIX_CACHE_PAGES": str(
+            4 * (sessions + 1) * (-(-block // page))),
+    }
+    saved = {k: os.environ.get(k)
+             for k in (*env, "PENROZ_TIER_HOST_MB")}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(3)
+    # index 0 is the per-phase warm-up session; 1..N are timed
+    prompts = [[int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+               for _ in range(sessions + 1)]
+    sids = [f"bench-sess-{i}" for i in range(sessions + 1)]
+
+    def payload(prompt, session_id=None):
+        body = {"model_id": "bench-sessions", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+        if session_id:
+            body["session_id"] = session_id
+        return body
+
+    async def wait_tier(tier, deadline_s=30.0):
+        """Background demotion is asynchronous — poll /sessions/ until
+        every record reached ``tier`` (or the deadline trips)."""
+        deadline = time.perf_counter() + deadline_s
+        while True:
+            resp = await client.get("/sessions/")
+            body = await resp.json()
+            tiers = [s["tier"] for s in body["sessions"]]
+            if tiers and all(t == tier for t in tiers):
+                return body
+            assert time.perf_counter() < deadline, (tier, body)
+            await asyncio.sleep(0.05)
+
+    async def tier_counters():
+        resp = await client.get("/serving_stats/")
+        stats = await resp.json()
+        return {"promotions": dict(stats["tier_promotions"]),
+                "by_tier": dict(stats["sessions_by_tier"]),
+                "resident": stats["sessions_resident"]}
+
+    def promo_delta(before, after):
+        return {k: after["promotions"][k] - before["promotions"][k]
+                for k in after["promotions"]}
+
+    results: dict = {"mode": "sessions", "block_size": block,
+                     "page_size": page, "sessions": sessions,
+                     "prompt_len": prompt_len, "max_new_tokens": max_new,
+                     "model_d": d, "model_depth": depth}
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-sessions",
+            "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
+
+        # -- hibernate: one generation per session id -------------------
+        histories = []
+        for p, sid in zip(prompts, sids):
+            toks, _, _ = await _stream_one(client, payload(p, sid))
+            histories.append(p + toks)
+        listing = await wait_tier("host")
+        results["hibernated"] = listing["sessions_resident"]
+        results["nbytes_per_session"] = (
+            listing["sessions"][0]["nbytes"] if listing["sessions"] else 0)
+
+        outputs: dict = {}
+        ttfts: dict = {}
+
+        async def resume_phase(name):
+            """Warm-up resume (session 0, untimed) then timed resumes of
+            sessions 1..N; parity-checked against the other phases."""
+            before = await tier_counters()
+            await _stream_one(client, payload(histories[0]))
+            outs, times = [], []
+            for h in histories[1:]:
+                toks, ttft_ms, _ = await _stream_one(client, payload(h))
+                outs.append(toks)
+                times.append(ttft_ms)
+            outputs[name] = outs
+            ttfts[name] = times
+            results[f"resume_{name}"] = {
+                "ttft_ms_p50": round(_pct(times, 0.5), 3),
+                "ttft_ms_all": [round(t, 3) for t in times],
+                "promotions_delta": promo_delta(before,
+                                                await tier_counters()),
+            }
+
+        # -- hbm: radix copies still resident on the live engine --------
+        await resume_phase("hbm")
+
+        # -- host: fresh engine, blob import from pinned host RAM -------
+        decode_scheduler.reset()
+        await resume_phase("host")
+
+        # -- disk: re-hibernate under a zero host cap (spills every blob
+        # to the disk store), fresh engine again, import from disk ------
+        os.environ["PENROZ_TIER_HOST_MB"] = "0"
+        for h, sid in zip(histories, sids):
+            await _stream_one(client, payload(h, sid))
+        await wait_tier("disk")
+        decode_scheduler.reset()
+        await resume_phase("disk")
+
+        # -- cold: no sessions at all, full re-prefill ------------------
+        for sid in sids:
+            resp = await client.delete(f"/sessions/{sid}")
+            assert resp.status == 200, await resp.text()
+        resp = await client.get("/sessions/")
+        assert (await resp.json())["sessions_resident"] == 0
+        decode_scheduler.reset()
+        await resume_phase("cold")
+
+        results["parity_ok"] = (
+            outputs["hbm"] == outputs["host"] == outputs["disk"]
+            == outputs["cold"])
+        for tier in ("hbm", "host", "disk"):
+            results[f"ttft_p50_speedup_{tier}_vs_cold"] = round(
+                results["resume_cold"]["ttft_ms_p50"]
+                / max(results[f"resume_{tier}"]["ttft_ms_p50"], 1e-9), 3)
+        wakes = sessions  # timed resumes per warm phase
+        promoted = sum(results["resume_host"]["promotions_delta"].values())
+        results["promotion_hit_rate_host"] = round(
+            (results["resume_host"]["promotions_delta"]["ok"]
+             + results["resume_host"]["promotions_delta"]["partial"])
+            / max(promoted, 1), 3) if promoted else 0.0
+        results["wakes_per_phase"] = wakes
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
+        results["ok"] = bool(
+            results["parity_ok"]
+            and results["hibernated"] >= sessions
+            and results["ttft_p50_speedup_host_vs_cold"] >= 2.0)
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
 # --chaos: one armed fault site under overload (scripts/chaos_matrix.sh)
 # ---------------------------------------------------------------------------
 
@@ -2269,6 +2468,20 @@ async def _bench_chaos() -> dict:
         env["PENROZ_DISAGG_ELASTIC"] = "0"
         env["PENROZ_DISAGG_REBALANCE_COOLDOWN_MS"] = "0"
         env["PENROZ_DISAGG_REBALANCE_DOWN"] = "1000000000"
+    tier = site.startswith("tier.")
+    if tier:
+        # tier.demote / tier.promote only execute when sessions actually
+        # hibernate and wake: small pages so the short bench prompts span
+        # whole pages, session ids on every request (below), and the
+        # chaos waves replay each baseline's FULL token history so the
+        # promote-on-match import runs while armed
+        env["PENROZ_KV_PAGE_SIZE"] = "4"
+    if site == "tier.promote":
+        # the import only executes once the radix copy is gone (a
+        # radix-resident session wakes on the HBM fast path, no blob
+        # read) — a tiny prefix cache makes each baseline session evict
+        # its predecessors', so the armed wakes must import
+        env["PENROZ_PREFIX_CACHE_PAGES"] = "8"
     saved = {k: os.environ.get(k) for k in env}
     saved[faults.ENV] = os.environ.get(faults.ENV)
     os.environ.update(env)
@@ -2283,12 +2496,16 @@ async def _bench_chaos() -> dict:
     klass = ["batch" if i < offered - 2 else "interactive"
              for i in range(offered)]
 
-    async def one(prompt, priority=None):
+    sids = [f"chaos-{i}" if tier else None for i in range(offered)]
+
+    async def one(prompt, priority=None, session_id=None):
         body = {"model_id": "bench-chaos", "input": [prompt],
                 "block_size": block, "max_new_tokens": max_new,
                 "temperature": 0.0}
         if priority:
             body["priority"] = priority
+        if session_id:
+            body["session_id"] = session_id
         resp = await client.post("/generate/", json=body)
         return resp.status, (await resp.json() if resp.status != 204
                              else None)
@@ -2301,10 +2518,17 @@ async def _bench_chaos() -> dict:
         assert resp.status == 200, await resp.text()
 
         baselines = {}
-        for p in prompts:
-            status, body = await one(p)
+        for p, sid in zip(prompts, sids):
+            status, body = await one(p, session_id=sid)
             assert status == 200, body
             baselines[tuple(p)] = body["tokens"]
+
+        # Tier sites: the armed waves resume each baseline's session with
+        # its full history as the prompt — every admission is a hibernated
+        # wake (tier.promote fires mid-import) and every retirement
+        # re-hibernates (tier.demote fires in the background spill).
+        wave_prompts = ([baselines[tuple(p)] for p in prompts] if tier
+                        else prompts)
 
         os.environ[faults.ENV] = f"{site}:raise@{at}"
         if site == "disagg.rebalance":
@@ -2313,7 +2537,8 @@ async def _bench_chaos() -> dict:
         statuses: dict = {}
         for _ in range(waves):
             results = await asyncio.gather(
-                *[one(p, k) for p, k in zip(prompts, klass)])
+                *[one(p, k, sid)
+                  for p, k, sid in zip(wave_prompts, klass, sids)])
             for status, _ in results:
                 statuses[status] = statuses.get(status, 0) + 1
         os.environ.pop(faults.ENV, None)
@@ -2358,6 +2583,11 @@ async def _bench_chaos() -> dict:
             # disagg.rebalance evidence: the crashed flip retried and
             # landed (>0), with the role registry still consistent
             "disagg_role_changes": stats.get("disagg_role_changes", 0),
+            # tier.* evidence: sessions really hibernated and wakes really
+            # ran the promote import while the site was armed
+            "sessions_hibernated": stats.get("sessions_hibernated", 0),
+            "session_promotions": stats.get("session_promotions", 0),
+            "tier_promotions": stats.get("tier_promotions", {}),
             "parity_ok": parity_ok,
             "ok": not disallowed and parity_ok,
         }
@@ -2386,7 +2616,7 @@ def main():
             if a not in ("--shared-prefix", "--overload", "--speculative",
                          "--multi-adapter", "--multistep", "--mixed-slo",
                          "--chaos", "--ragged", "--memory", "--replicas",
-                         "--disagg", "--disagg-elastic")]
+                         "--disagg", "--disagg-elastic", "--sessions")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     replicas = "--replicas" in sys.argv[1:]
@@ -2395,6 +2625,7 @@ def main():
     multistep = "--multistep" in sys.argv[1:]
     mixed_slo = "--mixed-slo" in sys.argv[1:]
     chaos = "--chaos" in sys.argv[1:]
+    sessions = "--sessions" in sys.argv[1:]
     ragged = "--ragged" in sys.argv[1:]
     memory = "--memory" in sys.argv[1:]
     disagg = "--disagg" in sys.argv[1:]
@@ -2435,6 +2666,9 @@ def main():
         return
     if chaos:
         _emit(asyncio.run(_bench_chaos()))
+        return
+    if sessions:
+        _emit(asyncio.run(_bench_sessions()))
         return
     if ragged:
         _emit(asyncio.run(_bench_ragged()))

@@ -183,6 +183,21 @@ while true; do
           -- "BENCH_SHARD_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) replica-router capture committed" >> logs/bench_watch.log
     fi
+    # Session hibernation / KV tiering capture (same shape as the
+    # shared-prefix hook): resume TTFT per tier (hbm radix hit, host blob
+    # import, disk blob import) vs cold re-prefill, with greedy parity
+    # across all placements and the promotion hit rate.  Opt-in; failures
+    # must not block the main capture.
+    if [ "${PENROZ_WATCH_SESSIONS:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_TIER_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --sessions \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_TIER_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: session-tiering resume capture" \
+          -- "BENCH_TIER_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) session-tiering capture committed" >> logs/bench_watch.log
+    fi
     # Multi-tenant LoRA capture (same shape as the shared-prefix hook):
     # mixed-adapter ITL/wall vs per-adapter serial groups + parity.
     # Opt-in; failures must not block the main capture.

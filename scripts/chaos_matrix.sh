@@ -117,6 +117,35 @@ if [ "${CHAOS_FAST:-0}" != "1" ]; then
     fi
   done
 
+  # KV tiering / session hibernation (PR 17): tier.demote crashes the
+  # background spill of a freshly hibernated session's pages (worker-loop
+  # tail), tier.promote crashes a hibernated wake mid-import.  The bench
+  # attaches session ids and replays full histories so both sites really
+  # execute while armed; each crash must recover through the standard
+  # engine reset with no leaked hibernating pages (strict ledger audits
+  # the demote seam and crash recovery) and greedy parity on the solo
+  # replay — a hibernated wake after the crash recomputes or re-imports,
+  # never serves wrong tokens.
+  for tsite in ${CHAOS_TIER_SITES:-tier.demote tier.promote}; do
+    ran=$((ran + 1))
+    echo "=== chaos: site=$tsite sessions=1 ===" >&2
+    out=$(PENROZ_BENCH_CHAOS_SITE="$tsite" \
+            PENROZ_RAGGED_ATTENTION=1 PENROZ_MEMLEDGER_STRICT=1 \
+            timeout 900 python scripts/bench_serving.py --chaos)
+    rc=$?
+    echo "$out"
+    if [ "$rc" -ne 0 ]; then
+      echo "FAIL site=$tsite rc=$rc" >&2
+      fail=1
+      continue
+    fi
+    if ! printf '%s' "$out" | python -c \
+        'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") and r.get("sessions_hibernated", 0) > 0 else 1)'; then
+      echo "FAIL site=$tsite: disallowed statuses, parity break, or no hibernation" >&2
+      fail=1
+    fi
+  done
+
   # disagg.rebalance (PR 16): crash the first elastic role-flip attempt
   # (the bench arms elastic together with the fault, so flip #1 runs
   # armed).  The crash must recover with the role registry consistent

@@ -515,6 +515,61 @@ def test_disagg_elastic_bench_smoke(tmp_path):
     assert delta["penroz_disagg_handoff_bytes_count"] > 0, delta
 
 
+@pytest.mark.slow
+def test_sessions_bench_smoke(tmp_path):
+    """--sessions (PR 17): N sessions hibernate at retirement (KV demoted
+    HBM -> host -> disk), then resume under four placements — hbm radix
+    hit, host blob import after an engine reset, disk blob import after a
+    zero-host-cap spill, and cold re-prefill with the sessions deleted.
+    This smoke holds the STRUCTURAL gate: greedy parity across ALL four
+    placements, every session hibernated and demoted to the expected
+    tier, and promotions counted per tier.  The hbm radix hit skips the
+    whole prefill so its >=2x TTFT bound is structural even at toy scale;
+    the host/disk >=2x timing claims (full ok) are the committed
+    BENCH_TIER capture's job at the default O(d^2)-prefill scale."""
+    out_path = tmp_path / "sessions.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PENROZ_BENCH_SERVING_BLOCK="256",
+        PENROZ_BENCH_SERVING_D="128",
+        PENROZ_BENCH_SERVING_DEPTH="2",
+        PENROZ_BENCH_SESSIONS="2",
+        PENROZ_BENCH_SESSION_PROMPT="128",
+        PENROZ_BENCH_MAX_NEW="8",
+        PENROZ_BENCH_PREFIX_PAGE="16",
+        PENROZ_BENCH_JSON_OUT=str(out_path),
+    )
+    proc = subprocess.run([sys.executable, SCRIPT, "--sessions"],
+                          capture_output=True, text=True, timeout=900,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.loads(out_path.read_text()) == results
+
+    assert results["mode"] == "sessions"
+    assert results["parity_ok"] is True, results       # never wrong tokens
+    assert results["hibernated"] == 3, results         # 2 timed + 1 warm-up
+    assert results["nbytes_per_session"] > 0, results
+    # each warm phase woke every session from the tier under test
+    for tier in ("hbm", "host", "disk"):
+        ph = results[f"resume_{tier}"]
+        assert ph["ttft_ms_p50"] > 0, results
+        assert ph["promotions_delta"]["ok"] == 3, results
+        assert ph["promotions_delta"]["corrupt"] == 0, results
+    assert results["resume_cold"]["promotions_delta"]["ok"] == 0, results
+    # radix-hit resume skips the entire prefill: structural at any scale
+    assert results["ttft_p50_speedup_hbm_vs_cold"] >= 2.0, results
+    assert results["promotion_hit_rate_host"] == 1.0, results
+    delta = results["metrics_delta"]
+    assert delta["penroz_sessions_hibernated_total"] >= 3, delta
+    assert delta['penroz_tier_promotions_total'
+                 '{outcome="ok",tier="host"}'] == 3, delta
+    assert delta['penroz_tier_promotions_total'
+                 '{outcome="ok",tier="disk"}'] == 3, delta
+    assert delta["penroz_session_resume_ttft_ms_count"] > 0, delta
+
+
 def test_chaos_matrix_fast_subset(tmp_path):
     """scripts/chaos_matrix.sh CHAOS_FAST=1: the qos.preempt x unified
     combo through the chaos overload bench — the injected

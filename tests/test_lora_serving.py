@@ -110,7 +110,9 @@ def _submit(engine, prompt, max_new, adapter=None):
 # THE parity matrix: mixed batch == isolated per-adapter engines
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("prefix_cache", [False, True],
+@pytest.mark.parametrize("prefix_cache", [pytest.param(False,
+                                                       marks=pytest.mark.slow),
+                                          True],
                          ids=["nocache", "prefix"])
 @pytest.mark.parametrize("spec", [False, True], ids=["nospec", "spec"])
 @pytest.mark.parametrize("chunked", [pytest.param(False, marks=pytest.mark.slow),

@@ -599,3 +599,151 @@ def test_router_replicas_visible_in_stats_and_memory(gpt_model,
     for entry in mem["engines"]:
         pools = entry["pool_pages"]
         assert sum(pools.values()) == entry["pool_pages_total"]
+
+
+# ---------------------------------------------------------------------------
+# Hibernated-session placement (serve/tierstore.py, PR 17)
+# ---------------------------------------------------------------------------
+
+def _session_env(monkeypatch, tmp_path):
+    from penroz_tpu.serve import tierstore
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    monkeypatch.setenv("PENROZ_TIER_DISK_PATH", str(tmp_path / "tier"))
+    tierstore.reset()
+
+
+def _submit_session(router, prompt, max_new, session_id):
+    from penroz_tpu.serve import decode_scheduler
+    collector = _Collector(prompt)
+    router.submit(decode_scheduler.Request(prompt, max_new, None,
+                                           collector.on_event,
+                                           session_id=session_id))
+    return collector
+
+
+def _wait_tier(sid, tier, timeout=60):
+    from penroz_tpu.serve import tierstore
+    deadline = time.monotonic() + timeout
+    while True:
+        rec = tierstore.TIERS.get(sid)
+        if rec is not None and rec.tier == tier:
+            return rec
+        assert time.monotonic() < deadline, \
+            f"session {sid} never reached tier {tier!r}: {rec}"
+        time.sleep(0.02)
+
+
+def test_router_session_steer_to_home_replica(gpt_model, monkeypatch,
+                                              tmp_path):
+    """A wake prompt whose affinity entries are gone (LRU churn) still
+    lands on the replica that hibernated the session: the tier store's
+    placement record steers it home (outcome="session_steer"), where the
+    radix copy makes the wake HBM-fast."""
+    from penroz_tpu.serve import metrics as serve_metrics
+    from penroz_tpu.serve import tierstore
+    _session_env(monkeypatch, tmp_path)
+    router = _get_router(monkeypatch, n=2)
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    out = gpt_model.generate_tokens([prompt], BLOCK, 4, temperature=0.0)
+    cont = out + [9]
+    base = gpt_model.generate_tokens([cont], BLOCK, 3, temperature=0.0)
+    assert _submit_session(router, prompt, 4, "conv").result() == out
+    rec = _wait_tier("conv", "host")
+    home = int(rec.replica)
+    done_before = router.replicas[home].stats()["completed"]
+    with router._lock:          # simulate affinity-index LRU churn
+        router._affinity.clear()
+    before = serve_metrics.ROUTER_AFFINITY.value(outcome="session_steer")
+    assert _submit(router, cont, 3).result() == base
+    assert router.session_steers == 1
+    assert router.session_redirects == 0
+    assert serve_metrics.ROUTER_AFFINITY.value(outcome="session_steer") \
+        == before + 1
+    assert router.replicas[home].stats()["completed"] == done_before + 1
+    assert tierstore.TIERS.promotions[("hbm", "ok")] == 1  # radix-fast wake
+
+
+def test_router_session_redirect_when_home_breaker_open(gpt_model,
+                                                        monkeypatch,
+                                                        tmp_path):
+    """A hibernated session whose home replica is breaker-open wakes on a
+    healthy sibling (outcome="session_redirect") via the process-wide
+    host tier — and the record survives to steer home again after the
+    breaker closes."""
+    from penroz_tpu.serve import metrics as serve_metrics
+    from penroz_tpu.serve import tierstore
+    _session_env(monkeypatch, tmp_path)
+    router = _get_router(monkeypatch, n=2)
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    out = gpt_model.generate_tokens([prompt], BLOCK, 4, temperature=0.0)
+    cont = out + [9]
+    base = gpt_model.generate_tokens([cont], BLOCK, 3, temperature=0.0)
+    assert _submit_session(router, prompt, 4, "conv").result() == out
+    rec = _wait_tier("conv", "host")
+    home = int(rec.replica)
+    other = 1 - home
+    router.replicas[home]._breaker_open = True
+    router.replicas[home]._breaker_open_t = time.monotonic()
+    with router._lock:
+        router._affinity.clear()
+    assert _submit(router, cont, 3).result() == base
+    assert router.session_redirects == 1
+    assert serve_metrics.ROUTER_AFFINITY.value(outcome="session_redirect") \
+        >= 1
+    assert router.replicas[other].stats()["completed"] == 1
+    # blob import on the sibling, not an HBM alias on the dead home
+    assert tierstore.TIERS.promotions[("host", "ok")] == 1
+    # the record was NOT dropped: once the home recovers, steering resumes
+    router.replicas[home]._breaker_open = False
+    assert tierstore.TIERS.get("conv") is not None
+    with router._lock:
+        router._affinity.clear()
+    assert _submit(router, cont, 3).result() == base
+    assert router.session_steers == 1
+
+
+def test_router_session_placement_survives_role_flip(gpt_model,
+                                                     monkeypatch,
+                                                     tmp_path):
+    """Affinity-hygiene satellite: unlike prefix-affinity entries (which
+    age out on a stale role), a hibernated session's placement record
+    survives its home replica flipping to prefill-role — wakes redirect
+    to a decode sibling while flipped, then steer home again after the
+    replica flips back."""
+    from penroz_tpu.serve import tierstore
+    _disagg_env(monkeypatch)
+    monkeypatch.setenv("PENROZ_TIER_DISK_PATH", str(tmp_path / "tier"))
+    tierstore.reset()
+    router = _get_router(monkeypatch, n=3)
+    assert [e.role for e in router.replicas] == \
+        ["prefill", "decode", "decode"]
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    out = gpt_model.generate_tokens([prompt], BLOCK, 4, temperature=0.0)
+    cont = out + [9]
+    base = gpt_model.generate_tokens([cont], BLOCK, 3, temperature=0.0)
+    assert _submit_session(router, prompt, 4, "conv").result() == out
+    rec = _wait_tier("conv", "host")
+    home = int(rec.replica)
+    assert router.replicas[home].role == "decode"   # retired on decode
+    router.replicas[home].request_role("prefill")   # elastic flip
+    deadline = time.monotonic() + 60
+    while router.replicas[home].role != "prefill":
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    with router._lock:
+        router._affinity.clear()
+    assert _submit(router, cont, 3).result() == base
+    assert router.session_redirects == 1
+    assert tierstore.TIERS.get("conv") is not None  # record survived
+    router.replicas[home].request_role("decode")    # flip back
+    while router.replicas[home].role != "decode":
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    with router._lock:
+        router._affinity.clear()
+    assert _submit(router, cont, 3).result() == base
+    assert router.session_steers == 1               # home again
+    _assert_no_transit_or_blob_leaks()

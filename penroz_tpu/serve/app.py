@@ -349,7 +349,7 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
                  "continuous-batching scheduler", body.model_id)
         # submit BEFORE prepare: shed paths (429/503/504-queued) still get
         # their real status line instead of a broken 200 stream
-        req, queue = decode_scheduler.start_stream(
+        req, queue, stream = decode_scheduler.start_stream(
             engine, prompt, body.max_new_tokens, body.stop_token,
             body.timeout_ms, adapter=adapter, request_id=rid, trace=trace,
             priority=body.priority, tenant=body.tenant,
@@ -387,7 +387,7 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
     await response.prepare(request)
     try:
         while True:
-            kind, value = await queue.get()
+            seq, kind, value = await queue.get()
             if kind == "token":
                 await response.write(f"{value}\n".encode())
             elif kind == "done":
@@ -400,13 +400,24 @@ async def _try_scheduler_generate(request: web.Request, body, adapter=None):
             else:
                 raise value
     except asyncio.CancelledError:
-        # aiohttp cancels the handler on client disconnect — free the row
-        req.cancelled = True
+        # aiohttp cancels the handler on client disconnect.  With a
+        # detach grace configured (PENROZ_STREAM_DETACH_MS) the
+        # generation keeps running and the replay ring keeps filling for
+        # a GET /generate/{id}/stream reconnect; otherwise free the row
+        # exactly as before.
+        _stream_disconnect(stream, req)
         raise
+    except ConnectionResetError:
+        # A disconnect can also surface as a write-time reset ("Cannot
+        # write to closing transport") instead of a cancellation — same
+        # detach-or-cancel seam, but nothing more can be written.
+        _stream_disconnect(stream, req)
+        return response
     except Exception:  # noqa: BLE001 — headers already out; end + log
         req.cancelled = True
         log.exception("Scheduler streaming failed for model %s",
                       body.model_id)
+    stream.release()
     await response.write_eof()
     return response
 
@@ -501,6 +512,94 @@ async def _model_generate_legacy(request: web.Request, body, entry, rid):
                                       body.max_new_tokens, body.temperature,
                                       body.top_k, body.stop_token))
     return _json({"tokens": tokens})
+
+
+def _stream_disconnect(stream, req):
+    """The streaming client vanished (handler cancelled or a write-time
+    connection reset): detach when PENROZ_STREAM_DETACH_MS grants a
+    grace, let a finished stream's ring linger for late reconnects, and
+    otherwise fire the pre-existing cancellation path."""
+    from penroz_tpu.serve import streams
+    if stream.try_detach():
+        return
+    if stream.terminal:
+        stream.release()
+        return
+    req.cancelled = True
+    streams.STREAMS.discard(stream.request_id)
+
+
+async def resume_stream(request: web.Request):
+    """Reattach to a live token stream (GET
+    /generate/{request_id}/stream?from_seq=N): replays the events the
+    bounded per-request ring still holds from sequence number ``N`` on,
+    then continues live — exactly-once across the seam
+    (serve/streams.py).  Lines are ``seq:value`` (value = token int, or
+    the terminal ``done`` / ``timeout`` / ``error``), so the client
+    always knows the next ``from_seq`` to ask for.  404 for an unknown
+    or already-purged request id; 410 when ``from_seq`` fell behind the
+    ring (``PENROZ_STREAM_REPLAY``) or the detach grace already expired
+    — resuming would skip tokens, so the client must restart."""
+    from penroz_tpu.serve import streams
+    rid = request.match_info["request_id"]
+    try:
+        from_seq = int(request.query.get("from_seq", "0"))
+    except ValueError:
+        raise web.HTTPUnprocessableEntity(
+            text=json.dumps({"detail": "from_seq must be an integer"}),
+            content_type="application/json")
+    if from_seq < 0:
+        raise web.HTTPUnprocessableEntity(
+            text=json.dumps({"detail": "from_seq must be >= 0"}),
+            content_type="application/json")
+    sess = streams.STREAMS.get(rid)
+    if sess is None:
+        raise KeyError(
+            f"no resumable stream for request id {rid!r} (terminal "
+            f"streams linger briefly; expired/unknown ones do not)")
+    loop = asyncio.get_running_loop()
+    queue: asyncio.Queue = asyncio.Queue()
+    try:
+        backlog = sess.resume(loop, queue, from_seq)
+    except streams.ReplayGapError as exc:
+        return _json({"detail": f"Gone: {exc}"}, status=410)
+    log.info("Stream %s resumed at seq %d (%d ring event(s) to replay)",
+             rid, from_seq, len(backlog))
+
+    def _line(seq: int, kind: str, value) -> bytes:
+        return (f"{seq}:{value}\n" if kind == "token"
+                else f"{seq}:{kind}\n").encode()
+
+    response = web.StreamResponse(
+        headers={"Content-Type": "text/plain; charset=utf-8",
+                 "X-Request-Id": rid})
+    await response.prepare(request)
+    terminal = False
+    try:
+        for seq, kind, value in backlog:
+            await response.write(_line(seq, kind, value))
+            if kind in ("done", "timeout", "error"):
+                terminal = True
+                break
+        while not terminal:
+            seq, kind, value = await queue.get()
+            await response.write(_line(seq, kind, value))
+            if kind in ("done", "timeout", "error"):
+                terminal = True
+    except asyncio.CancelledError:
+        # the resumed consumer vanished too: same detach-or-cancel seam
+        # as the original stream handler
+        _stream_disconnect(sess, sess.req)
+        raise
+    except ConnectionResetError:
+        _stream_disconnect(sess, sess.req)
+        return response
+    except Exception:  # noqa: BLE001 — headers already out; end + log
+        sess.req.cancelled = True
+        log.exception("Resumed stream %s failed mid-write", rid)
+    sess.release()
+    await response.write_eof()
+    return response
 
 
 async def _resolve_batch_adapters(body):
@@ -849,11 +948,17 @@ async def put_tenant_quota(request: web.Request):
         raise ValueError("tokens_per_s must be >= 0 (or null to clear "
                          "the override)")
     qos.QUOTAS.set_rate(tenant_id, body.tokens_per_s)
+    journal_fields = {"tenant": tenant_id, "rate": body.tokens_per_s}
     if "tier_mb" in body.model_fields_set:
         if body.tier_mb is not None and body.tier_mb < 0:
             raise ValueError("tier_mb must be >= 0 (or null to clear "
                              "the override)")
         qos.QUOTAS.set_tier_mb(tenant_id, body.tier_mb)
+        journal_fields["tier_mb"] = body.tier_mb
+    # Write-ahead: the override survives a process restart
+    # (tierstore.recover() replays quota records last-write-wins).
+    from penroz_tpu.serve import journal
+    journal.JOURNAL.append("quota", **journal_fields)
     log.info("Tenant %s quota %s", tenant_id,
              "cleared (env default)" if body.tokens_per_s is None
              else f"set to {body.tokens_per_s} tokens/s")
@@ -967,9 +1072,13 @@ async def debug_dump(request: web.Request):
     queue depths, recent trace ids — captured at every engine crash,
     circuit-breaker open, and failed reset, before recovery wipes the
     state (serve/memledger.py FlightRecorder)."""
-    from penroz_tpu.serve import memledger
+    from penroz_tpu.serve import memledger, tierstore
+    dump = memledger.FLIGHT_RECORDER.dump()
+    # Restart forensics ride along: what the last tierstore.recover()
+    # replayed, dropped, and swept (empty dict before any recovery ran).
+    dump["restart_recovery"] = dict(tierstore.TIERS.last_recovery)
     return _json(schemas.DebugDumpResponse.model_validate(
-        memledger.FLIGHT_RECORDER.dump()).model_dump())
+        dump).model_dump())
 
 
 async def healthz(request: web.Request):
@@ -982,15 +1091,19 @@ async def healthz(request: web.Request):
 async def readyz(request: web.Request):
     """Readiness: 503 while the scheduler path cannot serve — an open
     standalone-engine breaker, or (PENROZ_SCHED_REPLICAS > 1) a replica
-    group with EVERY breaker open, or a drain in progress.  One healthy
-    replica keeps its model ready: the router fails admissions over to it
-    instead of 503ing, so load balancers keep routing here."""
+    group with EVERY breaker open, a worker stuck inside one tick
+    dispatch past PENROZ_TICK_WATCHDOG_MS (same group-aware rule), or a
+    drain in progress.  One healthy replica keeps its model ready: the
+    router fails admissions over to it instead of 503ing, so load
+    balancers keep routing here."""
     from penroz_tpu.serve import decode_scheduler
     breaker_open = decode_scheduler.breaker_open_engines()
+    stuck = decode_scheduler.stuck_engines()
     draining = decode_scheduler.draining()
-    ready = not breaker_open and not draining
+    ready = not breaker_open and not stuck and not draining
     return _json({"ready": ready, "draining": draining,
-                  "breaker_open_engines": breaker_open},
+                  "breaker_open_engines": breaker_open,
+                  "stuck_engines": stuck},
                  status=200 if ready else 503)
 
 
@@ -1052,6 +1165,12 @@ async def create_adapter(request: web.Request):
     blob = await _run_blocking(
         lambda: lora.create_adapter(body.adapter_id, model, cfg,
                                     seed=body.seed, init=body.init))
+    # Journal the registration (informational: the adapter's factors are
+    # already durable as a checkpoint; the record makes the restart
+    # recovery summary account for every registered adapter).
+    from penroz_tpu.serve import journal
+    journal.JOURNAL.append("adapter", adapter_id=body.adapter_id,
+                           model_id=body.model_id)
     return _json({"adapter_id": body.adapter_id, "model_id": body.model_id,
                   "config": blob["config"],
                   "message": f"Adapter {body.adapter_id} created for model "
@@ -1133,6 +1252,14 @@ def create_app() -> web.Application:
     # stale pre-restart payload).  patch_meta keeps this cheap — O(file
     # copy) per orphan, no array decode.
     _sweep_orphaned_training()
+    # Restart recovery (serve/tierstore.py): replay the write-ahead
+    # journal and cross-check the disk tier BEFORE the socket binds, so
+    # the first GET /sessions/ already lists every session that survived
+    # a kill -9 — and a torn journal tail or orphaned atomic-write temp
+    # is repaired before any request can race it.  A no-op (plus orphan
+    # temp sweep) when PENROZ_JOURNAL_PATH is unset.
+    from penroz_tpu.serve import tierstore
+    tierstore.TIERS.recover()
     app = web.Application(middlewares=[request_id_middleware,
                                        error_middleware, gzip_middleware],
                           client_max_size=1024 ** 3)
@@ -1156,6 +1283,7 @@ def create_app() -> web.Application:
     app.router.add_post("/output/", compute_model_output)
     app.router.add_post("/evaluate/", evaluate_model)
     app.router.add_post("/generate/", model_generate)
+    app.router.add_get("/generate/{request_id}/stream", resume_stream)
     app.router.add_post("/generate_batch/", model_generate_batch)
     app.router.add_post("/decode/", decode_tokens)
     app.router.add_put("/train/", train_model)

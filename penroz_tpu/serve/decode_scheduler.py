@@ -162,10 +162,12 @@ from penroz_tpu.models import model as model_mod
 from penroz_tpu.models.model import NeuralNetworkModel
 from penroz_tpu.ops import kv_cache as KV
 from penroz_tpu.serve import adapters as adapters_mod
+from penroz_tpu.serve import journal
 from penroz_tpu.serve import memledger
 from penroz_tpu.serve import metrics as serve_metrics
 from penroz_tpu.serve import qos
 from penroz_tpu.serve import spec_decode
+from penroz_tpu.serve import streams
 from penroz_tpu.serve import tierstore
 from penroz_tpu.serve.qos import TenantQuotaExceeded  # noqa: F401 — re-export
 from penroz_tpu.utils import bucketing, checkpoint, faults, profiling
@@ -197,6 +199,9 @@ REPLICAS_ENV = "PENROZ_SCHED_REPLICAS"
 # crash-safe fallback whenever the d2d path fails mid-hand-off).
 DISAGG_TRANSPORT_ENV = "PENROZ_DISAGG_TRANSPORT"
 DISAGG_ACK_TIMEOUT_ENV = "PENROZ_DISAGG_ACK_TIMEOUT_MS"
+# Worker-tick watchdog: an engine is "stuck" when its worker has been
+# inside ONE tick dispatch longer than this many ms (0/unset = off).
+TICK_WATCHDOG_ENV = "PENROZ_TICK_WATCHDOG_MS"
 
 # Max tick-timeline entries served per /serving_stats/ payload (the ring
 # itself holds PENROZ_TICK_TIMELINE entries).
@@ -255,6 +260,13 @@ def _env_int(name: str, default: int, lo: int = 1) -> int:
         log.warning("Unparseable %s=%r; using default %d", name,
                     os.environ.get(name), default)
         return default
+
+
+def _watchdog_ms() -> float:
+    try:
+        return max(0.0, float(os.environ.get(TICK_WATCHDOG_ENV, "0")))
+    except ValueError:
+        return 0.0
 
 
 def _env_float(name: str, default: float) -> float:
@@ -667,6 +679,16 @@ class DecodeEngine:
         self._session_promotions = 0
         self._h_resume_ttft = metrics_util.Hist()
 
+        # Worker-tick watchdog (PENROZ_TICK_WATCHDOG_MS): _dispatch_t0 is
+        # set for exactly the duration of one tick's device dispatch and
+        # cleared in a finally, so "stuck" is computable lazily at scrape
+        # //readyz time with no extra thread — a wedged dispatch (device
+        # hang, pathological compile) becomes visible while it is still
+        # wedged.  _watchdog_fired makes the flight-recorder postmortem
+        # one-shot per episode.
+        self._dispatch_t0 = None
+        self._watchdog_fired = False
+
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"penroz-sched-{model_id}-{self.block_size}")
@@ -863,6 +885,30 @@ class DecodeEngine:
     def idle(self) -> bool:
         return self.active_rows == 0 and not self._pending
 
+    def stuck(self) -> bool:
+        """Watchdog verdict, computed lazily at read time (scrape /
+        /readyz / serving_stats — no watchdog thread exists): True while
+        the worker has been inside ONE tick dispatch longer than
+        ``PENROZ_TICK_WATCHDOG_MS`` (0/unset = watchdog off).  The first
+        read of a stuck episode records a ``watchdog`` flight-recorder
+        entry so the pre-hang tick timeline survives for the postmortem
+        even if the process is later killed."""
+        limit = _watchdog_ms()
+        t0 = self._dispatch_t0
+        if limit <= 0 or t0 is None:
+            return False
+        if (time.monotonic() - t0) * 1000.0 < limit:
+            return False
+        if not self._watchdog_fired:
+            self._watchdog_fired = True
+            memledger.FLIGHT_RECORDER.record(
+                self, "watchdog",
+                error=f"tick dispatch exceeded {limit:.0f} ms")
+            log.warning("Decode engine %s watchdog: tick dispatch running "
+                        "for %.0f ms (limit %.0f ms)", self.model_id,
+                        (time.monotonic() - t0) * 1000.0, limit)
+        return True
+
     @property
     def disagg_transport(self) -> str:
         """Live hand-off transport this engine exports with."""
@@ -955,6 +1001,7 @@ class DecodeEngine:
             "queue_wait_ms_p99": (round(queue_wait_p99, 3)
                                   if queue_wait_p99 is not None else None),
             "breaker_open": self._breaker_open,
+            "stuck": self.stuck(),
             "consecutive_crashes": self._crashes,
             "crashes_total": self._crashes_total,
             "engine_resets": self._engine_resets,
@@ -1124,16 +1171,21 @@ class DecodeEngine:
         chunks0 = self._prefill_chunks
         verify_rows = shared_rows = emitted = steps = 0
         t0 = time.monotonic()
-        with profiling.span("penroz/sched_tick"):
-            self._prefill_tick()
-            if self._decoding_rows():
-                n = self._plan_superstep()
-                if n > 1:
-                    shared_rows, emitted = self._superstep(n)
-                    steps = n
-                else:
-                    verify_rows, shared_rows, emitted = self._step()
-                    steps = 1
+        self._dispatch_t0 = t0
+        try:
+            with profiling.span("penroz/sched_tick"):
+                self._prefill_tick()
+                if self._decoding_rows():
+                    n = self._plan_superstep()
+                    if n > 1:
+                        shared_rows, emitted = self._superstep(n)
+                        steps = n
+                    else:
+                        verify_rows, shared_rows, emitted = self._step()
+                        steps = 1
+        finally:
+            self._dispatch_t0 = None
+            self._watchdog_fired = False
         dur_ms = (time.monotonic() - t0) * 1000.0
         self._h_tick.observe(dur_ms)
         serve_metrics.TICK_MS.observe(dur_ms)
@@ -1175,11 +1227,16 @@ class DecodeEngine:
         ``PENROZ_SCHED_SUPERSTEP`` granularity trade as the phased path."""
         _warn_stall_deprecated()
         t0 = time.monotonic()
-        with profiling.span("penroz/sched_tick"):
-            plan = self._plan_mixed()
-            if plan is None:
-                return
-            comp = self._mixed_dispatch(plan)
+        self._dispatch_t0 = t0
+        try:
+            with profiling.span("penroz/sched_tick"):
+                plan = self._plan_mixed()
+                if plan is None:
+                    return
+                comp = self._mixed_dispatch(plan)
+        finally:
+            self._dispatch_t0 = None
+            self._watchdog_fired = False
         dur_ms = (time.monotonic() - t0) * 1000.0
         self._h_tick.observe(dur_ms)
         serve_metrics.TICK_MS.observe(dur_ms)
@@ -3202,6 +3259,26 @@ def breaker_open_engines() -> list[str]:
     return sorted(out)
 
 
+def stuck_engines() -> list[str]:
+    """model_ids whose worker is wedged inside a tick dispatch longer than
+    ``PENROZ_TICK_WATCHDOG_MS`` — the watchdog readiness signal (and the
+    ``penroz_engine_stuck`` gauge).  Same group-aware rule as
+    ``breaker_open_engines``: a standalone stuck engine names its model;
+    a router-owned replica group reports only when EVERY replica is stuck,
+    because one live replica keeps the model serving."""
+    with _REG_LOCK:
+        live = [e for e in _ENGINES.values() if not e._shutdown]
+    out = set()
+    groups: dict = {}
+    for e in live:
+        if e._router_owned:
+            groups.setdefault(e.model_id, []).append(e.stuck())
+        elif e.stuck():
+            out.add(e.model_id)
+    out.update(m for m, vals in groups.items() if all(vals))
+    return sorted(out)
+
+
 def drain_and_shutdown(drain_s: float | None = None) -> bool:
     """Graceful server shutdown: mark the registry draining (readyz flips
     not-ready, engines stop admitting), give in-flight rows up to
@@ -3371,6 +3448,14 @@ def serving_stats() -> dict:
                                                 0.5),
         "session_resume_ttft_ms_p99": _merged_q(per, "session_resume_ttft_ms",
                                                 0.99),
+        # Crash durability (serve/journal.py, serve/streams.py): the
+        # write-ahead journal's counters, the last restart-recovery
+        # summary (tierstore.recover()), the resumable-stream registry,
+        # and the tick-watchdog verdict.
+        "journal": journal.JOURNAL.stats(),
+        "restart_recovery": tiers["restart_recovery"],
+        "streams": streams.STREAMS.stats(),
+        "engines_stuck": len(stuck_engines()),
     }
 
 
@@ -3448,13 +3533,25 @@ async def run_request(engine: DecodeEngine, prompt, max_new_tokens,
 def start_stream(engine: DecodeEngine, prompt, max_new_tokens, stop_token,
                  timeout_ms=None, adapter=None, request_id=None,
                  trace=None, priority=None, tenant=None, session_id=None):
-    """Submit a streaming request; returns ``(req, queue)`` so the HTTP
-    layer can consume events AND flip ``req.cancelled`` itself when the
-    client goes away mid-stream (a write failure is invisible to an async
-    generator until its GC-time close — the explicit handle is the
-    disconnect wiring)."""
+    """Submit a streaming request; returns ``(req, queue, stream)`` so the
+    HTTP layer can consume events AND flip ``req.cancelled`` itself when
+    the client goes away mid-stream (a write failure is invisible to an
+    async generator until its GC-time close — the explicit handle is the
+    disconnect wiring).
+
+    Events route through a :class:`serve.streams.StreamSession` replay
+    ring, so the queue carries ``(seq, kind, value)`` triples and a
+    dropped client can reattach at ``GET /generate/{id}/stream?from_seq=N``
+    (serve/streams.py).  ``stream`` is the session handle: the HTTP layer
+    calls ``stream.try_detach()`` on disconnect (grace window instead of
+    cancel when ``PENROZ_STREAM_DETACH_MS`` > 0) and ``stream.release()``
+    when it finishes reading."""
     req, queue = _async_request(prompt, max_new_tokens, stop_token,
                                 timeout_ms, adapter, request_id, trace,
                                 priority, tenant, session_id)
+    rid = req.request_id or f"req-{id(req):x}"
+    stream = streams.STREAMS.register(rid, req)
+    stream.attach_initial(asyncio.get_running_loop(), queue)
+    req.on_event = stream.publish
     engine.submit(req)
-    return req, queue
+    return req, queue, stream

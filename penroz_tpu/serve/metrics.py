@@ -25,7 +25,11 @@ counters   requests_total{outcome}, decode_tokens_total,
            disagg_handoffs_total{outcome,transport},
            disagg_role_changes_total,
            tier_promotions_total{tier,outcome}, tier_demotions_total{tier},
-           tier_corrupt_blobs_total, sessions_hibernated_total
+           tier_corrupt_blobs_total, sessions_hibernated_total,
+           journal_appends_total, journal_errors_total,
+           journal_bad_records_total, journal_compactions_total,
+           sessions_recovered_total, stream_detaches_total,
+           stream_resumes_total, stream_detach_expired_total
 gauges     engines, active_rows, queue_depth, batch_occupancy,
            breaker_open, draining, lora_live_adapters,
            kv_pool_capacity_drops, prefix_cache_unpin_underflow
@@ -167,6 +171,39 @@ SESSIONS_HIBERNATED = REGISTRY.register(m.Counter(
     "penroz_sessions_hibernated_total",
     "Session retirements that hibernated the row's full prompt+"
     "generated KV into the tier store"))
+JOURNAL_APPENDS = REGISTRY.register(m.Counter(
+    "penroz_journal_appends_total",
+    "Records durably framed into the write-ahead session journal "
+    "(serve/journal.py, PENROZ_JOURNAL_PATH)"))
+JOURNAL_ERRORS = REGISTRY.register(m.Counter(
+    "penroz_journal_errors_total",
+    "Journal appends dropped by a write failure (injected or real) — "
+    "contained: serving continues, restart recovery degrades"))
+JOURNAL_BAD = REGISTRY.register(m.Counter(
+    "penroz_journal_bad_records_total",
+    "Frames dropped by replay truncation (torn tail / CRC mismatch) — "
+    "bounded loss of the newest record(s), never a crash"))
+JOURNAL_COMPACTIONS = REGISTRY.register(m.Counter(
+    "penroz_journal_compactions_total",
+    "Journal rewrites triggered by the dead-record ratio "
+    "(PENROZ_JOURNAL_COMPACT_RATIO)"))
+SESSIONS_RECOVERED = REGISTRY.register(m.Counter(
+    "penroz_sessions_recovered_total",
+    "Hibernated sessions restored into the tier store by startup journal "
+    "replay + disk-scan cross-check (they resume from the disk tier "
+    "instead of cold after a process restart)"))
+STREAM_DETACHES = REGISTRY.register(m.Counter(
+    "penroz_stream_detaches_total",
+    "Client disconnects that detached a /generate/ stream instead of "
+    "cancelling it (PENROZ_STREAM_DETACH_MS grace; decode keeps running)"))
+STREAM_RESUMES = REGISTRY.register(m.Counter(
+    "penroz_stream_resumes_total",
+    "Reconnects via GET /generate/{request_id}/stream?from_seq=N that "
+    "replayed the missed events exactly once from the replay ring"))
+STREAM_EXPIRED = REGISTRY.register(m.Counter(
+    "penroz_stream_detach_expired_total",
+    "Detached streams whose grace window expired with no reconnect — "
+    "the normal cancellation path then fired"))
 
 # -- histograms (engine observes the global mirror alongside its own) -------
 
@@ -279,6 +316,14 @@ SESSIONS_RESIDENT = REGISTRY.register(m.Gauge(
     "penroz_sessions_resident",
     "Hibernated sessions currently resident across all tiers (process-"
     "wide tier store)"))
+ENGINE_STUCK = REGISTRY.register(m.Gauge(
+    "penroz_engine_stuck",
+    "Engines whose in-flight tick dispatch has exceeded "
+    "PENROZ_TICK_WATCHDOG_MS (watchdog; 0 when the knob is off)"))
+STREAMS_DETACHED = REGISTRY.register(m.Gauge(
+    "penroz_streams_detached",
+    "Resumable /generate/ streams currently inside their disconnect "
+    "grace window, decode still running"))
 
 
 def _wire_gauges():
@@ -331,6 +376,11 @@ def _wire_gauges():
     TIER_PAGES.set_function(lambda: tierstore.TIERS.pages_by_tier())
     SESSIONS_RESIDENT.set_function(
         lambda: tierstore.TIERS.resident_sessions())
+
+    ENGINE_STUCK.set_function(lambda: len(ds.stuck_engines()))
+
+    from penroz_tpu.serve import streams
+    STREAMS_DETACHED.set_function(streams.STREAMS.detached_count)
 
 
 _WIRED = False

@@ -448,6 +448,11 @@ class EngineStats(BaseModel):
                                "(PENROZ_ENGINE_MAX_CRASHES consecutive "
                                "crashes open it; a successful probe "
                                "closes it)")
+    stuck: bool = Field(False, description="Worker-tick watchdog verdict: "
+                        "the worker has been inside ONE tick dispatch "
+                        "longer than PENROZ_TICK_WATCHDOG_MS (0/unset = "
+                        "watchdog off; computed at read time — /readyz "
+                        "503s only when a model has NO unstuck replica)")
     consecutive_crashes: int = Field(0, description="Tick crashes since "
                                      "the last successfully completed "
                                      "request")
@@ -704,6 +709,28 @@ class ServingStatsResponse(BaseModel):
         "(merged histogram buckets; penroz_session_resume_ttft_ms)")
     session_resume_ttft_ms_p99: Optional[float] = Field(
         None, description="p99 session-resume TTFT across engines")
+    journal: dict = Field(
+        default_factory=dict, description="Write-ahead journal counters "
+        "(serve/journal.py): enabled, fsync policy, records in the "
+        "current log, lifetime appends/append_errors, bad_records + "
+        "truncated_bytes dropped by torn-tail replay truncation, "
+        "compactions, last replay_ms")
+    restart_recovery: dict = Field(
+        default_factory=dict, description="Summary of the last "
+        "tierstore.recover() (runs at create_app, before the socket "
+        "binds): records_replayed, sessions_recovered/volatile/stale/"
+        "blob_missing/blob_corrupt, quota_overrides_replayed, "
+        "blobs_swept + temp_files_swept, replay_ms — empty before any "
+        "recovery ran")
+    streams: dict = Field(
+        default_factory=dict, description="Resumable-stream registry "
+        "(serve/streams.py): active/detached rings, lifetime detaches/"
+        "resumes/expired, PENROZ_STREAM_REPLAY ring capacity and "
+        "PENROZ_STREAM_DETACH_MS grace in effect")
+    engines_stuck: int = Field(
+        0, description="Engines currently failing the worker-tick "
+        "watchdog, group-aware (penroz_engine_stuck gauge; names appear "
+        "in /readyz stuck_engines)")
 
 
 class SessionInfo(BaseModel):
@@ -825,6 +852,10 @@ class DebugDumpResponse(BaseModel):
         "shape), tick_timeline (last PENROZ_DEBUG_DUMP_TICKS TickRecords), "
         "queue_depth_by_class, queue_depth_by_tenant, recent_traces "
         "{completed, live}")
+    restart_recovery: dict = Field(
+        default_factory=dict, description="The last tierstore.recover() "
+        "summary (journal replay + disk-tier cross-check + orphan "
+        "sweeps); empty before any recovery ran this process")
 
 
 class ProfileRequest(BaseModel):

@@ -329,6 +329,23 @@ function renderServing(data) {
       `${resumeP99 == null ? "—" : resumeP99.toFixed(0) + "ms"}` +
       `${data.tier_corrupt_blobs ? ` · CORRUPT ${data.tier_corrupt_blobs}`
          : ""}`;
+  /* Crash durability (PR 18): write-ahead journal health, what the last
+   * restart recovered, detached-but-running resumable streams, and the
+   * tick watchdog — "journal off" when PENROZ_JOURNAL_PATH is unset. */
+  const jr = data.journal || {};
+  const rec = data.restart_recovery || {};
+  const streams = data.streams || {};
+  const stuck = data.engines_stuck || 0;
+  const durTxt = (!jr.enabled && !streams.active && !stuck)
+    ? "journal off"
+    : `journal ${jr.records || 0} rec` +
+      `${jr.append_errors ? ` (${jr.append_errors} ERR)` : ""}` +
+      `${jr.bad_records ? ` · torn ${jr.bad_records}` : ""}` +
+      `${rec.sessions_recovered ? ` · restored ${rec.sessions_recovered}`
+         : ""}` +
+      `${streams.detached ? ` · detached streams ${streams.detached}`
+         : ""}` +
+      `${stuck ? ` · STUCK ${stuck}` : ""}`;
   meta.textContent =
     `rows ${data.active_rows}/${data.capacity} (occupancy ` +
     `${(occ * 100).toFixed(0)}%) · queue ${data.queue_depth} · ` +
@@ -340,7 +357,7 @@ function renderServing(data) {
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
     `${multistepTxt} · ` +
     `${specTxt} · ${loraTxt} · ${prefixTxt} · ${qosTxt} · ${routerTxt} · ` +
-    `${disaggTxt} · ${tierTxt} · KV pool drops ${drops}`;
+    `${disaggTxt} · ${tierTxt} · ${durTxt} · KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();
   const xs = servingHistory.map((_, i) => i);

@@ -48,6 +48,16 @@ Per-tenant residency quotas ride the QoS machinery
 (``PENROZ_QOS_TENANT_TIER_MB`` + ``PUT /tenants/{id}/quota`` overrides):
 a hibernation that would put the tenant over cap evicts that tenant's LRU
 sessions first and is refused if the new session alone cannot fit.
+
+Durability: the registry is JOURNAL-BACKED when ``PENROZ_JOURNAL_PATH``
+is set (serve/journal.py) — every register/demote/promote/drop appends a
+CRC-framed record, and :meth:`TierStore.recover` (run once at app
+startup) replays the journal, cross-checks it against a scan of the
+disk tier (header-validate blobs, fence stale model stamps, sweep
+unreferenced blobs and torn temp files), and re-admits the disk-tier
+sessions — so hibernated sessions survive ``kill -9`` and resume from
+disk instead of cold.  HBM- and host-tier copies are volatile by
+design: only bytes that reached the disk tier outlive the process.
 """
 
 from __future__ import annotations
@@ -162,6 +172,59 @@ class TierStore:
         self.promotions = collections.Counter()   # (tier, outcome) -> count
         self.corrupt_blobs = 0
         self.drops = collections.Counter()        # reason -> count
+        self.last_recovery: dict = {}    # recover() summary (startup)
+        self._replaying = False          # recover() must not re-journal
+
+    # -- write-ahead journal --------------------------------------------------
+
+    def _journal(self, kind: str, **fields):
+        """Best-effort WAL append for one registry mutation (no-op while
+        the journal is disabled or recovery itself is replaying)."""
+        from penroz_tpu.serve import journal
+        if self._replaying or not journal.JOURNAL.enabled():
+            return
+        journal.JOURNAL.append(kind, **fields)
+
+    def _maybe_compact_locked(self):
+        from penroz_tpu.serve import journal
+        if self._replaying:
+            return
+        # Cheap live-count upper bound first; the snapshot walk only runs
+        # when the dead ratio actually trips.
+        if journal.JOURNAL.should_compact(self._live_record_count_locked()):
+            journal.JOURNAL.compact(self._snapshot_records_locked())
+
+    def _live_record_count_locked(self) -> int:
+        from penroz_tpu.serve import qos
+        return (len(self._sessions) + len(qos.QUOTAS.overrides())
+                + len(qos.QUOTAS.tier_overrides()))
+
+    def _snapshot_records_locked(self) -> list:
+        """The current registry + override state as journal records — what
+        compaction rewrites the log down to.  Adapter registrations are
+        re-derived from their (already durable) checkpoints."""
+        from penroz_tpu.serve import qos
+        from penroz_tpu.utils import checkpoint
+        recs = []
+        for r in self._sessions.values():
+            recs.append({"t": "register", "ts": r.created,
+                         "session_id": r.session_id, "tenant": r.tenant,
+                         "model_id": r.model_id,
+                         "model_stamp": r.model_stamp,
+                         "tokens": [int(t) for t in r.tokens],
+                         "kv_len": r.kv_len, "page_size": r.page_size,
+                         "quantized": r.quantized, "nbytes": r.nbytes,
+                         "replica": r.replica, "tier": r.tier})
+        now = time.time()
+        for tenant, rate in qos.QUOTAS.overrides().items():
+            recs.append({"t": "quota", "ts": now, "tenant": tenant,
+                         "rate": rate})
+        for tenant, mb in qos.QUOTAS.tier_overrides().items():
+            recs.append({"t": "quota", "ts": now, "tenant": tenant,
+                         "tier_mb": mb})
+        for aid in checkpoint.list_adapter_ids():
+            recs.append({"t": "adapter", "ts": now, "adapter_id": aid})
+        return recs
 
     # -- registration / demotion --------------------------------------------
 
@@ -220,6 +283,12 @@ class TierStore:
             self._sessions[session_id] = rec
             self._index_add(rec)
             self.hibernated += 1
+            self._journal("register", session_id=session_id, tenant=tenant,
+                          model_id=model_id, model_stamp=model_stamp,
+                          tokens=[int(t) for t in tokens],
+                          kv_len=int(kv_len), page_size=int(page_size),
+                          quantized=bool(quantized), nbytes=int(nbytes),
+                          replica=replica)
         from penroz_tpu.serve import metrics as serve_metrics
         serve_metrics.SESSIONS_HIBERNATED.inc()
         return True
@@ -241,6 +310,8 @@ class TierStore:
             self._host[session_id] = blob
             self.demotions["host"] += 1
             serve_metrics.TIER_DEMOTIONS.inc(tier="host")
+            self._journal("demote", session_id=session_id, tier="host",
+                          nbytes=rec.nbytes)
             self._enforce_caps_locked()
         return True
 
@@ -272,6 +343,8 @@ class TierStore:
             rec.nbytes = checkpoint.tier_blob_nbytes(rec.session_id)
             self.demotions["disk"] += 1
             serve_metrics.TIER_DEMOTIONS.inc(tier="disk")
+            self._journal("demote", session_id=rec.session_id, tier="disk",
+                          nbytes=rec.nbytes)
         disk_cap = disk_cap_bytes()
         while self._tier_bytes_locked("disk") > disk_cap:
             rec = self._lru_locked("disk")
@@ -317,6 +390,8 @@ class TierStore:
                     span = depth * P
                     if rec.kv_len >= span and rec.tokens[:span] == toks[:span]:
                         self.touch(sid)
+                        self._journal("promote", session_id=sid,
+                                      tier=rec.tier, depth=depth)
                         return rec, depth
             return None, 0
 
@@ -401,6 +476,8 @@ class TierStore:
             checkpoint.delete_tier_blob(rec.session_id)
         self._index_remove(rec)
         self.drops[reason] += 1
+        self._journal("drop", session_id=rec.session_id, reason=reason)
+        self._maybe_compact_locked()
 
     def drop(self, session_id: str, reason: str = "api") -> bool:
         """Evict one session from every tier (``DELETE /sessions/{id}``).
@@ -424,6 +501,174 @@ class TierStore:
             for rec in victims:
                 self._drop_locked(rec, reason)
             return len(victims)
+
+    # -- restart recovery ----------------------------------------------------
+
+    def recover(self) -> dict:
+        """Rebuild the registry after a process restart: replay the
+        journal into final per-session states, cross-check the survivors
+        against the disk tier (blob exists + container header validates
+        + model stamp is still current), re-admit what checks out, apply
+        journaled quota overrides, and sweep everything unreferenced —
+        orphan atomic-write temp files AND finished blobs no record
+        claims.  Called once from ``create_app()`` before routes are
+        built; idempotent and a cheap no-op when the journal is off.
+
+        Only disk-tier sessions recover: HBM pages and the pinned
+        host-RAM cache died with the process.  Recovered records carry
+        ``owner=None, replica=None`` so the router's steer-to-home
+        degrades to normal placement when the home replica no longer
+        exists."""
+        from penroz_tpu.serve import journal
+        from penroz_tpu.serve import metrics as serve_metrics
+        from penroz_tpu.serve import qos
+        from penroz_tpu.utils import checkpoint
+        t0 = time.monotonic()
+        summary = {
+            "journal_enabled": journal.JOURNAL.enabled(),
+            "records_replayed": 0, "bad_records": 0, "truncated_bytes": 0,
+            "replay_errors": 0, "sessions_recovered": 0,
+            "sessions_volatile": 0, "sessions_stale": 0,
+            "sessions_blob_missing": 0, "sessions_blob_corrupt": 0,
+            "quota_overrides_replayed": 0, "adapter_records_seen": 0,
+            "blobs_swept": 0, "temp_files_swept": 0, "replay_ms": 0.0,
+        }
+        records: list = []
+        if journal.JOURNAL.enabled():
+            # A SIGKILL mid-compaction can strand the rewrite temp.
+            try:
+                os.remove(f"{journal.journal_path()}.compact.tmp")
+            except OSError:
+                pass
+            try:
+                records = journal.JOURNAL.replay()
+            except Exception:  # noqa: BLE001 — recovery must never crash startup
+                summary["replay_errors"] += 1
+                log.warning("journal replay failed; recovering to an "
+                            "empty registry", exc_info=True)
+            summary["records_replayed"] = len(records)
+            summary["bad_records"] = journal.JOURNAL.bad_records
+            summary["truncated_bytes"] = journal.JOURNAL.truncated_bytes
+        # Fold the record stream into final per-session state (last write
+        # wins; promote = LRU touch so recovered eviction order matches).
+        finals: collections.OrderedDict = collections.OrderedDict()
+        quota_rate: dict = {}
+        quota_tier: dict = {}
+        for rec in records:
+            kind = rec.get("t")
+            sid = rec.get("session_id")
+            if kind == "register" and sid:
+                finals.pop(sid, None)
+                finals[sid] = dict(rec)
+            elif kind == "demote" and sid in finals:
+                finals[sid]["tier"] = rec.get("tier", "host")
+                finals[sid]["nbytes"] = rec.get(
+                    "nbytes", finals[sid].get("nbytes", 0))
+            elif kind == "promote" and sid in finals:
+                finals.move_to_end(sid)
+            elif kind == "drop" and sid:
+                finals.pop(sid, None)
+            elif kind == "quota" and rec.get("tenant") is not None:
+                if "rate" in rec:
+                    quota_rate[rec["tenant"]] = rec["rate"]
+                if "tier_mb" in rec:
+                    quota_tier[rec["tenant"]] = rec["tier_mb"]
+            elif kind == "adapter":
+                summary["adapter_records_seen"] += 1
+        with self._lock:
+            self._replaying = True
+            try:
+                for sid, rec in finals.items():
+                    if sid in self._sessions:
+                        continue   # live (warm, in-process) record wins
+                    if rec.get("tier", "hbm") != "disk":
+                        summary["sessions_volatile"] += 1
+                        continue
+                    try:
+                        self._recover_one_locked(rec, summary)
+                    except Exception:  # noqa: BLE001 — skip, never crash
+                        log.warning("could not recover session %r", sid,
+                                    exc_info=True)
+            finally:
+                self._replaying = False
+            referenced = [r.session_id for r in self._sessions.values()
+                          if r.tier == "disk"]
+        for tenant, rate in quota_rate.items():
+            qos.QUOTAS.set_rate(tenant, rate)
+            summary["quota_overrides_replayed"] += 1
+        for tenant, mb in quota_tier.items():
+            qos.QUOTAS.set_tier_mb(tenant, mb)
+            summary["quota_overrides_replayed"] += 1
+        # A failed replay means the reference set is unknown: sweep only
+        # the (always-safe) atomic-write temps, never finished blobs —
+        # a transient replay error must not destroy recoverable sessions.
+        summary.update(checkpoint.sweep_tier_orphans(
+            None if summary["replay_errors"] else referenced))
+        if summary["sessions_recovered"]:
+            serve_metrics.SESSIONS_RECOVERED.inc(
+                summary["sessions_recovered"])
+        summary["replay_ms"] = round((time.monotonic() - t0) * 1000.0, 3)
+        self.last_recovery = summary
+        with self._lock:
+            self._maybe_compact_locked()
+        if summary["sessions_recovered"] or summary["bad_records"]:
+            log.info("restart recovery: %(sessions_recovered)d session(s) "
+                     "restored, %(sessions_stale)d stale, "
+                     "%(sessions_blob_missing)d missing, "
+                     "%(sessions_blob_corrupt)d corrupt, "
+                     "%(bad_records)d bad journal record(s) "
+                     "(%(truncated_bytes)d torn bytes)", summary)
+        return summary
+
+    def _recover_one_locked(self, rec: dict, summary: dict):
+        """Admit one journal-final disk-tier session if its blob and
+        model stamp survive scrutiny (caller holds the lock with
+        ``_replaying`` set; counted drops here re-journal explicitly so
+        the next replay skips them)."""
+        from penroz_tpu.serve import journal
+        from penroz_tpu.utils import checkpoint
+        sid = rec["session_id"]
+
+        def _dead(counter: str, reason: str, delete_blob: bool):
+            summary[counter] += 1
+            if delete_blob:
+                checkpoint.delete_tier_blob(sid)
+            self.drops[reason] += 1
+            if journal.JOURNAL.enabled():
+                journal.JOURNAL.append("drop", session_id=sid, reason=reason)
+
+        if not os.path.exists(checkpoint.tier_blob_path(sid)):
+            _dead("sessions_blob_missing", "recover_blob_missing", False)
+            return
+        if not checkpoint.validate_tier_blob(sid):
+            self.corrupt_blobs += 1
+            _dead("sessions_blob_corrupt", "recover_blob_corrupt", True)
+            return
+        model_id = rec.get("model_id")
+        try:
+            current_stamp = os.path.getmtime(
+                checkpoint._source_path(model_id))
+        except OSError:
+            current_stamp = None
+        if current_stamp is None or rec.get("model_stamp") != current_stamp:
+            _dead("sessions_stale", "recover_stale_model", True)
+            return
+        tokens = tuple(int(t) for t in rec.get("tokens", ()))
+        kv_len = int(rec.get("kv_len", 0))
+        page_size = int(rec.get("page_size", 0) or 0)
+        if page_size < 1 or kv_len // page_size < 1:
+            _dead("sessions_blob_corrupt", "recover_bad_record", True)
+            return
+        fps = _fingerprints(tokens, page_size, kv_len // page_size)
+        sess = _Session(sid, rec.get("tenant"), model_id,
+                        rec.get("model_stamp"), tokens, kv_len, page_size,
+                        rec.get("quantized", False),
+                        checkpoint.tier_blob_nbytes(sid), None, None, fps)
+        sess.tier = "disk"
+        sess.created = float(rec.get("ts") or sess.created)
+        self._sessions[sid] = sess
+        self._index_add(sess)
+        summary["sessions_recovered"] += 1
 
     # -- introspection -------------------------------------------------------
 
@@ -488,6 +733,7 @@ class TierStore:
                 "tier_demotions": {t: self.demotions.get(t, 0)
                                    for t in ("host", "disk")},
                 "tier_corrupt_blobs": self.corrupt_blobs,
+                "restart_recovery": dict(self.last_recovery),
             }
 
     def reset(self):
@@ -504,6 +750,8 @@ class TierStore:
             self.promotions.clear()
             self.corrupt_blobs = 0
             self.drops.clear()
+            self.last_recovery = {}
+            self._replaying = False
 
 
 TIERS = TierStore()

@@ -590,6 +590,63 @@ def tier_blob_nbytes(blob_id: str) -> int:
         return 0
 
 
+def list_tier_blob_ids() -> list[str]:
+    """Session ids with a tier blob on disk.  Temp siblings from torn
+    atomic writes (``*.ckpt.<hex>``) don't match the glob — the restart
+    sweep handles those separately."""
+    import glob
+    import re
+    ids = []
+    for path in glob.glob(os.path.join(tier_dir(), "tierblob_*.ckpt")):
+        m = re.match(r"tierblob_(.+?)\.ckpt$", os.path.basename(path))
+        if m:
+            ids.append(m.group(1))
+    return sorted(ids)
+
+
+def validate_tier_blob(blob_id: str) -> bool:
+    """Cheap container-header check (magic + parseable header JSON) for
+    the restart recovery scan — full per-stream CRC verification still
+    happens at :func:`load_tier_blob` time."""
+    try:
+        with open(tier_blob_path(blob_id), "rb") as f:
+            _read_header(f)
+        return True
+    except (OSError, ValueError, KeyError, struct.error):
+        return False
+
+
+def sweep_tier_orphans(referenced_ids) -> dict:
+    """Startup sweep of the tier dir: remove (a) temp siblings a crash
+    left behind mid-atomic-write (``tierblob_*.ckpt.<12-hex>`` — torn
+    bytes that would silently consume disk-cap budget forever) and
+    (b) finished blobs no journal-recovered or live session references
+    (unreachable orphans).  ``referenced_ids=None`` means the reference
+    set is UNKNOWN (journal replay failed) — temps are still safe to
+    reap, but no finished blob is touched, so a transient replay error
+    never destroys recoverable sessions.  Returns removal counts."""
+    import glob
+    import re
+    referenced = None if referenced_ids is None else set(referenced_ids)
+    temps = blobs = 0
+    d = tier_dir()
+    if not os.path.isdir(d):
+        return {"temp_files_swept": 0, "blobs_swept": 0}
+    for path in glob.glob(os.path.join(d, "tierblob_*.ckpt.*")):
+        if re.search(r"\.ckpt\.[0-9a-f]{12}$", path) and _remove_quietly(path):
+            temps += 1
+    for path in glob.glob(os.path.join(d, "tierblob_*.ckpt")):
+        if referenced is None:
+            break
+        m = re.match(r"tierblob_(.+?)\.ckpt$", os.path.basename(path))
+        if m and m.group(1) not in referenced and _remove_quietly(path):
+            blobs += 1
+    if temps or blobs:
+        log.info("tier sweep: removed %d orphan temp file(s), %d "
+                 "unreferenced blob(s) from %s", temps, blobs, d)
+    return {"temp_files_swept": temps, "blobs_swept": blobs}
+
+
 def save(model_id: str, data: dict, sync_flush: bool = False):
     """Write checkpoint to shm and flush to disk in the background.
 

@@ -46,7 +46,16 @@ before the page export — a crash must leave no leaked ``hibernating``
 pages after recovery and greedy replay must be identical),
 ``tier.promote`` (promote-on-match session wake, fired before the blob
 import mutates the radix cache/pool — a crash recovers to a clean audit
-and the admission replays as a cold prefill with the same tokens).
+and the admission replays as a cold prefill with the same tokens),
+``journal.append`` (write-ahead journal frame write, fired inside the
+append's own try — a failure is CONTAINED: the record is dropped and
+counted, live serving proceeds unharmed),
+``journal.replay`` (startup journal replay, fired before any frame is
+read — a failure recovers to an empty registry and a clean audit, never
+a crashed startup),
+``stream.resume`` (stream reattach at GET /generate/{id}/stream, fired
+before the ring is consulted — a failure surfaces as the HTTP error
+while the generation keeps running and remains resumable).
 Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.
 """

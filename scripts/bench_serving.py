@@ -2407,6 +2407,253 @@ async def _bench_sessions() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --restart: crash-durable serving (journal replay + stream reconnect)
+# ---------------------------------------------------------------------------
+
+def _simulate_process_death():
+    """What SIGKILL leaves behind, in-process: the journal file and the
+    disk-tier blobs survive; every in-memory registry vanishes WITHOUT
+    running a single drop/demote path.  (The real-subprocess SIGKILL
+    variant lives in tests/test_journal.py — this bench measures the
+    recovery timings, which need a shared process for a fair clock.)"""
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import journal, streams, tierstore
+    with tierstore.TIERS._lock:
+        tierstore.TIERS._sessions.clear()
+        tierstore.TIERS._host.clear()
+        tierstore.TIERS._index.clear()
+    journal.JOURNAL.close()
+    journal.reset()        # fresh-process counters; the FILE is untouched
+    streams.reset()
+    app_mod.model_locks.clear()
+    app_mod.dataset_locks.clear()
+
+
+async def _bench_restart() -> dict:
+    """Crash-durability workload (serve/journal.py + tierstore recovery +
+    resumable streams).  Legs:
+
+    1. **Hibernate**: N sessions generate once each with a write-ahead
+       journal armed and ``PENROZ_TIER_HOST_MB=0`` so every blob lands in
+       the disk store.
+    2. **Warm-disk reference**: same-process resumes from the disk tier
+       (fresh engine) — PR 17's ~195 ms path, re-measured on this machine
+       so the restart gate is hardware-independent.
+    3. **Restart**: the process "dies" (see _simulate_process_death) and
+       a fresh ``create_app()`` replays the journal.  Reported:
+       sessions_restored, journal_replay_ms.
+    4. **Post-restart resume**: each session's full history re-submitted;
+       the wake must promote the recovered disk blob at greedy parity.
+       Headline gate: post-restart resume TTFT p50 within 1.5x of leg 2.
+    5. **Reconnect**: R streams drop mid-flight and reattach with
+       ``GET /generate/{id}/stream?from_seq`` — reconnect gap (close ->
+       first replayed event) p50/p99, with exactly-once sequence coverage
+       asserted on every cycle.
+    """
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.serve import streams as streams_mod
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 512)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 512)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 4)
+    sessions = _env_i("PENROZ_BENCH_SESSIONS", 4)
+    prompt_len = _env_i("PENROZ_BENCH_SESSION_PROMPT", 320)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 8)
+    page = _env_i("PENROZ_BENCH_PREFIX_PAGE", 16)
+    reconnects = _env_i("PENROZ_BENCH_RECONNECTS", 8)
+    vocab = 512
+    assert prompt_len + 2 * max_new <= block
+
+    durdir = tempfile.mkdtemp(prefix="penroz_bench_restart_")
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        "PAGED_KV_CACHE": "1",
+        "PENROZ_KV_PAGE_SIZE": str(page),
+        "PENROZ_PREFIX_CACHE": "1",
+        "PENROZ_PREFIX_CACHE_PAGES": str(
+            4 * (sessions + 1) * (-(-block // page))),
+        "PENROZ_TIER_HOST_MB": "0",           # demote straight to disk
+        "PENROZ_TIER_DISK_PATH": os.path.join(durdir, "tier"),
+        "PENROZ_JOURNAL_PATH": os.path.join(durdir, "serve.journal"),
+        "PENROZ_JOURNAL_FSYNC": "batch",
+        "PENROZ_STREAM_DETACH_MS": "60000",
+        "PENROZ_STREAM_REPLAY": str(4 * max_new),
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(11)
+    # index 0 is the per-phase warm-up session; 1..N are timed
+    prompts = [[int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+               for _ in range(sessions + 1)]
+    sids = [f"bench-restart-{i}" for i in range(sessions + 1)]
+
+    def payload(prompt, session_id=None):
+        body = {"model_id": "bench-restart", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+        if session_id:
+            body["session_id"] = session_id
+        return body
+
+    async def wait_tier(tier, deadline_s=30.0):
+        deadline = time.perf_counter() + deadline_s
+        while True:
+            resp = await client.get("/sessions/")
+            body = await resp.json()
+            tiers = [s["tier"] for s in body["sessions"]]
+            if tiers and all(t == tier for t in tiers):
+                return body
+            assert time.perf_counter() < deadline, (tier, body)
+            await asyncio.sleep(0.05)
+
+    async def resume_phase(name, results):
+        """Warm-up resume (session 0, untimed) then timed resumes of
+        sessions 1..N via promote-on-match of the full history."""
+        await _stream_one(client, payload(histories[0]))
+        outs, times = [], []
+        for h in histories[1:]:
+            toks, ttft_ms, _ = await _stream_one(client, payload(h))
+            outs.append(toks)
+            times.append(ttft_ms)
+        results[f"resume_{name}"] = {
+            "ttft_ms_p50": round(_pct(times, 0.5), 3),
+            "ttft_ms_all": [round(t, 3) for t in times]}
+        return outs
+
+    results: dict = {"mode": "restart", "block_size": block,
+                     "page_size": page, "sessions": sessions,
+                     "prompt_len": prompt_len, "max_new_tokens": max_new,
+                     "model_d": d, "model_depth": depth}
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-restart",
+            "layers": _toy_gpt(d=d, vocab=vocab, block=block, depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+
+        # -- leg 1: hibernate every session to the disk tier ------------
+        histories = []
+        for p, sid in zip(prompts, sids):
+            toks, _, _ = await _stream_one(client, payload(p, sid))
+            histories.append(p + toks)
+        await wait_tier("disk")
+        resp = await client.get("/serving_stats/")
+        results["journal_pre_kill"] = (await resp.json())["journal"]
+
+        # -- leg 2: same-process warm-disk reference (PR 17 path).  The
+        # wakes import the disk blobs but do NOT consume the records
+        # (match() journals a promote and leaves the tier alone), so the
+        # disk store is still fully populated when the process "dies".
+        decode_scheduler.reset()
+        warm_out = await resume_phase("warm_disk", results)
+
+        # -- leg 3: kill -9 and restart through create_app() ------------
+        decode_scheduler.reset()
+        await client.close()
+        _simulate_process_death()
+        t_restart = time.perf_counter()
+        client = TestClient(TestServer(app_mod.create_app()))
+        await client.start_server()
+        results["restart_wall_ms"] = round(
+            (time.perf_counter() - t_restart) * 1000.0, 3)
+        resp = await client.get("/serving_stats/")
+        stats = await resp.json()
+        recovery = stats["restart_recovery"]
+        results["restart_recovery"] = recovery
+        results["sessions_restored"] = recovery.get("sessions_recovered", 0)
+        results["journal_replay_ms"] = recovery.get("replay_ms", 0.0)
+        resp = await client.get("/sessions/")
+        listing = await resp.json()
+        results["restored_by_tier"] = dict(listing["sessions_by_tier"])
+
+        # -- leg 4: post-restart resume (recovered blobs, fresh engine) -
+        post_out = await resume_phase("post_restart", results)
+        resp = await client.get("/serving_stats/")
+        promos = (await resp.json())["tier_promotions"]
+        results["post_restart_promotions"] = dict(promos)
+        results["parity_ok"] = post_out == warm_out
+        warm = results["resume_warm_disk"]["ttft_ms_p50"]
+        post = results["resume_post_restart"]["ttft_ms_p50"]
+        results["restart_ttft_ratio"] = round(post / max(warm, 1e-9), 3)
+        results["ref_warm_disk_ms_pr17"] = 195.0
+
+        # -- leg 5: stream drop + from_seq reconnect, exactly once ------
+        gaps, exactly_once = [], True
+        for i in range(reconnects):
+            rid = f"bench-reconn-{i}"
+            body = dict(payload(prompts[1 + i % sessions][:64]),
+                        stream=True)
+            resp = await client.post("/generate/", json=body,
+                                     headers={"X-Request-Id": rid})
+            assert resp.status == 200, await resp.text()
+            first = int(await resp.content.readline())
+            t_drop = time.perf_counter()
+            resp.close()
+            # wait for the server to see the drop (detach) or finish
+            deadline = time.perf_counter() + 10.0
+            while True:
+                sess = streams_mod.STREAMS.get(rid)
+                if sess is None or sess.terminal \
+                        or sess.detached_at is not None:
+                    break
+                assert time.perf_counter() < deadline, "no detach"
+                await asyncio.sleep(0.005)
+            r2 = await client.get(f"/generate/{rid}/stream",
+                                  params={"from_seq": 1})
+            assert r2.status == 200, await r2.text()
+            seqs, vals, gap_ms = [], [], None
+            while True:
+                line = await r2.content.readline()
+                if not line:
+                    break
+                if gap_ms is None:
+                    gap_ms = (time.perf_counter() - t_drop) * 1000.0
+                s, v = line.decode().strip().split(":", 1)
+                seqs.append(int(s))
+                vals.append(v)
+            gaps.append(gap_ms if gap_ms is not None else float("inf"))
+            exactly_once = exactly_once and bool(seqs) \
+                and seqs == list(range(1, 1 + len(seqs))) \
+                and vals[-1] == "done" \
+                and len([first] + vals[:-1]) == max_new
+        resp = await client.get("/serving_stats/")
+        stream_stats = (await resp.json())["streams"]
+        results["reconnect"] = {
+            "cycles": reconnects,
+            "gap_ms_p50": round(_pct(gaps, 0.5), 3),
+            "gap_ms_p99": round(_pct(gaps, 0.99), 3),
+            "gap_ms_all": [round(g, 3) for g in gaps],
+            "exactly_once_ok": exactly_once,
+            "detaches": stream_stats["detaches"],
+            "resumes": stream_stats["resumes"],
+            "expired": stream_stats["expired"]}
+        resp = await client.get("/serving_stats/")
+        results["journal_post_restart"] = (await resp.json())["journal"]
+
+        results["ok"] = bool(
+            results["parity_ok"]
+            and exactly_once
+            and results["sessions_restored"] >= sessions + 1
+            and (results["restart_ttft_ratio"] <= 1.5
+                 or post <= 1.5 * 195.0))
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
 # --chaos: one armed fault site under overload (scripts/chaos_matrix.sh)
 # ---------------------------------------------------------------------------
 
@@ -2469,13 +2716,32 @@ async def _bench_chaos() -> dict:
         env["PENROZ_DISAGG_REBALANCE_COOLDOWN_MS"] = "0"
         env["PENROZ_DISAGG_REBALANCE_DOWN"] = "1000000000"
     tier = site.startswith("tier.")
-    if tier:
+    journal_site = site.startswith("journal.")
+    stream_site = site == "stream.resume"
+    if tier or journal_site:
         # tier.demote / tier.promote only execute when sessions actually
         # hibernate and wake: small pages so the short bench prompts span
         # whole pages, session ids on every request (below), and the
         # chaos waves replay each baseline's FULL token history so the
         # promote-on-match import runs while armed
         env["PENROZ_KV_PAGE_SIZE"] = "4"
+    if journal_site:
+        # journal.append fires on every session register/demote/promote;
+        # journal.replay only fires inside create_app()'s recovery — the
+        # armed phase for that site is a double in-process restart (see
+        # below), not a request wave.  Zero host cap pushes every blob
+        # to the disk store so recovery has something to restore.
+        jdir = tempfile.mkdtemp(prefix="penroz_chaos_journal_")
+        env["PENROZ_JOURNAL_PATH"] = os.path.join(jdir, "serve.journal")
+        env["PENROZ_JOURNAL_FSYNC"] = "always"
+        env["PENROZ_TIER_DISK_PATH"] = os.path.join(jdir, "tier")
+        env["PENROZ_TIER_HOST_MB"] = "0"
+    if stream_site:
+        # stream.resume fires at the top of every from_seq reattach: the
+        # armed phase drops streaming clients mid-flight and reconnects;
+        # a generous grace + ring keeps every drop resumable
+        env["PENROZ_STREAM_DETACH_MS"] = "60000"
+        env["PENROZ_STREAM_REPLAY"] = "64"
     if site == "tier.promote":
         # the import only executes once the radix copy is gone (a
         # radix-resident session wakes on the HBM fast path, no blob
@@ -2496,7 +2762,8 @@ async def _bench_chaos() -> dict:
     klass = ["batch" if i < offered - 2 else "interactive"
              for i in range(offered)]
 
-    sids = [f"chaos-{i}" if tier else None for i in range(offered)]
+    sids = [f"chaos-{i}" if (tier or journal_site) else None
+            for i in range(offered)]
 
     async def one(prompt, priority=None, session_id=None):
         body = {"model_id": "bench-chaos", "input": [prompt],
@@ -2527,20 +2794,111 @@ async def _bench_chaos() -> dict:
         # its full history as the prompt — every admission is a hibernated
         # wake (tier.promote fires mid-import) and every retirement
         # re-hibernates (tier.demote fires in the background spill).
-        wave_prompts = ([baselines[tuple(p)] for p in prompts] if tier
-                        else prompts)
+        wave_prompts = ([baselines[tuple(p)] for p in prompts]
+                        if tier or journal_site else prompts)
+
+        extra: dict = {}
+        if journal_site:
+            # both journal sites need the baselines' blobs settled in the
+            # disk store before arming (demotion is asynchronous)
+            deadline = time.perf_counter() + 30.0
+            while True:
+                resp = await client.get("/sessions/")
+                listing = await resp.json()
+                tiers = [s["tier"] for s in listing["sessions"]]
+                if tiers and all(t == "disk" for t in tiers):
+                    break
+                assert time.perf_counter() < deadline, listing
+                await asyncio.sleep(0.05)
 
         os.environ[faults.ENV] = f"{site}:raise@{at}"
         if site == "disagg.rebalance":
             os.environ["PENROZ_DISAGG_ELASTIC"] = "1"
         faults.reset()
         statuses: dict = {}
-        for _ in range(waves):
-            results = await asyncio.gather(
-                *[one(p, k, sid)
-                  for p, k, sid in zip(wave_prompts, klass, sids)])
-            for status, _ in results:
-                statuses[status] = statuses.get(status, 0) + 1
+        if site == "journal.replay":
+            # the site fires inside create_app()'s journal replay: kill
+            # the process in-bench and restart WHILE armed — the injected
+            # crash must be contained (empty registry, disk blobs
+            # untouched) — then restart again clean and require full
+            # recovery before the parity replay below
+            decode_scheduler.reset()
+            await client.close()
+            _simulate_process_death()
+            client = TestClient(TestServer(app_mod.create_app()))
+            await client.start_server()
+            resp = await client.get("/serving_stats/")
+            armed = (await resp.json())["restart_recovery"]
+            extra["replay_errors_armed"] = armed.get("replay_errors", 0)
+            extra["sessions_recovered_armed"] = armed.get(
+                "sessions_recovered", 0)
+            os.environ.pop(faults.ENV, None)
+            faults.reset()
+            decode_scheduler.reset()
+            await client.close()
+            _simulate_process_death()
+            client = TestClient(TestServer(app_mod.create_app()))
+            await client.start_server()
+            resp = await client.get("/serving_stats/")
+            clean = (await resp.json())["restart_recovery"]
+            extra["sessions_recovered"] = clean.get("sessions_recovered", 0)
+        elif stream_site:
+            # drop a streaming client mid-flight, reattach with from_seq;
+            # the injected crash 500s one reattach and the retry must
+            # deliver the missed tokens exactly once
+            from penroz_tpu.serve import streams as streams_mod
+            extra["stream_resume_faults"] = 0
+            exactly_once = True
+            for i in range(2 * waves):
+                rid = f"chaos-reconn-{i}"
+                body = {"model_id": "bench-chaos",
+                        "input": [prompts[i % offered]],
+                        "block_size": block, "max_new_tokens": max_new,
+                        "temperature": 0.0, "stream": True}
+                resp = await client.post(
+                    "/generate/", json=body,
+                    headers={"X-Request-Id": rid})
+                assert resp.status == 200, await resp.text()
+                first = int(await resp.content.readline())
+                resp.close()
+                deadline = time.perf_counter() + 10.0
+                while True:
+                    sess = streams_mod.STREAMS.get(rid)
+                    if sess is None or sess.terminal \
+                            or sess.detached_at is not None:
+                        break
+                    assert time.perf_counter() < deadline, "no detach"
+                    await asyncio.sleep(0.005)
+                for attempt in range(2):
+                    r2 = await client.get(f"/generate/{rid}/stream",
+                                          params={"from_seq": 1})
+                    statuses[r2.status] = statuses.get(r2.status, 0) + 1
+                    if r2.status == 200:
+                        break
+                    extra["stream_resume_faults"] += 1
+                    await r2.release()
+                assert r2.status == 200, await r2.text()
+                seqs, vals = [], []
+                while True:
+                    line = await r2.content.readline()
+                    if not line:
+                        break
+                    s, v = line.decode().strip().split(":", 1)
+                    seqs.append(int(s))
+                    vals.append(v)
+                exactly_once = exactly_once and bool(seqs) \
+                    and seqs == list(range(1, 1 + len(seqs))) \
+                    and vals[-1] == "done" \
+                    and len([first] + vals[:-1]) == max_new
+            extra["stream_exactly_once"] = exactly_once
+            extra["stream_stats"] = streams_mod.STREAMS.stats()
+        else:
+            for _ in range(waves):
+                results = await asyncio.gather(
+                    *[one(p, k, sid)
+                      for p, k, sid in zip(wave_prompts, klass, sids)])
+                for status, _ in results:
+                    statuses[status] = statuses.get(status, 0) + 1
         os.environ.pop(faults.ENV, None)
         faults.reset()
 
@@ -2588,8 +2946,17 @@ async def _bench_chaos() -> dict:
             "sessions_hibernated": stats.get("sessions_hibernated", 0),
             "session_promotions": stats.get("session_promotions", 0),
             "tier_promotions": stats.get("tier_promotions", {}),
+            # journal.append evidence lives in journal.append_errors (the
+            # failed append is contained, not a crash); journal.replay /
+            # stream.resume evidence is in the `extra` keys filled by
+            # their armed phases above
+            "journal": stats.get("journal", {}),
+            **extra,
             "parity_ok": parity_ok,
-            "ok": not disallowed and parity_ok,
+            "ok": (not disallowed and parity_ok
+                   and extra.get("stream_exactly_once", True)
+                   and ("sessions_recovered" not in extra
+                        or extra["sessions_recovered"] >= offered)),
         }
     finally:
         decode_scheduler.reset()
@@ -2616,7 +2983,8 @@ def main():
             if a not in ("--shared-prefix", "--overload", "--speculative",
                          "--multi-adapter", "--multistep", "--mixed-slo",
                          "--chaos", "--ragged", "--memory", "--replicas",
-                         "--disagg", "--disagg-elastic", "--sessions")]
+                         "--disagg", "--disagg-elastic", "--sessions",
+                         "--restart")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     replicas = "--replicas" in sys.argv[1:]
@@ -2626,6 +2994,7 @@ def main():
     mixed_slo = "--mixed-slo" in sys.argv[1:]
     chaos = "--chaos" in sys.argv[1:]
     sessions = "--sessions" in sys.argv[1:]
+    restart = "--restart" in sys.argv[1:]
     ragged = "--ragged" in sys.argv[1:]
     memory = "--memory" in sys.argv[1:]
     disagg = "--disagg" in sys.argv[1:]
@@ -2669,6 +3038,9 @@ def main():
         return
     if sessions:
         _emit(asyncio.run(_bench_sessions()))
+        return
+    if restart:
+        _emit(asyncio.run(_bench_restart()))
         return
     if ragged:
         _emit(asyncio.run(_bench_ragged()))

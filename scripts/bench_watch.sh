@@ -198,6 +198,20 @@ while true; do
           -- "BENCH_TIER_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
         && echo "$(date -u +%FT%TZ) session-tiering capture committed" >> logs/bench_watch.log
     fi
+    # Crash-durability capture: journal replay ms, sessions restored
+    # across a simulated kill -9, post-restart resume TTFT vs the
+    # in-run warm-disk reference, and stream reconnect-gap p99.
+    # Opt-in; failures must not block the main capture.
+    if [ "${PENROZ_WATCH_RESTART:-0}" = "1" ]; then
+      PENROZ_BENCH_JSON_OUT="$PWD/BENCH_RESTART_r${ROUND}.json" \
+        timeout 1800 python scripts/bench_serving.py --restart \
+          >> logs/bench_watch.log 2>&1 \
+        && git add -- "BENCH_RESTART_r${ROUND}.json" \
+          >> logs/bench_watch.log 2>&1 \
+        && git commit -m "bench watcher: restart-durability capture" \
+          -- "BENCH_RESTART_r${ROUND}.json" >> logs/bench_watch.log 2>&1 \
+        && echo "$(date -u +%FT%TZ) restart-durability capture committed" >> logs/bench_watch.log
+    fi
     # Multi-tenant LoRA capture (same shape as the shared-prefix hook):
     # mixed-adapter ITL/wall vs per-adapter serial groups + parity.
     # Opt-in; failures must not block the main capture.

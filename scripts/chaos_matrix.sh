@@ -15,6 +15,13 @@
 # tier-1 test can afford the sweep; the full matrix is the pre-release /
 # soak entry point.
 #
+# CHAOS_RESTART=1 runs ONLY the crash-durability drills (PR 18) and
+# exits: real-subprocess SIGKILL both mid-hibernation-demotion and
+# post-demotion, each followed by a restart whose journal replay must
+# rebuild a consistent registry and whose next turn must hit greedy
+# token parity — plus the --restart bench's journal/reconnect gates.
+# Both run under PENROZ_MEMLEDGER_STRICT=1.
+#
 # Env passthrough: PENROZ_BENCH_SERVING_PLATFORM, PENROZ_BENCH_* scale
 # knobs.  CHAOS_SITES / CHAOS_MODES / CHAOS_REPLICAS override the swept
 # sets (space-separated).  CHAOS_REPLICAS > 1 runs the combo through the
@@ -23,6 +30,33 @@
 # replay parity gate holds for the whole group.
 set -u
 cd "$(dirname "$0")/.."
+
+if [ "${CHAOS_RESTART:-0}" = "1" ]; then
+  # SIGKILL drills: phase-1 process hibernates a session and is killed —
+  # once the moment the first turn completes (demotion still in flight),
+  # once after the disk spill settled — and the phase-2 process must
+  # replay the journal to a consistent registry and resume at greedy
+  # parity.  The pytest entry points own the subprocess plumbing.
+  echo "=== chaos restart: SIGKILL mid-demotion + post-demotion ===" >&2
+  if ! PENROZ_MEMLEDGER_STRICT=1 timeout 900 env JAX_PLATFORMS=cpu \
+      python -m pytest tests/test_journal.py -q -k sigkill \
+      -p no:cacheprovider; then
+    echo "chaos restart: FAILED (SIGKILL drills)" >&2
+    exit 1
+  fi
+  echo "=== chaos restart: --restart bench (replay + reconnect gates) ===" >&2
+  out=$(PENROZ_MEMLEDGER_STRICT=1 timeout 900 \
+          python scripts/bench_serving.py --restart)
+  rc=$?
+  echo "$out"
+  if [ "$rc" -ne 0 ] || ! printf '%s' "$out" | python -c \
+      'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") else 1)'; then
+    echo "chaos restart: FAILED (--restart bench)" >&2
+    exit 1
+  fi
+  echo "chaos restart: OK" >&2
+  exit 0
+fi
 
 SITES="${CHAOS_SITES:-decode.step decode.prefill_chunk decode.verify ckpt.write data.download lora.load qos.preempt}"
 MODES="${CHAOS_MODES:-unified phased}"
@@ -142,6 +176,47 @@ if [ "${CHAOS_FAST:-0}" != "1" ]; then
     if ! printf '%s' "$out" | python -c \
         'import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if r.get("ok") and r.get("sessions_hibernated", 0) > 0 else 1)'; then
       echo "FAIL site=$tsite: disallowed statuses, parity break, or no hibernation" >&2
+      fail=1
+    fi
+  done
+
+  # Crash-durable serving (PR 18): the three durability fault sites, all
+  # under the strict memory ledger.
+  #
+  # - journal.append: the Nth write-ahead append fails (disk error) —
+  #   MUST be contained (append returns False, request succeeds); gate
+  #   on append_errors > 0 proving the site really fired.
+  # - journal.replay: the startup replay crashes (at=1: the only call) —
+  #   the armed restart must come up with an empty-but-consistent
+  #   registry AND leave the disk blobs alone, so the follow-up clean
+  #   restart recovers every session (sessions_recovered gate inside the
+  #   bench ok) at greedy parity.
+  # - stream.resume: the Nth from_seq reattach crashes (500) — the retry
+  #   must deliver the missed tokens exactly once (stream_exactly_once
+  #   folded into the bench ok).
+  for jsite in ${CHAOS_DURABILITY_SITES:-journal.append journal.replay stream.resume}; do
+    ran=$((ran + 1))
+    at=3
+    [ "$jsite" = "journal.replay" ] && at=1
+    echo "=== chaos: site=$jsite at=$at durability=1 ===" >&2
+    out=$(PENROZ_BENCH_CHAOS_SITE="$jsite" PENROZ_BENCH_CHAOS_AT="$at" \
+            PENROZ_RAGGED_ATTENTION=1 PENROZ_MEMLEDGER_STRICT=1 \
+            timeout 900 python scripts/bench_serving.py --chaos)
+    rc=$?
+    echo "$out"
+    if [ "$rc" -ne 0 ]; then
+      echo "FAIL site=$jsite rc=$rc" >&2
+      fail=1
+      continue
+    fi
+    case "$jsite" in
+      journal.append) gate='r.get("ok") and r.get("journal", {}).get("append_errors", 0) > 0' ;;
+      journal.replay) gate='r.get("ok") and r.get("replay_errors_armed", 0) > 0 and r.get("sessions_recovered", 0) > 0' ;;
+      *)              gate='r.get("ok") and r.get("stream_resume_faults", 0) > 0 and r.get("stream_stats", {}).get("resumes", 0) > 0' ;;
+    esac
+    if ! printf '%s' "$out" | python -c \
+        "import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if ($gate) else 1)"; then
+      echo "FAIL site=$jsite: disallowed statuses, parity break, or site never fired" >&2
       fail=1
     fi
   done

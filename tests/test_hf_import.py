@@ -558,6 +558,8 @@ def test_phi_import_logit_parity(workdir):
     assert (ours.argmax(-1) == ref_logits.argmax(-1)).mean() >= 0.8
 
 
+# the cached-vs-uncached generate seam is pinned fast by the NeoX variant
+@pytest.mark.slow
 def test_phi_cached_generate_matches_uncached(workdir):
     """Phi partial rotary + biased fused QKV through the KV-cached decode
     path: greedy cached generation == uncached argmax rollout."""
@@ -780,7 +782,9 @@ def _tiny_stablelm(use_qkv_bias=True):
     return config, StableLmForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("use_qkv_bias", [True, pytest.param(False, marks=pytest.mark.slow)])
+# partial-rotary + qkv-bias import seams stay fast via the phi3/qwen3 tests
+@pytest.mark.slow
+@pytest.mark.parametrize("use_qkv_bias", [True, False])
 def test_stablelm_import_logit_parity_and_generate(workdir, use_qkv_bias):
     """StableLM: llama-shaped blocks with LayerNorm (weight+bias) norms,
     partial rotary, qkv bias on and off (the DSL bias flag is config-
@@ -836,6 +840,8 @@ def _tiny_gptj():
     return config, GPTJForCausalLM(config).eval()
 
 
+# parallel-residual rotary import stays fast via the NeoX cached-generate test
+@pytest.mark.slow
 def test_gptj_import_logit_parity_and_generate(workdir):
     """GPT-J: parallel branches sharing one ln_1, bias-free projections,
     biased head, and partial INTERLEAVED rotary — handled entirely at
@@ -937,7 +943,9 @@ def _tiny_bigcode(multi_query=True):
     return config, GPTBigCodeForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("multi_query", [True, pytest.param(False, marks=pytest.mark.slow)])
+# multi-query import seam stays fast via the old-arch Falcon test
+@pytest.mark.slow
+@pytest.mark.parametrize("multi_query", [True, False])
 def test_bigcode_import_logit_parity_and_generate(workdir, multi_query):
     """GPT-BigCode (StarCoder): the GPT-2 structure with multi-query
     attention — the MQA-fused c_attn is already our [q; k; v] layout —
@@ -1023,6 +1031,8 @@ def _tiny_opt(enable_bias=True):
     return config, OPTForCausalLM(config).eval()
 
 
+# learned-positional import seam stays fast via the GPT-2 import test
+@pytest.mark.slow
 def test_opt_import_logit_parity_and_generate(workdir):
     """OPT: model.decoder layout, separate-then-fused biased QKV, ReLU
     MLPs, and the LEARNED position table's +2 row offset folded away at
@@ -1079,6 +1089,8 @@ def _tiny_bloom():
     return config, BloomForCausalLM(config).eval()
 
 
+# alibi import seam stays fast via the Falcon-RW alibi test
+@pytest.mark.slow
 def test_bloom_import_logit_parity_and_generate(workdir):
     """BLOOM: no positional embedding at all — ALiBi logit biases carry
     position — plus the embedding LayerNorm and the per-head-interleaved
@@ -1230,7 +1242,9 @@ def _tiny_qwen2_moe(norm_topk=False):
     return config, Qwen2MoeForCausalLM(config).eval()
 
 
-@pytest.mark.parametrize("norm_topk", [False, pytest.param(True, marks=pytest.mark.slow)])
+# MoE import seam stays fast via the Mixtral test
+@pytest.mark.slow
+@pytest.mark.parametrize("norm_topk", [False, True])
 def test_qwen2_moe_import_logit_parity_and_generate(workdir, norm_topk):
     """Qwen2-MoE: fine-grained routed experts (norm_topk_prob both ways —
     the default False keeps raw softmax mass on the selected experts)

@@ -114,7 +114,11 @@ def _submit(engine, prompt, max_new, adapter=None):
                                                        marks=pytest.mark.slow),
                                           True],
                          ids=["nocache", "prefix"])
-@pytest.mark.parametrize("spec", [False, True], ids=["nospec", "spec"])
+# spec-off mixing covered by the superstep parity test below
+@pytest.mark.parametrize("spec", [pytest.param(False,
+                                               marks=pytest.mark.slow),
+                                  True],
+                         ids=["nospec", "spec"])
 @pytest.mark.parametrize("chunked", [pytest.param(False, marks=pytest.mark.slow),
                                      True],
                          ids=["oneshot", "chunked"])
@@ -434,7 +438,11 @@ def test_delete_model_flushes_its_adapters(client, toy_gpt_layers):
     assert [a["adapter_id"] for a in body["adapters"]] == ["theirs"]
 
 
-@pytest.mark.parametrize("batching", ["0", "1"], ids=["legacy", "sched"])
+# the legacy (non-scheduler) serve path is covered by the nocache arms
+@pytest.mark.parametrize("batching", [pytest.param("0",
+                                                   marks=pytest.mark.slow),
+                                      "1"],
+                         ids=["legacy", "sched"])
 def test_api_trained_adapter_roundtrips_and_serves(client, toy_gpt_layers,
                                                    toy_shards, monkeypatch,
                                                    batching):
@@ -508,7 +516,12 @@ def test_train_worker_clean_failure_exits_nonzero_and_parent_logs(
                for m in errors), errors
 
 
-@pytest.mark.parametrize("superstep", [1, 4, 8])
+@pytest.mark.parametrize("superstep", [
+    # step-1 mixing is covered by the parity matrix above; 4 adds no
+    # seam beyond 8
+    pytest.param(1, marks=pytest.mark.slow),
+    pytest.param(4, marks=pytest.mark.slow),
+    8])
 def test_mixed_adapter_superstep_parity(gpt_model, tenants, make_engine,
                                         monkeypatch, superstep):
     """Compiled multi-step decode over a MIXED-adapter batch: rows bound
@@ -546,6 +559,9 @@ def test_mixed_adapter_superstep_parity(gpt_model, tenants, make_engine,
         assert any(e["superstep"] > 1 for e in stats["tick_timeline"])
 
 
+# adapter mixing under the unified tick is also pinned by the
+# chunked-spec-prefix arm of the parity matrix above
+@pytest.mark.slow
 def test_unified_mixed_adapter_parity(gpt_model, tenants, make_engine,
                                       monkeypatch):
     """The ragged unified tick serves a mixed-adapter batch (A, B, base

@@ -223,10 +223,15 @@ def _oracle_drafter(bases):
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("paged,int8,superstep,spec,use_lora,mesh", [
-    (0, 0, 1, 0, 0, 0), (1, 0, 1, 0, 0, 0),
+    # contiguous-cache ledger accounting is covered by the contig arms
+    # of the scheduler parity matrices
+    pytest.param(0, 0, 1, 0, 0, 0, marks=pytest.mark.slow),
+    (1, 0, 1, 0, 0, 0),
     # int8 step-1 ledger accounting is covered by int8-superstep8
     pytest.param(1, 1, 1, 0, 0, 0, marks=pytest.mark.slow),
-    (1, 0, 4, 0, 0, 0), (1, 1, 8, 0, 0, 0), (1, 0, 1, 1, 0, 0),
+    # superstep retirement seams covered at step 8
+    pytest.param(1, 0, 4, 0, 0, 0, marks=pytest.mark.slow),
+    (1, 1, 8, 0, 0, 0), (1, 0, 1, 1, 0, 0),
     (1, 0, 1, 0, 1, 0), (1, 0, 1, 0, 0, 1)],
     ids=["fp-contig", "paged-prefix", "int8-paged-prefix", "superstep4",
          "int8-superstep8", "spec-paged-prefix", "lora-paged-prefix",

@@ -772,6 +772,9 @@ def test_generate_mesh_skipped_for_paged_cache(workdir, toy_gpt_layers,
     assert len(tokens) == 5
 
 
+# heaviest single test in the file; the microstep loop's scheduling
+# behaviour stays pinned by test_train_microstepped_yields_between_micro_steps
+@pytest.mark.slow
 def test_train_microstepped_matches_fused(workdir, toy_gpt_layers,
                                           toy_shards, monkeypatch):
     """Decode-priority micro-step dispatch is numerics-identical to the

@@ -450,7 +450,8 @@ def _preempt_env(monkeypatch, superstep, int8):
 @pytest.mark.parametrize("int8,superstep", [
     pytest.param(0, 1, id="fp-1",
                  marks=pytest.mark.slow),  # fp step-1 covered by int8-1 arm
-    pytest.param(0, 8, id="fp-8"),
+    pytest.param(0, 8, id="fp-8",
+                 marks=pytest.mark.slow),  # fp step-8 covered by int8-8 arm
     pytest.param(1, 1, id="int8-1"),
     pytest.param(1, 8, id="int8-8")])
 def test_preempt_resume_parity_matrix(gpt_model, make_engine, monkeypatch,

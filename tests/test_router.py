@@ -246,7 +246,10 @@ def _assert_no_transit_or_blob_leaks():
 
 
 @pytest.mark.parametrize("transport", ["d2d", "host"])
-@pytest.mark.parametrize("int8", [False, True])
+# int8 KV parity through the hand-off is pinned by the single-engine
+# matrices and the int8 codec property tests
+@pytest.mark.parametrize("int8", [False,
+                                  pytest.param(True, marks=pytest.mark.slow)])
 @pytest.mark.parametrize("prefix", [False, True])
 @pytest.mark.parametrize("superstep", ["1", "8"])
 def test_router_disagg_greedy_parity_matrix(gpt_model, monkeypatch, int8,

@@ -1,0 +1,444 @@
+"""Resumable token streams + worker-tick watchdog tests
+(serve/streams.py, the /generate/{id}/stream endpoint, and the
+``PENROZ_TICK_WATCHDOG_MS`` readiness signal in serve/decode_scheduler.py).
+
+The load-bearing contract is exactly-once across the reconnect seam: a
+client that drops mid-stream and reattaches with ``from_seq`` sees every
+sequence number exactly once — some replayed from the bounded ring, some
+live — with no duplicates and no gaps, while the generation itself never
+stopped.  The flip side is honored too: with no detach grace configured
+the pre-existing cancel-on-disconnect behavior is unchanged, and an
+expired grace fires the ordinary cancellation path under strict
+memledger audits.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+
+pytestmark = pytest.mark.runtime
+
+BLOCK = 16
+SGD = {"sgd": {"lr": 0.1}}
+
+
+@pytest.fixture(autouse=True)
+def _streams_registry(workdir):
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import decode_scheduler, qos, streams
+    from penroz_tpu.utils import faults
+    faults.reset()
+    qos.reset()
+    streams.reset()
+    KV.reset_unpin_underflow_count()
+    yield
+    decode_scheduler.reset()
+    streams.reset()
+    faults.reset()
+    qos.reset()
+    KV.reset_unpin_underflow_count()
+
+
+@pytest.fixture
+def gpt_model(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("streamgpt", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    return model
+
+
+@pytest.fixture
+def client(workdir):
+    from penroz_tpu.serve import app as app_mod
+    app_mod.model_locks.clear()
+    app_mod.dataset_locks.clear()
+    from aiohttp.test_utils import TestClient, TestServer
+    loop = asyncio.new_event_loop()
+    client = TestClient(TestServer(app_mod.create_app()), loop=loop)
+    loop.run_until_complete(client.start_server())
+    yield client, loop
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+def _json(client_loop, method, path, **kw):
+    client, loop = client_loop
+
+    async def go():
+        resp = await client.request(method, path, **kw)
+        body = await resp.read()
+        return resp.status, (json.loads(body) if body else None)
+
+    return loop.run_until_complete(go())
+
+
+def _gen_payload(**overrides):
+    payload = {"model_id": "streamgpt", "input": [[1, 2, 3]],
+               "block_size": BLOCK, "max_new_tokens": 6,
+               "temperature": 0.0}
+    payload.update(overrides)
+    return payload
+
+
+def _parse_seq_lines(text):
+    """``seq:value`` resume-endpoint lines → [(seq, value-str), ...]."""
+    out = []
+    for line in text.strip().split("\n"):
+        seq, value = line.split(":", 1)
+        out.append((int(seq), value))
+    return out
+
+
+class _Req:
+    cancelled = False
+
+
+# -- unit layer --------------------------------------------------------------
+
+def test_ring_resume_seam_is_exactly_once(monkeypatch):
+    """resume() returns the ring backlog and subscribes the queue under
+    ONE lock: backlog ∪ live-queue covers every seq >= from_seq exactly
+    once, including events published after the reattach."""
+    from penroz_tpu.serve import streams
+    sess = streams.StreamSession("r1", _Req())
+    for i in range(5):
+        sess.publish("token", 100 + i)
+    loop = asyncio.new_event_loop()
+    try:
+        q = asyncio.Queue()
+        backlog = sess.resume(loop, q, 2)
+        assert [(s, v) for s, _, v in backlog] == [(2, 102), (3, 103),
+                                                   (4, 104)]
+        sess.publish("token", 105)
+        sess.publish("done", None)
+        loop.run_until_complete(asyncio.sleep(0.01))
+        live = []
+        while not q.empty():
+            live.append(q.get_nowait())
+        assert [(s, k) for s, k, _ in live] == [(5, "token"), (6, "done")]
+        seqs = [e[0] for e in backlog] + [e[0] for e in live]
+        assert seqs == sorted(set(seqs)) == list(range(2, 7))
+        assert sess.snapshot()["resumes"] == 1
+    finally:
+        loop.close()
+
+
+def test_replay_gap_and_expiry_are_typed_errors(monkeypatch):
+    """Asking for seqs the bounded ring evicted — or reattaching after
+    the detach grace already cancelled the request — raises
+    ReplayGapError (the HTTP 410), never a silent skip."""
+    from penroz_tpu.serve import streams
+    monkeypatch.setenv(streams.REPLAY_ENV, "4")
+    sess = streams.StreamSession("r2", _Req())
+    for i in range(10):
+        sess.publish("token", i)
+    loop = asyncio.new_event_loop()
+    try:
+        with pytest.raises(streams.ReplayGapError):
+            sess.resume(loop, asyncio.Queue(), 0)
+        backlog = sess.resume(loop, asyncio.Queue(), 6)
+        assert [e[0] for e in backlog] == [6, 7, 8, 9]
+
+        # grace expiry flips req.cancelled and poisons later resumes
+        monkeypatch.setenv(streams.DETACH_MS_ENV, "30")
+        req = _Req()
+        sess2 = streams.StreamSession("r3", req)
+        sess2.publish("token", 0)
+        assert sess2.try_detach() is True
+        deadline = time.monotonic() + 5
+        while not req.cancelled:
+            assert time.monotonic() < deadline, "grace never expired"
+            time.sleep(0.01)
+        assert sess2.expired is True
+        with pytest.raises(streams.ReplayGapError):
+            sess2.resume(loop, asyncio.Queue(), 0)
+    finally:
+        loop.close()
+
+
+def test_zero_grace_means_cancel_on_disconnect(monkeypatch):
+    """The default (no PENROZ_STREAM_DETACH_MS) keeps the pre-existing
+    behavior: try_detach refuses and the caller runs the cancel path."""
+    from penroz_tpu.serve import streams
+    monkeypatch.delenv(streams.DETACH_MS_ENV, raising=False)
+    sess = streams.StreamSession("r4", _Req())
+    sess.publish("token", 0)
+    assert sess.try_detach() is False
+    # terminal streams refuse too, whatever the grace says
+    monkeypatch.setenv(streams.DETACH_MS_ENV, "60000")
+    sess.publish("done", None)
+    assert sess.try_detach() is False
+
+
+# -- HTTP layer --------------------------------------------------------------
+
+def test_http_resume_replays_completed_stream(client, gpt_model,
+                                              monkeypatch):
+    """A finished stream lingers: GET /generate/{id}/stream?from_seq=0
+    replays the whole ring as ``seq:value`` lines ending in ``N:done``,
+    token-for-token equal to what the live stream delivered."""
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    test_client, loop = client
+
+    async def go():
+        resp = await test_client.post(
+            "/generate/", json=_gen_payload(stream=True),
+            headers={"X-Request-Id": "resume-a"})
+        assert resp.status == 200
+        return (await resp.read()).decode()
+
+    streamed = [int(t) for t in
+                loop.run_until_complete(go()).strip().split("\n")]
+
+    async def resume(rid, from_seq):
+        resp = await test_client.get(f"/generate/{rid}/stream",
+                                     params={"from_seq": str(from_seq)})
+        return resp.status, (await resp.read()).decode()
+
+    status, text = loop.run_until_complete(resume("resume-a", 0))
+    assert status == 200
+    events = _parse_seq_lines(text)
+    assert [s for s, _ in events] == list(range(len(streamed) + 1))
+    assert [int(v) for _, v in events[:-1]] == streamed
+    assert events[-1][1] == "done"
+    # mid-stream reattach point: only the suffix replays
+    status, text = loop.run_until_complete(resume("resume-a", 3))
+    assert status == 200
+    assert [s for s, _ in _parse_seq_lines(text)] == \
+        list(range(3, len(streamed) + 1))
+
+    # error surface: unknown id 404, junk from_seq 422
+    status, _ = loop.run_until_complete(resume("never-was", 0))
+    assert status == 404
+    async def bad():
+        resp = await test_client.get("/generate/resume-a/stream",
+                                     params={"from_seq": "soon"})
+        return resp.status
+    assert loop.run_until_complete(bad()) == 422
+
+
+def test_http_resume_behind_ring_is_410(client, gpt_model, monkeypatch):
+    """A reconnect that fell further behind than PENROZ_STREAM_REPLAY is
+    refused with 410 Gone — resuming would skip tokens silently."""
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv("PENROZ_STREAM_REPLAY", "2")
+    test_client, loop = client
+
+    async def go():
+        resp = await test_client.post(
+            "/generate/", json=_gen_payload(stream=True),
+            headers={"X-Request-Id": "tiny-ring"})
+        await resp.read()
+        gone = await test_client.get("/generate/tiny-ring/stream",
+                                     params={"from_seq": "0"})
+        body = await gone.read()
+        return gone.status, body.decode()
+
+    status, body = loop.run_until_complete(go())
+    assert status == 410 and "replay ring" in body
+
+
+def test_http_disconnect_detach_reconnect_exactly_once(client, gpt_model,
+                                                       monkeypatch):
+    """THE acceptance path: client drops mid-stream with a detach grace
+    configured → decode keeps running (no cancel) → reconnect at the
+    next unseen seq → replayed ring + live tail cover every seq exactly
+    once and the union equals the uninterrupted greedy stream."""
+    from penroz_tpu.serve import streams
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(streams.DETACH_MS_ENV, "60000")
+    # slow each decode step down so the disconnect happens mid-flight
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@30")
+    test_client, loop = client
+    payload = _gen_payload(max_new_tokens=8, stream=True)
+    rid = "reconnect-1"
+
+    async def drop_then_resume():
+        resp = await test_client.post("/generate/", json=payload,
+                                      headers={"X-Request-Id": rid})
+        assert resp.status == 200
+        line = await resp.content.readline()
+        first = int(line.decode().strip())
+        resp.close()              # hard disconnect, handler cancelled
+        # the server notices at its next write and detaches instead of
+        # cancelling; the generation (and the ring) keep going
+        deadline = time.monotonic() + 30
+        while True:
+            sess = streams.STREAMS.get(rid)
+            assert sess is not None, \
+                "stream was discarded => cancel path ran"
+            snap = sess.snapshot()
+            if snap["detached"] or snap["terminal"]:
+                break
+            assert time.monotonic() < deadline, snap
+            await asyncio.sleep(0.01)
+        assert not sess.req.cancelled
+        resumed = await test_client.get(f"/generate/{rid}/stream",
+                                        params={"from_seq": "1"})
+        assert resumed.status == 200
+        return first, (await resumed.read()).decode()
+
+    first, text = loop.run_until_complete(drop_then_resume())
+    events = _parse_seq_lines(text)
+    assert [s for s, _ in events] == list(range(1, 9))   # 7 tokens + done
+    assert events[-1][1] == "done"
+    resumed = [int(v) for _, v in events[:-1]]
+
+    # the union equals the uninterrupted greedy stream
+    monkeypatch.delenv(faults.ENV)
+    monkeypatch.delenv("PENROZ_CONTINUOUS_BATCHING")
+    status, legacy = _json(client, "POST", "/generate/",
+                           json=_gen_payload(max_new_tokens=8))
+    assert status == 200
+    assert [first] + resumed == legacy["tokens"][3:]
+    stats = streams.STREAMS.stats()
+    assert stats["detaches"] >= 1 and stats["resumes"] >= 1
+    assert stats["expired"] == 0
+
+
+def test_http_detach_grace_expiry_cancels(client, gpt_model, monkeypatch):
+    """When no reconnect arrives inside the grace the ordinary
+    cancellation path fires: the row is retired early (strict memledger
+    audits the unwind) and later resumes are refused."""
+    from penroz_tpu.serve import streams
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv(streams.DETACH_MS_ENV, "150")
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@40")
+    test_client, loop = client
+    rid = "abandoned-1"
+
+    async def drop_and_expire():
+        resp = await test_client.post(
+            "/generate/", json=_gen_payload(max_new_tokens=12, stream=True),
+            headers={"X-Request-Id": rid})
+        await resp.content.readline()
+        resp.close()
+        deadline = time.monotonic() + 30
+        while streams.STREAMS.stats()["expired"] == 0:
+            assert time.monotonic() < deadline, streams.STREAMS.stats()
+            await asyncio.sleep(0.02)
+        resumed = await test_client.get(f"/generate/{rid}/stream",
+                                        params={"from_seq": "0"})
+        await resumed.read()
+        return resumed.status
+
+    assert loop.run_until_complete(drop_and_expire()) in (404, 410)
+    # the engine retired the row long before 12 tokens' worth of sleeps
+    status, stats = _json(client, "GET", "/serving_stats/")
+    assert status == 200 and stats["streams"]["expired"] >= 1
+
+
+def test_stream_resume_fault_site(client, gpt_model, monkeypatch):
+    """An injected stream.resume failure surfaces as the HTTP 500 while
+    the ring (and a later reattach) stay intact."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    test_client, loop = client
+
+    async def go():
+        resp = await test_client.post(
+            "/generate/", json=_gen_payload(stream=True),
+            headers={"X-Request-Id": "faulty-resume"})
+        return (await resp.read()).decode()
+
+    streamed = [int(t) for t in
+                loop.run_until_complete(go()).strip().split("\n")]
+    monkeypatch.setenv(faults.ENV, "stream.resume:raise@1")
+
+    async def resume():
+        resp = await test_client.get("/generate/faulty-resume/stream",
+                                     params={"from_seq": "0"})
+        return resp.status, (await resp.read()).decode()
+
+    status, _ = loop.run_until_complete(resume())
+    assert status == 500
+    # the fault was one-shot; the stream is still resumable afterwards
+    status, text = loop.run_until_complete(resume())
+    assert status == 200
+    events = _parse_seq_lines(text)
+    assert [int(v) for _, v in events[:-1]] == streamed
+
+
+# -- worker-tick watchdog ----------------------------------------------------
+
+def test_watchdog_flags_wedged_tick_and_recovers(client, gpt_model,
+                                                 monkeypatch):
+    """A tick dispatch that outlives PENROZ_TICK_WATCHDOG_MS flips the
+    engine's ``stuck`` verdict, names it in /readyz (503) and
+    ``engines_stuck``, and records ONE ``watchdog`` flight-recorder
+    entry; when the dispatch finally returns everything clears."""
+    from penroz_tpu.serve import memledger
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.setenv("PENROZ_TICK_WATCHDOG_MS", "100")
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@300")
+    memledger.FLIGHT_RECORDER.reset()
+    test_client, loop = client
+
+    async def go():
+        gen = asyncio.ensure_future(test_client.post(
+            "/generate/", json=_gen_payload(max_new_tokens=4)))
+        # give the worker time to get wedged inside a tick dispatch
+        await asyncio.sleep(0.6)
+        ready = await test_client.get("/readyz")
+        ready_body = await ready.json()
+        stats = await (await test_client.get("/serving_stats/")).json()
+        resp = await gen
+        body = await resp.json()
+        assert resp.status == 200, body
+        return ready.status, ready_body, stats
+
+    ready_status, ready_body, stats = loop.run_until_complete(go())
+    assert ready_status == 503
+    assert ready_body["ready"] is False
+    assert ready_body["stuck_engines"] == ["streamgpt"]
+    assert stats["engines_stuck"] == 1
+    assert any(e["stuck"] for e in stats["engines"])
+    dump = memledger.FLIGHT_RECORDER.dump()
+    watchdog_entries = [e for e in dump["entries"]
+                        if e["reason"] == "watchdog"]
+    assert len(watchdog_entries) == 1
+    assert watchdog_entries[0]["model_id"] == "streamgpt"
+
+    # once the wedged dispatch finally returns the verdict clears with
+    # no reset — poll past the tail of the in-flight tick
+    monkeypatch.delenv(faults.ENV)
+    deadline = time.monotonic() + 30
+    while True:
+        status, body = _json(client, "GET", "/readyz")
+        if status == 200:
+            break
+        assert time.monotonic() < deadline, body
+        time.sleep(0.05)
+    assert body["stuck_engines"] == []
+    status, stats = _json(client, "GET", "/serving_stats/")
+    assert status == 200 and stats["engines_stuck"] == 0
+
+
+def test_watchdog_off_by_default(client, gpt_model, monkeypatch):
+    """Without PENROZ_TICK_WATCHDOG_MS even a slow tick is never flagged
+    — the watchdog is strictly opt-in."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_CONTINUOUS_BATCHING", "1")
+    monkeypatch.delenv("PENROZ_TICK_WATCHDOG_MS", raising=False)
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@200")
+    test_client, loop = client
+
+    async def go():
+        gen = asyncio.ensure_future(test_client.post(
+            "/generate/", json=_gen_payload(max_new_tokens=3)))
+        await asyncio.sleep(0.4)
+        ready = await test_client.get("/readyz")
+        body = await ready.json()
+        resp = await gen
+        await resp.read()
+        return ready.status, body
+
+    status, body = loop.run_until_complete(go())
+    assert status == 200 and body["stuck_engines"] == []

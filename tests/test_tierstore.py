@@ -325,7 +325,8 @@ def _wait_tier(sid, tier, timeout=60):
 
 
 @pytest.mark.parametrize("int8,superstep", [
-    (0, 1), (0, 8),
+    (0, 1),
+    pytest.param(0, 8, marks=pytest.mark.slow),  # step8 covered by int8-step8
     pytest.param(1, 1, marks=pytest.mark.slow),  # int8 covered at step8
     (1, 8)],
     ids=["fp-step1", "fp-step8", "int8-step1", "int8-step8"])

@@ -843,6 +843,32 @@ class CompiledArch:
                 tok = jax.random.categorical(rng, logits)
         return tok.astype(jnp.int32)
 
+    @staticmethod
+    def _sample_packed(logits, rng, row_ids, positions, temp, top_k):
+        """(Tp,) sampled tokens from packed (Tp, V) logits with a
+        POSITIONAL key per slot: ``fold_in(fold_in(rng, row), position)``.
+        A (row, position) pair draws the same token no matter which packed
+        slot, superstep, chunk split or pipeline micro-block it rides in —
+        the invariance that lets seeded temperature>0 streams stay
+        identical across spec-on/off (rejection sampling over point-mass
+        drafts reduces to prefix matching against these draws) and across
+        pipeline stage counts.  Padding slots carry ``row_ids < 0``;
+        clipped to 0, sampled, and discarded by the host replay."""
+        logits = logits.astype(jnp.float32)
+        logits = logits / jnp.maximum(temp, 1e-6)
+        keys = jax.vmap(
+            lambda rid, pos: jax.random.fold_in(
+                jax.random.fold_in(rng, jnp.clip(rid, 0)),
+                jnp.maximum(pos, 0))
+        )(row_ids.astype(jnp.int32), positions.astype(jnp.int32))
+        if top_k is not None:
+            vals, idx = jax.lax.top_k(logits, int(top_k))
+            choice = jax.vmap(jax.random.categorical)(keys, vals)
+            tok = jnp.take_along_axis(idx, choice[..., None], -1)[..., 0]
+        else:
+            tok = jax.vmap(jax.random.categorical)(keys, logits)
+        return tok.astype(jnp.int32)
+
     def decode_fn(self):
         """Dispatcher for single decode/prefill steps (jits per static
         (greedy, top_k, dtype); shapes retrace automatically)."""
@@ -921,6 +947,82 @@ class CompiledArch:
                 jax.grad(f, argnums=(0, 1))(p, d, xb, yb, bufs))
         weight_grads, act_grads = fn(params, deltas, x, y, buffers)
         return acts, act_grads, weight_grads
+
+
+class ServePipeline:
+    """Stage partition of a compiled arch for MPMD pipeline serving
+    (``PENROZ_SERVE_PIPE_STAGES``).
+
+    Unlike the training pipeline (``__pipe__`` stacked layouts + ppermute
+    inside one jit, parallel/pipeline.py) the serving pipeline is MPMD:
+    each stage is its own :class:`CompiledArch` over a contiguous slice of
+    the layer DSL, compiling and dispatching its own per-stage program
+    while the scheduler hands activations across stage boundaries
+    (PAPERS.md #3).  The slice boundaries come from
+    ``parallel.pipeline.serve_stage_bounds`` — contiguous runs of the
+    repeated transformer block, with the prologue (embeddings) glued to
+    the first stage and the epilogue (final norm / head) to the last.
+
+    Per-stage KV: stage ``s`` owns attention layers ``kv_bounds[s] =
+    [lo, hi)`` of the full paged cache — its pools live on its own stage
+    mesh (``ops.kv_cache.stage_kv_view`` / ``merge_stage_kv``), which is
+    what drops per-device HBM ~1/S.  Stage archs index their attention
+    layers 0.. locally, matching the sliced pool lists exactly.
+
+    Params/buffers are NOT copied: :meth:`stage_params` re-keys the
+    canonical flat dict (``layers.{i}.*`` → ``layers.{i-lo}.*``) per
+    dispatch — dict slicing over array references, no device traffic.
+    """
+
+    def __init__(self, arch: "CompiledArch", stages: int):
+        from penroz_tpu.parallel import pipeline
+        self.stages = int(stages)
+        self.bounds = pipeline.serve_stage_bounds(arch.layers_dsl,
+                                                  self.stages)
+        self.archs = [CompiledArch.get(arch.layers_dsl[lo:hi])
+                      for lo, hi in self.bounds]
+        self.kv_bounds: list[tuple] = []
+        off = 0
+        for s, stage_arch in enumerate(self.archs):
+            n = len(stage_arch.kv_specs)
+            if n == 0:
+                raise ValueError(
+                    f"pipeline stage {s} owns no attention layers; lower "
+                    f"PENROZ_SERVE_PIPE_STAGES (bounds {self.bounds[s]})")
+            self.kv_bounds.append((off, off + n))
+            off += n
+        if off != len(arch.kv_specs):
+            raise ValueError(
+                f"stage KV partition covers {off} attention layers, "
+                f"model has {len(arch.kv_specs)}")
+        # Per-stage TP meshes, filled by _enter_serve_pipe_mesh when the
+        # group really spans devices (None = degenerate single-device
+        # layout — no placement, no per-dispatch re-staging needed).
+        self.meshes = None
+
+    def stage_key_range(self, s: int):
+        """Half-open top-level DSL entry range owned by stage ``s``."""
+        return self.bounds[s]
+
+    def _rekey(self, flat: dict, s: int) -> dict:
+        lo, hi = self.bounds[s]
+        out = {}
+        for k, v in flat.items():
+            if not k.startswith("layers."):
+                if s == 0:  # prologue state rides with the first stage
+                    out[k] = v
+                continue
+            idx, _, suffix = k[len("layers."):].partition(".")
+            i = int(idx)
+            if lo <= i < hi:
+                out[f"layers.{i - lo}.{suffix}"] = v
+        return out
+
+    def stage_params(self, params: dict, s: int) -> dict:
+        return self._rekey(params, s)
+
+    def stage_buffers(self, buffers: dict, s: int) -> dict:
+        return self._rekey(buffers, s)
 
 
 class NeuralNetworkModel:
@@ -2190,19 +2292,39 @@ class NeuralNetworkModel:
             return None
         return mesh_lib.serve_mesh(model=model, devices=devices)
 
-    def enter_serve_mesh(self, kv):
+    def enter_serve_mesh(self, kv, pipe=None):
         """Place params/buffers and a DecodeEngine's freshly allocated KV
         state on the serving mesh (``PENROZ_SERVE_MESH=1``).  Returns
         ``(kv, devices)`` where ``devices`` is the mesh size (1 when
         unmeshed).  A 1-device mesh is numerically a GSPMD no-op —
         token-identical to the unmeshed engine — which is what lets the
         CPU tier-1 parity matrix keep proving correctness for the sharded
-        serving path."""
+        serving path.
+
+        A model still in the ``__pipe__`` stacked layout from a pipelined
+        train run is restored to the canonical flat layout first (the
+        decode programs address ``layers.{i}.*``) — serving no longer
+        refuses the layout; only cross-host stacked shards (where the
+        unstack would be a one-sided collective) are left alone.
+
+        ``pipe`` (a :class:`ServePipeline`) switches to stage-partitioned
+        placement: each stage's params/buffers and its slice of the paged
+        pools land on that stage's own mesh
+        (``parallel.mesh.serve_stage_meshes`` ×
+        ``PENROZ_SERVE_MESH_MODEL`` TP width per stage)."""
+        if any(k.startswith("__pipe__") for k in self.params):
+            if all(self._is_host_readable(v)
+                   for v in self.params.values()):
+                log.info("Restoring flat layer layout from __pipe__ "
+                         "stacked params for serving")
+                self._exit_pipe_layout()
+            else:
+                return kv, 1  # cross-host stacked shards: leave alone
+        if pipe is not None:
+            return self._enter_serve_pipe_mesh(kv, pipe)
         mesh = self._serve_mesh()
         if mesh is None:
             return kv, 1
-        if any(k.startswith("__pipe__") for k in self.params):
-            return kv, 1  # mid-pipeline-training layout: leave it alone
         live = [v for v in self.params.values()
                 if isinstance(getattr(v, "sharding", None),
                               jax.sharding.NamedSharding)
@@ -2225,6 +2347,67 @@ class NeuralNetworkModel:
         else:
             tree = self._kv_sharding_tree(kv, mesh)
         return jax.device_put(kv, tree), mesh.size
+
+    def _enter_serve_pipe_mesh(self, kv, pipe):
+        """Stage-partitioned placement for one pipeline group: stage ``s``
+        gets its params/buffers sharded over its own TP mesh and its
+        ``kv_bounds[s]`` slice of the paged pools placed there
+        (parallel/sharding.py::paged_kv_stage_shard) — per-device KV HBM
+        drops ~1/S.  On a host with fewer devices than ``stages × model``
+        every stage collapses onto the same devices: the partition,
+        schedule and numerics are identical and placement is skipped (the
+        CPU parity suite rides this degenerate layout)."""
+        model = 1
+        if os.environ.get("PENROZ_SERVE_MESH", "0") == "1":
+            try:
+                model = max(1, int(os.environ.get(
+                    "PENROZ_SERVE_MESH_MODEL", "1")))
+            except ValueError:
+                model = 1
+        try:
+            platform = (self.device.platform if self.device is not None
+                        else None)
+            devices = (jax.local_devices(backend=platform) if platform
+                       else jax.local_devices())
+        except RuntimeError:
+            return kv, 1
+        meshes = mesh_lib.serve_stage_meshes(pipe.stages, model=model,
+                                             devices=devices)
+        distinct = {d for m in meshes for d in np.asarray(m.devices).flat}
+        if len(distinct) <= 1:
+            pipe.meshes = None
+            return kv, 1  # degenerate single-device group: no-op layout
+        pipe.meshes = meshes
+        log.info("Serving pipeline group: %d stages × %d-wide TP over "
+                 "%d devices", pipe.stages, model, len(distinct))
+        new_params = dict(self.params)
+        new_buffers = dict(self.buffers)
+        for s, mesh in enumerate(meshes):
+            new_params.update(sharding_lib.shard_params(
+                {k: v for k, v in self.params.items()
+                 if self._stage_owns(pipe, s, k)}, mesh))
+            new_buffers.update({
+                k: sharding_lib.place(v, mesh_lib.replicated(mesh))
+                for k, v in self.buffers.items()
+                if self._stage_owns(pipe, s, k)})
+        self.params, self.buffers = new_params, new_buffers
+        if isinstance(kv, KV.PagedKVState):
+            kv = sharding_lib.paged_kv_stage_shard(
+                kv, meshes, pipe.kv_bounds, self.arch.kv_specs)
+        return kv, len(distinct)
+
+    @staticmethod
+    def _stage_owns(pipe, s: int, key: str) -> bool:
+        """Whether flat param/buffer ``key`` belongs to stage ``s``
+        (non-``layers.`` keys ride with stage 0 — prologue state)."""
+        if not key.startswith("layers."):
+            return s == 0
+        lo, hi = pipe.bounds[s]
+        try:
+            i = int(key[len("layers."):].split(".", 1)[0])
+        except ValueError:
+            return s == 0
+        return lo <= i < hi
 
     def _kv_specs(self, batch: int = 1, max_len: int = 0):
         return self.arch.kv_specs
@@ -2750,7 +2933,7 @@ class NeuralNetworkModel:
     def decode_mixed_step(self, kv, descs, tok_lit, tok_src, positions,
                           sample_slot, last_tokens, rng, dispatch,
                           temperature=1.0, top_k=None, lora=None,
-                          lora_slots=None):
+                          lora_slots=None, row_ids=None):
         """Run ``n`` unified RAGGED steps in one dispatch — the single
         program that subsumes :meth:`decode_prefill_chunk`,
         :meth:`decode_step_batched` and :meth:`decode_verify_row` for
@@ -2781,9 +2964,15 @@ class NeuralNetworkModel:
         - ``lora_slots`` (n, Tp) per-TOKEN adapter slots when ``lora``
           is set (the per-row gather rides the same dispatch).
 
-        The sampling key for step ``i`` is ``fold_in(rng, dispatch+i)``,
-        the same sequence the phased path folds over its dispatch
-        ordinals.  Returns ``(sampled (n, Tp) int32, kv')``; the caller
+        The GREEDY sampling key for step ``i`` is ``fold_in(rng,
+        dispatch+i)``, the same sequence the phased path folds over its
+        dispatch ordinals (unused by argmax; kept for program identity).
+        Non-greedy sampling uses POSITIONAL keys —
+        :meth:`CompiledArch._sample_packed` over ``row_ids`` (n, Tp, row
+        index per packed slot, -1 padding) — so a (row, position) draw is
+        invariant to packing, superstep, chunk splits and pipeline
+        micro-blocking; spec-on/off and pipeline parity at temperature>0
+        ride on this.  Returns ``(sampled (n, Tp) int32, kv')``; the caller
         replays per-row emissions (stop tokens, verify acceptance,
         rollbacks) host-side — host lengths stay authoritative exactly
         as on the phased path.  Jits per (n, NB, Tp, sampling, cache
@@ -2806,10 +2995,10 @@ class NeuralNetworkModel:
             platform = self._platform
 
             def run(p, b, kv0, dsc_s, tlit_s, tsrc_s, pos_s, sslot_s,
-                    li_s, last0, r, d0, tmp, lo):
+                    li_s, rid_s, last0, r, d0, tmp, lo):
                 def step(carry, x):
                     kvc, last = carry
-                    dsc, tlit, tsrc, pos, sslot, li, i = x
+                    dsc, tlit, tsrc, pos, sslot, li, rid, i = x
                     toks = jnp.where(tsrc >= 0,
                                      last[jnp.clip(tsrc, 0)], tlit)
                     rows = kvc.packed_rows(dsc, block_q)
@@ -2821,13 +3010,17 @@ class NeuralNetworkModel:
                         lora_idx=(li[None, :] if lo is not None else None),
                         ragged_descs=dsc, ragged_rows=rows)
                     logits = acts[-1][0]                       # (Tp, V)
-                    out = arch._sample(logits, r_i, tmp, greedy=greedy,
-                                       top_k=top_k)            # (Tp,)
+                    if greedy:
+                        out = arch._sample(logits, r_i, tmp, greedy=True,
+                                           top_k=top_k)        # (Tp,)
+                    else:
+                        out = arch._sample_packed(logits, r, rid, pos,
+                                                  tmp, top_k)  # (Tp,)
                     new_last = jnp.where(sslot >= 0,
                                          out[jnp.clip(sslot, 0)], last)
                     return (kv2, new_last), out
 
-                xs = (dsc_s, tlit_s, tsrc_s, pos_s, sslot_s, li_s,
+                xs = (dsc_s, tlit_s, tsrc_s, pos_s, sslot_s, li_s, rid_s,
                       jnp.arange(n, dtype=jnp.int32))
                 (kvf, _), sampled = jax.lax.scan(step, (kv0, last0), xs)
                 return sampled, kvf
@@ -2835,14 +3028,105 @@ class NeuralNetworkModel:
             fn = arch._jit_cache[key] = jax.jit(run, donate_argnums=(2,))
         li = (np.asarray(lora_slots, np.int32) if lora_slots is not None
               else np.zeros((n, Tp), np.int32))
+        rid = (np.asarray(row_ids, np.int32) if row_ids is not None
+               else np.full((n, Tp), -1, np.int32))
         with profiling.span("penroz/decode_mixed_step"):
             return fn(self.params, self.buffers, kv,
                       jnp.asarray(descs), jnp.asarray(tok_lit),
                       jnp.asarray(tok_src, jnp.int32).reshape(n, Tp),
                       jnp.asarray(positions, jnp.int32).reshape(n, Tp),
                       jnp.asarray(sample_slot, jnp.int32),
-                      jnp.asarray(li), jnp.asarray(last_tokens, jnp.int32),
+                      jnp.asarray(li), jnp.asarray(rid.reshape(n, Tp)),
+                      jnp.asarray(last_tokens, jnp.int32),
                       rng, jnp.asarray(dispatch, jnp.int32), temp, lora)
+
+    def serve_pipeline(self, stages: int) -> "ServePipeline":
+        """Build (and validate) the MPMD serving stage partition for this
+        model — raises ``ValueError`` when the DSL has fewer repeated
+        blocks than ``stages`` or a stage would own no attention layer."""
+        return ServePipeline(self.arch, stages)
+
+    def decode_pipe_stage(self, pipe: "ServePipeline", s: int, kv_stage,
+                          x, descs, positions, row_ids, rng,
+                          temperature=1.0, top_k=None):
+        """Run ONE pipeline stage of one unified ragged step over one
+        micro-block — the MPMD counterpart of a single
+        :meth:`decode_mixed_step` scan iteration, split at stage
+        boundaries.  Stage 0 consumes packed tokens ``x`` (1, Tp) int32
+        (the host resolves the ``tok_src`` indirection — it already owns
+        ``last_tokens`` between micro-blocks); later stages consume the
+        previous stage's hidden-state hand-off (1, Tp, D).  Every stage
+        appends into its own KV slice via ``kv_stage``
+        (ops/kv_cache.py::stage_kv_view) — stage archs index attention
+        layers 0.. locally, matching the sliced pools.  The LAST stage
+        samples: greedy argmax (bit-identical to the fused program — the
+        module stack is split only at module boundaries, so the logits
+        are the same floats) or :meth:`CompiledArch._sample_packed`
+        positional draws (identical to the unpiped non-greedy stream by
+        construction).  Returns ``(hidden|sampled, kv_stage')``.
+
+        Jits per (stage, NB, Tp, cache type, sampling); cached in the
+        STAGE arch's program cache so ``jit_program_counts`` attributes
+        them per stage.  Deliberately does NOT donate ``kv_stage``: its
+        counters/table/lengths buffers are shared with every other
+        stage's view of the same cache (and with the full state the
+        scheduler threads), so donation would invalidate siblings —
+        correctness over the copy-elision, documented perf gap."""
+        greedy, temp = self._norm_temperature(temperature)
+        arch_s = pipe.archs[s]
+        descs = np.asarray(descs, np.int32)
+        NB = descs.shape[0]
+        positions = np.asarray(positions, np.int32)
+        Tp = positions.shape[-1]
+        if Tp % NB != 0:
+            raise ValueError(f"packed length {Tp} must be a multiple of "
+                             f"the descriptor count {NB}")
+        block_q = Tp // NB
+        last_stage = s == pipe.stages - 1
+        key = ("pipe_stage", s, pipe.stages, NB, Tp,
+               type(kv_stage).__name__, bool(greedy), top_k,
+               self._platform)
+        fn = arch_s._jit_cache.get(key)
+        if fn is None:
+            platform = self._platform
+
+            def run(p, b, kv0, xx, dsc, pos, rid, r, tmp):
+                rows = kv0.packed_rows(dsc, block_q)
+                acts, _, _, kv2 = arch_s.forward(
+                    p, b, xx, None, kv=kv0, pos_offset=pos[None, :],
+                    skip_softmax=True, compute_dtype=None,
+                    platform=platform, ragged_descs=dsc, ragged_rows=rows)
+                h = acts[-1]
+                if not last_stage:
+                    return h, kv2
+                logits = h[0]                                  # (Tp, V)
+                if greedy:
+                    out = arch_s._sample(logits, r, tmp, greedy=True,
+                                         top_k=top_k)
+                else:
+                    out = arch_s._sample_packed(logits, r, rid, pos,
+                                                tmp, top_k)
+                return out, kv2
+
+            fn = arch_s._jit_cache[key] = jax.jit(run)
+        params = pipe.stage_params(self.params, s)
+        buffers = pipe.stage_buffers(self.buffers, s)
+        if s == 0:
+            x = jnp.asarray(np.asarray(x, np.int32).reshape(1, Tp))
+        if pipe.meshes is not None:
+            # MPMD placement is live: pull the shared KV metadata and the
+            # previous stage's activation hand-off onto THIS stage's mesh
+            # (device-to-device) so the stage jit sees one device group.
+            repl = mesh_lib.replicated(pipe.meshes[s])
+            kv_stage = KV.restage_shared(kv_stage, repl)
+            if s > 0 and isinstance(x, jax.Array):
+                x = jax.device_put(x, repl)
+        with profiling.span("penroz/decode_pipe_stage"):
+            return fn(params, buffers, kv_stage, x, jnp.asarray(descs),
+                      jnp.asarray(positions.reshape(Tp)),
+                      jnp.asarray(np.asarray(row_ids,
+                                             np.int32).reshape(Tp)),
+                      rng, temp)
 
     def _sampling_setup(self, temperature):
         """Shared generation preamble: (greedy, temp scalar, call rng).

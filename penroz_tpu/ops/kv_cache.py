@@ -1427,6 +1427,86 @@ class QuantPagedKVState(PagedKVState):
                 "kv_block_table": self._table_bytes()}
 
 
+def stage_kv_view(kv: PagedKVState, lo: int, hi: int) -> PagedKVState:
+    """A pipeline stage's slice of a paged cache: pools restricted to
+    attention layers ``[lo, hi)``, everything else SHARED with the full
+    state (same counters, block table, ragged lengths, page geometry).
+
+    Safe because the ragged serving path never consults the bump
+    allocator: ``packed_rows`` walks the (static) block table and the
+    scheduler authors lengths host-side, so S stage views over disjoint
+    layer ranges can each run their own forward against the same tables
+    and merge back without coordination (serve/decode_scheduler.py
+    pipeline dispatch).  The slices alias the full state's pool arrays —
+    a view costs no HBM until a stage's forward replaces its pools.
+    """
+    if isinstance(kv, QuantPagedKVState):
+        return QuantPagedKVState(
+            kv.k[lo:hi], kv.v[lo:hi], kv.counters, kv.block_table,
+            kv.page_size, kv.pages_per_seq, kv.k_scale[lo:hi],
+            kv.v_scale[lo:hi], out_dtype=kv.out_dtype,
+            ragged_lengths=kv.ragged_lengths)
+    return PagedKVState(kv.k[lo:hi], kv.v[lo:hi], kv.counters,
+                        kv.block_table, kv.page_size, kv.pages_per_seq,
+                        ragged_lengths=kv.ragged_lengths)
+
+
+def restage_shared(kv: PagedKVState, sharding) -> PagedKVState:
+    """Move a stage view's SHARED metadata (counters, block table, ragged
+    lengths) onto the stage's own placement — the small-int32 re-staging
+    each MPMD stage dispatch performs so its jit never mixes committed
+    devices (the pools already live on the stage mesh; metadata follows
+    whichever stage merged last).  Device-to-device: no host round trip.
+    """
+    import jax
+    counters, table = jax.device_put((kv.counters, kv.block_table),
+                                     sharding)
+    lengths = (jax.device_put(kv.ragged_lengths, sharding)
+               if kv.ragged_lengths is not None else None)
+    if isinstance(kv, QuantPagedKVState):
+        return QuantPagedKVState(
+            kv.k, kv.v, counters, table, kv.page_size, kv.pages_per_seq,
+            kv.k_scale, kv.v_scale, out_dtype=kv.out_dtype,
+            ragged_lengths=lengths)
+    return PagedKVState(kv.k, kv.v, counters, table, kv.page_size,
+                        kv.pages_per_seq, ragged_lengths=lengths)
+
+
+def merge_stage_kv(kv: PagedKVState, lo: int, hi: int,
+                   stage_kv: PagedKVState) -> PagedKVState:
+    """Fold a stage's advanced view back into the full cache: the stage's
+    pools replace layers ``[lo, hi)`` and its counters/lengths become the
+    whole cache's (every stage advances them identically — same descs,
+    same block table — so taking the last merged stage's copy is exact).
+    Returns a new full-state instance; the input is not mutated."""
+    k = list(kv.k)
+    v = list(kv.v)
+    k[lo:hi] = stage_kv.k
+    v[lo:hi] = stage_kv.v
+    if isinstance(kv, QuantPagedKVState):
+        ks = list(kv.k_scale)
+        vs = list(kv.v_scale)
+        ks[lo:hi] = stage_kv.k_scale
+        vs[lo:hi] = stage_kv.v_scale
+        return QuantPagedKVState(
+            k, v, stage_kv.counters, stage_kv.block_table, kv.page_size,
+            kv.pages_per_seq, ks, vs, out_dtype=kv.out_dtype,
+            ragged_lengths=stage_kv.ragged_lengths)
+    return PagedKVState(k, v, stage_kv.counters, stage_kv.block_table,
+                        kv.page_size, kv.pages_per_seq,
+                        ragged_lengths=stage_kv.ragged_lengths)
+
+
+def stage_pool_bytes(kv: PagedKVState, lo: int, hi: int) -> int:
+    """Device bytes held by the pool slices of attention layers
+    ``[lo, hi)`` — the per-stage HBM attribution memledger reports
+    (values + int8 scales; the shared block table is whole-cache)."""
+    arrays = [*kv.k[lo:hi], *kv.v[lo:hi]]
+    if isinstance(kv, QuantPagedKVState):
+        arrays += [*kv.k_scale[lo:hi], *kv.v_scale[lo:hi]]
+    return sum(array_device_bytes(a) for a in arrays)
+
+
 def create_kv_state(specs, batch: int, max_len: int, dtype=jnp.float32,
                     quantized: bool | None = None,
                     paged: bool | None = None,

@@ -93,6 +93,31 @@ def serve_mesh(model: int = 1, devices=None) -> Mesh:
     return make_mesh(devices[:model], model=model)
 
 
+def serve_stage_meshes(stages: int, model: int = 1,
+                       devices=None) -> list[Mesh]:
+    """Per-stage serving meshes for ONE pipeline group
+    (PENROZ_SERVE_PIPE_STAGES × PENROZ_SERVE_MESH_MODEL): stage ``s``
+    owns the contiguous local device range ``[s·model, (s+1)·model)``
+    as its own ``model``-wide TP mesh.  Disjoint meshes rather than one
+    ``pipe``-axis mesh because serving stages are MPMD — each stage
+    compiles and dispatches its own program and the scheduler hands
+    activations across (PAPERS.md #3), so a stage recompile or crash
+    never invalidates a sibling's programs (same isolation argument as
+    router replicas).  When the host has fewer than ``stages × model``
+    devices every stage collapses onto the first ``model`` devices —
+    placement degenerates but the schedule, partition, and numerics are
+    identical (the CPU parity suite rides this)."""
+    devices = list(devices if devices is not None else jax.local_devices())
+    stages = int(stages)
+    if stages < 1 or model < 1:
+        raise ValueError(f"need stages >= 1 and model >= 1 "
+                         f"(got {stages}, {model})")
+    if len(devices) < stages * model:
+        return [serve_mesh(model=model, devices=devices)] * stages
+    return [make_mesh(devices[s * model:(s + 1) * model], model=model)
+            for s in range(stages)]
+
+
 def batch_sharding(mesh: Mesh, batch_ndim: int = 2) -> NamedSharding:
     """Shard the leading batch dim over ``data``.  For sequence sharding use
     ``parallel.sharding.shard_batch`` (spec-based, handles both axes)."""

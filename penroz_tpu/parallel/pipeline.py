@@ -64,6 +64,44 @@ def pipeline_block_range(layers_dsl: list[dict]) -> tuple[int, int]:
     return best_start, best_count
 
 
+def serve_stage_bounds(layers_dsl: list[dict], stages: int) -> list[tuple]:
+    """Contiguous top-level DSL entry ranges for ``stages`` serving
+    pipeline stages — the MPMD stage partition of the decode path
+    (PENROZ_SERVE_PIPE_STAGES).
+
+    The repeated transformer blocks (:func:`pipeline_block_range`) are
+    split into ``stages`` near-equal contiguous runs; stage 0 prepends
+    everything before the run (embedding/position), the last stage
+    appends everything after it (final norm / head / softmax), so the
+    stage DSLs concatenate back to the full stack and each mid-stage
+    consumes hidden states directly (CompiledArch._apply iterates its
+    module list over whatever ``x`` it is given).  Returns
+    ``[(lo, hi), ...]`` half-open entry ranges covering the whole list.
+
+    Raises ``ValueError`` when the model has fewer repeated blocks than
+    stages — a stage without a block would hold no attention layer and
+    no KV pool slice, which the per-stage ledger attribution rejects.
+    """
+    stages = int(stages)
+    if stages < 1:
+        raise ValueError(f"stages must be >= 1, got {stages}")
+    start, count = pipeline_block_range(layers_dsl)
+    if count < stages:
+        raise ValueError(
+            f"cannot partition {count} repeated block(s) over {stages} "
+            f"pipeline stages (need at least one block per stage)")
+    sizes = [count // stages + (1 if i < count % stages else 0)
+             for i in range(stages)]
+    bounds = []
+    lo = 0
+    hi = start
+    for i, size in enumerate(sizes):
+        hi += size
+        bounds.append((lo, len(layers_dsl) if i == stages - 1 else hi))
+        lo = hi
+    return bounds
+
+
 def stack_block_params(params: dict, block_indices, prefix="layers") -> dict:
     """Stack per-block params ``layers.{i}.<suffix>`` into ``(L, ...)`` leaves.
 

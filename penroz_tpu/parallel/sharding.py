@@ -152,6 +152,26 @@ def paged_kv_sharding_tree(kv, mesh: Mesh, kv_specs):
     return jax.tree.map(leaf_sharding, kv)
 
 
+def paged_kv_stage_shard(kv, meshes, kv_bounds, kv_specs):
+    """Place a pipeline group's paged pools stage-by-stage: attention
+    layers ``kv_bounds[s] = (lo, hi)`` land on ``meshes[s]`` (their own
+    TP sharding via :func:`paged_kv_sharding_tree`), so each stage's
+    device group holds ONLY its own layers' KV — per-device HBM drops
+    ~1/S, the pipeline-serving capacity claim.  The shared block table /
+    counters / ragged lengths follow the last stage's mesh replicated
+    (host-authored; every stage dispatch re-stages them — small int32
+    arrays, not pools).  Degenerate meshes (every stage on the same
+    devices, the CPU case) make this a no-op placement-wise."""
+    import jax
+
+    from penroz_tpu.ops import kv_cache as KV
+    for mesh, (lo, hi) in zip(meshes, kv_bounds):
+        view = KV.stage_kv_view(kv, lo, hi)
+        tree = paged_kv_sharding_tree(view, mesh, kv_specs[lo:hi])
+        kv = KV.merge_stage_kv(kv, lo, hi, jax.device_put(view, tree))
+    return kv
+
+
 def batch_spec(mesh: Mesh, *, leading_steps: bool = False,
                shard_sequence: bool = False) -> P:
     """Spec for (B, T) or (num_steps, B, T) token batches."""

@@ -155,6 +155,7 @@ import threading
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from penroz_tpu.models import lora as lora_mod
@@ -202,6 +203,15 @@ DISAGG_ACK_TIMEOUT_ENV = "PENROZ_DISAGG_ACK_TIMEOUT_MS"
 # Worker-tick watchdog: an engine is "stuck" when its worker has been
 # inside ONE tick dispatch longer than this many ms (0/unset = off).
 TICK_WATCHDOG_ENV = "PENROZ_TICK_WATCHDOG_MS"
+# Pipeline-parallel serving (MPMD stage partition of the unified ragged
+# path): PENROZ_SERVE_PIPE_STAGES=S splits the layer stack over S
+# stage-engines (composing with PENROZ_SERVE_MESH_MODEL TP width per
+# stage); the scheduler keeps stages busy by splitting each tick's mixed
+# batch into PENROZ_SERVE_PIPE_BLOCKS micro-blocks (default = S) that
+# flow between stages.  Unset or S<=1 leaves the fused single-dispatch
+# path untouched (byte-identical — the whole pipeline branch is dead).
+PIPE_STAGES_ENV = "PENROZ_SERVE_PIPE_STAGES"
+PIPE_BLOCKS_ENV = "PENROZ_SERVE_PIPE_BLOCKS"
 
 # Max tick-timeline entries served per /serving_stats/ payload (the ring
 # itself holds PENROZ_TICK_TIMELINE entries).
@@ -375,6 +385,17 @@ def _superstep_max() -> int:
     """Decode steps fused per dispatch (compiled multi-step decode).
     1 restores the legacy one-dispatch-per-token tick loop."""
     return _env_int(SUPERSTEP_ENV, 8)
+
+
+def _pipe_stages() -> int:
+    """Pipeline stage count for one serving group (1 = off)."""
+    return _env_int(PIPE_STAGES_ENV, 1)
+
+
+def _pipe_blocks(stages: int) -> int:
+    """Micro-blocks the mixed batch splits into per pipeline tick — at
+    least ``stages`` so every stage can be busy once the fill drains."""
+    return max(int(stages), _env_int(PIPE_BLOCKS_ENV, stages))
 
 
 def _effective_timeout_ms(timeout_ms) -> float | None:
@@ -554,6 +575,33 @@ class DecodeEngine:
 
         self._model = NeuralNetworkModel.deserialize(model_id)
         self._ckpt_stamp_v = self._ckpt_stamp()
+        # Pipeline-parallel serving (PENROZ_SERVE_PIPE_STAGES >= 2): the
+        # MPMD stage partition of the unified ragged path.  Built before
+        # _alloc_state so the fresh KV pools land stage-by-stage
+        # (enter_serve_mesh).  Requires the paged+ragged unified dispatch
+        # — micro-blocks are slices of the mixed plan — and is mutually
+        # exclusive with mixed-adapter serving (stage re-keying does not
+        # thread the LoRA pack; gate loudly rather than corrupt).
+        self._pipe = None
+        self._pipe_ticks = 0
+        self._pipe_bubble_ticks = 0
+        self._pipe_stage_busy: collections.Counter = collections.Counter()
+        self._pipe_handoffs = 0
+        self._pipe_handoff_host_fallbacks = 0
+        self._pipe_lora_warned = False
+        stages = _pipe_stages()
+        if stages > 1:
+            if not (KV.paged_enabled() and ragged_enabled()):
+                log.warning(
+                    "%s=%d ignored: pipeline serving rides the unified "
+                    "ragged dispatch (PAGED_KV_CACHE=1 + %s=1)",
+                    PIPE_STAGES_ENV, stages, RAGGED_ENV)
+            else:
+                try:
+                    self._pipe = self._model.serve_pipeline(stages)
+                except ValueError as e:
+                    log.warning("%s=%d ignored: %s", PIPE_STAGES_ENV,
+                                stages, e)
         self._extra_pages = 0
         if KV.prefix_cache_enabled():
             if KV.paged_enabled():
@@ -713,8 +761,11 @@ class DecodeEngine:
         # Serving mesh (PENROZ_SERVE_MESH=1): params/buffers shard over the
         # model axis once, the fresh KV pools follow; a 1-device mesh is a
         # GSPMD no-op so the CPU parity suite covers this path.  Block
-        # table and lengths stay host-authored either way.
-        self._kv, self._mesh_devices = self._model.enter_serve_mesh(self._kv)
+        # table and lengths stay host-authored either way.  With a
+        # pipeline group, placement is stage-partitioned instead: stage
+        # params and KV-pool slices land on per-stage meshes.
+        self._kv, self._mesh_devices = self._model.enter_serve_mesh(
+            self._kv, pipe=self._pipe)
         self._prefix_cache = None
         if self._extra_pages > 0 and isinstance(self._kv, KV.PagedKVState):
             base = self.capacity * self._kv.pages_per_seq
@@ -1020,6 +1071,20 @@ class DecodeEngine:
             "disagg_handoff_ms_p99": self._round_q(self._h_handoff, 0.99),
             "disagg_transport": _disagg_transport(),
             "disagg_role_changes": self._disagg_role_changes,
+            "pipe_stages": (self._pipe.stages if self._pipe is not None
+                            else 1),
+            "pipe_microblocks": (_pipe_blocks(self._pipe.stages)
+                                 if self._pipe is not None else 0),
+            "pipe_ticks": self._pipe_ticks,
+            "pipe_bubble_fraction": (
+                round(self._pipe_bubble_ticks
+                      / (self._pipe_ticks * self._pipe.stages), 4)
+                if self._pipe is not None and self._pipe_ticks else None),
+            "pipe_stage_busy": {str(s): int(c) for s, c
+                                in sorted(self._pipe_stage_busy.items())},
+            "pipe_handoffs": self._pipe_handoffs,
+            "pipe_handoff_host_fallbacks":
+                self._pipe_handoff_host_fallbacks,
             "sessions_hibernated": self._sessions_hibernated,
             "session_promotions": self._session_promotions,
             "session_resume_ttft_ms_p50": self._round_q(
@@ -1201,6 +1266,8 @@ class DecodeEngine:
             "unified": False,
             "prefill_rows": prefill_rows,
             "decode_rows": shared_rows,
+            "pipe_ticks": 0,
+            "pipe_bubbles": 0,
         })
 
     def _unified(self) -> bool:
@@ -1228,12 +1295,28 @@ class DecodeEngine:
         _warn_stall_deprecated()
         t0 = time.monotonic()
         self._dispatch_t0 = t0
+        superstep = 0
         try:
             with profiling.span("penroz/sched_tick"):
-                plan = self._plan_mixed()
-                if plan is None:
-                    return
-                comp = self._mixed_dispatch(plan)
+                if self._pipe is not None and self._lora_pack is None:
+                    plans = self._plan_mixed_blocks()
+                    if not plans:
+                        return
+                    comp = self._pipeline_dispatch(plans)
+                    superstep = max(p["n"] for p in plans)
+                else:
+                    if (self._pipe is not None
+                            and not self._pipe_lora_warned):
+                        self._pipe_lora_warned = True
+                        log.warning(
+                            "pipeline serving suspended while LoRA "
+                            "adapters are live: stage re-keying does not "
+                            "thread the adapter pack")
+                    plan = self._plan_mixed()
+                    if plan is None:
+                        return
+                    comp = self._mixed_dispatch(plan)
+                    superstep = plan["n"]
         finally:
             self._dispatch_t0 = None
             self._watchdog_fired = False
@@ -1248,13 +1331,15 @@ class DecodeEngine:
             "verify_rows": comp["verify_rows"],
             "shared_rows": comp["decode_rows"],
             "emitted": comp["emitted"],
-            "superstep": plan["n"],
+            "superstep": superstep,
             "unified": True,
             "prefill_rows": comp["prefill_rows"],
             "decode_rows": comp["decode_rows"],
+            "pipe_ticks": comp.get("pipe_ticks", 0),
+            "pipe_bubbles": comp.get("pipe_bubbles", 0),
         })
 
-    def _plan_mixed(self):
+    def _plan_mixed(self, rows=None):
         """Host-side plan for one unified block: simulate every row's next
         ``PENROZ_SCHED_SUPERSTEP`` steps of work — a prefilling row runs
         one pow-2-bucketed chunk per step and flows STRAIGHT into decode
@@ -1265,17 +1350,22 @@ class DecodeEngine:
         spent — and pack each step's spans into shape-bucketed descriptor
         arrays (utils/bucketing.py: the step count takes the pow-2 floor,
         the block count the pow-2 ceiling, so the compiled mixed-program
-        set stays O(log²) for any workload)."""
+        set stays O(log²) for any workload).  ``rows`` restricts the plan
+        to a subset of ``(index, state)`` pairs — pipeline micro-blocks
+        plan disjoint row partitions through this."""
         from penroz_tpu.ops.pallas.ragged_paged_attention import (
             default_block_q)
-        rows = [(i, r) for i, r in enumerate(self._rows)
-                if r is not None and not r.transit]
+        if rows is None:
+            rows = [(i, r) for i, r in enumerate(self._rows)
+                    if r is not None and not r.transit]
         if not rows:
             return None
+        subset = {i for i, _ in rows}
         block_q = default_block_q()
         n_max = max(1, _superstep_max())
         spec = self._spec_on()
-        drafts = dict(self._plan_drafts(self._decoding_rows()))
+        drafts = dict(self._plan_drafts(
+            [i for i in self._decoding_rows() if i in subset]))
         sim = {}
         for i, state in rows:
             sim[i] = {
@@ -1341,6 +1431,7 @@ class DecodeEngine:
         positions = np.zeros((n, Tp), np.int32)
         sample_slot = np.full((n, self.capacity), -1, np.int32)
         lora_slots = np.full((n, Tp), self._max_live, np.int32)
+        row_ids = np.full((n, Tp), -1, np.int32)
         replay = []
         for s, (spans, ops) in enumerate(steps):
             d, offsets = KV.build_descriptors(spans, block_q, NB)
@@ -1353,6 +1444,7 @@ class DecodeEngine:
                 slots = KV.packed_slots(offsets[span_idx], q_len, block_q)
                 positions[s, slots] = q_start + np.arange(q_len)
                 lora_slots[s, slots] = int(self._row_adapter[i])
+                row_ids[s, slots] = i
                 if kind == "chunk":
                     _, _, _, start, size, final, _ = op
                     tok_lit[s, slots] = state.history[start:start + size]
@@ -1376,7 +1468,7 @@ class DecodeEngine:
         return {"n": n, "descs": descs, "tok_lit": tok_lit,
                 "tok_src": tok_src, "positions": positions,
                 "sample_slot": sample_slot, "lora_slots": lora_slots,
-                "replay": replay}
+                "row_ids": row_ids, "replay": replay}
 
     def _mixed_dispatch(self, plan) -> dict:
         """Run the planned block as ONE ``decode_mixed_step`` dispatch and
@@ -1403,9 +1495,18 @@ class DecodeEngine:
                 self._kv, plan["descs"], plan["tok_lit"], plan["tok_src"],
                 plan["positions"], plan["sample_slot"], self._last_tok,
                 self._rng, dispatch, self.temperature, self.top_k,
-                lora=self._lora_pack, lora_slots=plan["lora_slots"])
+                lora=self._lora_pack, lora_slots=plan["lora_slots"],
+                row_ids=plan["row_ids"])
             arr = np.asarray(sampled)
         t1 = time.monotonic()
+        return self._replay_block(plan, arr, t0, t1)
+
+    def _replay_block(self, plan, arr, t0, t1) -> dict:
+        """Replay one planned block's ``(n, Tp)`` sample array through the
+        per-token retirement path (shared by the fused single-dispatch
+        path and each pipeline micro-block) and account its metrics.
+        Host lengths stay authoritative throughout."""
+        n, replay = plan["n"], plan["replay"]
         prefill_rows = {op[1] for ops in replay for op in ops
                         if op[0] == "chunk"}
         decode_rows = {op[1] for ops in replay for op in ops
@@ -1512,6 +1613,140 @@ class DecodeEngine:
                 "decode_rows": len(decode_rows),
                 "verify_rows": len(verify_rows),
                 "emitted": emitted_total}
+
+    def _plan_mixed_blocks(self) -> list:
+        """Partition the active rows round-robin into pipeline
+        micro-blocks and plan each as its own mixed block.  ≥ S blocks
+        (``PENROZ_SERVE_PIPE_BLOCKS``, capped by the live row count) keep
+        every stage busy once the pipeline fills; fewer live rows than
+        stages degenerates gracefully — the schedule still completes,
+        just with fill/drain bubbles the telemetry reports."""
+        rows = [(i, r) for i, r in enumerate(self._rows)
+                if r is not None and not r.transit]
+        if not rows:
+            return []
+        m = min(_pipe_blocks(self._pipe.stages), len(rows))
+        plans = []
+        for b in range(m):
+            plan = self._plan_mixed(rows[b::m])
+            if plan is not None:
+                plans.append(plan)
+        return plans
+
+    def _pipeline_dispatch(self, plans: list) -> dict:
+        """Run the planned micro-blocks through the MPMD stage pipeline
+        and replay each block through the shared retirement path.
+
+        Software-pipeline schedule, host-orchestrated: the unit of work
+        is (block b, step i, stage s) — one ``decode_pipe_stage`` dispatch
+        over block b's step-i packed batch against stage s's KV slice.
+        Within a block, step i's stage 0 needs step i-1's sampled tokens
+        (the ``tok_src`` carry the fused scan threads on-device), so ONE
+        block occupies exactly one stage at a time; overlap comes from
+        multiple blocks — each pipeline tick walks stages LAST→FIRST and
+        advances at most one block per stage, so a block moves one stage
+        per tick and S blocks keep S stages busy (PAPERS.md #3's
+        micro-batching, applied to decode).  ``bubbles`` counts
+        stage-ticks spent idle (fill, drain, or too few live blocks):
+        bubble fraction = bubbles / (ticks × S).
+
+        Activations hand off stage-to-stage as device arrays (the PR 16
+        d2d style); an injected ``pipe.handoff`` fault is CONTAINED — the
+        transfer re-stages through the host (bounce via numpy, numerics
+        identical) and counts in ``pipe_handoff_host_fallbacks``.
+        ``pipe.stage_crash`` propagates like any tick crash: the worker's
+        crash handler recovers the WHOLE group via ``_alloc_state``.
+
+        KV safety: every stage dispatch reads the current full state's
+        stage view and merges back pools + counters/lengths.  Blocks own
+        disjoint rows, so interleaved merges touch disjoint ragged-length
+        entries; within a block, stages share one step's descriptors and
+        recompute identical lengths — merge order cannot change any
+        value the attention kernel reads (descriptors and the static
+        block table, both host-authored)."""
+        faults.check("decode.step")
+        if any(op[0] == "chunk" for p in plans
+               for ops in p["replay"] for op in ops):
+            faults.check("decode.prefill_chunk")
+        if any(op[0] == "verify" for p in plans
+               for ops in p["replay"] for op in ops):
+            faults.check("decode.verify")
+        pipe = self._pipe
+        S = pipe.stages
+        self._dispatch += sum(p["n"] for p in plans)
+        t0 = time.monotonic()
+        last_local = self._last_tok.copy()
+        blocks = [{"plan": p, "step": 0, "stage": 0, "h": None,
+                   "arr": np.zeros(p["tok_lit"].shape, np.int32)}
+                  for p in plans]
+        live = set(range(len(blocks)))
+        ticks = bubbles = 0
+        with model_mod.decode_priority(), \
+                profiling.span("penroz/sched_pipeline"):
+            while live:
+                ran_stage = 0
+                for s in reversed(range(S)):
+                    b = next((b for b in sorted(live)
+                              if blocks[b]["stage"] == s), None)
+                    if b is None:
+                        continue
+                    st = blocks[b]
+                    plan = st["plan"]
+                    i = st["step"]
+                    faults.check("pipe.stage_crash")
+                    if s == 0:
+                        tsrc = plan["tok_src"][i]
+                        x = np.where(tsrc >= 0,
+                                     last_local[np.clip(tsrc, 0, None)],
+                                     plan["tok_lit"][i])
+                    else:
+                        x = st["h"]
+                    lo, hi = pipe.kv_bounds[s]
+                    view = KV.stage_kv_view(self._kv, lo, hi)
+                    out, view2 = self._model.decode_pipe_stage(
+                        pipe, s, view, x, plan["descs"][i],
+                        plan["positions"][i], plan["row_ids"][i],
+                        self._rng, self.temperature, self.top_k)
+                    self._kv = KV.merge_stage_kv(self._kv, lo, hi, view2)
+                    ran_stage += 1
+                    self._pipe_stage_busy[s] += 1
+                    if s < S - 1:
+                        self._pipe_handoffs += 1
+                        try:
+                            faults.check("pipe.handoff")
+                        except faults.InjectedFault:
+                            # Mid-transfer fault: bounce the activations
+                            # through the host and carry on — numerics
+                            # identical, parity preserved.
+                            out = jnp.asarray(np.asarray(out))
+                            self._pipe_handoff_host_fallbacks += 1
+                        st["h"] = out
+                        st["stage"] = s + 1
+                        continue
+                    sampled = np.asarray(out)
+                    st["arr"][i] = sampled
+                    sslot = plan["sample_slot"][i]
+                    upd = np.where(sslot >= 0)[0]
+                    last_local[upd] = sampled[sslot[upd]]
+                    st["h"] = None
+                    st["step"] += 1
+                    st["stage"] = 0
+                    if st["step"] >= plan["n"]:
+                        live.discard(b)
+                ticks += 1
+                bubbles += S - ran_stage
+        t1 = time.monotonic()
+        self._pipe_ticks += ticks
+        self._pipe_bubble_ticks += bubbles
+        comp = {"prefill_chunks": 0, "prefill_rows": 0, "decode_rows": 0,
+                "verify_rows": 0, "emitted": 0}
+        for st in blocks:
+            part = self._replay_block(st["plan"], st["arr"], t0, t1)
+            for k in comp:
+                comp[k] += part[k]
+        comp["pipe_ticks"] = ticks
+        comp["pipe_bubbles"] = bubbles
+        return comp
 
     def _record_crash(self):
         serve_metrics.ENGINE_CRASHES.inc()
@@ -2768,10 +3003,15 @@ class DecodeEngine:
     # -- speculative decoding (PENROZ_SPEC_DECODE=1) -------------------------
 
     def _spec_on(self) -> bool:
-        """Greedy engines only: accepting a drafted token under sampling
-        would need rejection-resampling to keep the output distribution —
-        non-greedy engines cleanly bypass drafting."""
-        return self.greedy and spec_decode.enabled()
+        """Speculative decoding applies to greedy engines everywhere, and
+        to SAMPLING engines on the unified ragged path: its non-greedy
+        sampler draws with positional keys (one deterministic draw per
+        (row, position) — models/model.py::_sample_packed), so verifying
+        a point-mass prompt-lookup draft by longest matching prefix IS
+        exact rejection sampling (serve/spec_decode.py) and the emitted
+        stream is token-identical to spec-off.  The legacy phased path
+        still samples per-dispatch and keeps the greedy-only bypass."""
+        return spec_decode.enabled() and (self.greedy or self._unified())
 
     def _plan_drafts(self, rows: list[int]) -> list[tuple[int, list[int]]]:
         """(row, draft) pairs for this tick's verify steps.  The per-row
@@ -3314,6 +3554,21 @@ def _merged_q(per: list[dict], name: str, q: float):
     return round(v, 3) if v is not None else None
 
 
+def _pipe_bubble_agg(per: list[dict]):
+    """Stage-tick-weighted bubble fraction across every piped engine
+    (None until any pipeline group ticks): each engine's lifetime
+    fraction weighted by its pipe_ticks × stages denominator, so a busy
+    group dominates an idle one instead of averaging them 50/50."""
+    num = den = 0.0
+    for p in per:
+        ticks, frac = p["pipe_ticks"], p["pipe_bubble_fraction"]
+        if ticks and frac is not None:
+            w = ticks * p["pipe_stages"]
+            num += frac * w
+            den += w
+    return round(num / den, 4) if den else None
+
+
 def serving_stats() -> dict:
     """Aggregate scheduler observability — the /serving_stats/ payload.
 
@@ -3432,6 +3687,16 @@ def serving_stats() -> dict:
         "disagg_handoff_ms_p99": _merged_q(per, "handoff_ms", 0.99),
         "disagg_transport": _disagg_transport(),
         "disagg_role_changes": sum(p["disagg_role_changes"] for p in per),
+        # Pipeline-parallel serving (PENROZ_SERVE_PIPE_STAGES >= 2): the
+        # router sees each stage group as ONE replica, so the aggregate is
+        # over groups — widest group, total schedule ticks, and the
+        # tick-weighted idle share across every piped engine.
+        "pipe_stages": max((p["pipe_stages"] for p in per), default=1),
+        "pipe_ticks": sum(p["pipe_ticks"] for p in per),
+        "pipe_bubble_fraction": _pipe_bubble_agg(per),
+        "pipe_handoffs": sum(p["pipe_handoffs"] for p in per),
+        "pipe_handoff_host_fallbacks": sum(
+            p["pipe_handoff_host_fallbacks"] for p in per),
         # KV tiering / session hibernation (serve/tierstore.py): the
         # store is process-wide (shared across engines and replicas), so
         # residency/tier fields come from it directly; the counters below

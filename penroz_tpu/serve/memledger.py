@@ -282,6 +282,7 @@ class MemoryLedger:
             "pool_pages": states,
             "tenant_pages": tenant_pages,
             "adapter_pages": adapter_pages,
+            "stage_pools": self._stage_pools_locked(),
             "hbm_bytes": hbm,
             "high_water_pages": dict(self.high_water),
             "time_to_exhaustion_s": self._time_to_exhaustion(
@@ -291,6 +292,26 @@ class MemoryLedger:
             "pressure_events": self.pressure_events,
             "audit_failures": self.audit_failures,
         }
+
+    def _stage_pools_locked(self) -> list:
+        """Per-pipeline-stage pool attribution: stage ``s`` owns attention
+        layers ``kv_bounds[s]`` of the paged cache, so its device holds
+        ``kv_pool_bytes`` of pool HBM for the SAME ``pool_pages`` page
+        partition (pages are a per-layer-replicated concept: every stage
+        sees every logical page, in its own layers only — which is
+        exactly why per-device KV HBM drops ~1/S).  Empty list when the
+        engine is not a pipeline group."""
+        e = self._engine
+        pipe = getattr(e, "_pipe", None)
+        kv = e._kv
+        if (pipe is None or not isinstance(kv, KV.PagedKVState)
+                or not enabled()):
+            return []
+        return [{"stage": s,
+                 "kv_layers": hi - lo,
+                 "pool_pages": kv.num_pool_pages,
+                 "kv_pool_bytes": KV.stage_pool_bytes(kv, lo, hi)}
+                for s, (lo, hi) in enumerate(pipe.kv_bounds)]
 
     def _time_to_exhaustion(self, free_pages: int, page_size: int):
         """Free row-region KV tokens over the recent token burn rate —
@@ -376,6 +397,26 @@ class MemoryLedger:
         for s, n in states.items():
             if n < 0:
                 problems.append(f"negative page count {s}={n}")
+        # Pipeline groups: re-prove the partition invariant per stage
+        # pool (every stage sees the full logical page partition over its
+        # own layers), and the stage byte attribution must tile the pool
+        # HBM exactly — a stage slice that drifted from kv_bounds would
+        # double-count or leak pool bytes here.
+        for entry in snap["stage_pools"]:
+            if entry["pool_pages"] != snap["pool_pages_total"]:
+                problems.append(
+                    f"stage {entry['stage']}: pool_pages="
+                    f"{entry['pool_pages']} != pool capacity "
+                    f"{snap['pool_pages_total']}")
+        if snap["stage_pools"]:
+            stage_bytes = sum(en["kv_pool_bytes"]
+                              for en in snap["stage_pools"])
+            kv_bytes = (snap["hbm_bytes"]["kv_values"]
+                        + snap["hbm_bytes"]["kv_scales"])
+            if stage_bytes != kv_bytes:
+                problems.append(
+                    f"stage pool bytes sum to {stage_bytes} != kv pool "
+                    f"HBM {kv_bytes}")
         return problems
 
 

@@ -32,6 +32,8 @@ counters   requests_total{outcome}, decode_tokens_total,
            stream_resumes_total, stream_detach_expired_total
 gauges     engines, active_rows, queue_depth, batch_occupancy,
            breaker_open, draining, lora_live_adapters,
+           pipe_stages, pipe_ticks, pipe_bubble_ticks,
+           pipe_handoffs{path},
            kv_pool_capacity_drops, prefix_cache_unpin_underflow
            (both monotonic in practice, exposed as gauges because the
            source counters live in ops/kv_cache.py),
@@ -324,6 +326,25 @@ STREAMS_DETACHED = REGISTRY.register(m.Gauge(
     "penroz_streams_detached",
     "Resumable /generate/ streams currently inside their disconnect "
     "grace window, decode still running"))
+PIPE_STAGES_GAUGE = REGISTRY.register(m.Gauge(
+    "penroz_pipe_stages",
+    "Widest pipeline-parallel serving group across engines "
+    "(PENROZ_SERVE_PIPE_STAGES; 1 = no piped engine)"))
+PIPE_TICKS = REGISTRY.register(m.Gauge(
+    "penroz_pipe_ticks",
+    "Pipeline schedule ticks across piped engines (lifetime counter "
+    "read at scrape) — with penroz_pipe_bubble_ticks this derives the "
+    "bubble fraction: bubble_ticks / (ticks × stages)"))
+PIPE_BUBBLE_TICKS = REGISTRY.register(m.Gauge(
+    "penroz_pipe_bubble_ticks",
+    "Idle stage-ticks across piped engines (a stage with no live "
+    "micro-block to advance that tick)"))
+PIPE_HANDOFFS = REGISTRY.register(m.Gauge(
+    "penroz_pipe_handoffs",
+    "Stage-to-stage activation hand-offs by path: 'device' direct "
+    "array hand-over, 'host' re-staged through the host after a "
+    "pipe.handoff fault (contained; numerics identical)",
+    labelnames=("path",)))
 
 
 def _wire_gauges():
@@ -381,6 +402,24 @@ def _wire_gauges():
 
     from penroz_tpu.serve import streams
     STREAMS_DETACHED.set_function(streams.STREAMS.detached_count)
+
+    # Pipeline-parallel serving (PENROZ_SERVE_PIPE_STAGES >= 2): scrape-
+    # time reads of the engines' lifetime schedule counters, like the
+    # other gauge families above.
+    PIPE_STAGES_GAUGE.set_function(lambda: max(
+        (e._pipe.stages for e in engines() if e._pipe is not None),
+        default=1))
+    PIPE_TICKS.set_function(
+        lambda: sum(e._pipe_ticks for e in engines()))
+    PIPE_BUBBLE_TICKS.set_function(
+        lambda: sum(e._pipe_bubble_ticks for e in engines()))
+
+    def pipe_handoffs():
+        host = sum(e._pipe_handoff_host_fallbacks for e in engines())
+        total = sum(e._pipe_handoffs for e in engines())
+        return {"device": total - host, "host": host}
+
+    PIPE_HANDOFFS.set_function(pipe_handoffs)
 
 
 _WIRED = False

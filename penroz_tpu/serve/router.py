@@ -29,6 +29,17 @@ Placement policy, in order:
    queue depths would deny), then class queue depth, then total load,
    then replica index (deterministic placement for the parity tests).
 
+**Pipeline groups are one replica** (``PENROZ_SERVE_PIPE_STAGES=S``,
+S ≥ 2): a stage-partitioned engine is still ONE :class:`DecodeEngine` —
+the S stage-engines (stage-sliced params + per-stage KV pool, composing
+with ``PENROZ_SERVE_MESH_MODEL`` TP width per stage) are internal to its
+tick loop, so the router places requests, counts load, and trips
+breakers at pipeline-group granularity.  A stage crash surfaces as that
+one engine's crash: the worker's crash handler reallocates the WHOLE
+group (every stage's pools and placement) through the same
+``_alloc_state`` path as an unpiped engine, and the group's breaker —
+not a per-stage one — decides when it takes traffic again.
+
 Failover: a replica that refuses (breaker open, queue full, draining) is
 skipped and the next candidate tried — the client only sees an error when
 EVERY replica refuses, so one crashed replica never 503s a request a

@@ -254,6 +254,31 @@ class TickRecord(BaseModel):
                               "at tick start (mixed-composition view)")
     decode_rows: int = Field(0, description="Rows in the decode/verify "
                              "phase at tick start (mixed-composition view)")
+    pipe_ticks: int = Field(0, description="Pipeline schedule ticks this "
+                            "scheduler tick ran (stage-unit rounds; 0 off "
+                            "the pipeline path)")
+    pipe_bubbles: int = Field(0, description="Idle stage-ticks during "
+                              "this tick's pipeline schedule (fill/drain "
+                              "or too few micro-blocks); bubble fraction "
+                              "= pipe_bubbles / (pipe_ticks × stages)")
+
+
+class StagePoolEntry(BaseModel):
+    """One pipeline stage's slice of a group's paged KV pool
+    (PENROZ_SERVE_PIPE_STAGES): the stage holds the SAME logical page
+    partition over its own attention layers only, so per-device pool HBM
+    drops ~1/S while the page states stay group-wide."""
+    stage: int = Field(..., description="Stage index (0-based, in layer "
+                       "order)")
+    kv_layers: int = Field(..., description="Attention layers whose K/V "
+                           "pools live on this stage's mesh")
+    pool_pages: int = Field(..., description="Logical pool pages visible "
+                            "to this stage (= pool_pages_total; audited "
+                            "per stage in strict mode)")
+    kv_pool_bytes: int = Field(..., description="Pool bytes resident on "
+                               "this stage's devices (values + int8 "
+                               "scales); stages sum to the group's "
+                               "kv_values + kv_scales")
 
 
 class EngineMemory(BaseModel):
@@ -284,6 +309,10 @@ class EngineMemory(BaseModel):
     adapter_pages: dict[str, int] = Field(
         default_factory=dict, description="Row-owned pages per LoRA "
         "adapter id (adapter-bound rows only)")
+    stage_pools: list[StagePoolEntry] = Field(
+        default_factory=list, description="Per-pipeline-stage pool "
+        "attribution (PENROZ_SERVE_PIPE_STAGES >= 2 groups only; empty "
+        "for unpiped engines)")
     hbm_bytes: dict[str, int] = Field(
         default_factory=dict, description="Bytes per component: "
         "kv_values / kv_scales (int8 variants) / kv_block_table / "
@@ -358,6 +387,30 @@ class EngineStats(BaseModel):
     disagg_role_changes: int = Field(
         0, description="Elastic role flips this engine applied at drain "
         "boundaries (PENROZ_DISAGG_ELASTIC=1)")
+    pipe_stages: int = Field(1, description="Pipeline stages in this "
+                             "engine's serving group "
+                             "(PENROZ_SERVE_PIPE_STAGES; 1 = unpiped)")
+    pipe_microblocks: int = Field(0, description="Micro-blocks the mixed "
+                                  "batch splits into per pipeline tick "
+                                  "(PENROZ_SERVE_PIPE_BLOCKS, >= stages; "
+                                  "0 = unpiped)")
+    pipe_ticks: int = Field(0, description="Pipeline schedule ticks over "
+                            "the engine lifetime (stage-unit rounds)")
+    pipe_bubble_fraction: Optional[float] = Field(
+        None, description="Lifetime idle share of stage-ticks: "
+        "bubble_ticks / (pipe_ticks × stages).  Null before the first "
+        "pipeline tick or when unpiped")
+    pipe_stage_busy: dict[str, int] = Field(
+        default_factory=dict, description="Stage-unit dispatches per "
+        "stage index (balanced stages decode in lockstep; a skewed "
+        "count means a stage is starving)")
+    pipe_handoffs: int = Field(0, description="Stage-to-stage activation "
+                               "hand-offs (device-array transfers, PR 16 "
+                               "d2d style)")
+    pipe_handoff_host_fallbacks: int = Field(
+        0, description="Hand-offs re-staged through the host after a "
+        "pipe.handoff fault mid-transfer (contained; numerics "
+        "identical)")
     sessions_hibernated: int = Field(
         0, description="Session-tagged retirements whose KV this engine "
         "parked in the radix cache for tier demotion instead of freeing "
@@ -470,9 +523,10 @@ class EngineStats(BaseModel):
         default_factory=dict, description="Tokens emitted per adapter id "
         "over the engine lifetime (multi-tenant accounting)")
     spec_decode: bool = Field(False, description="Speculative decoding "
-                              "active on this engine (PENROZ_SPEC_DECODE=1 "
-                              "and greedy sampling; non-greedy engines "
-                              "bypass drafting)")
+                              "active on this engine (PENROZ_SPEC_DECODE=1; "
+                              "greedy engines verify by argmax match, "
+                              "non-greedy unified engines by rejection "
+                              "sampling against the positional keys)")
     spec_verify_steps: int = Field(0, description="Multi-token verify "
                                    "dispatches (one per drafted row per "
                                    "decode tick)")
@@ -670,6 +724,20 @@ class ServingStatsResponse(BaseModel):
     disagg_role_changes: int = Field(
         0, description="Aggregate elastic role flips applied across "
         "engines (PENROZ_DISAGG_ELASTIC=1)")
+    pipe_stages: int = Field(
+        1, description="Widest pipeline group across engines "
+        "(PENROZ_SERVE_PIPE_STAGES; 1 = no piped engine)")
+    pipe_ticks: int = Field(
+        0, description="Aggregate pipeline schedule ticks across piped "
+        "engines")
+    pipe_bubble_fraction: Optional[float] = Field(
+        None, description="Stage-tick-weighted idle share across every "
+        "piped engine (null until any pipeline group ticks)")
+    pipe_handoffs: int = Field(
+        0, description="Aggregate stage-to-stage activation hand-offs")
+    pipe_handoff_host_fallbacks: int = Field(
+        0, description="Aggregate hand-offs re-staged through the host "
+        "after a pipe.handoff fault")
     sessions_resident: int = Field(
         0, description="Hibernated sessions currently resident in any "
         "tier (process-wide tier store, serve/tierstore.py; "

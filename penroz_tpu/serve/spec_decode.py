@@ -9,18 +9,34 @@ predictable case directly: if the trailing ``n``-gram of a row's context
 tokens that followed it are proposed as a draft, and the scheduler's
 **verify step** runs ONE forward over the K+1 candidate positions
 (``NeuralNetworkModel.decode_verify_row``), accepting the longest
-greedy-matching prefix plus the model's bonus token.  Rejections roll the
+target-matching prefix plus the model's bonus token.  Rejections roll the
 row's KV length back (``KVState.rollback_row``), so a wrong draft costs
-one multi-token forward instead of wrong output — greedy results are
+one multi-token forward instead of wrong output — results are
 token-identical to speculation off by construction.
 
-Greedy-only: under sampling, accepting a draft token would need the full
-rejection-resampling scheme to keep the output distribution; non-greedy
-engines bypass drafting entirely (the scheduler checks ``engine.greedy``).
+Sampling (temperature > 0) is exact rejection sampling, for free: the
+unified engine samples every packed slot with a **positional key**
+(``fold_in(fold_in(rng, row), position)`` —
+``CompiledArch._sample_packed``), so the target token at (row, position)
+is one deterministic draw ``t ~ p_target`` no matter which dispatch
+carries the slot.  Prompt-lookup drafts are point masses (q = δ_draft),
+and for a point-mass proposal the textbook accept/resample rule
+["accept ``d`` w.p. min(1, p(d)/q(d)); else resample the residual
+max(0, p − q)/Z"] collapses to exactly "sample ``t ~ p``, accept iff
+``t == d``, else emit ``t``": acceptance probability is p(d), and the
+rejected-slot token is distributed p(t)/(1 − p(d)) on t ≠ d — the
+residual.  That is precisely the longest-matching-prefix comparison the
+greedy path already runs, applied to the positionally-keyed samples
+instead of the argmax.  Spec-on therefore emits the byte-identical
+stream to spec-off at any temperature (pinned by the seeded parity
+test); the greedy path's argmax comparison is untouched.  The legacy
+phased engine keeps the greedy-only gate — it samples with
+dispatch-order keys, which verify dispatches would perturb.
 
 Knobs::
 
-    PENROZ_SPEC_DECODE=1   enable (scheduler path, greedy engines)
+    PENROZ_SPEC_DECODE=1   enable (greedy engines; non-greedy too on
+                           the unified ragged scheduler path)
     PENROZ_SPEC_K          max draft tokens per verify step (default 4)
     PENROZ_SPEC_NGRAM      trailing-match length (default 3)
 

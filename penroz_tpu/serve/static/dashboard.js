@@ -311,6 +311,20 @@ function renderServing(data) {
       `(${data.disagg_handoff_failures || 0} failed) · handoff p99 ` +
       `${handoffP99 == null ? "—" : handoffP99.toFixed(0) + "ms"}` +
       `${roleChanges ? ` · flips ${roleChanges}` : ""}`;
+  /* Pipeline-parallel serving (PENROZ_SERVE_PIPE_STAGES >= 2): stage
+   * count of the widest group, the lifetime bubble (idle stage-tick)
+   * fraction from the schedule telemetry, and hand-off health — a
+   * nonzero host-fallback count means a pipe.handoff fault re-staged
+   * activations through the host (contained, numerics identical).
+   * "pipe off" on unpiped engines. */
+  const pipeStages = data.pipe_stages || 1;
+  const bubble = data.pipe_bubble_fraction;
+  const pipeTxt = pipeStages <= 1 ? "pipe off"
+    : `pipe ${pipeStages} stages · bubble ` +
+      `${bubble == null ? "—" : (bubble * 100).toFixed(0) + "%"} · ` +
+      `handoffs ${data.pipe_handoffs || 0}` +
+      `${data.pipe_handoff_host_fallbacks
+         ? ` (${data.pipe_handoff_host_fallbacks} host)` : ""}`;
   /* Session hibernation / KV tiering (session_id on /generate/): resident
    * sessions split by tier, promotion outcome tallies, and the resume-TTFT
    * tail — "sessions off" until any session hibernates. */
@@ -357,7 +371,8 @@ function renderServing(data) {
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
     `${multistepTxt} · ` +
     `${specTxt} · ${loraTxt} · ${prefixTxt} · ${qosTxt} · ${routerTxt} · ` +
-    `${disaggTxt} · ${tierTxt} · ${durTxt} · KV pool drops ${drops}`;
+    `${disaggTxt} · ${pipeTxt} · ${tierTxt} · ${durTxt} · ` +
+    `KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });
   if (servingHistory.length > 200) servingHistory.shift();
   const xs = servingHistory.map((_, i) => i);

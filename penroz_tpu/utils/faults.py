@@ -55,7 +55,17 @@ read — a failure recovers to an empty registry and a clean audit, never
 a crashed startup),
 ``stream.resume`` (stream reattach at GET /generate/{id}/stream, fired
 before the ring is consulted — a failure surfaces as the HTTP error
-while the generation keeps running and remains resumable).
+while the generation keeps running and remains resumable),
+``pipe.handoff`` (pipeline-parallel stage-to-stage activation hand-off,
+fired after the upstream stage's dispatch returns but before the next
+stage consumes the activations — a failure is CONTAINED: the transfer
+re-stages through the host (``jnp.asarray(np.asarray(h))``), counted in
+``pipe_handoff_host_fallbacks``, with greedy parity preserved),
+``pipe.stage_crash`` (fired at the top of each stage-unit dispatch in
+the pipeline schedule — a raise propagates out of the tick like any
+stage failure would, and the worker's crash handler reallocates the
+WHOLE pipeline group through ``_alloc_state``: every stage's pool
+rebuilt, placement redone, strict memledger audit clean afterwards).
 Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.
 """

@@ -2715,6 +2715,13 @@ async def _bench_chaos() -> dict:
         env["PENROZ_DISAGG_ELASTIC"] = "0"
         env["PENROZ_DISAGG_REBALANCE_COOLDOWN_MS"] = "0"
         env["PENROZ_DISAGG_REBALANCE_DOWN"] = "1000000000"
+    if site.startswith("pipe."):
+        # the pipeline schedule only runs with a stage group configured;
+        # the ragged unified dispatch is its prerequisite (the matrix
+        # pins it, but arming pipe sites standalone must work too)
+        env["PENROZ_SERVE_PIPE_STAGES"] = os.environ.get(
+            "PENROZ_SERVE_PIPE_STAGES", "2")
+        env["PENROZ_RAGGED_ATTENTION"] = "1"
     tier = site.startswith("tier.")
     journal_site = site.startswith("journal.")
     stream_site = site == "stream.resume"
@@ -2951,6 +2958,14 @@ async def _bench_chaos() -> dict:
             # stream.resume evidence is in the `extra` keys filled by
             # their armed phases above
             "journal": stats.get("journal", {}),
+            # pipe.handoff evidence: the caught fault re-staged through
+            # the host (fallback counter); pipe.stage_crash evidence is
+            # the ordinary crash/reset pair — whole-group recovery
+            "pipe_stages": stats.get("pipe_stages", 1),
+            "pipe_handoffs": stats.get("pipe_handoffs", 0),
+            "pipe_handoff_host_fallbacks": stats.get(
+                "pipe_handoff_host_fallbacks", 0),
+            "engine_resets": stats.get("engine_resets", 0),
             **extra,
             "parity_ok": parity_ok,
             "ok": (not disallowed and parity_ok
@@ -2962,6 +2977,164 @@ async def _bench_chaos() -> dict:
         decode_scheduler.reset()
         await client.close()
         faults.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# --pipeline: MPMD stage-partitioned decode (PENROZ_SERVE_PIPE_STAGES)
+# ---------------------------------------------------------------------------
+
+async def _bench_pipeline() -> dict:
+    """Pipeline-parallel decode: the SAME greedy workload measured three
+    ways — unpiped (``PENROZ_SERVE_PIPE_STAGES`` unset, the PR 18 serving
+    path), S=1 (pipeline code path armed but degenerate — must be
+    byte-identical to unpiped), and S=2 (stage-partitioned params +
+    per-stage KV pools, token micro-batching between stages).
+
+    Evidence the JSON carries:
+
+    - ``parity_s1`` / ``parity_s2``: greedy token streams byte-identical
+      to the unpiped baseline at both stage counts;
+    - ``capacity``: the unpiped engine's KV pool bytes vs the largest
+      single-stage pool at S=2 (from ``/memory/`` ``stage_pools``) — the
+      full model's pool exceeds one stage's budget, i.e. S=2 serves a
+      model sized past what one stage provisions;
+    - ``bubble_fraction`` / ``pipe_stage_busy`` / ``pipe_handoffs``: the
+      fill-drain bubble model from tick telemetry — stage-slot idleness
+      over ``pipe_ticks * stages`` stage-slots, with zero host fallbacks
+      on the healthy path.
+
+    Scale knobs: the shared ``PENROZ_BENCH_SERVING_BLOCK/_D/_DEPTH``,
+    ``PENROZ_BENCH_MAX_NEW``, ``PENROZ_BENCH_PIPE_STREAMS``."""
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 128)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 64)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 4)
+    streams = _env_i("PENROZ_BENCH_PIPE_STREAMS", 4)
+    prompt_len = _env_i("PENROZ_BENCH_PIPE_PROMPT", 12)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 32)
+    vocab = 256
+    assert prompt_len + max_new <= block
+    assert depth % 2 == 0, "need an even layer count to split at S=2"
+
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        decode_scheduler.MAX_ROWS_ENV: str(streams),
+        "PAGED_KV_CACHE": "1",
+        "PENROZ_RAGGED_ATTENTION": "1",
+        "PENROZ_KV_PAGE_SIZE": "16",
+    }
+    saved = {k: os.environ.get(k)
+             for k in (*env, "PENROZ_SERVE_PIPE_STAGES")}
+    os.environ.update(env)
+    os.environ.pop("PENROZ_SERVE_PIPE_STAGES", None)
+
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(17)
+    prompts = [[int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+               for _ in range(streams)]
+    warm_prompts = [[int(t) for t in rng.integers(1, vocab - 1, prompt_len)]
+                    for _ in range(streams)]
+
+    def payload(prompt):
+        return {"model_id": "bench-pipe", "input": [prompt],
+                "block_size": block, "max_new_tokens": max_new,
+                "temperature": 0.0}
+
+    try:
+        resp = await client.post("/model/", json={
+            "model_id": "bench-pipe",
+            "layers": _toy_gpt(d=d, heads=4, vocab=vocab, block=block,
+                               depth=depth),
+            "optimizer": {"sgd": {"lr": 0.1}}})
+        assert resp.status == 200, await resp.text()
+        metrics_before = await _scrape_metrics(client)
+
+        results: dict = {
+            "mode": "pipeline", "block_size": block, "model_d": d,
+            "model_depth": depth, "streams": streams,
+            "prompt_len": prompt_len, "max_new": max_new,
+        }
+        seqs: dict = {}
+        for phase, stages in (("unpiped", None), ("s1", 1), ("s2", 2)):
+            if stages is None:
+                os.environ.pop("PENROZ_SERVE_PIPE_STAGES", None)
+            else:
+                os.environ["PENROZ_SERVE_PIPE_STAGES"] = str(stages)
+            decode_scheduler.reset()
+            # warm with distinct prompts so measured streams pay no compiles
+            await asyncio.gather(*[_stream_one(client, payload(p))
+                                   for p in warm_prompts])
+            outs = await asyncio.gather(*[_stream_one(client, payload(p))
+                                          for p in prompts])
+            seqs[phase] = [toks for toks, _, _ in outs]
+            itls = [g for _, _, gaps in outs for g in gaps]
+            resp = await client.get("/serving_stats/")
+            stats = await resp.json()
+            resp = await client.get("/memory/")
+            mem = await resp.json()
+            eng = mem["engines"][0] if mem.get("engines") else {}
+            e_stats = stats["engines"][0] if stats.get("engines") else {}
+            results[phase] = {
+                "itl_ms_p50": (round(_pct(itls, 0.5), 3) if itls else None),
+                "itl_ms_p99": (round(_pct(itls, 0.99), 3) if itls else None),
+                "pipe_stages": stats.get("pipe_stages", 1),
+                "pipe_ticks": stats.get("pipe_ticks", 0),
+                "pipe_microblocks": e_stats.get("pipe_microblocks", 0),
+                "pipe_bubble_fraction": stats.get("pipe_bubble_fraction"),
+                "pipe_stage_busy": e_stats.get("pipe_stage_busy", {}),
+                "pipe_handoffs": stats.get("pipe_handoffs", 0),
+                "pipe_handoff_host_fallbacks": stats.get(
+                    "pipe_handoff_host_fallbacks", 0),
+                "kv_pool_bytes": (int(eng["hbm_bytes"].get("kv_values", 0))
+                                  + int(eng["hbm_bytes"].get("kv_scales", 0))
+                                  if eng.get("hbm_bytes") else 0),
+                "stage_pools": eng.get("stage_pools", []),
+            }
+
+        results["parity_s1"] = seqs["s1"] == seqs["unpiped"]
+        results["parity_s2"] = seqs["s2"] == seqs["unpiped"]
+        # Capacity: the whole model's KV pool vs ONE stage's provisioned
+        # pool at S=2.  Each stage only budgets pages for its own layer
+        # slice, so the unpiped pool (all layers on one stage) must not
+        # fit inside the largest single-stage pool.
+        full_bytes = results["unpiped"]["kv_pool_bytes"]
+        stage_bytes = [int(sp["kv_pool_bytes"])
+                       for sp in results["s2"]["stage_pools"]]
+        results["capacity"] = {
+            "full_model_kv_pool_bytes": full_bytes,
+            "s2_stage_kv_pool_bytes": stage_bytes,
+            "exceeds_single_stage_pool": bool(
+                stage_bytes and full_bytes > max(stage_bytes)),
+        }
+        s2 = results["s2"]
+        bubble = s2["pipe_bubble_fraction"]
+        pipe_ok = (
+            s2["pipe_stages"] == 2 and s2["pipe_ticks"] > 0
+            and bubble is not None and 0.0 <= bubble < 1.0
+            and s2["pipe_handoffs"] > 0
+            and s2["pipe_handoff_host_fallbacks"] == 0
+            and set(s2["pipe_stage_busy"]) == {"0", "1"}
+            and results["unpiped"]["pipe_ticks"] == 0)
+        results["bubble_fraction"] = bubble
+        results["ok"] = bool(
+            results["parity_s1"] and results["parity_s2"] and pipe_ok
+            and results["capacity"]["exceeds_single_stage_pool"])
+        results["metrics_delta"] = _metrics_delta(
+            metrics_before, await _scrape_metrics(client))
+        return results
+    finally:
+        decode_scheduler.reset()
+        await client.close()
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)
@@ -2984,7 +3157,7 @@ def main():
                          "--multi-adapter", "--multistep", "--mixed-slo",
                          "--chaos", "--ragged", "--memory", "--replicas",
                          "--disagg", "--disagg-elastic", "--sessions",
-                         "--restart")]
+                         "--restart", "--pipeline")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     replicas = "--replicas" in sys.argv[1:]
@@ -2999,6 +3172,7 @@ def main():
     memory = "--memory" in sys.argv[1:]
     disagg = "--disagg" in sys.argv[1:]
     disagg_elastic = "--disagg-elastic" in sys.argv[1:]
+    pipeline = "--pipeline" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
@@ -3050,6 +3224,9 @@ def main():
         return
     if disagg_elastic:
         _emit(asyncio.run(_bench_disagg_elastic()))
+        return
+    if pipeline:
+        _emit(asyncio.run(_bench_pipeline()))
         return
     if disagg:
         _emit(asyncio.run(_bench_disagg()))

@@ -221,6 +221,42 @@ if [ "${CHAOS_FAST:-0}" != "1" ]; then
     fi
   done
 
+  # Pipeline-parallel serving (PENROZ_SERVE_PIPE_STAGES=2): the two
+  # stage-schedule fault sites, both on the ragged unified engine with
+  # the strict ledger re-proving the per-stage pool partition.
+  #
+  # - pipe.handoff crashes a stage-to-stage activation transfer
+  #   mid-flight — CONTAINED: the hand-off re-stages through the host
+  #   (gate on pipe_handoff_host_fallbacks > 0 proving the site really
+  #   fired) and the solo replay stays greedy token-identical.
+  # - pipe.stage_crash raises at the top of a stage-unit dispatch —
+  #   propagates like any stage failure: the worker's crash handler must
+  #   reallocate the WHOLE group (gate on engine_resets > 0), the strict
+  #   audit must stay clean, and parity must hold after recovery.
+  for psite in ${CHAOS_PIPE_SITES:-pipe.handoff pipe.stage_crash}; do
+    ran=$((ran + 1))
+    echo "=== chaos: site=$psite stages=2 ===" >&2
+    out=$(PENROZ_BENCH_CHAOS_SITE="$psite" PENROZ_SERVE_PIPE_STAGES=2 \
+            PENROZ_RAGGED_ATTENTION=1 PENROZ_MEMLEDGER_STRICT=1 \
+            timeout 900 python scripts/bench_serving.py --chaos)
+    rc=$?
+    echo "$out"
+    if [ "$rc" -ne 0 ]; then
+      echo "FAIL site=$psite rc=$rc" >&2
+      fail=1
+      continue
+    fi
+    case "$psite" in
+      pipe.handoff) gate='r.get("ok") and r.get("pipe_handoff_host_fallbacks", 0) > 0' ;;
+      *)            gate='r.get("ok") and r.get("engine_resets", 0) > 0' ;;
+    esac
+    if ! printf '%s' "$out" | python -c \
+        "import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if ($gate) else 1)"; then
+      echo "FAIL site=$psite: disallowed statuses, parity break, or site never fired" >&2
+      fail=1
+    fi
+  done
+
   # disagg.rebalance (PR 16): crash the first elastic role-flip attempt
   # (the bench arms elastic together with the fault, so flip #1 runs
   # armed).  The crash must recover with the role registry consistent

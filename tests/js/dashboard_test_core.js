@@ -226,6 +226,12 @@ async function runDashboardTests(src, fixtures) {
                fixtures.serving.disagg_handoff_ms_p99.toFixed(0) + "ms" +
                ` · flips ${fixtures.serving.disagg_role_changes}`),
              "serving tile shows disagg transport, role chips, flips");
+    assertOk(servingMeta.includes(
+               `pipe ${fixtures.serving.pipe_stages} stages · bubble ` +
+               (fixtures.serving.pipe_bubble_fraction * 100).toFixed(0) +
+               `% · handoffs ${fixtures.serving.pipe_handoffs} ` +
+               `(${fixtures.serving.pipe_handoff_host_fallbacks} host)`),
+             "serving tile shows pipeline stages, bubble %, hand-offs");
     const servingOps = document.byId["serving-chart"]._ops.map((o) => o[0]);
     assertOk(servingOps.includes("stroke"), "serving chart drew");
     const badge = document.byId["status-badge"];

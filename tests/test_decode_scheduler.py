@@ -1084,9 +1084,12 @@ def test_spec_mid_flight_admission_during_verify(gpt_model, make_engine,
 
 def test_spec_non_greedy_engine_bypasses_drafting(gpt_model, make_engine,
                                                   spec_env):
-    """Non-greedy engines cleanly bypass drafting (acceptance under
-    sampling would need rejection-resampling): the request completes and
-    no draft is ever proposed."""
+    """Non-greedy engines on the LEGACY (contiguous-cache phased) path
+    still bypass drafting — its dispatch-order sampling keys would be
+    perturbed by verify dispatches.  The unified ragged engine lifts the
+    gate via positional-key rejection sampling
+    (tests/test_pipeline_serving.py pins that parity); no PAGED_KV_CACHE
+    here, so this engine is the phased one."""
     engine = make_engine("schedgpt", BLOCK, 0.8, 4, capacity=2)
     result = _submit(engine, [1, 2, 3], 4).result()
     assert len(result) == 7

@@ -1,0 +1,373 @@
+"""Pipeline-parallel serving tests (PENROZ_SERVE_PIPE_STAGES).
+
+The MPMD stage-partitioned decode path: S stage-engines over stage-sliced
+params and per-stage paged KV pools, kept busy by token micro-batching
+over the ragged unified dispatch.  The load-bearing contract is the same
+one every scheduler feature carries — greedy token parity with the
+unpiped engine — plus the pipeline's own telemetry (schedule ticks,
+bubble fraction, stage busy counts, hand-offs), the per-stage memledger
+attribution, and the two fault sites (pipe.handoff contained host
+re-stage, pipe.stage_crash whole-group recovery).
+
+Tier-1-safe: CPU, the 2-block conftest toy GPT (one attention layer per
+stage at S=2), strict memory ledger on suite-wide (tests/conftest.py) so
+every tick re-proves the per-stage pool partition.
+"""
+
+import queue
+import time
+
+import pytest
+
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+
+pytestmark = pytest.mark.runtime
+
+BLOCK = 16
+SGD = {"sgd": {"lr": 0.1}}
+REP_PROMPT = [1, 2, 3, 1, 2, 3, 1, 2]
+
+
+@pytest.fixture(autouse=True)
+def _scheduler_registry(workdir):
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import decode_scheduler, qos
+    from penroz_tpu.utils import faults
+    faults.reset()
+    qos.reset()
+    KV.reset_unpin_underflow_count()
+    yield
+    decode_scheduler.reset()
+    faults.reset()
+    qos.reset()
+    KV.reset_unpin_underflow_count()
+
+
+@pytest.fixture
+def pipe_env(monkeypatch):
+    """The pipeline's prerequisites: paged KV + the ragged unified
+    dispatch (small pages so the toy prompts span several)."""
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv("PENROZ_RAGGED_ATTENTION", "1")
+    return monkeypatch
+
+
+@pytest.fixture
+def gpt_model(workdir, toy_gpt_layers):
+    model = NeuralNetworkModel("pipegpt", Mapper(toy_gpt_layers, SGD))
+    model.serialize(sync_flush=True)
+    return model
+
+
+@pytest.fixture
+def make_engine():
+    from penroz_tpu.serve import decode_scheduler
+    engines = []
+
+    def build(*args, **kwargs):
+        engine = decode_scheduler.DecodeEngine(*args, **kwargs)
+        engines.append(engine)
+        return engine
+
+    yield build
+    for engine in engines:
+        engine.shutdown()
+
+
+class _Collector:
+    def __init__(self, prompt):
+        self.q = queue.Queue()
+        self.tokens = list(prompt)
+        self.received = 0
+
+    def on_event(self, kind, value):
+        self.q.put((kind, value))
+
+    def result(self, timeout=180):
+        deadline = time.monotonic() + timeout
+        while True:
+            kind, value = self.q.get(
+                timeout=max(deadline - time.monotonic(), 0.1))
+            if kind == "token":
+                self.tokens.append(value)
+                self.received += 1
+            elif kind == "done":
+                return self.tokens
+            else:
+                raise value
+
+
+def _submit(engine, prompt, max_new, stop_token=None):
+    from penroz_tpu.serve import decode_scheduler
+    collector = _Collector(prompt)
+    engine.submit(decode_scheduler.Request(prompt, max_new, stop_token,
+                                           collector.on_event))
+    return collector
+
+
+def _wait_tokens(collector, n, timeout=120):
+    deadline = time.monotonic() + timeout
+    while collector.received < n:
+        assert time.monotonic() < deadline, "request never started decoding"
+        try:
+            kind, value = collector.q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        assert kind == "token", kind
+        collector.tokens.append(value)
+        collector.received += 1
+
+
+def _oracle_drafter(bases):
+    def propose(history, k, n):
+        for base in bases:
+            if len(history) < len(base) and history == base[:len(history)]:
+                return [int(t) for t in base[len(history):len(history) + k]]
+        return []
+    return propose
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance matrix: greedy parity with the unpiped engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("stages,prefix,int8,superstep,spec", [
+    # S=2 crossed with every cache/dispatch/spec variant (the pipeline
+    # schedule must be invisible in the tokens whatever shares the tick)
+    (2, prefix, int8, superstep, spec)
+    for prefix in (0, 1) for int8 in (0, 1)
+    for superstep in ("1", "8") for spec in (0, 1)] + [
+    # S=1 representative corners: the knob parses but the pipeline is
+    # fully off, so the engine IS the unpiped engine (byte-identical
+    # trivially) — two corners pin the wiring without re-running the
+    # whole matrix on a no-op
+    (1, 0, 0, "8", 0), (1, 1, 1, "1", 1)])
+def test_pipe_greedy_parity_matrix(gpt_model, make_engine, pipe_env,
+                                   stages, prefix, int8, superstep, spec):
+    """Greedy outputs under PENROZ_SERVE_PIPE_STAGES are token-identical
+    to the standalone baseline across prefix-cache x int8 KV x superstep
+    x spec-decode (oracle drafts, so the verify path provably rides the
+    pipeline when armed)."""
+    from penroz_tpu.serve import spec_decode
+    if prefix:
+        pipe_env.setenv("PENROZ_PREFIX_CACHE", "1")
+        pipe_env.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    if int8:
+        pipe_env.setenv("TURBO_QUANT_KV_CACHE", "1")
+    pipe_env.setenv("PENROZ_SCHED_SUPERSTEP", superstep)
+    pipe_env.setenv("PENROZ_SERVE_PIPE_STAGES", str(stages))
+    pa, pb = REP_PROMPT, [5, 6, 5, 6]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 6, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 5, temperature=0.0)
+    if spec:
+        pipe_env.setenv("PENROZ_SPEC_DECODE", "1")
+        pipe_env.setattr(spec_decode, "propose",
+                         _oracle_drafter([base_a, base_b]))
+    engine = make_engine("pipegpt", BLOCK, 0.0, None, capacity=2)
+    ca = _submit(engine, pa, 6)
+    cb = _submit(engine, pb, 5)
+    assert ca.result() == base_a
+    assert cb.result() == base_b
+    stats = engine.stats()
+    assert stats["pipe_stages"] == stages
+    if stages > 1:
+        assert stats["pipe_ticks"] > 0
+        assert stats["pipe_microblocks"] >= stages
+        assert set(stats["pipe_stage_busy"]) == {"0", "1"}
+        assert stats["pipe_handoffs"] > 0
+        assert stats["pipe_handoff_host_fallbacks"] == 0
+        assert 0.0 <= stats["pipe_bubble_fraction"] <= 1.0
+    else:
+        assert stats["pipe_ticks"] == 0
+        assert stats["pipe_bubble_fraction"] is None
+    if spec:
+        assert stats["spec_verify_steps"] > 0
+        assert stats["spec_accept_rate"] == 1.0      # oracle drafts
+
+
+def test_pipe_memledger_stage_pools(gpt_model, make_engine, pipe_env):
+    """Per-stage HBM attribution: the memory snapshot carries one entry
+    per stage whose kv_pool_bytes sum to the pooled kv components and
+    whose per-stage page counts each equal the (shared-table) pool total
+    — re-proved under the suite-wide strict audit every tick."""
+    pipe_env.setenv("PENROZ_SERVE_PIPE_STAGES", "2")
+    engine = make_engine("pipegpt", BLOCK, 0.0, None, capacity=2)
+    assert len(_submit(engine, REP_PROMPT, 4).result()) \
+        == len(REP_PROMPT) + 4
+    mem = engine.stats()["memory"]
+    pools = mem["stage_pools"]
+    assert [p["stage"] for p in pools] == [0, 1]
+    assert all(p["kv_layers"] == 1 for p in pools)   # 2 layers, 2 stages
+    assert all(p["pool_pages"] == mem["pool_pages_total"] for p in pools)
+    assert sum(p["kv_pool_bytes"] for p in pools) \
+        == mem["hbm_bytes"]["kv_values"] + mem["hbm_bytes"]["kv_scales"]
+
+
+def test_pipe_unpiped_engine_reports_empty_stage_pools(
+        gpt_model, make_engine, pipe_env):
+    engine = make_engine("pipegpt", BLOCK, 0.0, None, capacity=2)
+    _submit(engine, [1, 2, 3], 3).result()
+    assert engine.stats()["memory"]["stage_pools"] == []
+
+
+def test_pipe_mid_flight_admission(gpt_model, make_engine, pipe_env):
+    """A row admitted while another is mid-flight through the stage
+    schedule: the newcomer's prefill joins a later micro-block and both
+    streams stay standalone-identical."""
+    pipe_env.setenv("PENROZ_SERVE_PIPE_STAGES", "2")
+    pa, pb = REP_PROMPT, [5, 6, 5, 6]
+    base_a = gpt_model.generate_tokens([pa], BLOCK, 8, temperature=0.0)
+    base_b = gpt_model.generate_tokens([pb], BLOCK, 5, temperature=0.0)
+    engine = make_engine("pipegpt", BLOCK, 0.0, None, capacity=2)
+    ca = _submit(engine, pa, 8)
+    _wait_tokens(ca, 2)            # A provably mid-generation
+    cb = _submit(engine, pb, 5)
+    assert cb.result() == base_b
+    assert ca.result() == base_a
+    stats = engine.stats()
+    assert stats["completed"] == 2
+    assert stats["pipe_ticks"] > 0
+
+
+def test_pipe_drain_finishes_inflight_blocks(gpt_model, make_engine,
+                                             pipe_env):
+    """shutdown(drain_s=...) on a piped engine lets the in-flight
+    micro-blocks finish their inter-stage journey: every pending token
+    arrives (greedy-identical) and no block is abandoned mid-hand-off."""
+    from penroz_tpu.utils import faults
+    pipe_env.setenv("PENROZ_SERVE_PIPE_STAGES", "2")
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                     temperature=0.0)
+    pipe_env.setenv(faults.ENV, "decode.step:sleep@40")  # slow ticks
+    engine = make_engine("pipegpt", BLOCK, 0.0, None, capacity=2)
+    c = _submit(engine, REP_PROMPT, 6)
+    _wait_tokens(c, 1)             # provably in-flight
+    assert engine.shutdown(timeout=30.0, drain_s=30.0) is True
+    assert c.result(timeout=5) == base   # drained, not killed
+    assert engine.stats()["pipe_ticks"] > 0
+
+
+def test_pipe_handoff_fault_host_restage_parity(gpt_model, make_engine,
+                                                pipe_env):
+    """An injected pipe.handoff fault mid-transfer is CONTAINED: the
+    activation re-stages through the host, the fallback counter ticks,
+    nothing crashes, and the stream is greedy token-identical."""
+    from penroz_tpu.utils import faults
+    pipe_env.setenv("PENROZ_SERVE_PIPE_STAGES", "2")
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                     temperature=0.0)
+    pipe_env.setenv(faults.ENV, "pipe.handoff:raise@2")
+    engine = make_engine("pipegpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["pipe_handoff_host_fallbacks"] == 1
+    assert stats["pipe_handoffs"] > 1
+    assert stats["crashes_total"] == 0
+
+
+def test_pipe_stage_crash_recovers_whole_group(gpt_model, make_engine,
+                                               pipe_env):
+    """An injected pipe.stage_crash propagates like any stage failure:
+    waiting requests fail typed, the crash handler reallocates the WHOLE
+    group (stage pools rebuilt through _alloc_state, strict audit clean),
+    and the next request is greedy token-identical."""
+    from penroz_tpu.utils import faults
+    pipe_env.setenv("PENROZ_SERVE_PIPE_STAGES", "2")
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                     temperature=0.0)
+    pipe_env.setenv(faults.ENV, "pipe.stage_crash:raise@1")
+    engine = make_engine("pipegpt", BLOCK, 0.0, None, capacity=2)
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, REP_PROMPT, 6).result()
+    pipe_env.delenv(faults.ENV)
+    faults.reset()
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["crashes_total"] == 1
+    assert stats["engine_resets"] == 1
+    assert stats["breaker_open"] is False
+    assert stats["pipe_stages"] == 2
+    assert stats["pipe_ticks"] > 0           # post-recovery schedule ran
+    pools = stats["memory"]["stage_pools"]
+    assert [p["stage"] for p in pools] == [0, 1]   # group came back piped
+    assert engine.active_rows == 0
+
+
+def test_pipe_stages_without_paged_kv_warns_and_disables(
+        gpt_model, make_engine, monkeypatch):
+    """PENROZ_SERVE_PIPE_STAGES without its paged+ragged prerequisites is
+    ignored with a warning — the engine serves unpiped, not wrong."""
+    monkeypatch.setenv("PENROZ_SERVE_PIPE_STAGES", "2")
+    base = gpt_model.generate_tokens([REP_PROMPT], BLOCK, 4,
+                                     temperature=0.0)
+    engine = make_engine("pipegpt", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, REP_PROMPT, 4).result() == base
+    stats = engine.stats()
+    assert stats["pipe_stages"] == 1
+    assert stats["pipe_ticks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# non-greedy speculative decoding (the PR 4 greedy-only gate, lifted)
+# ---------------------------------------------------------------------------
+
+def test_spec_temp_parity_spec_on_vs_off(gpt_model, make_engine, pipe_env):
+    """THE sampling-rule pin: at temperature > 0 on the unified engine,
+    spec-on and spec-off emit byte-identical streams (fixed engine seed).
+    Positional sampling keys make the target token at (row, position)
+    one deterministic draw however the slot is dispatched, and for
+    point-mass prompt-lookup drafts the longest-matching-prefix
+    acceptance IS exact rejection sampling — so speculation changes
+    latency, never tokens."""
+    from penroz_tpu.serve import spec_decode
+    engine_off = make_engine("pipegpt", BLOCK, 0.8, 4, capacity=2)
+    base = _submit(engine_off, REP_PROMPT, 8).result()
+    engine_off.shutdown()
+    pipe_env.setenv("PENROZ_SPEC_DECODE", "1")
+    pipe_env.setenv("PENROZ_SPEC_NGRAM", "1")
+    engine_on = make_engine("pipegpt", BLOCK, 0.8, 4, capacity=2)
+    assert _submit(engine_on, REP_PROMPT, 8).result() == base
+    stats = engine_on.stats()
+    assert stats["spec_decode"] is True
+    assert stats["spec_drafted_tokens"] > 0      # drafting really engaged
+    assert 0.0 <= stats["spec_accept_rate"] <= 1.0
+
+
+def test_spec_temp_oracle_drafts_full_accept(gpt_model, make_engine,
+                                             pipe_env):
+    """Drafting the sampled continuation itself (oracle over a spec-off
+    probe run) must fully accept — p(draft) = 1 under the positional
+    keys — while staying byte-identical; accept rate 1.0 proves the
+    non-greedy acceptance comparison runs against the sampled tokens."""
+    from penroz_tpu.serve import spec_decode
+    probe = make_engine("pipegpt", BLOCK, 0.8, 4, capacity=2)
+    base = _submit(probe, REP_PROMPT, 6).result()
+    probe.shutdown()
+    pipe_env.setenv("PENROZ_SPEC_DECODE", "1")
+    pipe_env.setattr(spec_decode, "propose", _oracle_drafter([base]))
+    engine = make_engine("pipegpt", BLOCK, 0.8, 4, capacity=2)
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["spec_verify_steps"] > 0
+    assert stats["spec_accept_rate"] == 1.0
+
+
+def test_spec_temp_parity_through_pipeline(gpt_model, make_engine,
+                                           pipe_env):
+    """Sampling parity composes with the pipeline: temp>0 + spec drafts +
+    2 stages still reproduces the unpiped spec-off stream byte-for-byte
+    (the positional keys are packing-, superstep- AND stage-invariant)."""
+    from penroz_tpu.serve import spec_decode
+    probe = make_engine("pipegpt", BLOCK, 0.8, 4, capacity=2)
+    base = _submit(probe, REP_PROMPT, 6).result()
+    probe.shutdown()
+    pipe_env.setenv("PENROZ_SPEC_DECODE", "1")
+    pipe_env.setattr(spec_decode, "propose", _oracle_drafter([base]))
+    pipe_env.setenv("PENROZ_SERVE_PIPE_STAGES", "2")
+    engine = make_engine("pipegpt", BLOCK, 0.8, 4, capacity=2)
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["pipe_ticks"] > 0
+    assert stats["spec_verify_steps"] > 0

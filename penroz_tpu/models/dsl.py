@@ -57,6 +57,7 @@ _LEAF_ALGOS = {
     "softmaxlast": M.SoftmaxOnLast,
     "dropout": M.Dropout,
     "attention": M.CausalSelfAttention,
+    "ssm": M.GatedSSM,
     "gatedmlp": M.GatedMLP,
     "moe": M.MixtureOfExperts,
     "clamp": M.Clamp,

@@ -287,6 +287,7 @@ class CompiledArch:
                 for name in sub.param_shapes():
                     self.param_order.append(sub.key(name))
         self.attn_layers: list[M.CausalSelfAttention] = []
+        self.ssm_layers: list[M.GatedSSM] = []
         self._index_attention()
         self._jit_cache: dict = {}
 
@@ -295,12 +296,17 @@ class CompiledArch:
     def _index_attention(self):
         """Assign KV-cache slots and infer head dims from the preceding fused
         QKV projection (reference derives head dim the same way:
-        neural_net_layers.py:61-75)."""
+        neural_net_layers.py:61-75).  ``ssm`` blocks get their own slot
+        sequence — their state lives in the recurrent child of the KV
+        pytree, indexed independently of the attention pools."""
 
         def visit(mod):
             if isinstance(mod, M.CausalSelfAttention):
                 mod.layer_idx = len(self.attn_layers)
                 self.attn_layers.append(mod)
+            if isinstance(mod, M.GatedSSM):
+                mod.layer_idx = len(self.ssm_layers)
+                self.ssm_layers.append(mod)
             if isinstance(mod, M.Sequential):
                 prev = None
                 for child in mod.layers:
@@ -329,6 +335,13 @@ class CompiledArch:
                                  "or pass head_dim explicitly")
             specs.append((mod.num_kv_heads, mod.head_dim))
         return specs
+
+    @property
+    def ssm_specs(self) -> list[tuple[int, int, int]]:
+        """Per-``ssm``-layer (num_heads, head_dim, value_dim) for the
+        fixed-size recurrent state (ops/ssm.py::SSMState.create)."""
+        return [(mod.num_heads, mod.head_dim, mod.value_dim)
+                for mod in self.ssm_layers]
 
     def jit_program_counts(self) -> dict[str, int]:
         """Live jitted-program count per function family — cache keys are
@@ -976,6 +989,11 @@ class ServePipeline:
 
     def __init__(self, arch: "CompiledArch", stages: int):
         from penroz_tpu.parallel import pipeline
+        if arch.ssm_specs:
+            raise ValueError(
+                "pipeline serving does not support SSM/recurrent blocks: "
+                "stage_kv_view slices attention pools only and would drop "
+                "the per-row recurrent state")
         self.stages = int(stages)
         self.bounds = pipeline.serve_stage_bounds(arch.layers_dsl,
                                                   self.stages)
@@ -2445,7 +2463,8 @@ class NeuralNetworkModel:
         # contiguous decode kernel streams K/V tiles through its grid, so
         # long contexts need no auto-paging heuristic.
         kv = KV.create_kv_state(self.arch.kv_specs, 1, block_size,
-                                self._kv_dtype())
+                                self._kv_dtype(),
+                                ssm_specs=self.arch.ssm_specs)
         kv = self._enter_decode_mesh(kv)
         cache_len = 0
         produced = 0    # tokens yielded to the caller
@@ -2591,7 +2610,8 @@ class NeuralNetworkModel:
         # pools do ragged batches too (per-sequence lengths thread through
         # the allocator, appends, and the ragged kernels).
         kv = KV.create_kv_state(arch.kv_specs, B, block_size,
-                                self._kv_dtype())
+                                self._kv_dtype(),
+                                ssm_specs=arch.ssm_specs)
         kv = self._enter_decode_mesh(kv, batch=B)
         lengths = jnp.asarray(lens, jnp.int32)
         done = [False] * B
@@ -2663,7 +2683,8 @@ class NeuralNetworkModel:
         greedy, temp = self._norm_temperature(temperature)
         decode = self.arch.decode_fn()
         kv = KV.create_kv_state(self.arch.kv_specs, 1, block_size,
-                                self._kv_dtype())
+                                self._kv_dtype(),
+                                ssm_specs=self.arch.ssm_specs)
         feed = prompt[-block_size:]
         x = jnp.asarray(np.asarray(feed, np.int64)[None, :], jnp.int32)
         tok_arr, kv = decode(self.params, self.buffers, kv, x, rng, temp,

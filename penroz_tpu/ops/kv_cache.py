@@ -15,6 +15,11 @@ Two layers of abstraction:
   "TurboQuant" variant stores values with per-token scales and dequantizes on
   read (kv_cache.py:101-195); the same env flag ``TURBO_QUANT_KV_CACHE=1``
   selects it.
+
+Every cache variant here — and the fixed-size recurrent backend in
+ops/ssm.py — implements the :class:`SequenceState` protocol: the per-row
+slot-management contract the continuous-batching scheduler drives
+(insert/reset/rollback/row_view/merge plus the export/import hand-off pair).
 """
 
 from __future__ import annotations
@@ -24,6 +29,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
+from typing import Protocol, runtime_checkable
 
 import jax
 import jax.numpy as jnp
@@ -199,6 +205,42 @@ def array_device_bytes(a) -> int:
     return size * np.dtype(a.dtype).itemsize
 
 
+@runtime_checkable
+class SequenceState(Protocol):
+    """Per-row sequence-state contract of the continuous-batching scheduler.
+
+    What was an implicit convention duplicated across the four KV variants
+    is the explicit interface any backend must implement to ride the
+    unified scheduler — the O(T) paged/contiguous KV caches here and the
+    O(1) recurrent state in ops/ssm.py both conform:
+
+    - ``insert_row(row, src)``     — admit a prefilled batch-1 state
+    - ``reset_row(row)``           — recycle a slot for the next sequence
+    - ``rollback_row(row, L)``     — exact rewind (spec-decode rejection)
+    - ``row_view(row, length)``    — batch-1 view for chunked prefill/verify
+    - ``merge_row(row, view)``     — fold an advanced view back in
+    - ``export_row_pages(row, length, device=False)`` /
+      ``import_row_pages(row, blob)`` — the disagg hand-off pair (O(T)
+      page moves for KV, a constant-size blob for recurrent state)
+    - ``reset()`` and ``hbm_components()`` — lifecycle + byte attribution
+
+    All implementations are registered pytrees whose row ops may take
+    traced scalars, so one compiled program serves every slot.
+    """
+
+    def insert_row(self, row, src): ...
+
+    def reset_row(self, row): ...
+
+    def rollback_row(self, row, new_length): ...
+
+    def row_view(self, row, length): ...
+
+    def merge_row(self, row, view): ...
+
+    def reset(self): ...
+
+
 @jax.tree_util.register_pytree_node_class
 class KVState:
     """Preallocated functional KV buffers: per-layer (B, Hkv, S_max, D).
@@ -229,11 +271,17 @@ class KVState:
 
     quantized = False
 
-    def __init__(self, k, v, length, ragged_lengths=None):
+    def __init__(self, k, v, length, ragged_lengths=None, ssm=None):
         self.k = list(k)
         self.v = list(v)
         self._length = length
         self.ragged_lengths = ragged_lengths
+        # Optional fixed-size recurrent child (ops/ssm.py::SSMState) for
+        # hybrid attention+SSM models; ``None`` (pure-attention) is a
+        # zero-leaf pytree, so attention-only models see no new leaves,
+        # donation aliasing is unchanged and the row ops below stay
+        # no-ops for it.
+        self.ssm = ssm
 
     @property
     def length(self):
@@ -243,12 +291,12 @@ class KVState:
 
     def tree_flatten(self):
         return (tuple(self.k), tuple(self.v), self._length,
-                self.ragged_lengths), len(self.k)
+                self.ragged_lengths, self.ssm), len(self.k)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, length, ragged = children
-        return cls(list(k), list(v), length, ragged_lengths=ragged)
+        k, v, length, ragged, ssm = children
+        return cls(list(k), list(v), length, ragged_lengths=ragged, ssm=ssm)
 
     @classmethod
     def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32):
@@ -308,7 +356,10 @@ class KVState:
         return self._with_length(self.length + num_tokens)
 
     def reset(self):
-        return self._with_length(jnp.zeros((), jnp.int32))
+        out = self._with_length(jnp.zeros((), jnp.int32))
+        if self.ssm is not None:
+            out.ssm = self.ssm.reset()
+        return out
 
     def with_lengths(self, lengths):
         """State with RAGGED per-sequence (B,) valid lengths — installed
@@ -322,8 +373,9 @@ class KVState:
         if jnp.ndim(length) >= 1:
             return KVState(list(self.k), list(self.v),
                            jnp.full_like(self._length, -1),
-                           ragged_lengths=jnp.asarray(length, jnp.int32))
-        return KVState(list(self.k), list(self.v), length)
+                           ragged_lengths=jnp.asarray(length, jnp.int32),
+                           ssm=self.ssm)
+        return KVState(list(self.k), list(self.v), length, ssm=self.ssm)
 
     # -- per-row slot management (continuous-batching scheduler) ------------
 
@@ -367,17 +419,23 @@ class KVState:
         out.v = [jax.lax.dynamic_update_slice(d, s.astype(d.dtype),
                                               (row, 0, 0, 0))
                  for d, s in zip(self.v, src.v)]
+        if self.ssm is not None:
+            out.ssm = self.ssm.insert_row(row, src.ssm)
         return out
 
     def reset_row(self, row):
         """Zero row ``row``'s valid length, recycling the slot for the next
         sequence (ragged states only).  The stale K/V rows stay in place as
-        dead weight the per-row masks never attend."""
+        dead weight the per-row masks never attend; a recurrent child has
+        no masking to hide behind, so its row is zeroed for real."""
         if self.ragged_lengths is None:
             raise ValueError("reset_row requires ragged per-row lengths "
                              "(call with_lengths first)")
-        return self._with_length(
+        out = self._with_length(
             self.ragged_lengths.at[jnp.asarray(row, jnp.int32)].set(0))
+        if self.ssm is not None:
+            out.ssm = self.ssm.reset_row(jnp.asarray(row, jnp.int32))
+        return out
 
     def rollback_row(self, row, new_length):
         """Rewind row ``row``'s valid length to ``new_length`` — the
@@ -399,9 +457,15 @@ class KVState:
         if self.ragged_lengths is None:
             raise ValueError("rollback_row requires ragged per-row lengths "
                              "(call with_lengths first)")
-        return self._with_length(
+        out = self._with_length(
             self.ragged_lengths.at[jnp.asarray(row, jnp.int32)].set(
                 jnp.asarray(new_length, jnp.int32)))
+        if self.ssm is not None:
+            # recurrent state cannot be length-masked — restore the exact
+            # checkpointed state for the target length (ops/ssm.py ring)
+            out.ssm = self.ssm.rollback_row(jnp.asarray(row, jnp.int32),
+                                            new_length)
+        return out
 
     def row_view(self, row, length):
         """Batch-1 view of row ``row`` with scalar valid ``length`` — the
@@ -414,7 +478,9 @@ class KVState:
         slc = lambda a: jax.lax.dynamic_slice(
             a, (row,) + (0,) * (a.ndim - 1), (1,) + a.shape[1:])
         return KVState([slc(a) for a in self.k], [slc(a) for a in self.v],
-                       jnp.asarray(length, jnp.int32))
+                       jnp.asarray(length, jnp.int32),
+                       ssm=(self.ssm.row_view(row)
+                            if self.ssm is not None else None))
 
     def merge_row(self, row, view):
         """Multi-row state with row ``row``'s buffers replaced by ``view``'s
@@ -427,6 +493,8 @@ class KVState:
         out = self._with_length(self.length)
         out.k = [upd(d, s) for d, s in zip(self.k, view.k)]
         out.v = [upd(d, s) for d, s in zip(self.v, view.v)]
+        if self.ssm is not None:
+            out.ssm = self.ssm.merge_row(row, view.ssm)
         return out
 
     def with_static_table(self):
@@ -443,13 +511,18 @@ class KVState:
         """Bytes an unquantized fp cache of the same shape would occupy."""
         return self.memory_bytes()
 
+    def _ssm_bytes(self) -> int:
+        return self.ssm.nbytes() if self.ssm is not None else 0
+
     def hbm_components(self) -> dict:
         """Byte attribution for the capacity ledger (serve/memledger.py):
-        KV values vs quantization scales vs block-table/counter metadata.
-        Components sum to everything this cache holds resident."""
+        KV values vs quantization scales vs block-table/counter metadata
+        vs recurrent state.  Components sum to everything this cache holds
+        resident."""
         return {"kv_values": self.memory_bytes(),
                 "kv_scales": 0,
-                "kv_block_table": 0}
+                "kv_block_table": 0,
+                "ssm_state": self._ssm_bytes()}
 
 
 @jax.tree_util.register_pytree_node_class
@@ -459,8 +532,9 @@ class QuantKVState(KVState):
     quantized = True
 
     def __init__(self, k, v, length, k_scale, v_scale, out_dtype=jnp.float32,
-                 ragged_lengths=None):
-        super().__init__(k, v, length, ragged_lengths=ragged_lengths)
+                 ragged_lengths=None, ssm=None):
+        super().__init__(k, v, length, ragged_lengths=ragged_lengths,
+                         ssm=ssm)
         self.k_scale = list(k_scale)
         self.v_scale = list(v_scale)
         self.out_dtype = out_dtype
@@ -468,14 +542,14 @@ class QuantKVState(KVState):
     def tree_flatten(self):
         children = (tuple(self.k), tuple(self.v), self._length,
                     tuple(self.k_scale), tuple(self.v_scale),
-                    self.ragged_lengths)
+                    self.ragged_lengths, self.ssm)
         return children, (len(self.k), self.out_dtype)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, length, k_scale, v_scale, ragged = children
+        k, v, length, k_scale, v_scale, ragged, ssm = children
         return cls(list(k), list(v), length, list(k_scale), list(v_scale),
-                   out_dtype=aux[1], ragged_lengths=ragged)
+                   out_dtype=aux[1], ragged_lengths=ragged, ssm=ssm)
 
     @classmethod
     def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32):
@@ -530,10 +604,11 @@ class QuantKVState(KVState):
                                 jnp.full_like(self._length, -1),
                                 list(self.k_scale), list(self.v_scale),
                                 out_dtype=self.out_dtype,
-                                ragged_lengths=jnp.asarray(length, jnp.int32))
+                                ragged_lengths=jnp.asarray(length, jnp.int32),
+                                ssm=self.ssm)
         return QuantKVState(list(self.k), list(self.v), length,
                             list(self.k_scale), list(self.v_scale),
-                            out_dtype=self.out_dtype)
+                            out_dtype=self.out_dtype, ssm=self.ssm)
 
     def insert_row(self, row, src):
         out = super().insert_row(row, src)
@@ -553,7 +628,9 @@ class QuantKVState(KVState):
                             jnp.asarray(length, jnp.int32),
                             [slc(a) for a in self.k_scale],
                             [slc(a) for a in self.v_scale],
-                            out_dtype=self.out_dtype)
+                            out_dtype=self.out_dtype,
+                            ssm=(self.ssm.row_view(row)
+                                 if self.ssm is not None else None))
 
     def merge_row(self, row, view):
         out = super().merge_row(row, view)
@@ -572,7 +649,8 @@ class QuantKVState(KVState):
         return {"kv_values": self.memory_bytes(),
                 "kv_scales": sum(array_device_bytes(a)
                                  for a in (*self.k_scale, *self.v_scale)),
-                "kv_block_table": 0}
+                "kv_block_table": 0,
+                "ssm_state": self._ssm_bytes()}
 
 
 def build_descriptors(spans, block_q: int, num_blocks: int):
@@ -658,7 +736,8 @@ class PagedKVState(KVState):
     # ``counters[0]``.
 
     def __init__(self, k, v, counters, block_table,
-                 page_size: int, pages_per_seq: int, ragged_lengths=None):
+                 page_size: int, pages_per_seq: int, ragged_lengths=None,
+                 ssm=None):
         self.k = list(k)
         self.v = list(v)
         self.counters = counters
@@ -666,6 +745,7 @@ class PagedKVState(KVState):
         self.page_size = int(page_size)
         self.pages_per_seq = int(pages_per_seq)
         self.ragged_lengths = ragged_lengths
+        self.ssm = ssm  # optional recurrent child (see KVState.__init__)
 
     @property
     def length(self):
@@ -684,15 +764,15 @@ class PagedKVState(KVState):
 
     def tree_flatten(self):
         children = (tuple(self.k), tuple(self.v), self.counters,
-                    self.block_table, self.ragged_lengths)
+                    self.block_table, self.ragged_lengths, self.ssm)
         return children, (self.page_size, self.pages_per_seq)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, counters, block_table, ragged = children
+        k, v, counters, block_table, ragged, ssm = children
         return cls(list(k), list(v), counters, block_table,
                    page_size=aux[0], pages_per_seq=aux[1],
-                   ragged_lengths=ragged)
+                   ragged_lengths=ragged, ssm=ssm)
 
     @classmethod
     def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32,
@@ -717,7 +797,12 @@ class PagedKVState(KVState):
 
     @property
     def num_pool_pages(self) -> int:
-        return self.k[0].shape[1] // self.page_size if self.k else 0
+        if self.k:
+            return self.k[0].shape[1] // self.page_size
+        # Pure-SSM shell: no attention layers, so no pools — the logical
+        # zero-byte static partition (one "page" slot per table entry)
+        # keeps with_static_table and the memledger partition audit sound.
+        return int(self.block_table.size)
 
     def _allocate(self, new_length):
         """Bump-allocate physical pages covering ``[0, new_length)``.
@@ -897,17 +982,21 @@ class PagedKVState(KVState):
                                 self.block_table, self.page_size,
                                 self.pages_per_seq,
                                 ragged_lengths=jnp.asarray(length,
-                                                           jnp.int32))
+                                                           jnp.int32),
+                                ssm=self.ssm)
         counters = self.counters.at[0].set(length)
         return PagedKVState(list(self.k), list(self.v), counters,
                             self.block_table,
-                            self.page_size, self.pages_per_seq)
+                            self.page_size, self.pages_per_seq,
+                            ssm=self.ssm)
 
     def reset(self):
         table = jnp.full_like(self.block_table, -1)
         return PagedKVState(list(self.k), list(self.v),
                             jnp.zeros((3,), jnp.int32), table,
-                            self.page_size, self.pages_per_seq)
+                            self.page_size, self.pages_per_seq,
+                            ssm=(self.ssm.reset()
+                                 if self.ssm is not None else None))
 
     # -- per-row slot management (continuous-batching scheduler) ------------
 
@@ -969,6 +1058,8 @@ class PagedKVState(KVState):
         out.v = [jax.lax.dynamic_update_slice(
                      d, s[:, :span].astype(d.dtype), (0, start, 0))
                  for d, s in zip(base.v, src.v)]
+        if self.ssm is not None:
+            out.ssm = self.ssm.insert_row(row, src.ssm)
         return out
 
     def row_view(self, row, length):
@@ -990,15 +1081,21 @@ class PagedKVState(KVState):
                               self.counters[1],
                               jnp.asarray(self.pages_per_seq, jnp.int32)])
         return PagedKVState(list(self.k), list(self.v), counters, table,
-                            self.page_size, self.pages_per_seq)
+                            self.page_size, self.pages_per_seq,
+                            ssm=(self.ssm.row_view(row)
+                                 if self.ssm is not None else None))
 
     def merge_row(self, row, view):
         """Adopt the view's (already scattered-into) pools; table, counters
         and per-row lengths are untouched — the scheduler's host array
-        stays authoritative."""
+        stays authoritative.  A recurrent child has no shared pool, so its
+        batch-1 state is written back into the row explicitly."""
         out = self._with_length(self.length)
         out.k = list(view.k)
         out.v = list(view.v)
+        if self.ssm is not None:
+            out.ssm = self.ssm.merge_row(jnp.asarray(row, jnp.int32),
+                                         view.ssm)
         return out
 
     def with_row_prefix(self, row, prefix_pages):
@@ -1070,10 +1167,15 @@ class PagedKVState(KVState):
         pool_rows = self._export_pool_rows(row, n)
         gather = ((lambda a: a[:, pool_rows]) if device
                   else (lambda a: np.asarray(a[:, pool_rows])))
-        return {"page_size": P, "pages": n, "length": int(length),
+        blob = {"page_size": P, "pages": n, "length": int(length),
                 "quantized": bool(getattr(self, "quantized", False)),
                 "k": [gather(a) for a in self.k],
                 "v": [gather(a) for a in self.v]}
+        if self.ssm is not None:
+            # constant-size recurrent state rides the same blob — for a
+            # pure-SSM row this is the ENTIRE hand-off payload
+            blob["ssm"] = self.ssm.export_row(int(row), device=device)
+        return blob
 
     @staticmethod
     def _import_operand(s, a):
@@ -1114,6 +1216,8 @@ class PagedKVState(KVState):
         out.v = [jax.lax.dynamic_update_slice(
                      a, self._import_operand(s, a), (zero, start, zero))
                  for a, s in zip(out.v, blob["v"])]
+        if self.ssm is not None and blob.get("ssm") is not None:
+            out.ssm = self.ssm.import_row(int(row), blob["ssm"])
         return out
 
     def _page_pool_rows(self, pages):
@@ -1197,7 +1301,8 @@ class PagedKVState(KVState):
     def hbm_components(self) -> dict:
         return {"kv_values": self.memory_bytes(),
                 "kv_scales": 0,
-                "kv_block_table": self._table_bytes()}
+                "kv_block_table": self._table_bytes(),
+                "ssm_state": self._ssm_bytes()}
 
 
 @jax.tree_util.register_pytree_node_class
@@ -1215,9 +1320,10 @@ class QuantPagedKVState(PagedKVState):
 
     def __init__(self, k, v, counters, block_table, page_size: int,
                  pages_per_seq: int, k_scale, v_scale,
-                 out_dtype=jnp.float32, ragged_lengths=None):
+                 out_dtype=jnp.float32, ragged_lengths=None, ssm=None):
         super().__init__(k, v, counters, block_table, page_size,
-                         pages_per_seq, ragged_lengths=ragged_lengths)
+                         pages_per_seq, ragged_lengths=ragged_lengths,
+                         ssm=ssm)
         self.k_scale = list(k_scale)
         self.v_scale = list(v_scale)
         self.out_dtype = out_dtype
@@ -1225,16 +1331,16 @@ class QuantPagedKVState(PagedKVState):
     def tree_flatten(self):
         children = (tuple(self.k), tuple(self.v), self.counters,
                     self.block_table, tuple(self.k_scale),
-                    tuple(self.v_scale), self.ragged_lengths)
+                    tuple(self.v_scale), self.ragged_lengths, self.ssm)
         return children, (self.page_size, self.pages_per_seq, self.out_dtype)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        k, v, counters, block_table, k_scale, v_scale, ragged = children
+        k, v, counters, block_table, k_scale, v_scale, ragged, ssm = children
         return cls(list(k), list(v), counters, block_table,
                    page_size=aux[0], pages_per_seq=aux[1],
                    k_scale=list(k_scale), v_scale=list(v_scale),
-                   out_dtype=aux[2], ragged_lengths=ragged)
+                   out_dtype=aux[2], ragged_lengths=ragged, ssm=ssm)
 
     @classmethod
     def create(cls, specs, batch: int, max_len: int, dtype=jnp.float32,
@@ -1299,13 +1405,13 @@ class QuantPagedKVState(PagedKVState):
                 list(self.k), list(self.v), counters, self.block_table,
                 self.page_size, self.pages_per_seq, list(self.k_scale),
                 list(self.v_scale), out_dtype=self.out_dtype,
-                ragged_lengths=jnp.asarray(length, jnp.int32))
+                ragged_lengths=jnp.asarray(length, jnp.int32), ssm=self.ssm)
         counters = self.counters.at[0].set(length)
         return QuantPagedKVState(list(self.k), list(self.v), counters,
                                  self.block_table, self.page_size,
                                  self.pages_per_seq, list(self.k_scale),
                                  list(self.v_scale),
-                                 out_dtype=self.out_dtype)
+                                 out_dtype=self.out_dtype, ssm=self.ssm)
 
     def reset(self):
         table = jnp.full_like(self.block_table, -1)
@@ -1313,7 +1419,9 @@ class QuantPagedKVState(PagedKVState):
                                  jnp.zeros((3,), jnp.int32), table,
                                  self.page_size, self.pages_per_seq,
                                  list(self.k_scale), list(self.v_scale),
-                                 out_dtype=self.out_dtype)
+                                 out_dtype=self.out_dtype,
+                                 ssm=(self.ssm.reset()
+                                      if self.ssm is not None else None))
 
     def insert_row(self, row, src):
         out = super().insert_row(row, src)
@@ -1334,7 +1442,7 @@ class QuantPagedKVState(PagedKVState):
                                  base.block_table, base.page_size,
                                  base.pages_per_seq, list(self.k_scale),
                                  list(self.v_scale),
-                                 out_dtype=self.out_dtype)
+                                 out_dtype=self.out_dtype, ssm=base.ssm)
 
     def merge_row(self, row, view):
         out = super().merge_row(row, view)
@@ -1424,7 +1532,8 @@ class QuantPagedKVState(PagedKVState):
                                  for a in (*self.k, *self.v)),
                 "kv_scales": sum(array_device_bytes(a)
                                  for a in (*self.k_scale, *self.v_scale)),
-                "kv_block_table": self._table_bytes()}
+                "kv_block_table": self._table_bytes(),
+                "ssm_state": self._ssm_bytes()}
 
 
 def stage_kv_view(kv: PagedKVState, lo: int, hi: int) -> PagedKVState:
@@ -1510,11 +1619,18 @@ def stage_pool_bytes(kv: PagedKVState, lo: int, hi: int) -> int:
 def create_kv_state(specs, batch: int, max_len: int, dtype=jnp.float32,
                     quantized: bool | None = None,
                     paged: bool | None = None,
-                    extra_pool_pages: int = 0) -> KVState:
+                    extra_pool_pages: int = 0,
+                    ssm_specs=None) -> KVState:
     """Factory honoring ``TURBO_QUANT_KV_CACHE=1`` and ``PAGED_KV_CACHE=1``
     (both together → the int8 paged pool).  ``extra_pool_pages`` grows the
     paged pool beyond the per-row partition — the reserved prefix-cache
-    region (ignored by contiguous layouts, which have no shared pool)."""
+    region (ignored by contiguous layouts, which have no shared pool).
+
+    ``ssm_specs`` — per-``ssm``-layer ``(num_heads, head_dim, value_dim)``
+    triples (models/model.py::CompiledArch.ssm_specs) — attaches a
+    fixed-size recurrent child (ops/ssm.py) that rides every variant's
+    pytree and row ops; pure-SSM models get an empty-pool paged/contiguous
+    shell whose only state bytes are the recurrent tensors."""
     if quantized is None:
         quantized = turbo_quant_enabled()
     if paged is None:
@@ -1526,17 +1642,22 @@ def create_kv_state(specs, batch: int, max_len: int, dtype=jnp.float32,
     if quantized and paged:
         log.info("Int8 paged KV cache enabled (%s=1 + %s=1, page_size=%d)",
                  TURBO_QUANT_ENV, PAGED_ENV, page)
-        return QuantPagedKVState.create(specs, batch, max_len, dtype,
-                                        pool_pages=pool_pages)
-    if quantized:
+        state = QuantPagedKVState.create(specs, batch, max_len, dtype,
+                                         pool_pages=pool_pages)
+    elif quantized:
         log.info("TurboQuant KV cache enabled (%s=1)", TURBO_QUANT_ENV)
-        return QuantKVState.create(specs, batch, max_len, dtype)
-    if paged:
+        state = QuantKVState.create(specs, batch, max_len, dtype)
+    elif paged:
         log.info("Paged KV cache enabled (%s=1, page_size=%d)", PAGED_ENV,
                  page)
-        return PagedKVState.create(specs, batch, max_len, dtype,
-                                   pool_pages=pool_pages)
-    return KVState.create(specs, batch, max_len, dtype)
+        state = PagedKVState.create(specs, batch, max_len, dtype,
+                                    pool_pages=pool_pages)
+    else:
+        state = KVState.create(specs, batch, max_len, dtype)
+    if ssm_specs:
+        from penroz_tpu.ops.ssm import SSMState
+        state.ssm = SSMState.create(ssm_specs, batch)
+    return state
 
 
 # ---------------------------------------------------------------------------

@@ -1308,3 +1308,64 @@ class CausalSelfAttention(Module):
                                             softcap=self.logit_softcap)
 
         return out.transpose(0, 2, 1, 3).reshape(B, T, q_dim)
+
+
+class GatedSSM(Module):
+    """Gated linear-attention / SSD token mixer with O(1) per-row state.
+
+    Consumes a fused projection laid out ``[q (H·dk) | k (H·dk) | v (H·dv)
+    | gate (H)]`` — the SSM analogue of attention's fused qkv Linear — and
+    runs the recurrence ``S_t = σ(gate_t)·S_{t-1} + k_t ⊗ v_t,
+    y_t = q_t·S_t`` (ops/ssm.py).  No positional encoding: the recurrence
+    itself is the position signal, so the layer needs no RoPE/offset.
+
+    Cached serving rides ``ctx.kv.ssm`` (the fixed-size
+    :class:`~penroz_tpu.ops.ssm.SSMState` child of any KV variant) through
+    the same dense / packed-ragged dispatch as attention; without a cache
+    the full-sequence chunked form runs (Pallas kernel on TPU, scan oracle
+    elsewhere).  ``layer_idx`` indexes the model's *ssm* layers, assigned
+    by the model builder like attention's (models/model.py).
+    """
+
+    def __init__(self, num_heads: int, head_dim: int,
+                 value_dim: int | None = None):
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.value_dim = int(value_dim) if value_dim is not None \
+            else int(head_dim)
+        self.layer_idx = 0  # assigned by the model builder
+
+    @property
+    def fused_dim(self) -> int:
+        """Input width the preceding fused Linear must produce."""
+        return self.num_heads * (2 * self.head_dim + self.value_dim + 1)
+
+    def apply(self, x, ctx):
+        from penroz_tpu.ops import ssm as ssm_ops
+        B, T, total = x.shape
+        H, dk, dv = self.num_heads, self.head_dim, self.value_dim
+        if total != self.fused_dim:
+            raise ValueError(f"ssm fused input width {total} != expected "
+                             f"{self.fused_dim} (H={H}, dk={dk}, dv={dv})")
+        q = x[..., :H * dk].reshape(B, T, H, dk) * (dk ** -0.5)
+        k = x[..., H * dk:2 * H * dk].reshape(B, T, H, dk)
+        v = x[..., 2 * H * dk:2 * H * dk + H * dv].reshape(B, T, H, dv)
+        # fp32 gate: σ saturates in bf16 after ~8 tokens of decay product
+        g = jax.nn.sigmoid(
+            x[..., 2 * H * dk + H * dv:].astype(jnp.float32)).reshape(B, T, H)
+
+        ssm = getattr(ctx.kv, "ssm", None) if ctx.kv is not None else None
+        if ssm is not None:
+            if ctx.ragged_descs is not None:
+                # packed slots per block = Tp // NB (build_descriptors
+                # emits NB equal blocks of block_q slots)
+                nb = ctx.ragged_descs.shape[0]
+                y = ssm.update_packed(self.layer_idx, q, k, v, g,
+                                      ctx.ragged_descs, T // nb)
+            else:
+                y = ssm.update_dense(self.layer_idx, q, k, v, g,
+                                     ctx.offset())
+        else:
+            y = ssm_ops.gla_full(q, k, v, g, platform=ctx.platform,
+                                 training=ctx.training)
+        return y.reshape(B, T, H * dv).astype(x.dtype)

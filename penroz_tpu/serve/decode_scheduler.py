@@ -602,9 +602,21 @@ class DecodeEngine:
                 except ValueError as e:
                     log.warning("%s=%d ignored: %s", PIPE_STAGES_ENV,
                                 stages, e)
+        # Constant-memory sequence rows (ops/ssm.py): archs with recurrent
+        # blocks carry a per-row SSMState alongside (or instead of) the KV
+        # pools.  Prefix-KV sharing is fundamentally incompatible — a radix
+        # match aliases token-extent pages, but the matching row's recurrent
+        # state cannot be reconstructed from them — so the cache (and with
+        # it preempt/hibernate/promote, which all ride it) gates off.
+        self._has_ssm = bool(self._model.arch.ssm_specs)
         self._extra_pages = 0
         if KV.prefix_cache_enabled():
-            if KV.paged_enabled():
+            if self._has_ssm:
+                log.warning(
+                    "%s=1 ignored: arch has %d SSM layer(s); recurrent row "
+                    "state cannot be rebuilt from shared prefix pages",
+                    KV.PREFIX_CACHE_ENV, len(self._model.arch.ssm_specs))
+            elif KV.paged_enabled():
                 self._extra_pages = KV.prefix_cache_pages()
             else:
                 log.warning(
@@ -755,7 +767,8 @@ class DecodeEngine:
         self._kv = (KV.create_kv_state(self._model.arch.kv_specs,
                                        self.capacity, self.block_size,
                                        self._model._kv_dtype(),
-                                       extra_pool_pages=self._extra_pages)
+                                       extra_pool_pages=self._extra_pages,
+                                       ssm_specs=self._model.arch.ssm_specs)
                     .with_static_table()
                     .with_lengths(np.zeros(self.capacity, np.int32)))
         # Serving mesh (PENROZ_SERVE_MESH=1): params/buffers shard over the
@@ -1116,6 +1129,10 @@ class DecodeEngine:
                 if r is not None
                 and int(self._row_adapter[i]) != self._max_live),
             "lora_adapter_tokens": dict(self._adapter_tokens),
+            "ssm_rows": active if self._has_ssm else 0,
+            "ssm_state_bytes": (int(self._kv.ssm.nbytes())
+                                if getattr(self._kv, "ssm", None) is not None
+                                else 0),
             "spec_decode": self._spec_on(),
             "spec_verify_steps": self._spec_verify_steps,
             "spec_drafted_tokens": self._spec_drafted_tokens,
@@ -1486,6 +1503,8 @@ class DecodeEngine:
             faults.check("decode.prefill_chunk")
         if has_verify:
             faults.check("decode.verify")
+        if self._has_ssm:
+            faults.check("ssm.scan")
         dispatch = self._dispatch
         self._dispatch += n
         t0 = time.monotonic()
@@ -2118,6 +2137,12 @@ class DecodeEngine:
                 sp = trace.span("resume", cached_tokens=state.prefilled,
                                 produced=state.produced)
                 trace.end(sp)
+        if getattr(self._kv, "ssm", None) is not None:
+            # A recycled row's recurrent state is stale garbage — the shared
+            # decode step advances every batch row, parked or not, so unlike
+            # KV rows (whose stale tail the masks never attend) SSM rows
+            # must be explicitly re-zeroed before the first prefill chunk.
+            self._kv.ssm = self._kv.ssm.reset_row(row)
         state.chunks = _chunk_plan(len(eff_prompt) - state.prefilled,
                                    _prefill_chunk())
         self._rows[row] = state
@@ -2421,6 +2446,10 @@ class DecodeEngine:
         blob_id = (f"{self.model_id}-{self.replica}-{id(req):x}"
                    f"-{self._dispatch}")
         try:
+            if self._has_ssm:
+                # ssm.handoff ordinal: mid-export crash with a recurrent
+                # state plane in the blob (chaos matrix).
+                faults.check("ssm.handoff")
             kv_len = int(state.prefilled)
             blob = self._kv.export_row_pages(row, kv_len)
             blob["first_token"] = int(first)
@@ -2483,6 +2512,8 @@ class DecodeEngine:
             # disagg.d2d exporter-side ordinal (one per d2d hand-off; the
             # importer-side check in _admit_handoff is the other).
             faults.check("disagg.d2d")
+            if self._has_ssm:
+                faults.check("ssm.handoff")
             kv_len = int(state.prefilled)
             blob = self._kv.export_row_pages(row, kv_len, device=True)
             blob["first_token"] = int(first)
@@ -2835,6 +2866,8 @@ class DecodeEngine:
         plus the dispatch ordinal instead of launching a fold dispatch
         per token (bit-identical key, so seeded non-greedy output is
         unchanged — tested)."""
+        if self._has_ssm:
+            faults.check("ssm.scan")
         dispatch = self._dispatch
         self._dispatch += 1
         t0 = time.monotonic()
@@ -3654,6 +3687,8 @@ def serving_stats() -> dict:
         "lora_active_adapters": sum(p["lora_active_adapters"] for p in per),
         "lora_rows": sum(p["lora_rows"] for p in per),
         "lora_adapter_tokens": adapter_tokens,
+        "ssm_rows": sum(p["ssm_rows"] for p in per),
+        "ssm_state_bytes": sum(p["ssm_state_bytes"] for p in per),
         "spec_decode_enabled": spec_decode.enabled(),
         "spec_drafted_tokens": spec_drafted,
         "spec_accepted_tokens": spec_accepted,

@@ -84,7 +84,7 @@ PAGE_STATES = ("free", "row", "prefix_pinned", "prefix_evictable",
 #: Fixed keys of the per-engine byte ledger (``hbm_bytes``); the
 #: aggregate adds ``adapter_host_cache`` (process-wide, host RAM).
 BYTE_COMPONENTS = ("kv_values", "kv_scales", "kv_block_table",
-                   "lora_pack", "params")
+                   "lora_pack", "params", "ssm_state")
 
 #: Sliding window for the token-burn-rate estimate (matches the
 #: decode_scheduler tokens/sec window).

@@ -301,7 +301,8 @@ HBM_BYTES = REGISTRY.register(m.Gauge(
     "penroz_hbm_bytes",
     "Serving memory bytes by component: kv_values/kv_scales/"
     "kv_block_table (device), lora_pack (device), params (device), "
-    "adapter_host_cache (host RAM)", labelnames=("component",)))
+    "ssm_state (device, constant per row), adapter_host_cache (host RAM)",
+    labelnames=("component",)))
 KV_TTE = REGISTRY.register(m.Gauge(
     "penroz_kv_time_to_exhaustion_s",
     "Most-pressed engine's free-pool runway at the current token burn "

@@ -522,6 +522,13 @@ class EngineStats(BaseModel):
     lora_adapter_tokens: dict[str, int] = Field(
         default_factory=dict, description="Tokens emitted per adapter id "
         "over the engine lifetime (multi-tenant accounting)")
+    ssm_rows: int = Field(0, description="In-flight rows carrying O(1) "
+                          "recurrent (SSM) state — nonzero only when the "
+                          "served arch has ssm blocks")
+    ssm_state_bytes: int = Field(0, description="HBM bytes of the engine's "
+                                 "recurrent-state planes (states + rollback "
+                                 "checkpoint ring); constant w.r.t. "
+                                 "generated length by construction")
     spec_decode: bool = Field(False, description="Speculative decoding "
                               "active on this engine (PENROZ_SPEC_DECODE=1; "
                               "greedy engines verify by argmax match, "
@@ -653,6 +660,10 @@ class ServingStatsResponse(BaseModel):
     lora_adapter_tokens: dict[str, int] = Field(
         default_factory=dict, description="Aggregate tokens emitted per "
         "adapter id")
+    ssm_rows: int = Field(0, description="Aggregate in-flight rows carrying "
+                          "O(1) recurrent (SSM) state")
+    ssm_state_bytes: int = Field(0, description="Aggregate HBM bytes of "
+                                 "recurrent-state planes across engines")
     spec_decode_enabled: bool = Field(False, description="PENROZ_SPEC_DECODE"
                                       "=1 (greedy engines draft via prompt "
                                       "lookup + multi-token verify steps)")

@@ -261,6 +261,13 @@ function renderServing(data) {
   const loraAdapters = data.lora_active_adapters || 0;
   const loraTxt = loraAdapters === 0 ? "lora off"
     : `lora ${loraAdapters} adapters · ${data.lora_rows || 0} rows`;
+  /* Constant-memory sequence rows (ops/ssm.py): rows carrying O(1)
+   * recurrent state and the (generation-length-independent) HBM bytes of
+   * their state planes — "ssm off" when no served arch has ssm blocks. */
+  const ssmBytes = data.ssm_state_bytes || 0;
+  const ssmTxt = ssmBytes === 0 ? "ssm off"
+    : `ssm ${data.ssm_rows || 0} rows · ` +
+      `${(ssmBytes / (1024 * 1024)).toFixed(1)}MB state`;
   const crashes = data.crashes_total || 0;
   const breakerTxt = data.breaker_open
     ? `breaker OPEN (${crashes} crashes, ${data.engine_resets || 0} resets)`
@@ -370,7 +377,8 @@ function renderServing(data) {
        : data.admission_latency_ms_p50.toFixed(1) + "ms"} · ` +
     `chunk stall p99 ${stall == null ? "—" : stall.toFixed(1) + "ms"} · ` +
     `${multistepTxt} · ` +
-    `${specTxt} · ${loraTxt} · ${prefixTxt} · ${qosTxt} · ${routerTxt} · ` +
+    `${specTxt} · ${loraTxt} · ${ssmTxt} · ${prefixTxt} · ${qosTxt} · ` +
+    `${routerTxt} · ` +
     `${disaggTxt} · ${pipeTxt} · ${tierTxt} · ${durTxt} · ` +
     `KV pool drops ${drops}`;
   servingHistory.push({ occ: occ * 100, tps });

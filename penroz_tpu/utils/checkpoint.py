@@ -532,6 +532,12 @@ def page_blob_nbytes(blob: dict) -> int:
     for key in ("k", "v", "k_scale", "v_scale"):
         for plane in blob.get(key, ()):
             total += int(plane.nbytes)
+    ssm = blob.get("ssm")
+    if ssm is not None:
+        # Recurrent rows hand off a constant-size state plane per SSM
+        # layer alongside (or instead of) the token-extent KV pages.
+        for plane in ssm.get("state", ()):
+            total += int(plane.nbytes)
     return total
 
 

@@ -65,7 +65,16 @@ re-stages through the host (``jnp.asarray(np.asarray(h))``), counted in
 the pipeline schedule — a raise propagates out of the tick like any
 stage failure would, and the worker's crash handler reallocates the
 WHOLE pipeline group through ``_alloc_state``: every stage's pool
-rebuilt, placement redone, strict memledger audit clean afterwards).
+rebuilt, placement redone, strict memledger audit clean afterwards),
+``ssm.scan`` (fired before each decode dispatch on engines whose arch
+carries recurrent/SSM blocks — a crash mid-scan drops the in-flight
+recurrent states with the rest of the engine state and ``_alloc_state``
+recovery replays greedy-identically from the journal, with no leaked
+``ssm_state`` bytes under the strict memledger audit),
+``ssm.handoff`` (fired inside the disaggregated-prefill export when the
+blob carries a recurrent-state plane — a failure falls back exactly like
+``disagg.handoff``: monolithic prefill on a decode replica, greedy
+parity preserved).
 Call counters are per-site and process-wide; tests reset them
 (and the parsed-spec cache) with :func:`reset`.
 """

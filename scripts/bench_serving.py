@@ -225,6 +225,17 @@ def _toy_gpt(d=256, heads=8, vocab=512, block=256, depth=4):
                {"softmaxlast": {"dim": -1}}])
 
 
+def _toy_hybrid(d=256, heads=8, vocab=512, block=256, depth=4,
+                ssm_every=2):
+    """Hybrid twin of :func:`_toy_gpt`: every ``ssm_every``-th block is a
+    gated linear-attention (O(1) recurrent state) block instead of
+    attention+KV (models/presets.py::hybrid_custom)."""
+    from penroz_tpu.models import presets
+    return presets.hybrid_custom(d=d, heads=heads, depth=depth, vocab=vocab,
+                                 block=block, dropout=0.0,
+                                 ssm_every=ssm_every)
+
+
 async def _bench(concurrency: int, max_new: int, block: int) -> dict:
     import numpy as np
     from aiohttp.test_utils import TestClient, TestServer
@@ -2694,6 +2705,7 @@ async def _bench_chaos() -> dict:
         "PENROZ_PREFIX_CACHE": "1",
         "PENROZ_PREFIX_CACHE_PAGES": "64",
     }
+    hybrid = site.startswith("ssm.")
     if site.startswith("disagg."):
         # the hand-off only executes with prefill replicas split out;
         # odd PENROZ_BENCH_CHAOS_AT ordinals crash an export, even ones
@@ -2702,6 +2714,15 @@ async def _bench_chaos() -> dict:
         env["PENROZ_DISAGG_PREFILL_REPLICAS"] = "1"
         if _env_i(decode_scheduler.REPLICAS_ENV, 1) < 2:
             env[decode_scheduler.REPLICAS_ENV] = "2"
+    if site == "ssm.handoff":
+        # the site fires mid-export only for archs with recurrent blocks
+        # and only on the disagg hand-off path; transport pinned to the
+        # host codec so each hand-off burns exactly one ordinal (the d2d
+        # path would re-stage through the host and burn two)
+        env["PENROZ_DISAGG_PREFILL"] = "1"
+        env["PENROZ_DISAGG_PREFILL_REPLICAS"] = "1"
+        env[decode_scheduler.REPLICAS_ENV] = "2"
+        env[decode_scheduler.DISAGG_TRANSPORT_ENV] = "host"
     if site == "disagg.rebalance":
         # the flip only executes with the elastic rebalancer on; an
         # absurd shrink threshold makes every submit request a 2->1
@@ -2785,9 +2806,10 @@ async def _bench_chaos() -> dict:
                              else None)
 
     try:
+        layers = (_toy_hybrid(d=128, depth=2, block=block) if hybrid
+                  else _toy_gpt(d=128, depth=2, block=block))
         resp = await client.post("/model/", json={
-            "model_id": "bench-chaos", "layers": _toy_gpt(
-                d=128, depth=2, block=block),
+            "model_id": "bench-chaos", "layers": layers,
             "optimizer": {"sgd": {"lr": 0.1}}})
         assert resp.status == 200, await resp.text()
 
@@ -2965,6 +2987,10 @@ async def _bench_chaos() -> dict:
             "pipe_handoffs": stats.get("pipe_handoffs", 0),
             "pipe_handoff_host_fallbacks": stats.get(
                 "pipe_handoff_host_fallbacks", 0),
+            # ssm.* evidence: the arch really carried recurrent rows
+            # (ssm.scan crashes surface as the ordinary crash/reset pair;
+            # ssm.handoff failures land in disagg_handoff_failures)
+            "ssm_state_bytes": stats.get("ssm_state_bytes", 0),
             "engine_resets": stats.get("engine_resets", 0),
             **extra,
             "parity_ok": parity_ok,
@@ -3142,6 +3168,139 @@ async def _bench_pipeline() -> dict:
                 os.environ[k] = v
 
 
+# ---------------------------------------------------------------------------
+# --hybrid: constant-memory sequence backends vs the all-attention twin
+# ---------------------------------------------------------------------------
+
+async def _bench_hybrid() -> dict:
+    """Hybrid (attention + ssm blocks) vs its all-attention twin at the
+    same d/depth/block — the capacity claim of the constant-memory
+    backends PR, measured two ways:
+
+    - capacity: per-row sequence-state bytes (KV pool rows + recurrent
+      planes, REAL allocated states, not formulas) and the max concurrent
+      rows a fixed HBM budget holds.  Headline gate: ``row_ratio`` —
+      hybrid must fit >= 1.5x the rows of the twin (every ssm block
+      replaces an O(T) KV pool with an O(1) state);
+    - serving: the same greedy workload through the unified scheduler for
+      both archs, with live ssm stats evidence (the hybrid engine reports
+      recurrent rows/bytes, the twin reports zero) and per-arch
+      throughput/ITL.
+
+    Scale knobs: ``PENROZ_BENCH_SERVING_BLOCK/_D/_DEPTH``,
+    ``PENROZ_BENCH_HBM_BUDGET_MB``, ``PENROZ_BENCH_REQUESTS``,
+    ``PENROZ_BENCH_MAX_NEW``.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+    from penroz_tpu.models.model import CompiledArch
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import app as app_mod
+    from penroz_tpu.serve import decode_scheduler
+
+    block = _env_i("PENROZ_BENCH_SERVING_BLOCK", 256)
+    d = _env_i("PENROZ_BENCH_SERVING_D", 128)
+    depth = _env_i("PENROZ_BENCH_SERVING_DEPTH", 4)
+    budget_mb = _env_i("PENROZ_BENCH_HBM_BUDGET_MB", 64)
+    requests = _env_i("PENROZ_BENCH_REQUESTS", 4)
+    max_new = _env_i("PENROZ_BENCH_MAX_NEW", 16)
+    env = {
+        decode_scheduler.ENABLE_ENV: "1",
+        decode_scheduler.MAX_ROWS_ENV: "4",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+
+    twins = {
+        "attn": _toy_gpt(d=d, depth=depth, block=block),
+        "hybrid": _toy_hybrid(d=d, depth=depth, block=block, ssm_every=2),
+    }
+
+    # -- capacity: real per-row state bytes at this block size ------------
+    capacity = {}
+    for name, layers in twins.items():
+        arch = CompiledArch.get(layers)
+        state = KV.create_kv_state(arch.kv_specs, 1, block, jnp.float32,
+                                   ssm_specs=arch.ssm_specs)
+        per_row = sum(state.hbm_components().values())
+        capacity[name] = {
+            "kv_layers": len(arch.kv_specs),
+            "ssm_layers": len(arch.ssm_specs),
+            "per_row_state_bytes": int(per_row),
+            "max_rows_at_budget": int(budget_mb * 2**20 // per_row),
+        }
+    row_ratio = (capacity["hybrid"]["max_rows_at_budget"]
+                 / max(capacity["attn"]["max_rows_at_budget"], 1))
+
+    # -- serving: the same workload through the unified scheduler ---------
+    client = TestClient(TestServer(app_mod.create_app()))
+    await client.start_server()
+    rng = np.random.default_rng(11)
+    prompts = [[int(t) for t in rng.integers(1, 255, 6 + (i % 3))]
+               for i in range(requests)]
+    serving = {}
+    try:
+        for name, layers in twins.items():
+            model_id = f"bench-{name}"
+            resp = await client.post("/model/", json={
+                "model_id": model_id, "layers": layers,
+                "optimizer": {"sgd": {"lr": 0.1}}})
+            assert resp.status == 200, await resp.text()
+
+            async def one(prompt):
+                resp = await client.post("/generate/", json={
+                    "model_id": model_id, "input": [prompt],
+                    "block_size": block, "max_new_tokens": max_new,
+                    "temperature": 0.0})
+                assert resp.status == 200, await resp.text()
+                return await resp.json()
+
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(*[one(p) for p in prompts])
+            elapsed = time.perf_counter() - t0
+            # solo replay parity: the batched scheduler output must match
+            # each request run alone (same contract the tests enforce)
+            parity_ok = True
+            for p, out in zip(prompts, outs):
+                solo = await one(p)
+                parity_ok = parity_ok and solo["tokens"] == out["tokens"]
+            resp = await client.get("/serving_stats/")
+            stats = await resp.json()
+            entry = next(e for e in stats["engines"]
+                         if e["model_id"] == model_id)
+            serving[name] = {
+                "requests": requests, "max_new": max_new,
+                "wall_s": round(elapsed, 3),
+                "tokens_per_sec": round(requests * max_new / elapsed, 2),
+                "itl_ms_p50": entry.get("itl_ms_p50"),
+                "ttft_ms_p99": entry.get("ttft_ms_p99"),
+                "ssm_rows_now": entry.get("ssm_rows", 0),
+                "ssm_state_bytes": entry.get("ssm_state_bytes", 0),
+                "parity_ok": parity_ok,
+            }
+    finally:
+        decode_scheduler.reset()
+        await client.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    return {
+        "mode": "hybrid", "block": block, "d": d, "depth": depth,
+        "hbm_budget_mb": budget_mb,
+        "capacity": capacity,
+        "row_ratio": round(row_ratio, 3),
+        "serving": serving,
+        "ok": (row_ratio >= 1.5
+               and serving["hybrid"]["ssm_state_bytes"] > 0
+               and serving["attn"]["ssm_state_bytes"] == 0
+               and all(s["parity_ok"] for s in serving.values())),
+    }
+
+
 def _emit(results: dict):
     line = json.dumps(results)
     print(line)
@@ -3157,7 +3316,7 @@ def main():
                          "--multi-adapter", "--multistep", "--mixed-slo",
                          "--chaos", "--ragged", "--memory", "--replicas",
                          "--disagg", "--disagg-elastic", "--sessions",
-                         "--restart", "--pipeline")]
+                         "--restart", "--pipeline", "--hybrid")]
     shared_prefix = "--shared-prefix" in sys.argv[1:]
     overload = "--overload" in sys.argv[1:]
     replicas = "--replicas" in sys.argv[1:]
@@ -3173,6 +3332,7 @@ def main():
     disagg = "--disagg" in sys.argv[1:]
     disagg_elastic = "--disagg-elastic" in sys.argv[1:]
     pipeline = "--pipeline" in sys.argv[1:]
+    hybrid = "--hybrid" in sys.argv[1:]
     if os.environ.get("PENROZ_BENCH_JSON_OUT"):
         # resolve before the chdir below so a relative path lands where the
         # caller (bench_watch.sh) expects it
@@ -3227,6 +3387,9 @@ def main():
         return
     if pipeline:
         _emit(asyncio.run(_bench_pipeline()))
+        return
+    if hybrid:
+        _emit(asyncio.run(_bench_hybrid()))
         return
     if disagg:
         _emit(asyncio.run(_bench_disagg()))

@@ -277,6 +277,42 @@ if [ "${CHAOS_FAST:-0}" != "1" ]; then
     echo "FAIL site=disagg.rebalance: disallowed statuses, parity break, or no role flip" >&2
     fail=1
   fi
+
+  # SSM recurrent-state sites (PR 18).  The bench serves a HYBRID
+  # (attention + ssm) model for ssm.* sites, so every combo carries real
+  # recurrent row state (gated on ssm_state_bytes > 0).
+  # - ssm.scan raises inside the recurrent prefill/decode scan update —
+  #   a mid-dispatch crash: the engine must reset (engine_resets > 0) and
+  #   re-admitted rows must replay greedy token-identical, proving the
+  #   recurrent planes were rebuilt, not resumed from poisoned state.
+  # - ssm.handoff raises mid-export of a recurrent row blob on the disagg
+  #   hand-off path (the bench pins the host transport so the d2d path
+  #   can't absorb the fault by re-staging): the prefill replica must fall
+  #   back to monolithic serving with parity, the failure counted in
+  #   disagg_handoff_failures, and the strict ledger clean on both sides.
+  for ssite in ${CHAOS_SSM_SITES:-ssm.scan ssm.handoff}; do
+    ran=$((ran + 1))
+    echo "=== chaos: site=$ssite hybrid=1 ===" >&2
+    out=$(PENROZ_BENCH_CHAOS_SITE="$ssite" \
+            PENROZ_RAGGED_ATTENTION=1 PENROZ_MEMLEDGER_STRICT=1 \
+            timeout 900 python scripts/bench_serving.py --chaos)
+    rc=$?
+    echo "$out"
+    if [ "$rc" -ne 0 ]; then
+      echo "FAIL site=$ssite rc=$rc" >&2
+      fail=1
+      continue
+    fi
+    case "$ssite" in
+      ssm.handoff) gate='r.get("ok") and r.get("disagg_handoff_failures", 0) > 0 and r.get("ssm_state_bytes", 0) > 0' ;;
+      *)           gate='r.get("ok") and r.get("engine_resets", 0) > 0 and r.get("ssm_state_bytes", 0) > 0' ;;
+    esac
+    if ! printf '%s' "$out" | python -c \
+        "import json,sys; r=json.loads(sys.stdin.read().strip().splitlines()[-1]); sys.exit(0 if ($gate) else 1)"; then
+      echo "FAIL site=$ssite: disallowed statuses, parity break, or site never fired" >&2
+      fail=1
+    fi
+  done
 fi
 
 if [ "$fail" -ne 0 ]; then

@@ -122,6 +122,24 @@ def toy_gpt_layers():
                {"softmaxlast": {"dim": -1}}])
 
 
+def _toy_hybrid(ssm_every: int):
+    from penroz_tpu.models import presets
+    return presets.hybrid_custom(d=32, heads=4, depth=2, vocab=64, block=16,
+                                 dropout=0.0, ssm_every=ssm_every)
+
+
+@pytest.fixture
+def toy_hybrid_layers():
+    """Two-block toy stack: block 0 is a gated-SSM block, block 1 attention."""
+    return _toy_hybrid(2)
+
+
+@pytest.fixture
+def toy_ssm_layers():
+    """Pure-SSM toy stack (no KV cache rows at all)."""
+    return _toy_hybrid(1)
+
+
 @pytest.fixture
 def toy_optimizer():
     return {"adamw": {"lr": 1e-3, "betas": [0.9, 0.95], "eps": 1e-8}}

@@ -199,6 +199,9 @@ async function runDashboardTests(src, fixtures) {
                `${fixtures.serving.lora_rows} rows`),
              "serving tile shows live LoRA adapters and bound rows");
     assertOk(servingMeta.includes(
+               `ssm ${fixtures.serving.ssm_rows} rows`),
+             "serving tile shows recurrent-state rows and bytes");
+    assertOk(servingMeta.includes(
                `quota shed ${fixtures.serving.quota_rejections}`),
              "serving tile shows tenant quota shed count");
     assertOk(servingMeta.includes(
@@ -357,6 +360,7 @@ async function runDashboardTests(src, fixtures) {
       prefix_cache_hit_rate: null, prefill_chunk_stall_ms_p99: null,
       spec_decode_enabled: false, spec_accept_rate: null,
       lora_active_adapters: 0, lora_rows: 0, lora_adapter_tokens: {},
+      ssm_rows: 0, ssm_state_bytes: 0,
       preemptions_total: 0, preempted_resume_cached_tokens: 0,
       tenant_tokens: {}, ttft_ms_p99_by_class: {} });
     const { document } = await runDashboard(src, {
@@ -373,6 +377,8 @@ async function runDashboardTests(src, fixtures) {
              "no tokens-per-step readout while speculation is off");
     assertOk(servingMeta.includes("lora off"),
              "serving tile shows 'lora off' with zero live adapters");
+    assertOk(servingMeta.includes("ssm off"),
+             "serving tile shows 'ssm off' with no recurrent-state bytes");
     assertOk(servingMeta.includes("qos idle"),
              "serving tile degrades to 'qos idle' with no QoS activity");
   }

@@ -570,6 +570,10 @@ def test_sessions_bench_smoke(tmp_path):
     assert delta["penroz_session_resume_ttft_ms_count"] > 0, delta
 
 
+# slow lane (tier1_budget): the subprocess smoke is the heaviest single
+# test in the gate; every fault site it drives stays fast via the
+# engine-level injection tests in the per-feature suites
+@pytest.mark.slow
 def test_chaos_matrix_fast_subset(tmp_path):
     """scripts/chaos_matrix.sh CHAOS_FAST=1: the qos.preempt x unified
     combo through the chaos overload bench — the injected

@@ -643,6 +643,9 @@ def _tiny_mixtral():
     return config, MixtralForCausalLM(config).eval()
 
 
+# slow lane (tier1_budget): MoE forward math stays fast via test_moe and
+# the qwen2-moe import gate; stacked-expert import parity rides slow
+@pytest.mark.slow
 def test_mixtral_import_logit_parity_and_generate(workdir):
     """Mixtral: sparse-MoE MLPs land on our stacked-expert module (dense
     dispatch reproduces HF's softmax->top-k->renormalize routing exactly);
@@ -686,6 +689,9 @@ def _tiny_olmo2():
     return config, Olmo2ForCausalLM(config).eval()
 
 
+# slow lane (tier1_budget): OLMo v1 keeps the family's import parity
+# fast; olmo2's unique qk-norm wiring is also pinned by qwen3
+@pytest.mark.slow
 def test_olmo2_import_logit_parity_and_generate(workdir):
     """OLMo-2: post-norm-only blocks (branch-tail rmsnorms, no input
     norms) and FLAT q/k RMS normalization over the whole projection before
@@ -989,6 +995,9 @@ def _tiny_phi3(partial_rotary_factor=1.0):
     return config, Phi3ForCausalLM(config).eval()
 
 
+# slow lane (tier1_budget): phi (shared-norm parallel branches) and neox
+# (partial rotary) keep the family's import seams fast
+@pytest.mark.slow
 @pytest.mark.parametrize("partial_rotary_factor", [pytest.param(1.0, marks=pytest.mark.slow), 0.5])
 def test_phi3_import_logit_parity_and_generate(workdir,
                                                partial_rotary_factor):
@@ -1178,6 +1187,8 @@ def _tiny_mpt(clip_qkv=None):
     return config, MptForCausalLM(config).eval()
 
 
+# slow lane (tier1_budget): falcon-rw keeps ALiBi import parity fast
+@pytest.mark.slow
 @pytest.mark.parametrize("clip_qkv", [None, pytest.param(4.0, marks=pytest.mark.slow)])
 def test_mpt_import_logit_parity_and_generate(workdir, clip_qkv):
     """MPT: ALiBi (MPT's slope·(k−T+1) absolute form is softmax-shift-
@@ -1321,6 +1332,9 @@ def test_gemma2_softcapping_and_query_scale_parity(workdir):
     assert toks == _greedy_rollout(model, [1, 2, 3], 6)
 
 
+# slow lane (tier1_budget): gemma2 parity + softcap/query-scale + sliding
+# layers stay fast as the family's architectural twin
+@pytest.mark.slow
 def test_gemma3_import_logit_parity_and_generate(workdir):
     """Gemma-3: per-head q/k RMS norms (zero-centered weights, +1 at
     import), rope_local_base_freq on sliding layers, LINEAR rope scaling

@@ -819,3 +819,240 @@ def test_export_import_row_pages_property(cls, length, pages, device):
     # the destination's untouched row stays zero — no bleed past the scatter
     assert float(np.abs(np.asarray(
         dst._gather(dst.k[0]), np.float32)[0, :, :length]).max()) == 0.0
+
+
+# -- SequenceState protocol conformance (constant-memory backends) ----------
+#
+# One parametrized driver pushes EVERY backend — the four O(T) KV variants
+# and the O(1) recurrent SSMState — through the scheduler's full row
+# lifecycle.  The protocol in ops/kv_cache.py is only worth its name if a
+# single test body can exercise all of them.
+
+from penroz_tpu.ops.ssm import SSMState  # noqa: E402
+
+_SEQ_T = 3
+
+
+class _KVHarness:
+    """Adapter driving one KV cache variant through the shared contract."""
+
+    specs = [(2, 4), (2, 4)]
+
+    def __init__(self, cls, kw):
+        self.cls, self.kw = cls, kw
+        self.name = cls.__name__
+
+    def batch(self):
+        return (self.cls.create(self.specs, batch=2, max_len=8, **self.kw)
+                .with_static_table().with_lengths([0, 0]))
+
+    def prefilled_single(self, seed, tokens=_SEQ_T):
+        state, _ = _prefilled_single(self.cls, self.specs, 8, tokens,
+                                     seed=seed, **self.kw)
+        return state
+
+    def row_len(self, st, row):
+        arr = np.asarray(st.length)
+        return int(arr[row] if arr.ndim else arr)
+
+    def fingerprint(self, st, row, length):
+        """Stored K content (raw — quantized codes compare exactly between
+        two caches of the same class) of the row's first ``length`` slots."""
+        outs = []
+        for layer in range(len(self.specs)):
+            read = (st._gather(st.k[layer])
+                    if isinstance(st, KV.PagedKVState) else st.k[layer])
+            outs.append(np.asarray(read, np.float32)[row, :, :length])
+        return np.stack(outs)
+
+    def rollback_reference(self, seed, tokens):
+        """Ground truth after rewinding to ``tokens``: per-token storage is
+        position-independent, so it is the committed prefix of the original
+        prefill."""
+        return self.fingerprint(self.prefilled_single(seed), 0, tokens)
+
+
+class _SSMHarness:
+    """Adapter driving the O(1) recurrent backend through the contract."""
+
+    name = "SSMState"
+    specs = [(2, 4, 4), (2, 4, 4)]
+
+    def batch(self):
+        return SSMState.create(self.specs, batch=2)
+
+    def _stream(self, seed):
+        rng = np.random.default_rng(seed)
+        H, dk, dv = self.specs[0]
+        q = rng.normal(size=(1, _SEQ_T, H, dk)).astype(np.float32)
+        k = rng.normal(size=(1, _SEQ_T, H, dk)).astype(np.float32)
+        v = rng.normal(size=(1, _SEQ_T, H, dv)).astype(np.float32)
+        g = rng.uniform(0.5, 0.95, size=(1, _SEQ_T, H)).astype(np.float32)
+        return q, k, v, g
+
+    def prefilled_single(self, seed, tokens=_SEQ_T):
+        st = SSMState.create(self.specs, batch=1)
+        q, k, v, g = self._stream(seed)
+        for layer in range(len(self.specs)):
+            st.update_dense(layer, jnp.asarray(q[:, :tokens]),
+                            jnp.asarray(k[:, :tokens]),
+                            jnp.asarray(v[:, :tokens]),
+                            jnp.asarray(g[:, :tokens]), start=0)
+        return st
+
+    def row_len(self, st, row):
+        # O(1) state has no positional extent; "length" is whatever the
+        # rollback checkpoint ring remembers (-1 slots are empty)
+        return max(int(np.asarray(st.ckpt_pos)[row].max()), 0)
+
+    def fingerprint(self, st, row, length=None):
+        return np.stack([np.asarray(s, np.float32)[row] for s in st.state])
+
+    def rollback_reference(self, seed, tokens):
+        return self.fingerprint(self.prefilled_single(seed, tokens), 0)
+
+
+_SEQ_IMPLS = [
+    _KVHarness(KV.KVState, {}),
+    _KVHarness(KV.QuantKVState, {}),
+    _KVHarness(KV.PagedKVState, {"page_size": 4}),
+    _KVHarness(KV.QuantPagedKVState, {"page_size": 4}),
+    _SSMHarness(),
+]
+
+
+@pytest.mark.parametrize("h", _SEQ_IMPLS, ids=lambda h: h.name)
+def test_sequence_state_protocol_runtime_checkable(h):
+    """Every backend satisfies the runtime-checkable protocol — the
+    scheduler's row plumbing needs no isinstance branches on the cache
+    flavor."""
+    assert isinstance(h.batch(), KV.SequenceState)
+    assert isinstance(h.prefilled_single(seed=0), KV.SequenceState)
+
+
+@pytest.mark.parametrize("h", _SEQ_IMPLS, ids=lambda h: h.name)
+def test_sequence_state_contract_roundtrip(h):
+    """Full row lifecycle on every backend: admit a prefilled batch-1
+    state -> view/merge round-trip (the in-dispatch access path) ->
+    exact rollback -> recycle the slot -> global reset."""
+    src = h.prefilled_single(seed=5)
+    st = h.batch().insert_row(1, src)
+    assert h.row_len(st, 0) == 0
+    assert h.row_len(st, 1) == _SEQ_T
+    np.testing.assert_array_equal(h.fingerprint(st, 1, _SEQ_T),
+                                  h.fingerprint(src, 0, _SEQ_T))
+
+    # row_view + merge_row is lossless (chunked prefill / verify seam)
+    merged = st.merge_row(1, st.row_view(1, _SEQ_T))
+    assert h.row_len(merged, 1) == _SEQ_T
+    np.testing.assert_array_equal(h.fingerprint(merged, 1, _SEQ_T),
+                                  h.fingerprint(st, 1, _SEQ_T))
+
+    # rollback_row rewinds EXACTLY to the committed prefix: bit-identical
+    # to a fresh prefill of only the first two stream entries (for the
+    # recurrent backend this exercises the checkpoint ring)
+    rolled = merged.rollback_row(1, 2)
+    assert h.row_len(rolled, 1) == 2
+    np.testing.assert_array_equal(h.fingerprint(rolled, 1, 2),
+                                  h.rollback_reference(seed=5, tokens=2))
+    # rollback to zero clears the row entirely
+    zeroed = merged.rollback_row(1, 0)
+    assert h.row_len(zeroed, 1) == 0
+
+    # recycle one slot, then reset the whole batch
+    recycled = rolled.reset_row(1)
+    assert h.row_len(recycled, 1) == 0
+    cleared = recycled.reset()
+    assert h.row_len(cleared, 0) == 0 and h.row_len(cleared, 1) == 0
+
+
+def test_sequence_state_insert_rejects_spec_mismatch():
+    """The recurrent backend mirrors the KV variants' typed admission
+    errors: mismatched layer specs are a ValueError, not silent garbage."""
+    dst = SSMState.create([(2, 4, 4)], batch=2)
+    src = SSMState.create([(2, 4, 8)], batch=1)
+    with pytest.raises(ValueError, match="specs"):
+        dst.insert_row(0, src)
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_ssm_export_import_row_roundtrip(device):
+    """Hand-off codec for the O(1) backend, both transports: the exported
+    blob is the constant-size live state (no token extent), and importing
+    it into a different pool/row reproduces the state exactly with an
+    empty checkpoint ring."""
+    import jax
+    h = _SSMHarness()
+    src = h.prefilled_single(seed=9)
+    blob = src.export_row_pages(0, _SEQ_T, device=device)
+    kind = jax.Array if device else np.ndarray
+    assert all(isinstance(p, kind) for p in blob["state"])
+    assert [tuple(s) for s in blob["specs"]] == [tuple(s) for s in h.specs]
+    # constant-size: the blob holds exactly the per-layer state planes,
+    # independent of how many tokens produced them
+    assert sum(int(np.asarray(p).nbytes) for p in blob["state"]) == \
+        sum(4 * H * dk * dv for (H, dk, dv) in h.specs)
+
+    dst = h.batch().import_row_pages(1, blob)
+    np.testing.assert_array_equal(h.fingerprint(dst, 1),
+                                  h.fingerprint(src, 0))
+    # untouched row stays zero; the imported row's ring starts empty
+    assert float(np.abs(h.fingerprint(dst, 0)).max()) == 0.0
+    assert int(np.asarray(dst.ckpt_pos)[1].max()) == -1
+
+
+@pytest.mark.parametrize("cls", [KV.PagedKVState, KV.QuantPagedKVState])
+def test_ssm_blob_rides_paged_kv_handoff(cls):
+    """Hybrid hand-off: a paged pool with a recurrent child exports ONE
+    blob carrying both the token-extent pages and the constant-size
+    state planes; page_blob_nbytes accounts for both; import installs
+    both sides."""
+    from penroz_tpu.utils import checkpoint
+    specs = [(1, 4)]
+    ssm_specs = [(2, 4, 4)]
+    src = cls.create(specs, batch=2, max_len=8, page_size=4) \
+        .with_static_table().with_lengths([0, 0])
+    src.ssm = SSMState.create(ssm_specs, batch=2)
+    view = src.row_view(0, 0)
+    rng = np.random.default_rng(11)
+    k = jnp.asarray(rng.normal(size=(1, 1, _SEQ_T, 4)).astype(np.float32))
+    view.append_rows(0, k, 2 * k)
+    q = jnp.asarray(rng.normal(size=(1, _SEQ_T, 2, 4)).astype(np.float32))
+    g = jnp.asarray(rng.uniform(0.5, 0.95,
+                                size=(1, _SEQ_T, 2)).astype(np.float32))
+    view.ssm.update_dense(0, q, q, q, g, start=0)
+    src = src.merge_row(0, view.advanced(_SEQ_T))
+
+    blob = src.export_row_pages(0, _SEQ_T)
+    assert "ssm" in blob
+    kv_planes = [*blob["k"], *blob["v"],
+                 *blob.get("k_scale", ()), *blob.get("v_scale", ())]
+    assert checkpoint.page_blob_nbytes(blob) == \
+        sum(int(p.nbytes) for p in kv_planes) + \
+        sum(int(np.asarray(p).nbytes) for p in blob["ssm"]["state"])
+
+    dst = cls.create(specs, batch=2, max_len=8, page_size=4) \
+        .with_static_table().with_lengths([0, 0])
+    dst.ssm = SSMState.create(ssm_specs, batch=2)
+    dst = dst.import_row_pages(1, blob)
+    np.testing.assert_array_equal(
+        np.asarray(dst._gather(dst.k[0]), np.float32)[1, :, :_SEQ_T],
+        np.asarray(src._gather(src.k[0]), np.float32)[0, :, :_SEQ_T])
+    np.testing.assert_array_equal(np.asarray(dst.ssm.state[0])[1],
+                                  np.asarray(src.ssm.state[0])[0])
+
+
+def test_hbm_components_reports_ssm_state():
+    """Byte attribution: a pool with a recurrent child reports its bytes
+    under the memledger's ``ssm_state`` component; without one the
+    component is zero (the key is always present for the gauge)."""
+    plain = KV.KVState.create([(1, 4)], batch=2, max_len=8)
+    assert plain.hbm_components()["ssm_state"] == 0
+    ssm = SSMState.create([(2, 4, 4)], batch=2)
+    hybrid = KV.KVState.create([(1, 4)], batch=2, max_len=8)
+    hybrid.ssm = ssm
+    comps = hybrid.hbm_components()
+    assert comps["ssm_state"] == ssm.nbytes() > 0
+    assert "ssm_state" in __import__(
+        "penroz_tpu.serve.memledger", fromlist=["BYTE_COMPONENTS"]
+    ).BYTE_COMPONENTS

@@ -110,6 +110,9 @@ def _submit(engine, prompt, max_new, adapter=None):
 # THE parity matrix: mixed batch == isolated per-adapter engines
 # ---------------------------------------------------------------------------
 
+# the whole matrix rides the slow lane (tier1_budget): mixed-adapter
+# parity stays fast via test_mixed_adapter_superstep_parity[8] below
+@pytest.mark.slow
 @pytest.mark.parametrize("prefix_cache", [pytest.param(False,
                                                        marks=pytest.mark.slow),
                                           True],

@@ -232,7 +232,10 @@ def _oracle_drafter(bases):
     # superstep retirement seams covered at step 8
     pytest.param(1, 0, 4, 0, 0, 0, marks=pytest.mark.slow),
     (1, 1, 8, 0, 0, 0), (1, 0, 1, 1, 0, 0),
-    (1, 0, 1, 0, 1, 0), (1, 0, 1, 0, 0, 1)],
+    # lora/mesh attribution covered by the lora-serving crash-recovery
+    # and mixed-tenant attribution tests (tier1_budget slow lane)
+    pytest.param(1, 0, 1, 0, 1, 0, marks=pytest.mark.slow),
+    pytest.param(1, 0, 1, 0, 0, 1, marks=pytest.mark.slow)],
     ids=["fp-contig", "paged-prefix", "int8-paged-prefix", "superstep4",
          "int8-superstep8", "spec-paged-prefix", "lora-paged-prefix",
          "mesh-paged-prefix"])

@@ -539,6 +539,10 @@ def test_batched_generate_matches_single(workdir, toy_gpt_layers):
         assert out == single, (p, out, single)
 
 
+# the whole env-cache matrix rides the slow lane (tier1_budget): the
+# plain batched-vs-single parity test above stays fast, and every cache
+# layout is pinned by the kv_cache unit suite + scheduler parity matrices
+@pytest.mark.slow
 @pytest.mark.parametrize("paged,quant", [("1", "0"), ("0", "1"), ("1", "1")])
 def test_batched_generate_matches_single_env_caches(workdir, toy_gpt_layers,
                                                     monkeypatch, paged,

@@ -452,7 +452,8 @@ def _preempt_env(monkeypatch, superstep, int8):
                  marks=pytest.mark.slow),  # fp step-1 covered by int8-1 arm
     pytest.param(0, 8, id="fp-8",
                  marks=pytest.mark.slow),  # fp step-8 covered by int8-8 arm
-    pytest.param(1, 1, id="int8-1"),
+    pytest.param(1, 1, id="int8-1",
+                 marks=pytest.mark.slow),  # step-1 seam covered elsewhere
     pytest.param(1, 8, id="int8-8")])
 def test_preempt_resume_parity_matrix(gpt_model, make_engine, monkeypatch,
                                       superstep, int8):
@@ -483,6 +484,9 @@ def test_preempt_resume_parity_matrix(gpt_model, make_engine, monkeypatch,
     assert _all_pins(engine._prefix_cache) == 0   # every pin released
 
 
+# slow lane (tier1_budget): the preempt matrix [int8-8] and the LoRA
+# crash-recovery tests keep both halves of this composition fast
+@pytest.mark.slow
 def test_preempt_resume_parity_with_lora_adapter(gpt_model, make_engine,
                                                  monkeypatch):
     """The mixed-LoRA clause: the victim decodes through a LoRA adapter —

@@ -149,7 +149,12 @@ def test_router_all_replicas_open_surfaces_circuit_error(gpt_model,
         _submit(router, prompt, 5)
 
 
-@pytest.mark.parametrize("replicas,affinity", [(1, "1"), (2, "1"), (2, "0")])
+# single-replica arms ride the slow lane (tier1_budget): a 1-replica
+# router is engine passthrough (the scheduler parity matrix pins it);
+# both 2-replica arms keep every real routing seam fast
+@pytest.mark.parametrize("replicas,affinity", [
+    pytest.param(1, "1", marks=pytest.mark.slow),
+    (2, "1"), (2, "0")])
 @pytest.mark.parametrize("prefix", [False, True])
 @pytest.mark.parametrize("superstep", ["1", "8"])
 def test_router_greedy_parity_matrix(gpt_model, monkeypatch, replicas,

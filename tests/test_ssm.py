@@ -1,0 +1,233 @@
+"""Constant-memory recurrent backend: the gated linear-attention scan.
+
+Three implementations of the same recurrence are cross-checked here —
+the token-sequential oracle (ops/ssm.py::gla_full_reference), the chunked
+SSD math (jnp twin + Pallas kernel in interpret mode), and the cached
+per-row scans (update_dense / update_packed) that serve decode — plus the
+checkpoint-ring rollback that spec-decode leans on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from penroz_tpu.ops import ssm
+from penroz_tpu.ops.pallas import ssm_scan
+
+
+def _inputs(B, T, H, dk, dv, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(B, T, H, dk)).astype(np.float32)
+    k = rng.normal(size=(B, T, H, dk)).astype(np.float32)
+    v = rng.normal(size=(B, T, H, dv)).astype(np.float32)
+    g = rng.uniform(0.05, 0.98, size=(B, T, H)).astype(np.float32)
+    return tuple(jnp.asarray(x) for x in (q, k, v, g))
+
+
+# -- full-sequence forms agree ----------------------------------------------
+
+@pytest.mark.parametrize("T,block_t", [(8, 8), (24, 8), (13, 8), (16, 16)])
+def test_chunked_reference_matches_sequential(T, block_t):
+    """The SSD chunk algebra == the token-by-token recurrence, including
+    ragged tails that need padding (13 % 8 != 0)."""
+    q, k, v, g = _inputs(2, T, 3, 4, 4, seed=T)
+    want = ssm.gla_full_reference(q, k, v, g)
+    got = ssm_scan.gla_chunked_reference(q, k, v, g, block_t=block_t)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("T,block_t", [(16, 8), (13, 8)])
+def test_pallas_kernel_matches_oracle_interpret(T, block_t):
+    """The Pallas kernel (interpret mode on CPU) == the sequential oracle:
+    the carry-in-scratch chunk loop implements the exact recurrence."""
+    q, k, v, g = _inputs(2, T, 2, 8, 8, seed=3)
+    want = ssm.gla_full_reference(q, k, v, g)
+    got = ssm_scan.gla_chunked(q, k, v, g, block_t=block_t, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gla_full_dispatch_cpu_and_training():
+    """On CPU (and always under training=True) gla_full routes to the
+    differentiable scan oracle — the kernel defines no VJP."""
+    q, k, v, g = _inputs(1, 6, 2, 4, 4, seed=9)
+    want = ssm.gla_full_reference(q, k, v, g)
+    for training in (False, True):
+        got = ssm.gla_full(q, k, v, g, training=training)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+    # and it is differentiable end to end
+    grad = jax.grad(lambda qq: ssm.gla_full(qq, k, v, g,
+                                            training=True).sum())(q)
+    assert np.isfinite(np.asarray(grad)).all()
+
+
+# -- cached scans (the decode path) -----------------------------------------
+
+def test_update_dense_matches_full_recompute():
+    """Feeding the stream through the cached state in two chunks produces
+    the same outputs (and final state) as the uncached full scan."""
+    B, T, H, dk, dv = 2, 10, 2, 4, 4
+    q, k, v, g = _inputs(B, T, H, dk, dv, seed=1)
+    want = ssm.gla_full_reference(q, k, v, g)
+
+    st = ssm.SSMState.create([(H, dk, dv)], batch=B)
+    cut = 6
+    y1 = st.update_dense(0, q[:, :cut], k[:, :cut], v[:, :cut], g[:, :cut],
+                         start=0)
+    y2 = st.update_dense(0, q[:, cut:], k[:, cut:], v[:, cut:], g[:, cut:],
+                         start=cut)
+    got = jnp.concatenate([y1, y2], axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # final state == decayed sum the oracle would carry
+    s_ref = ssm.SSMState.create([(H, dk, dv)], batch=B)
+    s_ref.update_dense(0, q, k, v, g, start=0)
+    np.testing.assert_allclose(np.asarray(st.state[0]),
+                               np.asarray(s_ref.state[0]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_update_packed_matches_dense():
+    """The unified ragged dispatch (packed slots + block descriptors) is
+    numerically identical to per-row dense updates — including dropped
+    invalid tail slots and rows at different offsets."""
+    H, dk, dv = 2, 4, 4
+    B, block_q = 3, 4
+    # row 0: 3 tokens at offset 2; row 2: 4 tokens at offset 0; row 1 idle
+    counts = {0: 3, 2: 4}
+    starts = {0: 2, 2: 0}
+
+    def advance_row(st, row, q, k, v, g, start):
+        view = st.row_view(row)
+        y = view.update_dense(0, q, k, v, g, start=start)
+        return st.merge_row(row, view), y
+
+    dense = ssm.SSMState.create([(H, dk, dv)], batch=B)
+    packed = ssm.SSMState.create([(H, dk, dv)], batch=B)
+    # pre-advance row 0 identically in both so its offset of 2 is real
+    rng = np.random.default_rng(7)
+    pre_q = jnp.asarray(rng.normal(size=(1, 2, H, dk)).astype(np.float32))
+    pre_g = jnp.asarray(rng.uniform(0.1, 0.9,
+                                    size=(1, 2, H)).astype(np.float32))
+    dense, _ = advance_row(dense, 0, pre_q, pre_q, pre_q, pre_g, 0)
+    packed, _ = advance_row(packed, 0, pre_q, pre_q, pre_q, pre_g, 0)
+
+    # per-row fresh tokens
+    tok = {r: _inputs(1, counts[r], H, dk, dv, seed=20 + r)
+           for r in counts}
+
+    y_dense = {}
+    for r, (q, k, v, g) in tok.items():
+        dense, y_dense[r] = advance_row(dense, r, q, k, v, g, starts[r])
+
+    # pack [row0 | row2] into block_q slots each, with invalid tails
+    def pad_t(x, n):
+        padw = [(0, 0), (0, n - x.shape[1])] + [(0, 0)] * (x.ndim - 2)
+        return jnp.pad(x, padw)
+
+    qp = jnp.concatenate([pad_t(tok[r][0], block_q) for r in (0, 2)], axis=1)
+    kp = jnp.concatenate([pad_t(tok[r][1], block_q) for r in (0, 2)], axis=1)
+    vp = jnp.concatenate([pad_t(tok[r][2], block_q) for r in (0, 2)], axis=1)
+    gp = jnp.concatenate([pad_t(tok[r][3], block_q) for r in (0, 2)], axis=1)
+    descs = jnp.asarray([[0, starts[0], counts[0], 0],
+                         [2, starts[2], counts[2], 0]], jnp.int32)
+    y_packed = packed.update_packed(0, qp, kp, vp, gp, descs, block_q)
+
+    np.testing.assert_allclose(np.asarray(y_packed)[0, :counts[0]],
+                               np.asarray(y_dense[0])[0], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(y_packed)[0, block_q:block_q + counts[2]],
+        np.asarray(y_dense[2])[0], rtol=1e-5, atol=1e-5)
+    # states identical for active rows, idle row untouched
+    np.testing.assert_allclose(np.asarray(packed.state[0]),
+                               np.asarray(dense.state[0]), rtol=1e-5,
+                               atol=1e-5)
+    assert float(np.abs(np.asarray(packed.state[0])[1]).max()) == 0.0
+    np.testing.assert_array_equal(np.asarray(packed.ckpt_pos),
+                                  np.asarray(dense.ckpt_pos))
+
+
+# -- checkpoint ring / rollback ---------------------------------------------
+
+def test_rollback_ring_every_recent_length_exact():
+    """Every length the ring still holds rewinds bit-exactly: after T
+    tokens, rollback to each of the last C lengths equals a fresh scan of
+    that prefix (the spec-decode reject path for any accept count)."""
+    H, dk, dv = 2, 4, 4
+    T = 9
+    q, k, v, g = _inputs(1, T, H, dk, dv, seed=5)
+    st = ssm.SSMState.create([(H, dk, dv)], batch=1, ckpt_slots=4)
+    st.update_dense(0, q, k, v, g, start=0)
+    for L in range(T - 4 + 1, T + 1):
+        rolled = st.rollback_row(0, L)
+        ref = ssm.SSMState.create([(H, dk, dv)], batch=1, ckpt_slots=4)
+        ref.update_dense(0, q[:, :L], k[:, :L], v[:, :L], g[:, :L], start=0)
+        np.testing.assert_array_equal(np.asarray(rolled.state[0]),
+                                      np.asarray(ref.state[0]))
+    # rollback to 0 restores zeros and empties the ring
+    zeroed = st.rollback_row(0, 0)
+    assert float(np.abs(np.asarray(zeroed.state[0])).max()) == 0.0
+    assert int(np.asarray(zeroed.ckpt_pos).max()) == -1
+
+
+def test_rollback_invalidates_discarded_future():
+    """After rewinding to L, slots holding positions > L are cleared — a
+    later rollback can never resurrect a rejected future."""
+    H, dk, dv = 1, 4, 4
+    q, k, v, g = _inputs(1, 6, H, dk, dv, seed=8)
+    st = ssm.SSMState.create([(H, dk, dv)], batch=1, ckpt_slots=8)
+    st.update_dense(0, q, k, v, g, start=0)
+    rolled = st.rollback_row(0, 3)
+    pos = np.asarray(rolled.ckpt_pos)[0]
+    assert pos.max() == 3
+    assert not ((pos > 3).any())
+    # and only the target row is touched in a batch
+    st2 = ssm.SSMState.create([(H, dk, dv)], batch=2, ckpt_slots=8)
+    st2.update_dense(0, jnp.tile(q, (2, 1, 1, 1)), jnp.tile(k, (2, 1, 1, 1)),
+                     jnp.tile(v, (2, 1, 1, 1)), jnp.tile(g, (2, 1, 1)),
+                     start=0)
+    before = np.asarray(st2.state[0])[1].copy()
+    rolled2 = st2.rollback_row(0, 2)
+    np.testing.assert_array_equal(np.asarray(rolled2.state[0])[1], before)
+
+
+def test_rollback_works_under_jit_with_traced_args():
+    """row and length may be traced scalars — one compiled program serves
+    every slot (the scheduler's requirement)."""
+    H, dk, dv = 1, 4, 4
+    q, k, v, g = _inputs(1, 5, H, dk, dv, seed=4)
+    st = ssm.SSMState.create([(H, dk, dv)], batch=1)
+    st.update_dense(0, q, k, v, g, start=0)
+    rb = jax.jit(lambda s, r, L: s.rollback_row(r, L))
+    rolled = rb(st, jnp.asarray(0, jnp.int32), jnp.asarray(3, jnp.int32))
+    ref = ssm.SSMState.create([(H, dk, dv)], batch=1)
+    ref.update_dense(0, q[:, :3], k[:, :3], v[:, :3], g[:, :3], start=0)
+    np.testing.assert_array_equal(np.asarray(rolled.state[0]),
+                                  np.asarray(ref.state[0]))
+
+
+def test_ckpt_slots_default_tracks_spec_decode(monkeypatch):
+    monkeypatch.delenv("PENROZ_SSM_CKPT", raising=False)
+    monkeypatch.delenv("PENROZ_SPEC_DECODE", raising=False)
+    assert ssm.ckpt_slots_default() == 8
+    monkeypatch.setenv("PENROZ_SSM_CKPT", "3")
+    assert ssm.ckpt_slots_default() == 3
+    # a spec-decode verify block of K tokens needs K+2 restorable lengths
+    monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+    monkeypatch.setenv("PENROZ_SPEC_K", "9")  # K rides PENROZ_SPEC_DECODE
+    assert ssm.ckpt_slots_default() >= 3
+
+
+def test_nbytes_constant_in_generated_length():
+    """The whole point: state bytes do not grow with tokens."""
+    H, dk, dv = 2, 4, 4
+    st = ssm.SSMState.create([(H, dk, dv)], batch=1)
+    size0 = st.nbytes()
+    for start in range(0, 64, 8):
+        q, k, v, g = _inputs(1, 8, H, dk, dv, seed=start)
+        st.update_dense(0, q, k, v, g, start=start)
+        assert st.nbytes() == size0

@@ -1,0 +1,460 @@
+"""Serving tests for the constant-memory sequence backends (ops/ssm.py).
+
+Hybrid (attention + ssm blocks) and pure-SSM models ride the SAME unified
+continuous-batching scheduler as attention-only models — same admission,
+slot recycling, superstep dispatch, spec-decode verify/rollback, crash
+recovery, and disagg hand-off.  The parity contract is unchanged: every
+greedy sequence the scheduler returns must be token-identical to the same
+request run alone through the legacy single-sequence path.  On top of
+that, the defining property is asserted here: recurrent-state bytes do
+NOT grow with generated length.
+"""
+
+import queue
+import time
+
+import pytest
+
+from penroz_tpu.models.dsl import Mapper
+from penroz_tpu.models.model import NeuralNetworkModel
+
+# CI tier: heavier compiles (serving stack), same tier as test_app.
+pytestmark = pytest.mark.runtime
+
+BLOCK = 16
+SGD = {"sgd": {"lr": 0.1}}
+REP_PROMPT = [1, 2, 3, 1, 2, 3, 1, 2]
+
+
+@pytest.fixture(autouse=True)
+def _ssm_registry(workdir):
+    """Fresh engine registry + fault/QoS/ledger counters per test."""
+    from penroz_tpu.ops import kv_cache as KV
+    from penroz_tpu.serve import decode_scheduler, memledger, qos
+    from penroz_tpu.utils import faults
+
+    def _zero():
+        faults.reset()
+        qos.reset()
+        KV.reset_unpin_underflow_count()
+        memledger.reset()
+
+    _zero()
+    yield
+    decode_scheduler.reset()
+    _zero()
+
+
+@pytest.fixture
+def hybrid_model(workdir, toy_hybrid_layers):
+    """Serialized toy hybrid: block 0 gated-SSM, block 1 attention."""
+    model = NeuralNetworkModel("schedhyb", Mapper(toy_hybrid_layers, SGD))
+    model.serialize(sync_flush=True)
+    return model
+
+
+@pytest.fixture
+def pure_ssm_model(workdir, toy_ssm_layers):
+    """Serialized pure-SSM toy: every block recurrent, no KV rows at all."""
+    model = NeuralNetworkModel("schedpure", Mapper(toy_ssm_layers, SGD))
+    model.serialize(sync_flush=True)
+    return model
+
+
+@pytest.fixture
+def make_engine():
+    from penroz_tpu.serve import decode_scheduler
+    engines = []
+
+    def build(*args, **kwargs):
+        engine = decode_scheduler.DecodeEngine(*args, **kwargs)
+        engines.append(engine)
+        return engine
+
+    yield build
+    for engine in engines:
+        engine.shutdown()
+
+
+class _Collector:
+    def __init__(self, prompt):
+        self.q = queue.Queue()
+        self.tokens = list(prompt)
+        self.received = 0
+
+    def on_event(self, kind, value):
+        self.q.put((kind, value))
+
+    def result(self, timeout=180):
+        deadline = time.monotonic() + timeout
+        while True:
+            kind, value = self.q.get(
+                timeout=max(deadline - time.monotonic(), 0.1))
+            if kind == "token":
+                self.tokens.append(value)
+                self.received += 1
+            elif kind == "done":
+                return self.tokens
+            else:
+                raise value
+
+
+def _submit(target, prompt, max_new):
+    from penroz_tpu.serve import decode_scheduler
+    collector = _Collector(prompt)
+    target.submit(decode_scheduler.Request(prompt, max_new, None,
+                                           collector.on_event))
+    return collector
+
+
+# -- parity through the unified scheduler -----------------------------------
+
+def test_hybrid_concurrent_parity_and_state_bytes(hybrid_model, make_engine,
+                                                  monkeypatch):
+    """Two overlapping greedy requests on a hybrid model match the legacy
+    path exactly, the engine reports recurrent-state bytes, and those
+    bytes are IDENTICAL after a 2-token and a 10-token generation — the
+    O(1) claim at the stats surface."""
+    monkeypatch.setenv("PENROZ_MEMLEDGER_STRICT", "1")
+    p1, p2 = [1, 2, 3], [5]
+    base1 = hybrid_model.generate_tokens([p1], BLOCK, 10, temperature=0.0)
+    base2 = hybrid_model.generate_tokens([p2], BLOCK, 2, temperature=0.0)
+    engine = make_engine("schedhyb", BLOCK, 0.0, None, capacity=2)
+    c1 = _submit(engine, p1, 10)
+    c2 = _submit(engine, p2, 2)
+    assert c2.result() == base2
+    bytes_short = engine.stats()["ssm_state_bytes"]
+    assert c1.result() == base1
+    stats = engine.stats()
+    assert stats["ssm_state_bytes"] == bytes_short > 0
+    assert stats["ssm_rows"] == 0          # both rows retired
+    # the ledger attributes the same bytes to the ssm_state component
+    assert engine._ledger.snapshot()["hbm_bytes"]["ssm_state"] == bytes_short
+
+
+def test_pure_ssm_slot_recycling_parity(pure_ssm_model, make_engine):
+    """Capacity-2 pure-SSM engine serves 4 requests: recycled rows must
+    re-zero their recurrent state (the shared decode step advances EVERY
+    batch row, so a stale state would corrupt the newcomer — there is no
+    mask protecting SSM rows the way KV tails are mask-protected)."""
+    prompts = [[1, 2, 3], [5], [7, 8], [9, 10, 11, 12]]
+    bases = [pure_ssm_model.generate_tokens([p], BLOCK, 5, temperature=0.0)
+             for p in prompts]
+    engine = make_engine("schedpure", BLOCK, 0.0, None, capacity=2)
+    collectors = [_submit(engine, p, 5) for p in prompts]
+    for collector, base in zip(collectors, bases):
+        assert collector.result() == base
+    stats = engine.stats()
+    assert stats["completed"] == 4
+    assert stats["ssm_state_bytes"] > 0
+
+
+# superstep-1 arms are the slow half of the matrix (per-token dispatch);
+# one stays in tier-1 as the fast sibling, the rest ride the slow lane
+# (tier1_budget.py precedent — coverage kept, gate wall contained)
+@pytest.mark.parametrize("paged_prefix,int8,superstep", [
+    pytest.param(paged, int8, ss,
+                 marks=([pytest.mark.slow]
+                        if ss == "1" and (paged, int8) != (0, 0) else []))
+    for paged in (0, 1) for int8 in (0, 1) for ss in ("1", "8")])
+def test_hybrid_spec_parity_matrix(hybrid_model, make_engine, monkeypatch,
+                                   paged_prefix, int8, superstep):
+    """THE acceptance matrix for hybrid archs: greedy outputs with
+    PENROZ_SPEC_DECODE=1 are token-identical to the legacy path across
+    paged(+prefix-cache request) × int8 KV × superstep {1, 8} — with the
+    verify/rollback path provably engaged (oracle drafts, full
+    acceptance).  When a prefix cache is requested it is refused for SSM
+    archs (recurrent state cannot be rebuilt from shared pages)."""
+    from penroz_tpu.serve import decode_scheduler, spec_decode
+    if paged_prefix:
+        monkeypatch.setenv("PAGED_KV_CACHE", "1")
+        monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+        monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    if int8:
+        monkeypatch.setenv("TURBO_QUANT_KV_CACHE", "1")
+    monkeypatch.setenv(decode_scheduler.SUPERSTEP_ENV, superstep)
+    monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+    base = hybrid_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                        temperature=0.0)
+    def oracle(history, k, n):
+        if len(history) < len(base) and history == base[:len(history)]:
+            return [int(t) for t in base[len(history):len(history) + k]]
+        return []
+
+    monkeypatch.setattr(spec_decode, "propose", oracle)
+    engine = make_engine("schedhyb", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["spec_verify_steps"] > 0
+    assert stats["spec_accept_rate"] == 1.0
+    assert stats["ssm_state_bytes"] > 0
+    # prefix cache never engages for SSM archs
+    assert stats["prefix_cache"] is None
+
+
+def test_hybrid_adversarial_drafter_exact_rollback(hybrid_model,
+                                                   make_engine, monkeypatch):
+    """Satellite: spec-decode rollback symmetry.  An always-wrong drafter
+    forces a checkpoint-ring rewind on EVERY verify step; the stream must
+    still be token-identical (KV truncates, SSM restores — both exact)."""
+    from penroz_tpu.serve import spec_decode
+    monkeypatch.setenv("PENROZ_SPEC_DECODE", "1")
+    monkeypatch.setenv("PENROZ_SPEC_NGRAM", "1")
+    base = hybrid_model.generate_tokens([REP_PROMPT], BLOCK, 6,
+                                        temperature=0.0)
+
+    def wrong(history, k, n):
+        nxt = base[len(history)] if len(history) < len(base) else 0
+        return [(int(nxt) + 1) % 64] * min(k, 2)   # first token always wrong
+
+    monkeypatch.setattr(spec_decode, "propose", wrong)
+    engine = make_engine("schedhyb", BLOCK, 0.0, None, capacity=2)
+    assert _submit(engine, REP_PROMPT, 6).result() == base
+    stats = engine.stats()
+    assert stats["spec_drafted_tokens"] > 0
+    assert stats["spec_accepted_tokens"] == 0
+
+
+# -- feature gating ----------------------------------------------------------
+
+def test_prefix_cache_refused_for_ssm_arch(hybrid_model, make_engine,
+                                           monkeypatch):
+    """PENROZ_PREFIX_CACHE=1 on an SSM arch logs the refusal and leaves
+    the radix cache off — shared prefix pages cannot reconstitute a
+    recurrent state, so hibernate/preempt/promote stay disabled too.
+
+    Asserted via a logger-method spy, not caplog — other suite tests
+    reconfigure logging handlers, which silently empties caplog (same
+    workaround as test_attention's softcap-warning test)."""
+    from penroz_tpu.serve import decode_scheduler
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE", "1")
+    monkeypatch.setenv("PENROZ_PREFIX_CACHE_PAGES", "8")
+    warnings = []
+    monkeypatch.setattr(
+        decode_scheduler.log, "warning",
+        lambda msg, *args, **kw: warnings.append(msg % tuple(args)
+                                                 if args else msg))
+    engine = make_engine("schedhyb", BLOCK, 0.0, None, capacity=2)
+    assert any("SSM" in m for m in warnings), warnings
+    assert engine._prefix_cache is None
+    assert engine._extra_pages == 0
+    base = hybrid_model.generate_tokens([REP_PROMPT], BLOCK, 4,
+                                        temperature=0.0)
+    assert _submit(engine, REP_PROMPT, 4).result() == base
+    assert engine.stats()["prefix_cache"] is None
+
+
+def test_pipeline_stages_fall_back_for_ssm_arch(hybrid_model, make_engine,
+                                                monkeypatch):
+    """PENROZ_SERVE_PIPE_STAGES on an SSM arch falls back to unpiped
+    serving (stage KV views slice attention pools only) — requests still
+    complete with exact parity."""
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv("PENROZ_RAGGED_ATTENTION", "1")
+    monkeypatch.setenv("PENROZ_SERVE_PIPE_STAGES", "2")
+    base = hybrid_model.generate_tokens([[1, 2, 3]], BLOCK, 5,
+                                        temperature=0.0)
+    engine = make_engine("schedhyb", BLOCK, 0.0, None, capacity=2)
+    assert engine._pipe is None
+    assert _submit(engine, [1, 2, 3], 5).result() == base
+
+
+# -- fault injection ---------------------------------------------------------
+
+def test_ssm_scan_crash_recovers_with_parity(hybrid_model, make_engine,
+                                             monkeypatch):
+    """An injected ssm.scan crash mid-dispatch fails in-flight requests
+    cleanly, drops every recurrent state with the engine reset, and the
+    next request is greedy-identical — under the strict memledger audit
+    (no leaked ssm_state bytes)."""
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv("PENROZ_MEMLEDGER_STRICT", "1")
+    prompt = [1, 2, 3]
+    base = hybrid_model.generate_tokens([prompt], BLOCK, 6, temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "ssm.scan:raise@1")
+    engine = make_engine("schedhyb", BLOCK, 0.0, None, capacity=2)
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, prompt, 6).result()
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+    assert _submit(engine, prompt, 6).result() == base
+    stats = engine.stats()
+    assert stats["crashes_total"] == 1
+    assert stats["engine_resets"] == 1
+    assert stats["ssm_state_bytes"] > 0
+
+
+def test_pure_ssm_scan_crash_recovers(pure_ssm_model, make_engine,
+                                      monkeypatch):
+    """Same recovery contract on a pure-SSM arch (no KV pool at all)."""
+    from penroz_tpu.utils import faults
+    prompt = [7, 8, 9]
+    base = pure_ssm_model.generate_tokens([prompt], BLOCK, 5,
+                                          temperature=0.0)
+    monkeypatch.setenv(faults.ENV, "ssm.scan:raise@1")
+    engine = make_engine("schedpure", BLOCK, 0.0, None, capacity=2)
+    with pytest.raises(faults.InjectedFault):
+        _submit(engine, prompt, 5).result()
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+    assert _submit(engine, prompt, 5).result() == base
+    assert engine.stats()["engine_resets"] == 1
+
+
+# -- disaggregated hand-off --------------------------------------------------
+
+def _ssm_disagg_env(monkeypatch):
+    from penroz_tpu.serve import router as router_mod
+    monkeypatch.setenv("PAGED_KV_CACHE", "1")
+    monkeypatch.setenv("PENROZ_KV_PAGE_SIZE", "4")
+    monkeypatch.setenv("PENROZ_MEMLEDGER_STRICT", "1")
+    monkeypatch.setenv(router_mod.DISAGG_ENV, "1")
+    monkeypatch.setenv(router_mod.DISAGG_REPLICAS_ENV, "1")
+
+
+def _get_router(monkeypatch, model_id, n=2):
+    from penroz_tpu.serve import decode_scheduler, router
+    monkeypatch.setenv(decode_scheduler.REPLICAS_ENV, str(n))
+    engine = decode_scheduler.get_engine(model_id, BLOCK, 0.0, None)
+    assert isinstance(engine, router.EngineRouter)
+    return engine
+
+
+def test_hybrid_disagg_handoff_carries_recurrent_state(hybrid_model,
+                                                       monkeypatch):
+    """The O(1) hand-off: a hybrid request prefilled on the prefill
+    replica decodes on the decode replica with exact greedy parity — the
+    export blob carried the constant-size recurrent planes next to the
+    token-extent KV pages (a dropped state would desync every SSM block's
+    logits immediately)."""
+    from penroz_tpu.serve import decode_scheduler
+    _ssm_disagg_env(monkeypatch)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    base = hybrid_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    router = _get_router(monkeypatch, "schedhyb", n=2)
+    try:
+        assert [e.role for e in router.replicas] == ["prefill", "decode"]
+        assert _submit(router, prompt, 5).result() == base
+        per = [e.stats() for e in router.replicas]
+        assert sum(p["disagg_exports"] for p in per) == 1
+        assert sum(p["disagg_imports"] for p in per) == 1
+        assert sum(p["disagg_handoff_failures"] for p in per) == 0
+    finally:
+        decode_scheduler.reset()
+
+
+def test_hybrid_ssm_handoff_fault_falls_back_with_parity(hybrid_model,
+                                                         monkeypatch):
+    """An ssm.handoff crash mid-export (the new fault site fires only for
+    SSM archs) degrades exactly like disagg.handoff: monolithic prefill
+    on the decode replica, greedy-identical output, failure counted.
+    Transport pinned to the host codec — the d2d path re-stages through
+    it on failure, which would mask the fallback being asserted."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    _ssm_disagg_env(monkeypatch)
+    monkeypatch.setenv(decode_scheduler.DISAGG_TRANSPORT_ENV, "host")
+    monkeypatch.setenv(faults.ENV, "ssm.handoff:raise@1")
+    prompt = [1, 2, 3, 4, 5, 6, 7]
+    base = hybrid_model.generate_tokens([prompt], BLOCK, 5, temperature=0.0)
+    router = _get_router(monkeypatch, "schedhyb", n=2)
+    try:
+        assert _submit(router, prompt, 5).result() == base
+        per = [e.stats() for e in router.replicas]
+        assert sum(p["disagg_handoff_failures"] for p in per) == 1
+        assert sum(p["disagg_imports"] for p in per) == 0
+        assert per[1]["completed"] == 1
+    finally:
+        decode_scheduler.reset()
+
+
+# -- /memory/ polling: the O(1) acceptance criterion -------------------------
+
+@pytest.fixture
+def client(workdir):
+    import asyncio
+    from penroz_tpu.serve import app as app_mod
+    app_mod.model_locks.clear()
+    app_mod.dataset_locks.clear()
+    from aiohttp.test_utils import TestClient, TestServer
+    loop = asyncio.new_event_loop()
+    client = TestClient(TestServer(app_mod.create_app()), loop=loop)
+    loop.run_until_complete(client.start_server())
+    yield client, loop
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+def _get_json(client_loop, path):
+    import json
+    client, loop = client_loop
+
+    async def go():
+        resp = await client.request("GET", path)
+        body = await resp.read()
+        return resp.status, json.loads(body)
+
+    return loop.run_until_complete(go())
+
+
+def test_memory_endpoint_ssm_state_constant_while_length_grows(
+        hybrid_model, client, monkeypatch):
+    """THE acceptance poll: GET /memory/ reports an ssm_state HBM
+    component that is byte-identical at two different generated lengths
+    of a live row — recurrent state does not grow with tokens, observed
+    end to end through the public memory ledger (not just stats())."""
+    from penroz_tpu.serve import decode_scheduler
+    from penroz_tpu.utils import faults
+    monkeypatch.setenv(faults.ENV, "decode.step:sleep@120")  # slow decode
+    engine = decode_scheduler.get_engine("schedhyb", BLOCK, 0.0, None)
+    collector = _submit(engine, [1, 2, 3], 10)
+
+    def row_len():
+        with engine._cond:
+            return max((int(n) for n in engine._lengths), default=0)
+
+    def poll_ssm_state():
+        status, body = _get_json(client, "/memory/")
+        assert status == 200
+        entry = next(e for e in body["engines"]
+                     if e["model_id"] == "schedhyb")
+        return entry["hbm_bytes"]["ssm_state"], body["hbm_bytes"]["ssm_state"]
+
+    # sample once early and once later in the decode; require the row to
+    # have provably advanced between the samples
+    deadline = time.monotonic() + 120
+    while collector.received < 1:
+        assert time.monotonic() < deadline, "decode never started"
+        try:
+            kind, value = collector.q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        assert kind == "token", kind
+        collector.tokens.append(value)
+        collector.received += 1
+    len1 = row_len()
+    first, first_agg = poll_ssm_state()
+    assert first > 0 and first_agg == first
+    while collector.received < 6:
+        assert time.monotonic() < deadline, "decode stalled"
+        try:
+            kind, value = collector.q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        assert kind == "token", kind
+        collector.tokens.append(value)
+        collector.received += 1
+    len2 = row_len()
+    second, second_agg = poll_ssm_state()
+    assert len2 > len1                       # the sequence provably grew
+    assert second == first                   # ...the recurrent state did not
+    assert second_agg == first_agg
+    faults.reset()
+    monkeypatch.delenv(faults.ENV)
+    collector.result()
+    decode_scheduler.reset()

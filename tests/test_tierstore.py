@@ -325,7 +325,9 @@ def _wait_tier(sid, tier, timeout=60):
 
 
 @pytest.mark.parametrize("int8,superstep", [
-    (0, 1),
+    # fp step-1 rides the slow lane too (tier1_budget): the int8-step8
+    # diagonal keeps hibernate/resume parity fast
+    pytest.param(0, 1, marks=pytest.mark.slow),
     pytest.param(0, 8, marks=pytest.mark.slow),  # step8 covered by int8-step8
     pytest.param(1, 1, marks=pytest.mark.slow),  # int8 covered at step8
     (1, 8)],
